@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Headline benchmark: fp32 all-reduce busbw, 2 loopback peers.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best sustained all-reduce number is 45 Gbit/s
+(= 5.625 GB/s, collocated nodes, "limited only by NIC speed" —
+/root/reference/docs/md/01_Introduction.md:8; see BASELINE.md). vs_baseline is
+value / 5.625.
+"""
+
+import json
+import os
+import sys
+
+BASELINE_GBPS = 45.0 / 8.0  # 45 Gbit/s → GB/s
+
+
+def main() -> None:
+    nbytes = int(os.environ.get("PCCLT_BENCH_BYTES", str(64 << 20)))
+    iters = int(os.environ.get("PCCLT_BENCH_ITERS", "10"))
+
+    busbw = None
+    try:
+        from pccl_tpu.comm import native_bench  # native C++ stack, preferred
+
+        busbw = native_bench.run_allreduce_bench(nbytes=nbytes, iters=iters)
+        path = "native"
+    except Exception as e:  # noqa: BLE001 — fall back to pure-python path
+        print(f"bench: native path unavailable ({type(e).__name__}: {e}); "
+              "using python fallback", file=sys.stderr)
+        from pccl_tpu.comm import pybench
+
+        busbw = pybench.run_allreduce_bench(nbytes=nbytes, iters=iters)
+        path = "python-fallback"
+
+    print(json.dumps({
+        "metric": f"allreduce_busbw_fp32_2peer_loopback({path})",
+        "value": round(busbw, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(busbw / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""pccl_tpu — TPU-native fault-tolerant collective communications framework.
+
+Capabilities (parity with the PCCL reference, re-designed TPU-first):
+- fault-tolerant collective ops over plain TCP/IP with dynamic peer
+  join/leave at any point in training (pccl_tpu.comm);
+- bit-identical shared-state synchronization with hash-based drift detection;
+- on-the-wire quantization (min-max and zero-point/scale);
+- bandwidth-aware ring topology optimization (ATSP);
+- TPU device type: collectives on HBM-resident JAX arrays, hierarchical
+  reduction — jax.lax.psum over ICI inside a slice, CCoIP-style WAN ring
+  across slices (pccl_tpu.parallel.hierarchical).
+
+Native core: the runtime (sockets, wire protocol, master, ring reduce,
+quantization, hashing) is C++ in pccl_tpu/native, loaded via ctypes.
+"""
+
+from .version import __version__  # noqa: F401

@@ -1,0 +1,77 @@
+"""Device-mesh construction and sharding rules.
+
+This is the in-slice half of the framework's parallelism story: inside one TPU
+slice, scaling is expressed as `jax.sharding` annotations over a `Mesh` and XLA
+inserts the ICI collectives (psum / all-gather / reduce-scatter). Across
+slices, the CCoIP-equivalent WAN ring (pccl_tpu.comm) carries the traffic —
+see pccl_tpu/parallel/hierarchical.py.
+
+Capability parity note: the reference's only parallelism dimensions are
+data-parallel peers and peer groups (SURVEY.md §2.3 — e.g. FSDP×PCCL grid in
+/root/reference/docs/md/8_CommonFootguns.md). The TPU build adds in-slice
+tensor/sequence sharding because on TPU that is how a "peer" (slice) reaches
+its compute roofline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def factor_mesh(n: int, n_axes: int = 2) -> Tuple[int, ...]:
+    """Factor n devices into a balanced (dp, tp, ...) shape, dp first."""
+    dims = [1] * n_axes
+    rem = n
+    # greedily pull factors of 2 into tp (last axis) then dp
+    i = n_axes - 1
+    while rem % 2 == 0 and dims[i] < 8:
+        dims[i] *= 2
+        rem //= 2
+        if dims[i] >= 4:
+            i = max(0, i - 1)
+    dims[0] *= rem
+    return tuple(dims)
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None,
+              axis_names: Tuple[str, ...] = ("dp", "tp"),
+              shape: Tuple[int, ...] | None = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = factor_mesh(len(devices), len(axis_names))
+    arr = np.array(devices[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+# --- GPT sharding rules (keyed to pccl_tpu.models.gpt.init_params layout) ---
+
+GPT_PARAM_SPECS: Dict[str, P] = {
+    # vocab-parallel embedding (megatron-style); head is the transpose
+    "tok_emb": P("tp", None),
+    "ln1_g": P(None, None),
+    "ln2_g": P(None, None),
+    # column-parallel in-projections: shard output features over tp
+    "attn_qkv": P(None, None, "tp"),
+    "mlp_in": P(None, None, "tp"),
+    # row-parallel out-projections: shard input features over tp
+    "attn_out": P(None, "tp", None),
+    "mlp_out": P(None, "tp", None),
+    "lnf_g": P(None),
+}
+
+
+def gpt_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, spec) for k, spec in GPT_PARAM_SPECS.items()}
+
+
+def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
+    """Tokens [B, T]: batch over dp, optionally sequence over `seq_axis`."""
+    return NamedSharding(mesh, P("dp", seq_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,61 @@
+"""Leveled stream logger for the Python layer.
+
+Reference parity: the reference has a C++ stream logger with env-selected
+level (/root/reference/log/src/pccl_log.cpp:28-56, levels TRACE..FATAL).
+The native library has its own C++ logger (pccl_tpu/native/src/log.cpp)
+honouring the same env var; this module mirrors it Python-side so both
+halves of the framework log uniformly.
+
+Env: PCCLT_LOG_LEVEL in {TRACE, DEBUG, INFO, WARN, ERROR, FATAL}; default INFO.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+_LEVELS = {"TRACE": 0, "DEBUG": 1, "INFO": 2, "WARN": 3, "ERROR": 4, "FATAL": 5}
+_level_name = os.environ.get("PCCLT_LOG_LEVEL", "INFO").upper()
+_threshold = _LEVELS.get(_level_name, 2)
+_lock = threading.Lock()
+
+
+def set_level(name: str) -> None:
+    global _threshold
+    _threshold = _LEVELS.get(name.upper(), _threshold)
+
+
+def _log(level: str, msg: str) -> None:
+    if _LEVELS[level] < _threshold:
+        return
+    ts = time.strftime("%H:%M:%S", time.localtime())
+    tid = threading.get_ident() % 100000
+    with _lock:
+        print(f"[{ts}][{level:>5}][py:{tid}] {msg}", file=sys.stderr, flush=True)
+
+
+def trace(msg: str) -> None:
+    _log("TRACE", msg)
+
+
+def debug(msg: str) -> None:
+    _log("DEBUG", msg)
+
+
+def info(msg: str) -> None:
+    _log("INFO", msg)
+
+
+def warn(msg: str) -> None:
+    _log("WARN", msg)
+
+
+def error(msg: str) -> None:
+    _log("ERROR", msg)
+
+
+def fatal(msg: str) -> None:
+    _log("FATAL", msg)
+    raise SystemExit(1)

@@ -1,0 +1,12 @@
+"""Version / build info for pccl_tpu.
+
+Reference parity: pcclGetBuildInfo (/root/reference/include/pccl.h:458).
+"""
+
+__version__ = "0.1.0"
+
+BUILD_INFO = {
+    "name": "pccl_tpu",
+    "version": __version__,
+    "protocol": "PCCP/1",  # Pod Collective Communication Protocol, wire rev 1
+}

@@ -1,0 +1,29 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax init.
+
+Multi-chip sharding is validated on a virtual CPU mesh (no multi-chip TPU
+hardware in CI); the real-chip path is exercised by bench.py.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("PCCLT_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"  # tests run on a virtual CPU mesh, always
+    # jax may already be imported by a pytest plugin; config.update still works
+    # as long as no backend has been initialized yet.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]
