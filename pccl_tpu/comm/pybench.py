@@ -12,13 +12,14 @@ busbw for ring all-reduce = 2*(N-1)/N * bytes / time  (N=2 → bytes/time).
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import socket
 import threading
 import time
 
 import numpy as np
 
-_PORT = 47911
+_PORT = int(os.environ.get("PCCLT_BENCH_PORT", "47911"))
 
 
 def _send_all(sock: socket.socket, buf: memoryview) -> None:
@@ -67,15 +68,18 @@ def _peer_main(rank: int, nbytes: int, iters: int, port: int, q) -> None:
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("127.0.0.1", port))
         srv.listen(1)
+        srv.settimeout(30)
         sock, _ = srv.accept()
         srv.close()
     else:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        for _ in range(100):
+        for attempt in range(100):
             try:
                 sock.connect(("127.0.0.1", port))
                 break
             except OSError:
+                if attempt == 99:
+                    raise
                 time.sleep(0.05)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
@@ -101,8 +105,13 @@ def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10) -> float:
     port = _PORT
     p1 = ctx.Process(target=_peer_main, args=(1, nbytes, iters, port, None))
     p1.start()
-    _peer_main(0, nbytes, iters, port, q)
-    times = q.get(timeout=60)
-    p1.join(timeout=30)
+    try:
+        _peer_main(0, nbytes, iters, port, q)
+        times = q.get(timeout=60)
+        p1.join(timeout=30)
+    finally:
+        if p1.is_alive():
+            p1.terminate()
+            p1.join(timeout=5)
     med = sorted(times)[len(times) // 2]
     return (nbytes / med) / 1e9

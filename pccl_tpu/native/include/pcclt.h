@@ -1,0 +1,181 @@
+/* pcclt — public C99 API of the pccl_tpu native core.
+ *
+ * Reference parity: include/pccl.h of the reference (19 exported functions,
+ * /root/reference/include/pccl.h) — same capability surface with a TPU
+ * device type. Bulk data pointers are host memory; TPU (HBM) arrays are
+ * staged by the Python layer (pccl_tpu.comm) which owns the JAX side.
+ */
+#ifndef PCCLT_H
+#define PCCLT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PCCLT_EXPORT __attribute__((visibility("default")))
+
+typedef enum pccltResult_t {
+    pccltSuccess = 0,
+    pccltInvalidArgument = 1,
+    pccltNotConnected = 2,
+    pccltConnectionLost = 3,
+    pccltOperationAborted = 4,
+    pccltTooFewPeers = 5,
+    pccltDuplicateTag = 6,
+    pccltKicked = 7,
+    pccltMasterUnreachable = 8,
+    pccltInternalError = 9,
+    pccltContentMismatch = 10,
+    pccltPendingAsyncOps = 11,
+    pccltInvalidUsage = 12,
+} pccltResult_t;
+
+typedef enum pccltDataType_t {
+    pccltUint8 = 0,
+    pccltInt8 = 1,
+    pccltUint16 = 2,
+    pccltInt16 = 3,
+    pccltUint32 = 4,
+    pccltInt32 = 5,
+    pccltUint64 = 6,
+    pccltInt64 = 7,
+    pccltFloat16 = 8,
+    pccltBFloat16 = 9,
+    pccltFloat32 = 10,
+    pccltFloat64 = 11,
+} pccltDataType_t;
+
+typedef enum pccltDeviceType_t {
+    pccltDeviceHost = 0,
+    pccltDeviceTpu = 1, /* HBM-resident JAX array staged to host by bindings */
+} pccltDeviceType_t;
+
+typedef enum pccltRedOp_t {
+    pccltSum = 0,
+    pccltAvg = 1,
+    pccltProd = 2,
+    pccltMax = 3,
+    pccltMin = 4,
+} pccltRedOp_t;
+
+typedef enum pccltQuantAlgo_t {
+    pccltQuantNone = 0,
+    pccltQuantMinMax = 1,
+    pccltQuantZeroPointScale = 2,
+} pccltQuantAlgo_t;
+
+typedef enum pccltSyncStrategy_t {
+    pccltSyncEnforcePopular = 0,
+    pccltSyncReceiveOnly = 1,
+    pccltSyncSendOnly = 2,
+} pccltSyncStrategy_t;
+
+typedef enum pccltAttribute_t {
+    PCCLT_ATTR_GLOBAL_WORLD_SIZE = 0,
+    PCCLT_ATTR_PEER_GROUP_WORLD_SIZE = 1,
+    PCCLT_ATTR_NUM_DISTINCT_PEER_GROUPS = 2,
+    PCCLT_ATTR_LARGEST_PEER_GROUP_WORLD_SIZE = 3,
+} pccltAttribute_t;
+
+typedef struct pccltComm pccltComm_t;
+typedef struct pccltMaster pccltMaster_t;
+
+typedef struct pccltCommCreateParams_t {
+    const char *master_ip;   /* dotted quad */
+    uint16_t master_port;
+    uint32_t peer_group;
+    const char *advertised_ip; /* NULL = let master observe source address */
+    uint16_t p2p_port;       /* 0 = default base; bump-allocated upward */
+    uint16_t ss_port;
+    uint16_t bench_port;
+    uint32_t p2p_connection_pool_size; /* 0 = 1 */
+} pccltCommCreateParams_t;
+
+typedef struct pccltReduceDescriptor_t {
+    uint64_t tag;
+    pccltRedOp_t op;
+    pccltQuantAlgo_t quant_algo;
+    pccltDataType_t quant_dtype;
+} pccltReduceDescriptor_t;
+
+typedef struct pccltReduceInfo_t {
+    uint64_t tx_bytes;
+    uint64_t rx_bytes;
+    uint32_t world_size;
+} pccltReduceInfo_t;
+
+typedef struct pccltTensorInfo_t {
+    const char *name;
+    void *data;
+    uint64_t count;
+    pccltDataType_t dtype;
+    pccltDeviceType_t device;
+    int allow_content_inequality;
+} pccltTensorInfo_t;
+
+typedef struct pccltSharedState_t {
+    uint64_t revision;
+    uint64_t count;
+    pccltTensorInfo_t *infos;
+} pccltSharedState_t;
+
+typedef struct pccltSharedStateSyncInfo_t {
+    uint64_t tx_bytes;
+    uint64_t rx_bytes;
+    uint64_t revision;
+} pccltSharedStateSyncInfo_t;
+
+/* --- the 19-function surface --- */
+
+PCCLT_EXPORT pccltResult_t pccltInit(void);
+PCCLT_EXPORT const char *pccltGetBuildInfo(void);
+
+PCCLT_EXPORT pccltResult_t pccltCreateMaster(const char *listen_ip, uint16_t port,
+                                             pccltMaster_t **out);
+PCCLT_EXPORT pccltResult_t pccltRunMaster(pccltMaster_t *m);
+PCCLT_EXPORT pccltResult_t pccltInterruptMaster(pccltMaster_t *m);
+PCCLT_EXPORT pccltResult_t pccltMasterAwaitTermination(pccltMaster_t *m);
+PCCLT_EXPORT pccltResult_t pccltDestroyMaster(pccltMaster_t *m);
+PCCLT_EXPORT uint16_t pccltMasterPort(pccltMaster_t *m); /* bound port */
+
+PCCLT_EXPORT pccltResult_t pccltCreateCommunicator(const pccltCommCreateParams_t *params,
+                                                   pccltComm_t **out);
+PCCLT_EXPORT pccltResult_t pccltDestroyCommunicator(pccltComm_t *c);
+PCCLT_EXPORT pccltResult_t pccltConnect(pccltComm_t *c);
+PCCLT_EXPORT pccltResult_t pccltGetAttribute(pccltComm_t *c, pccltAttribute_t attr,
+                                             int64_t *out);
+PCCLT_EXPORT pccltResult_t pccltUpdateTopology(pccltComm_t *c);
+PCCLT_EXPORT pccltResult_t pccltArePeersPending(pccltComm_t *c, int *pending);
+PCCLT_EXPORT pccltResult_t pccltOptimizeTopology(pccltComm_t *c);
+
+PCCLT_EXPORT pccltResult_t pccltAllReduce(pccltComm_t *c, const void *sendbuf,
+                                          void *recvbuf, uint64_t count,
+                                          pccltDataType_t dtype,
+                                          const pccltReduceDescriptor_t *desc,
+                                          pccltReduceInfo_t *info);
+PCCLT_EXPORT pccltResult_t pccltAllReduceAsync(pccltComm_t *c, const void *sendbuf,
+                                               void *recvbuf, uint64_t count,
+                                               pccltDataType_t dtype,
+                                               const pccltReduceDescriptor_t *desc);
+PCCLT_EXPORT pccltResult_t pccltAwaitAsyncReduce(pccltComm_t *c, uint64_t tag,
+                                                 pccltReduceInfo_t *info);
+/* Launch all descriptors, await all; on failure retry completed world until
+ * all succeed or world < 2 (reference pcclAllReduceMultipleWithRetry). */
+PCCLT_EXPORT pccltResult_t pccltAllReduceMultipleWithRetry(
+    pccltComm_t *c, const void *const *sendbufs, void *const *recvbufs,
+    const uint64_t *counts, pccltDataType_t dtype,
+    const pccltReduceDescriptor_t *descs, uint64_t n_ops, pccltReduceInfo_t *infos);
+
+PCCLT_EXPORT pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c,
+                                                       pccltSharedState_t *state,
+                                                       pccltSyncStrategy_t strategy,
+                                                       pccltSharedStateSyncInfo_t *info);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PCCLT_H */

@@ -1,0 +1,276 @@
+// C API shim over the C++ client/master.
+// Reference parity: src/pccl.cpp (validation + enum translation over CCoIP).
+#include "../include/pcclt.h"
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "client.hpp"
+#include "log.hpp"
+#include "master.hpp"
+
+using pcclt::client::Client;
+using pcclt::client::ClientConfig;
+using pcclt::client::ReduceDesc;
+using pcclt::client::Status;
+using pcclt::master::Master;
+
+struct pccltComm {
+    Client *client;
+};
+struct pccltMaster {
+    Master *master;
+    bool launched = false;
+};
+
+namespace {
+
+pccltResult_t to_result(Status s) {
+    switch (s) {
+    case Status::kOk: return pccltSuccess;
+    case Status::kInvalid: return pccltInvalidArgument;
+    case Status::kNotConnected: return pccltNotConnected;
+    case Status::kConnectionLost: return pccltConnectionLost;
+    case Status::kAborted: return pccltOperationAborted;
+    case Status::kTooFewPeers: return pccltTooFewPeers;
+    case Status::kDuplicateTag: return pccltDuplicateTag;
+    case Status::kKicked: return pccltKicked;
+    case Status::kMasterUnreachable: return pccltMasterUnreachable;
+    case Status::kContentMismatch: return pccltContentMismatch;
+    default: return pccltInternalError;
+    }
+}
+
+pcclt::proto::DType to_dtype(pccltDataType_t d) {
+    return static_cast<pcclt::proto::DType>(d);
+}
+
+ReduceDesc to_desc(const pccltReduceDescriptor_t *d) {
+    ReduceDesc r;
+    r.tag = d->tag;
+    r.op = static_cast<pcclt::proto::RedOp>(d->op);
+    r.quant = static_cast<pcclt::proto::QuantAlgo>(d->quant_algo);
+    r.quant_dtype = to_dtype(d->quant_dtype);
+    return r;
+}
+
+void fill_info(pccltReduceInfo_t *out, const pcclt::client::ReduceInfo &in) {
+    if (!out) return;
+    out->tx_bytes = in.tx_bytes;
+    out->rx_bytes = in.rx_bytes;
+    out->world_size = in.world;
+}
+
+} // namespace
+
+extern "C" {
+
+pccltResult_t pccltInit(void) { return pccltSuccess; }
+
+const char *pccltGetBuildInfo(void) {
+    return "pcclt 0.1.0 (PCCP/1, tpu-native pccl-capability core)";
+}
+
+// ---------------- master ----------------
+
+pccltResult_t pccltCreateMaster(const char *listen_ip, uint16_t port,
+                                pccltMaster_t **out) {
+    (void)listen_ip; // listens on all interfaces
+    if (!out) return pccltInvalidArgument;
+    auto *m = new pccltMaster{new Master(port ? port : 48501)};
+    *out = m;
+    return pccltSuccess;
+}
+
+pccltResult_t pccltRunMaster(pccltMaster_t *m) {
+    if (!m || m->launched) return pccltInvalidUsage;
+    if (!m->master->launch()) return pccltInternalError;
+    m->launched = true;
+    return pccltSuccess;
+}
+
+pccltResult_t pccltInterruptMaster(pccltMaster_t *m) {
+    if (!m) return pccltInvalidArgument;
+    m->master->interrupt();
+    return pccltSuccess;
+}
+
+pccltResult_t pccltMasterAwaitTermination(pccltMaster_t *m) {
+    if (!m) return pccltInvalidArgument;
+    m->master->join();
+    return pccltSuccess;
+}
+
+pccltResult_t pccltDestroyMaster(pccltMaster_t *m) {
+    if (!m) return pccltInvalidArgument;
+    m->master->interrupt();
+    m->master->join();
+    delete m->master;
+    delete m;
+    return pccltSuccess;
+}
+
+uint16_t pccltMasterPort(pccltMaster_t *m) { return m ? m->master->port() : 0; }
+
+// ---------------- communicator ----------------
+
+pccltResult_t pccltCreateCommunicator(const pccltCommCreateParams_t *params,
+                                      pccltComm_t **out) {
+    if (!params || !out || !params->master_ip) return pccltInvalidArgument;
+    auto addr = pcclt::net::Addr::parse(params->master_ip,
+                                        params->master_port ? params->master_port : 48501);
+    if (!addr) return pccltInvalidArgument;
+    ClientConfig cfg;
+    cfg.master = *addr;
+    cfg.peer_group = params->peer_group;
+    if (params->advertised_ip) cfg.adv_ip = params->advertised_ip;
+    if (params->p2p_port) cfg.p2p_port = params->p2p_port;
+    if (params->ss_port) cfg.ss_port = params->ss_port;
+    if (params->bench_port) cfg.bench_port = params->bench_port;
+    cfg.pool_size = params->p2p_connection_pool_size ? params->p2p_connection_pool_size : 1;
+    *out = new pccltComm{new Client(cfg)};
+    return pccltSuccess;
+}
+
+pccltResult_t pccltDestroyCommunicator(pccltComm_t *c) {
+    if (!c) return pccltInvalidArgument;
+    delete c->client;
+    delete c;
+    return pccltSuccess;
+}
+
+pccltResult_t pccltConnect(pccltComm_t *c) {
+    if (!c) return pccltInvalidArgument;
+    return to_result(c->client->connect());
+}
+
+pccltResult_t pccltGetAttribute(pccltComm_t *c, pccltAttribute_t attr, int64_t *out) {
+    if (!c || !out) return pccltInvalidArgument;
+    switch (attr) {
+    case PCCLT_ATTR_GLOBAL_WORLD_SIZE: *out = c->client->global_world(); break;
+    case PCCLT_ATTR_PEER_GROUP_WORLD_SIZE: *out = c->client->group_world(); break;
+    case PCCLT_ATTR_NUM_DISTINCT_PEER_GROUPS: *out = c->client->num_groups(); break;
+    case PCCLT_ATTR_LARGEST_PEER_GROUP_WORLD_SIZE: *out = c->client->largest_group(); break;
+    default: return pccltInvalidArgument;
+    }
+    return pccltSuccess;
+}
+
+pccltResult_t pccltUpdateTopology(pccltComm_t *c) {
+    if (!c) return pccltInvalidArgument;
+    return to_result(c->client->update_topology());
+}
+
+pccltResult_t pccltArePeersPending(pccltComm_t *c, int *pending) {
+    if (!c || !pending) return pccltInvalidArgument;
+    bool p = false;
+    auto st = c->client->are_peers_pending(p);
+    *pending = p ? 1 : 0;
+    return to_result(st);
+}
+
+pccltResult_t pccltOptimizeTopology(pccltComm_t *c) {
+    if (!c) return pccltInvalidArgument;
+    return to_result(c->client->optimize_topology());
+}
+
+pccltResult_t pccltAllReduce(pccltComm_t *c, const void *sendbuf, void *recvbuf,
+                             uint64_t count, pccltDataType_t dtype,
+                             const pccltReduceDescriptor_t *desc,
+                             pccltReduceInfo_t *info) {
+    if (!c || !desc) return pccltInvalidArgument;
+    pcclt::client::ReduceInfo ri;
+    auto st = c->client->all_reduce(sendbuf, recvbuf, count, to_dtype(dtype),
+                                    to_desc(desc), &ri);
+    fill_info(info, ri);
+    return to_result(st);
+}
+
+pccltResult_t pccltAllReduceAsync(pccltComm_t *c, const void *sendbuf, void *recvbuf,
+                                  uint64_t count, pccltDataType_t dtype,
+                                  const pccltReduceDescriptor_t *desc) {
+    if (!c || !desc) return pccltInvalidArgument;
+    return to_result(
+        c->client->all_reduce_async(sendbuf, recvbuf, count, to_dtype(dtype), to_desc(desc)));
+}
+
+pccltResult_t pccltAwaitAsyncReduce(pccltComm_t *c, uint64_t tag,
+                                    pccltReduceInfo_t *info) {
+    if (!c) return pccltInvalidArgument;
+    pcclt::client::ReduceInfo ri;
+    auto st = c->client->await_reduce(tag, &ri);
+    fill_info(info, ri);
+    return to_result(st);
+}
+
+pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const *sendbufs,
+                                              void *const *recvbufs, const uint64_t *counts,
+                                              pccltDataType_t dtype,
+                                              const pccltReduceDescriptor_t *descs,
+                                              uint64_t n_ops, pccltReduceInfo_t *infos) {
+    if (!c || !sendbufs || !recvbufs || !counts || !descs) return pccltInvalidArgument;
+    std::vector<bool> done(n_ops, false);
+    while (true) {
+        // launch all outstanding ops, await them, retry failures with the
+        // (possibly shrunken) world — reference pcclAllReduceMultipleWithRetry
+        bool any_launched = false;
+        for (uint64_t i = 0; i < n_ops; ++i) {
+            if (done[i]) continue;
+            auto st = c->client->all_reduce_async(sendbufs[i], recvbufs[i], counts[i],
+                                                  to_dtype(dtype), to_desc(&descs[i]));
+            if (st == Status::kTooFewPeers) return pccltTooFewPeers;
+            if (st != Status::kOk) return to_result(st);
+            any_launched = true;
+        }
+        if (!any_launched) return pccltSuccess;
+        bool all_ok = true;
+        for (uint64_t i = 0; i < n_ops; ++i) {
+            if (done[i]) continue;
+            pcclt::client::ReduceInfo ri;
+            auto st = c->client->await_reduce(descs[i].tag, &ri);
+            if (st == Status::kOk) {
+                done[i] = true;
+                fill_info(infos ? &infos[i] : nullptr, ri);
+            } else if (st == Status::kAborted || st == Status::kConnectionLost) {
+                all_ok = false;
+            } else {
+                return to_result(st);
+            }
+        }
+        if (all_ok) return pccltSuccess;
+        // re-establish the mesh before retrying
+        auto st = c->client->update_topology();
+        if (st != Status::kOk) return to_result(st);
+        if (c->client->group_world() < 2) return pccltTooFewPeers;
+    }
+}
+
+pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *state,
+                                          pccltSyncStrategy_t strategy,
+                                          pccltSharedStateSyncInfo_t *info) {
+    if (!c || !state || (state->count && !state->infos)) return pccltInvalidArgument;
+    std::vector<pcclt::client::SharedStateEntry> entries;
+    for (uint64_t i = 0; i < state->count; ++i) {
+        const auto &ti = state->infos[i];
+        if (!ti.name || !ti.data) return pccltInvalidArgument;
+        pcclt::client::SharedStateEntry e;
+        e.name = ti.name;
+        e.dtype = to_dtype(ti.dtype);
+        e.count = ti.count;
+        e.data = ti.data;
+        e.allow_content_inequality = ti.allow_content_inequality != 0;
+        entries.push_back(std::move(e));
+    }
+    pcclt::client::SyncInfo si;
+    auto st = c->client->sync_shared_state(
+        state->revision, static_cast<pcclt::proto::SyncStrategy>(strategy), entries, &si);
+    if (info) {
+        info->tx_bytes = si.tx_bytes;
+        info->rx_bytes = si.rx_bytes;
+        info->revision = si.revision;
+    }
+    return to_result(st);
+}
+
+} // extern "C"

@@ -1,0 +1,168 @@
+#include "atsp.hpp"
+
+#include <algorithm>
+#include <chrono>
+#include <limits>
+#include <random>
+
+namespace pcclt::atsp {
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double edge(const std::vector<double> &c, size_t n, int i, int j) {
+    return c[static_cast<size_t>(i) * n + static_cast<size_t>(j)];
+}
+
+std::vector<int> held_karp(const std::vector<double> &cost, size_t n) {
+    // exact DP over subsets; fix node 0 as start. O(2^n * n^2).
+    const size_t full = size_t{1} << n;
+    const double inf = std::numeric_limits<double>::infinity();
+    std::vector<double> dp(full * n, inf);
+    std::vector<int> parent(full * n, -1);
+    dp[(1u << 0) * n + 0] = 0.0;
+    for (size_t mask = 1; mask < full; ++mask) {
+        if (!(mask & 1)) continue;
+        for (size_t last = 0; last < n; ++last) {
+            if (!(mask & (size_t{1} << last))) continue;
+            double cur = dp[mask * n + last];
+            if (cur == inf) continue;
+            for (size_t nxt = 0; nxt < n; ++nxt) {
+                if (mask & (size_t{1} << nxt)) continue;
+                size_t nmask = mask | (size_t{1} << nxt);
+                double cand = cur + edge(cost, n, static_cast<int>(last), static_cast<int>(nxt));
+                if (cand < dp[nmask * n + nxt]) {
+                    dp[nmask * n + nxt] = cand;
+                    parent[nmask * n + nxt] = static_cast<int>(last);
+                }
+            }
+        }
+    }
+    double best = inf;
+    int best_last = 0;
+    for (size_t last = 1; last < n; ++last) {
+        double cand = dp[(full - 1) * n + last] + edge(cost, n, static_cast<int>(last), 0);
+        if (cand < best) {
+            best = cand;
+            best_last = static_cast<int>(last);
+        }
+    }
+    std::vector<int> tour(n);
+    size_t mask = full - 1;
+    int cur = best_last;
+    for (size_t i = n; i-- > 0;) {
+        tour[i] = cur;
+        int p = parent[mask * n + cur];
+        mask &= ~(size_t{1} << cur);
+        cur = p;
+    }
+    return tour;
+}
+
+std::vector<int> nearest_neighbor(const std::vector<double> &cost, size_t n, int start) {
+    std::vector<bool> used(n, false);
+    std::vector<int> tour;
+    tour.reserve(n);
+    int cur = start;
+    used[cur] = true;
+    tour.push_back(cur);
+    for (size_t step = 1; step < n; ++step) {
+        int best = -1;
+        double bc = std::numeric_limits<double>::infinity();
+        for (size_t j = 0; j < n; ++j) {
+            if (used[j]) continue;
+            double c = edge(cost, n, cur, static_cast<int>(j));
+            if (c < bc) {
+                bc = c;
+                best = static_cast<int>(j);
+            }
+        }
+        used[best] = true;
+        tour.push_back(best);
+        cur = best;
+    }
+    return tour;
+}
+
+// directed 2-opt: reverse segment (costs recomputed fully — asymmetric) + Or-opt
+bool local_search_pass(const std::vector<double> &cost, size_t n, std::vector<int> &tour,
+                       double &cur_cost) {
+    bool improved = false;
+    // Or-opt: move a segment of length 1..3 elsewhere
+    for (size_t seg = 1; seg <= 3 && seg < n; ++seg) {
+        for (size_t i = 0; i + seg <= n; ++i) {
+            for (size_t j = 0; j <= n - seg; ++j) {
+                if (j >= i && j <= i + seg) continue;
+                std::vector<int> cand;
+                cand.reserve(n);
+                for (size_t k = 0; k < n; ++k)
+                    if (k < i || k >= i + seg) cand.push_back(tour[k]);
+                size_t insert_at = j > i ? j - seg : j;
+                cand.insert(cand.begin() + insert_at, tour.begin() + i,
+                            tour.begin() + i + seg);
+                double c = tour_cost(cost, n, cand);
+                if (c + 1e-12 < cur_cost) {
+                    tour = cand;
+                    cur_cost = c;
+                    improved = true;
+                }
+            }
+        }
+    }
+    return improved;
+}
+
+} // namespace
+
+double tour_cost(const std::vector<double> &cost, size_t n, const std::vector<int> &tour) {
+    double c = 0;
+    for (size_t i = 0; i < n; ++i) c += edge(cost, n, tour[i], tour[(i + 1) % n]);
+    return c;
+}
+
+double improve(const std::vector<double> &cost, size_t n, std::vector<int> &tour,
+               int budget_ms) {
+    auto deadline = Clock::now() + std::chrono::milliseconds(budget_ms);
+    double cur = tour_cost(cost, n, tour);
+    while (Clock::now() < deadline) {
+        if (!local_search_pass(cost, n, tour, cur)) break;
+    }
+    return cur;
+}
+
+std::vector<int> solve(const std::vector<double> &cost, size_t n, int budget_ms) {
+    if (n == 0) return {};
+    if (n == 1) return {0};
+    if (n == 2) return {0, 1};
+    if (n <= 12) return held_karp(cost, n);
+
+    auto deadline = Clock::now() + std::chrono::milliseconds(budget_ms);
+    std::vector<int> best_tour;
+    double best = std::numeric_limits<double>::infinity();
+    for (size_t s = 0; s < n && Clock::now() < deadline; ++s) {
+        auto t = nearest_neighbor(cost, n, static_cast<int>(s));
+        double c = tour_cost(cost, n, t);
+        while (Clock::now() < deadline && local_search_pass(cost, n, t, c)) {}
+        if (c < best) {
+            best = c;
+            best_tour = t;
+        }
+    }
+    // random restarts with the remaining budget
+    std::mt19937 rng(12345);
+    while (Clock::now() < deadline) {
+        std::vector<int> t(n);
+        for (size_t i = 0; i < n; ++i) t[i] = static_cast<int>(i);
+        std::shuffle(t.begin(), t.end(), rng);
+        double c = tour_cost(cost, n, t);
+        while (Clock::now() < deadline && local_search_pass(cost, n, t, c)) {}
+        if (c < best) {
+            best = c;
+            best_tour = t;
+        }
+    }
+    return best_tour;
+}
+
+} // namespace pcclt::atsp

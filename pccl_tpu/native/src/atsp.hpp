@@ -1,0 +1,26 @@
+// Asymmetric TSP solver for bandwidth-aware ring ordering.
+// Reference parity: libtsp's tspAsymmetricSolve / ImproveSolution
+// (/root/reference/ccoip/src/cpp/topolgy_optimizer.cpp:50-62,134-146 usage)
+// — exact for small N, heuristic beyond. This implementation:
+//   n <= 12 : Held-Karp exact dynamic program
+//   n  > 12 : best-of-all-starts nearest neighbor + 2-opt + Or-opt local
+//             search under a millisecond budget, with random restarts.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace pcclt::atsp {
+
+// cost: n*n row-major, cost[i*n+j] = directed edge i->j; diagonal ignored.
+// Returns a tour as a permutation of [0, n).
+std::vector<int> solve(const std::vector<double> &cost, size_t n, int budget_ms);
+
+// Improve an existing tour in place (keeps it valid); returns improved cost.
+double improve(const std::vector<double> &cost, size_t n, std::vector<int> &tour,
+               int budget_ms);
+
+double tour_cost(const std::vector<double> &cost, size_t n, const std::vector<int> &tour);
+
+} // namespace pcclt::atsp

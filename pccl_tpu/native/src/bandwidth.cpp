@@ -1,0 +1,34 @@
+#include "bandwidth.hpp"
+
+namespace pcclt::master {
+
+void BandwidthStore::store(const proto::Uuid &from, const proto::Uuid &to, double mbps) {
+    mbps_[from][to] = mbps;
+}
+
+std::optional<double> BandwidthStore::get(const proto::Uuid &from,
+                                          const proto::Uuid &to) const {
+    auto it = mbps_.find(from);
+    if (it == mbps_.end()) return std::nullopt;
+    auto jt = it->second.find(to);
+    if (jt == it->second.end()) return std::nullopt;
+    return jt->second;
+}
+
+std::vector<std::pair<proto::Uuid, proto::Uuid>>
+BandwidthStore::missing_edges(const std::vector<proto::Uuid> &peers) const {
+    std::vector<std::pair<proto::Uuid, proto::Uuid>> out;
+    for (const auto &a : peers)
+        for (const auto &b : peers) {
+            if (a == b) continue;
+            if (!get(a, b)) out.emplace_back(a, b);
+        }
+    return out;
+}
+
+void BandwidthStore::forget(const proto::Uuid &peer) {
+    mbps_.erase(peer);
+    for (auto &[_, m] : mbps_) m.erase(peer);
+}
+
+} // namespace pcclt::master

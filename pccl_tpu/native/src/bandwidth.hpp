@@ -1,0 +1,32 @@
+// Asymmetric peer-to-peer bandwidth store.
+// Reference parity: /root/reference/ccoip/internal/bandwidth_store.hpp —
+// map<from, map<to, mbps>> with missing-edge enumeration for the
+// benchmark scheduler.
+#pragma once
+
+#include <map>
+#include <optional>
+#include <utility>
+#include <vector>
+
+#include "protocol.hpp"
+
+namespace pcclt::master {
+
+class BandwidthStore {
+public:
+    void store(const proto::Uuid &from, const proto::Uuid &to, double mbps);
+    std::optional<double> get(const proto::Uuid &from, const proto::Uuid &to) const;
+    // directed (from,to) pairs among `peers` with no measurement yet
+    std::vector<std::pair<proto::Uuid, proto::Uuid>>
+    missing_edges(const std::vector<proto::Uuid> &peers) const;
+    void forget(const proto::Uuid &peer);
+    bool fully_connected(const std::vector<proto::Uuid> &peers) const {
+        return missing_edges(peers).empty();
+    }
+
+private:
+    std::map<proto::Uuid, std::map<proto::Uuid, double>> mbps_;
+};
+
+} // namespace pcclt::master

@@ -1,0 +1,79 @@
+#include "benchmark.hpp"
+
+#include <chrono>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+#include "log.hpp"
+#include "protocol.hpp"
+#include "wire.hpp"
+
+namespace pcclt::bench {
+
+using Clock = std::chrono::steady_clock;
+
+double probe_seconds() {
+    if (const char *e = std::getenv("PCCLT_BENCH_SECONDS")) {
+        double v = atof(e);
+        if (v > 0) return v;
+    }
+    return 1.0;
+}
+
+double run_probe(const net::Addr &target) {
+    net::Socket sock;
+    if (!sock.connect(target)) return -1.0;
+    std::mutex mu;
+    if (!net::send_frame(sock, mu, proto::kBenchHello, {})) return -1.0;
+    auto ack = net::recv_frame(sock);
+    if (!ack || ack->type != proto::kBenchAck || ack->payload.empty() ||
+        ack->payload[0] == 0)
+        return -2.0; // busy
+
+    std::vector<uint8_t> buf(8 << 20);
+    std::mt19937_64 rng{0x9E3779B97F4A7C15ull};
+    for (size_t i = 0; i + 8 <= buf.size(); i += 8) {
+        uint64_t v = rng();
+        memcpy(buf.data() + i, &v, 8);
+    }
+    double secs = probe_seconds();
+    auto deadline = Clock::now() + std::chrono::duration<double>(secs);
+    uint64_t sent = 0;
+    auto t0 = Clock::now();
+    while (Clock::now() < deadline) {
+        if (!sock.send_all(buf.data(), buf.size())) break;
+        sent += buf.size();
+    }
+    double elapsed = std::chrono::duration<double>(Clock::now() - t0).count();
+    sock.shutdown();
+    sock.close();
+    if (elapsed <= 0 || sent == 0) return -1.0;
+    return static_cast<double>(sent) * 8.0 / 1e6 / elapsed;
+}
+
+void serve_connection(net::Socket sock, std::atomic<int> &active, int max_active) {
+    auto hello = net::recv_frame(sock);
+    if (!hello || hello->type != proto::kBenchHello) return;
+    int cur = active.load();
+    bool accept = false;
+    while (cur < max_active) {
+        if (active.compare_exchange_weak(cur, cur + 1)) {
+            accept = true;
+            break;
+        }
+    }
+    std::mutex mu;
+    uint8_t flag = accept ? 1 : 0;
+    net::send_frame(sock, mu, proto::kBenchAck, {&flag, 1});
+    if (!accept) return;
+
+    std::vector<uint8_t> buf(1 << 20);
+    while (true) {
+        ssize_t r = sock.recv_some(buf.data(), buf.size(), 2000);
+        if (r == 0 || r == -1) break; // closed or error; -2 timeout keeps waiting
+    }
+    active.fetch_sub(1);
+}
+
+} // namespace pcclt::bench

@@ -1,0 +1,24 @@
+// P2P bandwidth probe for topology optimization.
+// Reference parity: NetworkBenchmarkRunner (/root/reference/ccoip/src/cpp/
+// benchmark_runner.cpp) — client floods random buffers for a fixed window
+// and reports Mbit/s; server side accepts, counts and discards; busy
+// servers reject via the handshake. Duration env: PCCLT_BENCH_SECONDS
+// (default 1.0; the reference uses 10 s).
+#pragma once
+
+#include <atomic>
+
+#include "sockets.hpp"
+
+namespace pcclt::bench {
+
+double probe_seconds();
+
+// Run one outgoing probe; returns measured Mbit/s or <0 on failure/busy.
+double run_probe(const net::Addr &target);
+
+// Serve one accepted benchmark connection (counts+discards until close).
+// `busy` limits concurrency: if already at limit, the handshake is rejected.
+void serve_connection(net::Socket sock, std::atomic<int> &active, int max_active);
+
+} // namespace pcclt::bench

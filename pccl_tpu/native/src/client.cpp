@@ -1,0 +1,722 @@
+#include "client.hpp"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+#include <set>
+
+#include "benchmark.hpp"
+#include "hash.hpp"
+#include "log.hpp"
+#include "reduce.hpp"
+
+namespace pcclt::client {
+
+using proto::PacketType;
+
+namespace {
+
+size_t max_concurrent_ops() {
+    if (const char *e = std::getenv("PCCLT_MAX_CONCURRENT_COLLECTIVE_OPS")) {
+        int v = atoi(e);
+        if (v > 0) return static_cast<size_t>(v);
+    }
+    return 16;
+}
+
+} // namespace
+
+Client::~Client() { disconnect(); }
+
+// ---------------- accept handlers ----------------
+
+void Client::on_p2p_accept(net::Socket sock) {
+    // handshake: peer sends P2PHello{uuid, pool index}; we ack with our uuid
+    std::thread t([this, sock = std::move(sock)]() mutable {
+        auto hello = net::recv_frame(sock);
+        if (!hello || hello->type != PacketType::kP2PHello) return;
+        proto::Uuid peer;
+        uint32_t idx = 0;
+        try {
+            wire::Reader r(hello->payload);
+            peer = proto::get_uuid(r);
+            idx = r.u32();
+        } catch (...) { return; }
+        wire::Writer w;
+        proto::put_uuid(w, uuid_);
+        std::mutex mu;
+        if (!net::send_frame(sock, mu, PacketType::kP2PHelloAck, w.data())) return;
+        sock.set_keepalive();
+
+        auto conn = std::make_shared<net::MultiplexConn>(std::move(sock));
+        conn->run();
+        std::lock_guard lk(state_mu_);
+        auto &pc = peers_[peer];
+        if (pc.rx.size() <= idx) pc.rx.resize(idx + 1);
+        pc.rx[idx] = conn;
+    });
+    t.detach();
+}
+
+void Client::on_ss_accept(net::Socket sock) {
+    std::thread t([this, sock = std::move(sock)]() mutable {
+        auto req = net::recv_frame(sock);
+        if (!req || req->type != PacketType::kC2SStateRequest) return;
+        uint64_t revision;
+        std::vector<std::string> keys;
+        try {
+            wire::Reader r(req->payload);
+            revision = r.u64();
+            uint32_t n = r.u32();
+            for (uint32_t i = 0; i < n; ++i) keys.push_back(r.str());
+        } catch (...) { return; }
+
+        std::vector<SharedStateEntry> entries;
+        bool ok;
+        {
+            std::lock_guard lk(dist_mu_);
+            ok = dist_open_ && revision == dist_revision_;
+            if (ok)
+                for (const auto &k : keys) {
+                    auto it = dist_entries_.find(k);
+                    if (it == dist_entries_.end()) {
+                        ok = false;
+                        break;
+                    }
+                    entries.push_back(it->second);
+                }
+        }
+        wire::Writer w;
+        w.u8(ok ? 1 : 0);
+        w.u32(ok ? static_cast<uint32_t>(entries.size()) : 0);
+        for (const auto &e : entries) {
+            w.str(e.name);
+            w.u8(static_cast<uint8_t>(e.dtype));
+            w.u64(e.count);
+        }
+        std::mutex mu;
+        if (!net::send_frame(sock, mu, PacketType::kS2CStateHeader, w.data())) return;
+        if (!ok) return;
+        for (const auto &e : entries) {
+            size_t nbytes = e.count * proto::dtype_size(e.dtype);
+            if (!sock.send_all(e.data, nbytes)) return;
+            dist_tx_bytes_.fetch_add(nbytes);
+        }
+    });
+    t.detach();
+}
+
+void Client::on_bench_accept(net::Socket sock) {
+    static std::atomic<int> active{0};
+    std::thread t([sock = std::move(sock)]() mutable {
+        bench::serve_connection(std::move(sock), active, 4);
+    });
+    t.detach();
+}
+
+// ---------------- connect / disconnect ----------------
+
+Status Client::connect() {
+    if (connected_.load()) return Status::kInvalid;
+    if (!p2p_listener_.listen(cfg_.p2p_port, 64)) return Status::kInternal;
+    if (!ss_listener_.listen(cfg_.ss_port, 64)) return Status::kInternal;
+    if (!bench_listener_.listen(cfg_.bench_port, 64)) return Status::kInternal;
+    p2p_listener_.run_async([this](net::Socket s) { on_p2p_accept(std::move(s)); });
+    ss_listener_.run_async([this](net::Socket s) { on_ss_accept(std::move(s)); });
+    bench_listener_.run_async([this](net::Socket s) { on_bench_accept(std::move(s)); });
+
+    if (!master_.connect(cfg_.master)) return Status::kMasterUnreachable;
+    master_.run();
+
+    proto::HelloC2M h;
+    h.peer_group = cfg_.peer_group;
+    h.p2p_port = p2p_listener_.port();
+    h.ss_port = ss_listener_.port();
+    h.bench_port = bench_listener_.port();
+    h.adv_ip = cfg_.adv_ip;
+    if (!master_.send(PacketType::kC2MHello, h.encode())) return Status::kMasterUnreachable;
+    auto welcome = master_.recv_match(PacketType::kM2CWelcome, nullptr, 30'000);
+    if (!welcome) return Status::kMasterUnreachable;
+    try {
+        wire::Reader r(welcome->payload);
+        if (r.u8() != 1) return Status::kMasterUnreachable;
+        uuid_ = proto::get_uuid(r);
+    } catch (...) { return Status::kInternal; }
+    connected_ = true;
+
+    // blocks until the first topology round admits us
+    Status st = establish_loop();
+    if (st != Status::kOk) {
+        connected_ = false;
+        return st;
+    }
+    PLOG(kInfo) << "connected as " << proto::uuid_str(uuid_) << ", group world "
+                << group_world();
+    return Status::kOk;
+}
+
+void Client::disconnect() {
+    connected_ = false;
+    {
+        std::lock_guard lk(ops_mu_);
+        for (auto &[_, op] : ops_) {
+            op->abort = true;
+            if (op->worker.joinable()) op->worker.join();
+        }
+        ops_.clear();
+    }
+    master_.close();
+    p2p_listener_.stop();
+    ss_listener_.stop();
+    bench_listener_.stop();
+    std::lock_guard lk(state_mu_);
+    for (auto &[_, pc] : peers_) {
+        for (auto &c : pc.tx)
+            if (c) c->close();
+        for (auto &c : pc.rx)
+            if (c) c->close();
+    }
+    peers_.clear();
+    ring_.clear();
+}
+
+Status Client::check_kicked() {
+    auto kicked = master_.recv_match(PacketType::kM2CKicked, nullptr, 0, true);
+    if (kicked) {
+        std::string reason;
+        try {
+            wire::Reader r(kicked->payload);
+            reason = r.str();
+        } catch (...) {}
+        PLOG(kError) << "kicked by master: " << reason;
+        connected_ = false;
+        return Status::kKicked;
+    }
+    if (!master_.connected()) {
+        connected_ = false;
+        return Status::kConnectionLost;
+    }
+    return Status::kOk;
+}
+
+// ---------------- topology / establishment ----------------
+
+Status Client::establish_from_info(const proto::P2PConnInfo &info,
+                                   std::vector<proto::Uuid> &failed) {
+    for (const auto &ep : info.peers) {
+        std::lock_guard lk(state_mu_);
+        auto &pc = peers_[ep.uuid];
+        pc.ep = ep;
+        // build tx pool (reconnect from scratch each round: robust under churn)
+        for (auto &c : pc.tx)
+            if (c) c->close();
+        pc.tx.clear();
+        bool ok = true;
+        for (size_t i = 0; i < cfg_.pool_size; ++i) {
+            net::Socket s;
+            if (!s.connect(net::Addr{ep.ip, ep.p2p_port}, 5000)) {
+                ok = false;
+                break;
+            }
+            s.set_keepalive();
+            wire::Writer w;
+            proto::put_uuid(w, uuid_);
+            w.u32(static_cast<uint32_t>(i));
+            std::mutex mu;
+            if (!net::send_frame(s, mu, PacketType::kP2PHello, w.data())) {
+                ok = false;
+                break;
+            }
+            auto ack = net::recv_frame(s);
+            if (!ack || ack->type != PacketType::kP2PHelloAck) {
+                ok = false;
+                break;
+            }
+            auto conn = std::make_shared<net::MultiplexConn>(std::move(s));
+            conn->run();
+            pc.tx.push_back(conn);
+        }
+        if (!ok) {
+            failed.push_back(ep.uuid);
+            for (auto &c : pc.tx)
+                if (c) c->close();
+            pc.tx.clear();
+        }
+    }
+    // drop peers no longer in the world
+    {
+        std::lock_guard lk(state_mu_);
+        std::set<proto::Uuid> alive;
+        for (const auto &ep : info.peers) alive.insert(ep.uuid);
+        for (auto it = peers_.begin(); it != peers_.end();) {
+            if (!alive.count(it->first)) {
+                for (auto &c : it->second.tx)
+                    if (c) c->close();
+                for (auto &c : it->second.rx)
+                    if (c) c->close();
+                it = peers_.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
+    return failed.empty() ? Status::kOk : Status::kInternal;
+}
+
+void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid> &ring) {
+    std::lock_guard lk(state_mu_);
+    ring_ = ring;
+    topo_revision_ = info.revision;
+}
+
+Status Client::establish_loop() {
+    while (true) {
+        if (auto st = check_kicked(); st != Status::kOk) return st;
+        auto fr = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 120'000);
+        if (!fr) return check_kicked() == Status::kOk ? Status::kMasterUnreachable
+                                                      : Status::kKicked;
+        // stale rounds may have queued older conn infos; use the newest
+        while (auto newer = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 0, true))
+            fr = std::move(newer);
+        auto info = proto::P2PConnInfo::decode(fr->payload);
+        if (!info) return Status::kInternal;
+
+        std::vector<proto::Uuid> failed;
+        establish_from_info(*info, failed);
+
+        wire::Writer w;
+        w.u64(info->revision);
+        w.u8(failed.empty() ? 1 : 0);
+        w.u32(static_cast<uint32_t>(failed.size()));
+        for (const auto &f : failed) proto::put_uuid(w, f);
+        if (!master_.send(PacketType::kC2MP2PEstablished, w.data()))
+            return Status::kConnectionLost;
+
+        // match only this round's response (stale-round responses are dropped
+        // by revision, mirroring the reference's connection-revision guard)
+        auto rev_pred = [rev = info->revision](const std::vector<uint8_t> &p) {
+            try {
+                wire::Reader r(p);
+                return r.u64() == rev;
+            } catch (...) { return false; }
+        };
+        auto resp =
+            master_.recv_match(PacketType::kM2CP2PEstablishedResp, rev_pred, 120'000);
+        if (!resp) return check_kicked() == Status::kOk ? Status::kMasterUnreachable
+                                                         : Status::kKicked;
+        try {
+            wire::Reader r(resp->payload);
+            r.u64(); // revision (matched by predicate)
+            bool ok = r.u8() != 0;
+            uint32_t n = r.u32();
+            std::vector<proto::Uuid> ring;
+            for (uint32_t i = 0; i < n; ++i) ring.push_back(proto::get_uuid(r));
+            if (ok) {
+                adopt(*info, ring);
+                return Status::kOk;
+            }
+        } catch (...) { return Status::kInternal; }
+        // retry: wait for the next round's conn info
+    }
+}
+
+Status Client::update_topology() {
+    if (!connected_.load()) return Status::kNotConnected;
+    if (!master_.send(PacketType::kC2MTopologyUpdate, {})) return Status::kConnectionLost;
+    return establish_loop();
+}
+
+Status Client::are_peers_pending(bool &pending) {
+    if (!connected_.load()) return Status::kNotConnected;
+    if (!master_.send(PacketType::kC2MPeersPendingQuery, {})) return Status::kConnectionLost;
+    auto fr = master_.recv_match(PacketType::kM2CPeersPendingReply, nullptr, 30'000);
+    if (!fr) return Status::kConnectionLost;
+    pending = !fr->payload.empty() && fr->payload[0] != 0;
+    return Status::kOk;
+}
+
+Status Client::optimize_topology() {
+    if (!connected_.load()) return Status::kNotConnected;
+    if (!master_.send(PacketType::kC2MOptimizeTopology, {})) return Status::kConnectionLost;
+    while (true) {
+        auto fr = master_.recv_match_any(
+            {PacketType::kM2COptimizeResponse, PacketType::kM2COptimizeComplete}, nullptr,
+            300'000);
+        if (!fr) return check_kicked() == Status::kOk ? Status::kMasterUnreachable
+                                                       : Status::kKicked;
+        if (fr->type == PacketType::kM2COptimizeComplete) {
+            try {
+                wire::Reader r(fr->payload);
+                bool ok = r.u8() != 0;
+                uint32_t n = r.u32();
+                std::vector<proto::Uuid> ring;
+                for (uint32_t i = 0; i < n; ++i) ring.push_back(proto::get_uuid(r));
+                if (ok) {
+                    std::lock_guard lk(state_mu_);
+                    ring_ = ring;
+                }
+                return ok ? Status::kOk : Status::kInternal;
+            } catch (...) { return Status::kInternal; }
+        }
+        auto resp = proto::OptimizeResponse::decode(fr->payload);
+        if (!resp) return Status::kInternal;
+        for (const auto &req : resp->requests) {
+            double mbps = -1.0;
+            for (int attempt = 0; attempt < 5 && mbps < 0; ++attempt) {
+                mbps = bench::run_probe(net::Addr{req.ip, req.bench_port});
+                if (mbps == -2.0) { // busy; back off
+                    std::this_thread::sleep_for(std::chrono::milliseconds(200 * (attempt + 1)));
+                    mbps = -1.0;
+                }
+            }
+            if (mbps < 0) mbps = 0.001; // unreachable: report epsilon
+            wire::Writer w;
+            proto::put_uuid(w, req.to);
+            w.f64(mbps);
+            if (!master_.send(PacketType::kC2MBandwidthReport, w.data()))
+                return Status::kConnectionLost;
+        }
+        if (!master_.send(PacketType::kC2MOptimizeWorkDone, {}))
+            return Status::kConnectionLost;
+    }
+}
+
+// ---------------- conn lookup ----------------
+
+std::shared_ptr<net::MultiplexConn> Client::tx_conn(const proto::Uuid &peer, size_t idx) {
+    std::lock_guard lk(state_mu_);
+    auto it = peers_.find(peer);
+    if (it == peers_.end() || it->second.tx.empty()) return nullptr;
+    return it->second.tx[idx % it->second.tx.size()];
+}
+
+std::shared_ptr<net::MultiplexConn> Client::rx_conn(const proto::Uuid &peer, size_t idx,
+                                                    int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+        {
+            std::lock_guard lk(state_mu_);
+            auto it = peers_.find(peer);
+            if (it != peers_.end() && !it->second.rx.empty()) {
+                auto c = it->second.rx[idx % it->second.rx.size()];
+                if (c && c->alive()) return c;
+            }
+        }
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    return nullptr;
+}
+
+// ---------------- collectives ----------------
+
+Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
+                                proto::DType dtype, const ReduceDesc &desc) {
+    if (!connected_.load()) return Status::kNotConnected;
+    if (!send || !recv || count == 0) return Status::kInvalid;
+    if (group_world() < 2) return Status::kTooFewPeers;
+    {
+        std::lock_guard lk(ops_mu_);
+        if (ops_.count(desc.tag)) return Status::kDuplicateTag;
+        if (ops_.size() >= max_concurrent_ops()) return Status::kInvalid;
+        auto op = std::make_unique<AsyncOp>();
+        auto promise = std::make_shared<std::promise<Status>>();
+        op->result = promise->get_future();
+        AsyncOp *op_ptr = op.get();
+        op->worker = std::thread([this, send, recv, count, dtype, desc, op_ptr, promise] {
+            Status st = run_reduce_worker(send, recv, count, dtype, desc, op_ptr);
+            promise->set_value(st);
+        });
+        ops_[desc.tag] = std::move(op);
+    }
+    return Status::kOk;
+}
+
+Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
+                                 proto::DType dtype, ReduceDesc desc, AsyncOp *op) {
+    // 1. initiate with master, await commence (predicate-matched by tag)
+    proto::CollectiveInit ci;
+    ci.tag = desc.tag;
+    ci.count = count;
+    ci.dtype = dtype;
+    ci.op = desc.op;
+    ci.quant = desc.quant;
+    ci.quant_dtype = desc.quant_dtype;
+    if (!master_.send(PacketType::kC2MCollectiveInit, ci.encode()))
+        return Status::kConnectionLost;
+
+    auto tag_pred = [tag = desc.tag](const std::vector<uint8_t> &p) {
+        try {
+            wire::Reader r(p);
+            return r.u64() == tag;
+        } catch (...) { return false; }
+    };
+    auto commence =
+        master_.recv_match(PacketType::kM2CCollectiveCommence, tag_pred, 600'000);
+    if (!commence) return Status::kConnectionLost;
+    uint64_t seq;
+    try {
+        wire::Reader r(commence->payload);
+        r.u64();
+        seq = r.u64();
+    } catch (...) { return Status::kInternal; }
+
+    // 2. snapshot ring + neighbor connections
+    std::vector<proto::Uuid> ring;
+    {
+        std::lock_guard lk(state_mu_);
+        ring = ring_;
+    }
+    uint32_t world = static_cast<uint32_t>(ring.size());
+    auto self_it = std::find(ring.begin(), ring.end(), uuid_);
+    if (self_it == ring.end() || world < 2) return Status::kInternal;
+    uint32_t rank = static_cast<uint32_t>(self_it - ring.begin());
+    const proto::Uuid &next = ring[(rank + 1) % world];
+    const proto::Uuid &prev = ring[(rank + world - 1) % world];
+
+    bool consumed_abort = false;
+    bool verdict_aborted = false;
+    auto consume_abort = [&](bool no_wait) -> bool {
+        auto fr = master_.recv_match(PacketType::kM2CCollectiveAbort, tag_pred,
+                                     no_wait ? 0 : 600'000, no_wait);
+        if (!fr) return false;
+        consumed_abort = true;
+        try {
+            wire::Reader r(fr->payload);
+            r.u64();
+            verdict_aborted = r.u8() != 0;
+        } catch (...) {}
+        return true;
+    };
+
+    Status st = Status::kOk;
+    auto tx = tx_conn(next, seq);
+    auto rx = rx_conn(prev, seq, 10'000);
+    if (!tx || !rx || !tx->alive()) {
+        st = Status::kConnectionLost;
+    } else {
+        reduce::RingCtx ctx;
+        ctx.tx = tx;
+        ctx.rx = rx;
+        ctx.rank = rank;
+        ctx.world = world;
+        ctx.op_seq = seq;
+        ctx.dtype = dtype;
+        ctx.op = desc.op;
+        ctx.quant = desc.quant;
+        ctx.q_dtype = desc.quant_dtype;
+        ctx.should_abort = [&]() -> bool {
+            if (op->abort.load()) return true;
+            if (consume_abort(true) && verdict_aborted) return true;
+            return false;
+        };
+        auto res = reduce::ring_allreduce(ctx, send, recv, count);
+        op->info.tx_bytes = ctx.tx_bytes;
+        op->info.rx_bytes = ctx.rx_bytes;
+        op->info.world = world;
+        if (res == reduce::Result::kAborted) st = Status::kAborted;
+        else if (res == reduce::Result::kConnectionLost) st = Status::kConnectionLost;
+    }
+
+    // 3. report completion; consume the exactly-one abort verdict; await done
+    bool local_failure = st != Status::kOk;
+    wire::Writer w;
+    w.u64(desc.tag);
+    w.u8(local_failure ? 1 : 0);
+    if (!master_.send(PacketType::kC2MCollectiveComplete, w.data()))
+        return Status::kConnectionLost;
+    if (!consumed_abort) {
+        if (!consume_abort(false)) return Status::kConnectionLost;
+    }
+    auto done = master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
+    if (!done) return Status::kConnectionLost;
+
+    if (st == Status::kOk && verdict_aborted) st = Status::kAborted;
+    return st;
+}
+
+Status Client::await_reduce(uint64_t tag, ReduceInfo *info) {
+    std::unique_ptr<AsyncOp> op;
+    {
+        std::lock_guard lk(ops_mu_);
+        auto it = ops_.find(tag);
+        if (it == ops_.end()) return Status::kInvalid;
+        op = std::move(it->second);
+        ops_.erase(it);
+    }
+    if (op->worker.joinable()) op->worker.join();
+    Status st = op->result.get();
+    if (info) *info = op->info;
+    return st;
+}
+
+Status Client::all_reduce(const void *send, void *recv, uint64_t count,
+                          proto::DType dtype, const ReduceDesc &desc, ReduceInfo *info) {
+    Status st = all_reduce_async(send, recv, count, dtype, desc);
+    if (st != Status::kOk) return st;
+    return await_reduce(desc.tag, info);
+}
+
+// ---------------- shared state ----------------
+
+Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy,
+                                 const std::vector<SharedStateEntry> &entries,
+                                 SyncInfo *info) {
+    if (!connected_.load()) return Status::kNotConnected;
+
+    // open the distribution window (we may be elected distributor)
+    {
+        std::lock_guard lk(dist_mu_);
+        dist_open_ = true;
+        dist_revision_ = revision;
+        dist_entries_.clear();
+        for (const auto &e : entries) dist_entries_[e.name] = e;
+        dist_tx_bytes_ = 0;
+    }
+    auto close_window = [this] {
+        std::lock_guard lk(dist_mu_);
+        dist_open_ = false;
+        dist_entries_.clear();
+    };
+
+    proto::SharedStateSyncC2M req;
+    req.revision = revision;
+    req.strategy = strategy;
+    for (const auto &e : entries) {
+        proto::SharedStateEntryMeta m;
+        m.name = e.name;
+        m.dtype = e.dtype;
+        m.count = e.count;
+        m.allow_content_inequality = e.allow_content_inequality ? 1 : 0;
+        m.hash = e.allow_content_inequality
+                     ? 0
+                     : hash::simplehash(e.data, e.count * proto::dtype_size(e.dtype));
+        req.entries.push_back(std::move(m));
+    }
+    if (!master_.send(PacketType::kC2MSharedStateSync, req.encode())) {
+        close_window();
+        return Status::kConnectionLost;
+    }
+    auto fr = master_.recv_match(PacketType::kM2CSharedStateSyncResp, nullptr, 300'000);
+    if (!fr) {
+        close_window();
+        return check_kicked() == Status::kOk ? Status::kConnectionLost : Status::kKicked;
+    }
+    auto resp = proto::SharedStateSyncResp::decode(fr->payload);
+    if (!resp) {
+        close_window();
+        return Status::kInternal;
+    }
+
+    uint64_t rx_bytes = 0;
+    Status st = Status::kOk;
+    if (resp->outdated) {
+        // update the distribution window so we don't serve stale content
+        {
+            std::lock_guard lk(dist_mu_);
+            dist_open_ = false;
+        }
+        net::Socket sock;
+        if (!sock.connect(net::Addr{resp->dist_ip, resp->dist_port}, 10'000)) {
+            st = Status::kConnectionLost;
+        } else {
+            wire::Writer w;
+            w.u64(resp->revision);
+            w.u32(static_cast<uint32_t>(resp->outdated_keys.size()));
+            for (const auto &k : resp->outdated_keys) w.str(k);
+            std::mutex mu;
+            if (!net::send_frame(sock, mu, PacketType::kC2SStateRequest, w.data())) {
+                st = Status::kConnectionLost;
+            } else {
+                auto hdr = net::recv_frame(sock);
+                if (!hdr || hdr->type != PacketType::kS2CStateHeader) {
+                    st = Status::kConnectionLost;
+                } else {
+                    try {
+                        wire::Reader r(hdr->payload);
+                        bool ok = r.u8() != 0;
+                        uint32_t n = r.u32();
+                        if (!ok) {
+                            st = Status::kAborted;
+                        } else {
+                            for (uint32_t i = 0; i < n && st == Status::kOk; ++i) {
+                                std::string name = r.str();
+                                auto dt = static_cast<proto::DType>(r.u8());
+                                uint64_t cnt = r.u64();
+                                const SharedStateEntry *target = nullptr;
+                                for (const auto &e : entries)
+                                    if (e.name == name) target = &e;
+                                if (!target || target->dtype != dt || target->count != cnt) {
+                                    st = Status::kContentMismatch;
+                                    break;
+                                }
+                                size_t nbytes = cnt * proto::dtype_size(dt);
+                                if (!sock.recv_all(target->data, nbytes)) {
+                                    st = Status::kConnectionLost;
+                                    break;
+                                }
+                                rx_bytes += nbytes;
+                                // verify against the mask's expected hash
+                                for (size_t k = 0; k < resp->outdated_keys.size(); ++k) {
+                                    if (resp->outdated_keys[k] != name) continue;
+                                    uint64_t h = hash::simplehash(target->data, nbytes);
+                                    if (h != resp->expected_hashes[k])
+                                        st = Status::kContentMismatch;
+                                }
+                            }
+                        }
+                    } catch (...) { st = Status::kInternal; }
+                }
+            }
+        }
+    }
+
+    if (!master_.send(PacketType::kC2MSharedStateDistDone, {})) {
+        close_window();
+        return Status::kConnectionLost;
+    }
+    auto done = master_.recv_match(PacketType::kM2CSharedStateDone, nullptr, 300'000);
+    close_window();
+    if (!done)
+        return check_kicked() == Status::kOk ? Status::kConnectionLost : Status::kKicked;
+
+    if (info) {
+        info->rx_bytes = rx_bytes;
+        info->tx_bytes = dist_tx_bytes_.load();
+        try {
+            wire::Reader r(done->payload);
+            info->revision = r.u64();
+        } catch (...) {}
+    }
+    return st;
+}
+
+// ---------------- attributes ----------------
+
+uint32_t Client::global_world() const {
+    std::lock_guard lk(state_mu_);
+    return static_cast<uint32_t>(peers_.size() + 1);
+}
+
+uint32_t Client::group_world() const {
+    std::lock_guard lk(state_mu_);
+    return static_cast<uint32_t>(ring_.size());
+}
+
+uint32_t Client::num_groups() const {
+    std::lock_guard lk(state_mu_);
+    std::set<uint32_t> g{cfg_.peer_group};
+    for (const auto &[_, pc] : peers_) g.insert(pc.ep.peer_group);
+    return static_cast<uint32_t>(g.size());
+}
+
+uint32_t Client::largest_group() const {
+    std::lock_guard lk(state_mu_);
+    std::map<uint32_t, uint32_t> counts;
+    ++counts[cfg_.peer_group];
+    for (const auto &[_, pc] : peers_) ++counts[pc.ep.peer_group];
+    uint32_t best = 0;
+    for (auto &[_, c] : counts) best = std::max(best, c);
+    return best;
+}
+
+} // namespace pcclt::client

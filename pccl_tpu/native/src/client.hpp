@@ -1,0 +1,162 @@
+// Client: membership, p2p mesh, collectives, shared-state sync.
+//
+// Reference parity: CCoIPClientHandler + CCoIPClientState
+// (/root/reference/ccoip/src/cpp/ccoip_client_handler.cpp, _state.cpp).
+// Same four sockets: master control connection (matched receive), p2p listen
+// + per-peer multiplex pools, shared-state distribution server, benchmark
+// server. Collective workers poll master abort packets by tag, so concurrent
+// reduce threads never steal the main thread's packets (the reference's
+// QueuedSocket discipline, ccoip_client_handler.cpp:1235-1241).
+#pragma once
+
+#include <atomic>
+#include <future>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "protocol.hpp"
+#include "sockets.hpp"
+
+namespace pcclt::client {
+
+enum class Status : int {
+    kOk = 0,
+    kInvalid = 1,
+    kNotConnected = 2,
+    kConnectionLost = 3,
+    kAborted = 4,
+    kTooFewPeers = 5,
+    kDuplicateTag = 6,
+    kKicked = 7,
+    kMasterUnreachable = 8,
+    kInternal = 9,
+    kContentMismatch = 10,
+};
+
+struct ClientConfig {
+    net::Addr master;
+    uint32_t peer_group = 0;
+    std::string adv_ip;            // explicit advertised address (NAT)
+    uint16_t p2p_port = 48502;     // bump-allocated upward if taken
+    uint16_t ss_port = 48532;
+    uint16_t bench_port = 48562;
+    size_t pool_size = 1;          // p2p connection pool per peer
+};
+
+struct ReduceDesc {
+    uint64_t tag = 0;
+    proto::RedOp op = proto::RedOp::kSum;
+    proto::QuantAlgo quant = proto::QuantAlgo::kNone;
+    proto::DType quant_dtype = proto::DType::kU8;
+};
+
+struct ReduceInfo {
+    uint64_t tx_bytes = 0, rx_bytes = 0;
+    uint32_t world = 0;
+};
+
+struct SharedStateEntry {
+    std::string name;
+    proto::DType dtype = proto::DType::kF32;
+    uint64_t count = 0;
+    void *data = nullptr;
+    bool allow_content_inequality = false;
+};
+
+struct SyncInfo {
+    uint64_t tx_bytes = 0, rx_bytes = 0;
+    uint64_t revision = 0;
+};
+
+class Client {
+public:
+    explicit Client(ClientConfig cfg) : cfg_(cfg) {}
+    ~Client();
+
+    Status connect();
+    void disconnect();
+
+    Status update_topology();
+    Status are_peers_pending(bool &pending);
+    Status optimize_topology();
+
+    Status all_reduce_async(const void *send, void *recv, uint64_t count,
+                            proto::DType dtype, const ReduceDesc &desc);
+    Status await_reduce(uint64_t tag, ReduceInfo *info);
+    Status all_reduce(const void *send, void *recv, uint64_t count, proto::DType dtype,
+                      const ReduceDesc &desc, ReduceInfo *info);
+
+    Status sync_shared_state(uint64_t revision, proto::SyncStrategy strategy,
+                             const std::vector<SharedStateEntry> &entries,
+                             SyncInfo *info);
+
+    uint32_t global_world() const;
+    uint32_t group_world() const;
+    uint32_t num_groups() const;
+    uint32_t largest_group() const;
+    const proto::Uuid &uuid() const { return uuid_; }
+    bool connected() const { return connected_.load(); }
+
+private:
+    struct PeerConns {
+        proto::PeerEndpoint ep;
+        std::vector<std::shared_ptr<net::MultiplexConn>> tx;
+        std::vector<std::shared_ptr<net::MultiplexConn>> rx;
+    };
+    struct AsyncOp {
+        std::thread worker;
+        std::future<Status> result;
+        ReduceInfo info;
+        std::atomic<bool> abort{false};
+    };
+    struct DistEntry {
+        const SharedStateEntry *e;
+    };
+
+    Status establish_loop(); // wait conn-info, connect mesh, confirm; until ok
+    Status establish_from_info(const proto::P2PConnInfo &info,
+                               std::vector<proto::Uuid> &failed);
+    void adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid> &ring);
+    Status check_kicked(); // poll for a queued kick packet
+    Status run_reduce_worker(const void *send, void *recv, uint64_t count,
+                             proto::DType dtype, ReduceDesc desc, AsyncOp *op);
+    void on_p2p_accept(net::Socket sock);
+    void on_ss_accept(net::Socket sock);
+    void on_bench_accept(net::Socket sock);
+
+    std::shared_ptr<net::MultiplexConn> tx_conn(const proto::Uuid &peer, size_t idx);
+    std::shared_ptr<net::MultiplexConn> rx_conn(const proto::Uuid &peer, size_t idx,
+                                                int timeout_ms);
+
+    ClientConfig cfg_;
+    proto::Uuid uuid_{};
+    std::atomic<bool> connected_{false};
+
+    net::ControlClient master_;
+    net::Listener p2p_listener_, ss_listener_, bench_listener_;
+
+    mutable std::mutex state_mu_;
+    std::map<proto::Uuid, PeerConns> peers_;
+    std::vector<proto::Uuid> ring_;
+    uint64_t topo_revision_ = 0;
+
+    std::mutex ops_mu_;
+    std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_;
+
+    // shared-state distribution window (serve only while a sync is active)
+    std::mutex dist_mu_;
+    bool dist_open_ = false;
+    uint64_t dist_revision_ = 0;
+    std::map<std::string, SharedStateEntry> dist_entries_;
+    std::atomic<uint64_t> dist_tx_bytes_{0};
+
+    std::vector<std::thread> service_threads_;
+    std::mutex service_mu_;
+};
+
+} // namespace pcclt::client

@@ -1,0 +1,83 @@
+#include "hash.hpp"
+
+#include <array>
+#include <cstring>
+
+namespace pcclt::hash {
+
+uint64_t avalanche64(uint64_t x) {
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+    x ^= x >> 33;
+    x *= 0xC4CEB9FE1A85EC53ull;
+    x ^= x >> 33;
+    return x;
+}
+
+uint64_t simplehash(const void *data, size_t nbytes) {
+    const auto *bytes = static_cast<const uint8_t *>(data);
+    const size_t nwords = (nbytes + 3) / 4;
+
+    std::array<uint64_t, kLanes> lane;
+    lane.fill(kSeed);
+
+    size_t full_words = nbytes / 4;
+    for (size_t i = 0; i < full_words; ++i) {
+        uint32_t w;
+        memcpy(&w, bytes + i * 4, 4);  // little-endian word load
+        size_t l = i % kLanes;
+        lane[l] = lane[l] * kP + w;
+    }
+    if (full_words != nwords) { // zero-padded tail word
+        uint32_t w = 0;
+        memcpy(&w, bytes + full_words * 4, nbytes - full_words * 4);
+        size_t l = full_words % kLanes;
+        lane[l] = lane[l] * kP + w;
+    }
+
+    uint64_t acc = kSeed ^ (static_cast<uint64_t>(nbytes) * kQ);
+    for (size_t l = 0; l < kLanes; ++l) acc = acc * kQ + lane[l];
+    return avalanche64(acc);
+}
+
+namespace {
+
+// slice-by-8 CRC32 tables, generated at first use
+struct Crc32Tables {
+    uint32_t t[8][256];
+    Crc32Tables() {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k) c = (c >> 1) ^ (0xEDB88320u & (~(c & 1) + 1));
+            t[0][i] = c;
+        }
+        for (uint32_t i = 0; i < 256; ++i)
+            for (int s = 1; s < 8; ++s)
+                t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xFF];
+    }
+};
+
+} // namespace
+
+uint32_t crc32(const void *data, size_t nbytes, uint32_t crc) {
+    static const Crc32Tables tbl;
+    const auto *p = static_cast<const uint8_t *>(data);
+    crc = ~crc;
+    while (nbytes >= 8) {
+        uint32_t lo;
+        memcpy(&lo, p, 4);
+        lo ^= crc;
+        uint32_t hi;
+        memcpy(&hi, p + 4, 4);
+        crc = tbl.t[7][lo & 0xFF] ^ tbl.t[6][(lo >> 8) & 0xFF] ^
+              tbl.t[5][(lo >> 16) & 0xFF] ^ tbl.t[4][lo >> 24] ^
+              tbl.t[3][hi & 0xFF] ^ tbl.t[2][(hi >> 8) & 0xFF] ^
+              tbl.t[1][(hi >> 16) & 0xFF] ^ tbl.t[0][hi >> 24];
+        p += 8;
+        nbytes -= 8;
+    }
+    while (nbytes--) crc = (crc >> 8) ^ tbl.t[0][(crc ^ *p++) & 0xFF];
+    return ~crc;
+}
+
+} // namespace pcclt::hash

@@ -1,0 +1,36 @@
+// Content hashing for shared-state drift detection.
+//
+// Reference parity: simplehash (CPU emulating the CUDA grid layout for
+// bit-identical CPU/GPU digests — /root/reference/ccoip/src/cpp/simplehash/
+// simplehash_cpu.cpp:7-58) and CRC32 (crc32_cpu.cpp).
+//
+// TPU-first re-design: instead of emulating an accelerator grid, the hash is
+// a 256-lane polynomial hash whose lane structure vectorizes identically in
+// C++ (Horner per lane) and numpy/JAX (matrix-times-power-vector) — the
+// device-independent bit-parity invariant the reference achieves with its
+// warp-shuffle emulation. See pccl_tpu/ops/hashing.py for the Python twin.
+//
+// Layout: bytes → little-endian u32 words (zero-padded tail), word i → lane
+// (i % 256). Lane state: Horner with P = 0x100000001B3 over u64. Lanes are
+// combined with a second Horner pass (Q = golden ratio), seeded with the
+// byte length, then finalized with a murmur-style avalanche.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pcclt::hash {
+
+inline constexpr uint64_t kLanes = 256;
+inline constexpr uint64_t kP = 0x100000001B3ull;           // FNV-1a prime
+inline constexpr uint64_t kQ = 0x9E3779B97F4A7C15ull;      // 2^64 / phi
+inline constexpr uint64_t kSeed = 0xCBF29CE484222325ull;   // FNV offset basis
+
+uint64_t simplehash(const void *data, size_t nbytes);
+
+// CRC32 (IEEE 802.3, reflected, poly 0xEDB88320) — matches zlib.crc32.
+uint32_t crc32(const void *data, size_t nbytes, uint32_t crc = 0);
+
+uint64_t avalanche64(uint64_t x); // exposed for the Python twin's tests
+
+} // namespace pcclt::hash

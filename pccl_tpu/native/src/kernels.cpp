@@ -1,0 +1,174 @@
+#include "kernels.hpp"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace pcclt::kernels {
+
+float f16_to_f32(uint16_t h) {
+    uint32_t sign = (h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t mant = h & 0x3FF;
+    uint32_t u;
+    if (exp == 0) {
+        if (mant == 0) {
+            u = sign;
+        } else { // subnormal
+            int e = -1;
+            do {
+                ++e;
+                mant <<= 1;
+            } while (!(mant & 0x400));
+            mant &= 0x3FF;
+            u = sign | ((127 - 15 - e) << 23) | (mant << 13);
+        }
+    } else if (exp == 0x1F) {
+        u = sign | 0x7F800000u | (mant << 13);
+    } else {
+        u = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    float f;
+    memcpy(&f, &u, 4);
+    return f;
+}
+
+uint16_t f32_to_f16(float f) {
+    uint32_t u;
+    memcpy(&u, &f, 4);
+    uint32_t sign = (u >> 16) & 0x8000u;
+    int32_t exp = static_cast<int32_t>((u >> 23) & 0xFF) - 127 + 15;
+    uint32_t mant = u & 0x7FFFFF;
+    if (exp >= 0x1F) return static_cast<uint16_t>(sign | 0x7C00 | (((u & 0x7F800000) == 0x7F800000 && mant) ? 0x200 : 0));
+    if (exp <= 0) {
+        if (exp < -10) return static_cast<uint16_t>(sign);
+        mant |= 0x800000;
+        uint32_t shift = static_cast<uint32_t>(14 - exp);
+        uint32_t half = mant >> shift;
+        uint32_t rem = mant & ((1u << shift) - 1);
+        uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (half & 1))) ++half;
+        return static_cast<uint16_t>(sign | half);
+    }
+    uint32_t half = (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+    uint32_t rem = mant & 0x1FFF;
+    if (rem > 0x1000 || (rem == 0x1000 && (half & 1))) ++half;
+    return static_cast<uint16_t>(sign | half);
+}
+
+namespace {
+
+template <typename T, typename Op> void loop(T *dst, const T *src, size_t n, Op op) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) dst[i] = op(dst[i], src[i]);
+}
+
+template <typename Op>
+void loop16(bool bf16, uint16_t *dst, const uint16_t *src, size_t n, Op op) {
+    for (size_t i = 0; i < n; ++i) {
+        float a = bf16 ? bf16_to_f32(dst[i]) : f16_to_f32(dst[i]);
+        float b = bf16 ? bf16_to_f32(src[i]) : f16_to_f32(src[i]);
+        float r = op(a, b);
+        dst[i] = bf16 ? f32_to_bf16(r) : f32_to_f16(r);
+    }
+}
+
+struct Add {
+    template <typename T> T operator()(T a, T b) const { return a + b; }
+};
+struct Mul {
+    template <typename T> T operator()(T a, T b) const { return a * b; }
+};
+struct Max {
+    template <typename T> T operator()(T a, T b) const { return std::max(a, b); }
+};
+struct Min {
+    template <typename T> T operator()(T a, T b) const { return std::min(a, b); }
+};
+
+template <typename T>
+void dispatch_op(proto::RedOp op, T *dst, const T *src, size_t n) {
+    switch (op) {
+    case proto::RedOp::kSum:
+    case proto::RedOp::kAvg: loop(dst, src, n, Add{}); break;
+    case proto::RedOp::kProd: loop(dst, src, n, Mul{}); break;
+    case proto::RedOp::kMax: loop(dst, src, n, Max{}); break;
+    case proto::RedOp::kMin: loop(dst, src, n, Min{}); break;
+    }
+}
+
+void dispatch_op16(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *src,
+                   size_t n) {
+    switch (op) {
+    case proto::RedOp::kSum:
+    case proto::RedOp::kAvg: loop16(bf16, dst, src, n, Add{}); break;
+    case proto::RedOp::kProd: loop16(bf16, dst, src, n, Mul{}); break;
+    case proto::RedOp::kMax: loop16(bf16, dst, src, n, Max{}); break;
+    case proto::RedOp::kMin: loop16(bf16, dst, src, n, Min{}); break;
+    }
+}
+
+} // namespace
+
+void accumulate(proto::DType dt, proto::RedOp op, void *dst, const void *src,
+                size_t count) {
+    using proto::DType;
+    switch (dt) {
+    case DType::kU8: dispatch_op(op, static_cast<uint8_t *>(dst), static_cast<const uint8_t *>(src), count); break;
+    case DType::kI8: dispatch_op(op, static_cast<int8_t *>(dst), static_cast<const int8_t *>(src), count); break;
+    case DType::kU16: dispatch_op(op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(src), count); break;
+    case DType::kI16: dispatch_op(op, static_cast<int16_t *>(dst), static_cast<const int16_t *>(src), count); break;
+    case DType::kU32: dispatch_op(op, static_cast<uint32_t *>(dst), static_cast<const uint32_t *>(src), count); break;
+    case DType::kI32: dispatch_op(op, static_cast<int32_t *>(dst), static_cast<const int32_t *>(src), count); break;
+    case DType::kU64: dispatch_op(op, static_cast<uint64_t *>(dst), static_cast<const uint64_t *>(src), count); break;
+    case DType::kI64: dispatch_op(op, static_cast<int64_t *>(dst), static_cast<const int64_t *>(src), count); break;
+    case DType::kF16: dispatch_op16(false, op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(src), count); break;
+    case DType::kBF16: dispatch_op16(true, op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(src), count); break;
+    case DType::kF32: dispatch_op(op, static_cast<float *>(dst), static_cast<const float *>(src), count); break;
+    case DType::kF64: dispatch_op(op, static_cast<double *>(dst), static_cast<const double *>(src), count); break;
+    }
+}
+
+void assign(proto::DType dt, void *dst, const void *src, size_t count) {
+    memcpy(dst, src, count * proto::dtype_size(dt));
+}
+
+namespace {
+
+template <typename T> void div_loop(T *dst, size_t n, uint64_t world) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) dst[i] = static_cast<T>(dst[i] / static_cast<T>(world));
+}
+
+} // namespace
+
+void finalize_avg(proto::DType dt, void *dst, size_t count, uint64_t world) {
+    using proto::DType;
+    switch (dt) {
+    case DType::kU8: div_loop(static_cast<uint8_t *>(dst), count, world); break;
+    case DType::kI8: div_loop(static_cast<int8_t *>(dst), count, world); break;
+    case DType::kU16: div_loop(static_cast<uint16_t *>(dst), count, world); break;
+    case DType::kI16: div_loop(static_cast<int16_t *>(dst), count, world); break;
+    case DType::kU32: div_loop(static_cast<uint32_t *>(dst), count, world); break;
+    case DType::kI32: div_loop(static_cast<int32_t *>(dst), count, world); break;
+    case DType::kU64: div_loop(static_cast<uint64_t *>(dst), count, world); break;
+    case DType::kI64: div_loop(static_cast<int64_t *>(dst), count, world); break;
+    case DType::kF16: {
+        auto *d = static_cast<uint16_t *>(dst);
+        for (size_t i = 0; i < count; ++i)
+            d[i] = f32_to_f16(f16_to_f32(d[i]) / static_cast<float>(world));
+        break;
+    }
+    case DType::kBF16: {
+        auto *d = static_cast<uint16_t *>(dst);
+        for (size_t i = 0; i < count; ++i)
+            d[i] = f32_to_bf16(bf16_to_f32(d[i]) / static_cast<float>(world));
+        break;
+    }
+    case DType::kF32: div_loop(static_cast<float *>(dst), count, world); break;
+    case DType::kF64: div_loop(static_cast<double *>(dst), count, world); break;
+    }
+}
+
+} // namespace pcclt::kernels

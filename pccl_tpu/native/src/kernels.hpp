@@ -1,0 +1,40 @@
+// Elementwise reduce kernels over all 12 wire dtypes.
+// Reference parity: /root/reference/ccoip/src/cpp/reduce_kernels.cpp —
+// op structs Set/Sum/Prod/Max/Min (+Avg via Sum + finalize divide),
+// dispatched over dtype. fp16/bf16 accumulate in float32.
+#pragma once
+
+#include <cstddef>
+
+#include "protocol.hpp"
+
+namespace pcclt::kernels {
+
+// dst[i] = op(dst[i], src[i]); op kSum/kAvg both accumulate via add.
+void accumulate(proto::DType dt, proto::RedOp op, void *dst, const void *src,
+                size_t count);
+
+// dst[i] = src[i]
+void assign(proto::DType dt, void *dst, const void *src, size_t count);
+
+// Avg finalization: dst[i] /= world (float dtypes; integer dtypes divide)
+void finalize_avg(proto::DType dt, void *dst, size_t count, uint64_t world);
+
+// fp16/bf16 <-> f32 scalar converters (shared with quantization)
+float f16_to_f32(uint16_t h);
+uint16_t f32_to_f16(float f);
+inline float bf16_to_f32(uint16_t b) {
+    uint32_t u = static_cast<uint32_t>(b) << 16;
+    float f;
+    __builtin_memcpy(&f, &u, 4);
+    return f;
+}
+inline uint16_t f32_to_bf16(float f) {
+    uint32_t u;
+    __builtin_memcpy(&u, &f, 4);
+    // round-to-nearest-even
+    uint32_t rounding = 0x7FFF + ((u >> 16) & 1);
+    return static_cast<uint16_t>((u + rounding) >> 16);
+}
+
+} // namespace pcclt::kernels

@@ -1,0 +1,62 @@
+#include "log.hpp"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+#include <thread>
+
+namespace pcclt::log {
+
+namespace {
+
+Level parse_env() {
+    const char *e = std::getenv("PCCLT_LOG_LEVEL");
+    if (!e) return Level::kInfo;
+    if (!strcasecmp(e, "TRACE")) return Level::kTrace;
+    if (!strcasecmp(e, "DEBUG")) return Level::kDebug;
+    if (!strcasecmp(e, "INFO")) return Level::kInfo;
+    if (!strcasecmp(e, "WARN")) return Level::kWarn;
+    if (!strcasecmp(e, "ERROR")) return Level::kError;
+    if (!strcasecmp(e, "FATAL")) return Level::kFatal;
+    return Level::kInfo;
+}
+
+Level g_threshold = parse_env();
+std::mutex g_mu;
+
+const char *name(Level lv) {
+    switch (lv) {
+    case Level::kTrace: return "TRACE";
+    case Level::kDebug: return "DEBUG";
+    case Level::kInfo: return "INFO";
+    case Level::kWarn: return "WARN";
+    case Level::kError: return "ERROR";
+    case Level::kFatal: return "FATAL";
+    }
+    return "?";
+}
+
+} // namespace
+
+Level threshold() { return g_threshold; }
+void set_threshold(Level lv) { g_threshold = lv; }
+
+void write(Level lv, const std::string &msg) {
+    if (lv < g_threshold) return;
+    time_t t = time(nullptr);
+    struct tm tmv;
+    localtime_r(&t, &tmv);
+    char ts[16];
+    strftime(ts, sizeof ts, "%H:%M:%S", &tmv);
+    auto tid = std::hash<std::thread::id>{}(std::this_thread::get_id()) % 100000;
+    std::lock_guard lk(g_mu);
+    fprintf(stderr, "[%s][%5s][cc:%zu] %s\n", ts, name(lv), tid, msg.c_str());
+    if (lv == Level::kFatal) {
+        fflush(stderr);
+        abort();
+    }
+}
+
+} // namespace pcclt::log

@@ -1,0 +1,37 @@
+// Leveled stream logger for the native core.
+// Reference parity: /root/reference/log/include/pccl_log.hpp (stream logger,
+// env-selected level) — re-designed as a small macro-free API.
+// Env: PCCLT_LOG_LEVEL in {TRACE, DEBUG, INFO, WARN, ERROR, FATAL}; default INFO.
+#pragma once
+
+#include <sstream>
+#include <string>
+
+namespace pcclt::log {
+
+enum class Level : int { kTrace = 0, kDebug, kInfo, kWarn, kError, kFatal };
+
+Level threshold();
+void set_threshold(Level lv);
+void write(Level lv, const std::string &msg);
+
+// Usage: PLOG(kDebug) << "x=" << x;
+class Line {
+public:
+    explicit Line(Level lv) : lv_(lv) {}
+    ~Line() { write(lv_, ss_.str()); }
+    template <typename T> Line &operator<<(const T &v) {
+        ss_ << v;
+        return *this;
+    }
+
+private:
+    Level lv_;
+    std::ostringstream ss_;
+};
+
+} // namespace pcclt::log
+
+#define PLOG(level)                                                            \
+    if (::pcclt::log::Level::level >= ::pcclt::log::threshold())               \
+    ::pcclt::log::Line(::pcclt::log::Level::level)

@@ -1,0 +1,203 @@
+#include "master.hpp"
+
+#include "log.hpp"
+
+namespace pcclt::master {
+
+using proto::PacketType;
+
+bool Master::launch() {
+    if (!listener_.listen(port_)) {
+        PLOG(kError) << "master: cannot bind port " << port_;
+        return false;
+    }
+    port_ = listener_.port();
+    running_ = true;
+    dispatcher_ = std::thread([this] { dispatcher_loop(); });
+
+    listener_.run_async([this](net::Socket sock) {
+        uint64_t id;
+        std::shared_ptr<Conn> conn;
+        {
+            std::lock_guard lk(conns_mu_);
+            id = next_conn_id_++;
+            conn = std::make_shared<Conn>();
+            conn->src_ip = sock.peer_addr().ip;
+            conn->sock = std::move(sock);
+            conns_[id] = conn;
+        }
+        conn->sock.set_keepalive();
+        conn->reader = std::thread([this, id, conn] {
+            while (running_.load()) {
+                auto f = net::recv_frame(conn->sock);
+                if (!f) break;
+                push_event({Event::kPacket, id, std::move(*f)});
+            }
+            push_event({Event::kDisconnect, id, {}});
+        });
+    });
+    PLOG(kInfo) << "master listening on port " << port_;
+    return true;
+}
+
+void Master::push_event(Event ev) {
+    {
+        std::lock_guard lk(ev_mu_);
+        events_.push_back(std::move(ev));
+    }
+    ev_cv_.notify_one();
+}
+
+void Master::apply_outbox(const std::vector<Outbox> &out) {
+    for (const auto &o : out) {
+        std::shared_ptr<Conn> conn;
+        {
+            std::lock_guard lk(conns_mu_);
+            auto it = conns_.find(o.conn_id);
+            if (it == conns_.end()) continue;
+            conn = it->second;
+        }
+        net::send_frame(conn->sock, conn->write_mu, o.type, o.payload);
+    }
+    for (uint64_t id : state_.take_pending_closes()) {
+        std::shared_ptr<Conn> conn;
+        {
+            std::lock_guard lk(conns_mu_);
+            auto it = conns_.find(id);
+            if (it == conns_.end()) continue;
+            conn = it->second;
+        }
+        conn->sock.shutdown(); // reader thread will emit the disconnect event
+    }
+}
+
+void Master::dispatcher_loop() {
+    while (running_.load()) {
+        Event ev;
+        {
+            std::unique_lock lk(ev_mu_);
+            ev_cv_.wait_for(lk, std::chrono::milliseconds(100),
+                            [this] { return !events_.empty() || !running_.load(); });
+            if (events_.empty()) continue;
+            ev = std::move(events_.front());
+            events_.pop_front();
+        }
+
+        std::vector<Outbox> out;
+        if (ev.kind == Event::kDisconnect) {
+            out = state_.on_disconnect(ev.conn_id);
+            std::shared_ptr<Conn> conn;
+            {
+                std::lock_guard lk(conns_mu_);
+                auto it = conns_.find(ev.conn_id);
+                if (it != conns_.end()) {
+                    conn = it->second;
+                    conns_.erase(it);
+                }
+            }
+            if (conn) {
+                conn->sock.close();
+                if (conn->reader.joinable()) conn->reader.detach();
+            }
+        } else {
+            uint32_t src_ip = 0;
+            {
+                std::lock_guard lk(conns_mu_);
+                auto it = conns_.find(ev.conn_id);
+                if (it != conns_.end()) src_ip = it->second->src_ip;
+            }
+            const auto &p = ev.frame.payload;
+            try {
+                switch (ev.frame.type) {
+                case PacketType::kC2MHello: {
+                    auto h = proto::HelloC2M::decode(p);
+                    if (h) out = state_.on_hello(ev.conn_id, src_ip, *h);
+                    break;
+                }
+                case PacketType::kC2MTopologyUpdate:
+                    out = state_.on_topology_update(ev.conn_id);
+                    break;
+                case PacketType::kC2MPeersPendingQuery:
+                    out = state_.on_peers_pending_query(ev.conn_id);
+                    break;
+                case PacketType::kC2MP2PEstablished: {
+                    wire::Reader r(p);
+                    uint64_t revision = r.u64();
+                    bool ok = r.u8() != 0;
+                    uint32_t n = r.u32();
+                    std::vector<Uuid> failed;
+                    for (uint32_t i = 0; i < n; ++i) failed.push_back(proto::get_uuid(r));
+                    out = state_.on_p2p_established(ev.conn_id, revision, ok, failed);
+                    break;
+                }
+                case PacketType::kC2MCollectiveInit: {
+                    auto ci = proto::CollectiveInit::decode(p);
+                    if (ci) out = state_.on_collective_init(ev.conn_id, *ci);
+                    break;
+                }
+                case PacketType::kC2MCollectiveComplete: {
+                    wire::Reader r(p);
+                    uint64_t tag = r.u64();
+                    bool aborted = r.u8() != 0;
+                    out = state_.on_collective_complete(ev.conn_id, tag, aborted);
+                    break;
+                }
+                case PacketType::kC2MSharedStateSync: {
+                    auto s = proto::SharedStateSyncC2M::decode(p);
+                    if (s) out = state_.on_shared_state_sync(ev.conn_id, *s);
+                    break;
+                }
+                case PacketType::kC2MSharedStateDistDone:
+                    out = state_.on_dist_done(ev.conn_id);
+                    break;
+                case PacketType::kC2MOptimizeTopology:
+                    out = state_.on_optimize(ev.conn_id);
+                    break;
+                case PacketType::kC2MBandwidthReport: {
+                    wire::Reader r(p);
+                    Uuid to = proto::get_uuid(r);
+                    double mbps = r.f64();
+                    out = state_.on_bandwidth_report(ev.conn_id, to, mbps);
+                    break;
+                }
+                case PacketType::kC2MOptimizeWorkDone:
+                    out = state_.on_optimize_work_done(ev.conn_id);
+                    break;
+                default:
+                    PLOG(kWarn) << "master: unknown packet type 0x" << std::hex
+                                << ev.frame.type;
+                }
+            } catch (const std::exception &e) {
+                PLOG(kError) << "master: malformed packet type 0x" << std::hex
+                             << ev.frame.type << ": " << e.what();
+            }
+        }
+        apply_outbox(out);
+    }
+}
+
+void Master::interrupt() {
+    if (!running_.exchange(false)) return;
+    listener_.stop();
+    {
+        std::lock_guard lk(conns_mu_);
+        for (auto &[_, c] : conns_) c->sock.shutdown();
+    }
+    ev_cv_.notify_all();
+}
+
+void Master::join() {
+    if (dispatcher_.joinable()) dispatcher_.join();
+    std::map<uint64_t, std::shared_ptr<Conn>> conns;
+    {
+        std::lock_guard lk(conns_mu_);
+        conns.swap(conns_);
+    }
+    for (auto &[_, c] : conns) {
+        c->sock.shutdown();
+        if (c->reader.joinable()) c->reader.join();
+        c->sock.close();
+    }
+}
+
+} // namespace pcclt::master

@@ -1,0 +1,65 @@
+// Master node: accepts client control connections and drives the
+// MasterState machine from a single dispatcher thread.
+//
+// Reference parity: CCoIPMaster/CCoIPMasterHandler (/root/reference/ccoip/
+// src/cpp/ccoip_master_handler.cpp) — the reference uses one libuv loop
+// thread; here each connection has a cheap blocking reader thread that
+// feeds a single MPSC event queue, preserving the deterministic
+// single-threaded state machine property.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "master_state.hpp"
+#include "sockets.hpp"
+
+namespace pcclt::master {
+
+class Master {
+public:
+    explicit Master(uint16_t port) : port_(port) {}
+    ~Master() { interrupt(); join(); }
+
+    bool launch();
+    void interrupt();
+    void join();
+    uint16_t port() const { return port_; }
+
+private:
+    struct Conn {
+        net::Socket sock;
+        std::mutex write_mu;
+        std::thread reader;
+        uint32_t src_ip = 0;
+    };
+    struct Event {
+        enum Kind { kPacket, kDisconnect } kind;
+        uint64_t conn_id;
+        net::Frame frame;
+    };
+
+    void dispatcher_loop();
+    void push_event(Event ev);
+    void apply_outbox(const std::vector<Outbox> &out);
+
+    uint16_t port_;
+    net::Listener listener_;
+    MasterState state_;
+    std::map<uint64_t, std::shared_ptr<Conn>> conns_;
+    std::mutex conns_mu_;
+    uint64_t next_conn_id_ = 1;
+
+    std::mutex ev_mu_;
+    std::condition_variable ev_cv_;
+    std::deque<Event> events_;
+    std::thread dispatcher_;
+    std::atomic<bool> running_{false};
+};
+
+} // namespace pcclt::master

@@ -1,0 +1,31 @@
+// Standalone master executable.
+// Reference parity: ccoip_master binary (/root/reference/ccoip_master/src/
+// main.cpp) — listens on the default port, SIGINT/SIGTERM interrupts.
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+
+#include "../include/pcclt.h"
+
+static pccltMaster_t *g_master = nullptr;
+
+static void on_signal(int) {
+    if (g_master) pccltInterruptMaster(g_master);
+}
+
+int main(int argc, char **argv) {
+    uint16_t port = 48501;
+    if (argc > 1) port = static_cast<uint16_t>(atoi(argv[1]));
+    if (pccltCreateMaster("0.0.0.0", port, &g_master) != pccltSuccess) return 1;
+    if (pccltRunMaster(g_master) != pccltSuccess) {
+        fprintf(stderr, "failed to launch master on port %u\n", port);
+        return 1;
+    }
+    printf("pcclt master listening on port %u\n", pccltMasterPort(g_master));
+    fflush(stdout);
+    signal(SIGINT, on_signal);
+    signal(SIGTERM, on_signal);
+    pccltMasterAwaitTermination(g_master);
+    pccltDestroyMaster(g_master);
+    return 0;
+}

@@ -1,0 +1,635 @@
+#include "master_state.hpp"
+
+#include <algorithm>
+
+#include "atsp.hpp"
+#include "log.hpp"
+#include "sockets.hpp"
+
+namespace pcclt::master {
+
+using proto::PacketType;
+
+namespace {
+proto::PeerEndpoint endpoint_of(const ClientInfo &c) {
+    return proto::PeerEndpoint{c.uuid, c.ip, c.p2p_port, c.bench_port, c.peer_group};
+}
+} // namespace
+
+ClientInfo *MasterState::by_conn(uint64_t conn) {
+    auto it = clients_.find(conn);
+    return it == clients_.end() ? nullptr : &it->second;
+}
+
+ClientInfo *MasterState::by_uuid(const Uuid &u) {
+    for (auto &[_, c] : clients_)
+        if (c.uuid == u) return &c;
+    return nullptr;
+}
+
+std::vector<ClientInfo *> MasterState::accepted_clients() {
+    std::vector<ClientInfo *> v;
+    for (auto &[_, c] : clients_)
+        if (c.accepted) v.push_back(&c);
+    return v;
+}
+
+std::vector<ClientInfo *> MasterState::group_members(uint32_t group) {
+    std::vector<ClientInfo *> v;
+    for (auto &[_, c] : clients_)
+        if (c.accepted && c.peer_group == group) v.push_back(&c);
+    return v;
+}
+
+size_t MasterState::world_size() const {
+    size_t n = 0;
+    for (auto &[_, c] : clients_)
+        if (c.accepted) ++n;
+    return n;
+}
+
+std::vector<Uuid> MasterState::build_ring(uint32_t group) {
+    // keep the existing (possibly ATSP-optimized) order for surviving members,
+    // append newcomers in join order
+    auto members = group_members(group);
+    std::vector<Uuid> ring;
+    for (const auto &u : groups_[group].ring) {
+        for (auto *m : members)
+            if (m->uuid == u) {
+                ring.push_back(u);
+                break;
+            }
+    }
+    for (auto *m : members)
+        if (std::find(ring.begin(), ring.end(), m->uuid) == ring.end())
+            ring.push_back(m->uuid);
+    groups_[group].ring = ring;
+    return ring;
+}
+
+void MasterState::kick(std::vector<Outbox> &out, ClientInfo &c, const std::string &reason) {
+    PLOG(kWarn) << "kicking client " << proto::uuid_str(c.uuid) << ": " << reason;
+    wire::Writer w;
+    w.str(reason);
+    out.push_back({c.conn_id, PacketType::kM2CKicked, w.take()});
+    pending_closes_.push_back(c.conn_id);
+    // removal + consensus re-checks happen when the dispatcher closes the
+    // conn and feeds the disconnect event back in.
+}
+
+std::vector<uint64_t> MasterState::take_pending_closes() {
+    auto v = std::move(pending_closes_);
+    pending_closes_.clear();
+    return v;
+}
+
+// ---------- join ----------
+
+std::vector<Outbox> MasterState::on_hello(uint64_t conn, uint32_t src_ip,
+                                          const proto::HelloC2M &h) {
+    std::vector<Outbox> out;
+    ClientInfo c;
+    c.uuid = proto::uuid_random();
+    c.conn_id = conn;
+    c.peer_group = h.peer_group;
+    c.ip = src_ip;
+    c.p2p_port = h.p2p_port;
+    c.ss_port = h.ss_port;
+    c.bench_port = h.bench_port;
+    if (!h.adv_ip.empty()) {
+        if (auto a = net::Addr::parse(h.adv_ip, 0)) c.ip = a->ip;
+    }
+    clients_[conn] = c;
+    PLOG(kInfo) << "client " << proto::uuid_str(c.uuid) << " joined (pending), group "
+                << c.peer_group << ", world=" << world_size();
+
+    wire::Writer w;
+    w.u8(1);
+    proto::put_uuid(w, c.uuid);
+    w.str("welcome");
+    out.push_back({conn, PacketType::kM2CWelcome, w.take()});
+    check_topology(out);
+    return out;
+}
+
+// ---------- topology update / peer accept round ----------
+
+std::vector<Outbox> MasterState::on_topology_update(uint64_t conn) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    c->vote_topology = true;
+    check_topology(out);
+    return out;
+}
+
+std::vector<Outbox> MasterState::on_peers_pending_query(uint64_t conn) {
+    std::vector<Outbox> out;
+    bool pending = false;
+    for (auto &[_, c] : clients_)
+        if (!c.accepted) pending = true;
+    wire::Writer w;
+    w.u8(pending ? 1 : 0);
+    out.push_back({conn, PacketType::kM2CPeersPendingReply, w.take()});
+    return out;
+}
+
+void MasterState::check_topology(std::vector<Outbox> &out) {
+    if (establish_in_flight_ || optimize_in_flight_) return;
+    auto acc = accepted_clients();
+    bool any_pending = clients_.size() > acc.size();
+    if (acc.empty() && !any_pending) return;
+    // round runs when every accepted client voted; a lone pending world
+    // (no accepted clients yet) admits immediately
+    for (auto *a : acc)
+        if (!a->vote_topology) return;
+    if (acc.empty() || any_pending || !acc.empty()) {
+        // admit all pending
+        for (auto &[_, c] : clients_)
+            if (!c.accepted) {
+                c.accepted = true;
+                PLOG(kInfo) << "admitted " << proto::uuid_str(c.uuid) << " to group "
+                            << c.peer_group;
+            }
+    }
+    ++topology_revision_;
+    establish_in_flight_ = true;
+    round_members_.clear();
+    std::set<uint32_t> groups;
+    for (auto &[_, c] : clients_) {
+        round_members_.insert(c.uuid);
+        c.reported_establish = false;
+        c.establish_ok = false;
+        c.establish_failed.clear();
+        groups.insert(c.peer_group);
+    }
+    for (uint32_t g : groups) build_ring(g);
+
+    for (auto &[_, c] : clients_) {
+        proto::P2PConnInfo info;
+        info.revision = topology_revision_;
+        for (auto &[_, o] : clients_)
+            if (o.uuid != c.uuid) info.peers.push_back(endpoint_of(o));
+        info.ring = groups_[c.peer_group].ring;
+        out.push_back({c.conn_id, PacketType::kM2CP2PConnInfo, info.encode()});
+    }
+}
+
+std::vector<Outbox> MasterState::on_p2p_established(uint64_t conn, uint64_t revision,
+                                                    bool ok,
+                                                    const std::vector<Uuid> &failed) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    if (revision != topology_revision_) return out; // stale-round report
+    c->reported_establish = true;
+    c->establish_ok = ok;
+    c->establish_failed = failed;
+    check_establish(out);
+    return out;
+}
+
+void MasterState::check_establish(std::vector<Outbox> &out) {
+    if (!establish_in_flight_) return;
+    for (auto &[_, c] : clients_)
+        if (c.accepted && !c.reported_establish) return;
+
+    // a round member departed mid-round? force retry (newly-arrived pending
+    // clients are NOT round members and do not disturb the round)
+    size_t present = 0;
+    for (auto &[_, c] : clients_)
+        if (round_members_.count(c.uuid)) ++present;
+    bool membership_stable = present == round_members_.size();
+
+    // peers reported unreachable by anyone get kicked
+    std::set<Uuid> unreachable;
+    bool all_ok = true;
+    for (auto &[_, c] : clients_) {
+        if (!c.accepted) continue; // pending newcomers are not in the round
+        if (!c.establish_ok) all_ok = false;
+        for (const auto &f : c.establish_failed) unreachable.insert(f);
+    }
+
+    establish_in_flight_ = false;
+    if (all_ok && membership_stable && unreachable.empty()) {
+        for (auto &[_, c] : clients_) {
+            if (!c.accepted) continue; // pending clients are not in this round
+            c.vote_topology = false;
+            c.reported_establish = false;
+            wire::Writer w;
+            w.u64(topology_revision_);
+            w.u8(1);
+            const auto &ring = groups_[c.peer_group].ring;
+            w.u32(static_cast<uint32_t>(ring.size()));
+            for (const auto &u : ring) proto::put_uuid(w, u);
+            out.push_back({c.conn_id, PacketType::kM2CP2PEstablishedResp, w.take()});
+        }
+        PLOG(kInfo) << "topology round " << topology_revision_ << " complete, world="
+                    << world_size();
+    } else {
+        // kick unreachable peers; everyone else retries
+        std::vector<ClientInfo *> to_kick;
+        for (auto &[_, c] : clients_)
+            if (unreachable.count(c.uuid)) to_kick.push_back(&c);
+        for (auto *c : to_kick) kick(out, *c, "unreachable by peers");
+        for (auto &[_, c] : clients_) {
+            if (!c.accepted || unreachable.count(c.uuid)) continue;
+            c.reported_establish = false;
+            wire::Writer w;
+            w.u64(topology_revision_);
+            w.u8(0);
+            w.u32(0);
+            out.push_back({c.conn_id, PacketType::kM2CP2PEstablishedResp, w.take()});
+        }
+        PLOG(kWarn) << "topology round " << topology_revision_ << " failed; clients retry";
+        // votes are still standing: immediately open the next round so joiners
+        // that raced into the failed round get admitted now
+        check_topology(out);
+    }
+}
+
+// ---------- collectives ----------
+
+std::vector<Outbox> MasterState::on_collective_init(uint64_t conn,
+                                                    const proto::CollectiveInit &ci) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c || !c->accepted) return out;
+    auto &g = groups_[c->peer_group];
+    auto it = g.ops.find(ci.tag);
+    if (it == g.ops.end()) {
+        CollectiveOp op;
+        op.params = ci;
+        g.ops[ci.tag] = op;
+        it = g.ops.find(ci.tag);
+    } else if (it->second.params.count != ci.count ||
+               it->second.params.dtype != ci.dtype || it->second.params.op != ci.op) {
+        kick(out, *c, "collective op parameter mismatch");
+        return out;
+    }
+    it->second.initiated.insert(c->uuid);
+    check_collective(out, c->peer_group, ci.tag);
+    return out;
+}
+
+void MasterState::check_collective(std::vector<Outbox> &out, uint32_t group, uint64_t tag) {
+    auto git = groups_.find(group);
+    if (git == groups_.end()) return;
+    auto oit = git->second.ops.find(tag);
+    if (oit == git->second.ops.end()) return;
+    auto &op = oit->second;
+    auto members = group_members(group);
+
+    if (!op.commenced) {
+        for (auto *m : members)
+            if (!op.initiated.count(m->uuid)) return;
+        op.commenced = true;
+        op.seq = next_seq_++;
+        for (auto *m : members) op.members.insert(m->uuid);
+        for (auto *m : members) {
+            wire::Writer w;
+            w.u64(tag);
+            w.u64(op.seq);
+            out.push_back({m->conn_id, PacketType::kM2CCollectiveCommence, w.take()});
+        }
+        PLOG(kDebug) << "collective tag " << tag << " commenced, group " << group
+                     << ", world " << op.members.size();
+        return;
+    }
+
+    // completion: all surviving members must have reported
+    for (const auto &u : op.members) {
+        auto *m = by_uuid(u);
+        if (m && !op.completed.count(u)) return;
+    }
+    // exactly-one-abort accounting: if not broadcast early, deliver verdict now
+    for (const auto &u : op.members) {
+        auto *m = by_uuid(u);
+        if (!m) continue;
+        if (!op.abort_broadcast) {
+            wire::Writer w;
+            w.u64(tag);
+            w.u8(op.any_aborted ? 1 : 0);
+            out.push_back({m->conn_id, PacketType::kM2CCollectiveAbort, w.take()});
+        }
+        wire::Writer w2;
+        w2.u64(tag);
+        out.push_back({m->conn_id, PacketType::kM2CCollectiveDone, w2.take()});
+    }
+    git->second.ops.erase(oit);
+}
+
+std::vector<Outbox> MasterState::on_collective_complete(uint64_t conn, uint64_t tag,
+                                                        bool aborted) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    auto &g = groups_[c->peer_group];
+    auto it = g.ops.find(tag);
+    if (it == g.ops.end()) return out;
+    it->second.completed.insert(c->uuid);
+    if (aborted) it->second.any_aborted = true;
+    check_collective(out, c->peer_group, tag);
+    return out;
+}
+
+void MasterState::abort_group_collectives(std::vector<Outbox> &out, uint32_t group) {
+    auto git = groups_.find(group);
+    if (git == groups_.end()) return;
+    for (auto &[tag, op] : git->second.ops) {
+        if (!op.commenced || op.abort_broadcast) continue;
+        op.abort_broadcast = true;
+        op.any_aborted = true;
+        for (const auto &u : op.members) {
+            auto *m = by_uuid(u);
+            if (!m) continue;
+            wire::Writer w;
+            w.u64(tag);
+            w.u8(1);
+            out.push_back({m->conn_id, PacketType::kM2CCollectiveAbort, w.take()});
+        }
+        PLOG(kWarn) << "aborting collective tag " << tag << " in group " << group;
+    }
+}
+
+// ---------- shared state ----------
+
+std::vector<Outbox> MasterState::on_shared_state_sync(uint64_t conn,
+                                                      const proto::SharedStateSyncC2M &req) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c || !c->accepted) return out;
+    auto &g = groups_[c->peer_group];
+    if (g.revision_initialized && req.revision > g.last_revision + 1) {
+        kick(out, *c, "shared-state revision increment violation");
+        return out;
+    }
+    c->sync_req = req;
+    c->dist_done = false;
+    check_shared_state(out, c->peer_group);
+    return out;
+}
+
+void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
+    if (groups_[group].sync_in_flight) return; // round already answered
+    auto members = group_members(group);
+    if (members.empty()) return;
+    for (auto *m : members)
+        if (!m->sync_req) return;
+    auto &g = groups_[group];
+
+    // key-set agreement: every member must declare the same entry names,
+    // dtypes and counts (content may differ)
+    const auto &ref_entries = members[0]->sync_req->entries;
+    for (auto *m : members) {
+        const auto &e = m->sync_req->entries;
+        bool mismatch = e.size() != ref_entries.size();
+        if (!mismatch)
+            for (size_t i = 0; i < e.size(); ++i)
+                if (e[i].name != ref_entries[i].name || e[i].dtype != ref_entries[i].dtype ||
+                    e[i].count != ref_entries[i].count)
+                    mismatch = true;
+        if (mismatch) {
+            kick(out, *m, "shared-state key-set mismatch");
+            return; // disconnect event will re-run this check
+        }
+    }
+
+    // mask election: candidates are tx-capable peers; canonical revision is
+    // the max among them; winning content is the most popular hash-vector
+    // at the canonical revision (reference: popularity + priority election,
+    // ccoip_master_state.cpp:1139-1184)
+    std::vector<ClientInfo *> candidates;
+    for (auto *m : members)
+        if (m->sync_req->strategy != proto::SyncStrategy::kRxOnly) candidates.push_back(m);
+    if (candidates.empty()) {
+        for (auto *m : members) kick(out, *m, "no tx-capable peer for shared-state sync");
+        return;
+    }
+    uint64_t canonical_rev = 0;
+    for (auto *m : candidates) canonical_rev = std::max(canonical_rev, m->sync_req->revision);
+
+    std::map<std::vector<uint64_t>, std::vector<ClientInfo *>> content_groups;
+    for (auto *m : candidates) {
+        if (m->sync_req->revision != canonical_rev) continue;
+        std::vector<uint64_t> key;
+        for (const auto &e : m->sync_req->entries)
+            key.push_back(e.allow_content_inequality ? 0 : e.hash);
+        content_groups[key].push_back(m);
+    }
+    std::vector<ClientInfo *> mask;
+    size_t best = 0;
+    for (auto &[_, v] : content_groups)
+        if (v.size() > best) {
+            best = v.size();
+            mask = v;
+        }
+    if (mask.empty()) return; // cannot happen: candidates nonempty
+    ClientInfo *distributor = mask[0];
+    const auto &mask_entries = distributor->sync_req->entries;
+
+    for (auto *m : members) {
+        std::vector<std::string> dirty;
+        std::vector<uint64_t> expected;
+        bool outdated_rev = m->sync_req->revision != canonical_rev;
+        for (size_t i = 0; i < mask_entries.size(); ++i) {
+            if (mask_entries[i].allow_content_inequality) continue;
+            if (outdated_rev || m->sync_req->entries[i].hash != mask_entries[i].hash) {
+                dirty.push_back(mask_entries[i].name);
+                expected.push_back(mask_entries[i].hash);
+            }
+        }
+        bool outdated = !dirty.empty();
+        if (outdated && m->sync_req->strategy == proto::SyncStrategy::kTxOnly) {
+            kick(out, *m, "tx-only peer has outdated shared state");
+            return;
+        }
+        proto::SharedStateSyncResp resp;
+        resp.outdated = outdated ? 1 : 0;
+        resp.dist_ip = distributor->ip;
+        resp.dist_port = distributor->ss_port;
+        resp.revision = canonical_rev;
+        resp.outdated_keys = dirty;
+        resp.expected_hashes = expected;
+        out.push_back({m->conn_id, PacketType::kM2CSharedStateSyncResp, resp.encode()});
+    }
+    g.sync_in_flight = true;
+    g.sync_revision = canonical_rev;
+}
+
+std::vector<Outbox> MasterState::on_dist_done(uint64_t conn) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    c->dist_done = true;
+    auto members = group_members(c->peer_group);
+    for (auto *m : members)
+        if (m->sync_req && !m->dist_done) return out;
+    auto &g = groups_[c->peer_group];
+    for (auto *m : members) {
+        wire::Writer w;
+        w.u64(g.sync_revision);
+        out.push_back({m->conn_id, PacketType::kM2CSharedStateDone, w.take()});
+        m->sync_req.reset();
+        m->dist_done = false;
+    }
+    g.last_revision = g.sync_revision;
+    g.revision_initialized = true;
+    g.sync_in_flight = false;
+    PLOG(kDebug) << "shared-state sync complete, group " << c->peer_group << " revision "
+                 << g.last_revision;
+    return out;
+}
+
+// ---------- topology optimization ----------
+
+std::vector<Outbox> MasterState::on_optimize(uint64_t conn) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c || !c->accepted) return out;
+    c->vote_optimize = true;
+    check_optimize(out);
+    return out;
+}
+
+void MasterState::check_optimize(std::vector<Outbox> &out) {
+    auto acc = accepted_clients();
+    if (acc.empty()) return;
+    if (!optimize_in_flight_) {
+        for (auto *a : acc)
+            if (!a->vote_optimize) return;
+        optimize_in_flight_ = true;
+    } else {
+        for (auto *a : acc)
+            if (!a->optimize_work_done) return;
+    }
+
+    std::vector<Uuid> uuids;
+    for (auto *a : acc) uuids.push_back(a->uuid);
+    auto missing = bandwidth_.missing_edges(uuids);
+
+    if (!missing.empty()) {
+        // hand each client its outgoing un-measured edges
+        for (auto *a : acc) {
+            proto::OptimizeResponse resp;
+            resp.complete = 0;
+            for (const auto &[from, to] : missing) {
+                if (from != a->uuid) continue;
+                auto *t = by_uuid(to);
+                if (!t) continue;
+                resp.requests.push_back({to, t->ip, t->bench_port});
+            }
+            a->optimize_work_done = false;
+            out.push_back({a->conn_id, PacketType::kM2COptimizeResponse, resp.encode()});
+        }
+        return;
+    }
+
+    // all edges measured: solve ATSP per group, adopt new rings
+    std::set<uint32_t> groups;
+    for (auto *a : acc) groups.insert(a->peer_group);
+    for (uint32_t gid : groups) {
+        auto members = group_members(gid);
+        if (members.size() >= 2) {
+            std::vector<Uuid> m_uuids;
+            for (auto *m : members) m_uuids.push_back(m->uuid);
+            size_t n = m_uuids.size();
+            std::vector<double> cost(n * n, 0.0);
+            for (size_t i = 0; i < n; ++i)
+                for (size_t j = 0; j < n; ++j) {
+                    if (i == j) continue;
+                    auto bw = bandwidth_.get(m_uuids[i], m_uuids[j]);
+                    cost[i * n + j] = bw && *bw > 0 ? 1000.0 / *bw : 1e9;
+                }
+            auto tour = atsp::solve(cost, n, /*budget_ms=*/1000);
+            std::vector<Uuid> ring;
+            for (int idx : tour) ring.push_back(m_uuids[idx]);
+            groups_[gid].ring = ring;
+        }
+    }
+    for (auto *a : acc) {
+        a->vote_optimize = false;
+        a->optimize_work_done = false;
+        wire::Writer w;
+        w.u8(1);
+        const auto &ring = groups_[a->peer_group].ring;
+        w.u32(static_cast<uint32_t>(ring.size()));
+        for (const auto &u : ring) proto::put_uuid(w, u);
+        out.push_back({a->conn_id, PacketType::kM2COptimizeComplete, w.take()});
+    }
+    optimize_in_flight_ = false;
+    PLOG(kInfo) << "topology optimization complete";
+}
+
+std::vector<Outbox> MasterState::on_bandwidth_report(uint64_t conn, const Uuid &to,
+                                                     double mbps) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    bandwidth_.store(c->uuid, to, mbps);
+    return out;
+}
+
+std::vector<Outbox> MasterState::on_optimize_work_done(uint64_t conn) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    c->optimize_work_done = true;
+    check_optimize(out);
+    return out;
+}
+
+// ---------- disconnect recovery ----------
+
+std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
+    std::vector<Outbox> out;
+    auto it = clients_.find(conn);
+    if (it == clients_.end()) return out;
+    ClientInfo gone = it->second;
+    clients_.erase(it);
+    bandwidth_.forget(gone.uuid);
+    PLOG(kInfo) << "client " << proto::uuid_str(gone.uuid) << " disconnected, world="
+                << world_size();
+
+    // abort running collectives in its group, prune its votes from ops
+    abort_group_collectives(out, gone.peer_group);
+    auto git = groups_.find(gone.peer_group);
+    if (git != groups_.end()) {
+        for (auto &[_, op] : git->second.ops) {
+            op.initiated.erase(gone.uuid);
+            op.completed.erase(gone.uuid);
+        }
+    }
+    recheck_all(out);
+    return out;
+}
+
+void MasterState::recheck_all(std::vector<Outbox> &out) {
+    // the reference re-checks EVERY consensus on every disconnect
+    // (ccoip_master_handler.cpp:1312-1400); same discipline here
+    check_establish(out);
+    check_topology(out);
+    std::vector<std::pair<uint32_t, uint64_t>> keys;
+    for (auto &[gid, g] : groups_)
+        for (auto &[tag, _] : g.ops) keys.emplace_back(gid, tag);
+    for (auto &[gid, tag] : keys) check_collective(out, gid, tag);
+    std::vector<uint32_t> gids;
+    for (auto &[gid, _] : groups_) gids.push_back(gid);
+    for (auto gid : gids) {
+        check_shared_state(out, gid);
+        // a disconnect may have been the last missing dist-done
+        auto members = group_members(gid);
+        if (!members.empty() && groups_[gid].sync_in_flight) {
+            bool all = true;
+            for (auto *m : members)
+                if (m->sync_req && !m->dist_done) all = false;
+            if (all && members[0]) {
+                auto extra = on_dist_done(members[0]->conn_id);
+                out.insert(out.end(), extra.begin(), extra.end());
+            }
+        }
+    }
+    check_optimize(out);
+}
+
+} // namespace pcclt::master

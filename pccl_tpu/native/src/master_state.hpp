@@ -1,0 +1,139 @@
+// Authoritative master state machine — pure logic, no IO.
+//
+// Reference parity: CCoIPMasterState + the consensus logic of
+// CCoIPMasterHandler (/root/reference/ccoip/internal_include/
+// ccoip_master_state.hpp, ccoip/src/cpp/ccoip_master_handler.cpp).
+// Re-designed as an event-in → packets-out pure state machine: every
+// client packet (or disconnect) is applied by one method which returns the
+// set of packets to emit. A single dispatcher thread applies events, so the
+// machine is deterministic by construction (the reference achieves the same
+// via a single libuv loop thread).
+//
+// Orchestrated consensus rounds:
+//  - topology update / peer accept (global vote, admits pending peers)
+//  - collective ops (per peer-group, per tag: init votes -> commence,
+//    complete votes -> exactly-one-abort + done)
+//  - shared-state sync (per group: mask election by popularity, dirty keys,
+//    one-increment revision rule, kicks)
+//  - topology optimization (global: bandwidth probes -> ATSP ring)
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "bandwidth.hpp"
+#include "protocol.hpp"
+
+namespace pcclt::master {
+
+using proto::Uuid;
+
+struct Outbox {
+    uint64_t conn_id;
+    uint16_t type;
+    std::vector<uint8_t> payload;
+};
+
+struct ClientInfo {
+    Uuid uuid{};
+    uint64_t conn_id = 0;
+    uint32_t peer_group = 0;
+    uint32_t ip = 0; // host order, observed or advertised
+    uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
+    bool accepted = false; // admitted to the world vs pending join
+
+    // votes (valid within their phase)
+    bool vote_topology = false;
+    bool reported_establish = false;
+    bool establish_ok = false;
+    std::vector<Uuid> establish_failed;
+    bool vote_optimize = false;
+    bool optimize_work_done = false;
+    std::optional<proto::SharedStateSyncC2M> sync_req;
+    bool dist_done = false;
+};
+
+struct CollectiveOp {
+    proto::CollectiveInit params;
+    uint64_t seq = 0;
+    bool commenced = false;
+    bool abort_broadcast = false; // exactly-one-abort accounting
+    bool any_aborted = false;
+    std::set<Uuid> members; // group membership at commence
+    std::set<Uuid> initiated;
+    std::set<Uuid> completed;
+};
+
+struct GroupState {
+    bool revision_initialized = false;
+    uint64_t last_revision = 0;                 // last completed sync revision
+    bool sync_in_flight = false;                // responses sent, awaiting dist-done
+    uint64_t sync_revision = 0;                 // canonical revision of current round
+    std::map<uint64_t, CollectiveOp> ops;       // by tag
+    std::vector<Uuid> ring;                     // current ring order
+};
+
+class MasterState {
+public:
+    // --- event handlers: apply + return packets to send ---
+    std::vector<Outbox> on_hello(uint64_t conn, uint32_t src_ip, const proto::HelloC2M &h);
+    std::vector<Outbox> on_topology_update(uint64_t conn);
+    std::vector<Outbox> on_peers_pending_query(uint64_t conn);
+    std::vector<Outbox> on_p2p_established(uint64_t conn, uint64_t revision, bool ok,
+                                           const std::vector<Uuid> &failed);
+    std::vector<Outbox> on_collective_init(uint64_t conn, const proto::CollectiveInit &ci);
+    std::vector<Outbox> on_collective_complete(uint64_t conn, uint64_t tag, bool aborted);
+    std::vector<Outbox> on_shared_state_sync(uint64_t conn,
+                                             const proto::SharedStateSyncC2M &req);
+    std::vector<Outbox> on_dist_done(uint64_t conn);
+    std::vector<Outbox> on_optimize(uint64_t conn);
+    std::vector<Outbox> on_bandwidth_report(uint64_t conn, const Uuid &to, double mbps);
+    std::vector<Outbox> on_optimize_work_done(uint64_t conn);
+    std::vector<Outbox> on_disconnect(uint64_t conn);
+
+    // conns the dispatcher should close (kicked); cleared on read
+    std::vector<uint64_t> take_pending_closes();
+
+    size_t num_clients() const { return clients_.size(); }
+    size_t world_size() const;
+
+private:
+    ClientInfo *by_conn(uint64_t conn);
+    ClientInfo *by_uuid(const Uuid &u);
+    std::vector<ClientInfo *> accepted_clients();
+    std::vector<ClientInfo *> group_members(uint32_t group);
+    std::vector<Uuid> build_ring(uint32_t group);
+
+    void kick(std::vector<Outbox> &out, ClientInfo &c, const std::string &reason);
+
+    // consensus checks — called after votes change AND after disconnects
+    void check_topology(std::vector<Outbox> &out);
+    void check_establish(std::vector<Outbox> &out);
+    void check_collective(std::vector<Outbox> &out, uint32_t group, uint64_t tag);
+    void check_shared_state(std::vector<Outbox> &out, uint32_t group);
+    void check_optimize(std::vector<Outbox> &out);
+    void abort_group_collectives(std::vector<Outbox> &out, uint32_t group);
+    void recheck_all(std::vector<Outbox> &out);
+
+    std::map<uint64_t, ClientInfo> clients_; // by conn_id
+    std::map<uint32_t, GroupState> groups_;
+
+    // topology / establishment round
+    bool establish_in_flight_ = false;
+    std::set<Uuid> round_members_;
+    uint64_t topology_revision_ = 0;
+    uint64_t next_seq_ = 1;
+
+    // optimization round
+    bool optimize_in_flight_ = false;
+    bool optimize_work_phase_ = false;
+    BandwidthStore bandwidth_;
+
+    std::vector<uint64_t> pending_closes_;
+};
+
+} // namespace pcclt::master

@@ -1,0 +1,231 @@
+#include "protocol.hpp"
+
+#include <random>
+
+namespace pcclt::proto {
+
+std::string uuid_str(const Uuid &u) {
+    static const char *hex = "0123456789abcdef";
+    std::string s;
+    s.reserve(36);
+    for (int i = 0; i < 16; ++i) {
+        if (i == 4 || i == 6 || i == 8 || i == 10) s.push_back('-');
+        s.push_back(hex[u[i] >> 4]);
+        s.push_back(hex[u[i] & 0xf]);
+    }
+    return s;
+}
+
+Uuid uuid_random() {
+    static thread_local std::mt19937_64 rng{std::random_device{}()};
+    Uuid u;
+    for (int i = 0; i < 16; i += 8) {
+        uint64_t v = rng();
+        memcpy(u.data() + i, &v, 8);
+    }
+    u[6] = (u[6] & 0x0f) | 0x40; // version 4
+    u[8] = (u[8] & 0x3f) | 0x80;
+    return u;
+}
+
+size_t dtype_size(DType d) {
+    switch (d) {
+    case DType::kU8: case DType::kI8: return 1;
+    case DType::kU16: case DType::kI16: case DType::kF16: case DType::kBF16: return 2;
+    case DType::kU32: case DType::kI32: case DType::kF32: return 4;
+    case DType::kU64: case DType::kI64: case DType::kF64: return 8;
+    }
+    return 0;
+}
+
+// --- HelloC2M ---
+
+std::vector<uint8_t> HelloC2M::encode() const {
+    wire::Writer w;
+    w.u32(peer_group);
+    w.u16(p2p_port);
+    w.u16(ss_port);
+    w.u16(bench_port);
+    w.str(adv_ip);
+    return w.take();
+}
+
+std::optional<HelloC2M> HelloC2M::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        HelloC2M h;
+        h.peer_group = r.u32();
+        h.p2p_port = r.u16();
+        h.ss_port = r.u16();
+        h.bench_port = r.u16();
+        h.adv_ip = r.str();
+        return h;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- P2PConnInfo ---
+
+std::vector<uint8_t> P2PConnInfo::encode() const {
+    wire::Writer w;
+    w.u64(revision);
+    w.u32(static_cast<uint32_t>(peers.size()));
+    for (const auto &p : peers) {
+        put_uuid(w, p.uuid);
+        w.u32(p.ip);
+        w.u16(p.p2p_port);
+        w.u16(p.bench_port);
+        w.u32(p.peer_group);
+    }
+    w.u32(static_cast<uint32_t>(ring.size()));
+    for (const auto &u : ring) put_uuid(w, u);
+    return w.take();
+}
+
+std::optional<P2PConnInfo> P2PConnInfo::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        P2PConnInfo p;
+        p.revision = r.u64();
+        uint32_t n = r.u32();
+        for (uint32_t i = 0; i < n; ++i) {
+            PeerEndpoint e;
+            e.uuid = get_uuid(r);
+            e.ip = r.u32();
+            e.p2p_port = r.u16();
+            e.bench_port = r.u16();
+            e.peer_group = r.u32();
+            p.peers.push_back(e);
+        }
+        uint32_t m = r.u32();
+        for (uint32_t i = 0; i < m; ++i) p.ring.push_back(get_uuid(r));
+        return p;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- CollectiveInit ---
+
+std::vector<uint8_t> CollectiveInit::encode() const {
+    wire::Writer w;
+    w.u64(tag);
+    w.u64(count);
+    w.u8(static_cast<uint8_t>(dtype));
+    w.u8(static_cast<uint8_t>(op));
+    w.u8(static_cast<uint8_t>(quant));
+    w.u8(static_cast<uint8_t>(quant_dtype));
+    return w.take();
+}
+
+std::optional<CollectiveInit> CollectiveInit::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        CollectiveInit c;
+        c.tag = r.u64();
+        c.count = r.u64();
+        c.dtype = static_cast<DType>(r.u8());
+        c.op = static_cast<RedOp>(r.u8());
+        c.quant = static_cast<QuantAlgo>(r.u8());
+        c.quant_dtype = static_cast<DType>(r.u8());
+        return c;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- SharedStateSyncC2M ---
+
+std::vector<uint8_t> SharedStateSyncC2M::encode() const {
+    wire::Writer w;
+    w.u64(revision);
+    w.u8(static_cast<uint8_t>(strategy));
+    w.u32(static_cast<uint32_t>(entries.size()));
+    for (const auto &e : entries) {
+        w.str(e.name);
+        w.u8(static_cast<uint8_t>(e.dtype));
+        w.u64(e.count);
+        w.u8(e.allow_content_inequality);
+        w.u64(e.hash);
+    }
+    return w.take();
+}
+
+std::optional<SharedStateSyncC2M> SharedStateSyncC2M::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        SharedStateSyncC2M s;
+        s.revision = r.u64();
+        s.strategy = static_cast<SyncStrategy>(r.u8());
+        uint32_t n = r.u32();
+        for (uint32_t i = 0; i < n; ++i) {
+            SharedStateEntryMeta e;
+            e.name = r.str();
+            e.dtype = static_cast<DType>(r.u8());
+            e.count = r.u64();
+            e.allow_content_inequality = r.u8();
+            e.hash = r.u64();
+            s.entries.push_back(std::move(e));
+        }
+        return s;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- SharedStateSyncResp ---
+
+std::vector<uint8_t> SharedStateSyncResp::encode() const {
+    wire::Writer w;
+    w.u8(outdated);
+    w.u32(dist_ip);
+    w.u16(dist_port);
+    w.u64(revision);
+    w.u32(static_cast<uint32_t>(outdated_keys.size()));
+    for (const auto &k : outdated_keys) w.str(k);
+    w.u32(static_cast<uint32_t>(expected_hashes.size()));
+    for (auto h : expected_hashes) w.u64(h);
+    return w.take();
+}
+
+std::optional<SharedStateSyncResp> SharedStateSyncResp::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        SharedStateSyncResp s;
+        s.outdated = r.u8();
+        s.dist_ip = r.u32();
+        s.dist_port = r.u16();
+        s.revision = r.u64();
+        uint32_t n = r.u32();
+        for (uint32_t i = 0; i < n; ++i) s.outdated_keys.push_back(r.str());
+        uint32_t m = r.u32();
+        for (uint32_t i = 0; i < m; ++i) s.expected_hashes.push_back(r.u64());
+        return s;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- OptimizeResponse ---
+
+std::vector<uint8_t> OptimizeResponse::encode() const {
+    wire::Writer w;
+    w.u8(complete);
+    w.u32(static_cast<uint32_t>(requests.size()));
+    for (const auto &q : requests) {
+        put_uuid(w, q.to);
+        w.u32(q.ip);
+        w.u16(q.bench_port);
+    }
+    return w.take();
+}
+
+std::optional<OptimizeResponse> OptimizeResponse::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        OptimizeResponse o;
+        o.complete = r.u8();
+        uint32_t n = r.u32();
+        for (uint32_t i = 0; i < n; ++i) {
+            BenchRequest q;
+            q.to = get_uuid(r);
+            q.ip = r.u32();
+            q.bench_port = r.u16();
+            o.requests.push_back(q);
+        }
+        return o;
+    } catch (...) { return std::nullopt; }
+}
+
+} // namespace pcclt::proto

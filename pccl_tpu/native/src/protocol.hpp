@@ -1,0 +1,160 @@
+// PCCP wire protocol: packet ids + typed payload serializers.
+//
+// Reference parity: the 7 packet families of CCoIP
+// (/root/reference/ccoip/internal/ccoip_packets.hpp) — C2M/M2C for
+// control, P2P handshake, C2S/S2C shared-state distribution, benchmark
+// handshake. Re-designed: ids are grouped by direction nibble, payloads are
+// written with the big-endian wire::Writer rather than per-packet classes.
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "wire.hpp"
+
+namespace pcclt::proto {
+
+using Uuid = std::array<uint8_t, 16>;
+
+std::string uuid_str(const Uuid &u);
+Uuid uuid_random();
+
+inline void put_uuid(wire::Writer &w, const Uuid &u) { w.raw(u.data(), 16); }
+inline Uuid get_uuid(wire::Reader &r) {
+    Uuid u;
+    for (auto &b : u) b = r.u8();
+    return u;
+}
+
+enum PacketType : uint16_t {
+    // client -> master
+    kC2MHello = 0x1001,
+    kC2MTopologyUpdate = 0x1002,
+    kC2MPeersPendingQuery = 0x1003,
+    kC2MP2PEstablished = 0x1004,
+    kC2MCollectiveInit = 0x1005,
+    kC2MCollectiveComplete = 0x1006,
+    kC2MSharedStateSync = 0x1007,
+    kC2MSharedStateDistDone = 0x1008,
+    kC2MOptimizeTopology = 0x1009,
+    kC2MBandwidthReport = 0x100A,
+    kC2MOptimizeWorkDone = 0x100B,
+
+    // master -> client
+    kM2CWelcome = 0x2001,
+    kM2CPeersPendingReply = 0x2002,
+    kM2CP2PConnInfo = 0x2003,
+    kM2CP2PEstablishedResp = 0x2004,
+    kM2CCollectiveCommence = 0x2005,
+    kM2CCollectiveAbort = 0x2006,
+    kM2CCollectiveDone = 0x2007,
+    kM2CSharedStateSyncResp = 0x2008,
+    kM2CSharedStateDone = 0x2009,
+    kM2COptimizeResponse = 0x200A,
+    kM2COptimizeComplete = 0x200B,
+    kM2CKicked = 0x200C,
+
+    // p2p handshake
+    kP2PHello = 0x3001,
+    kP2PHelloAck = 0x3002,
+
+    // shared-state distribution
+    kC2SStateRequest = 0x4001,
+    kS2CStateHeader = 0x4002,
+
+    // bandwidth benchmark handshake
+    kBenchHello = 0x5001,
+    kBenchAck = 0x5002, // {accepted u8} — busy-rejection
+};
+
+// dtypes shared across API / wire / kernels
+enum class DType : uint8_t {
+    kU8 = 0, kI8, kU16, kI16, kU32, kI32, kU64, kI64, kF16, kBF16, kF32, kF64
+};
+size_t dtype_size(DType d);
+
+enum class RedOp : uint8_t { kSum = 0, kAvg, kProd, kMax, kMin };
+enum class QuantAlgo : uint8_t { kNone = 0, kMinMax, kZeroPointScale };
+enum class SyncStrategy : uint8_t { kEnforcePopular = 0, kRxOnly, kTxOnly };
+
+// --- typed payloads for the structured packets ---
+
+struct HelloC2M {
+    uint32_t peer_group = 0;
+    uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
+    std::string adv_ip; // empty = use source address of the connection
+    std::vector<uint8_t> encode() const;
+    static std::optional<HelloC2M> decode(const std::vector<uint8_t> &);
+};
+
+struct PeerEndpoint {
+    Uuid uuid{};
+    uint32_t ip = 0; // host order
+    uint16_t p2p_port = 0;
+    uint16_t bench_port = 0;
+    uint32_t peer_group = 0;
+};
+
+struct P2PConnInfo {
+    uint64_t revision = 0;
+    std::vector<PeerEndpoint> peers; // everyone else in my group's world
+    std::vector<Uuid> ring;          // group ring order (includes self)
+    std::vector<uint8_t> encode() const;
+    static std::optional<P2PConnInfo> decode(const std::vector<uint8_t> &);
+};
+
+struct CollectiveInit {
+    uint64_t tag = 0;
+    uint64_t count = 0;
+    DType dtype = DType::kF32;
+    RedOp op = RedOp::kSum;
+    QuantAlgo quant = QuantAlgo::kNone;
+    DType quant_dtype = DType::kU8;
+    std::vector<uint8_t> encode() const;
+    static std::optional<CollectiveInit> decode(const std::vector<uint8_t> &);
+};
+
+struct SharedStateEntryMeta {
+    std::string name;
+    DType dtype = DType::kF32;
+    uint64_t count = 0;
+    uint8_t allow_content_inequality = 0;
+    uint64_t hash = 0;
+};
+
+struct SharedStateSyncC2M {
+    uint64_t revision = 0;
+    SyncStrategy strategy = SyncStrategy::kEnforcePopular;
+    std::vector<SharedStateEntryMeta> entries;
+    std::vector<uint8_t> encode() const;
+    static std::optional<SharedStateSyncC2M> decode(const std::vector<uint8_t> &);
+};
+
+struct SharedStateSyncResp {
+    uint8_t outdated = 0;
+    uint32_t dist_ip = 0;
+    uint16_t dist_port = 0;
+    uint64_t revision = 0;
+    std::vector<std::string> outdated_keys;
+    std::vector<uint64_t> expected_hashes; // parallel to outdated_keys
+    std::vector<uint8_t> encode() const;
+    static std::optional<SharedStateSyncResp> decode(const std::vector<uint8_t> &);
+};
+
+struct BenchRequest {
+    Uuid to{};
+    uint32_t ip = 0;
+    uint16_t bench_port = 0;
+};
+
+struct OptimizeResponse {
+    uint8_t complete = 0;
+    std::vector<BenchRequest> requests;
+    std::vector<uint8_t> encode() const;
+    static std::optional<OptimizeResponse> decode(const std::vector<uint8_t> &);
+};
+
+} // namespace pcclt::proto

@@ -1,0 +1,209 @@
+#include "quantize.hpp"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <limits>
+
+#include "kernels.hpp"
+#include "wire.hpp"
+
+namespace pcclt::quant {
+
+using proto::DType;
+using proto::QuantAlgo;
+
+std::vector<uint8_t> Meta::encode() const {
+    wire::Writer w;
+    w.u8(static_cast<uint8_t>(algo));
+    w.u8(static_cast<uint8_t>(src_dtype));
+    w.u8(static_cast<uint8_t>(q_dtype));
+    w.f64(lo);
+    w.f64(hi);
+    return w.take();
+}
+
+std::optional<Meta> Meta::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        Meta m;
+        m.algo = static_cast<QuantAlgo>(r.u8());
+        m.src_dtype = static_cast<DType>(r.u8());
+        m.q_dtype = static_cast<DType>(r.u8());
+        m.lo = r.f64();
+        m.hi = r.f64();
+        return m;
+    } catch (...) { return std::nullopt; }
+}
+
+size_t quantized_bytes(DType q_dtype, size_t count) {
+    return proto::dtype_size(q_dtype) * count;
+}
+
+namespace {
+
+// read element i of a float-typed source as double
+template <typename T> double get_as_double(const void *p, size_t i) {
+    return static_cast<double>(static_cast<const T *>(p)[i]);
+}
+
+double load_elem(DType dt, const void *p, size_t i) {
+    switch (dt) {
+    case DType::kF32: return get_as_double<float>(p, i);
+    case DType::kF64: return get_as_double<double>(p, i);
+    case DType::kF16: return kernels::f16_to_f32(static_cast<const uint16_t *>(p)[i]);
+    case DType::kBF16: return kernels::bf16_to_f32(static_cast<const uint16_t *>(p)[i]);
+    default: return 0.0; // quantization only defined for float dtypes
+    }
+}
+
+void store_elem(DType dt, void *p, size_t i, double v) {
+    switch (dt) {
+    case DType::kF32: static_cast<float *>(p)[i] = static_cast<float>(v); break;
+    case DType::kF64: static_cast<double *>(p)[i] = v; break;
+    case DType::kF16:
+        static_cast<uint16_t *>(p)[i] = kernels::f32_to_f16(static_cast<float>(v));
+        break;
+    case DType::kBF16:
+        static_cast<uint16_t *>(p)[i] = kernels::f32_to_bf16(static_cast<float>(v));
+        break;
+    default: break;
+    }
+}
+
+double qmax_of(DType q) {
+    switch (q) {
+    case DType::kU8: return 255.0;
+    case DType::kU16: return 65535.0;
+    case DType::kU32: return 4294967295.0;
+    case DType::kI8: return 255.0; // ZPS uses the full 256-step range
+    default: return 255.0;
+    }
+}
+
+template <typename Q> void store_q(void *q, size_t i, double v) {
+    static_cast<Q *>(q)[i] = static_cast<Q>(v);
+}
+
+void store_quant(DType qd, void *q, size_t i, double v) {
+    switch (qd) {
+    case DType::kU8: store_q<uint8_t>(q, i, v); break;
+    case DType::kU16: store_q<uint16_t>(q, i, v); break;
+    case DType::kU32: store_q<uint32_t>(q, i, v); break;
+    case DType::kI8: static_cast<int8_t *>(q)[i] = static_cast<int8_t>(v); break;
+    default: break;
+    }
+}
+
+double load_quant(DType qd, const void *q, size_t i) {
+    switch (qd) {
+    case DType::kU8: return static_cast<const uint8_t *>(q)[i];
+    case DType::kU16: return static_cast<const uint16_t *>(q)[i];
+    case DType::kU32: return static_cast<const uint32_t *>(q)[i];
+    case DType::kI8: return static_cast<const int8_t *>(q)[i];
+    default: return 0.0;
+    }
+}
+
+} // namespace
+
+Meta compute_meta(QuantAlgo algo, DType q_dtype, DType src_dtype, const void *src,
+                  size_t count) {
+    Meta m;
+    m.algo = algo;
+    m.src_dtype = src_dtype;
+    m.q_dtype = q_dtype;
+    if (algo == QuantAlgo::kNone || count == 0) return m;
+
+    double lo = std::numeric_limits<double>::infinity();
+    double hi = -std::numeric_limits<double>::infinity();
+    for (size_t i = 0; i < count; ++i) {
+        double v = load_elem(src_dtype, src, i);
+        lo = std::min(lo, v);
+        hi = std::max(hi, v);
+    }
+    if (!std::isfinite(lo) || !std::isfinite(hi)) {
+        lo = 0.0;
+        hi = 0.0;
+    }
+    if (algo == QuantAlgo::kMinMax) {
+        m.lo = lo;
+        m.hi = hi;
+    } else { // ZeroPointScale (asymmetric, piquant-style)
+        double qmax = qmax_of(q_dtype);
+        double scale = (hi - lo) / qmax;
+        if (scale <= 0.0) scale = 1.0;
+        double zp = std::round(-lo / scale) + (q_dtype == DType::kI8 ? -128.0 : 0.0);
+        m.lo = scale;
+        m.hi = zp;
+    }
+    return m;
+}
+
+void quantize(const Meta &m, const void *src, void *q_out, size_t count) {
+    if (m.algo == QuantAlgo::kMinMax) {
+        double range = m.hi - m.lo;
+        double qmax = qmax_of(m.q_dtype);
+        double inv = range > 0 ? qmax / range : 0.0;
+        for (size_t i = 0; i < count; ++i) {
+            double v = load_elem(m.src_dtype, src, i);
+            double q = std::round((v - m.lo) * inv);
+            q = std::clamp(q, 0.0, qmax);
+            store_quant(m.q_dtype, q_out, i, q);
+        }
+    } else { // ZPS: q = round(x/scale) + zp
+        double scale = m.lo, zp = m.hi;
+        double qlo = m.q_dtype == DType::kI8 ? -128.0 : 0.0;
+        double qhi = m.q_dtype == DType::kI8 ? 127.0 : qmax_of(m.q_dtype);
+        for (size_t i = 0; i < count; ++i) {
+            double v = load_elem(m.src_dtype, src, i);
+            double q = std::clamp(std::round(v / scale) + zp, qlo, qhi);
+            store_quant(m.q_dtype, q_out, i, q);
+        }
+    }
+}
+
+namespace {
+
+double dequant_elem(const Meta &m, const void *q, size_t i) {
+    double qv = load_quant(m.q_dtype, q, i);
+    if (m.algo == QuantAlgo::kMinMax) {
+        double range = m.hi - m.lo;
+        double qmax = qmax_of(m.q_dtype);
+        return m.lo + (range > 0 ? qv * range / qmax : 0.0);
+    }
+    return (qv - m.hi) * m.lo; // (q - zp) * scale
+}
+
+} // namespace
+
+void dequantize_set(const Meta &m, const void *q, void *dst, size_t count) {
+    for (size_t i = 0; i < count; ++i) store_elem(m.src_dtype, dst, i, dequant_elem(m, q, i));
+}
+
+void dequantize_accumulate(const Meta &m, proto::RedOp op, const void *q, void *dst,
+                           size_t count) {
+    for (size_t i = 0; i < count; ++i) {
+        double v = dequant_elem(m, q, i);
+        double d = load_elem(m.src_dtype, dst, i);
+        double r;
+        switch (op) {
+        case proto::RedOp::kSum:
+        case proto::RedOp::kAvg: r = d + v; break;
+        case proto::RedOp::kProd: r = d * v; break;
+        case proto::RedOp::kMax: r = std::max(d, v); break;
+        case proto::RedOp::kMin: r = std::min(d, v); break;
+        default: r = v;
+        }
+        store_elem(m.src_dtype, dst, i, r);
+    }
+}
+
+void requantize_self(const Meta &m, void *data, size_t count) {
+    if (m.algo == QuantAlgo::kNone) return;
+    std::vector<uint8_t> q(quantized_bytes(m.q_dtype, count));
+    quantize(m, data, q.data(), count);
+    dequantize_set(m, q.data(), data, count);
+}
+
+} // namespace pcclt::quant

@@ -1,0 +1,48 @@
+// On-the-wire quantization: MinMax (float -> uintN affine on [min,max])
+// and ZeroPointScale (piquant-style asymmetric int8/uint8).
+//
+// Reference parity: /root/reference/ccoip/internal/quantize.hpp (MinMax own
+// kernels; ZeroPointScale delegated to the piquant library) and the fused
+// dequantize+accumulate path of reduce_kernels.cpp:361-427. The
+// quantize-dequantize "self-destruction" used for bit parity
+// (quantize.hpp:190-199) is `requantize_self`.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+#include "protocol.hpp"
+
+namespace pcclt::quant {
+
+struct Meta {
+    proto::QuantAlgo algo = proto::QuantAlgo::kNone;
+    proto::DType src_dtype = proto::DType::kF32;
+    proto::DType q_dtype = proto::DType::kU8;
+    double lo = 0.0;    // MinMax: min;      ZPS: scale
+    double hi = 0.0;    // MinMax: max;      ZPS: zero_point
+    std::vector<uint8_t> encode() const;
+    static std::optional<Meta> decode(const std::vector<uint8_t> &);
+};
+
+size_t quantized_bytes(proto::DType q_dtype, size_t count);
+
+// Compute quantization parameters from data (min/max scan).
+Meta compute_meta(proto::QuantAlgo algo, proto::DType q_dtype, proto::DType src_dtype,
+                  const void *src, size_t count);
+
+// q_out must hold quantized_bytes(q_dtype, count).
+void quantize(const Meta &m, const void *src, void *q_out, size_t count);
+
+// dst = dequant(q)           (op == set)
+void dequantize_set(const Meta &m, const void *q, void *dst, size_t count);
+// dst = red_op(dst, dequant(q))  — fused dequantize+accumulate
+void dequantize_accumulate(const Meta &m, proto::RedOp op, const void *q, void *dst,
+                           size_t count);
+
+// In-place quantize-then-dequantize so the chunk owner loses exactly the
+// precision every other peer loses (bit-parity invariant).
+void requantize_self(const Meta &m, void *data, size_t count);
+
+} // namespace pcclt::quant

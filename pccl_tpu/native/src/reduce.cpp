@@ -1,0 +1,247 @@
+#include "reduce.hpp"
+
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "kernels.hpp"
+#include "log.hpp"
+#include "quantize.hpp"
+
+namespace pcclt::reduce {
+
+namespace {
+
+constexpr uint64_t kMetaBit = 0x8000;
+constexpr size_t kSubChunk = 1 << 20; // streaming granularity (bytes)
+
+struct ChunkSpan {
+    size_t start_elem, n_elems;
+};
+
+ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
+    size_t base = count / world, rem = count % world;
+    size_t start = c * base + std::min<size_t>(c, rem);
+    size_t len = base + (c < rem ? 1 : 0);
+    return {start, len};
+}
+
+// Wait until `target` bytes for `tag` arrived, reducing/consuming via `on_data`
+// in sub-chunk slices aligned to `elem_size`. Returns false on abort/conn loss.
+bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
+                 const std::function<void(size_t lo, size_t hi)> &on_data) {
+    size_t consumed = 0;
+    while (consumed < target) {
+        size_t want = std::min(target, consumed + kSubChunk);
+        size_t filled = ctx.rx->wait_filled(tag, want);
+        // consume only whole elements
+        size_t usable = (filled / elem_size) * elem_size;
+        if (usable > consumed) {
+            on_data(consumed, usable);
+            consumed = usable;
+        }
+        if (consumed >= target) break;
+        if (ctx.should_abort && ctx.should_abort()) return false;
+        if (!ctx.rx->alive()) return false;
+    }
+    return true;
+}
+
+} // namespace
+
+Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) {
+    const size_t esz = proto::dtype_size(ctx.dtype);
+    const uint32_t world = ctx.world, rank = ctx.rank;
+    auto *out = static_cast<uint8_t *>(recv);
+    const bool quantized = ctx.quant != proto::QuantAlgo::kNone;
+    const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
+    const uint64_t base_tag = ctx.op_seq << 16;
+
+    // working copy + abort restore
+    std::vector<uint8_t> backup;
+    const bool in_place = send == recv;
+    if (in_place) {
+        backup.resize(count * esz);
+        memcpy(backup.data(), recv, count * esz);
+    } else {
+        memcpy(recv, send, count * esz);
+    }
+    auto restore = [&] {
+        if (in_place) memcpy(recv, backup.data(), count * esz);
+        else memcpy(recv, send, count * esz);
+        ctx.tx->purge_range(base_tag, base_tag + 0x10000);
+        ctx.rx->purge_range(base_tag, base_tag + 0x10000);
+    };
+    auto fail = [&](bool conn_lost) {
+        restore();
+        return conn_lost ? Result::kConnectionLost : Result::kAborted;
+    };
+
+    // scratch buffers
+    size_t max_chunk = chunk_of(count, world, 0).n_elems;
+    std::vector<uint8_t> rx_scratch(max_chunk * qsz);
+    std::vector<uint8_t> tx_scratch(quantized ? max_chunk * qsz : 0);
+
+    // sender thread helper: sends meta (if any) then payload on `tag`
+    struct TxJob {
+        std::thread th;
+        bool ok = true;
+    };
+    auto launch_tx = [&](uint64_t tag, std::vector<uint8_t> meta,
+                         std::span<const uint8_t> payload) {
+        auto job = std::make_shared<TxJob>();
+        job->th = std::thread([this_ctx = &ctx, tag, meta = std::move(meta), payload,
+                               job] {
+            bool ok = true;
+            if (!meta.empty())
+                ok = this_ctx->tx->send_bytes(tag | kMetaBit, 0, meta);
+            if (ok) ok = this_ctx->tx->send_bytes(tag, 0, payload);
+            job->ok = ok;
+        });
+        return job;
+    };
+    auto join_tx = [&](const std::shared_ptr<TxJob> &job) -> bool {
+        job->th.join();
+        return job->ok;
+    };
+
+    // ---------------- phase 1: reduce-scatter ----------------
+    for (uint32_t s = 0; s + 1 < world; ++s) {
+        const uint64_t tag = base_tag | s;
+        const uint32_t send_c = (rank + world - s) % world;
+        const uint32_t recv_c = (rank + world - s - 1) % world;
+        const auto send_span = chunk_of(count, world, send_c);
+        const auto recv_span = chunk_of(count, world, recv_c);
+        uint8_t *send_ptr = out + send_span.start_elem * esz;
+        uint8_t *recv_ptr = out + recv_span.start_elem * esz;
+
+        std::shared_ptr<TxJob> tx_job;
+        quant::Meta rx_meta;
+        if (quantized) {
+            auto meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype, send_ptr,
+                                            send_span.n_elems);
+            quant::quantize(meta, send_ptr, tx_scratch.data(), send_span.n_elems);
+            tx_job = launch_tx(tag, meta.encode(),
+                               {tx_scratch.data(), send_span.n_elems * qsz});
+            ctx.tx_bytes += send_span.n_elems * qsz;
+
+            // receive peer meta first, then streamed quantized payload
+            ctx.rx->register_sink(tag, rx_scratch.data(), recv_span.n_elems * qsz);
+            auto mraw = ctx.rx->recv_queued(tag | kMetaBit, 60'000);
+            if (!mraw) {
+                join_tx(tx_job);
+                return fail(!ctx.rx->alive());
+            }
+            auto m = quant::Meta::decode(*mraw);
+            if (!m) {
+                join_tx(tx_job);
+                return fail(false);
+            }
+            rx_meta = *m;
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz,
+                                  [&](size_t lo, size_t hi) {
+                                      size_t e0 = lo / qsz, e1 = hi / qsz;
+                                      quant::dequantize_accumulate(
+                                          rx_meta, ctx.op, rx_scratch.data() + lo,
+                                          recv_ptr + e0 * esz, e1 - e0);
+                                  });
+            ctx.rx->unregister_sink(tag);
+            bool tx_ok = join_tx(tx_job);
+            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            ctx.rx_bytes += recv_span.n_elems * qsz;
+        } else {
+            tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});
+            ctx.tx_bytes += send_span.n_elems * esz;
+            ctx.rx->register_sink(tag, rx_scratch.data(), recv_span.n_elems * esz);
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
+                                  [&](size_t lo, size_t hi) {
+                                      size_t e0 = lo / esz, e1 = hi / esz;
+                                      kernels::accumulate(ctx.dtype, ctx.op,
+                                                          recv_ptr + e0 * esz,
+                                                          rx_scratch.data() + lo,
+                                                          e1 - e0);
+                                  });
+            ctx.rx->unregister_sink(tag);
+            bool tx_ok = join_tx(tx_job);
+            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            ctx.rx_bytes += recv_span.n_elems * esz;
+        }
+    }
+
+    // ---------------- phase 2: all-gather ----------------
+    // after reduce-scatter, this rank owns fully-reduced chunk (rank+1)%world.
+    // Quantized path: own chunk is quantized ONCE; received chunks are
+    // forwarded verbatim (no re-quantization), and the owner self-dequantizes
+    // for bit parity (reference reduce.cpp:673-738).
+    std::vector<uint8_t> fwd_q;      // quantized bytes to forward next stage
+    std::vector<uint8_t> fwd_meta;   // encoded meta to forward
+    for (uint32_t s = 0; s + 1 < world; ++s) {
+        const uint64_t tag = base_tag | (0x4000u + s);
+        const uint32_t send_c = (rank + 1 + world - s) % world;
+        const uint32_t recv_c = (rank + world - s) % world;
+        const auto send_span = chunk_of(count, world, send_c);
+        const auto recv_span = chunk_of(count, world, recv_c);
+        uint8_t *send_ptr = out + send_span.start_elem * esz;
+        uint8_t *recv_ptr = out + recv_span.start_elem * esz;
+
+        std::shared_ptr<TxJob> tx_job;
+        if (quantized) {
+            if (s == 0) {
+                auto meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
+                                                send_ptr, send_span.n_elems);
+                fwd_q.resize(send_span.n_elems * qsz);
+                quant::quantize(meta, send_ptr, fwd_q.data(), send_span.n_elems);
+                // bit parity: owner keeps exactly what the others will decode
+                quant::dequantize_set(meta, fwd_q.data(), send_ptr, send_span.n_elems);
+                fwd_meta = meta.encode();
+            }
+            tx_job = launch_tx(tag, fwd_meta, fwd_q);
+            ctx.tx_bytes += fwd_q.size();
+
+            ctx.rx->register_sink(tag, rx_scratch.data(), recv_span.n_elems * qsz);
+            auto mraw = ctx.rx->recv_queued(tag | kMetaBit, 60'000);
+            if (!mraw) {
+                join_tx(tx_job);
+                return fail(!ctx.rx->alive());
+            }
+            auto m = quant::Meta::decode(*mraw);
+            if (!m) {
+                join_tx(tx_job);
+                return fail(false);
+            }
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz,
+                                  [&](size_t lo, size_t hi) {
+                                      size_t e0 = lo / qsz, e1 = hi / qsz;
+                                      quant::dequantize_set(*m, rx_scratch.data() + lo,
+                                                            recv_ptr + e0 * esz, e1 - e0);
+                                  });
+            ctx.rx->unregister_sink(tag);
+            bool tx_ok = join_tx(tx_job);
+            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            ctx.rx_bytes += recv_span.n_elems * qsz;
+            // forward what we received on the next stage
+            fwd_q.assign(rx_scratch.data(), rx_scratch.data() + recv_span.n_elems * qsz);
+            fwd_meta = mraw.value();
+        } else {
+            tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});
+            ctx.tx_bytes += send_span.n_elems * esz;
+            // zero-copy: incoming reduced chunk lands straight in the result
+            ctx.rx->register_sink(tag, recv_ptr, recv_span.n_elems * esz);
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
+                                  [](size_t, size_t) {});
+            ctx.rx->unregister_sink(tag);
+            bool tx_ok = join_tx(tx_job);
+            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            ctx.rx_bytes += recv_span.n_elems * esz;
+        }
+    }
+
+    if (ctx.op == proto::RedOp::kAvg)
+        kernels::finalize_avg(ctx.dtype, recv, count, world);
+
+    ctx.tx->purge_range(base_tag, base_tag + 0x10000);
+    ctx.rx->purge_range(base_tag, base_tag + 0x10000);
+    return Result::kOk;
+}
+
+} // namespace pcclt::reduce

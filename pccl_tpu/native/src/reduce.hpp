@@ -1,0 +1,35 @@
+// Pipelined ring all-reduce over multiplex connections.
+// Reference parity: reduce::pipelineRingReduce (/root/reference/ccoip/src/
+// cpp/reduce.cpp:528) — reduce-scatter + all-gather with on-the-wire
+// quantization, streaming sub-chunk accumulation, abort polling and
+// src-buffer restore. Wire tags: (op_seq << 16) | stage, meta bit 0x8000.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+
+#include "protocol.hpp"
+#include "sockets.hpp"
+
+namespace pcclt::reduce {
+
+enum class Result : int { kOk = 0, kAborted, kConnectionLost };
+
+struct RingCtx {
+    std::shared_ptr<net::MultiplexConn> tx; // to ring successor
+    std::shared_ptr<net::MultiplexConn> rx; // from ring predecessor
+    uint32_t rank = 0, world = 0;
+    uint64_t op_seq = 0;
+    proto::DType dtype = proto::DType::kF32;
+    proto::RedOp op = proto::RedOp::kSum;
+    proto::QuantAlgo quant = proto::QuantAlgo::kNone;
+    proto::DType q_dtype = proto::DType::kU8;
+    // polled between sub-chunks; true → abort (master abort or conn loss)
+    std::function<bool()> should_abort;
+    uint64_t tx_bytes = 0, rx_bytes = 0;
+};
+
+Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count);
+
+} // namespace pcclt::reduce

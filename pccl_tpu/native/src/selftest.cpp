@@ -1,0 +1,246 @@
+// In-process self-test: unit checks + multi-peer loopback end-to-end.
+// Reference parity: the e2e test style of /root/reference/ccoip/tests/
+// end_to_end/test_all_reduce.cpp — real master + N client instances on
+// loopback threads, never network mocks.
+#include <atomic>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "atsp.hpp"
+#include "client.hpp"
+#include "hash.hpp"
+#include "kernels.hpp"
+#include "master.hpp"
+#include "quantize.hpp"
+#include "wire.hpp"
+
+using namespace pcclt;
+
+static int g_failures = 0;
+#define CHECK(cond)                                                                     \
+    do {                                                                                \
+        if (!(cond)) {                                                                  \
+            fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+            ++g_failures;                                                               \
+        }                                                                               \
+    } while (0)
+
+static void test_wire() {
+    wire::Writer w;
+    w.u8(7);
+    w.u16(0x1234);
+    w.u32(0xDEADBEEF);
+    w.u64(0x0102030405060708ull);
+    w.str("hello");
+    w.f64(3.25);
+    auto buf = w.take();
+    // big-endian layout check
+    CHECK(buf[0] == 7 && buf[1] == 0x12 && buf[2] == 0x34 && buf[3] == 0xDE);
+    wire::Reader r(buf);
+    CHECK(r.u8() == 7);
+    CHECK(r.u16() == 0x1234);
+    CHECK(r.u32() == 0xDEADBEEF);
+    CHECK(r.u64() == 0x0102030405060708ull);
+    CHECK(r.str() == "hello");
+    CHECK(r.f64() == 3.25);
+    CHECK(r.done());
+}
+
+static void test_hash() {
+    const char *s = "the quick brown fox jumps over the lazy dog";
+    uint64_t h1 = hash::simplehash(s, strlen(s));
+    uint64_t h2 = hash::simplehash(s, strlen(s));
+    CHECK(h1 == h2 && h1 != 0);
+    std::string s2(s);
+    s2[0] = 'T';
+    CHECK(hash::simplehash(s2.data(), s2.size()) != h1);
+    // long buffer exercising many lanes/rows
+    std::vector<uint32_t> big(300000);
+    for (size_t i = 0; i < big.size(); ++i) big[i] = static_cast<uint32_t>(i * 2654435761u);
+    uint64_t hb = hash::simplehash(big.data(), big.size() * 4);
+    big[299999] ^= 1;
+    CHECK(hash::simplehash(big.data(), big.size() * 4) != hb);
+    // crc32 known vector: crc32("123456789") == 0xCBF43926
+    CHECK(hash::crc32("123456789", 9) == 0xCBF43926u);
+}
+
+static void test_kernels() {
+    float a[5] = {1, 2, 3, 4, 5}, b[5] = {10, 20, 30, 40, 50};
+    kernels::accumulate(proto::DType::kF32, proto::RedOp::kSum, a, b, 5);
+    CHECK(a[0] == 11 && a[4] == 55);
+    kernels::finalize_avg(proto::DType::kF32, a, 5, 2);
+    CHECK(a[0] == 5.5f);
+    uint16_t h = kernels::f32_to_f16(1.5f);
+    CHECK(kernels::f16_to_f32(h) == 1.5f);
+    uint16_t bf = kernels::f32_to_bf16(1.5f);
+    CHECK(kernels::bf16_to_f32(bf) == 1.5f);
+    int32_t ia[3] = {3, 7, 9}, ib[3] = {5, 2, 9};
+    kernels::accumulate(proto::DType::kI32, proto::RedOp::kMax, ia, ib, 3);
+    CHECK(ia[0] == 5 && ia[1] == 7 && ia[2] == 9);
+}
+
+static void test_quant() {
+    std::vector<float> x(1000);
+    for (size_t i = 0; i < x.size(); ++i) x[i] = std::sin(i * 0.1f) * 5.0f;
+    for (auto algo : {proto::QuantAlgo::kMinMax, proto::QuantAlgo::kZeroPointScale}) {
+        auto qd = algo == proto::QuantAlgo::kMinMax ? proto::DType::kU8 : proto::DType::kI8;
+        auto m = quant::compute_meta(algo, qd, proto::DType::kF32, x.data(), x.size());
+        std::vector<uint8_t> q(quant::quantized_bytes(qd, x.size()));
+        quant::quantize(m, x.data(), q.data(), x.size());
+        std::vector<float> y(x.size());
+        quant::dequantize_set(m, q.data(), y.data(), x.size());
+        double max_err = 0;
+        for (size_t i = 0; i < x.size(); ++i)
+            max_err = std::max(max_err, std::abs(double(x[i]) - double(y[i])));
+        CHECK(max_err < 10.0 / 255.0 + 1e-6); // range 10, 8-bit steps
+        // meta roundtrip
+        auto dec = quant::Meta::decode(m.encode());
+        CHECK(dec && dec->lo == m.lo && dec->hi == m.hi);
+        // requantize_self must be idempotent (bit parity invariant)
+        std::vector<float> z = y;
+        quant::requantize_self(m, z.data(), z.size());
+        CHECK(memcmp(z.data(), y.data(), z.size() * 4) == 0);
+    }
+}
+
+static void test_atsp() {
+    // 4-node asymmetric instance with a known-best ring 0->1->2->3->0
+    const double INF = 100;
+    std::vector<double> c = {
+        0, 1, INF, INF,
+        INF, 0, 1, INF,
+        INF, INF, 0, 1,
+        1, INF, INF, 0,
+    };
+    auto tour = atsp::solve(c, 4, 100);
+    CHECK(atsp::tour_cost(c, 4, tour) == 4.0);
+    // heuristic path (n > 12)
+    size_t n = 15;
+    std::vector<double> big(n * n);
+    for (size_t i = 0; i < n; ++i)
+        for (size_t j = 0; j < n; ++j)
+            big[i * n + j] = i == j ? 0.0 : 1.0 + ((i * 7 + j * 13) % 10);
+    auto t2 = atsp::solve(big, n, 200);
+    std::vector<bool> seen(n, false);
+    for (int v : t2) seen[v] = true;
+    for (size_t i = 0; i < n; ++i) CHECK(seen[i]);
+}
+
+// ---- end-to-end: master + N clients, fp32 ring allreduce + shared state ----
+
+static void test_e2e(size_t world, proto::QuantAlgo quant) {
+    master::Master m(0); // port 0 -> bump allocation from 48501 happens in api; use random
+    // use an ephemeral-ish fixed test port
+    static uint16_t port_base = 49400;
+    uint16_t port = port_base;
+    port_base += 16;
+    master::Master mm(port);
+    CHECK(mm.launch());
+    port = mm.port();
+
+    const size_t count = 4099; // deliberately not divisible by world
+    std::vector<std::thread> threads;
+    std::atomic<int> ok_count{0};
+
+    for (size_t r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+            client::ClientConfig cfg;
+            cfg.master = *net::Addr::parse("127.0.0.1", port);
+            cfg.p2p_port = static_cast<uint16_t>(49600 + r * 8);
+            cfg.ss_port = static_cast<uint16_t>(49700 + r * 8);
+            cfg.bench_port = static_cast<uint16_t>(49800 + r * 8);
+            client::Client cl(cfg);
+            if (cl.connect() != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: connect failed\n", r);
+                return;
+            }
+            // wait for all peers to join (reference establishConnections helper)
+            while (cl.group_world() < world) {
+                bool pending = false;
+                cl.are_peers_pending(pending);
+                if (pending) cl.update_topology();
+                std::this_thread::sleep_for(std::chrono::milliseconds(10));
+            }
+
+            std::vector<float> x(count), y(count, 0.0f);
+            for (size_t i = 0; i < count; ++i)
+                x[i] = static_cast<float>(i % 97) + static_cast<float>(r);
+            client::ReduceDesc desc;
+            desc.tag = 1;
+            desc.op = proto::RedOp::kSum;
+            desc.quant = quant;
+            desc.quant_dtype = proto::DType::kU8;
+            client::ReduceInfo info;
+            auto st = cl.all_reduce(x.data(), y.data(), count, proto::DType::kF32, desc,
+                                    &info);
+            if (st != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: allreduce failed st=%d\n", r, int(st));
+                return;
+            }
+            bool correct = true;
+            double tol = quant == proto::QuantAlgo::kNone ? 1e-4 : 1.5 * world;
+            for (size_t i = 0; i < count; ++i) {
+                double expect = world * double(i % 97) + world * (world - 1) / 2.0;
+                if (std::abs(double(y[i]) - expect) > tol) {
+                    if (correct)
+                        fprintf(stderr, "peer %zu: y[%zu]=%f expect %f\n", r, i, y[i],
+                                expect);
+                    correct = false;
+                }
+            }
+            if (!correct) return;
+
+            // shared state: rank 0 has the canonical content, others fetch
+            std::vector<float> state(1024, r == 0 ? 42.0f : 0.0f);
+            uint64_t marker = r == 0 ? 7 : 0;
+            std::vector<uint64_t> step{marker};
+            client::SharedStateEntry e1{"weights", proto::DType::kF32, state.size(),
+                                        state.data(), false};
+            client::SharedStateEntry e2{"step", proto::DType::kU64, 1, step.data(), false};
+            client::SyncInfo si;
+            // strategy: rank0 sends, others receive-or-enforce
+            auto strat = r == 0 ? proto::SyncStrategy::kTxOnly
+                                : proto::SyncStrategy::kRxOnly;
+            auto sst = cl.sync_shared_state(1, strat, {e1, e2}, &si);
+            if (sst != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: shared state failed st=%d\n", r, int(sst));
+                return;
+            }
+            if (state[0] != 42.0f || step[0] != 7) {
+                fprintf(stderr, "peer %zu: shared state content wrong (%f, %llu)\n", r,
+                        state[0], (unsigned long long)step[0]);
+                return;
+            }
+            ok_count.fetch_add(1);
+            cl.disconnect();
+        });
+    }
+    for (auto &t : threads) t.join();
+    CHECK(ok_count.load() == static_cast<int>(world));
+    mm.interrupt();
+    mm.join();
+}
+
+int main() {
+    test_wire();
+    test_hash();
+    test_kernels();
+    test_quant();
+    test_atsp();
+    printf("unit tests: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e(2, proto::QuantAlgo::kNone);
+    printf("e2e world=2 fp32: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e(4, proto::QuantAlgo::kNone);
+    printf("e2e world=4 fp32: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e(3, proto::QuantAlgo::kMinMax);
+    printf("e2e world=3 minmax-quantized: %s\n", g_failures ? "FAIL" : "ok");
+    if (g_failures) {
+        printf("SELFTEST FAILED (%d)\n", g_failures);
+        return 1;
+    }
+    printf("SELFTEST PASSED\n");
+    return 0;
+}

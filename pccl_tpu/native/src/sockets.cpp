@@ -1,0 +1,480 @@
+#include "sockets.hpp"
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "log.hpp"
+#include "wire.hpp"
+
+namespace pcclt::net {
+
+// ---------- Addr ----------
+
+std::string Addr::str() const {
+    struct in_addr a;
+    a.s_addr = htonl(ip);
+    char buf[INET_ADDRSTRLEN];
+    inet_ntop(AF_INET, &a, buf, sizeof buf);
+    return std::string(buf) + ":" + std::to_string(port);
+}
+
+std::optional<Addr> Addr::parse(const std::string &ip_str, uint16_t port) {
+    struct in_addr a;
+    if (inet_pton(AF_INET, ip_str.c_str(), &a) != 1) return std::nullopt;
+    return Addr{ntohl(a.s_addr), port};
+}
+
+// ---------- Socket ----------
+
+bool Socket::connect(const Addr &addr, int timeout_ms) {
+    close();
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    struct sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(addr.port);
+    sa.sin_addr.s_addr = htonl(addr.ip);
+
+    // non-blocking connect with timeout, then back to blocking
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = ::connect(fd, reinterpret_cast<sockaddr *>(&sa), sizeof sa);
+    if (rc != 0 && errno != EINPROGRESS) {
+        ::close(fd);
+        return false;
+    }
+    if (rc != 0) {
+        struct pollfd pfd{fd, POLLOUT, 0};
+        rc = ::poll(&pfd, 1, timeout_ms);
+        if (rc <= 0) {
+            ::close(fd);
+            return false;
+        }
+        int err = 0;
+        socklen_t len = sizeof err;
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err != 0) {
+            ::close(fd);
+            return false;
+        }
+    }
+    fcntl(fd, F_SETFL, flags);
+    fd_ = fd;
+    set_nodelay();
+    return true;
+}
+
+bool Socket::send_all(const void *data, size_t n) {
+    auto *p = static_cast<const uint8_t *>(data);
+    while (n > 0) {
+        int fd = fd_.load();
+        if (fd < 0) return false;
+        ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        p += w;
+        n -= static_cast<size_t>(w);
+    }
+    return true;
+}
+
+bool Socket::recv_all(void *data, size_t n) {
+    auto *p = static_cast<uint8_t *>(data);
+    while (n > 0) {
+        int fd = fd_.load();
+        if (fd < 0) return false;
+        ssize_t r = ::recv(fd, p, n, 0);
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        if (r == 0) return false;
+        p += r;
+        n -= static_cast<size_t>(r);
+    }
+    return true;
+}
+
+ssize_t Socket::recv_some(void *data, size_t n, int timeout_ms) {
+    int fd = fd_.load();
+    if (fd < 0) return -1;
+    struct pollfd pfd{fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms);
+    if (rc == 0) return -2;
+    if (rc < 0) return -1;
+    ssize_t r = ::recv(fd, data, n, 0);
+    return r < 0 ? -1 : r;
+}
+
+void Socket::shutdown() {
+    int fd = fd_.load();
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+}
+
+void Socket::close() {
+    int fd = fd_.exchange(-1);
+    if (fd >= 0) ::close(fd);
+}
+
+void Socket::set_nodelay() {
+    int one = 1;
+    setsockopt(fd_.load(), IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+}
+
+void Socket::set_keepalive(int idle_s) {
+    int fd = fd_.load();
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof one);
+    setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle_s, sizeof idle_s);
+    int intvl = 5, cnt = 3;
+    setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof intvl);
+    setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof cnt);
+}
+
+Addr Socket::peer_addr() const {
+    struct sockaddr_in sa{};
+    socklen_t len = sizeof sa;
+    if (getpeername(fd_.load(), reinterpret_cast<sockaddr *>(&sa), &len) != 0) return {};
+    return Addr{ntohl(sa.sin_addr.s_addr), ntohs(sa.sin_port)};
+}
+
+// ---------- control framing ----------
+
+bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
+                std::span<const uint8_t> payload) {
+    uint32_t len = static_cast<uint32_t>(2 + payload.size());
+    uint8_t hdr[6];
+    uint32_t be_len = wire::to_be(len);
+    uint16_t be_type = wire::to_be(type);
+    memcpy(hdr, &be_len, 4);
+    memcpy(hdr + 4, &be_type, 2);
+    std::lock_guard lk(write_mu);
+    if (!s.send_all(hdr, 6)) return false;
+    if (!payload.empty() && !s.send_all(payload.data(), payload.size())) return false;
+    return true;
+}
+
+std::optional<Frame> recv_frame(Socket &s) {
+    uint8_t hdr[6];
+    if (!s.recv_all(hdr, 6)) return std::nullopt;
+    uint32_t be_len;
+    uint16_t be_type;
+    memcpy(&be_len, hdr, 4);
+    memcpy(&be_type, hdr + 4, 2);
+    uint32_t len = wire::from_be(be_len);
+    if (len < 2 || len > wire::kMaxControlPacket) {
+        PLOG(kError) << "recv_frame: bad length " << len;
+        return std::nullopt;
+    }
+    Frame f;
+    f.type = wire::from_be(be_type);
+    f.payload.resize(len - 2);
+    if (!f.payload.empty() && !s.recv_all(f.payload.data(), f.payload.size()))
+        return std::nullopt;
+    return f;
+}
+
+// ---------- Listener ----------
+
+bool Listener::listen(uint16_t port, int tries, bool loopback_only) {
+    for (int i = 0; i < tries; ++i) {
+        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) return false;
+        int one = 1;
+        setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+        struct sockaddr_in sa{};
+        sa.sin_family = AF_INET;
+        sa.sin_port = htons(static_cast<uint16_t>(port + i));
+        sa.sin_addr.s_addr = htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
+        if (bind(fd, reinterpret_cast<sockaddr *>(&sa), sizeof sa) == 0 &&
+            ::listen(fd, 64) == 0) {
+            fd_ = fd;
+            port_ = static_cast<uint16_t>(port + i);
+            return true;
+        }
+        ::close(fd);
+    }
+    return false;
+}
+
+void Listener::run_async(std::function<void(Socket)> on_accept) {
+    running_ = true;
+    thread_ = std::thread([this, on_accept = std::move(on_accept)] {
+        while (running_.load()) {
+            struct pollfd pfd{fd_, POLLIN, 0};
+            int rc = ::poll(&pfd, 1, 200);
+            if (rc < 0 && errno != EINTR) break;
+            if (rc <= 0) continue;
+            int cfd = ::accept(fd_, nullptr, nullptr);
+            if (cfd < 0) continue;
+            on_accept(Socket(cfd));
+        }
+    });
+}
+
+void Listener::stop() {
+    running_ = false;
+    if (thread_.joinable()) thread_.join();
+    if (fd_ >= 0) {
+        ::close(fd_);
+        fd_ = -1;
+    }
+}
+
+// ---------- ControlClient ----------
+
+bool ControlClient::connect(const Addr &addr) {
+    if (!sock_.connect(addr)) return false;
+    sock_.set_keepalive();
+    connected_ = true;
+    return true;
+}
+
+void ControlClient::run(std::function<void()> on_disconnect) {
+    on_disconnect_ = std::move(on_disconnect);
+    reader_ = std::thread([this] {
+        while (connected_.load()) {
+            auto f = recv_frame(sock_);
+            if (!f) break;
+            {
+                std::lock_guard lk(mu_);
+                queue_.push_back(std::move(*f));
+            }
+            cv_.notify_all();
+        }
+        bool was = connected_.exchange(false);
+        cv_.notify_all();
+        if (was && on_disconnect_) on_disconnect_();
+    });
+}
+
+bool ControlClient::send(uint16_t type, std::span<const uint8_t> payload) {
+    if (!connected_.load()) return false;
+    return send_frame(sock_, write_mu_, type, payload);
+}
+
+std::optional<Frame> ControlClient::recv_match(uint16_t type, const Pred &pred,
+                                               int timeout_ms, bool no_wait) {
+    std::unique_lock lk(mu_);
+    auto scan = [&]() -> std::optional<Frame> {
+        for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+            if (it->type == type && (!pred || pred(it->payload))) {
+                Frame f = std::move(*it);
+                queue_.erase(it);
+                return f;
+            }
+        }
+        return std::nullopt;
+    };
+    if (auto f = scan()) return f;
+    if (no_wait) return std::nullopt;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 3600'000 : timeout_ms);
+    while (connected_.load()) {
+        if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+            return scan(); // last chance
+        if (auto f = scan()) return f;
+        if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
+            return std::nullopt;
+    }
+    return scan();
+}
+
+std::optional<Frame> ControlClient::recv_match_any(const std::vector<uint16_t> &types,
+                                                   const FramePred &pred, int timeout_ms,
+                                                   bool no_wait) {
+    std::unique_lock lk(mu_);
+    auto scan = [&]() -> std::optional<Frame> {
+        for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+            bool type_ok = false;
+            for (auto t : types)
+                if (it->type == t) type_ok = true;
+            if (type_ok && (!pred || pred(*it))) {
+                Frame f = std::move(*it);
+                queue_.erase(it);
+                return f;
+            }
+        }
+        return std::nullopt;
+    };
+    if (auto f = scan()) return f;
+    if (no_wait) return std::nullopt;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 3600'000 : timeout_ms);
+    while (connected_.load()) {
+        if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) return scan();
+        if (auto f = scan()) return f;
+        if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
+            return std::nullopt;
+    }
+    return scan();
+}
+
+void ControlClient::close() {
+    connected_ = false;
+    sock_.shutdown();
+    if (reader_.joinable()) reader_.join();
+    sock_.close();
+    cv_.notify_all();
+}
+
+// ---------- MultiplexConn ----------
+
+void MultiplexConn::run() {
+    alive_ = true;
+    rx_thread_ = std::thread([this] { rx_loop(); });
+}
+
+bool MultiplexConn::send_bytes(uint64_t tag, uint64_t seq,
+                               std::span<const uint8_t> data, size_t chunk) {
+    size_t off = 0;
+    do {
+        size_t n = std::min(chunk, data.size() - off);
+        uint8_t hdr[20];
+        uint32_t be_len = wire::to_be(static_cast<uint32_t>(16 + n));
+        uint64_t be_tag = wire::to_be(tag);
+        uint64_t be_seq = wire::to_be(seq);
+        memcpy(hdr, &be_len, 4);
+        memcpy(hdr + 4, &be_tag, 8);
+        memcpy(hdr + 12, &be_seq, 8);
+        std::lock_guard lk(write_mu_);
+        if (!sock_.send_all(hdr, 20)) return false;
+        if (n > 0 && !sock_.send_all(data.data() + off, n)) return false;
+        off += n;
+    } while (off < data.size());
+    return true;
+}
+
+void MultiplexConn::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
+    std::lock_guard lk(mu_);
+    Sink s{base, cap, 0};
+    // frames that raced ahead of registration are queued; drain them in order
+    auto it = queues_.find(tag);
+    if (it != queues_.end()) {
+        for (auto &buf : it->second) {
+            size_t n = std::min(buf.size(), s.cap - s.filled);
+            memcpy(s.base + s.filled, buf.data(), n);
+            s.filled += n;
+        }
+        queues_.erase(it);
+    }
+    sinks_[tag] = s;
+    cv_.notify_all();
+}
+
+size_t MultiplexConn::wait_filled(uint64_t tag, size_t min_bytes,
+                                  const std::atomic<bool> *abort) {
+    std::unique_lock lk(mu_);
+    while (true) {
+        auto it = sinks_.find(tag);
+        if (it == sinks_.end()) return 0;
+        if (it->second.filled >= min_bytes) return it->second.filled;
+        if (!alive_.load()) return it->second.filled;
+        if (abort && abort->load()) return it->second.filled;
+        cv_.wait_for(lk, std::chrono::milliseconds(50));
+    }
+}
+
+void MultiplexConn::unregister_sink(uint64_t tag) {
+    std::lock_guard lk(mu_);
+    sinks_.erase(tag);
+}
+
+std::optional<std::vector<uint8_t>> MultiplexConn::recv_queued(
+    uint64_t tag, int timeout_ms, const std::atomic<bool> *abort) {
+    std::unique_lock lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 3600'000 : timeout_ms);
+    while (true) {
+        auto it = queues_.find(tag);
+        if (it != queues_.end() && !it->second.empty()) {
+            auto v = std::move(it->second.front());
+            it->second.pop_front();
+            return v;
+        }
+        if (!alive_.load()) return std::nullopt;
+        if (abort && abort->load()) return std::nullopt;
+        if (cv_.wait_until(lk, std::min(deadline,
+                                        std::chrono::steady_clock::now() +
+                                            std::chrono::milliseconds(50))) ==
+                std::cv_status::timeout &&
+            std::chrono::steady_clock::now() >= deadline)
+            return std::nullopt;
+    }
+}
+
+void MultiplexConn::purge_range(uint64_t lo, uint64_t hi) {
+    std::lock_guard lk(mu_);
+    for (auto it = sinks_.begin(); it != sinks_.end();)
+        it = (it->first >= lo && it->first < hi) ? sinks_.erase(it) : std::next(it);
+    for (auto it = queues_.begin(); it != queues_.end();)
+        it = (it->first >= lo && it->first < hi) ? queues_.erase(it) : std::next(it);
+}
+
+void MultiplexConn::rx_loop() {
+    std::vector<uint8_t> scratch;
+    while (alive_.load()) {
+        uint8_t hdr[20];
+        if (!sock_.recv_all(hdr, 20)) break;
+        uint32_t be_len;
+        uint64_t be_tag, be_seq;
+        memcpy(&be_len, hdr, 4);
+        memcpy(&be_tag, hdr + 4, 8);
+        memcpy(&be_seq, hdr + 12, 8);
+        uint32_t len = wire::from_be(be_len);
+        uint64_t tag = wire::from_be(be_tag);
+        if (len < 16 || len > (272u << 20)) {
+            PLOG(kError) << "multiplex rx: bad frame length " << len;
+            break;
+        }
+        size_t n = len - 16;
+
+        // sink fast path: read straight into the registered destination
+        uint8_t *dst = nullptr;
+        {
+            std::lock_guard lk(mu_);
+            auto it = sinks_.find(tag);
+            if (it != sinks_.end() && it->second.filled + n <= it->second.cap)
+                dst = it->second.base + it->second.filled;
+        }
+        if (dst) {
+            if (!sock_.recv_all(dst, n)) break;
+            {
+                std::lock_guard lk(mu_);
+                auto it = sinks_.find(tag);
+                if (it != sinks_.end()) it->second.filled += n;
+            }
+            cv_.notify_all();
+        } else {
+            scratch.resize(n);
+            if (n > 0 && !sock_.recv_all(scratch.data(), n)) break;
+            {
+                std::lock_guard lk(mu_);
+                queues_[tag].push_back(scratch);
+            }
+            cv_.notify_all();
+        }
+    }
+    alive_ = false;
+    cv_.notify_all();
+}
+
+void MultiplexConn::close() {
+    alive_ = false;
+    sock_.shutdown();
+    if (rx_thread_.joinable()) rx_thread_.join();
+    sock_.close();
+    cv_.notify_all();
+}
+
+} // namespace pcclt::net

@@ -1,0 +1,191 @@
+// TCP socket layer for the PCCP protocol.
+//
+// Reference parity: tinysockets (/root/reference/tinysockets/include/
+// tinysockets.hpp) provides ServerSocket (libuv), BlockingIOSocket,
+// QueuedSocket, BlockingIOServerSocket, MultiplexedIOSocket. This layer
+// covers the same roles with a leaner, thread-per-connection design:
+//
+//   Socket        — RAII fd + sendall/recvall            (BlockingIOSocket)
+//   Listener      — accept loop on own thread            (BlockingIOServerSocket
+//                                                         + libuv ServerSocket roles)
+//   ControlClient — reader thread + type/predicate-matched
+//                   receive queue                        (QueuedSocket)
+//   MultiplexConn — tag-demuxed full-duplex data plane
+//                   with registered zero-copy sinks      (MultiplexedIOSocket)
+//
+// Framing:
+//   control: [u32 len][u16 type][payload]         len = 2 + payload_size
+//   data:    [u32 len][u64 tag][u64 seq][payload] len = 16 + payload_size
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <span>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace pcclt::net {
+
+struct Addr {
+    uint32_t ip = 0; // host byte order
+    uint16_t port = 0;
+    std::string str() const;
+    static std::optional<Addr> parse(const std::string &ip_str, uint16_t port);
+    bool operator==(const Addr &o) const { return ip == o.ip && port == o.port; }
+};
+
+class Socket {
+public:
+    Socket() = default;
+    explicit Socket(int fd) : fd_(fd) {}
+    ~Socket() { close(); }
+    Socket(const Socket &) = delete;
+    Socket &operator=(const Socket &) = delete;
+    Socket(Socket &&o) noexcept : fd_(o.fd_.exchange(-1)) {}
+    Socket &operator=(Socket &&o) noexcept {
+        if (this != &o) {
+            close();
+            fd_ = o.fd_.exchange(-1);
+        }
+        return *this;
+    }
+
+    bool connect(const Addr &addr, int timeout_ms = 5000);
+    bool send_all(const void *data, size_t n);
+    bool recv_all(void *data, size_t n);
+    // recv with timeout; returns bytes read (0 on orderly close), -1 error, -2 timeout
+    ssize_t recv_some(void *data, size_t n, int timeout_ms);
+    void shutdown(); // wake up blocked recv
+    void close();
+    bool valid() const { return fd_ >= 0; }
+    int fd() const { return fd_; }
+    void set_nodelay();
+    void set_keepalive(int idle_s = 30);
+    Addr peer_addr() const;
+
+private:
+    std::atomic<int> fd_{-1};
+};
+
+// --- control framing over a Socket ---
+struct Frame {
+    uint16_t type = 0;
+    std::vector<uint8_t> payload;
+};
+
+bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
+                std::span<const uint8_t> payload);
+// blocking; returns nullopt on disconnect/error
+std::optional<Frame> recv_frame(Socket &s);
+
+// --- Listener: accept loop on its own thread ---
+class Listener {
+public:
+    ~Listener() { stop(); }
+    // binds 127.0.0.1/0.0.0.0:port, bump-allocating upward up to +tries if taken
+    bool listen(uint16_t port, int tries = 16, bool loopback_only = false);
+    uint16_t port() const { return port_; }
+    // on_accept runs on the accept thread; it must hand off quickly
+    void run_async(std::function<void(Socket)> on_accept);
+    void stop();
+
+private:
+    int fd_ = -1;
+    uint16_t port_ = 0;
+    std::thread thread_;
+    std::atomic<bool> running_{false};
+};
+
+// --- ControlClient: one socket, reader thread, matched receive ---
+class ControlClient {
+public:
+    ~ControlClient() { close(); }
+    bool connect(const Addr &addr);
+    // spawn reader thread; on_disconnect fires once when the socket dies
+    void run(std::function<void()> on_disconnect = nullptr);
+    bool send(uint16_t type, std::span<const uint8_t> payload);
+
+    using Pred = std::function<bool(const std::vector<uint8_t> &)>;
+    // Wait for a frame of `type` matching pred (nullptr = any). timeout_ms<0 →
+    // wait forever; no_wait → poll. Returns nullopt on timeout or disconnect.
+    std::optional<Frame> recv_match(uint16_t type, const Pred &pred,
+                                    int timeout_ms = -1, bool no_wait = false);
+    // Same, but matches any of `types`; pred sees the whole frame.
+    using FramePred = std::function<bool(const Frame &)>;
+    std::optional<Frame> recv_match_any(const std::vector<uint16_t> &types,
+                                        const FramePred &pred, int timeout_ms = -1,
+                                        bool no_wait = false);
+    bool connected() const { return connected_.load(); }
+    void close();
+
+private:
+    Socket sock_;
+    std::mutex write_mu_;
+    std::thread reader_;
+    std::atomic<bool> connected_{false};
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::deque<Frame> queue_;
+    std::function<void()> on_disconnect_;
+};
+
+// --- MultiplexConn: tag-demuxed bulk data plane ---
+class MultiplexConn {
+public:
+    explicit MultiplexConn(Socket sock) : sock_(std::move(sock)) {}
+    ~MultiplexConn() { close(); }
+
+    void run(); // spawn RX thread
+
+    // TX: splits into sub-frames of `chunk` bytes; blocking; thread-safe.
+    bool send_bytes(uint64_t tag, uint64_t seq, std::span<const uint8_t> data,
+                    size_t chunk = 1 << 20);
+
+    // Zero-copy RX: register a sink; RX thread appends payloads for `tag`
+    // in arrival order starting at base. wait_filled blocks until >= min
+    // bytes landed (returns current fill), or 0 on close/abort.
+    void register_sink(uint64_t tag, uint8_t *base, size_t cap);
+    size_t wait_filled(uint64_t tag, size_t min_bytes,
+                       const std::atomic<bool> *abort = nullptr);
+    void unregister_sink(uint64_t tag);
+
+    // Queued RX for small per-tag messages (quantization metadata):
+    // frames for tags with no sink land in a per-tag queue.
+    std::optional<std::vector<uint8_t>> recv_queued(uint64_t tag, int timeout_ms = -1,
+                                                    const std::atomic<bool> *abort = nullptr);
+
+    // Drop all sinks and queued frames with lo <= tag < hi (end-of-op cleanup).
+    void purge_range(uint64_t lo, uint64_t hi);
+
+    bool alive() const { return alive_.load(); }
+    void close();
+    Socket &socket() { return sock_; }
+
+private:
+    void rx_loop();
+
+    struct Sink {
+        uint8_t *base = nullptr;
+        size_t cap = 0;
+        size_t filled = 0;
+    };
+
+    Socket sock_;
+    std::mutex write_mu_;
+    std::thread rx_thread_;
+    std::atomic<bool> alive_{false};
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::map<uint64_t, Sink> sinks_;
+    std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_;
+};
+
+} // namespace pcclt::net

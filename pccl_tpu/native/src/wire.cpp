@@ -1,0 +1,2 @@
+#include "wire.hpp"
+// header-only; this TU anchors the target.

@@ -1,0 +1,119 @@
+// Big-endian wire buffers.
+// Reference parity: PacketReadBuffer/PacketWriteBuffer
+// (/root/reference/ccoip/internal_include/ccoip_packet_buffer.hpp) — network
+// byte order for all integers, length-prefixed strings/byte spans.
+#pragma once
+
+#include <bit>
+#include <cstdint>
+#include <cstring>
+#include <span>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace pcclt::wire {
+
+// 64 MiB guard for control packets (bulk data uses the multiplex framing).
+inline constexpr uint64_t kMaxControlPacket = 64ull << 20;
+
+template <typename T> T to_be(T v) {
+    static_assert(std::is_integral_v<T>);
+    if constexpr (std::endian::native == std::endian::little) {
+        if constexpr (sizeof(T) == 2) return static_cast<T>(__builtin_bswap16(static_cast<uint16_t>(v)));
+        else if constexpr (sizeof(T) == 4) return static_cast<T>(__builtin_bswap32(static_cast<uint32_t>(v)));
+        else if constexpr (sizeof(T) == 8) return static_cast<T>(__builtin_bswap64(static_cast<uint64_t>(v)));
+        else return v;
+    }
+    return v;
+}
+template <typename T> T from_be(T v) { return to_be(v); }
+
+class Writer {
+public:
+    template <typename T> void u(T v) {
+        static_assert(std::is_integral_v<T>);
+        T be = to_be(v);
+        append(&be, sizeof be);
+    }
+    void u8(uint8_t v) { append(&v, 1); }
+    void u16(uint16_t v) { u(v); }
+    void u32(uint32_t v) { u(v); }
+    void u64(uint64_t v) { u(v); }
+    void f64(double v) {
+        uint64_t bits;
+        memcpy(&bits, &v, 8);
+        u64(bits);
+    }
+    void str(const std::string &s) {
+        u32(static_cast<uint32_t>(s.size()));
+        append(s.data(), s.size());
+    }
+    void bytes(std::span<const uint8_t> b) {
+        u64(b.size());
+        append(b.data(), b.size());
+    }
+    void raw(const void *p, size_t n) { append(p, n); }
+
+    const std::vector<uint8_t> &data() const { return buf_; }
+    std::vector<uint8_t> take() { return std::move(buf_); }
+
+private:
+    void append(const void *p, size_t n) {
+        auto *b = static_cast<const uint8_t *>(p);
+        buf_.insert(buf_.end(), b, b + n);
+    }
+    std::vector<uint8_t> buf_;
+};
+
+class Reader {
+public:
+    explicit Reader(std::span<const uint8_t> data) : data_(data) {}
+
+    template <typename T> T u() {
+        static_assert(std::is_integral_v<T>);
+        T v;
+        need(sizeof v);
+        memcpy(&v, data_.data() + pos_, sizeof v);
+        pos_ += sizeof v;
+        return from_be(v);
+    }
+    uint8_t u8() {
+        need(1);
+        return data_[pos_++];
+    }
+    uint16_t u16() { return u<uint16_t>(); }
+    uint32_t u32() { return u<uint32_t>(); }
+    uint64_t u64() { return u<uint64_t>(); }
+    double f64() {
+        uint64_t bits = u64();
+        double v;
+        memcpy(&v, &bits, 8);
+        return v;
+    }
+    std::string str() {
+        uint32_t n = u32();
+        need(n);
+        std::string s(reinterpret_cast<const char *>(data_.data() + pos_), n);
+        pos_ += n;
+        return s;
+    }
+    std::vector<uint8_t> bytes() {
+        uint64_t n = u64();
+        need(n);
+        std::vector<uint8_t> b(data_.begin() + pos_, data_.begin() + pos_ + n);
+        pos_ += n;
+        return b;
+    }
+    size_t remaining() const { return data_.size() - pos_; }
+    bool done() const { return pos_ == data_.size(); }
+
+private:
+    void need(size_t n) const {
+        if (pos_ + n > data_.size()) throw std::runtime_error("wire: short read");
+    }
+    std::span<const uint8_t> data_;
+    size_t pos_ = 0;
+};
+
+} // namespace pcclt::wire
