@@ -1,5 +1,7 @@
 #include "client.hpp"
 
+#include <sys/socket.h>
+
 #include <algorithm>
 #include <cstdlib>
 #include <cstring>
@@ -28,12 +30,43 @@ size_t max_concurrent_ops() {
 
 Client::~Client() { disconnect(); }
 
+// ---------------- service thread registry ----------------
+
+void Client::spawn_service(
+    net::Socket sock,
+    std::function<void(net::Socket &, const std::shared_ptr<std::atomic<int>> &)> body) {
+    auto fd = std::make_shared<std::atomic<int>>(sock.fd());
+    auto done = std::make_shared<std::atomic<bool>>(false);
+    std::lock_guard lk(svc_mu_);
+    if (!svc_accepting_) return; // disconnecting: drop the connection
+    // reap finished threads so the vector stays bounded under churn
+    for (auto it = svc_threads_.begin(); it != svc_threads_.end();) {
+        if (it->done->load()) {
+            it->th.join();
+            it = svc_threads_.erase(it);
+        } else {
+            ++it;
+        }
+    }
+    SvcThread st;
+    st.fd = fd;
+    st.done = done;
+    st.th = std::thread(
+        [sock = std::move(sock), body = std::move(body), fd, done]() mutable {
+            body(sock, fd);
+            fd->store(-1);
+            done->store(true);
+        });
+    svc_threads_.push_back(std::move(st));
+}
+
 // ---------------- accept handlers ----------------
 
 void Client::on_p2p_accept(net::Socket sock) {
     // handshake: peer sends P2PHello{uuid, pool index}; we ack with our uuid
-    std::thread t([this, sock = std::move(sock)]() mutable {
-        auto hello = net::recv_frame(sock);
+    spawn_service(std::move(sock), [this](net::Socket &sock,
+                                          const std::shared_ptr<std::atomic<int>> &fd) {
+        auto hello = net::recv_frame(sock, 15'000);
         if (!hello || hello->type != PacketType::kP2PHello) return;
         proto::Uuid peer;
         uint32_t idx = 0;
@@ -49,18 +82,19 @@ void Client::on_p2p_accept(net::Socket sock) {
         sock.set_keepalive();
 
         auto conn = std::make_shared<net::MultiplexConn>(std::move(sock));
+        fd->store(-1); // handed off: the conn owns the fd now
         conn->run();
         std::lock_guard lk(state_mu_);
         auto &pc = peers_[peer];
         if (pc.rx.size() <= idx) pc.rx.resize(idx + 1);
         pc.rx[idx] = conn;
     });
-    t.detach();
 }
 
 void Client::on_ss_accept(net::Socket sock) {
-    std::thread t([this, sock = std::move(sock)]() mutable {
-        auto req = net::recv_frame(sock);
+    spawn_service(std::move(sock), [this](net::Socket &sock,
+                                          const std::shared_ptr<std::atomic<int>> &) {
+        auto req = net::recv_frame(sock, 15'000);
         if (!req || req->type != PacketType::kC2SStateRequest) return;
         uint64_t revision;
         std::vector<std::string> keys;
@@ -103,21 +137,24 @@ void Client::on_ss_accept(net::Socket sock) {
             dist_tx_bytes_.fetch_add(nbytes);
         }
     });
-    t.detach();
 }
 
 void Client::on_bench_accept(net::Socket sock) {
     static std::atomic<int> active{0};
-    std::thread t([sock = std::move(sock)]() mutable {
+    spawn_service(std::move(sock), [](net::Socket &sock,
+                                      const std::shared_ptr<std::atomic<int>> &) {
         bench::serve_connection(std::move(sock), active, 4);
     });
-    t.detach();
 }
 
 // ---------------- connect / disconnect ----------------
 
 Status Client::connect() {
     if (connected_.load()) return Status::kInvalid;
+    {
+        std::lock_guard lk(svc_mu_);
+        svc_accepting_ = true;
+    }
     if (!p2p_listener_.listen(cfg_.p2p_port, 64)) return Status::kInternal;
     if (!ss_listener_.listen(cfg_.ss_port, 64)) return Status::kInternal;
     if (!bench_listener_.listen(cfg_.bench_port, 64)) return Status::kInternal;
@@ -169,6 +206,20 @@ void Client::disconnect() {
     p2p_listener_.stop();
     ss_listener_.stop();
     bench_listener_.stop();
+    // interrupt + join all service threads before tearing down state they touch
+    std::vector<SvcThread> svcs;
+    {
+        std::lock_guard lk(svc_mu_);
+        svc_accepting_ = false;
+        for (auto &s : svc_threads_) {
+            int fd = s.fd->load();
+            if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+        }
+        svcs = std::move(svc_threads_);
+        svc_threads_.clear();
+    }
+    for (auto &s : svcs)
+        if (s.th.joinable()) s.th.join();
     std::lock_guard lk(state_mu_);
     for (auto &[_, pc] : peers_) {
         for (auto &c : pc.tx)
@@ -273,8 +324,10 @@ Status Client::establish_loop() {
     while (true) {
         if (auto st = check_kicked(); st != Status::kOk) return st;
         auto fr = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 120'000);
-        if (!fr) return check_kicked() == Status::kOk ? Status::kMasterUnreachable
-                                                      : Status::kKicked;
+        if (!fr) {
+            auto st = check_kicked();
+            return st == Status::kOk ? Status::kMasterUnreachable : st;
+        }
         // stale rounds may have queued older conn infos; use the newest
         while (auto newer = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 0, true))
             fr = std::move(newer);
@@ -302,8 +355,10 @@ Status Client::establish_loop() {
         };
         auto resp =
             master_.recv_match(PacketType::kM2CP2PEstablishedResp, rev_pred, 120'000);
-        if (!resp) return check_kicked() == Status::kOk ? Status::kMasterUnreachable
-                                                         : Status::kKicked;
+        if (!resp) {
+            auto st = check_kicked();
+            return st == Status::kOk ? Status::kMasterUnreachable : st;
+        }
         try {
             wire::Reader r(resp->payload);
             r.u64(); // revision (matched by predicate)
@@ -342,8 +397,10 @@ Status Client::optimize_topology() {
         auto fr = master_.recv_match_any(
             {PacketType::kM2COptimizeResponse, PacketType::kM2COptimizeComplete}, nullptr,
             300'000);
-        if (!fr) return check_kicked() == Status::kOk ? Status::kMasterUnreachable
-                                                       : Status::kKicked;
+        if (!fr) {
+            auto st = check_kicked();
+            return st == Status::kOk ? Status::kMasterUnreachable : st;
+        }
         if (fr->type == PacketType::kM2COptimizeComplete) {
             try {
                 wire::Reader r(fr->payload);
@@ -489,6 +546,15 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     };
 
     Status st = Status::kOk;
+    // snapshot the in-place input here (not just inside the ring) so a
+    // post-hoc abort verdict can also restore it — all ranks must retry a
+    // failed collective from identical inputs
+    const size_t nbytes = count * proto::dtype_size(dtype);
+    std::vector<uint8_t> snapshot;
+    if (send == recv) {
+        snapshot.resize(nbytes);
+        memcpy(snapshot.data(), recv, nbytes);
+    }
     auto tx = tx_conn(next, seq);
     auto rx = rx_conn(prev, seq, 10'000);
     if (!tx || !rx || !tx->alive()) {
@@ -504,6 +570,7 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         ctx.op = desc.op;
         ctx.quant = desc.quant;
         ctx.q_dtype = desc.quant_dtype;
+        ctx.backup = snapshot.empty() ? nullptr : snapshot.data();
         ctx.should_abort = [&]() -> bool {
             if (op->abort.load()) return true;
             if (consume_abort(true) && verdict_aborted) return true;
@@ -530,7 +597,12 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     auto done = master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
     if (!done) return Status::kConnectionLost;
 
-    if (st == Status::kOk && verdict_aborted) st = Status::kAborted;
+    if (st == Status::kOk && verdict_aborted) {
+        // we finished the ring, but the op was aborted group-wide: restore the
+        // input so every rank retries from identical buffers
+        memcpy(recv, snapshot.empty() ? send : snapshot.data(), nbytes);
+        st = Status::kAborted;
+    }
     return st;
 }
 
@@ -599,7 +671,10 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
     auto fr = master_.recv_match(PacketType::kM2CSharedStateSyncResp, nullptr, 300'000);
     if (!fr) {
         close_window();
-        return check_kicked() == Status::kOk ? Status::kConnectionLost : Status::kKicked;
+        {
+        auto kst = check_kicked();
+        return kst == Status::kOk ? Status::kConnectionLost : kst;
+    }
     }
     auto resp = proto::SharedStateSyncResp::decode(fr->payload);
     if (!resp) {
@@ -677,7 +752,10 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
     auto done = master_.recv_match(PacketType::kM2CSharedStateDone, nullptr, 300'000);
     close_window();
     if (!done)
-        return check_kicked() == Status::kOk ? Status::kConnectionLost : Status::kKicked;
+        {
+        auto kst = check_kicked();
+        return kst == Status::kOk ? Status::kConnectionLost : kst;
+    }
 
     if (info) {
         info->rx_bytes = rx_bytes;

@@ -155,8 +155,21 @@ private:
     std::map<std::string, SharedStateEntry> dist_entries_;
     std::atomic<uint64_t> dist_tx_bytes_{0};
 
-    std::vector<std::thread> service_threads_;
-    std::mutex service_mu_;
+    // Per-connection service threads (p2p handshakes, shared-state serving,
+    // benchmark serving). Tracked so disconnect() can interrupt their sockets
+    // and join them — a detached thread capturing `this` could otherwise
+    // outlive the Client and touch freed state.
+    struct SvcThread {
+        std::thread th;
+        std::shared_ptr<std::atomic<int>> fd;    // -1 once handed off or closed
+        std::shared_ptr<std::atomic<bool>> done;
+    };
+    void spawn_service(net::Socket sock,
+                       std::function<void(net::Socket &,
+                                          const std::shared_ptr<std::atomic<int>> &)> body);
+    std::mutex svc_mu_;
+    std::vector<SvcThread> svc_threads_;
+    bool svc_accepting_ = false;
 };
 
 } // namespace pcclt::client

@@ -139,19 +139,16 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
     auto acc = accepted_clients();
     bool any_pending = clients_.size() > acc.size();
     if (acc.empty() && !any_pending) return;
-    // round runs when every accepted client voted; a lone pending world
-    // (no accepted clients yet) admits immediately
+    // a round runs when every accepted client has voted (trivially true when
+    // none are accepted yet — a pending-only world admits immediately)
     for (auto *a : acc)
         if (!a->vote_topology) return;
-    if (acc.empty() || any_pending || !acc.empty()) {
-        // admit all pending
-        for (auto &[_, c] : clients_)
-            if (!c.accepted) {
-                c.accepted = true;
-                PLOG(kInfo) << "admitted " << proto::uuid_str(c.uuid) << " to group "
-                            << c.peer_group;
-            }
-    }
+    for (auto &[_, c] : clients_)
+        if (!c.accepted) {
+            c.accepted = true;
+            PLOG(kInfo) << "admitted " << proto::uuid_str(c.uuid) << " to group "
+                        << c.peer_group;
+        }
     ++topology_revision_;
     establish_in_flight_ = true;
     round_members_.clear();
@@ -327,8 +324,26 @@ std::vector<Outbox> MasterState::on_collective_complete(uint64_t conn, uint64_t 
     auto &g = groups_[c->peer_group];
     auto it = g.ops.find(tag);
     if (it == g.ops.end()) return out;
-    it->second.completed.insert(c->uuid);
-    if (aborted) it->second.any_aborted = true;
+    auto &op = it->second;
+    op.completed.insert(c->uuid);
+    if (aborted) {
+        op.any_aborted = true;
+        // a local failure must abort the whole op NOW — the other members are
+        // blocked in the ring waiting for data that will never arrive
+        // (reference: exactly-one-abort broadcast, ccoip_master_handler.cpp:887-905)
+        if (op.commenced && !op.abort_broadcast) {
+            op.abort_broadcast = true;
+            for (const auto &u : op.members) {
+                auto *m = by_uuid(u);
+                if (!m) continue;
+                wire::Writer w;
+                w.u64(tag);
+                w.u8(1);
+                out.push_back({m->conn_id, PacketType::kM2CCollectiveAbort, w.take()});
+            }
+            PLOG(kWarn) << "collective tag " << tag << " aborted by peer failure report";
+        }
+    }
     check_collective(out, c->peer_group, tag);
     return out;
 }

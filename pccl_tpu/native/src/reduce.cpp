@@ -33,7 +33,8 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
     size_t consumed = 0;
     while (consumed < target) {
         size_t want = std::min(target, consumed + kSubChunk);
-        size_t filled = ctx.rx->wait_filled(tag, want);
+        // bounded wait so master aborts / peer death interrupt the stream
+        size_t filled = ctx.rx->wait_filled(tag, want, 100);
         // consume only whole elements
         size_t usable = (filled / elem_size) * elem_size;
         if (usable > consumed) {
@@ -57,20 +58,27 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
     const uint64_t base_tag = ctx.op_seq << 16;
 
-    // working copy + abort restore
-    std::vector<uint8_t> backup;
+    // working copy + abort restore (external backup preferred: lets the
+    // caller also restore after a post-hoc abort verdict)
+    std::vector<uint8_t> backup_local;
     const bool in_place = send == recv;
+    const uint8_t *restore_src;
     if (in_place) {
-        backup.resize(count * esz);
-        memcpy(backup.data(), recv, count * esz);
+        if (ctx.backup) {
+            restore_src = ctx.backup;
+        } else {
+            backup_local.resize(count * esz);
+            memcpy(backup_local.data(), recv, count * esz);
+            restore_src = backup_local.data();
+        }
     } else {
         memcpy(recv, send, count * esz);
+        restore_src = static_cast<const uint8_t *>(send);
     }
     auto restore = [&] {
-        if (in_place) memcpy(recv, backup.data(), count * esz);
-        else memcpy(recv, send, count * esz);
-        ctx.tx->purge_range(base_tag, base_tag + 0x10000);
+        memcpy(recv, restore_src, count * esz);
         ctx.rx->purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx->purge_range(base_tag, base_tag + 0x10000);
     };
     auto fail = [&](bool conn_lost) {
         restore();

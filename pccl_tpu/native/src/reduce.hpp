@@ -27,6 +27,10 @@ struct RingCtx {
     proto::DType q_dtype = proto::DType::kU8;
     // polled between sub-chunks; true → abort (master abort or conn loss)
     std::function<bool()> should_abort;
+    // caller-owned copy of the input (same byte size). When set, the ring
+    // restores from it on abort instead of making its own backup — the caller
+    // can then also restore after a post-hoc abort verdict from the master.
+    const uint8_t *backup = nullptr;
     uint64_t tx_bytes = 0, rx_bytes = 0;
 };
 

@@ -184,6 +184,40 @@ std::optional<Frame> recv_frame(Socket &s) {
     return f;
 }
 
+std::optional<Frame> recv_frame(Socket &s, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    uint8_t hdr[6];
+    auto recv_bounded = [&](uint8_t *dst, size_t n) -> bool {
+        size_t off = 0;
+        while (off < n) {
+            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+            if (left <= 0) return false;
+            ssize_t r = s.recv_some(dst + off, n - off,
+                                    static_cast<int>(std::min<long long>(left, 200)));
+            if (r == -2) continue; // poll timeout slice; re-check deadline
+            if (r <= 0) return false;
+            off += static_cast<size_t>(r);
+        }
+        return true;
+    };
+    if (!recv_bounded(hdr, 6)) return std::nullopt;
+    uint32_t be_len;
+    uint16_t be_type;
+    memcpy(&be_len, hdr, 4);
+    memcpy(&be_type, hdr + 4, 2);
+    uint32_t len = wire::from_be(be_len);
+    if (len < 2 || len > wire::kMaxControlPacket) return std::nullopt;
+    Frame f;
+    f.type = wire::from_be(be_type);
+    f.payload.resize(len - 2);
+    if (!f.payload.empty() && !recv_bounded(f.payload.data(), f.payload.size()))
+        return std::nullopt;
+    return f;
+}
+
 // ---------- Listener ----------
 
 bool Listener::listen(uint16_t port, int tries, bool loopback_only) {
@@ -279,10 +313,13 @@ std::optional<Frame> ControlClient::recv_match(uint16_t type, const Pred &pred,
     if (auto f = scan()) return f;
     if (no_wait) return std::nullopt;
     auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 3600'000 : timeout_ms);
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (connected_.load()) {
-        if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        if (timeout_ms < 0) {
+            cv_.wait_for(lk, std::chrono::seconds(1)); // forever, re-armed
+        } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
             return scan(); // last chance
+        }
         if (auto f = scan()) return f;
         if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
             return std::nullopt;
@@ -310,9 +347,13 @@ std::optional<Frame> ControlClient::recv_match_any(const std::vector<uint16_t> &
     if (auto f = scan()) return f;
     if (no_wait) return std::nullopt;
     auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 3600'000 : timeout_ms);
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (connected_.load()) {
-        if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) return scan();
+        if (timeout_ms < 0) {
+            cv_.wait_for(lk, std::chrono::seconds(1));
+        } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+            return scan();
+        }
         if (auto f = scan()) return f;
         if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
             return std::nullopt;
@@ -372,21 +413,42 @@ void MultiplexConn::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
     cv_.notify_all();
 }
 
-size_t MultiplexConn::wait_filled(uint64_t tag, size_t min_bytes,
-                                  const std::atomic<bool> *abort) {
+size_t MultiplexConn::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms) {
     std::unique_lock lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (true) {
         auto it = sinks_.find(tag);
         if (it == sinks_.end()) return 0;
         if (it->second.filled >= min_bytes) return it->second.filled;
         if (!alive_.load()) return it->second.filled;
-        if (abort && abort->load()) return it->second.filled;
-        cv_.wait_for(lk, std::chrono::milliseconds(50));
+        if (timeout_ms < 0) {
+            cv_.wait_for(lk, std::chrono::seconds(1)); // forever, re-armed
+        } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout ||
+                   std::chrono::steady_clock::now() >= deadline) {
+            auto it2 = sinks_.find(tag);
+            return it2 == sinks_.end() ? 0 : it2->second.filled;
+        }
     }
 }
 
 void MultiplexConn::unregister_sink(uint64_t tag) {
-    std::lock_guard lk(mu_);
+    std::unique_lock lk(mu_);
+    // The RX thread may be mid-recv into the sink buffer outside the lock;
+    // wait until it is not, so the caller can free the buffer afterwards.
+    // If the peer stalls mid-frame (recv_all blocked with bytes owed), kick
+    // the RX thread out via shutdown — the op is being torn down anyway and
+    // the ring is re-established from scratch on recovery.
+    auto busy = [&] {
+        auto it = sinks_.find(tag);
+        return it != sinks_.end() && it->second.busy;
+    };
+    if (busy()) {
+        if (!cv_.wait_for(lk, std::chrono::milliseconds(250), [&] { return !busy(); })) {
+            sock_.shutdown();
+            cv_.wait(lk, [&] { return !busy(); }); // recv_all now fails promptly
+        }
+    }
     sinks_.erase(tag);
 }
 
@@ -394,7 +456,7 @@ std::optional<std::vector<uint8_t>> MultiplexConn::recv_queued(
     uint64_t tag, int timeout_ms, const std::atomic<bool> *abort) {
     std::unique_lock lk(mu_);
     auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 3600'000 : timeout_ms);
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (true) {
         auto it = queues_.find(tag);
         if (it != queues_.end() && !it->second.empty()) {
@@ -404,17 +466,25 @@ std::optional<std::vector<uint8_t>> MultiplexConn::recv_queued(
         }
         if (!alive_.load()) return std::nullopt;
         if (abort && abort->load()) return std::nullopt;
-        if (cv_.wait_until(lk, std::min(deadline,
-                                        std::chrono::steady_clock::now() +
-                                            std::chrono::milliseconds(50))) ==
-                std::cv_status::timeout &&
-            std::chrono::steady_clock::now() >= deadline)
+        cv_.wait_for(lk, std::chrono::milliseconds(50));
+        if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
             return std::nullopt;
     }
 }
 
 void MultiplexConn::purge_range(uint64_t lo, uint64_t hi) {
-    std::lock_guard lk(mu_);
+    std::unique_lock lk(mu_);
+    auto any_busy = [&] {
+        for (auto &[tag, s] : sinks_)
+            if (tag >= lo && tag < hi && s.busy) return true;
+        return false;
+    };
+    if (any_busy()) {
+        if (!cv_.wait_for(lk, std::chrono::milliseconds(250), [&] { return !any_busy(); })) {
+            sock_.shutdown(); // stalled peer mid-frame: kick the RX thread out
+            cv_.wait(lk, [&] { return !any_busy(); });
+        }
+    }
     for (auto it = sinks_.begin(); it != sinks_.end();)
         it = (it->first >= lo && it->first < hi) ? sinks_.erase(it) : std::next(it);
     for (auto it = queues_.begin(); it != queues_.end();)
@@ -439,28 +509,45 @@ void MultiplexConn::rx_loop() {
         }
         size_t n = len - 16;
 
-        // sink fast path: read straight into the registered destination
+        // sink fast path: read straight into the registered destination.
+        // busy marks the sink so unregister/purge cannot free the buffer
+        // while we write outside the lock.
         uint8_t *dst = nullptr;
         {
             std::lock_guard lk(mu_);
             auto it = sinks_.find(tag);
-            if (it != sinks_.end() && it->second.filled + n <= it->second.cap)
+            if (it != sinks_.end() && it->second.filled + n <= it->second.cap) {
                 dst = it->second.base + it->second.filled;
+                it->second.busy = true;
+            }
         }
         if (dst) {
-            if (!sock_.recv_all(dst, n)) break;
+            bool ok = sock_.recv_all(dst, n);
             {
                 std::lock_guard lk(mu_);
                 auto it = sinks_.find(tag);
-                if (it != sinks_.end()) it->second.filled += n;
+                if (it != sinks_.end()) {
+                    it->second.busy = false;
+                    if (ok) it->second.filled += n;
+                }
             }
             cv_.notify_all();
+            if (!ok) break;
         } else {
             scratch.resize(n);
             if (n > 0 && !sock_.recv_all(scratch.data(), n)) break;
             {
+                // re-check: a sink may have been registered while we were in
+                // recv_all above — queueing now would strand the bytes where
+                // wait_filled never looks (this was a real deadlock)
                 std::lock_guard lk(mu_);
-                queues_[tag].push_back(scratch);
+                auto it = sinks_.find(tag);
+                if (it != sinks_.end() && it->second.filled + n <= it->second.cap) {
+                    memcpy(it->second.base + it->second.filled, scratch.data(), n);
+                    it->second.filled += n;
+                } else {
+                    queues_[tag].push_back(scratch);
+                }
             }
             cv_.notify_all();
         }

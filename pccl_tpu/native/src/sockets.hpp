@@ -85,6 +85,9 @@ bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
                 std::span<const uint8_t> payload);
 // blocking; returns nullopt on disconnect/error
 std::optional<Frame> recv_frame(Socket &s);
+// bounded: returns nullopt on disconnect/error/deadline (for handshake
+// threads that must not block forever on a silent connection)
+std::optional<Frame> recv_frame(Socket &s, int timeout_ms);
 
 // --- Listener: accept loop on its own thread ---
 class Listener {
@@ -151,10 +154,12 @@ public:
 
     // Zero-copy RX: register a sink; RX thread appends payloads for `tag`
     // in arrival order starting at base. wait_filled blocks until >= min
-    // bytes landed (returns current fill), or 0 on close/abort.
+    // bytes landed or timeout_ms elapsed (timeout_ms < 0 = forever); returns
+    // the current fill level so callers can poll abort conditions between
+    // bounded waits. unregister_sink blocks while the RX thread is mid-write
+    // into the sink buffer (busy flag) so the buffer can be freed safely.
     void register_sink(uint64_t tag, uint8_t *base, size_t cap);
-    size_t wait_filled(uint64_t tag, size_t min_bytes,
-                       const std::atomic<bool> *abort = nullptr);
+    size_t wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms = -1);
     void unregister_sink(uint64_t tag);
 
     // Queued RX for small per-tag messages (quantization metadata):
@@ -176,6 +181,7 @@ private:
         uint8_t *base = nullptr;
         size_t cap = 0;
         size_t filled = 0;
+        bool busy = false; // RX thread is writing into base outside the lock
     };
 
     Socket sock_;

@@ -110,7 +110,8 @@ public:
 
 private:
     void need(size_t n) const {
-        if (pos_ + n > data_.size()) throw std::runtime_error("wire: short read");
+        // n is attacker-controlled (length fields); pos_ + n can wrap
+        if (n > data_.size() - pos_) throw std::runtime_error("wire: short read");
     }
     std::span<const uint8_t> data_;
     size_t pos_ = 0;
