@@ -1,0 +1,149 @@
+"""ctypes loader for the native core (libpcclt.so).
+
+Reference parity: python/framework/pccl/_loader.py + _cdecls.py of the
+reference (cffi ABI mode over libpccl). Here: plain ctypes over the pcclt
+C API (pccl_tpu/native/include/pcclt.h) — no codegen step, the surface is
+declared once below.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+_LIB = None
+
+
+def _candidate_paths():
+    env = os.environ.get("PCCLT_LIB")
+    if env:
+        yield Path(env)
+    here = Path(__file__).resolve().parent.parent / "native"
+    yield here / "build" / "libpcclt.so"
+    yield here / "libpcclt.so"
+
+
+def load():
+    """Load libpcclt.so and declare signatures. Raises OSError if missing."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = None
+    for p in _candidate_paths():
+        if p.exists():
+            path = p
+            break
+    if path is None:
+        raise OSError(
+            "libpcclt.so not found; build it with "
+            "`cmake -S pccl_tpu/native -B pccl_tpu/native/build -G Ninja && "
+            "ninja -C pccl_tpu/native/build` or set PCCLT_LIB")
+    lib = ctypes.CDLL(str(path))
+    _declare(lib)
+    _LIB = lib
+    return lib
+
+
+class CommCreateParams(ctypes.Structure):
+    _fields_ = [
+        ("master_ip", ctypes.c_char_p),
+        ("master_port", ctypes.c_uint16),
+        ("peer_group", ctypes.c_uint32),
+        ("advertised_ip", ctypes.c_char_p),
+        ("p2p_port", ctypes.c_uint16),
+        ("ss_port", ctypes.c_uint16),
+        ("bench_port", ctypes.c_uint16),
+        ("p2p_connection_pool_size", ctypes.c_uint32),
+    ]
+
+
+class ReduceDescriptor(ctypes.Structure):
+    _fields_ = [
+        ("tag", ctypes.c_uint64),
+        ("op", ctypes.c_int),
+        ("quant_algo", ctypes.c_int),
+        ("quant_dtype", ctypes.c_int),
+    ]
+
+
+class ReduceInfo(ctypes.Structure):
+    _fields_ = [
+        ("tx_bytes", ctypes.c_uint64),
+        ("rx_bytes", ctypes.c_uint64),
+        ("world_size", ctypes.c_uint32),
+    ]
+
+
+class TensorInfoC(ctypes.Structure):
+    _fields_ = [
+        ("name", ctypes.c_char_p),
+        ("data", ctypes.c_void_p),
+        ("count", ctypes.c_uint64),
+        ("dtype", ctypes.c_int),
+        ("device", ctypes.c_int),
+        ("allow_content_inequality", ctypes.c_int),
+    ]
+
+
+class SharedStateC(ctypes.Structure):
+    _fields_ = [
+        ("revision", ctypes.c_uint64),
+        ("count", ctypes.c_uint64),
+        ("infos", ctypes.POINTER(TensorInfoC)),
+    ]
+
+
+class SharedStateSyncInfo(ctypes.Structure):
+    _fields_ = [
+        ("tx_bytes", ctypes.c_uint64),
+        ("rx_bytes", ctypes.c_uint64),
+        ("revision", ctypes.c_uint64),
+    ]
+
+
+def _declare(lib):
+    c = ctypes
+    P = c.POINTER
+
+    lib.pccltInit.restype = c.c_int
+    lib.pccltGetBuildInfo.restype = c.c_char_p
+
+    lib.pccltCreateMaster.restype = c.c_int
+    lib.pccltCreateMaster.argtypes = [c.c_char_p, c.c_uint16, P(c.c_void_p)]
+    for fn in ("pccltRunMaster", "pccltInterruptMaster",
+               "pccltMasterAwaitTermination", "pccltDestroyMaster"):
+        f = getattr(lib, fn)
+        f.restype = c.c_int
+        f.argtypes = [c.c_void_p]
+    lib.pccltMasterPort.restype = c.c_uint16
+    lib.pccltMasterPort.argtypes = [c.c_void_p]
+
+    lib.pccltCreateCommunicator.restype = c.c_int
+    lib.pccltCreateCommunicator.argtypes = [P(CommCreateParams), P(c.c_void_p)]
+    for fn in ("pccltDestroyCommunicator", "pccltConnect", "pccltUpdateTopology",
+               "pccltOptimizeTopology"):
+        f = getattr(lib, fn)
+        f.restype = c.c_int
+        f.argtypes = [c.c_void_p]
+    lib.pccltGetAttribute.restype = c.c_int
+    lib.pccltGetAttribute.argtypes = [c.c_void_p, c.c_int, P(c.c_int64)]
+    lib.pccltArePeersPending.restype = c.c_int
+    lib.pccltArePeersPending.argtypes = [c.c_void_p, P(c.c_int)]
+
+    lib.pccltAllReduce.restype = c.c_int
+    lib.pccltAllReduce.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_uint64,
+                                   c.c_int, P(ReduceDescriptor), P(ReduceInfo)]
+    lib.pccltAllReduceAsync.restype = c.c_int
+    lib.pccltAllReduceAsync.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                        c.c_uint64, c.c_int, P(ReduceDescriptor)]
+    lib.pccltAwaitAsyncReduce.restype = c.c_int
+    lib.pccltAwaitAsyncReduce.argtypes = [c.c_void_p, c.c_uint64, P(ReduceInfo)]
+    lib.pccltAllReduceMultipleWithRetry.restype = c.c_int
+    lib.pccltAllReduceMultipleWithRetry.argtypes = [
+        c.c_void_p, P(c.c_void_p), P(c.c_void_p), P(c.c_uint64), c.c_int,
+        P(ReduceDescriptor), c.c_uint64, P(ReduceInfo)]
+
+    lib.pccltSynchronizeSharedState.restype = c.c_int
+    lib.pccltSynchronizeSharedState.argtypes = [c.c_void_p, P(SharedStateC), c.c_int,
+                                                P(SharedStateSyncInfo)]

@@ -1,0 +1,71 @@
+"""Native-stack loopback all-reduce benchmark (bench.py's preferred path).
+
+Matches BASELINE.md config 1: fp32 ring all-reduce, 2 loopback peers, over
+the real native stack (master + 2 communicator processes, PCCP wire
+protocol). busbw for a ring all-reduce = 2*(N-1)/N * bytes / time; N=2 →
+bytes/time. The reference's equivalent harness is
+tests/basic_reduce_test/main.cpp (fp32 loop over loopback peers).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+
+
+def _peer_main(rank: int, master_port: int, nbytes: int, iters: int, q) -> None:
+    from pccl_tpu.comm.api import Communicator, ReduceOp
+
+    comm = Communicator("127.0.0.1", master_port,
+                        p2p_port=48700 + rank * 4, ss_port=48740 + rank * 4,
+                        bench_port=48780 + rank * 4)
+    comm.connect()
+    while comm.world_size < 2:
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+
+    count = nbytes // 4
+    x = np.full(count, float(rank + 1), dtype=np.float32)
+    y = np.empty_like(x)
+    comm.all_reduce(x, y, op=ReduceOp.SUM)  # warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        comm.all_reduce(x, y, op=ReduceOp.SUM)
+        times.append(time.perf_counter() - t0)
+    assert abs(float(y[0]) - 3.0) < 1e-6, f"allreduce wrong: {y[0]}"
+    if q is not None:
+        q.put(times)
+    comm.destroy()
+
+
+def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10) -> float:
+    """Returns busbw in GB/s (median over iters)."""
+    from pccl_tpu.comm.api import MasterNode
+
+    master = MasterNode("0.0.0.0", int(os.environ.get("PCCLT_BENCH_MASTER_PORT",
+                                                      "48651")))
+    master.run()
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p1 = ctx.Process(target=_peer_main,
+                         args=(1, master.port, nbytes, iters, None))
+        p1.start()
+        try:
+            _peer_main(0, master.port, nbytes, iters, q)
+            times = q.get(timeout=120)
+            p1.join(timeout=30)
+        finally:
+            if p1.is_alive():
+                p1.terminate()
+                p1.join(timeout=5)
+        med = sorted(times)[len(times) // 2]
+        return (nbytes / med) / 1e9
+    finally:
+        master.interrupt()
+        master.destroy()

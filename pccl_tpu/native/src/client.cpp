@@ -278,7 +278,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
                 ok = false;
                 break;
             }
-            auto ack = net::recv_frame(s);
+            auto ack = net::recv_frame(s, 15'000);
             if (!ack || ack->type != PacketType::kP2PHelloAck) {
                 ok = false;
                 break;
@@ -671,10 +671,8 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
     auto fr = master_.recv_match(PacketType::kM2CSharedStateSyncResp, nullptr, 300'000);
     if (!fr) {
         close_window();
-        {
         auto kst = check_kicked();
         return kst == Status::kOk ? Status::kConnectionLost : kst;
-    }
     }
     auto resp = proto::SharedStateSyncResp::decode(fr->payload);
     if (!resp) {
@@ -702,7 +700,7 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
             if (!net::send_frame(sock, mu, PacketType::kC2SStateRequest, w.data())) {
                 st = Status::kConnectionLost;
             } else {
-                auto hdr = net::recv_frame(sock);
+                auto hdr = net::recv_frame(sock, 30'000);
                 if (!hdr || hdr->type != PacketType::kS2CStateHeader) {
                     st = Status::kConnectionLost;
                 } else {
@@ -751,8 +749,7 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
     }
     auto done = master_.recv_match(PacketType::kM2CSharedStateDone, nullptr, 300'000);
     close_window();
-    if (!done)
-        {
+    if (!done) {
         auto kst = check_kicked();
         return kst == Status::kOk ? Status::kConnectionLost : kst;
     }

@@ -164,9 +164,29 @@ bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
     return true;
 }
 
-std::optional<Frame> recv_frame(Socket &s) {
+// single implementation: timeout_ms < 0 blocks forever (plain recv_all),
+// otherwise the whole frame must arrive before the deadline
+static std::optional<Frame> recv_frame_impl(Socket &s, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+    auto recv_n = [&](uint8_t *dst, size_t n) -> bool {
+        if (timeout_ms < 0) return s.recv_all(dst, n);
+        size_t off = 0;
+        while (off < n) {
+            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+            if (left <= 0) return false;
+            ssize_t r = s.recv_some(dst + off, n - off,
+                                    static_cast<int>(std::min<long long>(left, 200)));
+            if (r == -2) continue; // poll slice elapsed; re-check deadline
+            if (r <= 0) return false;
+            off += static_cast<size_t>(r);
+        }
+        return true;
+    };
     uint8_t hdr[6];
-    if (!s.recv_all(hdr, 6)) return std::nullopt;
+    if (!recv_n(hdr, 6)) return std::nullopt;
     uint32_t be_len;
     uint16_t be_type;
     memcpy(&be_len, hdr, 4);
@@ -179,43 +199,15 @@ std::optional<Frame> recv_frame(Socket &s) {
     Frame f;
     f.type = wire::from_be(be_type);
     f.payload.resize(len - 2);
-    if (!f.payload.empty() && !s.recv_all(f.payload.data(), f.payload.size()))
+    if (!f.payload.empty() && !recv_n(f.payload.data(), f.payload.size()))
         return std::nullopt;
     return f;
 }
 
+std::optional<Frame> recv_frame(Socket &s) { return recv_frame_impl(s, -1); }
+
 std::optional<Frame> recv_frame(Socket &s, int timeout_ms) {
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms);
-    uint8_t hdr[6];
-    auto recv_bounded = [&](uint8_t *dst, size_t n) -> bool {
-        size_t off = 0;
-        while (off < n) {
-            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
-                            deadline - std::chrono::steady_clock::now())
-                            .count();
-            if (left <= 0) return false;
-            ssize_t r = s.recv_some(dst + off, n - off,
-                                    static_cast<int>(std::min<long long>(left, 200)));
-            if (r == -2) continue; // poll timeout slice; re-check deadline
-            if (r <= 0) return false;
-            off += static_cast<size_t>(r);
-        }
-        return true;
-    };
-    if (!recv_bounded(hdr, 6)) return std::nullopt;
-    uint32_t be_len;
-    uint16_t be_type;
-    memcpy(&be_len, hdr, 4);
-    memcpy(&be_type, hdr + 4, 2);
-    uint32_t len = wire::from_be(be_len);
-    if (len < 2 || len > wire::kMaxControlPacket) return std::nullopt;
-    Frame f;
-    f.type = wire::from_be(be_type);
-    f.payload.resize(len - 2);
-    if (!f.payload.empty() && !recv_bounded(f.payload.data(), f.payload.size()))
-        return std::nullopt;
-    return f;
+    return recv_frame_impl(s, timeout_ms);
 }
 
 // ---------- Listener ----------
@@ -434,19 +426,21 @@ size_t MultiplexConn::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms
 
 void MultiplexConn::unregister_sink(uint64_t tag) {
     std::unique_lock lk(mu_);
-    // The RX thread may be mid-recv into the sink buffer outside the lock;
-    // wait until it is not, so the caller can free the buffer afterwards.
-    // If the peer stalls mid-frame (recv_all blocked with bytes owed), kick
-    // the RX thread out via shutdown — the op is being torn down anyway and
-    // the ring is re-established from scratch on recovery.
+    // The RX thread may be mid-recv into the sink buffer outside the lock.
+    // Mark the sink cancelled: the RX thread checks between bounded slices,
+    // redirects the rest of the frame to scratch, and clears busy — the
+    // connection stays healthy. Only if the wire makes NO progress for 5 s
+    // (genuinely stalled peer) do we shutdown to free the caller's buffer.
+    auto it0 = sinks_.find(tag);
+    if (it0 != sinks_.end()) it0->second.cancel = true;
     auto busy = [&] {
         auto it = sinks_.find(tag);
         return it != sinks_.end() && it->second.busy;
     };
     if (busy()) {
-        if (!cv_.wait_for(lk, std::chrono::milliseconds(250), [&] { return !busy(); })) {
+        if (!cv_.wait_for(lk, std::chrono::seconds(5), [&] { return !busy(); })) {
             sock_.shutdown();
-            cv_.wait(lk, [&] { return !busy(); }); // recv_all now fails promptly
+            cv_.wait(lk, [&] { return !busy(); }); // recv now fails promptly
         }
     }
     sinks_.erase(tag);
@@ -474,14 +468,16 @@ std::optional<std::vector<uint8_t>> MultiplexConn::recv_queued(
 
 void MultiplexConn::purge_range(uint64_t lo, uint64_t hi) {
     std::unique_lock lk(mu_);
+    for (auto &[tag, s] : sinks_)
+        if (tag >= lo && tag < hi) s.cancel = true;
     auto any_busy = [&] {
         for (auto &[tag, s] : sinks_)
             if (tag >= lo && tag < hi && s.busy) return true;
         return false;
     };
     if (any_busy()) {
-        if (!cv_.wait_for(lk, std::chrono::milliseconds(250), [&] { return !any_busy(); })) {
-            sock_.shutdown(); // stalled peer mid-frame: kick the RX thread out
+        if (!cv_.wait_for(lk, std::chrono::seconds(5), [&] { return !any_busy(); })) {
+            sock_.shutdown(); // peer made no progress at all: last resort
             cv_.wait(lk, [&] { return !any_busy(); });
         }
     }
@@ -511,24 +507,44 @@ void MultiplexConn::rx_loop() {
 
         // sink fast path: read straight into the registered destination.
         // busy marks the sink so unregister/purge cannot free the buffer
-        // while we write outside the lock.
+        // while we write outside the lock; the frame is read in bounded
+        // slices so a cancel request (op abort) is honoured promptly without
+        // killing the connection.
+        constexpr size_t kSlice = 256 << 10;
         uint8_t *dst = nullptr;
         {
             std::lock_guard lk(mu_);
             auto it = sinks_.find(tag);
-            if (it != sinks_.end() && it->second.filled + n <= it->second.cap) {
+            if (it != sinks_.end() && !it->second.cancel &&
+                it->second.filled + n <= it->second.cap) {
                 dst = it->second.base + it->second.filled;
                 it->second.busy = true;
             }
         }
         if (dst) {
-            bool ok = sock_.recv_all(dst, n);
+            bool ok = true, cancelled = false;
+            size_t off = 0;
+            while (off < n && ok) {
+                size_t want = std::min(kSlice, n - off);
+                if (!cancelled) {
+                    ok = sock_.recv_all(dst + off, want);
+                } else {
+                    scratch.resize(want); // drain + drop the rest of the frame
+                    ok = sock_.recv_all(scratch.data(), want);
+                }
+                off += want;
+                if (ok && !cancelled && off < n) {
+                    std::lock_guard lk(mu_);
+                    auto it = sinks_.find(tag);
+                    cancelled = it == sinks_.end() || it->second.cancel;
+                }
+            }
             {
                 std::lock_guard lk(mu_);
                 auto it = sinks_.find(tag);
                 if (it != sinks_.end()) {
                     it->second.busy = false;
-                    if (ok) it->second.filled += n;
+                    if (ok && !cancelled) it->second.filled += n;
                 }
             }
             cv_.notify_all();
@@ -542,7 +558,8 @@ void MultiplexConn::rx_loop() {
                 // wait_filled never looks (this was a real deadlock)
                 std::lock_guard lk(mu_);
                 auto it = sinks_.find(tag);
-                if (it != sinks_.end() && it->second.filled + n <= it->second.cap) {
+                if (it != sinks_.end() && !it->second.cancel &&
+                    it->second.filled + n <= it->second.cap) {
                     memcpy(it->second.base + it->second.filled, scratch.data(), n);
                     it->second.filled += n;
                 } else {

@@ -181,7 +181,8 @@ private:
         uint8_t *base = nullptr;
         size_t cap = 0;
         size_t filled = 0;
-        bool busy = false; // RX thread is writing into base outside the lock
+        bool busy = false;   // RX thread is writing into base outside the lock
+        bool cancel = false; // unregister requested: stop writing, drain+drop
     };
 
     Socket sock_;

@@ -1,0 +1,211 @@
+"""End-to-end tests for pccl_tpu.comm over the native core.
+
+Reference parity: ccoip/tests/end_to_end/test_all_reduce.cpp (real master +
+N clients on loopback threads, never network mocks) and
+python/tests/unit_tests/pccl_test.py (master lifecycle, communicator edge
+cases)."""
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+LIB = Path(__file__).resolve().parent.parent / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+_PORT_COUNTER = [49000]
+
+
+def _ports(n=1):
+    p = _PORT_COUNTER[0]
+    _PORT_COUNTER[0] += 64 * n
+    return p
+
+
+def _run_peers(master_port, world, worker, base):
+    """Spin up `world` client threads; each runs worker(comm, rank).
+    Mirrors the reference establishConnections helper (test_all_reduce.cpp:16-42)."""
+    from pccl_tpu.comm import Communicator
+
+    errors = []
+
+    def peer(rank):
+        comm = Communicator("127.0.0.1", master_port,
+                            p2p_port=base + rank * 8, ss_port=base + 512 + rank * 8,
+                            bench_port=base + 1024 + rank * 8)
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < world:
+                if time.time() > deadline:
+                    raise TimeoutError(f"rank {rank}: world never reached {world}")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, f"peer failures: {errors}"
+
+
+@pytest.fixture
+def master():
+    from pccl_tpu.comm import MasterNode
+
+    m = MasterNode("0.0.0.0", _ports())
+    m.run()
+    yield m
+    m.interrupt()
+    m.destroy()
+
+
+def test_build_info_and_master_lifecycle():
+    from pccl_tpu.comm import MasterNode, _native
+
+    lib = _native.load()
+    assert b"pcclt" in lib.pccltGetBuildInfo()
+    m = MasterNode("0.0.0.0", _ports())
+    m.run()
+    assert m.port > 0
+    m.interrupt()
+    m.await_termination()
+    m.destroy()
+    m.destroy()  # idempotent
+
+
+def test_allreduce_fp32_2peer(master):
+    from pccl_tpu.comm import ReduceOp
+
+    count = 4099
+
+    def worker(comm, rank):
+        x = np.arange(count, dtype=np.float32) + rank
+        y = np.empty_like(x)
+        info = comm.all_reduce(x, y, op=ReduceOp.SUM, tag=7)
+        expect = 2 * np.arange(count, dtype=np.float32) + 1
+        np.testing.assert_allclose(y, expect, rtol=1e-6)
+        assert info.world_size == 2
+        assert info.tx_bytes > 0 and info.rx_bytes > 0
+
+    _run_peers(master.port, 2, worker, base=50000)
+
+
+def test_allreduce_inplace_avg_4peer(master):
+    from pccl_tpu.comm import ReduceOp
+
+    count = 1000
+
+    def worker(comm, rank):
+        x = np.full(count, float(rank), dtype=np.float32)
+        comm.all_reduce(x, op=ReduceOp.AVG, tag=9)
+        np.testing.assert_allclose(x, np.full(count, 1.5, dtype=np.float32),
+                                   rtol=1e-6)
+
+    _run_peers(master.port, 4, worker, base=50300)
+
+
+def test_allreduce_int_dtypes(master):
+    from pccl_tpu.comm import ReduceOp
+
+    def worker(comm, rank):
+        a = np.array([3, 7, 9, 2], dtype=np.int32) + rank
+        comm.all_reduce(a, op=ReduceOp.MAX, tag=11)
+        np.testing.assert_array_equal(a, np.array([4, 8, 10, 3], dtype=np.int32))
+        b = np.array([1.5, -2.5, 4.0], dtype=np.float64) * (rank + 1)
+        comm.all_reduce(b, op=ReduceOp.SUM, tag=12)
+        np.testing.assert_allclose(b, np.array([4.5, -7.5, 12.0]))
+
+    _run_peers(master.port, 2, worker, base=50600)
+
+
+def test_allreduce_quantized_minmax(master):
+    from pccl_tpu.comm import DataType, QuantizationAlgorithm, ReduceOp
+
+    count = 2048
+
+    def worker(comm, rank):
+        x = np.sin(np.arange(count, dtype=np.float32) * 0.01) * 4 + rank
+        comm.all_reduce(x, op=ReduceOp.SUM, tag=13,
+                        quantization=QuantizationAlgorithm.MIN_MAX,
+                        quantized_dtype=DataType.UINT8)
+        expect = (np.sin(np.arange(count, dtype=np.float32) * 0.01) * 4) * 3 + 3
+        assert np.abs(x - expect).max() < 0.2  # 8-bit wire precision
+
+    _run_peers(master.port, 3, worker, base=50900)
+
+
+def test_async_and_multiple(master):
+    from pccl_tpu.comm import ReduceOp
+
+    def worker(comm, rank):
+        xs = [np.full(256, float(rank + i), dtype=np.float32) for i in range(3)]
+        handles = [comm.all_reduce_async(x, tag=20 + i, op=ReduceOp.SUM)
+                   for i, x in enumerate(xs)]
+        for h in handles:
+            h.wait()
+        for i, x in enumerate(xs):
+            np.testing.assert_allclose(x, np.full(256, 2 * i + 1.0))
+        ys = [np.full(128, float(rank), dtype=np.float32) for _ in range(2)]
+        comm.all_reduce_multiple_with_retry(ys, op=ReduceOp.SUM)
+        for y in ys:
+            np.testing.assert_allclose(y, np.full(128, 1.0))
+
+    _run_peers(master.port, 2, worker, base=51200)
+
+
+def test_shared_state_sync(master):
+    from pccl_tpu.comm import SharedState, SharedStateSyncStrategy, TensorInfo
+
+    def worker(comm, rank):
+        w = np.full(512, 42.0 if rank == 0 else 0.0, dtype=np.float32)
+        step = np.array([7 if rank == 0 else 0], dtype=np.uint64)
+        state = SharedState([
+            TensorInfo.from_numpy("weights", w),
+            TensorInfo.from_numpy("step", step),
+        ], revision=1)
+        strategy = (SharedStateSyncStrategy.SEND_ONLY if rank == 0
+                    else SharedStateSyncStrategy.RECEIVE_ONLY)
+        info = comm.sync_shared_state(state, strategy)
+        assert w[0] == 42.0 and step[0] == 7
+        assert info.revision == 1
+        if rank != 0:
+            assert info.rx_bytes > 0
+
+    _run_peers(master.port, 3, worker, base=51500)
+
+
+def test_shared_state_popular_election(master):
+    from pccl_tpu.comm import SharedState, SharedStateSyncStrategy, TensorInfo
+
+    def worker(comm, rank):
+        # ranks 0,1 agree; rank 2 diverges → popular content (0/1) wins
+        w = np.full(128, 1.0 if rank < 2 else 9.0, dtype=np.float32)
+        state = SharedState([TensorInfo.from_numpy("w", w)], revision=1)
+        comm.sync_shared_state(state, SharedStateSyncStrategy.ENFORCE_POPULAR)
+        np.testing.assert_allclose(w, np.full(128, 1.0))
+
+    _run_peers(master.port, 3, worker, base=51800)
+
+
+def test_errors():
+    from pccl_tpu.comm import Communicator, MasterUnreachableError, PcclError
+
+    comm = Communicator("127.0.0.1", 1)  # nothing listening
+    with pytest.raises(MasterUnreachableError):
+        comm.connect()
+    comm.destroy()
+
+    comm2 = Communicator("127.0.0.1", 2)
+    with pytest.raises(PcclError):
+        comm2.all_reduce(np.zeros(4, dtype=np.float32))  # not connected
+    comm2.destroy()
