@@ -1,1 +1,11 @@
 from . import mesh  # noqa: F401
+from .codec import PytreeCodec, build_codec  # noqa: F401
+
+
+def __getattr__(name):
+    # defer optax / ..models / ..comm imports until first use
+    if name in ("diloco", "hierarchical", "train"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,237 @@
+"""DiLoCo — low-communication data parallelism over the WAN ring.
+
+Capability parity: the reference ships sync DiLoCo
+(/root/reference/python/examples/nanogpt_diloco/sync_diloco.py:396-510,
+docs/md/07-.../02-SyncDiloco.md) and async one-step-delayed DiLoCo
+(async_diloco.py, docs/md/07-.../03-AsyncDiloco.md) as torch training loops
+over the pccl bindings. Here the same algorithm is a library component,
+designed TPU-first:
+
+- the inner loop is whatever jitted SPMD train step the caller owns
+  (pccl_tpu.parallel.train); DiLoCo never sees it;
+- pseudo-gradients (outer_params - inner_params) are computed ON DEVICE by a
+  jitted function that flattens every leaf into ONE contiguous fp32 vector —
+  a single large buffer is the shape the ring reduce wants (few tags, big
+  chunks saturate the pipe), and the flatten/unflatten round-trip is free
+  for XLA to fuse;
+- only that one vector crosses host↔device per outer step; the outer
+  (Nesterov SGD) update runs jitted on device;
+- the WAN hop supports on-the-wire quantization (MinMax / ZeroPointScale),
+  mirroring the reference's piquant path;
+- fault tolerance follows the reference contract: ConnectionLost/Aborted →
+  update_topology() → retry with the surviving world.
+
+Shared-state integration: `shared_state()` exposes outer params + outer
+optimizer momentum + step as a revisioned pccl_tpu.comm.SharedState so
+late joiners catch up bit-identically (reference sync_diloco.py keeps the
+same three groups in its shared state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import (
+    Communicator,
+    ConnectionLostError,
+    DataType,
+    OperationAbortedError,
+    QuantizationAlgorithm,
+    ReduceOp,
+    SharedState,
+    SharedStateSyncStrategy,
+    TensorInfo,
+    TooFewPeersError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DilocoConfig:
+    """Hyperparameters of the outer loop (reference defaults:
+    sync_diloco.py outer SGD lr=0.7, nesterov momentum=0.9, H~50-500)."""
+
+    outer_lr: float = 0.7
+    outer_momentum: float = 0.9
+    nesterov: bool = True
+    inner_steps: int = 50
+    quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE
+    quantized_dtype: DataType = DataType.UINT8
+    max_retries: int = 16
+
+
+from .codec import build_codec
+
+
+class Diloco:
+    """Synchronous DiLoCo driver around a Communicator.
+
+    Usage::
+
+        dl = Diloco(comm, params, cfg)
+        while training:
+            comm.update_topology()                 # admit joiners
+            dl.maybe_join_shared_state()           # catch up if outdated
+            for _ in range(cfg.inner_steps):
+                params, opt_state, loss = inner_step(params, opt_state, ...)
+            params = dl.outer_step(params)         # WAN ring + outer SGD
+
+    The returned `params` after outer_step are the new global (outer) params,
+    already on device with the original shardings — continue inner training
+    from them (reference: sync_diloco.py resets inner params to outer).
+    """
+
+    def __init__(self, comm: Optional[Communicator], params: Any,
+                 cfg: DilocoConfig = DilocoConfig()):
+        self.comm = comm
+        self.cfg = cfg
+        self.step = 0
+        self._delta_fn, self._flat_fn, self._unflat_fn, self.count = build_codec(params)
+        # outer params live on device; momentum buffer too
+        self.outer_params = jax.tree.map(lambda x: x, params)
+        self._momentum_vec = jnp.zeros((self.count,), jnp.float32)
+
+        lr, mu, nesterov = cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
+
+        def _apply(outer_vec, mom, delta):
+            mom = mu * mom + delta
+            upd = delta + mu * mom if nesterov else mom
+            return outer_vec - lr * upd, mom
+
+        self._apply_fn = jax.jit(_apply)
+
+    # -- the outer step --
+
+    def _reduce_host(self, vec: np.ndarray) -> int:
+        """AVG all-reduce `vec` in place over the ring with retry.
+        Returns the world size that completed the reduce."""
+        c = self.cfg
+        assert self.comm is not None
+        for attempt in range(c.max_retries):
+            try:
+                info = self.comm.all_reduce(
+                    vec, op=ReduceOp.AVG,
+                    quantization=c.quantization,
+                    quantized_dtype=c.quantized_dtype)
+                return info.world_size
+            except (ConnectionLostError, OperationAbortedError):
+                # world shrank mid-op; src buffer was restored by the native
+                # core — adopt the survivor ring and retry (reference
+                # README.md:117-123 loop)
+                self.comm.update_topology()
+            except TooFewPeersError:
+                return 1  # alone: outer step degenerates to local update
+        from ..comm import Result
+        raise ConnectionLostError(
+            Result.CONNECTION_LOST,
+            f"all_reduce failed after {c.max_retries} retries")
+
+    def outer_step(self, inner_params: Any) -> Any:
+        """Average pseudo-gradients across peers, apply outer Nesterov SGD,
+        return the new global params (device pytree)."""
+        delta = self._delta_fn(self.outer_params, inner_params)
+        host = np.array(jax.device_get(delta), dtype=np.float32)
+        if self.comm is not None:
+            self._reduce_host(host)
+        outer_vec = self._flat_fn(self.outer_params)
+        new_vec, self._momentum_vec = self._apply_fn(
+            outer_vec, self._momentum_vec, jnp.asarray(host))
+        self.outer_params = self._unflat_fn(new_vec)
+        self.step += 1
+        return self.outer_params
+
+    # -- shared state --
+
+    def shared_state(self) -> SharedState:
+        """Outer params + momentum + step as a revisioned SharedState.
+        Revision = outer step count (one-increment rule of the master,
+        reference ccoip_master_state.cpp:1066-1090)."""
+        self._ss_vec = np.array(
+            jax.device_get(self._flat_fn(self.outer_params)), dtype=np.float32)
+        self._ss_mom = np.array(jax.device_get(self._momentum_vec),
+                                  dtype=np.float32)
+        self._ss_step = np.array([self.step], dtype=np.uint64)
+        return SharedState([
+            TensorInfo.from_numpy("diloco.outer_params", self._ss_vec),
+            TensorInfo.from_numpy("diloco.outer_momentum", self._ss_mom),
+            TensorInfo.from_numpy("diloco.step", self._ss_step),
+        ], revision=self.step)
+
+    def sync_shared_state(
+            self,
+            strategy: SharedStateSyncStrategy = SharedStateSyncStrategy.ENFORCE_POPULAR):
+        """Sync outer state with the group; adopt whatever wins the election.
+        Returns the new inner params to train from (== outer params)."""
+        assert self.comm is not None
+        st = self.shared_state()
+        info = self.comm.sync_shared_state(st, strategy)
+        # adopt (possibly received) content
+        self.step = int(self._ss_step[0])
+        self._momentum_vec = jnp.asarray(self._ss_mom)
+        self.outer_params = self._unflat_fn(jnp.asarray(self._ss_vec))
+        return info
+
+
+class AsyncDiloco(Diloco):
+    """One-step-delayed DiLoCo: the reduce of outer step t overlaps with the
+    inner compute of step t+1 (reference async_diloco.py,
+    docs/md/07-.../03-AsyncDiloco.md:1-112).
+
+    outer_step_async(inner_params) kicks the WAN reduce on a background
+    thread and returns IMMEDIATELY with params to continue training from
+    (the current outer params — the delayed update lands next call).
+    Call .finish() (or the next outer_step_async) to join the in-flight
+    reduce and apply it.
+    """
+
+    def __init__(self, comm, params, cfg: DilocoConfig = DilocoConfig()):
+        super().__init__(comm, params, cfg)
+        self._inflight: Optional[threading.Thread] = None
+        self._inflight_host: Optional[np.ndarray] = None
+        self._err: Optional[BaseException] = None
+
+    def _reduce_bg(self, host: np.ndarray) -> None:
+        try:
+            if self.comm is not None:
+                self._reduce_host(host)
+        except BaseException as e:  # noqa: BLE001 — surfaced on join
+            self._err = e
+
+    def _join_inflight(self) -> None:
+        if self._inflight is None:
+            return
+        self._inflight.join()
+        self._inflight = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            self._inflight_host = None
+            raise err
+        host = self._inflight_host
+        self._inflight_host = None
+        outer_vec = self._flat_fn(self.outer_params)
+        new_vec, self._momentum_vec = self._apply_fn(
+            outer_vec, self._momentum_vec, jnp.asarray(host))
+        self.outer_params = self._unflat_fn(new_vec)
+        self.step += 1
+
+    def outer_step_async(self, inner_params: Any) -> Any:
+        """Apply the previous in-flight reduce (if any), launch the reduce of
+        this step's pseudo-gradient, return params to continue from."""
+        self._join_inflight()
+        delta = self._delta_fn(self.outer_params, inner_params)
+        host = np.array(jax.device_get(delta), dtype=np.float32)
+        self._inflight_host = host
+        self._inflight = threading.Thread(target=self._reduce_bg, args=(host,),
+                                          daemon=True)
+        self._inflight.start()
+        return self.outer_params
+
+    def finish(self) -> Any:
+        """Join any in-flight reduce and apply it; returns final outer params."""
+        self._join_inflight()
+        return self.outer_params

@@ -1,0 +1,128 @@
+"""Hierarchical all-reduce: ICI inside the slice, the TCP ring across slices.
+
+This is the TPU north star of the build (BASELINE.json, SURVEY.md §5
+"Distributed communication backend"): each TPU slice is ONE logical peer of
+the CCoIP-style ring. The reference has no equivalent — its peers are single
+CUDA hosts — so this module is new design, not a port.
+
+Data path for a global all-reduce of a sharded array tree:
+
+  1. **intra-slice reduce (ICI, jitted)** — if the tree carries a
+     data-parallel axis to fold (e.g. per-device gradients under shard_map),
+     a `psum`/mean over the mesh axis runs on-device; for trees produced by
+     an SPMD `jit` step the gradients are already slice-reduced and this is
+     the identity.
+  2. **host staging** — the fp32 flat vector (codec.build_codec) is fetched
+     once per slice. With `jax.sharding`, `device_get` of a fully-addressable
+     array performs the gather over ICI, not over PCIe per-shard.
+  3. **inter-slice ring (DCN)** — this process, acting as its slice's one
+     peer, runs the fault-tolerant ring all-reduce with optional on-the-wire
+     quantization (the reference's piquant path over WAN).
+  4. **broadcast back (ICI)** — `device_put` with the original sharding lays
+     the result back out across the slice; every device receives identical
+     bytes, preserving the bit-parity invariant the shared-state machinery
+     depends on (reference simplehash design, SURVEY.md §2 #13).
+
+Fault tolerance: ConnectionLost/Aborted → update_topology() → retry, same
+contract as the flat ring (reference README.md:90-130).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import (
+    Communicator,
+    ConnectionLostError,
+    DataType,
+    OperationAbortedError,
+    QuantizationAlgorithm,
+    ReduceOp,
+    Result,
+    TooFewPeersError,
+)
+from .codec import build_codec
+
+
+def local_mean(tree: Any, mesh, axis: str = "dp") -> Any:
+    """Explicit intra-slice mean over a mesh axis via shard_map + psum.
+
+    Each leaf's LEADING dim is the per-device stack (length = mesh axis
+    size × k); the output folds it away: [n·k, ...] → [k, ...] holding the
+    mean, replicated. Only needed when the caller holds per-device values
+    OUTSIDE an SPMD jit step; gradients from a jitted step are already
+    reduced by XLA."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+
+    def _mean(x):
+        return jax.lax.psum(x, axis) / n
+
+    fn = jax.shard_map(lambda t: jax.tree.map(_mean, t), mesh=mesh,
+                       in_specs=P(axis), out_specs=P())
+    return fn(tree)
+
+
+class HierarchicalAllReduce:
+    """Slice-as-one-peer global averaging.
+
+    Usage (one process per slice)::
+
+        h = HierarchicalAllReduce(comm, grads_template)
+        grads = h.all_reduce(grads)       # global mean across all slices
+
+    `comm=None` degrades to the single-slice case (identity), so the same
+    training loop runs on one slice or many.
+    """
+
+    def __init__(self, comm: Optional[Communicator], template: Any, *,
+                 quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
+                 quantized_dtype: DataType = DataType.UINT8,
+                 max_retries: int = 16):
+        self.comm = comm
+        self.quantization = quantization
+        self.quantized_dtype = quantized_dtype
+        self.max_retries = max_retries
+        self._codec = build_codec(template)
+        # sharding of the template leaves, reapplied on the way back
+        self._shardings = jax.tree.map(
+            lambda l: l.sharding if hasattr(l, "sharding") else None, template)
+
+    @property
+    def count(self) -> int:
+        return self._codec.count
+
+    def _ring_avg(self, vec: np.ndarray) -> int:
+        assert self.comm is not None
+        for _ in range(self.max_retries):
+            try:
+                info = self.comm.all_reduce(
+                    vec, op=ReduceOp.AVG, quantization=self.quantization,
+                    quantized_dtype=self.quantized_dtype)
+                return info.world_size
+            except (ConnectionLostError, OperationAbortedError):
+                self.comm.update_topology()
+            except TooFewPeersError:
+                return 1
+        raise ConnectionLostError(
+            Result.CONNECTION_LOST,
+            f"hierarchical all_reduce failed after {self.max_retries} retries")
+
+    def all_reduce(self, tree: Any) -> Any:
+        """Global mean of `tree` across slices. Returns a tree with the
+        original dtypes and shardings."""
+        vec = self._codec.flat(tree)
+        if self.comm is None:
+            return self._codec.unflat(vec)
+        host = np.array(jax.device_get(vec), dtype=np.float32)
+        self._ring_avg(host)
+        out = self._codec.unflat(jnp.asarray(host))
+        return jax.tree.map(
+            lambda l, s: jax.device_put(l, s) if s is not None else l,
+            out, self._shardings)

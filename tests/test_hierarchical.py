@@ -1,0 +1,102 @@
+"""Hierarchical (ICI + ring) all-reduce: two emulated slices on one host.
+
+Each 'slice' is a thread owning half of the 8 virtual CPU devices with its
+own Mesh; the cross-slice hop runs over the real native loopback ring. This
+is the slice-as-one-peer topology of BASELINE.json's north star."""
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+LIB = Path(__file__).resolve().parent.parent / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+needs_native = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+
+def test_local_mean_shard_map(eight_devices):
+    import jax.numpy as jnp
+
+    from pccl_tpu.parallel import mesh as mesh_lib
+    from pccl_tpu.parallel.hierarchical import local_mean
+
+    mesh = mesh_lib.make_mesh(eight_devices[:4], axis_names=("dp",), shape=(4,))
+    # per-device values 0,1,2,3 stacked along the leading dim → folded mean 1.5
+    x = jnp.repeat(jnp.arange(4, dtype=jnp.float32), 8)  # [32] = 4 shards of 8
+    out = local_mean(x, mesh, axis="dp")
+    assert out.shape == (8,)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 1.5))
+
+
+def test_identity_without_comm(eight_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.parallel.hierarchical import HierarchicalAllReduce
+
+    tree = {"a": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones(5, jnp.bfloat16)}
+    h = HierarchicalAllReduce(None, tree)
+    out = h.all_reduce(tree)
+    np.testing.assert_allclose(np.asarray(out["a"]),
+                               np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert out["b"].dtype == jnp.bfloat16
+
+
+@needs_native
+def test_two_slices_global_mean(eight_devices):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.comm import Communicator, MasterNode
+    from pccl_tpu.parallel import mesh as mesh_lib
+    from pccl_tpu.parallel.hierarchical import HierarchicalAllReduce
+
+    master = MasterNode("0.0.0.0", 52300)
+    master.run()
+    errors = []
+    results = {}
+
+    def slice_proc(slice_id):
+        try:
+            devs = eight_devices[slice_id * 4:(slice_id + 1) * 4]
+            mesh = mesh_lib.make_mesh(devs, axis_names=("dp", "tp"), shape=(2, 2))
+            # a sharded "gradient": value = slice_id + 1 everywhere
+            sharding = NamedSharding(mesh, P("dp", "tp"))
+            g = jax.device_put(
+                jnp.full((8, 8), float(slice_id + 1), jnp.float32), sharding)
+            tree = {"g": g}
+
+            base = 54500 + slice_id * 16
+            comm = Communicator("127.0.0.1", master.port, p2p_port=base,
+                                ss_port=base + 4, bench_port=base + 8)
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < 2:
+                if time.time() > deadline:
+                    raise TimeoutError("world never reached 2")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+
+            h = HierarchicalAllReduce(comm, tree)
+            out = h.all_reduce(tree)
+            assert out["g"].sharding.is_equivalent_to(sharding, 2)
+            results[slice_id] = np.asarray(out["g"])
+            comm.destroy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((slice_id, e))
+
+    ts = [threading.Thread(target=slice_proc, args=(s,)) for s in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    master.interrupt()
+    master.destroy()
+    assert not errors, f"slice failures: {errors}"
+    # global mean of 1.0 and 2.0 → 1.5, identical bytes on both slices
+    np.testing.assert_array_equal(results[0], results[1])
+    np.testing.assert_allclose(results[0], np.full((8, 8), 1.5))
