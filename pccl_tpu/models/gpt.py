@@ -103,7 +103,8 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: GPTConfig) -> jax.Array:
+def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: GPTConfig,
+           attn_fn=None) -> jax.Array:
     B, T, d = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
     h = _rmsnorm(x, layer["ln1_g"])
@@ -112,7 +113,7 @@ def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: GPTConfig) -> jax.Arr
     q = _rope(q.reshape(B, T, H, Dh), cfg.rope_theta)
     k = _rope(k.reshape(B, T, H, Dh), cfg.rope_theta)
     v = v.reshape(B, T, H, Dh)
-    att = _attention(q, k, v).reshape(B, T, d)
+    att = (attn_fn or _attention)(q, k, v).reshape(B, T, d)
     x = x + att @ layer["attn_out"].astype(att.dtype)
     h = _rmsnorm(x, layer["ln2_g"])
     h = jax.nn.gelu(h @ layer["mlp_in"].astype(h.dtype))
@@ -122,14 +123,19 @@ def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: GPTConfig) -> jax.Arr
 _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_qkv", "attn_out", "mlp_in", "mlp_out")
 
 
-def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
-    """tokens: int32 [B, T] → logits float32 [B, T, vocab]."""
+def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
+            attn_fn=None) -> jax.Array:
+    """tokens: int32 [B, T] → logits float32 [B, T, vocab].
+
+    attn_fn: optional (q, k, v) -> out override for the attention op —
+    e.g. ops.flash_attention (fused single-chip kernel) or
+    ops.ring_attention.make_ring_attn_fn(mesh) (sequence parallelism)."""
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
 
     layers = {k: params[k] for k in _LAYER_KEYS}
 
     def body(h, layer):
-        return _block(h, layer, cfg), None
+        return _block(h, layer, cfg, attn_fn), None
 
     x, _ = lax.scan(body, x, layers)
     x = _rmsnorm(x, params["lnf_g"])
@@ -138,9 +144,9 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig) -> 
     return logits
 
 
-def loss_fn(params, tokens, targets, cfg: GPTConfig) -> jax.Array:
+def loss_fn(params, tokens, targets, cfg: GPTConfig, attn_fn=None) -> jax.Array:
     """Mean next-token cross-entropy. targets: int32 [B, T]."""
-    logits = forward(params, tokens, cfg)
+    logits = forward(params, tokens, cfg, attn_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)

@@ -1,0 +1,9 @@
+"""pccl_tpu.ops — TPU compute ops: fused kernels and sequence parallelism.
+
+flash_attention: pallas causal attention for one core (MXU-tiled, online
+softmax). ring_attention: sequence-parallel attention over a mesh axis via
+shard_map + ppermute (long-context capability; rides ICI).
+"""
+
+from .flash_attention import flash_attention, reference_attention  # noqa: F401
+from .ring_attention import make_ring_attn_fn, ring_attention  # noqa: F401

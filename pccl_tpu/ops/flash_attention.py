@@ -1,0 +1,118 @@
+"""Flash attention — fused causal attention pallas kernel for one TPU core.
+
+The single-chip hot op under the flagship model (the reference has no model
+compute at all — its examples lean on torch SDPA; here the TPU-native
+equivalent is a pallas kernel feeding the MXU).
+
+Layout: grid over (batch·heads, q blocks); for each q block the kernel
+streams K/V blocks from VMEM with online softmax in fp32 scratch, skipping
+k blocks strictly above the causal diagonal (trip count depends only on the
+q-block index, so the loop stays statically boundable). Logits never
+materialize beyond a [block_q, block_k] tile.
+
+On non-TPU backends `flash_attention` falls back to the jnp reference
+implementation (CI runs on a virtual CPU mesh); `interpret=True` forces the
+pallas interpreter for kernel-logic tests anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Dense jnp causal attention; q,k,v: [B, T, H, Dh]."""
+    Dh = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(Dh)
+    if causal:
+        T = q.shape[1]
+        qi = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        logits = jnp.where(ki <= qi, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale        # [block_q, Dh]
+
+    nk = seq_len // block_k
+    if causal:
+        # last k block any row of this q block may attend to (ceil division)
+        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                  # [block_q, block_k]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + p @ v
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_bhtd(qt, kt, vt, *, block_q: int, block_k: int, causal: bool,
+                interpret: bool):
+    """qt,kt,vt: [BH, T, Dh] → [BH, T, Dh]."""
+    BH, T, Dh = qt.shape
+    scale = 1.0 / math.sqrt(Dh)
+    kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                               seq_len=T, causal=causal, scale=scale)
+    grid = (BH, T // block_q)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, T, Dh), qt.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dh), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, T, Dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, T, Dh), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dh), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Fused causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh].
+
+    Uses the pallas kernel on TPU (or under `interpret`); falls back to the
+    dense jnp path elsewhere or when T doesn't tile."""
+    B, T, H, Dh = q.shape
+    on_tpu = jax.default_backend() == "tpu"
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if not (on_tpu or interpret) or T % block_q or T % block_k:
+        return reference_attention(q, k, v, causal=causal)
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
+
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), block_q=block_q,
+                      block_k=block_k, causal=causal, interpret=interpret)
+    return out.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)

@@ -39,16 +39,13 @@ import numpy as np
 
 from ..comm import (
     Communicator,
-    ConnectionLostError,
     DataType,
-    OperationAbortedError,
     QuantizationAlgorithm,
-    ReduceOp,
     SharedState,
     SharedStateSyncStrategy,
     TensorInfo,
-    TooFewPeersError,
 )
+from .ring import avg_all_reduce_with_retry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,6 +89,10 @@ class Diloco:
         self.cfg = cfg
         self.step = 0
         self._delta_fn, self._flat_fn, self._unflat_fn, self.count = build_codec(params)
+        # leaf shardings of the template, reapplied after every unflatten so
+        # outer params keep the caller's TP/DP layout
+        self._shardings = jax.tree.map(
+            lambda l: l.sharding if hasattr(l, "sharding") else None, params)
         # outer params live on device; momentum buffer too
         self.outer_params = jax.tree.map(lambda x: x, params)
         self._momentum_vec = jnp.zeros((self.count,), jnp.float32)
@@ -107,29 +108,17 @@ class Diloco:
 
     # -- the outer step --
 
+    def _restore_shardings(self, tree: Any) -> Any:
+        return jax.tree.map(
+            lambda l, s: jax.device_put(l, s) if s is not None else l,
+            tree, self._shardings, is_leaf=lambda x: x is None)
+
     def _reduce_host(self, vec: np.ndarray) -> int:
-        """AVG all-reduce `vec` in place over the ring with retry.
-        Returns the world size that completed the reduce."""
-        c = self.cfg
         assert self.comm is not None
-        for attempt in range(c.max_retries):
-            try:
-                info = self.comm.all_reduce(
-                    vec, op=ReduceOp.AVG,
-                    quantization=c.quantization,
-                    quantized_dtype=c.quantized_dtype)
-                return info.world_size
-            except (ConnectionLostError, OperationAbortedError):
-                # world shrank mid-op; src buffer was restored by the native
-                # core — adopt the survivor ring and retry (reference
-                # README.md:117-123 loop)
-                self.comm.update_topology()
-            except TooFewPeersError:
-                return 1  # alone: outer step degenerates to local update
-        from ..comm import Result
-        raise ConnectionLostError(
-            Result.CONNECTION_LOST,
-            f"all_reduce failed after {c.max_retries} retries")
+        return avg_all_reduce_with_retry(
+            self.comm, vec, quantization=self.cfg.quantization,
+            quantized_dtype=self.cfg.quantized_dtype,
+            max_retries=self.cfg.max_retries)
 
     def outer_step(self, inner_params: Any) -> Any:
         """Average pseudo-gradients across peers, apply outer Nesterov SGD,
@@ -141,7 +130,7 @@ class Diloco:
         outer_vec = self._flat_fn(self.outer_params)
         new_vec, self._momentum_vec = self._apply_fn(
             outer_vec, self._momentum_vec, jnp.asarray(host))
-        self.outer_params = self._unflat_fn(new_vec)
+        self.outer_params = self._restore_shardings(self._unflat_fn(new_vec))
         self.step += 1
         return self.outer_params
 
@@ -165,15 +154,18 @@ class Diloco:
     def sync_shared_state(
             self,
             strategy: SharedStateSyncStrategy = SharedStateSyncStrategy.ENFORCE_POPULAR):
-        """Sync outer state with the group; adopt whatever wins the election.
-        Returns the new inner params to train from (== outer params)."""
+        """Sync outer state with the group; adopt whatever wins the election
+        into self.outer_params / momentum / step. Returns the
+        SharedStateSyncInfo (tx/rx bytes, revision); read the adopted params
+        from self.outer_params."""
         assert self.comm is not None
         st = self.shared_state()
         info = self.comm.sync_shared_state(st, strategy)
         # adopt (possibly received) content
         self.step = int(self._ss_step[0])
         self._momentum_vec = jnp.asarray(self._ss_mom)
-        self.outer_params = self._unflat_fn(jnp.asarray(self._ss_vec))
+        self.outer_params = self._restore_shardings(
+            self._unflat_fn(jnp.asarray(self._ss_vec)))
         return info
 
 
@@ -194,6 +186,7 @@ class AsyncDiloco(Diloco):
         self._inflight: Optional[threading.Thread] = None
         self._inflight_host: Optional[np.ndarray] = None
         self._err: Optional[BaseException] = None
+        self._baseline: Optional[Any] = None  # outer params inner started from
 
     def _reduce_bg(self, host: np.ndarray) -> None:
         try:
@@ -216,19 +209,24 @@ class AsyncDiloco(Diloco):
         outer_vec = self._flat_fn(self.outer_params)
         new_vec, self._momentum_vec = self._apply_fn(
             outer_vec, self._momentum_vec, jnp.asarray(host))
-        self.outer_params = self._unflat_fn(new_vec)
+        self.outer_params = self._restore_shardings(self._unflat_fn(new_vec))
         self.step += 1
 
     def outer_step_async(self, inner_params: Any) -> Any:
         """Apply the previous in-flight reduce (if any), launch the reduce of
         this step's pseudo-gradient, return params to continue from."""
-        self._join_inflight()
-        delta = self._delta_fn(self.outer_params, inner_params)
+        # the pseudo-gradient baseline is the outer params the inner phase
+        # STARTED from — before the delayed update from step t-1 lands
+        # (reference async semantics, docs/md/07-.../03-AsyncDiloco.md)
+        baseline = self._baseline if self._baseline is not None else self.outer_params
+        delta = self._delta_fn(baseline, inner_params)
         host = np.array(jax.device_get(delta), dtype=np.float32)
+        self._join_inflight()
         self._inflight_host = host
         self._inflight = threading.Thread(target=self._reduce_bg, args=(host,),
                                           daemon=True)
         self._inflight.start()
+        self._baseline = self.outer_params
         return self.outer_params
 
     def finish(self) -> Any:

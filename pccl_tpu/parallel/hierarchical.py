@@ -36,17 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..comm import (
-    Communicator,
-    ConnectionLostError,
-    DataType,
-    OperationAbortedError,
-    QuantizationAlgorithm,
-    ReduceOp,
-    Result,
-    TooFewPeersError,
-)
+from ..comm import Communicator, DataType, QuantizationAlgorithm
 from .codec import build_codec
+from .ring import avg_all_reduce_with_retry
 
 
 def local_mean(tree: Any, mesh, axis: str = "dp") -> Any:
@@ -100,19 +92,9 @@ class HierarchicalAllReduce:
 
     def _ring_avg(self, vec: np.ndarray) -> int:
         assert self.comm is not None
-        for _ in range(self.max_retries):
-            try:
-                info = self.comm.all_reduce(
-                    vec, op=ReduceOp.AVG, quantization=self.quantization,
-                    quantized_dtype=self.quantized_dtype)
-                return info.world_size
-            except (ConnectionLostError, OperationAbortedError):
-                self.comm.update_topology()
-            except TooFewPeersError:
-                return 1
-        raise ConnectionLostError(
-            Result.CONNECTION_LOST,
-            f"hierarchical all_reduce failed after {self.max_retries} retries")
+        return avg_all_reduce_with_retry(
+            self.comm, vec, quantization=self.quantization,
+            quantized_dtype=self.quantized_dtype, max_retries=self.max_retries)
 
     def all_reduce(self, tree: Any) -> Any:
         """Global mean of `tree` across slices. Returns a tree with the
@@ -125,4 +107,4 @@ class HierarchicalAllReduce:
         out = self._codec.unflat(jnp.asarray(host))
         return jax.tree.map(
             lambda l, s: jax.device_put(l, s) if s is not None else l,
-            out, self._shardings)
+            out, self._shardings, is_leaf=lambda x: x is None)

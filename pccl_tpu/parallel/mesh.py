@@ -64,13 +64,19 @@ GPT_PARAM_SPECS: Dict[str, P] = {
 }
 
 
+def _drop_missing_axes(spec: P, mesh: Mesh) -> P:
+    """Replace axis names absent from `mesh` with None (replicated)."""
+    return P(*[a if (a in mesh.shape) else None for a in spec])
+
+
 def gpt_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, spec) for k, spec in GPT_PARAM_SPECS.items()}
+    return {k: NamedSharding(mesh, _drop_missing_axes(spec, mesh))
+            for k, spec in GPT_PARAM_SPECS.items()}
 
 
 def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
     """Tokens [B, T]: batch over dp, optionally sequence over `seq_axis`."""
-    return NamedSharding(mesh, P("dp", seq_axis))
+    return NamedSharding(mesh, _drop_missing_axes(P("dp", seq_axis), mesh))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

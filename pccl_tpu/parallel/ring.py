@@ -1,0 +1,46 @@
+"""Shared fault-tolerance policy for WAN-ring collectives.
+
+One implementation of the reference's retry contract (README.md:90-130):
+ConnectionLost/Aborted → update_topology() → retry with the surviving world;
+TooFewPeers → the caller is alone and the reduce degenerates to identity.
+Used by both DiLoCo and the hierarchical all-reduce.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..comm import (
+    Communicator,
+    ConnectionLostError,
+    DataType,
+    OperationAbortedError,
+    QuantizationAlgorithm,
+    ReduceOp,
+    Result,
+    TooFewPeersError,
+)
+
+
+def avg_all_reduce_with_retry(
+        comm: Communicator, vec: np.ndarray, *,
+        quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
+        quantized_dtype: DataType = DataType.UINT8,
+        max_retries: int = 16) -> int:
+    """AVG all-reduce `vec` in place over the ring, retrying across peer
+    churn. Returns the world size that completed the reduce (1 = alone)."""
+    for _ in range(max_retries):
+        try:
+            info = comm.all_reduce(vec, op=ReduceOp.AVG,
+                                   quantization=quantization,
+                                   quantized_dtype=quantized_dtype)
+            return info.world_size
+        except (ConnectionLostError, OperationAbortedError):
+            # world shrank mid-op; the native core restored the src buffer —
+            # adopt the survivor ring and go again
+            comm.update_topology()
+        except TooFewPeersError:
+            return 1
+    raise ConnectionLostError(
+        Result.CONNECTION_LOST,
+        f"all_reduce failed after {max_retries} retries")

@@ -35,13 +35,18 @@ def make_train_state(key, cfg: gpt.GPTConfig, mesh, lr: float = 3e-4):
     return params, tx, opt_state
 
 
-def build_train_step(cfg: gpt.GPTConfig, tx, mesh):
-    """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss)."""
+def build_train_step(cfg: gpt.GPTConfig, tx, mesh, attn_fn=None,
+                     seq_axis: str | None = None):
+    """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
+
+    attn_fn: optional attention override (e.g. ring attention for sequence
+    parallelism over `seq_axis`)."""
     param_sharding = mesh_lib.gpt_param_sharding(mesh)
-    data_sharding = mesh_lib.batch_sharding(mesh)
+    data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)
 
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(gpt.loss_fn)(params, tokens, targets, cfg)
+        loss, grads = jax.value_and_grad(gpt.loss_fn)(
+            params, tokens, targets, cfg, attn_fn)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
