@@ -97,18 +97,9 @@ def _flash_bhtd(qt, kt, vt, *, block_q: int, block_k: int, causal: bool,
     )(qt, kt, vt)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
-    """Fused causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh].
-
-    Uses the pallas kernel on TPU (or under `interpret`); falls back to the
-    dense jnp path elsewhere or when T doesn't tile."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
     B, T, H, Dh = q.shape
-    on_tpu = jax.default_backend() == "tpu"
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if not (on_tpu or interpret) or T % block_q or T % block_k:
-        return reference_attention(q, k, v, causal=causal)
 
     def to_bhtd(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
@@ -116,3 +107,38 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), block_q=block_q,
                       block_k=block_k, causal=causal, interpret=interpret)
     return out.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)
+
+
+def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_diff(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_diff_bwd(causal, block_q, block_k, interpret, res, g):
+    # Backward recomputes through the dense reference path (O(T²) logits in
+    # the backward only); a fused flash backward kernel can swap in here
+    # without changing the public API.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Fused causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh].
+
+    Uses the pallas kernel on TPU (or under `interpret`); falls back to the
+    dense jnp path elsewhere or when T doesn't tile. Differentiable: the
+    forward runs the fused kernel, the backward recomputes via the dense
+    reference attention (custom_vjp), so it drops into build_train_step."""
+    B, T, H, Dh = q.shape
+    on_tpu = jax.default_backend() == "tpu"
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if not (on_tpu or interpret) or T % block_q or T % block_k:
+        return reference_attention(q, k, v, causal=causal)
+    return _flash_diff(q, k, v, causal, block_q, block_k, interpret)

@@ -64,7 +64,9 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
     # initial accumulators must carry the same varying-manual-axes type as
     # the loop outputs (shard_map's varying-axis tracking)
     def _vary(x):
-        return lax.pvary(x, manual_axes)
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, manual_axes, to="varying")
+        return lax.pvary(x, manual_axes)  # removed in newer JAX
 
     m0 = _vary(jnp.full((B, H, Tl), -1e30, jnp.float32))
     l0 = _vary(jnp.zeros((B, H, Tl), jnp.float32))

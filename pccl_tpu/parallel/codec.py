@@ -22,6 +22,19 @@ class PytreeCodec(NamedTuple):
     count: int
 
 
+def leaf_shardings(tree: Any) -> Any:
+    """Tree of per-leaf shardings (None for leaves without one)."""
+    return jax.tree.map(
+        lambda l: l.sharding if hasattr(l, "sharding") else None, tree)
+
+
+def restore_shardings(tree: Any, shardings: Any) -> Any:
+    """Lay `tree` back out with `shardings` captured via leaf_shardings."""
+    return jax.tree.map(
+        lambda l, s: jax.device_put(l, s) if s is not None else l,
+        tree, shardings, is_leaf=lambda x: x is None)
+
+
 def build_codec(template: Any) -> PytreeCodec:
     """Build jitted flatten/unflatten functions shaped to `template`."""
     leaves, treedef = jax.tree.flatten(template)

@@ -45,6 +45,7 @@ from ..comm import (
     SharedStateSyncStrategy,
     TensorInfo,
 )
+from . import codec
 from .ring import avg_all_reduce_with_retry
 
 
@@ -91,8 +92,7 @@ class Diloco:
         self._delta_fn, self._flat_fn, self._unflat_fn, self.count = build_codec(params)
         # leaf shardings of the template, reapplied after every unflatten so
         # outer params keep the caller's TP/DP layout
-        self._shardings = jax.tree.map(
-            lambda l: l.sharding if hasattr(l, "sharding") else None, params)
+        self._shardings = codec.leaf_shardings(params)
         # outer params live on device; momentum buffer too
         self.outer_params = jax.tree.map(lambda x: x, params)
         self._momentum_vec = jnp.zeros((self.count,), jnp.float32)
@@ -109,9 +109,7 @@ class Diloco:
     # -- the outer step --
 
     def _restore_shardings(self, tree: Any) -> Any:
-        return jax.tree.map(
-            lambda l, s: jax.device_put(l, s) if s is not None else l,
-            tree, self._shardings, is_leaf=lambda x: x is None)
+        return codec.restore_shardings(tree, self._shardings)
 
     def _reduce_host(self, vec: np.ndarray) -> int:
         assert self.comm is not None
@@ -228,6 +226,18 @@ class AsyncDiloco(Diloco):
         self._inflight.start()
         self._baseline = self.outer_params
         return self.outer_params
+
+    def sync_shared_state(
+            self,
+            strategy: SharedStateSyncStrategy = SharedStateSyncStrategy.ENFORCE_POPULAR):
+        """Land (or fail) the in-flight delayed update BEFORE the election so
+        the offered state is self-consistent, and drop the pseudo-gradient
+        baseline afterwards — adopted params invalidate it (the delta would
+        otherwise include the whole sync jump)."""
+        self._join_inflight()
+        info = super().sync_shared_state(strategy)
+        self._baseline = None
+        return info
 
     def finish(self) -> Any:
         """Join any in-flight reduce and apply it; returns final outer params."""

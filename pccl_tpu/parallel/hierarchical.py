@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm import Communicator, DataType, QuantizationAlgorithm
-from .codec import build_codec
+from .codec import build_codec, leaf_shardings, restore_shardings
 from .ring import avg_all_reduce_with_retry
 
 
@@ -83,8 +83,7 @@ class HierarchicalAllReduce:
         self.max_retries = max_retries
         self._codec = build_codec(template)
         # sharding of the template leaves, reapplied on the way back
-        self._shardings = jax.tree.map(
-            lambda l: l.sharding if hasattr(l, "sharding") else None, template)
+        self._shardings = leaf_shardings(template)
 
     @property
     def count(self) -> int:
@@ -105,6 +104,4 @@ class HierarchicalAllReduce:
         host = np.array(jax.device_get(vec), dtype=np.float32)
         self._ring_avg(host)
         out = self._codec.unflat(jnp.asarray(host))
-        return jax.tree.map(
-            lambda l, s: jax.device_put(l, s) if s is not None else l,
-            out, self._shardings, is_leaf=lambda x: x is None)
+        return restore_shardings(out, self._shardings)

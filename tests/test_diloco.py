@@ -61,6 +61,24 @@ def test_diloco_local_no_comm():
     assert dl.step == 5
 
 
+def test_async_diloco_sync_resets_baseline(monkeypatch):
+    """sync_shared_state must join the in-flight reduce and invalidate the
+    pseudo-gradient baseline — adopted params make the old baseline bogus."""
+    import jax.numpy as jnp
+
+    from pccl_tpu.parallel import diloco as dmod
+
+    params = {"w": jnp.zeros(4)}
+    dl = dmod.AsyncDiloco(None, params)
+    dl.outer_step_async(params)          # sets _baseline, no comm → no-op reduce
+    assert dl._baseline is not None
+    monkeypatch.setattr(dmod.Diloco, "sync_shared_state",
+                        lambda self, strategy=None: "info")
+    assert dl.sync_shared_state() == "info"
+    assert dl._baseline is None
+    assert dl._inflight is None
+
+
 @needs_native
 @pytest.mark.parametrize("async_mode", [False, True])
 def test_diloco_two_peers_converge(async_mode):
