@@ -80,6 +80,7 @@ void Client::on_p2p_accept(net::Socket sock) {
         std::mutex mu;
         if (!net::send_frame(sock, mu, PacketType::kP2PHelloAck, w.data())) return;
         sock.set_keepalive();
+        sock.set_bufsizes(4 << 20);
 
         auto conn = std::make_shared<net::MultiplexConn>(std::move(sock));
         fd->store(-1); // handed off: the conn owns the fd now
@@ -270,6 +271,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
                 break;
             }
             s.set_keepalive();
+            s.set_bufsizes(4 << 20);
             wire::Writer w;
             proto::put_uuid(w, uuid_);
             w.u32(static_cast<uint32_t>(i));
@@ -571,12 +573,15 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         ctx.quant = desc.quant;
         ctx.q_dtype = desc.quant_dtype;
         ctx.backup = snapshot.empty() ? nullptr : snapshot.data();
+        auto scratch = take_scratch();
+        ctx.scratch = &scratch;
         ctx.should_abort = [&]() -> bool {
             if (op->abort.load()) return true;
             if (consume_abort(true) && verdict_aborted) return true;
             return false;
         };
         auto res = reduce::ring_allreduce(ctx, send, recv, count);
+        give_scratch(std::move(scratch));
         op->info.tx_bytes = ctx.tx_bytes;
         op->info.rx_bytes = ctx.rx_bytes;
         op->info.world = world;
@@ -604,6 +609,20 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         st = Status::kAborted;
     }
     return st;
+}
+
+std::vector<uint8_t> Client::take_scratch() {
+    std::lock_guard lk(scratch_mu_);
+    if (scratch_pool_.empty()) return {};
+    auto v = std::move(scratch_pool_.back());
+    scratch_pool_.pop_back();
+    return v;
+}
+
+void Client::give_scratch(std::vector<uint8_t> v) {
+    if (v.empty()) return;
+    std::lock_guard lk(scratch_mu_);
+    if (scratch_pool_.size() < 8) scratch_pool_.push_back(std::move(v));
 }
 
 Status Client::await_reduce(uint64_t tag, ReduceInfo *info) {

@@ -148,6 +148,13 @@ private:
     std::mutex ops_mu_;
     std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_;
 
+    // reuse pool for ring receive scratch: per-op vectors would be
+    // page-zeroed by the kernel on every reduce (milliseconds at 10s of MiB)
+    std::mutex scratch_mu_;
+    std::vector<std::vector<uint8_t>> scratch_pool_;
+    std::vector<uint8_t> take_scratch();
+    void give_scratch(std::vector<uint8_t> v);
+
     // shared-state distribution window (serve only while a sync is active)
     std::mutex dist_mu_;
     bool dist_open_ = false;

@@ -64,6 +64,12 @@ template <typename T, typename Op> void loop(T *dst, const T *src, size_t n, Op 
     for (size_t i = 0; i < n; ++i) dst[i] = op(dst[i], src[i]);
 }
 
+template <typename T, typename Op>
+void loop3(T *dst, const T *a, const T *b, size_t n, Op op) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) dst[i] = op(a[i], b[i]);
+}
+
 template <typename Op>
 void loop16(bool bf16, uint16_t *dst, const uint16_t *src, size_t n, Op op) {
     for (size_t i = 0; i < n; ++i) {
@@ -109,6 +115,33 @@ void dispatch_op16(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *sr
     }
 }
 
+template <typename T>
+void dispatch_op3(proto::RedOp op, T *dst, const T *a, const T *b, size_t n) {
+    switch (op) {
+    case proto::RedOp::kSum:
+    case proto::RedOp::kAvg: loop3(dst, a, b, n, Add{}); break;
+    case proto::RedOp::kProd: loop3(dst, a, b, n, Mul{}); break;
+    case proto::RedOp::kMax: loop3(dst, a, b, n, Max{}); break;
+    case proto::RedOp::kMin: loop3(dst, a, b, n, Min{}); break;
+    }
+}
+
+void dispatch_op16_3(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *a,
+                     const uint16_t *b, size_t n) {
+    auto cvt = [bf16](uint16_t x) { return bf16 ? bf16_to_f32(x) : f16_to_f32(x); };
+    auto enc = [bf16](float f) { return bf16 ? f32_to_bf16(f) : f32_to_f16(f); };
+    auto go = [&](auto op_fn) {
+        for (size_t i = 0; i < n; ++i) dst[i] = enc(op_fn(cvt(a[i]), cvt(b[i])));
+    };
+    switch (op) {
+    case proto::RedOp::kSum:
+    case proto::RedOp::kAvg: go(Add{}); break;
+    case proto::RedOp::kProd: go(Mul{}); break;
+    case proto::RedOp::kMax: go(Max{}); break;
+    case proto::RedOp::kMin: go(Min{}); break;
+    }
+}
+
 } // namespace
 
 void accumulate(proto::DType dt, proto::RedOp op, void *dst, const void *src,
@@ -127,6 +160,25 @@ void accumulate(proto::DType dt, proto::RedOp op, void *dst, const void *src,
     case DType::kBF16: dispatch_op16(true, op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(src), count); break;
     case DType::kF32: dispatch_op(op, static_cast<float *>(dst), static_cast<const float *>(src), count); break;
     case DType::kF64: dispatch_op(op, static_cast<double *>(dst), static_cast<const double *>(src), count); break;
+    }
+}
+
+void accumulate3(proto::DType dt, proto::RedOp op, void *dst, const void *a,
+                 const void *b, size_t count) {
+    using proto::DType;
+    switch (dt) {
+    case DType::kU8: dispatch_op3(op, static_cast<uint8_t *>(dst), static_cast<const uint8_t *>(a), static_cast<const uint8_t *>(b), count); break;
+    case DType::kI8: dispatch_op3(op, static_cast<int8_t *>(dst), static_cast<const int8_t *>(a), static_cast<const int8_t *>(b), count); break;
+    case DType::kU16: dispatch_op3(op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(a), static_cast<const uint16_t *>(b), count); break;
+    case DType::kI16: dispatch_op3(op, static_cast<int16_t *>(dst), static_cast<const int16_t *>(a), static_cast<const int16_t *>(b), count); break;
+    case DType::kU32: dispatch_op3(op, static_cast<uint32_t *>(dst), static_cast<const uint32_t *>(a), static_cast<const uint32_t *>(b), count); break;
+    case DType::kI32: dispatch_op3(op, static_cast<int32_t *>(dst), static_cast<const int32_t *>(a), static_cast<const int32_t *>(b), count); break;
+    case DType::kU64: dispatch_op3(op, static_cast<uint64_t *>(dst), static_cast<const uint64_t *>(a), static_cast<const uint64_t *>(b), count); break;
+    case DType::kI64: dispatch_op3(op, static_cast<int64_t *>(dst), static_cast<const int64_t *>(a), static_cast<const int64_t *>(b), count); break;
+    case DType::kF16: dispatch_op16_3(false, op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(a), static_cast<const uint16_t *>(b), count); break;
+    case DType::kBF16: dispatch_op16_3(true, op, static_cast<uint16_t *>(dst), static_cast<const uint16_t *>(a), static_cast<const uint16_t *>(b), count); break;
+    case DType::kF32: dispatch_op3(op, static_cast<float *>(dst), static_cast<const float *>(a), static_cast<const float *>(b), count); break;
+    case DType::kF64: dispatch_op3(op, static_cast<double *>(dst), static_cast<const double *>(a), static_cast<const double *>(b), count); break;
     }
 }
 

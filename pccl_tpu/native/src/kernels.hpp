@@ -14,6 +14,12 @@ namespace pcclt::kernels {
 void accumulate(proto::DType dt, proto::RedOp op, void *dst, const void *src,
                 size_t count);
 
+// dst[i] = op(a[i], b[i]) — lets the ring's first accumulation of a chunk
+// combine the local contribution and the received bytes without first
+// memcpy-ing the whole send buffer into recv. dst == a is allowed.
+void accumulate3(proto::DType dt, proto::RedOp op, void *dst, const void *a,
+                 const void *b, size_t count);
+
 // dst[i] = src[i]
 void assign(proto::DType dt, void *dst, const void *src, size_t count);
 

@@ -13,7 +13,7 @@ namespace pcclt::reduce {
 namespace {
 
 constexpr uint64_t kMetaBit = 0x8000;
-constexpr size_t kSubChunk = 1 << 20; // streaming granularity (bytes)
+constexpr size_t kSubChunk = 2 << 20; // streaming granularity (bytes)
 
 struct ChunkSpan {
     size_t start_elem, n_elems;
@@ -53,6 +53,10 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
 Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) {
     const size_t esz = proto::dtype_size(ctx.dtype);
     const uint32_t world = ctx.world, rank = ctx.rank;
+    if (world < 2) { // degenerate ring: the reduction is the input itself
+        if (send != recv) memcpy(recv, send, count * esz);
+        return Result::kOk;
+    }
     auto *out = static_cast<uint8_t *>(recv);
     const bool quantized = ctx.quant != proto::QuantAlgo::kNone;
     const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
@@ -62,6 +66,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // caller also restore after a post-hoc abort verdict)
     std::vector<uint8_t> backup_local;
     const bool in_place = send == recv;
+    // out-of-place unquantized: no upfront copy — stage-0 sends read straight
+    // from `send` and the first accumulation of each chunk is a 3-operand
+    // op(a=send, b=rx) into recv, so the full-buffer memcpy never happens
+    const bool lazy = !in_place && !quantized;
+    const auto *src8 = static_cast<const uint8_t *>(send);
     const uint8_t *restore_src;
     if (in_place) {
         if (ctx.backup) {
@@ -72,9 +81,13 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             restore_src = backup_local.data();
         }
     } else {
-        memcpy(recv, send, count * esz);
-        restore_src = static_cast<const uint8_t *>(send);
+        if (!lazy) memcpy(recv, send, count * esz);
+        restore_src = src8;
     }
+    // NOTE: purge_range below also unregisters any sink still registered for
+    // this op's tags (meta tags included: kMetaBit < 0x10000), waiting out a
+    // busy RX write first — so every fail() exit leaves no sink pointing into
+    // the pooled scratch buffer.
     auto restore = [&] {
         memcpy(recv, restore_src, count * esz);
         ctx.rx->purge_range(base_tag, base_tag + 0x10000);
@@ -85,9 +98,12 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         return conn_lost ? Result::kConnectionLost : Result::kAborted;
     };
 
-    // scratch buffers
+    // scratch buffers (pooled by the caller when possible)
     size_t max_chunk = chunk_of(count, world, 0).n_elems;
-    std::vector<uint8_t> rx_scratch(max_chunk * qsz);
+    std::vector<uint8_t> scratch_local;
+    std::vector<uint8_t> &rx_vec = ctx.scratch ? *ctx.scratch : scratch_local;
+    if (rx_vec.size() < max_chunk * qsz) rx_vec.resize(max_chunk * qsz);
+    uint8_t *rx_scratch = rx_vec.data();
     std::vector<uint8_t> tx_scratch(quantized ? max_chunk * qsz : 0);
 
     // sender thread helper: sends meta (if any) then payload on `tag`
@@ -134,7 +150,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             ctx.tx_bytes += send_span.n_elems * qsz;
 
             // receive peer meta first, then streamed quantized payload
-            ctx.rx->register_sink(tag, rx_scratch.data(), recv_span.n_elems * qsz);
+            ctx.rx->register_sink(tag, rx_scratch, recv_span.n_elems * qsz);
             auto mraw = ctx.rx->recv_queued(tag | kMetaBit, 60'000);
             if (!mraw) {
                 join_tx(tx_job);
@@ -150,7 +166,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                   [&](size_t lo, size_t hi) {
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
                                       quant::dequantize_accumulate(
-                                          rx_meta, ctx.op, rx_scratch.data() + lo,
+                                          rx_meta, ctx.op, rx_scratch + lo,
                                           recv_ptr + e0 * esz, e1 - e0);
                                   });
             ctx.rx->unregister_sink(tag);
@@ -158,16 +174,23 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
         } else {
-            tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});
+            // stage 0 sends the pristine chunk, readable from `send` directly;
+            // later stages send chunks accumulated into recv at stage s-1
+            const uint8_t *tx_ptr =
+                (lazy && s == 0) ? src8 + send_span.start_elem * esz : send_ptr;
+            tx_job = launch_tx(tag, {}, {tx_ptr, send_span.n_elems * esz});
             ctx.tx_bytes += send_span.n_elems * esz;
-            ctx.rx->register_sink(tag, rx_scratch.data(), recv_span.n_elems * esz);
+            const uint8_t *local_ptr =
+                lazy ? src8 + recv_span.start_elem * esz : recv_ptr;
+            ctx.rx->register_sink(tag, rx_scratch, recv_span.n_elems * esz);
             bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
                                   [&](size_t lo, size_t hi) {
                                       size_t e0 = lo / esz, e1 = hi / esz;
-                                      kernels::accumulate(ctx.dtype, ctx.op,
-                                                          recv_ptr + e0 * esz,
-                                                          rx_scratch.data() + lo,
-                                                          e1 - e0);
+                                      kernels::accumulate3(ctx.dtype, ctx.op,
+                                                           recv_ptr + e0 * esz,
+                                                           local_ptr + e0 * esz,
+                                                           rx_scratch + lo,
+                                                           e1 - e0);
                                   });
             ctx.rx->unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
@@ -206,7 +229,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             tx_job = launch_tx(tag, fwd_meta, fwd_q);
             ctx.tx_bytes += fwd_q.size();
 
-            ctx.rx->register_sink(tag, rx_scratch.data(), recv_span.n_elems * qsz);
+            ctx.rx->register_sink(tag, rx_scratch, recv_span.n_elems * qsz);
             auto mraw = ctx.rx->recv_queued(tag | kMetaBit, 60'000);
             if (!mraw) {
                 join_tx(tx_job);
@@ -220,7 +243,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz,
                                   [&](size_t lo, size_t hi) {
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
-                                      quant::dequantize_set(*m, rx_scratch.data() + lo,
+                                      quant::dequantize_set(*m, rx_scratch + lo,
                                                             recv_ptr + e0 * esz, e1 - e0);
                                   });
             ctx.rx->unregister_sink(tag);
@@ -228,7 +251,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
             // forward what we received on the next stage
-            fwd_q.assign(rx_scratch.data(), rx_scratch.data() + recv_span.n_elems * qsz);
+            fwd_q.assign(rx_scratch, rx_scratch + recv_span.n_elems * qsz);
             fwd_meta = mraw.value();
         } else {
             tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});

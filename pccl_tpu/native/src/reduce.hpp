@@ -31,6 +31,10 @@ struct RingCtx {
     // restores from it on abort instead of making its own backup — the caller
     // can then also restore after a post-hoc abort verdict from the master.
     const uint8_t *backup = nullptr;
+    // optional caller-pooled receive scratch: a fresh per-op vector would be
+    // page-zeroed by the kernel on every reduce (~ms per 32 MiB), so the
+    // client keeps a reuse pool and lends a buffer for the op's lifetime
+    std::vector<uint8_t> *scratch = nullptr;
     uint64_t tx_bytes = 0, rx_bytes = 0;
 };
 

@@ -130,6 +130,13 @@ void Socket::set_nodelay() {
     setsockopt(fd_.load(), IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
 }
 
+void Socket::set_bufsizes(int bytes) {
+    int fd = fd_.load();
+    if (fd < 0) return;
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof bytes);
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof bytes);
+}
+
 void Socket::set_keepalive(int idle_s) {
     int fd = fd_.load();
     if (fd < 0) return;
@@ -159,9 +166,16 @@ bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
     memcpy(hdr, &be_len, 4);
     memcpy(hdr + 4, &be_type, 2);
     std::lock_guard lk(write_mu);
+    // small frames go out in one send: two back-to-back small writes would
+    // otherwise interact badly with Nagle/delayed-ACK on control sockets
+    if (payload.size() <= 64 << 10) {
+        uint8_t buf[6 + (64 << 10)];
+        memcpy(buf, hdr, 6);
+        if (!payload.empty()) memcpy(buf + 6, payload.data(), payload.size());
+        return s.send_all(buf, 6 + payload.size());
+    }
     if (!s.send_all(hdr, 6)) return false;
-    if (!payload.empty() && !s.send_all(payload.data(), payload.size())) return false;
-    return true;
+    return s.send_all(payload.data(), payload.size());
 }
 
 // single implementation: timeout_ms < 0 blocks forever (plain recv_all),
@@ -243,7 +257,11 @@ void Listener::run_async(std::function<void(Socket)> on_accept) {
             if (rc <= 0) continue;
             int cfd = ::accept(fd_, nullptr, nullptr);
             if (cfd < 0) continue;
-            on_accept(Socket(cfd));
+            Socket s(cfd);
+            // accepted sockets carry small control replies (commence/abort/
+            // done); without NODELAY those hit Nagle+delayed-ACK stalls
+            s.set_nodelay();
+            on_accept(std::move(s));
         }
     });
 }

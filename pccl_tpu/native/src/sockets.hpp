@@ -63,6 +63,9 @@ public:
     bool recv_all(void *data, size_t n);
     // recv with timeout; returns bytes read (0 on orderly close), -1 error, -2 timeout
     ssize_t recv_some(void *data, size_t n, int timeout_ms);
+    // SO_SNDBUF/SO_RCVBUF — large buffers keep the p2p data plane streaming
+    // with fewer scheduler round-trips (matters on low-core-count hosts)
+    void set_bufsizes(int bytes);
     void shutdown(); // wake up blocked recv
     void close();
     bool valid() const { return fd_ >= 0; }
@@ -150,7 +153,7 @@ public:
 
     // TX: splits into sub-frames of `chunk` bytes; blocking; thread-safe.
     bool send_bytes(uint64_t tag, uint64_t seq, std::span<const uint8_t> data,
-                    size_t chunk = 1 << 20);
+                    size_t chunk = 4 << 20);
 
     // Zero-copy RX: register a sink; RX thread appends payloads for `tag`
     // in arrival order starting at base. wait_filled blocks until >= min
