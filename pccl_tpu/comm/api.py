@@ -376,7 +376,7 @@ class Communicator:
                                                  ctypes.byref(handle)))
         self._h = handle
         self._tag_lock = threading.Lock()
-        self._next_tag = 1
+        self._next_tag = self._AUTO_TAG_BASE
 
     # -- lifecycle --
 
@@ -429,6 +429,11 @@ class Communicator:
 
     # -- collectives --
 
+    # auto tags live in a high band so they can never collide with the small
+    # deterministic tags used by blocking all_reduce (0) and
+    # all_reduce_multiple_with_retry (0..n-1) or typical user-chosen tags
+    _AUTO_TAG_BASE = 1 << 32
+
     def _auto_tag(self) -> int:
         with self._tag_lock:
             t = self._next_tag
@@ -457,14 +462,18 @@ class Communicator:
         return send, recv
 
     def all_reduce(self, send, recv=None, *, op: ReduceOp = ReduceOp.SUM,
-                   tag: Optional[int] = None,
+                   tag: int = 0,
                    quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
                    quantized_dtype: DataType = DataType.UINT8) -> ReduceInfo:
         """Blocking ring all-reduce. recv=None → in place. Raises
-        ConnectionLostError / OperationAbortedError on peer churn."""
+        ConnectionLostError / OperationAbortedError on peer churn.
+
+        The tag identifies the op ACROSS peers: every group member must call
+        with the same tag for the op to commence (reference descriptor tags).
+        The default tag 0 is stable, so late joiners match incumbents; pass
+        distinct explicit tags only for concurrent reduces."""
         send, recv = self._buffers(send, recv)
-        desc = ReduceDescriptor(tag if tag is not None else self._auto_tag(), op,
-                                quantization, quantized_dtype)._as_c()
+        desc = ReduceDescriptor(tag, op, quantization, quantized_dtype)._as_c()
         info = _native.ReduceInfo()
         code = self._lib.pccltAllReduce(
             self._h, send.ctypes.data_as(ctypes.c_void_p),
@@ -477,6 +486,10 @@ class Communicator:
                          tag: Optional[int] = None,
                          quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
                          quantized_dtype: DataType = DataType.UINT8) -> AsyncReduceHandle:
+        """Async variant. tag=None auto-allocates a locally increasing tag —
+        fine for a static world, but under dynamic membership every peer must
+        pass the SAME explicit tag per op or the group cannot reach consensus
+        (see all_reduce)."""
         send, recv = self._buffers(send, recv)
         tag = tag if tag is not None else self._auto_tag()
         desc = ReduceDescriptor(tag, op, quantization, quantized_dtype)._as_c()
@@ -515,8 +528,9 @@ class Communicator:
         counts = (ctypes.c_uint64 * n)(*[a.size for a in arrs])
         descs = (_native.ReduceDescriptor * n)()
         for i in range(n):
-            d = ReduceDescriptor(self._auto_tag(), op, quantization,
-                                 quantized_dtype)._as_c()
+            # deterministic tags (the tensor index): peers match ops by tag,
+            # and a late joiner's counter must not drift from incumbents'
+            d = ReduceDescriptor(i, op, quantization, quantized_dtype)._as_c()
             descs[i] = d
         infos = (_native.ReduceInfo * n)()
         code = self._lib.pccltAllReduceMultipleWithRetry(

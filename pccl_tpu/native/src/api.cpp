@@ -219,8 +219,14 @@ pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const 
             if (done[i]) continue;
             auto st = c->client->all_reduce_async(sendbufs[i], recvbufs[i], counts[i],
                                                   to_dtype(dtype), to_desc(&descs[i]));
-            if (st == Status::kTooFewPeers) return pccltTooFewPeers;
-            if (st != Status::kOk) return to_result(st);
+            if (st != Status::kOk) {
+                // await whatever we already launched this round — returning
+                // with in-flight ops would leave workers referencing caller
+                // buffers and their tags permanently "duplicate"
+                for (uint64_t j = 0; j < i; ++j)
+                    if (!done[j]) c->client->await_reduce(descs[j].tag, nullptr);
+                return st == Status::kTooFewPeers ? pccltTooFewPeers : to_result(st);
+            }
             any_launched = true;
         }
         if (!any_launched) return pccltSuccess;

@@ -1,0 +1,107 @@
+"""Fault-tolerance test peer (subprocess worker).
+
+Reference parity: the stress-test peers of the reference
+(/root/reference/python/tests/stress_tests/basic_stress_test/stresstest_peer.py)
+— loop collectives, print heartbeats, optionally die mid-run; the
+orchestrating test watches stdout and asserts survivors keep making progress.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-port", type=int, required=True)
+    ap.add_argument("--rank", type=int, default=0,
+                    help="label for heartbeat lines (ports come from --base-port)")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--min-world", type=int, default=2)
+    ap.add_argument("--join-delay", type=float, default=0.0)
+    ap.add_argument("--die-at", type=int, default=-1,
+                    help="exit(0) abruptly before this step (simulated crash)")
+    ap.add_argument("--base-port", type=int, required=True)
+    ap.add_argument("--count", type=int, default=4096)
+    ap.add_argument("--step-interval", type=float, default=0.0,
+                    help="sleep between steps (paces incumbents so churn "
+                         "events land mid-run)")
+    args = ap.parse_args()
+
+    if args.join_delay > 0:
+        time.sleep(args.join_delay)
+
+    from pccl_tpu.comm import (
+        Communicator,
+        ConnectionLostError,
+        OperationAbortedError,
+        ReduceOp,
+        TooFewPeersError,
+    )
+
+    comm = Communicator("127.0.0.1", args.master_port,
+                        p2p_port=args.base_port, ss_port=args.base_port + 4,
+                        bench_port=args.base_port + 8)
+    comm.connect()
+    deadline = time.time() + 60
+    while comm.world_size < args.min_world:
+        if time.time() > deadline:
+            print("TIMEOUT waiting for world", flush=True)
+            return 2
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+
+    x = np.ones(args.count, dtype=np.float32)
+    y = np.empty_like(x)
+    step = 0
+    while step < args.steps:
+        if args.die_at >= 0 and step >= args.die_at:
+            # simulated crash: no destroy(), no goodbye — the master must
+            # detect the dead TCP connection and abort our running ops
+            print(f"DYING at step {step}", flush=True)
+            sys.stdout.flush()
+            import os
+
+            os._exit(0)
+        # admit pending joiners between steps (reference update-topology loop)
+        try:
+            if comm.are_peers_pending():
+                comm.update_topology()
+        except Exception:  # noqa: BLE001 — churn mid-vote; retry next loop
+            time.sleep(0.05)
+            continue
+        try:
+            info = comm.all_reduce(x, y, op=ReduceOp.SUM)
+        except (ConnectionLostError, OperationAbortedError):
+            try:
+                comm.update_topology()
+            except Exception:  # noqa: BLE001
+                time.sleep(0.05)
+            continue
+        except TooFewPeersError:
+            # alone: everyone else died or left; count as progress
+            y[:] = x
+            info = None
+        world = info.world_size if info is not None else 1
+        if info is not None and abs(float(y[0]) - world) > 1e-5:
+            print(f"WRONG RESULT step={step} y={y[0]} world={world}", flush=True)
+            return 3
+        print(f"STEP {step} world={world} rank={args.rank}", flush=True)
+        step += 1
+        if args.step_interval > 0:
+            time.sleep(args.step_interval)
+    comm.destroy()
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
