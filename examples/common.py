@@ -1,0 +1,111 @@
+"""Shared plumbing for the example training loops.
+
+Reference parity: the reference examples (/root/reference/python/examples/
+nanogptddp/train_pccl.py, nanogpt_diloco/sync_diloco.py) share the same
+skeleton — connect to the master, wait for the world, per-step topology
+updates, retry on churn. Here that skeleton is TPU-first: every peer process
+is one "slice" running a jitted SPMD step over its local device mesh, and
+only the cross-slice hop rides the TCP ring.
+
+The dataset is synthetic (zero-egress environment): token t+1 is an affine
+function of token t plus rare noise, so next-token loss falls fast and
+convergence is assertable in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+def add_comm_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--master-ip", default="127.0.0.1")
+    ap.add_argument("--master-port", type=int, default=48500)
+    ap.add_argument("--base-port", type=int, default=56000,
+                    help="p2p/shared-state/bench listen ports (bump-allocated)")
+    ap.add_argument("--min-world", type=int, default=1,
+                    help="wait until this many peers joined before training")
+    ap.add_argument("--peer-group", type=int, default=0)
+    ap.add_argument("--solo", action="store_true",
+                    help="run without a comm (single slice, no master)")
+
+
+def connect(args):
+    """Create + connect a Communicator and wait for --min-world peers.
+    Returns None under --solo."""
+    if args.solo:
+        return None
+    from pccl_tpu.comm import Communicator
+
+    comm = Communicator(args.master_ip, args.master_port,
+                        peer_group=args.peer_group,
+                        p2p_port=args.base_port, ss_port=args.base_port + 4,
+                        bench_port=args.base_port + 8)
+    comm.connect()
+    deadline = time.time() + 120
+    while comm.world_size < args.min_world:
+        if time.time() > deadline:
+            raise TimeoutError(f"world never reached {args.min_world}")
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+    return comm
+
+
+def admit_pending(comm) -> None:
+    """Between-steps topology vote (reference update-topology loop)."""
+    if comm is not None and comm.are_peers_pending():
+        comm.update_topology()
+
+
+def synth_batch(rng: np.random.RandomState, batch: int, block: int,
+                vocab: int):
+    """Learnable synthetic LM data: x[t+1] = (5*x[t] + 7) % vocab, with 5%
+    uniform noise. Returns (tokens, targets) int32 [B, T]."""
+    x = np.empty((batch, block + 1), dtype=np.int64)
+    x[:, 0] = rng.randint(0, vocab, size=batch)
+    for t in range(block):
+        x[:, t + 1] = (5 * x[:, t] + 7) % vocab
+    noise = rng.rand(batch, block + 1) < 0.05
+    x[noise] = rng.randint(0, vocab, size=int(noise.sum()))
+    return x[:, :-1].astype(np.int32), x[:, 1:].astype(np.int32)
+
+
+def quant_from_arg(name: str):
+    """Map the --quantize CLI choice to a QuantizationAlgorithm."""
+    from pccl_tpu.comm import QuantizationAlgorithm
+
+    return {"none": QuantizationAlgorithm.NONE,
+            "minmax": QuantizationAlgorithm.MIN_MAX,
+            "zps": QuantizationAlgorithm.ZERO_POINT_SCALE}[name]
+
+
+def data_rng(args) -> np.random.RandomState:
+    """Per-peer data shard: seeded off the peer's unique base port."""
+    return np.random.RandomState(1000 + (args.base_port % 997))
+
+
+def report_final(first_loss: float, last_loss: float, comm) -> int:
+    """Print the FINAL line (parsed by tests/test_examples_e2e.py) and
+    return the process exit code (0 = loss decreased)."""
+    print(f"FINAL first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
+          flush=True)
+    if comm is not None:
+        comm.destroy()
+    return 0 if last_loss < first_loss else 4
+
+
+def force_cpu_if_requested() -> None:
+    """Honor JAX_PLATFORMS even when a TPU plugin tries to override it
+    (must run before first jax backend use)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass

@@ -1,0 +1,122 @@
+"""nanoGPT DDP over the WAN ring — per-step gradient averaging.
+
+Reference parity: /root/reference/python/examples/nanogptddp/train_pccl.py
+(torch DDP loop with pcclAllReduce per step). TPU-first redesign:
+
+- each peer process is one SLICE: the train step is a jitted SPMD program
+  over the local device mesh (dp x tp — pass --tp for in-slice tensor
+  parallelism; this is the reference's FSDP x PCCL grid pattern,
+  docs/md/8_CommonFootguns.md, with XLA sharding in place of FSDP);
+- per-step gradients cross the ring as ONE flat fp32 vector
+  (HierarchicalAllReduce: ICI in-jit, TCP across slices) with optional
+  on-the-wire quantization (--quantize minmax);
+- peer churn: ConnectionLost/Aborted -> update_topology -> retry, and
+  pending joiners are admitted between steps.
+
+Run (2 peers on loopback):
+    python -m pccl_tpu.comm.master --port 48500 &
+    python examples/nanogpt_ddp/train_ddp.py --master-port 48500 \
+        --base-port 56000 --min-world 2 --steps 50 &
+    python examples/nanogpt_ddp/train_ddp.py --master-port 48500 \
+        --base-port 56100 --min-world 2 --steps 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import numpy as np
+
+import common
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    common.add_comm_args(ap)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--block", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--tp", type=int, default=0,
+                    help="in-slice tensor-parallel degree (0 = auto mesh)")
+    ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    common.force_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pccl_tpu.comm import DataType
+    from pccl_tpu.models import gpt
+    from pccl_tpu.parallel import mesh as mesh_lib
+    from pccl_tpu.parallel.hierarchical import HierarchicalAllReduce
+
+    comm = common.connect(args)
+
+    # --- in-slice SPMD setup ---
+    devices = jax.devices()
+    if args.tp > 0:
+        shape = (max(1, len(devices) // args.tp), args.tp)
+        mesh = mesh_lib.make_mesh(devices[: shape[0] * shape[1]], ("dp", "tp"),
+                                  shape)
+    else:
+        mesh = mesh_lib.make_mesh(devices, ("dp", "tp"))
+    cfg = gpt.tiny_config(vocab_size=256, n_layer=2, n_head=4, n_embd=64,
+                          block_size=args.block)
+    param_sharding = mesh_lib.gpt_param_sharding(mesh)
+    data_sharding = mesh_lib.batch_sharding(mesh)
+
+    init = jax.jit(gpt.init_params, static_argnames=("cfg",),
+                   out_shardings=param_sharding)
+    params = init(jax.random.PRNGKey(args.seed), cfg)
+    tx = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = tx.init(params)
+
+    loss_and_grad = jax.jit(
+        jax.value_and_grad(functools.partial(gpt.loss_fn, cfg=cfg)),
+        in_shardings=(param_sharding, data_sharding, data_sharding),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1),
+                       out_shardings=(param_sharding, None))
+    def apply(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    # --- cross-slice gradient averaging ---
+    # params serve as the gradient template: same shapes/dtypes/shardings
+    ring = HierarchicalAllReduce(comm, params,
+                                 quantization=common.quant_from_arg(args.quantize),
+                                 quantized_dtype=DataType.UINT8)
+
+    rng = common.data_rng(args)  # per-peer data shard
+    first_loss = last_loss = None
+    for step in range(args.steps):
+        common.admit_pending(comm)
+        tok, tgt = common.synth_batch(rng, args.batch, args.block,
+                                      cfg.vocab_size)
+        tok = jax.device_put(jnp.asarray(tok), data_sharding)
+        tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
+        loss, grads = loss_and_grad(params, tok, tgt)
+        grads = ring.all_reduce(grads)  # global mean (identity when solo)
+        params, opt_state = apply(params, opt_state, grads)
+        loss = float(loss)
+        first_loss = first_loss if first_loss is not None else loss
+        last_loss = loss
+        world = comm.world_size if comm is not None else 1
+        print(f"step {step} loss {loss:.4f} world {world}", flush=True)
+
+    return common.report_final(first_loss, last_loss, comm)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Sync DiLoCo on nanoGPT — H local steps, then one outer reduce.
+
+Reference parity: /root/reference/python/examples/nanogpt_diloco/
+sync_diloco.py (torch inner AdamW + outer Nesterov SGD on pseudo-gradients,
+shared-state revision per outer step, late joiners catch up via
+sync_shared_state). TPU-first: the inner loop is a jitted SPMD step over the
+local mesh (pccl_tpu.parallel.train); only one flat fp32 pseudo-gradient
+vector crosses the ring per outer step, optionally quantized.
+
+Run (2 peers):
+    python -m pccl_tpu.comm.master --port 48500 &
+    python examples/nanogpt_diloco/sync_diloco.py --master-port 48500 \
+        --base-port 56000 --min-world 2 &
+    python examples/nanogpt_diloco/sync_diloco.py --master-port 48500 \
+        --base-port 56100 --min-world 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import numpy as np
+
+import common
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    common.add_comm_args(ap)
+    ap.add_argument("--outer-steps", type=int, default=8)
+    ap.add_argument("--inner-steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--block", type=int, default=64)
+    ap.add_argument("--inner-lr", type=float, default=1e-3)
+    ap.add_argument("--outer-lr", type=float, default=0.7)
+    ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    common.force_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.comm import DataType
+    from pccl_tpu.models import gpt
+    from pccl_tpu.parallel import mesh as mesh_lib, train as train_lib
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+
+    comm = common.connect(args)
+
+    mesh = mesh_lib.make_mesh(jax.devices(), ("dp", "tp"))
+    cfg = gpt.tiny_config(vocab_size=256, n_layer=2, n_head=4, n_embd=64,
+                          block_size=args.block)
+    params, tx, opt_state = train_lib.make_train_state(
+        jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.inner_lr)
+    step_fn = train_lib.build_train_step(cfg, tx, mesh)
+    data_sharding = mesh_lib.batch_sharding(mesh)
+
+    dl = Diloco(comm, params,
+                DilocoConfig(inner_steps=args.inner_steps,
+                             outer_lr=args.outer_lr,
+                             quantization=common.quant_from_arg(args.quantize),
+                             quantized_dtype=DataType.UINT8))
+
+    rng = common.data_rng(args)
+    first_loss = last_loss = None
+    for outer in range(args.outer_steps):
+        common.admit_pending(comm)
+        for _ in range(args.inner_steps):
+            tok, tgt = common.synth_batch(rng, args.batch, args.block,
+                                          cfg.vocab_size)
+            tok = jax.device_put(jnp.asarray(tok), data_sharding)
+            tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
+            params, opt_state, loss = step_fn(params, opt_state, tok, tgt)
+        params = dl.outer_step(params)  # ring AVG of pseudo-grads + outer SGD
+        loss = float(loss)
+        first_loss = first_loss if first_loss is not None else loss
+        last_loss = loss
+        world = comm.world_size if comm is not None else 1
+        print(f"outer {outer} loss {loss:.4f} world {world} "
+              f"revision {dl.step}", flush=True)
+
+    return common.report_final(first_loss, last_loss, comm)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

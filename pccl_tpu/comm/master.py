@@ -1,0 +1,42 @@
+"""Standalone master runner: ``python -m pccl_tpu.comm.master --port 48500``.
+
+Reference parity: the reference ships both a ccoip_master binary
+(/root/reference/ccoip_master/src/main.cpp) and a python master wrapper
+(/root/reference/python/framework/pccl/master.py). The native equivalent
+binary here is pccl_tpu/native/build/pcclt_master; this module is the
+python-side runner for environments that only have the shared library.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from .api import MasterNode
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="pccl_tpu master node")
+    ap.add_argument("--listen", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=48500)
+    args = ap.parse_args()
+
+    m = MasterNode(args.listen, args.port)
+    m.run()
+    print(f"master listening on {args.listen}:{m.port}", flush=True)
+
+    # sigwait instead of a signal handler: a handler would never run while
+    # the main thread is blocked inside the foreign await_termination call
+    # (ctypes pthread join), so Ctrl-C would hang the process. The signals
+    # must be blocked first or their default disposition still terminates.
+    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    m.interrupt()
+    m.await_termination()
+    m.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,7 +74,8 @@ class Diloco:
         dl = Diloco(comm, params, cfg)
         while training:
             comm.update_topology()                 # admit joiners
-            dl.maybe_join_shared_state()           # catch up if outdated
+            dl.sync_shared_state()                 # catch up if outdated
+            params = dl.params()                   # donation-safe copy
             for _ in range(cfg.inner_steps):
                 params, opt_state, loss = inner_step(params, opt_state, ...)
             params = dl.outer_step(params)         # WAN ring + outer SGD
@@ -93,8 +94,10 @@ class Diloco:
         # leaf shardings of the template, reapplied after every unflatten so
         # outer params keep the caller's TP/DP layout
         self._shardings = codec.leaf_shardings(params)
-        # outer params live on device; momentum buffer too
-        self.outer_params = jax.tree.map(lambda x: x, params)
+        # outer params live on device as PRIVATE copies: the caller's train
+        # step typically donates its param buffers (train.build_train_step
+        # uses donate_argnums), which would delete aliased arrays under us
+        self.outer_params = jax.tree.map(jnp.copy, params)
         self._momentum_vec = jnp.zeros((self.count,), jnp.float32)
 
         lr, mu, nesterov = cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
@@ -108,6 +111,11 @@ class Diloco:
 
     # -- the outer step --
 
+    def params(self) -> Any:
+        """Fresh copy of the current outer params, safe to hand to a
+        donating train step (the driver keeps its own private buffers)."""
+        return jax.tree.map(jnp.copy, self.outer_params)
+
     def _restore_shardings(self, tree: Any) -> Any:
         return codec.restore_shardings(tree, self._shardings)
 
@@ -120,7 +128,10 @@ class Diloco:
 
     def outer_step(self, inner_params: Any) -> Any:
         """Average pseudo-gradients across peers, apply outer Nesterov SGD,
-        return the new global params (device pytree)."""
+        return the new global params (device pytree).
+
+        The returned tree is a fresh copy safe to hand to a donating train
+        step; the driver keeps its own buffers for the next pseudo-gradient."""
         delta = self._delta_fn(self.outer_params, inner_params)
         host = np.array(jax.device_get(delta), dtype=np.float32)
         if self.comm is not None:
@@ -130,7 +141,7 @@ class Diloco:
             outer_vec, self._momentum_vec, jnp.asarray(host))
         self.outer_params = self._restore_shardings(self._unflat_fn(new_vec))
         self.step += 1
-        return self.outer_params
+        return jax.tree.map(jnp.copy, self.outer_params)
 
     # -- shared state --
 
@@ -154,8 +165,9 @@ class Diloco:
             strategy: SharedStateSyncStrategy = SharedStateSyncStrategy.ENFORCE_POPULAR):
         """Sync outer state with the group; adopt whatever wins the election
         into self.outer_params / momentum / step. Returns the
-        SharedStateSyncInfo (tx/rx bytes, revision); read the adopted params
-        from self.outer_params."""
+        SharedStateSyncInfo (tx/rx bytes, revision); take the adopted params
+        via self.params() — a donation-safe copy, NOT self.outer_params,
+        which aliases the driver's private buffers."""
         assert self.comm is not None
         st = self.shared_state()
         info = self.comm.sync_shared_state(st, strategy)
@@ -225,7 +237,8 @@ class AsyncDiloco(Diloco):
                                           daemon=True)
         self._inflight.start()
         self._baseline = self.outer_params
-        return self.outer_params
+        # fresh copy: the caller's train step may donate what we return
+        return jax.tree.map(jnp.copy, self.outer_params)
 
     def sync_shared_state(
             self,
@@ -240,6 +253,7 @@ class AsyncDiloco(Diloco):
         return info
 
     def finish(self) -> Any:
-        """Join any in-flight reduce and apply it; returns final outer params."""
+        """Join any in-flight reduce and apply it; returns final outer params
+        (fresh copy, donation-safe)."""
         self._join_inflight()
-        return self.outer_params
+        return jax.tree.map(jnp.copy, self.outer_params)
