@@ -147,3 +147,6 @@ def _declare(lib):
     lib.pccltSynchronizeSharedState.restype = c.c_int
     lib.pccltSynchronizeSharedState.argtypes = [c.c_void_p, P(SharedStateC), c.c_int,
                                                 P(SharedStateSyncInfo)]
+
+    lib.pccltHashBuffer.restype = c.c_uint64
+    lib.pccltHashBuffer.argtypes = [c.c_int, c.c_void_p, c.c_uint64]

@@ -174,6 +174,12 @@ PCCLT_EXPORT pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c,
                                                        pccltSyncStrategy_t strategy,
                                                        pccltSharedStateSyncInfo_t *info);
 
+/* Content hash used for shared-state drift detection (reference
+ * ccoip_hash_type_t). hash_type: 0 = simplehash (default), 1 = CRC32.
+ * Exposed so bindings/tools can verify bit parity with the Python twin. */
+PCCLT_EXPORT uint64_t pccltHashBuffer(int hash_type, const void *data,
+                                      uint64_t nbytes);
+
 #ifdef __cplusplus
 }
 #endif

@@ -7,6 +7,7 @@
 #include <vector>
 
 #include "client.hpp"
+#include "hash.hpp"
 #include "log.hpp"
 #include "master.hpp"
 
@@ -250,6 +251,11 @@ pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const 
         if (st != Status::kOk) return to_result(st);
         if (c->client->group_world() < 2) return pccltTooFewPeers;
     }
+}
+
+uint64_t pccltHashBuffer(int hash_type, const void *data, uint64_t nbytes) {
+    auto t = hash_type == 1 ? pcclt::hash::Type::kCrc32 : pcclt::hash::Type::kSimple;
+    return pcclt::hash::content_hash(t, data, nbytes);
 }
 
 pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *state,

@@ -669,6 +669,9 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
         dist_entries_.clear();
     };
 
+    // hoisted: one env read per sync, so request-time and verify-time hashes
+    // always use the same algorithm even if the env changes mid-sync
+    const hash::Type hash_type = hash::type_from_env();
     proto::SharedStateSyncC2M req;
     req.revision = revision;
     req.strategy = strategy;
@@ -680,7 +683,8 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
         m.allow_content_inequality = e.allow_content_inequality ? 1 : 0;
         m.hash = e.allow_content_inequality
                      ? 0
-                     : hash::simplehash(e.data, e.count * proto::dtype_size(e.dtype));
+                     : hash::content_hash(hash_type, e.data,
+                                          e.count * proto::dtype_size(e.dtype));
         req.entries.push_back(std::move(m));
     }
     if (!master_.send(PacketType::kC2MSharedStateSync, req.encode())) {
@@ -750,7 +754,8 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
                                 // verify against the mask's expected hash
                                 for (size_t k = 0; k < resp->outdated_keys.size(); ++k) {
                                     if (resp->outdated_keys[k] != name) continue;
-                                    uint64_t h = hash::simplehash(target->data, nbytes);
+                                    uint64_t h = hash::content_hash(
+                                        hash_type, target->data, nbytes);
                                     if (h != resp->expected_hashes[k])
                                         st = Status::kContentMismatch;
                                 }

@@ -1,7 +1,12 @@
 #include "hash.hpp"
 
 #include <array>
+#include <bit>
+#include <cstdlib>
 #include <cstring>
+#include <string_view>
+
+#include "log.hpp"
 
 namespace pcclt::hash {
 
@@ -21,18 +26,25 @@ uint64_t simplehash(const void *data, size_t nbytes) {
     std::array<uint64_t, kLanes> lane;
     lane.fill(kSeed);
 
+    // words are DEFINED as little-endian (the Python twin uses "<u4");
+    // byteswap on big-endian hosts so digests stay device-independent
+    auto le_word = [](uint32_t w) {
+        if constexpr (std::endian::native == std::endian::big)
+            w = __builtin_bswap32(w);
+        return w;
+    };
     size_t full_words = nbytes / 4;
     for (size_t i = 0; i < full_words; ++i) {
         uint32_t w;
-        memcpy(&w, bytes + i * 4, 4);  // little-endian word load
+        memcpy(&w, bytes + i * 4, 4);
         size_t l = i % kLanes;
-        lane[l] = lane[l] * kP + w;
+        lane[l] = lane[l] * kP + le_word(w);
     }
     if (full_words != nwords) { // zero-padded tail word
         uint32_t w = 0;
         memcpy(&w, bytes + full_words * 4, nbytes - full_words * 4);
         size_t l = full_words % kLanes;
-        lane[l] = lane[l] * kP + w;
+        lane[l] = lane[l] * kP + le_word(w);
     }
 
     uint64_t acc = kSeed ^ (static_cast<uint64_t>(nbytes) * kQ);
@@ -58,6 +70,23 @@ struct Crc32Tables {
 };
 
 } // namespace
+
+uint64_t content_hash(Type t, const void *data, size_t nbytes) {
+    switch (t) {
+    case Type::kCrc32: return crc32(data, nbytes);
+    case Type::kSimple: break;
+    }
+    return simplehash(data, nbytes);
+}
+
+Type type_from_env() {
+    const char *v = std::getenv("PCCLT_SS_HASH");
+    if (!v || std::string_view(v) == "simple") return Type::kSimple;
+    if (std::string_view(v) == "crc32") return Type::kCrc32;
+    PLOG(kWarn) << "unknown PCCLT_SS_HASH value \"" << v
+                << "\" (expected \"simple\" or \"crc32\"); using simplehash";
+    return Type::kSimple;
+}
 
 uint32_t crc32(const void *data, size_t nbytes, uint32_t crc) {
     static const Crc32Tables tbl;

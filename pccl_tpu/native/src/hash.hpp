@@ -31,6 +31,15 @@ uint64_t simplehash(const void *data, size_t nbytes);
 // CRC32 (IEEE 802.3, reflected, poly 0xEDB88320) — matches zlib.crc32.
 uint32_t crc32(const void *data, size_t nbytes, uint32_t crc = 0);
 
+// Selectable shared-state hash (reference ccoip_hash_type_t,
+// ccoip_types.hpp:27-30 — the reference also defaults to simplehash).
+// All peers of a group must agree on the type; it is configured via the
+// PCCLT_SS_HASH env var ("simple" | "crc32"), mirroring the reference where
+// the choice is internal rather than per-call.
+enum class Type : uint8_t { kSimple = 0, kCrc32 = 1 };
+uint64_t content_hash(Type t, const void *data, size_t nbytes);
+Type type_from_env();
+
 uint64_t avalanche64(uint64_t x); // exposed for the Python twin's tests
 
 } // namespace pcclt::hash

@@ -1,0 +1,106 @@
+"""Device-independent content hashing — the Python twin of the native hash.
+
+Reference parity: the reference's simplehash deliberately makes its CPU
+implementation emulate the CUDA grid (256-thread blocks, warp shuffles) so
+CPU and GPU produce identical digests (/root/reference/ccoip/src/cpp/
+simplehash/simplehash_cpu.cpp:7-58) — bit parity across devices is the core
+invariant of shared-state drift detection.
+
+TPU-first re-design (matches pccl_tpu/native/src/hash.cpp exactly): bytes →
+little-endian u32 words (zero-padded tail); word i feeds lane (i % 256) via
+Horner with P; lanes combine with a second Horner pass with Q, seeded with
+the byte length; murmur-style avalanche finalizes. The lane structure means
+the whole digest is expressible as vectorized numpy over a [n_chunks, 256]
+word matrix — no per-element Python loop — and the SAME digest is reproduced
+by the C++ core (pccltHashBuffer), so a TPU host process can hash staged HBM
+bytes wherever convenient and compare against any peer.
+
+CRC32 (hash type 1) needs no twin: the native implementation matches
+zlib.crc32 (IEEE reflected polynomial).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LANES = 256
+P = np.uint64(0x100000001B3)          # FNV-1a prime
+Q = np.uint64(0x9E3779B97F4A7C15)     # 2^64 / phi
+SEED = np.uint64(0xCBF29CE484222325)  # FNV offset basis
+_M1 = np.uint64(0xFF51AFD7ED558CCD)
+_M2 = np.uint64(0xC4CEB9FE1A85EC53)
+
+
+_BLOCK = 4096  # full rows folded per vectorized step
+
+
+def _p_powers(n: int) -> np.ndarray:
+    """P^0..P^n with uint64 wraparound, computed once at import."""
+    with np.errstate(over="ignore"):
+        pows = np.empty(n + 1, dtype=np.uint64)
+        pows[0] = np.uint64(1)
+        for i in range(1, n + 1):
+            pows[i] = pows[i - 1] * P
+    return pows
+
+
+_P_POWS = _p_powers(_BLOCK)
+
+
+def _avalanche64(x: np.uint64) -> np.uint64:
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(33)
+        x *= _M1
+        x ^= x >> np.uint64(33)
+        x *= _M2
+        x ^= x >> np.uint64(33)
+    return x
+
+
+def simplehash(buf) -> int:
+    """Digest of a bytes-like / ndarray's raw content. Bit-identical to the
+    native pcclt::hash::simplehash."""
+    if isinstance(buf, np.ndarray):
+        data = np.ascontiguousarray(buf).view(np.uint8).reshape(-1)
+    else:
+        data = np.frombuffer(memoryview(buf), dtype=np.uint8)
+    nbytes = data.size
+
+    n_words = (nbytes + 3) // 4
+    padded = np.zeros(((n_words + LANES - 1) // LANES) * LANES * 4,
+                      dtype=np.uint8)
+    padded[:nbytes] = data
+    words = padded.view("<u4").astype(np.uint64).reshape(-1, LANES)
+
+    # lane[l] = Horner over its word column. Full rows fold in blocks of B
+    # (lane = lane * P^B + Σ words[r] * P^(B-1-r)), so the work is a
+    # vectorized weighted sum instead of a per-row Python loop.
+    lane = np.full(LANES, SEED, dtype=np.uint64)
+    n_rows = n_words // LANES          # full rows of the word matrix
+    with np.errstate(over="ignore"):
+        pows = _P_POWS
+        r = 0
+        while r < n_rows:
+            b = min(_BLOCK, n_rows - r)
+            block = words[r:r + b]
+            weights = pows[b - 1::-1][:, None]      # P^(b-1) ... P^0
+            lane = lane * pows[b] + (block * weights).sum(axis=0,
+                                                          dtype=np.uint64)
+            r += b
+        if n_rows * LANES != n_words:  # partial last row
+            k = n_words - n_rows * LANES
+            lane[:k] = lane[:k] * P + words[n_rows, :k]
+        acc = SEED ^ (np.uint64(nbytes) * Q)
+        for lv in lane:
+            acc = acc * Q + lv
+    return int(_avalanche64(acc))
+
+
+def jax_simplehash(arr) -> int:
+    """Digest of a jax.Array's content: stages to host once (over ICI for a
+    sharded array) and hashes the canonical row-major bytes. Every device
+    layout of the same logical array yields the same digest."""
+    import jax
+
+    host = np.asarray(jax.device_get(arr))
+    return simplehash(host)

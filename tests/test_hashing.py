@@ -1,0 +1,138 @@
+"""Hash bit-parity: native simplehash == Python twin; native CRC32 == zlib.
+
+Reference parity: the reference tests its CPU simplehash against the real
+CUDA kernel digest (simplehash_cpu_test.cu) and CRC32 against a reference
+implementation on randomized buffers (crc32_cpu_test.cpp) — the invariant
+under test is device/implementation-independent digests (SURVEY.md §2 #13/#14).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pccl_tpu.ops import hashing
+
+LIB = Path(__file__).resolve().parent.parent / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+needs_native = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+
+def _native_hash(hash_type: int, data: bytes) -> int:
+    from pccl_tpu.comm import _native
+
+    lib = _native.load()
+    buf = (ctypes.c_char * len(data)).from_buffer_copy(data) if data else None
+    return int(lib.pccltHashBuffer(hash_type, buf, len(data)))
+
+
+@needs_native
+@pytest.mark.parametrize("n", [0, 1, 3, 4, 5, 255, 256, 1024, 1027,
+                               256 * 4, 256 * 4 * 3 + 7, 1 << 16])
+def test_simplehash_python_twin_matches_native(n):
+    rng = np.random.RandomState(n)
+    data = rng.bytes(n)
+    assert hashing.simplehash(data) == _native_hash(0, data)
+
+
+@needs_native
+def test_simplehash_on_ndarray_matches_native():
+    rng = np.random.RandomState(7)
+    arr = rng.randn(1000).astype(np.float32)
+    assert hashing.simplehash(arr) == _native_hash(0, arr.tobytes())
+
+
+@needs_native
+@pytest.mark.parametrize("n", [0, 1, 9, 4096, 65537])
+def test_crc32_matches_zlib(n):
+    rng = np.random.RandomState(n)
+    data = rng.bytes(n)
+    assert _native_hash(1, data) == zlib.crc32(data)
+
+
+@needs_native
+def test_crc32_known_vector():
+    # the canonical CRC-32/IEEE check value
+    assert _native_hash(1, b"123456789") == 0xCBF43926
+
+
+def test_simplehash_sensitivity():
+    base = b"x" * 1024
+    h0 = hashing.simplehash(base)
+    flipped = bytearray(base)
+    flipped[512] ^= 1
+    assert hashing.simplehash(bytes(flipped)) != h0
+    assert hashing.simplehash(base + b"\x00") != h0  # length-extension differs
+
+
+@needs_native
+def test_shared_state_sync_with_crc32(monkeypatch):
+    """Shared-state drift detection must work end-to-end with the alternate
+    CRC32 hash type (PCCLT_SS_HASH=crc32, read per hash call)."""
+    import threading
+    import time
+
+    monkeypatch.setenv("PCCLT_SS_HASH", "crc32")
+    from pccl_tpu.comm import (MasterNode, Communicator, SharedState,
+                               SharedStateSyncStrategy, TensorInfo)
+
+    master = MasterNode("0.0.0.0", 53400)
+    master.run()
+    errors = []
+
+    def worker(rank):
+        try:
+            base = 53420 + rank * 16
+            comm = Communicator("127.0.0.1", master.port, p2p_port=base,
+                                ss_port=base + 4, bench_port=base + 8)
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < 2:
+                if time.time() > deadline:
+                    raise TimeoutError("world never reached 2")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            w = np.full(256, 5.0 if rank == 0 else 0.0, dtype=np.float32)
+            state = SharedState([TensorInfo.from_numpy("w", w)], revision=1)
+            comm.sync_shared_state(
+                state,
+                SharedStateSyncStrategy.SEND_ONLY if rank == 0
+                else SharedStateSyncStrategy.RECEIVE_ONLY)
+            np.testing.assert_allclose(w, np.full(256, 5.0))
+            comm.destroy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    # a hung worker must fail loudly, not pass with empty `errors` while
+    # racing monkeypatch's env teardown against in-flight getenv calls
+    stuck = [t for t in ts if t.is_alive()]
+    master.interrupt()
+    master.destroy()
+    assert not stuck, "worker threads hung"
+    assert not errors, f"peer failures: {errors}"
+
+
+def test_jax_simplehash_layout_independent(eight_devices):
+    """A sharded and a replicated jax array with the same content must hash
+    identically (the device-independence invariant)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.parallel import mesh as mesh_lib
+
+    x = np.arange(1024, dtype=np.float32)
+    mesh = mesh_lib.make_mesh(eight_devices, ("dp",), (8,))
+    sharded = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    replicated = jax.device_put(x, NamedSharding(mesh, P()))
+    h_host = hashing.simplehash(x)
+    assert hashing.jax_simplehash(sharded) == h_host
+    assert hashing.jax_simplehash(replicated) == h_host
