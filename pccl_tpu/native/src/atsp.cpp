@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <functional>
 #include <limits>
 #include <random>
 
@@ -122,13 +123,59 @@ double tour_cost(const std::vector<double> &cost, size_t n, const std::vector<in
 }
 
 double improve(const std::vector<double> &cost, size_t n, std::vector<int> &tour,
-               int budget_ms) {
+               int budget_ms, const std::atomic<bool> *stop) {
     auto deadline = Clock::now() + std::chrono::milliseconds(budget_ms);
     double cur = tour_cost(cost, n, tour);
-    while (Clock::now() < deadline) {
+    while (Clock::now() < deadline && !(stop && stop->load())) {
         if (!local_search_pass(cost, n, tour, cur)) break;
     }
     return cur;
+}
+
+std::vector<int> hamiltonian(const std::vector<double> &cost, size_t n, double limit,
+                             int budget_ms) {
+    if (n == 0) return {};
+    if (n == 1) return {0};
+    auto deadline = Clock::now() + std::chrono::milliseconds(budget_ms);
+
+    // adjacency: usable out-neighbors per node, cheapest first
+    std::vector<std::vector<int>> adj(n);
+    for (size_t i = 0; i < n; ++i) {
+        for (size_t j = 0; j < n; ++j)
+            if (i != j && edge(cost, n, static_cast<int>(i), static_cast<int>(j)) < limit)
+                adj[i].push_back(static_cast<int>(j));
+        std::sort(adj[i].begin(), adj[i].end(), [&](int a, int b) {
+            return edge(cost, n, static_cast<int>(i), a) <
+                   edge(cost, n, static_cast<int>(i), b);
+        });
+        if (adj[i].empty()) return {}; // a node with no usable out-edge
+    }
+
+    std::vector<int> tour{0};
+    std::vector<bool> used(n, false);
+    used[0] = true;
+    bool timed_out = false;
+
+    std::function<bool()> dfs = [&]() -> bool {
+        if (Clock::now() >= deadline) {
+            timed_out = true;
+            return false;
+        }
+        if (tour.size() == n)
+            return edge(cost, n, tour.back(), 0) < limit; // close the cycle
+        for (int nxt : adj[tour.back()]) {
+            if (used[nxt]) continue;
+            used[nxt] = true;
+            tour.push_back(nxt);
+            if (dfs()) return true;
+            if (timed_out) return false;
+            tour.pop_back();
+            used[nxt] = false;
+        }
+        return false;
+    };
+    if (dfs()) return tour;
+    return {};
 }
 
 std::vector<int> solve(const std::vector<double> &cost, size_t n, int budget_ms) {

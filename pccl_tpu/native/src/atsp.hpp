@@ -7,6 +7,7 @@
 //             search under a millisecond budget, with random restarts.
 #pragma once
 
+#include <atomic>
 #include <cstddef>
 #include <cstdint>
 #include <vector>
@@ -18,9 +19,18 @@ namespace pcclt::atsp {
 std::vector<int> solve(const std::vector<double> &cost, size_t n, int budget_ms);
 
 // Improve an existing tour in place (keeps it valid); returns improved cost.
+// `stop` (optional) is polled between passes so a shutting-down owner can
+// cancel a long budget promptly.
 double improve(const std::vector<double> &cost, size_t n, std::vector<int> &tour,
-               int budget_ms);
+               int budget_ms, const std::atomic<bool> *stop = nullptr);
 
 double tour_cost(const std::vector<double> &cost, size_t n, const std::vector<int> &tour);
+
+// Hamiltonian cycle using only edges with cost < limit (reachability-aware
+// ring build, reference ccoip_master_state.cpp:1660-1770 backtracking).
+// Returns empty if none found within the budget. Neighbors are tried
+// cheapest-first, so the result doubles as a reasonable-quality tour.
+std::vector<int> hamiltonian(const std::vector<double> &cost, size_t n, double limit,
+                             int budget_ms);
 
 } // namespace pcclt::atsp

@@ -1,6 +1,7 @@
 #include "master_state.hpp"
 
 #include <algorithm>
+#include <cstdlib>
 
 #include "atsp.hpp"
 #include "log.hpp"
@@ -541,6 +542,9 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
     }
 
     // all edges measured: solve ATSP per group, adopt new rings
+    // (unreachable edges — epsilon-bandwidth reports — carry cost >= 5e5;
+    // a tour crossing one falls back to reachability-aware backtracking)
+    constexpr double kUnreachableCost = 5e5;
     std::set<uint32_t> groups;
     for (auto *a : acc) groups.insert(a->peer_group);
     for (uint32_t gid : groups) {
@@ -557,9 +561,64 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
                     cost[i * n + j] = bw && *bw > 0 ? 1000.0 / *bw : 1e9;
                 }
             auto tour = atsp::solve(cost, n, /*budget_ms=*/1000);
+
+            // adopt a finished moonshot result if it beats the quick solve
+            // and the membership hasn't changed since it was computed
+            {
+                std::lock_guard lk(moon_mu_);
+                auto it = moon_.find(gid);
+                if (it != moon_.end()) {
+                    std::set<Uuid> now(m_uuids.begin(), m_uuids.end());
+                    if (it->second.members == now) {
+                        std::map<Uuid, int> idx_of;
+                        for (size_t i = 0; i < n; ++i) idx_of[m_uuids[i]] = static_cast<int>(i);
+                        std::vector<int> mtour;
+                        for (const auto &u : it->second.ring) mtour.push_back(idx_of[u]);
+                        if (atsp::tour_cost(cost, n, mtour) <
+                            atsp::tour_cost(cost, n, tour)) {
+                            tour = mtour;
+                            PLOG(kInfo) << "adopting moonshot ring for group " << gid;
+                        }
+                    }
+                    moon_.erase(it);
+                }
+            }
+
+            // reachability: avoid unreachable edges if a Hamiltonian cycle
+            // over reachable edges exists (reference backtracking ring build)
+            bool crosses_unreachable = false;
+            for (size_t i = 0; i < n; ++i)
+                if (cost[static_cast<size_t>(tour[i]) * n + tour[(i + 1) % n]] >=
+                    kUnreachableCost)
+                    crosses_unreachable = true;
+            if (crosses_unreachable) {
+                auto h = atsp::hamiltonian(cost, n, kUnreachableCost, 500);
+                if (!h.empty()) {
+                    // improve() has no edge limit and could reintroduce an
+                    // unreachable edge; keep the feasible tour if it does
+                    auto feasible = h;
+                    atsp::improve(cost, n, h, 200);
+                    for (size_t i = 0; i < n; ++i)
+                        if (cost[static_cast<size_t>(h[i]) * n + h[(i + 1) % n]] >=
+                            kUnreachableCost) {
+                            h = feasible;
+                            break;
+                        }
+                    PLOG(kInfo) << "group " << gid
+                                << ": reachability-aware ring adopted (cost "
+                                << atsp::tour_cost(cost, n, h) << ")";
+                    tour = h;
+                } else {
+                    PLOG(kWarn) << "group " << gid
+                                << ": no fully-reachable ring exists; keeping "
+                                   "least-cost tour across unreachable edges";
+                }
+            }
+
             std::vector<Uuid> ring;
             for (int idx : tour) ring.push_back(m_uuids[idx]);
             groups_[gid].ring = ring;
+            spawn_moonshot(gid, m_uuids, cost, tour);
         }
     }
     for (auto *a : acc) {
@@ -574,6 +633,46 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
     }
     optimize_in_flight_ = false;
     PLOG(kInfo) << "topology optimization complete";
+}
+
+MasterState::~MasterState() {
+    moon_stop_ = true; // improve() polls this, so joins return promptly
+    for (auto &[_, t] : moon_threads_)
+        if (t.joinable()) t.join();
+}
+
+void MasterState::spawn_moonshot(uint32_t gid, std::vector<Uuid> uuids,
+                                 std::vector<double> cost, std::vector<int> tour) {
+    if (uuids.size() < 3) return; // a 2-node ring has nothing to improve
+    auto tit = moon_threads_.find(gid);
+    if (tit != moon_threads_.end()) {
+        auto rit = moon_running_.find(gid);
+        if (rit != moon_running_.end() && rit->second->load())
+            return; // previous worker still running: a stale result produced
+                    // from an older cost matrix must not overwrite a newer one
+        if (tit->second.joinable()) tit->second.join();
+        moon_threads_.erase(tit);
+    }
+    int budget_ms = 10'000; // reference uses 30 s; env-tunable for tests
+    if (const char *v = std::getenv("PCCLT_MOONSHOT_MS")) budget_ms = std::atoi(v);
+    if (budget_ms <= 0) return;
+    auto running = std::make_shared<std::atomic<bool>>(true);
+    moon_running_[gid] = running;
+    moon_threads_[gid] = std::thread([this, gid, uuids = std::move(uuids),
+                                      cost = std::move(cost), tour = std::move(tour),
+                                      budget_ms, running]() mutable {
+        size_t n = uuids.size();
+        double c = atsp::improve(cost, n, tour, budget_ms, &moon_stop_);
+        Moonshot m;
+        m.members.insert(uuids.begin(), uuids.end());
+        for (int idx : tour) m.ring.push_back(uuids[idx]);
+        m.cost = c;
+        {
+            std::lock_guard lk(moon_mu_);
+            moon_[gid] = std::move(m);
+        }
+        running->store(false);
+    });
 }
 
 std::vector<Outbox> MasterState::on_bandwidth_report(uint64_t conn, const Uuid &to,

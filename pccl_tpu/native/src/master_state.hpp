@@ -18,11 +18,15 @@
 //  - topology optimization (global: bandwidth probes -> ATSP ring)
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <map>
+#include <memory>
+#include <mutex>
 #include <optional>
 #include <set>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "bandwidth.hpp"
@@ -79,6 +83,8 @@ struct GroupState {
 
 class MasterState {
 public:
+    ~MasterState();
+
     // --- event handlers: apply + return packets to send ---
     std::vector<Outbox> on_hello(uint64_t conn, uint32_t src_ip, const proto::HelloC2M &h);
     std::vector<Outbox> on_topology_update(uint64_t conn);
@@ -132,6 +138,26 @@ private:
     bool optimize_in_flight_ = false;
     bool optimize_work_phase_ = false;
     BandwidthStore bandwidth_;
+
+    // "moonshot" background ATSP improvement (reference: 30 s budget on a
+    // thread pool, adopted on a LATER optimize round —
+    // ccoip_master_handler.cpp:455-496). The worker thread writes its result
+    // into a mutex-guarded slot; the single dispatcher thread adopts it on
+    // the next optimize completion if membership is unchanged.
+    struct Moonshot {
+        std::set<Uuid> members;   // membership the result is valid for
+        std::vector<Uuid> ring;
+        double cost = 0;
+    };
+    void spawn_moonshot(uint32_t gid, std::vector<Uuid> uuids,
+                        std::vector<double> cost, std::vector<int> tour);
+    std::mutex moon_mu_;
+    std::map<uint32_t, Moonshot> moon_;
+    // one worker per group at a time; finished handles are joined before a
+    // replacement is spawned, and moon_stop_ cancels workers on destruction
+    std::map<uint32_t, std::thread> moon_threads_;
+    std::map<uint32_t, std::shared_ptr<std::atomic<bool>>> moon_running_;
+    std::atomic<bool> moon_stop_{false};
 
     std::vector<uint64_t> pending_closes_;
 };

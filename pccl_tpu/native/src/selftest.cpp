@@ -127,6 +127,22 @@ static void test_atsp() {
     std::vector<bool> seen(n, false);
     for (int v : t2) seen[v] = true;
     for (size_t i = 0; i < n; ++i) CHECK(seen[i]);
+
+    // reachability-aware Hamiltonian: edges >= limit are unusable. Ring
+    // 0->2->1->3->0 is the only cycle under the limit.
+    const double X = 1e9;
+    std::vector<double> h = {
+        0, X, 1, X,
+        X, 0, X, 1,
+        X, 1, 0, X,
+        1, X, X, 0,
+    };
+    auto ht = atsp::hamiltonian(h, 4, 5e5, 100);
+    CHECK(ht.size() == 4);
+    CHECK(atsp::tour_cost(h, 4, ht) == 4.0);
+    // no cycle exists when an edge of the unique ring is removed
+    h[0 * 4 + 2] = X;
+    CHECK(atsp::hamiltonian(h, 4, 5e5, 100).empty());
 }
 
 // ---- end-to-end: master + N clients, fp32 ring allreduce + shared state ----
