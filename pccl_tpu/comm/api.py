@@ -416,6 +416,16 @@ class Communicator:
     def global_world_size(self) -> int:
         return self.get_attribute(Attribute.GLOBAL_WORLD_SIZE)
 
+    @property
+    def num_peer_groups(self) -> int:
+        return self.get_attribute(Attribute.NUM_DISTINCT_PEER_GROUPS)
+
+    @property
+    def largest_peer_group(self) -> int:
+        """Largest group's world size — with num_peer_groups, the grid
+        fullness check: global == num_groups * largest (docs 07)."""
+        return self.get_attribute(Attribute.LARGEST_PEER_GROUP_WORLD_SIZE)
+
     def update_topology(self) -> None:
         _check(self._lib.pccltUpdateTopology(self._h), "update topology")
 

@@ -1,0 +1,47 @@
+/* Compile-time check that pcclt.h is valid C99 — the public API must stay
+ * consumable from plain C (reference: tests/c99_compat/enforce_c99_compat.c).
+ * Compiled with -std=c99 -Werror by the build; never executed beyond a
+ * trivial smoke of the function-pointer surface. */
+#include <pcclt.h>
+
+#include <stddef.h>
+
+int main(void) {
+    /* touch every exported symbol so missing declarations fail the build */
+    pccltResult_t (*fns[])(void) = {pccltInit};
+    const char *(*info)(void) = pccltGetBuildInfo;
+    pccltResult_t (*cm)(const char *, uint16_t, pccltMaster_t **) = pccltCreateMaster;
+    pccltResult_t (*rm)(pccltMaster_t *) = pccltRunMaster;
+    pccltResult_t (*im)(pccltMaster_t *) = pccltInterruptMaster;
+    pccltResult_t (*am)(pccltMaster_t *) = pccltMasterAwaitTermination;
+    pccltResult_t (*dm)(pccltMaster_t *) = pccltDestroyMaster;
+    uint16_t (*mp)(pccltMaster_t *) = pccltMasterPort;
+    pccltResult_t (*cc)(const pccltCommCreateParams_t *, pccltComm_t **) =
+        pccltCreateCommunicator;
+    pccltResult_t (*dc)(pccltComm_t *) = pccltDestroyCommunicator;
+    pccltResult_t (*cn)(pccltComm_t *) = pccltConnect;
+    pccltResult_t (*ga)(pccltComm_t *, pccltAttribute_t, int64_t *) = pccltGetAttribute;
+    pccltResult_t (*ut)(pccltComm_t *) = pccltUpdateTopology;
+    pccltResult_t (*pp)(pccltComm_t *, int *) = pccltArePeersPending;
+    pccltResult_t (*ot)(pccltComm_t *) = pccltOptimizeTopology;
+    pccltResult_t (*ar)(pccltComm_t *, const void *, void *, uint64_t,
+                        pccltDataType_t, const pccltReduceDescriptor_t *,
+                        pccltReduceInfo_t *) = pccltAllReduce;
+    pccltResult_t (*ara)(pccltComm_t *, const void *, void *, uint64_t,
+                         pccltDataType_t, const pccltReduceDescriptor_t *) =
+        pccltAllReduceAsync;
+    pccltResult_t (*aw)(pccltComm_t *, uint64_t, pccltReduceInfo_t *) =
+        pccltAwaitAsyncReduce;
+    pccltResult_t (*mr)(pccltComm_t *, const void *const *, void *const *,
+                        const uint64_t *, pccltDataType_t,
+                        const pccltReduceDescriptor_t *, uint64_t,
+                        pccltReduceInfo_t *) = pccltAllReduceMultipleWithRetry;
+    pccltResult_t (*ss)(pccltComm_t *, pccltSharedState_t *, pccltSyncStrategy_t,
+                        pccltSharedStateSyncInfo_t *) = pccltSynchronizeSharedState;
+    uint64_t (*hb)(int, const void *, uint64_t) = pccltHashBuffer;
+
+    (void)fns; (void)info; (void)cm; (void)rm; (void)im; (void)am; (void)dm;
+    (void)mp; (void)cc; (void)dc; (void)cn; (void)ga; (void)ut; (void)pp;
+    (void)ot; (void)ar; (void)ara; (void)aw; (void)mr; (void)ss; (void)hb;
+    return 0;
+}

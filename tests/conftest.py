@@ -27,3 +27,14 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+# single in-process port allocator: every test file draws disjoint ranges
+# from here instead of hand-picking bases that can silently collide
+_PORT_COUNTER = [49000]
+
+
+def alloc_ports(span: int = 64) -> int:
+    p = _PORT_COUNTER[0]
+    _PORT_COUNTER[0] += span
+    return p

@@ -15,13 +15,11 @@ import pytest
 LIB = Path(__file__).resolve().parent.parent / "pccl_tpu" / "native" / "build" / "libpcclt.so"
 pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
 
-_PORT_COUNTER = [49000]
+from conftest import alloc_ports
 
 
 def _ports(n=1):
-    p = _PORT_COUNTER[0]
-    _PORT_COUNTER[0] += 64 * n
-    return p
+    return alloc_ports(64 * n)
 
 
 def _run_peers(master_port, world, worker, base):

@@ -19,13 +19,7 @@ REPO = Path(__file__).resolve().parent.parent
 LIB = REPO / "pccl_tpu" / "native" / "build" / "libpcclt.so"
 pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
 
-_PORT = [52600]
-
-
-def _next_port(span: int = 64) -> int:
-    p = _PORT[0]
-    _PORT[0] += span
-    return p
+from conftest import alloc_ports as _next_port
 
 
 def _peer_env() -> dict:

@@ -73,13 +73,7 @@ class PeerProc:
             raise
 
 
-_PORT_COUNTER = [52300]  # same per-test allocation convention as test_comm_native
-
-
-def _next_port(span: int = 32) -> int:
-    p = _PORT_COUNTER[0]
-    _PORT_COUNTER[0] += span
-    return p
+from conftest import alloc_ports as _next_port
 
 
 @pytest.fixture
