@@ -1,0 +1,123 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh axis.
+
+Capability beyond the reference (SURVEY.md §2.3: the reference has no
+TP/PP/SP anywhere); on TPU the layer-stacked GPT (pccl_tpu.models.gpt keeps
+per-layer params stacked on a leading [n_layer] dim precisely so the block
+stack is `lax.scan`-shaped) pipelines naturally: shard the layer dim over a
+`pp` mesh axis and rotate activations stage-to-stage with `lax.ppermute`.
+
+Schedule: plain GPipe. With S stages and M microbatches, the loop runs
+M + S - 1 ticks; at each tick every stage runs its local layer chunk on the
+activation it holds, then passes it to the next stage. Stage 0 feeds a new
+microbatch per tick; stage S-1 emits a finished microbatch per tick (after
+the S-1-tick fill bubble). Bubble fraction = (S-1)/(M+S-1) — pick M >= S.
+The tick loop is a `lax.scan`, so the whole pipeline is differentiable and
+the backward pass is the reverse pipeline, scheduled by XLA.
+
+Collectives ride ICI: `ppermute` only ever touches neighboring stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import gpt
+
+
+def pipeline_spec(mesh: Mesh, axis: str = "pp"):
+    """Sharding for the stacked per-layer param tree: leading layer dim over
+    `axis`, other dims replicated (composable with tp by extending specs)."""
+    def spec_of(leaf):
+        return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+    return spec_of
+
+
+def shard_layer_params(layers: Any, mesh: Mesh, axis: str = "pp") -> Any:
+    """Place a stacked layer tree ([L, ...] leaves) with L over `axis`."""
+    sp = pipeline_spec(mesh, axis)
+    return jax.tree.map(lambda l: jax.device_put(l, sp(l)), layers)
+
+
+def _run_local_stack(layers_local: Any, x: jax.Array, cfg: gpt.GPTConfig,
+                     attn_fn) -> jax.Array:
+    """One stage's chunk of the block stack: scan over the local layers."""
+    def body(h, layer):
+        return gpt._block(h, layer, cfg, attn_fn), None
+
+    out, _ = lax.scan(body, x, layers_local)
+    return out
+
+
+def pipeline_blocks(x: jax.Array, layers: Any, cfg: gpt.GPTConfig, mesh: Mesh,
+                    *, axis: str = "pp", microbatches: int = 0,
+                    attn_fn=None) -> jax.Array:
+    """Run the transformer block stack pipelined over mesh axis `axis`.
+
+    x: [B, T, d] activations (replicated over `axis`); layers: stacked tree
+    with leading [n_layer] dims sharded over `axis`. Returns [B, T, d].
+    microbatches=0 picks the stage count (minimum bubble-free choice)."""
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    M = microbatches or S
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    assert cfg.n_layer % S == 0, f"{cfg.n_layer} layers not divisible by {S} stages"
+    mb = B // M
+
+    xs = x.reshape(M, mb, *x.shape[1:])
+    # pad with bubble inputs for the drain ticks
+    pad = jnp.zeros((S - 1, mb, *x.shape[1:]), x.dtype)
+    xs_padded = jnp.concatenate([xs, pad], axis=0) if S > 1 else xs
+
+    def per_stage(layers_local, xs_padded):
+        stage = lax.axis_index(axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(cur, t):
+            # pass last tick's outputs forward; stage 0 takes microbatch t
+            prev = lax.ppermute(cur, axis, perm)
+            fed = lax.dynamic_index_in_dim(xs_padded, t, 0, keepdims=False)
+            inp = jnp.where(stage == 0, fed, prev)
+            out = _run_local_stack(layers_local, inp, cfg, attn_fn)
+            return out, out
+
+        cur0 = jnp.zeros((mb, *xs_padded.shape[2:]), x.dtype)
+        if hasattr(lax, "pcast"):
+            cur0 = lax.pcast(cur0, axis, to="varying")
+        _, ys = lax.scan(tick, cur0, jnp.arange(M + S - 1))
+        # microbatch m finishes on the LAST stage at tick m + S - 1
+        done = lax.dynamic_slice_in_dim(ys, S - 1, M, axis=0)
+        # replicate the result: only stage S-1 holds real outputs
+        done = jnp.where(stage == S - 1, done, jnp.zeros_like(done))
+        return lax.psum(done, axis)
+
+    fn = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P(),
+        axis_names={axis},
+    )
+    out = fn(layers, xs_padded)
+    return out.reshape(B, *x.shape[1:])
+
+
+def build_pipelined_forward(cfg: gpt.GPTConfig, mesh: Mesh, *,
+                            axis: str = "pp", microbatches: int = 0,
+                            attn_fn=None) -> Callable:
+    """(params, tokens) -> logits with the block stack pipelined over `axis`.
+
+    Embedding, final norm and the tied head stay replicated (they are a
+    small fraction of compute); per-layer params must be sharded with
+    shard_layer_params. Compose under an outer jit."""
+    def forward(params, tokens):
+        x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
+        layers = {k: params[k] for k in gpt._LAYER_KEYS}
+        x = pipeline_blocks(x, layers, cfg, mesh, axis=axis,
+                            microbatches=microbatches, attn_fn=attn_fn)
+        x = gpt._rmsnorm(x, params["lnf_g"])
+        return x.astype(jnp.float32) @ params["tok_emb"].T.astype(jnp.float32)
+
+    return forward

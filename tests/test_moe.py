@@ -1,0 +1,83 @@
+"""Expert-parallel MoE: EP dispatch matches the dense reference."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+def _setup(eight_devices, S, E=4, B=4, T=16, d=32, f=64):
+    import jax
+
+    from pccl_tpu.ops import moe
+    from pccl_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(eight_devices[:S], ("ep",), (S,))
+    params = moe.init_moe_params(jax.random.PRNGKey(0), d, f, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, T, d), jax.numpy.float32)
+    return mesh, params, x
+
+
+@pytest.mark.parametrize("S,E", [(2, 4), (4, 4), (4, 8)])
+def test_moe_ep_matches_dense(eight_devices, S, E):
+    import jax
+
+    from pccl_tpu.ops import moe
+
+    mesh, params, x = _setup(eight_devices, S, E=E)
+    # ample capacity: no token drops, so EP must match dense exactly
+    dense = moe.moe_mlp_dense(x, params, capacity_factor=float(E))
+    sharded = moe.shard_moe_params(params, mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x_ep = jax.device_put(x, NamedSharding(mesh, P("ep")))
+    ep = jax.jit(lambda xx, pp: moe.moe_mlp_ep(
+        xx, pp, mesh, capacity_factor=float(E)))(x_ep, sharded)
+    np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)  # bf16 expert compute
+
+
+def test_moe_grad_flows(eight_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.ops import moe
+
+    mesh, params, x = _setup(eight_devices, 2, E=4, B=2, T=8)
+    sharded = moe.shard_moe_params(params, mesh)
+
+    def loss(p, xx):
+        return jnp.sum(moe.moe_mlp_ep(xx, p, mesh,
+                                      capacity_factor=4.0) ** 2)
+
+    g = jax.jit(jax.grad(loss))(sharded, x)
+    # expert weights that received tokens must have nonzero grads
+    assert float(jnp.abs(g["w_in"]).sum()) > 0
+    assert float(jnp.abs(g["gate"]).sum()) > 0
+
+
+def test_moe_capacity_drops_tokens(eight_devices):
+    """With capacity 0-ish, dropped tokens produce zero output (switch
+    semantics), not garbage — on BOTH the dense and the EP path."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.ops import moe
+
+    mesh, params, x = _setup(eight_devices, 2, E=4, B=2, T=8)
+    out = moe.moe_mlp_dense(x, params, capacity_factor=0.01)  # C=1
+    arr = np.asarray(out)
+    assert np.isfinite(arr).all()
+    # most tokens dropped -> mostly zero rows
+    zero_rows = (np.abs(arr).sum(axis=-1) == 0).mean()
+    assert zero_rows > 0.5
+
+    sharded = moe.shard_moe_params(params, mesh)
+    x_ep = jax.device_put(x, NamedSharding(mesh, P("ep")))
+    out_ep = jax.jit(lambda xx, pp: moe.moe_mlp_ep(
+        xx, pp, mesh, capacity_factor=0.01))(x_ep, sharded)
+    arr_ep = np.asarray(out_ep)
+    assert np.isfinite(arr_ep).all()
+    # per-shard capacity keeps up to S*C tokens globally (C per shard per
+    # expert), so the drop fraction bound is weaker than dense
+    assert (np.abs(arr_ep).sum(axis=-1) == 0).mean() >= 0.5
