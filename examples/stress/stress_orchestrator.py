@@ -1,0 +1,126 @@
+"""Fault-tolerance soak test: churn real peer processes until the clock runs out.
+
+Reference parity: /root/reference/python/tests/stress_tests/basic_stress_test/
+stresstest_orchestrator.py — launch a master + N peers on loopback, let peers
+randomly kill themselves mid-run (tests/ft_peer.py --die-prob), relaunch
+them, and watch stdout heartbeats with a stall detector. Progress anywhere
+in the group within the stall window = healthy; no progress = the collective
+runtime wedged and the soak FAILS.
+
+Usage:
+    python examples/stress/stress_orchestrator.py --duration 120 --peers 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+PEER = REPO / "tests" / "ft_peer.py"
+sys.path.insert(0, str(REPO))
+
+
+class Peer:
+    def __init__(self, master_port: int, idx: int, base_port: int,
+                 die_prob: float, seed: int):
+        self.idx = idx
+        self.base_port = base_port
+        cmd = [sys.executable, str(PEER), "--master-port", str(master_port),
+               "--rank", str(idx), "--base-port", str(base_port),
+               "--steps", "1000000", "--min-world", "2",
+               "--step-interval", "0.05",
+               "--die-prob", str(die_prob), "--seed", str(seed)]
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True)
+        self.steps = 0
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            if line.startswith("STEP "):
+                self.steps += 1
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=10)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=120.0)
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--die-prob", type=float, default=0.002)
+    ap.add_argument("--master-port", type=int, default=48900)
+    ap.add_argument("--base-port", type=int, default=58000)
+    ap.add_argument("--stall-seconds", type=float, default=120.0,
+                    help="fail if NO peer makes progress for this long "
+                         "(reference uses 5 minutes)")
+    args = ap.parse_args()
+
+    from pccl_tpu.comm import MasterNode
+
+    master = MasterNode("0.0.0.0", args.master_port)
+    master.run()
+    peers: list[Peer] = []
+    seed = 1
+    total_relaunches = 0
+    retired_steps = 0  # steps of peers that died; keeps the total monotone
+    try:
+        for i in range(args.peers):
+            peers.append(Peer(master.port, i, args.base_port + i * 16,
+                              args.die_prob, seed))
+            seed += 1
+        deadline = time.time() + args.duration
+        last_progress = time.time()
+        last_total = 0
+        while time.time() < deadline:
+            time.sleep(1.0)
+            # monotone: a relaunched peer restarts at 0, so dead peers'
+            # counts are folded into retired_steps at relaunch time
+            total = retired_steps + sum(p.steps for p in peers)
+            if total > last_total:
+                last_total = total
+                last_progress = time.time()
+            if time.time() - last_progress > args.stall_seconds:
+                print(f"STALL: no progress for {args.stall_seconds}s "
+                      f"(total steps {total})", flush=True)
+                return 1
+            # relaunch the dead (the churn is the point)
+            for i, p in enumerate(peers):
+                if not p.alive():
+                    total_relaunches += 1
+                    retired_steps += p.steps
+                    print(f"peer {p.idx} died (steps={p.steps}); relaunching "
+                          f"(#{total_relaunches})", flush=True)
+                    peers[i] = Peer(master.port, p.idx, p.base_port,
+                                    args.die_prob, seed)
+                    seed += 1
+        total = retired_steps + sum(p.steps for p in peers)
+        if total == 0:
+            print("SOAK FAILED: zero heartbeat steps over the whole run",
+                  flush=True)
+            return 1
+        print(f"SOAK PASSED: {total} heartbeat steps, "
+              f"{total_relaunches} relaunches in {args.duration:.0f}s",
+              flush=True)
+        return 0
+    finally:
+        for p in peers:
+            p.kill()
+        master.interrupt()
+        master.destroy()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,7 @@ pccltResult_t to_result(Status s) {
     case Status::kKicked: return pccltKicked;
     case Status::kMasterUnreachable: return pccltMasterUnreachable;
     case Status::kContentMismatch: return pccltContentMismatch;
+    case Status::kPendingAsyncOps: return pccltPendingAsyncOps;
     default: return pccltInternalError;
     }
 }

@@ -476,7 +476,7 @@ Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
     {
         std::lock_guard lk(ops_mu_);
         if (ops_.count(desc.tag)) return Status::kDuplicateTag;
-        if (ops_.size() >= max_concurrent_ops()) return Status::kInvalid;
+        if (ops_.size() >= max_concurrent_ops()) return Status::kPendingAsyncOps;
         auto op = std::make_unique<AsyncOp>();
         auto promise = std::make_shared<std::promise<Status>>();
         op->result = promise->get_future();

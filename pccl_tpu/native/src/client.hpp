@@ -36,6 +36,7 @@ enum class Status : int {
     kMasterUnreachable = 8,
     kInternal = 9,
     kContentMismatch = 10,
+    kPendingAsyncOps = 11, // at the concurrent-op cap; await one first
 };
 
 struct ClientConfig {

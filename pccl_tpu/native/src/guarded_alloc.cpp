@@ -1,0 +1,83 @@
+#include "guarded_alloc.hpp"
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <new>
+#include <sys/mman.h>
+#include <unistd.h>
+
+namespace pcclt::galloc {
+
+namespace {
+
+std::atomic<size_t> g_live{0};
+
+size_t page_size() {
+    static const size_t ps = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+    return ps;
+}
+
+struct Header {
+    void *map_base;
+    size_t map_len;
+    uint64_t magic;
+};
+constexpr uint64_t kMagic = 0x6741726445644121ull;
+
+} // namespace
+
+void *guarded_malloc(size_t n) {
+    const size_t ps = page_size();
+    // layout: [Header ... backptr][user bytes, end flush][PROT_NONE guard]
+    const size_t need = sizeof(Header) + sizeof(void *) + ((n + 15) & ~size_t{15});
+    const size_t data_pages = (need + ps - 1) / ps;
+    const size_t map_len = (data_pages + 1) * ps;
+    void *base = mmap(nullptr, map_len, PROT_READ | PROT_WRITE,
+                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (base == MAP_FAILED) return nullptr;
+    uint8_t *guard = static_cast<uint8_t *>(base) + data_pages * ps;
+    if (mprotect(guard, ps, PROT_NONE) != 0) {
+        munmap(base, map_len);
+        return nullptr;
+    }
+    // user buffer flush against the guard page (16-aligned)
+    uint8_t *user = guard - ((n + 15) & ~size_t{15});
+    auto *h = reinterpret_cast<Header *>(base);
+    h->map_base = base;
+    h->map_len = map_len;
+    h->magic = kMagic;
+    // back-pointer to the header directly below the user buffer: O(1) free
+    // with no page scanning (a scan could fault on neighboring mappings)
+    memcpy(user - sizeof(void *), &h, sizeof(void *));
+    g_live.fetch_add(1);
+    return user;
+}
+
+void guarded_free(void *p) {
+    if (!p) return;
+    Header *h;
+    memcpy(&h, static_cast<uint8_t *>(p) - sizeof(void *), sizeof(void *));
+    if (!h || h->magic != kMagic || h->map_base != h) {
+        // not ours / corrupted back-pointer — crash loudly, don't leak silently
+        __builtin_trap();
+    }
+    size_t len = h->map_len;
+    void *base = h->map_base;
+    g_live.fetch_sub(1);
+    munmap(base, len);
+}
+
+size_t live_count() { return g_live.load(); }
+
+} // namespace pcclt::galloc
+
+#ifdef PCCLT_GUARDED_ALLOC_HOOK
+void *operator new(size_t n) {
+    void *p = pcclt::galloc::guarded_malloc(n);
+    if (!p) throw std::bad_alloc();
+    return p;
+}
+void operator delete(void *p) noexcept { pcclt::galloc::guarded_free(p); }
+void operator delete(void *p, size_t) noexcept { pcclt::galloc::guarded_free(p); }
+#endif

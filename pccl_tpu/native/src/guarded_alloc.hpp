@@ -1,0 +1,26 @@
+// Guarded allocator for memory-corruption debugging.
+//
+// Reference parity: the reference's optional mprotect-guarded malloc +
+// global new/delete hook (/root/reference/ccoip/src/cpp/alloc.cpp:1-16,
+// guarded_alloc.cpp:13-95), off by default. Allocations are placed so the
+// buffer ends flush against a PROT_NONE guard page: any overrun faults
+// immediately at the overrunning instruction instead of corrupting
+// neighboring state.
+//
+// Enable the global operator new/delete hook with -DPCCLT_GUARDED_ALLOC=ON
+// (debug builds only — every allocation costs >= 2 pages).
+#pragma once
+
+#include <cstddef>
+
+namespace pcclt::galloc {
+
+// Allocate n bytes with a PROT_NONE page immediately after the buffer.
+// Returns nullptr on failure. Alignment: 16 bytes.
+void *guarded_malloc(size_t n);
+void guarded_free(void *p);
+
+// Introspection for tests.
+size_t live_count();
+
+} // namespace pcclt::galloc

@@ -72,6 +72,9 @@ void Master::apply_outbox(const std::vector<Outbox> &out) {
 }
 
 void Master::dispatcher_loop() {
+    // the state machine is single-threaded by design; enforce it at runtime
+    // (reference THREAD_GUARD discipline)
+    PCCLT_THREAD_GUARD(state_guard_);
     while (running_.load()) {
         Event ev;
         {

@@ -18,6 +18,7 @@
 
 #include "master_state.hpp"
 #include "sockets.hpp"
+#include "thread_guard.hpp"
 
 namespace pcclt::master {
 
@@ -51,6 +52,7 @@ private:
     uint16_t port_;
     net::Listener listener_;
     MasterState state_;
+    ThreadGuard state_guard_;
     std::map<uint64_t, std::shared_ptr<Conn>> conns_;
     std::mutex conns_mu_;
     uint64_t next_conn_id_ = 1;

@@ -11,6 +11,7 @@
 
 #include "atsp.hpp"
 #include "client.hpp"
+#include "guarded_alloc.hpp"
 #include "hash.hpp"
 #include "kernels.hpp"
 #include "master.hpp"
@@ -246,6 +247,19 @@ int main() {
     test_kernels();
     test_quant();
     test_atsp();
+    {
+        // guarded allocator: bytes usable end-to-end, balanced live count
+        size_t live0 = pcclt::galloc::live_count();
+        for (size_t n : {size_t{1}, size_t{16}, size_t{4095}, size_t{4096},
+                         size_t{100000}}) {
+            auto *p = static_cast<uint8_t *>(pcclt::galloc::guarded_malloc(n));
+            CHECK(p != nullptr);
+            memset(p, 0xAB, n);   // every byte writable up to the guard page
+            CHECK(p[0] == 0xAB && p[n - 1] == 0xAB);
+            pcclt::galloc::guarded_free(p);
+        }
+        CHECK(pcclt::galloc::live_count() == live0);
+    }
     printf("unit tests: %s\n", g_failures ? "FAIL" : "ok");
     test_e2e(2, proto::QuantAlgo::kNone);
     printf("e2e world=2 fp32: %s\n", g_failures ? "FAIL" : "ok");

@@ -1,0 +1,41 @@
+// Runtime single-threaded-invariant enforcement.
+//
+// Reference parity: THREAD_GUARD(tid) (/root/reference/ccoip/internal/
+// thread_guard.hpp:9-13, used e.g. ccoip_master_handler.cpp:66) — state
+// machines that are single-threaded BY DESIGN terminate loudly if ever
+// entered from a second thread, instead of corrupting state silently.
+#pragma once
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+
+namespace pcclt {
+
+// Place one per guarded class; call check() at every entry point.
+class ThreadGuard {
+public:
+    void check(const char *where) {
+        // atomic CAS bind: a concurrent first entry from two threads is
+        // exactly the violation we exist to catch — the loser must abort,
+        // not racily co-bind
+        auto self = std::hash<std::thread::id>{}(std::this_thread::get_id());
+        size_t expected = kUnbound;
+        if (owner_.compare_exchange_strong(expected, self)) return;
+        if (expected != self) {
+            std::fprintf(stderr,
+                         "FATAL: single-threaded invariant violated at %s\n",
+                         where);
+            std::abort();
+        }
+    }
+
+private:
+    static constexpr size_t kUnbound = ~size_t{0};
+    std::atomic<size_t> owner_{kUnbound};
+};
+
+#define PCCLT_THREAD_GUARD(guard) (guard).check(__func__)
+
+} // namespace pcclt

@@ -20,7 +20,7 @@ Design (switch-style top-1 routing, Mesh-TensorFlow dispatch algebra):
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -28,6 +28,9 @@ def main() -> int:
     ap.add_argument("--join-delay", type=float, default=0.0)
     ap.add_argument("--die-at", type=int, default=-1,
                     help="exit(0) abruptly before this step (simulated crash)")
+    ap.add_argument("--die-prob", type=float, default=0.0,
+                    help="per-step probability of abrupt exit (soak testing)")
+    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--base-port", type=int, required=True)
     ap.add_argument("--count", type=int, default=4096)
     ap.add_argument("--step-interval", type=float, default=0.0,
@@ -59,10 +62,16 @@ def main() -> int:
             comm.update_topology()
         time.sleep(0.02)
 
+    rng = np.random.RandomState(args.seed or args.base_port)
     x = np.ones(args.count, dtype=np.float32)
     y = np.empty_like(x)
     step = 0
     while step < args.steps:
+        if args.die_prob > 0 and rng.rand() < args.die_prob:
+            print(f"DYING at step {step}", flush=True)
+            import os
+
+            os._exit(0)
         if args.die_at >= 0 and step >= args.die_at:
             # simulated crash: no destroy(), no goodbye — the master must
             # detect the dead TCP connection and abort our running ops

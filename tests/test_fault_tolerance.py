@@ -122,6 +122,22 @@ def test_abrupt_exit_mid_run(master):
             p.kill()
 
 
+def test_churn_soak_smoke():
+    """Short run of the stress orchestrator (examples/stress): random peer
+    deaths + relaunches must keep the group progressing."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "stress" / "stress_orchestrator.py"),
+         "--duration", "30", "--peers", "3", "--die-prob", "0.01",
+         "--master-port", str(_next_port()), "--base-port", str(_next_port(64)),
+         # 1-core CI: peer relaunch startup can eat a short stall window, so
+         # rely on the orchestrator's zero-total-progress check instead
+         "--stall-seconds", "60"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, \
+        f"soak failed:\nstdout:{proc.stdout[-1500:]}\nstderr:{proc.stderr[-1500:]}"
+    assert "SOAK PASSED" in proc.stdout
+
+
 def test_late_joiner_is_admitted(master):
     """A peer joining mid-training must be admitted by the running peers'
     update_topology votes and participate in subsequent reduces."""
