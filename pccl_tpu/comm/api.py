@@ -443,6 +443,10 @@ class Communicator:
     # deterministic tags used by blocking all_reduce (0) and
     # all_reduce_multiple_with_retry (0..n-1) or typical user-chosen tags
     _AUTO_TAG_BASE = 1 << 32
+    # all_reduce_multiple_with_retry uses deterministic tags in this reserved
+    # band (disjoint from the blocking default 0, typical user tags, and the
+    # auto band above) so concurrent collectives never collide on tag 0
+    _RETRY_TAG_BASE = 1 << 16
 
     def _auto_tag(self) -> int:
         with self._tag_lock:
@@ -538,9 +542,13 @@ class Communicator:
         counts = (ctypes.c_uint64 * n)(*[a.size for a in arrs])
         descs = (_native.ReduceDescriptor * n)()
         for i in range(n):
-            # deterministic tags (the tensor index): peers match ops by tag,
-            # and a late joiner's counter must not drift from incumbents'
-            d = ReduceDescriptor(i, op, quantization, quantized_dtype)._as_c()
+            # deterministic tags (reserved band + tensor index): peers match
+            # ops by tag, and a late joiner's counter must not drift from
+            # incumbents'. The band keeps these disjoint from the blocking
+            # default tag 0 and from user-chosen small tags, so a foreground
+            # all_reduce can run concurrently with a background retry batch.
+            d = ReduceDescriptor(self._RETRY_TAG_BASE + i, op, quantization,
+                                 quantized_dtype)._as_c()
             descs[i] = d
         infos = (_native.ReduceInfo * n)()
         code = self._lib.pccltAllReduceMultipleWithRetry(

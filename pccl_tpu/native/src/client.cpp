@@ -80,15 +80,32 @@ void Client::on_p2p_accept(net::Socket sock) {
         std::mutex mu;
         if (!net::send_frame(sock, mu, PacketType::kP2PHelloAck, w.data())) return;
         sock.set_keepalive();
-        sock.set_bufsizes(4 << 20);
+        sock.set_bufsizes(8 << 20);
 
-        auto conn = std::make_shared<net::MultiplexConn>(std::move(sock));
+        // all inbound conns from one peer share a sink table so striped
+        // transfers land in one place
+        std::shared_ptr<net::SinkTable> table;
+        {
+            std::lock_guard lk(state_mu_);
+            auto &pc = peers_[peer];
+            if (!pc.rx_table) pc.rx_table = std::make_shared<net::SinkTable>();
+            table = pc.rx_table;
+        }
+        auto conn = std::make_shared<net::MultiplexConn>(std::move(sock), table);
         fd->store(-1); // handed off: the conn owns the fd now
         conn->run();
-        std::lock_guard lk(state_mu_);
-        auto &pc = peers_[peer];
-        if (pc.rx.size() <= idx) pc.rx.resize(idx + 1);
-        pc.rx[idx] = conn;
+        std::shared_ptr<net::MultiplexConn> replaced;
+        {
+            std::lock_guard lk(state_mu_);
+            auto &pc = peers_[peer];
+            if (pc.rx.size() <= idx) pc.rx.resize(idx + 1);
+            replaced = std::move(pc.rx[idx]);
+            pc.rx[idx] = conn;
+        }
+        state_cv_.notify_all();
+        // close a replaced conn (peer reconnect) outside state_mu_: close
+        // joins its RX/TX threads, which can take a while mid-transfer
+        if (replaced) replaced->close();
     });
 }
 
@@ -256,13 +273,26 @@ Status Client::check_kicked() {
 Status Client::establish_from_info(const proto::P2PConnInfo &info,
                                    std::vector<proto::Uuid> &failed) {
     for (const auto &ep : info.peers) {
-        std::lock_guard lk(state_mu_);
-        auto &pc = peers_[ep.uuid];
-        pc.ep = ep;
-        // build tx pool (reconnect from scratch each round: robust under churn)
-        for (auto &c : pc.tx)
+        // take the old pool + shared table under the lock, then do all the
+        // blocking connect/handshake work OUTSIDE state_mu_ so attribute
+        // reads and the p2p accept path never stall behind a reconnect
+        std::vector<std::shared_ptr<net::MultiplexConn>> old_pool;
+        std::shared_ptr<net::SinkTable> table;
+        {
+            std::lock_guard lk(state_mu_);
+            auto &pc = peers_[ep.uuid];
+            pc.ep = ep;
+            old_pool = std::move(pc.tx);
+            pc.tx.clear();
+            if (!pc.tx_table) pc.tx_table = std::make_shared<net::SinkTable>();
+            table = pc.tx_table;
+        }
+        // reconnect from scratch each round: robust under churn
+        for (auto &c : old_pool)
             if (c) c->close();
-        pc.tx.clear();
+        old_pool.clear();
+
+        std::vector<std::shared_ptr<net::MultiplexConn>> pool;
         bool ok = true;
         for (size_t i = 0; i < cfg_.pool_size; ++i) {
             net::Socket s;
@@ -271,7 +301,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
                 break;
             }
             s.set_keepalive();
-            s.set_bufsizes(4 << 20);
+            s.set_bufsizes(8 << 20);
             wire::Writer w;
             proto::put_uuid(w, uuid_);
             w.u32(static_cast<uint32_t>(i));
@@ -285,18 +315,22 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
                 ok = false;
                 break;
             }
-            auto conn = std::make_shared<net::MultiplexConn>(std::move(s));
+            auto conn = std::make_shared<net::MultiplexConn>(std::move(s), table);
             conn->run();
-            pc.tx.push_back(conn);
+            pool.push_back(conn);
         }
         if (!ok) {
             failed.push_back(ep.uuid);
-            for (auto &c : pc.tx)
+            for (auto &c : pool)
                 if (c) c->close();
-            pc.tx.clear();
+        } else {
+            std::lock_guard lk(state_mu_);
+            peers_[ep.uuid].tx = std::move(pool);
         }
     }
-    // drop peers no longer in the world
+    // drop peers no longer in the world (close outside the lock: close joins
+    // the conns' RX/TX threads)
+    std::vector<std::shared_ptr<net::MultiplexConn>> to_close;
     {
         std::lock_guard lk(state_mu_);
         std::set<proto::Uuid> alive;
@@ -304,15 +338,16 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
         for (auto it = peers_.begin(); it != peers_.end();) {
             if (!alive.count(it->first)) {
                 for (auto &c : it->second.tx)
-                    if (c) c->close();
+                    if (c) to_close.push_back(c);
                 for (auto &c : it->second.rx)
-                    if (c) c->close();
+                    if (c) to_close.push_back(c);
                 it = peers_.erase(it);
             } else {
                 ++it;
             }
         }
     }
+    for (auto &c : to_close) c->close();
     return failed.empty() ? Status::kOk : Status::kInternal;
 }
 
@@ -442,28 +477,27 @@ Status Client::optimize_topology() {
 
 // ---------------- conn lookup ----------------
 
-std::shared_ptr<net::MultiplexConn> Client::tx_conn(const proto::Uuid &peer, size_t idx) {
+net::Link Client::tx_link(const proto::Uuid &peer) {
     std::lock_guard lk(state_mu_);
     auto it = peers_.find(peer);
-    if (it == peers_.end() || it->second.tx.empty()) return nullptr;
-    return it->second.tx[idx % it->second.tx.size()];
+    if (it == peers_.end() || it->second.tx.empty()) return {};
+    return net::Link(it->second.tx, it->second.tx_table);
 }
 
-std::shared_ptr<net::MultiplexConn> Client::rx_conn(const proto::Uuid &peer, size_t idx,
-                                                    int timeout_ms) {
+net::Link Client::rx_link(const proto::Uuid &peer, int timeout_ms) {
     auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    while (std::chrono::steady_clock::now() < deadline) {
-        {
-            std::lock_guard lk(state_mu_);
-            auto it = peers_.find(peer);
-            if (it != peers_.end() && !it->second.rx.empty()) {
-                auto c = it->second.rx[idx % it->second.rx.size()];
-                if (c && c->alive()) return c;
+    std::unique_lock lk(state_mu_);
+    while (true) {
+        auto it = peers_.find(peer);
+        if (it != peers_.end()) {
+            for (const auto &c : it->second.rx) {
+                if (c && c->alive())
+                    return net::Link(it->second.rx, it->second.rx_table);
             }
         }
-        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        if (state_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+            return {};
     }
-    return nullptr;
 }
 
 // ---------------- collectives ----------------
@@ -557,9 +591,9 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         snapshot.resize(nbytes);
         memcpy(snapshot.data(), recv, nbytes);
     }
-    auto tx = tx_conn(next, seq);
-    auto rx = rx_conn(prev, seq, 10'000);
-    if (!tx || !rx || !tx->alive()) {
+    auto tx = tx_link(next);
+    auto rx = rx_link(prev, 10'000);
+    if (!tx.valid() || !rx.valid() || !tx.alive()) {
         st = Status::kConnectionLost;
     } else {
         reduce::RingCtx ctx;

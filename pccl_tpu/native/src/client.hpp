@@ -108,6 +108,9 @@ private:
         proto::PeerEndpoint ep;
         std::vector<std::shared_ptr<net::MultiplexConn>> tx;
         std::vector<std::shared_ptr<net::MultiplexConn>> rx;
+        // pool-wide RX state: large transfers stripe across the pool into
+        // one shared sink table per direction
+        std::shared_ptr<net::SinkTable> tx_table, rx_table;
     };
     struct AsyncOp {
         std::thread worker;
@@ -130,9 +133,9 @@ private:
     void on_ss_accept(net::Socket sock);
     void on_bench_accept(net::Socket sock);
 
-    std::shared_ptr<net::MultiplexConn> tx_conn(const proto::Uuid &peer, size_t idx);
-    std::shared_ptr<net::MultiplexConn> rx_conn(const proto::Uuid &peer, size_t idx,
-                                                int timeout_ms);
+    net::Link tx_link(const proto::Uuid &peer);
+    // waits until at least one inbound conn from `peer` is up
+    net::Link rx_link(const proto::Uuid &peer, int timeout_ms);
 
     ClientConfig cfg_;
     proto::Uuid uuid_{};
@@ -142,6 +145,7 @@ private:
     net::Listener p2p_listener_, ss_listener_, bench_listener_;
 
     mutable std::mutex state_mu_;
+    std::condition_variable state_cv_; // signalled when inbound p2p conns land
     std::map<proto::Uuid, PeerConns> peers_;
     std::vector<proto::Uuid> ring_;
     uint64_t topo_revision_ = 0;

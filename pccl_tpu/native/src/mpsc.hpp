@@ -1,0 +1,65 @@
+// Lock-free intrusive MPSC queue (Vyukov-style).
+//
+// Reference parity: tinysockets' MPSC queue feeding the multiplexed socket's
+// dedicated TX thread (/root/reference/tinysockets/mpsc/include/
+// MPSCQueue.hpp, used at multiplexed_socket.cpp:129-136). Redesigned as the
+// classic intrusive exchange-based MPSC: producers do one atomic exchange +
+// one store; the single consumer walks next-pointers. No fixed capacity, no
+// CAS loops, no allocation inside the queue itself. Consumer wakeup is the
+// caller's concern (pair with park::Event).
+#pragma once
+
+#include <atomic>
+
+namespace pcclt::mpsc {
+
+struct Node {
+    std::atomic<Node *> next{nullptr};
+};
+
+// Multi-producer single-consumer queue of intrusive nodes. push() is
+// wait-free for producers. pop() must only be called from one thread.
+class Queue {
+public:
+    Queue() : head_(&stub_), tail_(&stub_) { stub_.next.store(nullptr); }
+
+    void push(Node *n) {
+        n->next.store(nullptr, std::memory_order_relaxed);
+        Node *prev = head_.exchange(n, std::memory_order_acq_rel);
+        prev->next.store(n, std::memory_order_release);
+    }
+
+    // Single-consumer pop; nullptr when empty OR when a producer is mid-push
+    // (the caller's park/retry loop absorbs the transient state). A popped
+    // node is fully detached and may be freed immediately.
+    Node *pop() {
+        Node *tail = tail_;
+        Node *next = tail->next.load(std::memory_order_acquire);
+        if (tail == &stub_) {
+            if (!next) return nullptr;
+            tail_ = next;
+            tail = next;
+            next = tail->next.load(std::memory_order_acquire);
+        }
+        if (next) {
+            tail_ = next;
+            return tail;
+        }
+        if (tail != head_.load(std::memory_order_acquire))
+            return nullptr; // producer mid-push; retry later
+        push(&stub_);       // re-link the stub behind the last element
+        next = tail->next.load(std::memory_order_acquire);
+        if (next) {
+            tail_ = next;
+            return tail;
+        }
+        return nullptr; // racing producer will finish the link; retry later
+    }
+
+private:
+    std::atomic<Node *> head_; // producers push here
+    Node *tail_;               // consumer-private
+    Node stub_;
+};
+
+} // namespace pcclt::mpsc

@@ -1,0 +1,60 @@
+// Futex-based thread parking.
+//
+// Reference parity: third_party threadpark (tpark_handle_t create/beginPark/
+// wait/wake, used by the reference's multiplexed socket TX thread and
+// send-completion handshakes, /root/reference/tinysockets/src/
+// multiplexed_socket.cpp:377-384,555-598). Redesigned as a single 32-bit
+// futex word: waiters snapshot the word and sleep until it changes; wakers
+// bump it and wake. No condition variable, no mutex — one atomic op per
+// wake on the hot path.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <ctime>
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace pcclt::park {
+
+// A 32-bit event counter threads can sleep on. Typical use:
+//   uint32_t v = ev.epoch();
+//   ... re-check predicate ...
+//   ev.wait(v, timeout_ms);   // sleeps only if nothing signalled since
+// and on the producer side: ev.signal() after publishing.
+class Event {
+public:
+    uint32_t epoch() const { return word_.load(std::memory_order_acquire); }
+
+    // Wake all waiters (and bump the epoch so racing waiters don't sleep).
+    void signal() {
+        word_.fetch_add(1, std::memory_order_release);
+        syscall(SYS_futex, reinterpret_cast<uint32_t *>(&word_), FUTEX_WAKE_PRIVATE,
+                INT32_MAX, nullptr, nullptr, 0);
+    }
+
+    // Sleep until the epoch moves past `seen` or timeout_ms elapses
+    // (timeout_ms < 0 = no timeout). Returns false on timeout.
+    bool wait(uint32_t seen, int timeout_ms = -1) const {
+        if (word_.load(std::memory_order_acquire) != seen) return true;
+        struct timespec ts, *tsp = nullptr;
+        if (timeout_ms >= 0) {
+            ts.tv_sec = timeout_ms / 1000;
+            ts.tv_nsec = static_cast<long>(timeout_ms % 1000) * 1'000'000L;
+            tsp = &ts;
+        }
+        long rc = syscall(SYS_futex,
+                          reinterpret_cast<uint32_t *>(
+                              const_cast<std::atomic<uint32_t> *>(&word_)),
+                          FUTEX_WAIT_PRIVATE, seen, tsp, nullptr, 0);
+        (void)rc; // EAGAIN (word moved) and EINTR both mean "re-check"
+        return word_.load(std::memory_order_acquire) != seen;
+    }
+
+private:
+    std::atomic<uint32_t> word_{0};
+};
+
+} // namespace pcclt::park

@@ -1,7 +1,8 @@
 #include "reduce.hpp"
 
+#include <chrono>
+#include <cstdlib>
 #include <cstring>
-#include <thread>
 #include <vector>
 
 #include "kernels.hpp"
@@ -11,6 +12,24 @@
 namespace pcclt::reduce {
 
 namespace {
+
+// PCCLT_PROF=1 → log per-op phase timings (diagnostics only)
+bool prof_enabled() {
+    static const bool on = [] {
+        const char *e = std::getenv("PCCLT_PROF");
+        return e && e[0] == '1';
+    }();
+    return on;
+}
+
+struct Prof {
+    double wait_ms = 0, compute_ms = 0, join_ms = 0, other_ms = 0;
+};
+
+using Clock = std::chrono::steady_clock;
+double ms_since(Clock::time_point t0) {
+    return std::chrono::duration<double, std::milli>(Clock::now() - t0).count();
+}
 
 constexpr uint64_t kMetaBit = 0x8000;
 constexpr size_t kSubChunk = 2 << 20; // streaming granularity (bytes)
@@ -29,21 +48,26 @@ ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
 // Wait until `target` bytes for `tag` arrived, reducing/consuming via `on_data`
 // in sub-chunk slices aligned to `elem_size`. Returns false on abort/conn loss.
 bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
-                 const std::function<void(size_t lo, size_t hi)> &on_data) {
+                 const std::function<void(size_t lo, size_t hi)> &on_data,
+                 Prof *prof = nullptr) {
     size_t consumed = 0;
     while (consumed < target) {
         size_t want = std::min(target, consumed + kSubChunk);
         // bounded wait so master aborts / peer death interrupt the stream
-        size_t filled = ctx.rx->wait_filled(tag, want, 100);
+        auto t0 = Clock::now();
+        size_t filled = ctx.rx.table().wait_filled(tag, want, 100);
+        if (prof) prof->wait_ms += ms_since(t0);
         // consume only whole elements
         size_t usable = (filled / elem_size) * elem_size;
         if (usable > consumed) {
+            t0 = Clock::now();
             on_data(consumed, usable);
+            if (prof) prof->compute_ms += ms_since(t0);
             consumed = usable;
         }
         if (consumed >= target) break;
         if (ctx.should_abort && ctx.should_abort()) return false;
-        if (!ctx.rx->alive()) return false;
+        if (!ctx.rx.alive()) return false;
     }
     return true;
 }
@@ -87,11 +111,12 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // NOTE: purge_range below also unregisters any sink still registered for
     // this op's tags (meta tags included: kMetaBit < 0x10000), waiting out a
     // busy RX write first — so every fail() exit leaves no sink pointing into
-    // the pooled scratch buffer.
+    // the pooled scratch buffer. On the TX side it acks dropped CMA
+    // descriptors so the peer's pending sends complete.
     auto restore = [&] {
         memcpy(recv, restore_src, count * esz);
-        ctx.rx->purge_range(base_tag, base_tag + 0x10000);
-        ctx.tx->purge_range(base_tag, base_tag + 0x10000);
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     };
     auto fail = [&](bool conn_lost) {
         restore();
@@ -106,27 +131,30 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     uint8_t *rx_scratch = rx_vec.data();
     std::vector<uint8_t> tx_scratch(quantized ? max_chunk * qsz : 0);
 
-    // sender thread helper: sends meta (if any) then payload on `tag`
-    struct TxJob {
-        std::thread th;
-        bool ok = true;
-    };
+    // Async TX via the conn's dedicated sender thread (or the same-host CMA
+    // descriptor path). The payload span must stay untouched until the
+    // handles complete, which stage-end join_tx guarantees.
     auto launch_tx = [&](uint64_t tag, std::vector<uint8_t> meta,
                          std::span<const uint8_t> payload) {
-        auto job = std::make_shared<TxJob>();
-        job->th = std::thread([this_ctx = &ctx, tag, meta = std::move(meta), payload,
-                               job] {
-            bool ok = true;
-            if (!meta.empty())
-                ok = this_ctx->tx->send_bytes(tag | kMetaBit, 0, meta);
-            if (ok) ok = this_ctx->tx->send_bytes(tag, 0, payload);
-            job->ok = ok;
-        });
-        return job;
+        std::vector<net::SendHandle> hs;
+        if (!meta.empty()) hs.push_back(ctx.tx.send_meta(tag | kMetaBit, std::move(meta)));
+        auto ph = ctx.tx.send_async(tag, payload, ctx.op_seq);
+        hs.insert(hs.end(), ph.begin(), ph.end());
+        return hs;
     };
-    auto join_tx = [&](const std::shared_ptr<TxJob> &job) -> bool {
-        job->th.join();
-        return job->ok;
+    Prof prof;
+    Prof *profp = prof_enabled() ? &prof : nullptr;
+    auto op_t0 = Clock::now();
+    auto join_tx = [&](const std::vector<net::SendHandle> &hs) -> bool {
+        auto t0 = Clock::now();
+        bool ok = net::Link::wait_all(hs);
+        if (profp) prof.join_ms += ms_since(t0);
+        return ok;
+    };
+    auto reg_sink = [&](uint64_t tag, uint8_t *base, size_t cap) {
+        auto t0 = Clock::now();
+        ctx.rx.table().register_sink(tag, base, cap);
+        if (profp) prof.other_ms += ms_since(t0);
     };
 
     // ---------------- phase 1: reduce-scatter ----------------
@@ -139,7 +167,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         uint8_t *send_ptr = out + send_span.start_elem * esz;
         uint8_t *recv_ptr = out + recv_span.start_elem * esz;
 
-        std::shared_ptr<TxJob> tx_job;
+        std::vector<net::SendHandle> tx_job;
         quant::Meta rx_meta;
         if (quantized) {
             auto meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype, send_ptr,
@@ -150,11 +178,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             ctx.tx_bytes += send_span.n_elems * qsz;
 
             // receive peer meta first, then streamed quantized payload
-            ctx.rx->register_sink(tag, rx_scratch, recv_span.n_elems * qsz);
-            auto mraw = ctx.rx->recv_queued(tag | kMetaBit, 60'000);
+            reg_sink(tag, rx_scratch, recv_span.n_elems * qsz);
+            auto mraw = ctx.rx.table().recv_queued(tag | kMetaBit, 60'000);
             if (!mraw) {
                 join_tx(tx_job);
-                return fail(!ctx.rx->alive());
+                return fail(!ctx.rx.alive());
             }
             auto m = quant::Meta::decode(*mraw);
             if (!m) {
@@ -168,10 +196,10 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       quant::dequantize_accumulate(
                                           rx_meta, ctx.op, rx_scratch + lo,
                                           recv_ptr + e0 * esz, e1 - e0);
-                                  });
-            ctx.rx->unregister_sink(tag);
+                                  }, profp);
+            ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
-            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
         } else {
             // stage 0 sends the pristine chunk, readable from `send` directly;
@@ -182,7 +210,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             ctx.tx_bytes += send_span.n_elems * esz;
             const uint8_t *local_ptr =
                 lazy ? src8 + recv_span.start_elem * esz : recv_ptr;
-            ctx.rx->register_sink(tag, rx_scratch, recv_span.n_elems * esz);
+            reg_sink(tag, rx_scratch, recv_span.n_elems * esz);
             bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
                                   [&](size_t lo, size_t hi) {
                                       size_t e0 = lo / esz, e1 = hi / esz;
@@ -191,10 +219,10 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                                            local_ptr + e0 * esz,
                                                            rx_scratch + lo,
                                                            e1 - e0);
-                                  });
-            ctx.rx->unregister_sink(tag);
+                                  }, profp);
+            ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
-            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * esz;
         }
     }
@@ -215,7 +243,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         uint8_t *send_ptr = out + send_span.start_elem * esz;
         uint8_t *recv_ptr = out + recv_span.start_elem * esz;
 
-        std::shared_ptr<TxJob> tx_job;
+        std::vector<net::SendHandle> tx_job;
         if (quantized) {
             if (s == 0) {
                 auto meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
@@ -229,11 +257,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             tx_job = launch_tx(tag, fwd_meta, fwd_q);
             ctx.tx_bytes += fwd_q.size();
 
-            ctx.rx->register_sink(tag, rx_scratch, recv_span.n_elems * qsz);
-            auto mraw = ctx.rx->recv_queued(tag | kMetaBit, 60'000);
+            reg_sink(tag, rx_scratch, recv_span.n_elems * qsz);
+            auto mraw = ctx.rx.table().recv_queued(tag | kMetaBit, 60'000);
             if (!mraw) {
                 join_tx(tx_job);
-                return fail(!ctx.rx->alive());
+                return fail(!ctx.rx.alive());
             }
             auto m = quant::Meta::decode(*mraw);
             if (!m) {
@@ -245,24 +273,25 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
                                       quant::dequantize_set(*m, rx_scratch + lo,
                                                             recv_ptr + e0 * esz, e1 - e0);
-                                  });
-            ctx.rx->unregister_sink(tag);
+                                  }, profp);
+            ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
-            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
-            // forward what we received on the next stage
+            // forward what we received on the next stage; the send buffer
+            // must be distinct from rx_scratch (next stage writes into it)
             fwd_q.assign(rx_scratch, rx_scratch + recv_span.n_elems * qsz);
             fwd_meta = mraw.value();
         } else {
             tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});
             ctx.tx_bytes += send_span.n_elems * esz;
             // zero-copy: incoming reduced chunk lands straight in the result
-            ctx.rx->register_sink(tag, recv_ptr, recv_span.n_elems * esz);
+            reg_sink(tag, recv_ptr, recv_span.n_elems * esz);
             bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
-                                  [](size_t, size_t) {});
-            ctx.rx->unregister_sink(tag);
+                                  [](size_t, size_t) {}, profp);
+            ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
-            if (!ok || !tx_ok) return fail(!ctx.rx->alive() || !ctx.tx->alive());
+            if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * esz;
         }
     }
@@ -270,8 +299,12 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     if (ctx.op == proto::RedOp::kAvg)
         kernels::finalize_avg(ctx.dtype, recv, count, world);
 
-    ctx.tx->purge_range(base_tag, base_tag + 0x10000);
-    ctx.rx->purge_range(base_tag, base_tag + 0x10000);
+    ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+    ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    if (profp)
+        PLOG(kInfo) << "reduce prof: total=" << ms_since(op_t0)
+                    << "ms wait=" << prof.wait_ms << " compute=" << prof.compute_ms
+                    << " join=" << prof.join_ms << " reg=" << prof.other_ms;
     return Result::kOk;
 }
 

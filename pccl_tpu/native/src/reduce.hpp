@@ -17,8 +17,8 @@ namespace pcclt::reduce {
 enum class Result : int { kOk = 0, kAborted, kConnectionLost };
 
 struct RingCtx {
-    std::shared_ptr<net::MultiplexConn> tx; // to ring successor
-    std::shared_ptr<net::MultiplexConn> rx; // from ring predecessor
+    net::Link tx; // to ring successor (striped over the p2p pool)
+    net::Link rx; // from ring predecessor
     uint32_t rank = 0, world = 0;
     uint64_t op_seq = 0;
     proto::DType dtype = proto::DType::kF32;

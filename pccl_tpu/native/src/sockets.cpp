@@ -8,7 +8,10 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
+
+#include <algorithm>
 
 #include "log.hpp"
 #include "wire.hpp"
@@ -87,6 +90,42 @@ bool Socket::send_all(const void *data, size_t n) {
     return true;
 }
 
+bool Socket::send_all2(const void *a, size_t na, const void *b, size_t nb) {
+    // gathered write: header + payload leave in one syscall, no staging copy
+    struct iovec iov[2];
+    iov[0] = {const_cast<void *>(a), na};
+    iov[1] = {const_cast<void *>(b), nb};
+    struct msghdr msg{};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = 2;
+    size_t sent = 0, total = na + nb;
+    while (sent < total) {
+        int fd = fd_.load();
+        if (fd < 0) return false;
+        ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        sent += static_cast<size_t>(w);
+        // advance the iovec past what was written
+        size_t skip = static_cast<size_t>(w);
+        while (skip > 0 && msg.msg_iovlen > 0) {
+            if (skip >= msg.msg_iov[0].iov_len) {
+                skip -= msg.msg_iov[0].iov_len;
+                ++msg.msg_iov;
+                --msg.msg_iovlen;
+            } else {
+                msg.msg_iov[0].iov_base =
+                    static_cast<uint8_t *>(msg.msg_iov[0].iov_base) + skip;
+                msg.msg_iov[0].iov_len -= skip;
+                skip = 0;
+            }
+        }
+    }
+    return true;
+}
+
 bool Socket::recv_all(void *data, size_t n) {
     auto *p = static_cast<uint8_t *>(data);
     while (n > 0) {
@@ -130,6 +169,13 @@ void Socket::set_nodelay() {
     setsockopt(fd_.load(), IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
 }
 
+void Socket::set_quickack() {
+    int fd = fd_.load();
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_QUICKACK, &one, sizeof one);
+}
+
 void Socket::set_bufsizes(int bytes) {
     int fd = fd_.load();
     if (fd < 0) return;
@@ -155,6 +201,13 @@ Addr Socket::peer_addr() const {
     return Addr{ntohl(sa.sin_addr.s_addr), ntohs(sa.sin_port)};
 }
 
+bool Socket::peer_is_loopback() const {
+    // 127.0.0.0/8. Two hosts can never reach each other via loopback, and a
+    // loopback connection can never cross a network namespace boundary, so
+    // this is a sound same-host test for the CMA fast path.
+    return (peer_addr().ip >> 24) == 127;
+}
+
 // ---------- control framing ----------
 
 bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
@@ -166,16 +219,9 @@ bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
     memcpy(hdr, &be_len, 4);
     memcpy(hdr + 4, &be_type, 2);
     std::lock_guard lk(write_mu);
-    // small frames go out in one send: two back-to-back small writes would
-    // otherwise interact badly with Nagle/delayed-ACK on control sockets
-    if (payload.size() <= 64 << 10) {
-        uint8_t buf[6 + (64 << 10)];
-        memcpy(buf, hdr, 6);
-        if (!payload.empty()) memcpy(buf + 6, payload.data(), payload.size());
-        return s.send_all(buf, 6 + payload.size());
-    }
-    if (!s.send_all(hdr, 6)) return false;
-    return s.send_all(payload.data(), payload.size());
+    // gathered write: header + payload in one segment, so control packets
+    // don't interact badly with Nagle/delayed-ACK, without a staging copy
+    return s.send_all2(hdr, 6, payload.data(), payload.size());
 }
 
 // single implementation: timeout_ms < 0 blocks forever (plain recv_all),
@@ -379,193 +425,598 @@ void ControlClient::close() {
     cv_.notify_all();
 }
 
-// ---------- MultiplexConn ----------
+// ---------- SendState ----------
 
-void MultiplexConn::run() {
-    alive_ = true;
-    rx_thread_ = std::thread([this] { rx_loop(); });
-}
-
-bool MultiplexConn::send_bytes(uint64_t tag, uint64_t seq,
-                               std::span<const uint8_t> data, size_t chunk) {
-    size_t off = 0;
-    do {
-        size_t n = std::min(chunk, data.size() - off);
-        uint8_t hdr[20];
-        uint32_t be_len = wire::to_be(static_cast<uint32_t>(16 + n));
-        uint64_t be_tag = wire::to_be(tag);
-        uint64_t be_seq = wire::to_be(seq);
-        memcpy(hdr, &be_len, 4);
-        memcpy(hdr + 4, &be_tag, 8);
-        memcpy(hdr + 12, &be_seq, 8);
-        std::lock_guard lk(write_mu_);
-        if (!sock_.send_all(hdr, 20)) return false;
-        if (n > 0 && !sock_.send_all(data.data() + off, n)) return false;
-        off += n;
-    } while (off < data.size());
-    return true;
-}
-
-void MultiplexConn::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
-    std::lock_guard lk(mu_);
-    Sink s{base, cap, 0};
-    // frames that raced ahead of registration are queued; drain them in order
-    auto it = queues_.find(tag);
-    if (it != queues_.end()) {
-        for (auto &buf : it->second) {
-            size_t n = std::min(buf.size(), s.cap - s.filled);
-            memcpy(s.base + s.filled, buf.data(), n);
-            s.filled += n;
-        }
-        queues_.erase(it);
-    }
-    sinks_[tag] = s;
-    cv_.notify_all();
-}
-
-size_t MultiplexConn::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms) {
-    std::unique_lock lk(mu_);
+bool SendState::wait(int timeout_ms) const {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (true) {
-        auto it = sinks_.find(tag);
-        if (it == sinks_.end()) return 0;
-        if (it->second.filled >= min_bytes) return it->second.filled;
-        if (!alive_.load()) return it->second.filled;
-        if (timeout_ms < 0) {
-            cv_.wait_for(lk, std::chrono::seconds(1)); // forever, re-armed
-        } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout ||
-                   std::chrono::steady_clock::now() >= deadline) {
-            auto it2 = sinks_.find(tag);
-            return it2 == sinks_.end() ? 0 : it2->second.filled;
+        uint32_t e = ev.epoch();
+        int s = status.load(std::memory_order_acquire);
+        if (s != 0) return s == 1;
+        int slice = 1000;
+        if (timeout_ms >= 0) {
+            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+            if (left <= 0) return false;
+            slice = static_cast<int>(std::min<long long>(left, 1000));
         }
+        ev.wait(e, slice);
     }
 }
 
-void MultiplexConn::unregister_sink(uint64_t tag) {
-    std::unique_lock lk(mu_);
-    // The RX thread may be mid-recv into the sink buffer outside the lock.
-    // Mark the sink cancelled: the RX thread checks between bounded slices,
-    // redirects the rest of the frame to scratch, and clears busy — the
-    // connection stays healthy. Only if the wire makes NO progress for 5 s
-    // (genuinely stalled peer) do we shutdown to free the caller's buffer.
-    auto it0 = sinks_.find(tag);
-    if (it0 != sinks_.end()) it0->second.cancel = true;
-    auto busy = [&] {
-        auto it = sinks_.find(tag);
-        return it != sinks_.end() && it->second.busy;
-    };
-    if (busy()) {
-        if (!cv_.wait_for(lk, std::chrono::seconds(5), [&] { return !busy(); })) {
-            sock_.shutdown();
-            cv_.wait(lk, [&] { return !busy(); }); // recv now fails promptly
+// ---------- SinkTable ----------
+
+void SinkTable::Sink::add_extent(size_t off, size_t end) {
+    if (off <= prefix) {
+        prefix = std::max(prefix, end);
+        // absorb any queued extents the new prefix reaches
+        auto it = extents.begin();
+        while (it != extents.end() && it->first <= prefix) {
+            prefix = std::max(prefix, it->second);
+            it = extents.erase(it);
         }
+    } else {
+        auto [it, inserted] = extents.try_emplace(off, end);
+        if (!inserted) it->second = std::max(it->second, end);
     }
+}
+
+void SinkTable::attach(const std::shared_ptr<MultiplexConn> &conn) {
+    std::lock_guard lk(mu_);
+    // drop expired members while we're here (conn churn under retries)
+    members_.erase(std::remove_if(members_.begin(), members_.end(),
+                                  [](const auto &w) { return w.expired(); }),
+                   members_.end());
+    members_.push_back(conn);
+}
+
+void SinkTable::on_conn_dead() { ev_.signal(); }
+
+void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
+    std::vector<PendingDesc> descs;
+    {
+        std::lock_guard lk(mu_);
+        Sink s;
+        s.base = base;
+        s.cap = cap;
+        // frames that raced ahead of registration were queued with their
+        // offsets; place them now
+        auto qit = queues_.find(tag);
+        if (qit != queues_.end()) {
+            for (auto &qf : qit->second) {
+                // queued frames store their offset in the first 8 bytes
+                if (qf.size() < 8) continue;
+                uint64_t off;
+                memcpy(&off, qf.data(), 8);
+                size_t n = qf.size() - 8;
+                if (off + n <= cap) {
+                    memcpy(base + off, qf.data() + 8, n);
+                    s.add_extent(off, off + n);
+                }
+            }
+            queues_.erase(qit);
+        }
+        sinks_[tag] = std::move(s);
+        auto range = pending_descs_.equal_range(tag);
+        for (auto it = range.first; it != range.second; ++it)
+            descs.push_back(it->second);
+        pending_descs_.erase(range.first, range.second);
+    }
+    ev_.signal();
+    // resolve CMA descriptors that arrived before the sink: pull the bytes
+    // now, on the registering thread (it is about to wait for them anyway)
+    for (auto &d : descs)
+        if (auto c = d.ack_conn.lock()) c->do_cma_fill(tag, d);
+}
+
+size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+    while (true) {
+        uint32_t e = ev_.epoch();
+        size_t cur;
+        {
+            std::lock_guard lk(mu_);
+            auto it = sinks_.find(tag);
+            if (it == sinks_.end()) return 0;
+            cur = it->second.prefix;
+        }
+        if (cur >= min_bytes) return cur;
+        int slice = 1000;
+        if (timeout_ms >= 0) {
+            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+            if (left <= 0) return cur;
+            slice = static_cast<int>(std::min<long long>(left, 1000));
+        }
+        ev_.wait(e, slice);
+    }
+}
+
+template <typename PredFn>
+void SinkTable::wait_not_busy(std::unique_lock<std::mutex> &lk, PredFn pred) {
+    auto start = std::chrono::steady_clock::now();
+    bool killed = false;
+    while (true) {
+        uint32_t e = ev_.epoch();
+        if (!pred()) return;
+        if (!killed &&
+            std::chrono::steady_clock::now() - start > std::chrono::seconds(5)) {
+            // the writer made no progress at all (genuinely stalled peer):
+            // kill the attached sockets so the blocked recv fails promptly
+            auto members = members_;
+            lk.unlock();
+            for (auto &w : members)
+                if (auto c = w.lock()) c->kill_socket();
+            lk.lock();
+            killed = true;
+        }
+        lk.unlock();
+        ev_.wait(e, 100);
+        lk.lock();
+    }
+}
+
+void SinkTable::unregister_sink(uint64_t tag) {
+    std::unique_lock lk(mu_);
+    auto it = sinks_.find(tag);
+    if (it == sinks_.end()) return;
+    it->second.cancel = true;
+    wait_not_busy(lk, [&] {
+        auto i = sinks_.find(tag);
+        return i != sinks_.end() && i->second.busy > 0;
+    });
     sinks_.erase(tag);
 }
 
-std::optional<std::vector<uint8_t>> MultiplexConn::recv_queued(
+std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
     uint64_t tag, int timeout_ms, const std::atomic<bool> *abort) {
-    std::unique_lock lk(mu_);
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (true) {
-        auto it = queues_.find(tag);
-        if (it != queues_.end() && !it->second.empty()) {
-            auto v = std::move(it->second.front());
-            it->second.pop_front();
-            return v;
+        uint32_t e = ev_.epoch();
+        bool dead;
+        {
+            std::lock_guard lk(mu_);
+            auto it = queues_.find(tag);
+            if (it != queues_.end() && !it->second.empty()) {
+                auto v = std::move(it->second.front());
+                it->second.pop_front();
+                // strip the 8-byte offset prefix queued frames carry
+                if (v.size() >= 8) v.erase(v.begin(), v.begin() + 8);
+                return v;
+            }
+            dead = !members_.empty();
+            for (auto &w : members_) {
+                auto c = w.lock();
+                if (c && c->alive()) {
+                    dead = false;
+                    break;
+                }
+            }
         }
-        if (!alive_.load()) return std::nullopt;
+        if (dead) return std::nullopt;
         if (abort && abort->load()) return std::nullopt;
-        cv_.wait_for(lk, std::chrono::milliseconds(50));
         if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
             return std::nullopt;
+        ev_.wait(e, 50);
     }
 }
 
-void MultiplexConn::purge_range(uint64_t lo, uint64_t hi) {
-    std::unique_lock lk(mu_);
-    for (auto &[tag, s] : sinks_)
-        if (tag >= lo && tag < hi) s.cancel = true;
-    auto any_busy = [&] {
+void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
+    std::vector<PendingDesc> dropped;
+    {
+        std::unique_lock lk(mu_);
         for (auto &[tag, s] : sinks_)
-            if (tag >= lo && tag < hi && s.busy) return true;
-        return false;
-    };
-    if (any_busy()) {
-        if (!cv_.wait_for(lk, std::chrono::seconds(5), [&] { return !any_busy(); })) {
-            sock_.shutdown(); // peer made no progress at all: last resort
-            cv_.wait(lk, [&] { return !any_busy(); });
+            if (tag >= lo && tag < hi) s.cancel = true;
+        wait_not_busy(lk, [&] {
+            for (auto &[tag, s] : sinks_)
+                if (tag >= lo && tag < hi && s.busy > 0) return true;
+            return false;
+        });
+        for (auto it = sinks_.begin(); it != sinks_.end();)
+            it = (it->first >= lo && it->first < hi) ? sinks_.erase(it) : std::next(it);
+        for (auto it = queues_.begin(); it != queues_.end();)
+            it = (it->first >= lo && it->first < hi) ? queues_.erase(it) : std::next(it);
+        for (auto it = pending_descs_.begin(); it != pending_descs_.end();) {
+            if (it->first >= lo && it->first < hi) {
+                dropped.push_back(it->second);
+                it = pending_descs_.erase(it);
+            } else {
+                ++it;
+            }
         }
     }
-    for (auto it = sinks_.begin(); it != sinks_.end();)
-        it = (it->first >= lo && it->first < hi) ? sinks_.erase(it) : std::next(it);
-    for (auto it = queues_.begin(); it != queues_.end();)
-        it = (it->first >= lo && it->first < hi) ? queues_.erase(it) : std::next(it);
+    // ack dropped descriptors so the sender's pending handle completes —
+    // the data is unwanted (op aborted), not undeliverable
+    for (auto &d : dropped)
+        if (auto c = d.ack_conn.lock()) c->send_ctl(MultiplexConn::kCmaAck, d.tag, d.off);
+}
+
+// ---------- MultiplexConn ----------
+
+namespace {
+
+size_t env_size(const char *name, size_t dflt) {
+    if (const char *e = std::getenv(name)) {
+        long long v = atoll(e);
+        if (v > 0) return static_cast<size_t>(v);
+    }
+    return dflt;
+}
+
+bool cma_enabled_env() {
+    const char *e = std::getenv("PCCLT_CMA");
+    return !(e && e[0] == '0');
+}
+
+constexpr size_t kRxSlice = 256 << 10;  // TCP sink write slice (cancel latency)
+constexpr uint32_t kMaxDataFrame = 272u << 20;
+
+// CMA read slice: cancel latency + streaming-consumer overlap granularity.
+// On a single-core host, per-slice publishing only causes context-switch
+// ping-pong between the puller and the consumer — pull in one shot there;
+// with real parallelism, 8 MiB slices let the reduction overlap the pull.
+size_t cma_slice() {
+    static const size_t v = [] {
+        long cores = sysconf(_SC_NPROCESSORS_ONLN);
+        return env_size("PCCLT_CMA_SLICE_BYTES",
+                        cores > 1 ? (8 << 20) : (256u << 20));
+    }();
+    return v;
+}
+
+} // namespace
+
+MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
+    : sock_(std::move(sock)),
+      table_(table ? std::move(table) : std::make_shared<SinkTable>()) {
+    tx_chunk_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
+    cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
+}
+
+MultiplexConn::~MultiplexConn() { close(); }
+
+void MultiplexConn::run() {
+    alive_ = true;
+    cma_ok_ = cma_enabled_env() && sock_.peer_is_loopback();
+    sock_.set_quickack();
+    table_->attach(shared_from_this());
+    rx_thread_ = std::thread([this] { rx_loop(); });
+    tx_thread_ = std::thread([this] { tx_loop(); });
+}
+
+void MultiplexConn::enqueue(SendReq *req) {
+    {
+        std::lock_guard lk(cma_mu_); // doubles as the enqueue/close gate
+        if (!closing_.load() && alive_.load()) {
+            txq_.push(req);
+            tx_ev_.signal();
+            return;
+        }
+    }
+    if (req->state) req->state->complete(false);
+    delete req;
+}
+
+SendHandle MultiplexConn::send_async(uint64_t tag, uint64_t off,
+                                     std::span<const uint8_t> payload, bool allow_cma) {
+    auto st = std::make_shared<SendState>();
+    st->tag = tag;
+    st->off = off;
+    st->span = payload;
+    auto *req = new SendReq;
+    req->kind = kData;
+    req->tag = tag;
+    req->off = off;
+    req->span = payload;
+    req->allow_cma = allow_cma;
+    req->state = st;
+    enqueue(req);
+    return st;
+}
+
+SendHandle MultiplexConn::send_copy(uint64_t tag, std::vector<uint8_t> payload) {
+    auto st = std::make_shared<SendState>();
+    st->tag = tag;
+    auto *req = new SendReq;
+    req->kind = kData;
+    req->tag = tag;
+    req->owned = std::move(payload);
+    req->span = req->owned;
+    req->allow_cma = false;
+    req->state = st;
+    enqueue(req);
+    return st;
+}
+
+bool MultiplexConn::send_bytes(uint64_t tag, std::span<const uint8_t> data,
+                               bool allow_cma) {
+    return send_async(tag, 0, data, allow_cma)->wait(-1);
+}
+
+void MultiplexConn::send_ctl(Kind kind, uint64_t tag, uint64_t off) {
+    auto *req = new SendReq;
+    req->kind = kind;
+    req->tag = tag;
+    req->off = off;
+    enqueue(req); // fire-and-forget: no state
+}
+
+bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
+                                std::span<const uint8_t> payload) {
+    uint8_t hdr[21];
+    uint32_t be_len = wire::to_be(static_cast<uint32_t>(17 + payload.size()));
+    uint64_t be_tag = wire::to_be(tag);
+    uint64_t be_off = wire::to_be(off);
+    memcpy(hdr, &be_len, 4);
+    hdr[4] = static_cast<uint8_t>(kind);
+    memcpy(hdr + 5, &be_tag, 8);
+    memcpy(hdr + 13, &be_off, 8);
+    return sock_.send_all2(hdr, 21, payload.data(), payload.size());
+}
+
+bool MultiplexConn::stream_payload(const SendReq &req) {
+    size_t off = 0;
+    do {
+        size_t n = std::min(tx_chunk_, req.span.size() - off);
+        if (!write_frame(kData, req.tag, req.off + off, req.span.subspan(off, n)))
+            return false;
+        off += n;
+    } while (off < req.span.size());
+    return true;
+}
+
+void MultiplexConn::tx_loop() {
+    while (true) {
+        mpsc::Node *n = txq_.pop();
+        if (!n) {
+            if (closing_.load() || !alive_.load()) break;
+            uint32_t e = tx_ev_.epoch();
+            if ((n = txq_.pop()) == nullptr) {
+                tx_ev_.wait(e, 100);
+                continue;
+            }
+        }
+        auto *req = static_cast<SendReq *>(n);
+        bool sock_ok = true;
+        switch (req->kind) {
+        case kData:
+            if (req->allow_cma && cma_ok_.load() && req->span.size() >= cma_min_) {
+                // same-host fast path: ship a descriptor; the receiver pulls
+                // the payload via process_vm_readv and acks. Completion is
+                // deferred to the ack (rx_loop).
+                {
+                    std::lock_guard lk(cma_mu_);
+                    pending_cma_[{req->tag, req->off}] = req->state;
+                }
+                wire::Writer w;
+                w.u32(static_cast<uint32_t>(getpid()));
+                w.u64(reinterpret_cast<uint64_t>(req->span.data()));
+                w.u64(req->span.size());
+                sock_ok = write_frame(kCmaDesc, req->tag, req->off, w.data());
+                if (!sock_ok) {
+                    std::lock_guard lk(cma_mu_);
+                    pending_cma_.erase({req->tag, req->off});
+                    req->state->complete(false);
+                }
+            } else {
+                sock_ok = stream_payload(*req);
+                if (req->state) req->state->complete(sock_ok);
+            }
+            break;
+        case kCmaAck:
+        case kCmaNack:
+            sock_ok = write_frame(req->kind, req->tag, req->off, {});
+            break;
+        case kCmaDesc:
+            break; // never enqueued directly
+        }
+        delete req;
+        if (!sock_ok) break;
+    }
+    // fail whatever is still queued. alive_ goes false under the enqueue
+    // gate so no producer can slip a request past this drain (a racer either
+    // pushed before we took the gate — its node is visible to pop() — or it
+    // sees alive_ false and fails its request itself).
+    {
+        std::lock_guard lk(cma_mu_);
+        alive_ = false;
+    }
+    mpsc::Node *n;
+    while ((n = txq_.pop()) != nullptr) {
+        auto *req = static_cast<SendReq *>(n);
+        if (req->state) req->state->complete(false);
+        delete req;
+    }
+    fail_all_pending();
+    table_->on_conn_dead();
+}
+
+void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
+    uint8_t *dst = nullptr;
+    bool drop = false;
+    {
+        std::lock_guard lk(table_->mu_);
+        auto it = table_->sinks_.find(tag);
+        if (it == table_->sinks_.end()) {
+            drop = false; // no sink at all: tell the sender to stream instead
+        } else if (it->second.cancel) {
+            drop = true; // op aborted locally: data unwanted, ack-drop
+        } else if (d.off + d.len <= it->second.cap) {
+            dst = it->second.base + d.off;
+            ++it->second.busy;
+        }
+    }
+    if (!dst) {
+        send_ctl(drop ? kCmaAck : kCmaNack, tag, d.off);
+        return;
+    }
+    bool ok = true, cancelled = false;
+    size_t off = 0;
+    while (off < d.len && ok && !cancelled) {
+        size_t want = std::min(cma_slice(), d.len - off);
+        size_t got = 0;
+        while (got < want) {
+            struct iovec liov{dst + off + got, want - got};
+            struct iovec riov{reinterpret_cast<void *>(d.addr + off + got), want - got};
+            ssize_t r = process_vm_readv(static_cast<pid_t>(d.pid), &liov, 1, &riov, 1, 0);
+            if (r <= 0) {
+                ok = false;
+                break;
+            }
+            got += static_cast<size_t>(r);
+        }
+        if (ok) {
+            // publish every slice (not just the whole payload) so a streaming
+            // consumer overlaps its reduction with the remainder of the pull
+            std::lock_guard lk(table_->mu_);
+            auto it = table_->sinks_.find(tag);
+            if (it == table_->sinks_.end() || it->second.cancel) {
+                cancelled = true;
+            } else {
+                it->second.add_extent(d.off + off, d.off + off + want);
+            }
+        }
+        off += want;
+        if (ok && !cancelled) table_->ev_.signal();
+    }
+    {
+        std::lock_guard lk(table_->mu_);
+        auto it = table_->sinks_.find(tag);
+        if (it != table_->sinks_.end()) --it->second.busy;
+    }
+    table_->ev_.signal();
+    send_ctl(ok || cancelled ? kCmaAck : kCmaNack, tag, d.off);
+    if (!ok && !cancelled)
+        PLOG(kWarn) << "CMA read from pid " << d.pid << " failed (errno " << errno
+                    << "); peer will fall back to streaming";
 }
 
 void MultiplexConn::rx_loop() {
     std::vector<uint8_t> scratch;
     while (alive_.load()) {
-        uint8_t hdr[20];
-        if (!sock_.recv_all(hdr, 20)) break;
+        uint8_t hdr[21];
+        if (!sock_.recv_all(hdr, 21)) break;
         uint32_t be_len;
-        uint64_t be_tag, be_seq;
+        uint64_t be_tag, be_off;
         memcpy(&be_len, hdr, 4);
-        memcpy(&be_tag, hdr + 4, 8);
-        memcpy(&be_seq, hdr + 12, 8);
+        uint8_t kind = hdr[4];
+        memcpy(&be_tag, hdr + 5, 8);
+        memcpy(&be_off, hdr + 13, 8);
         uint32_t len = wire::from_be(be_len);
         uint64_t tag = wire::from_be(be_tag);
-        if (len < 16 || len > (272u << 20)) {
+        uint64_t off = wire::from_be(be_off);
+        if (len < 17 || len > kMaxDataFrame) {
             PLOG(kError) << "multiplex rx: bad frame length " << len;
             break;
         }
-        size_t n = len - 16;
+        size_t n = len - 17;
 
-        // sink fast path: read straight into the registered destination.
-        // busy marks the sink so unregister/purge cannot free the buffer
-        // while we write outside the lock; the frame is read in bounded
-        // slices so a cancel request (op abort) is honoured promptly without
-        // killing the connection.
-        constexpr size_t kSlice = 256 << 10;
+        if (kind == kCmaAck || kind == kCmaNack) {
+            SendHandle st;
+            {
+                std::lock_guard lk(cma_mu_);
+                auto it = pending_cma_.find({tag, off});
+                if (it != pending_cma_.end()) {
+                    st = it->second;
+                    pending_cma_.erase(it);
+                }
+            }
+            if (st) {
+                if (kind == kCmaAck) {
+                    st->complete(true);
+                } else {
+                    // receiver could not pull: fall back to TCP streaming of
+                    // the same bytes, and stop offering CMA on this conn
+                    cma_ok_ = false;
+                    auto *req = new SendReq;
+                    req->kind = kData;
+                    req->tag = st->tag;
+                    req->off = st->off;
+                    req->span = st->span;
+                    req->allow_cma = false;
+                    req->state = st;
+                    enqueue(req);
+                }
+            }
+            continue;
+        }
+
+        if (kind == kCmaDesc) {
+            if (n != 20) {
+                PLOG(kError) << "multiplex rx: bad CMA descriptor";
+                break;
+            }
+            uint8_t buf[20];
+            if (!sock_.recv_all(buf, 20)) break;
+            SinkTable::PendingDesc d;
+            d.ack_conn = weak_from_this();
+            d.tag = tag;
+            uint32_t be_pid;
+            uint64_t be_addr, be_dlen;
+            memcpy(&be_pid, buf, 4);
+            memcpy(&be_addr, buf + 4, 8);
+            memcpy(&be_dlen, buf + 12, 8);
+            d.pid = wire::from_be(be_pid);
+            d.addr = wire::from_be(be_addr);
+            d.len = wire::from_be(be_dlen);
+            d.off = off;
+            bool have_sink;
+            {
+                std::lock_guard lk(table_->mu_);
+                have_sink = table_->sinks_.count(tag) != 0;
+                if (!have_sink) table_->pending_descs_.emplace(tag, d);
+            }
+            if (have_sink) do_cma_fill(tag, d);
+            continue;
+        }
+
+        // kData — sink fast path: read straight into the registered
+        // destination at the frame's offset. busy guards the buffer against
+        // unregister/purge while we write outside the lock; the frame is
+        // read in bounded slices so a cancel request (op abort) is honoured
+        // promptly without killing the connection.
         uint8_t *dst = nullptr;
         {
-            std::lock_guard lk(mu_);
-            auto it = sinks_.find(tag);
-            if (it != sinks_.end() && !it->second.cancel &&
-                it->second.filled + n <= it->second.cap) {
-                dst = it->second.base + it->second.filled;
-                it->second.busy = true;
+            std::lock_guard lk(table_->mu_);
+            auto it = table_->sinks_.find(tag);
+            if (it != table_->sinks_.end() && !it->second.cancel &&
+                off + n <= it->second.cap) {
+                dst = it->second.base + off;
+                ++it->second.busy;
             }
         }
         if (dst) {
             bool ok = true, cancelled = false;
-            size_t off = 0;
-            while (off < n && ok) {
-                size_t want = std::min(kSlice, n - off);
+            size_t done = 0;
+            while (done < n && ok) {
+                size_t want = std::min(kRxSlice, n - done);
                 if (!cancelled) {
-                    ok = sock_.recv_all(dst + off, want);
+                    ok = sock_.recv_all(dst + done, want);
                 } else {
                     scratch.resize(want); // drain + drop the rest of the frame
                     ok = sock_.recv_all(scratch.data(), want);
                 }
-                off += want;
-                if (ok && !cancelled && off < n) {
-                    std::lock_guard lk(mu_);
-                    auto it = sinks_.find(tag);
-                    cancelled = it == sinks_.end() || it->second.cancel;
+                done += want;
+                if (ok && !cancelled && done < n) {
+                    std::lock_guard lk(table_->mu_);
+                    auto it = table_->sinks_.find(tag);
+                    cancelled = it == table_->sinks_.end() || it->second.cancel;
                 }
             }
             {
-                std::lock_guard lk(mu_);
-                auto it = sinks_.find(tag);
-                if (it != sinks_.end()) {
-                    it->second.busy = false;
-                    if (ok && !cancelled) it->second.filled += n;
+                std::lock_guard lk(table_->mu_);
+                auto it = table_->sinks_.find(tag);
+                if (it != table_->sinks_.end()) {
+                    --it->second.busy;
+                    if (ok && !cancelled) it->second.add_extent(off, off + n);
                 }
             }
-            cv_.notify_all();
+            table_->ev_.signal();
             if (!ok) break;
         } else {
             scratch.resize(n);
@@ -574,29 +1025,116 @@ void MultiplexConn::rx_loop() {
                 // re-check: a sink may have been registered while we were in
                 // recv_all above — queueing now would strand the bytes where
                 // wait_filled never looks (this was a real deadlock)
-                std::lock_guard lk(mu_);
-                auto it = sinks_.find(tag);
-                if (it != sinks_.end() && !it->second.cancel &&
-                    it->second.filled + n <= it->second.cap) {
-                    memcpy(it->second.base + it->second.filled, scratch.data(), n);
-                    it->second.filled += n;
+                std::lock_guard lk(table_->mu_);
+                auto it = table_->sinks_.find(tag);
+                if (it != table_->sinks_.end() && !it->second.cancel &&
+                    off + n <= it->second.cap) {
+                    memcpy(it->second.base + off, scratch.data(), n);
+                    it->second.add_extent(off, off + n);
                 } else {
-                    queues_[tag].push_back(scratch);
+                    // queued frames carry their offset in the first 8 bytes
+                    std::vector<uint8_t> qf(8 + n);
+                    memcpy(qf.data(), &off, 8);
+                    if (n > 0) memcpy(qf.data() + 8, scratch.data(), n);
+                    table_->queues_[tag].push_back(std::move(qf));
                 }
             }
-            cv_.notify_all();
+            table_->ev_.signal();
         }
     }
     alive_ = false;
-    cv_.notify_all();
+    tx_ev_.signal(); // wake the TX thread so it notices and drains
+    fail_all_pending();
+    table_->on_conn_dead();
+}
+
+void MultiplexConn::fail_all_pending() {
+    std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending;
+    {
+        std::lock_guard lk(cma_mu_);
+        pending.swap(pending_cma_);
+    }
+    for (auto &[_, st] : pending) st->complete(false);
 }
 
 void MultiplexConn::close() {
-    alive_ = false;
+    // serialize concurrent closers: the loser blocks until the winner has
+    // fully torn down, then returns (concurrent join on one std::thread is
+    // UB, so exactly one thread may run the sequence below)
+    std::lock_guard close_lk(close_mu_);
+    if (closed_) return;
+    {
+        std::lock_guard lk(cma_mu_); // enqueue gate: no pushes after this
+        closing_ = true;
+        alive_ = false;
+    }
+    tx_ev_.signal();
     sock_.shutdown();
+    if (tx_thread_.joinable()) tx_thread_.join();
     if (rx_thread_.joinable()) rx_thread_.join();
+    // drain stragglers that were pushed before the gate closed
+    mpsc::Node *n;
+    while ((n = txq_.pop()) != nullptr) {
+        auto *req = static_cast<SendReq *>(n);
+        if (req->state) req->state->complete(false);
+        delete req;
+    }
+    fail_all_pending();
     sock_.close();
-    cv_.notify_all();
+    table_->on_conn_dead();
+    closed_ = true;
+}
+
+// ---------- Link ----------
+
+bool Link::alive() const {
+    for (const auto &c : conns_)
+        if (c && c->alive()) return true;
+    return false;
+}
+
+std::vector<SendHandle> Link::send_async(uint64_t tag, std::span<const uint8_t> payload,
+                                         size_t rot, bool allow_cma) {
+    std::vector<std::shared_ptr<MultiplexConn>> live;
+    for (const auto &c : conns_)
+        if (c && c->alive()) live.push_back(c);
+    if (live.empty()) {
+        auto st = std::make_shared<SendState>();
+        st->complete(false);
+        return {st};
+    }
+    auto &first = live[rot % live.size()];
+    // CMA sends have no wire bottleneck to stripe around; small payloads
+    // aren't worth the extra frames
+    constexpr size_t kStripeMin = 4 << 20;
+    if (live.size() == 1 || payload.size() < kStripeMin ||
+        (allow_cma && first->cma_eligible()))
+        return {first->send_async(tag, 0, payload, allow_cma)};
+    std::vector<SendHandle> hs;
+    size_t k = live.size();
+    size_t seg = (payload.size() + k - 1) / k;
+    seg = (seg + 4095) & ~size_t(4095); // page-align stripe boundaries
+    for (size_t i = 0, off = 0; i < k && off < payload.size(); ++i, off += seg) {
+        size_t n = std::min(seg, payload.size() - off);
+        hs.push_back(live[(rot + i) % k]->send_async(tag, off, payload.subspan(off, n),
+                                                     allow_cma));
+    }
+    return hs;
+}
+
+SendHandle Link::send_meta(uint64_t tag, std::vector<uint8_t> payload) {
+    for (const auto &c : conns_)
+        if (c && c->alive()) return c->send_copy(tag, std::move(payload));
+    auto st = std::make_shared<SendState>();
+    st->complete(false);
+    return st;
+}
+
+bool Link::wait_all(const std::vector<SendHandle> &hs, int timeout_ms) {
+    bool ok = true;
+    for (const auto &h : hs)
+        if (!h->wait(timeout_ms)) ok = false;
+    return ok;
 }
 
 } // namespace pcclt::net

@@ -3,19 +3,38 @@
 // Reference parity: tinysockets (/root/reference/tinysockets/include/
 // tinysockets.hpp) provides ServerSocket (libuv), BlockingIOSocket,
 // QueuedSocket, BlockingIOServerSocket, MultiplexedIOSocket. This layer
-// covers the same roles with a leaner, thread-per-connection design:
+// covers the same roles with a leaner design:
 //
-//   Socket        — RAII fd + sendall/recvall            (BlockingIOSocket)
-//   Listener      — accept loop on own thread            (BlockingIOServerSocket
-//                                                         + libuv ServerSocket roles)
+//   Socket        — RAII fd + sendall/recvall/writev      (BlockingIOSocket)
+//   Listener      — accept loop on own thread             (BlockingIOServerSocket
+//                                                          + libuv ServerSocket roles)
 //   ControlClient — reader thread + type/predicate-matched
-//                   receive queue                        (QueuedSocket)
-//   MultiplexConn — tag-demuxed full-duplex data plane
-//                   with registered zero-copy sinks      (MultiplexedIOSocket)
+//                   receive queue                         (QueuedSocket)
+//   MultiplexConn — tag-demuxed full-duplex data plane:
+//                   dedicated TX thread fed by a lock-free
+//                   MPSC queue (mpsc.hpp) with futex
+//                   parking (park.hpp), RX demux into a
+//                   shared SinkTable                      (MultiplexedIOSocket)
+//   SinkTable     — per-peer-link registered zero-copy RX sinks, shared by a
+//                   connection pool so large transfers can stripe across it
+//   Link          — striped send/recv view over a pool of MultiplexConns
+//
+// Same-host fast path: when a MultiplexConn's peer is on the same host
+// (loopback), bulk payloads skip the TCP stream entirely — the sender ships
+// a tiny CMA descriptor {pid, addr, len} and the RECEIVER pulls the bytes
+// straight from the sender's buffer via process_vm_readv into the registered
+// sink (one copy total, no kernel socket buffers). The receiver acks so the
+// sender knows when its buffer is reusable; any CMA failure falls back to
+// TCP streaming transparently. This is the same-host transport strategy of
+// NCCL/MPI intra-node paths, applied to the reference's WAN-oriented design
+// (the reference has no same-host fast path; multiplexed_socket.cpp always
+// streams).
 //
 // Framing:
-//   control: [u32 len][u16 type][payload]         len = 2 + payload_size
-//   data:    [u32 len][u64 tag][u64 seq][payload] len = 16 + payload_size
+//   control: [u32 len][u16 type][payload]              len = 2 + payload_size
+//   data:    [u32 len][u8 kind][u64 tag][u64 off][payload]
+//            len = 17 + payload_size; kind: 0=data @off, 1=CMA descriptor,
+//            2=CMA ack, 3=CMA nack
 #pragma once
 
 #include <atomic>
@@ -31,6 +50,9 @@
 #include <string>
 #include <thread>
 #include <vector>
+
+#include "mpsc.hpp"
+#include "park.hpp"
 
 namespace pcclt::net {
 
@@ -60,6 +82,8 @@ public:
 
     bool connect(const Addr &addr, int timeout_ms = 5000);
     bool send_all(const void *data, size_t n);
+    // gathered write: header + payload in one syscall (no staging copy)
+    bool send_all2(const void *a, size_t na, const void *b, size_t nb);
     bool recv_all(void *data, size_t n);
     // recv with timeout; returns bytes read (0 on orderly close), -1 error, -2 timeout
     ssize_t recv_some(void *data, size_t n, int timeout_ms);
@@ -71,8 +95,10 @@ public:
     bool valid() const { return fd_ >= 0; }
     int fd() const { return fd_; }
     void set_nodelay();
+    void set_quickack();
     void set_keepalive(int idle_s = 30);
     Addr peer_addr() const;
+    bool peer_is_loopback() const;
 
 private:
     std::atomic<int> fd_{-1};
@@ -143,26 +169,39 @@ private:
     std::function<void()> on_disconnect_;
 };
 
-// --- MultiplexConn: tag-demuxed bulk data plane ---
-class MultiplexConn {
+// --- data-plane send completion handle ---
+struct SendState {
+    std::atomic<int> status{0}; // 0 pending, 1 ok, 2 failed
+    park::Event ev;
+    // borrowed payload + routing, kept so a CMA nack can fall back to
+    // streaming the same bytes over TCP
+    uint64_t tag = 0, off = 0;
+    std::span<const uint8_t> span;
+
+    // true once the send completed successfully; false on failure or timeout
+    bool wait(int timeout_ms = -1) const;
+    void complete(bool ok) {
+        status.store(ok ? 1 : 2, std::memory_order_release);
+        ev.signal();
+    }
+    bool done() const { return status.load(std::memory_order_acquire) != 0; }
+};
+using SendHandle = std::shared_ptr<SendState>;
+
+class MultiplexConn;
+
+// --- SinkTable: registered RX destinations, shared across a conn pool ---
+class SinkTable {
 public:
-    explicit MultiplexConn(Socket sock) : sock_(std::move(sock)) {}
-    ~MultiplexConn() { close(); }
-
-    void run(); // spawn RX thread
-
-    // TX: splits into sub-frames of `chunk` bytes; blocking; thread-safe.
-    bool send_bytes(uint64_t tag, uint64_t seq, std::span<const uint8_t> data,
-                    size_t chunk = 4 << 20);
-
-    // Zero-copy RX: register a sink; RX thread appends payloads for `tag`
-    // in arrival order starting at base. wait_filled blocks until >= min
-    // bytes landed or timeout_ms elapsed (timeout_ms < 0 = forever); returns
-    // the current fill level so callers can poll abort conditions between
-    // bounded waits. unregister_sink blocks while the RX thread is mid-write
-    // into the sink buffer (busy flag) so the buffer can be freed safely.
+    // Zero-copy RX: register a sink; RX threads place payload bytes for
+    // `tag` at their frame offsets starting at base. wait_filled blocks
+    // until the CONTIGUOUS prefix reaches min bytes or timeout_ms elapsed
+    // (timeout_ms < 0 = forever); returns the current prefix so callers can
+    // poll abort conditions between bounded waits.
     void register_sink(uint64_t tag, uint8_t *base, size_t cap);
     size_t wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms = -1);
+    // Blocks while any RX thread is mid-write into the sink buffer so the
+    // buffer can be freed safely.
     void unregister_sink(uint64_t tag);
 
     // Queued RX for small per-tag messages (quantization metadata):
@@ -170,32 +209,143 @@ public:
     std::optional<std::vector<uint8_t>> recv_queued(uint64_t tag, int timeout_ms = -1,
                                                     const std::atomic<bool> *abort = nullptr);
 
-    // Drop all sinks and queued frames with lo <= tag < hi (end-of-op cleanup).
+    // Drop all sinks, queued frames, and pending CMA descriptors with
+    // lo <= tag < hi (end-of-op cleanup).
     void purge_range(uint64_t lo, uint64_t hi);
 
-    bool alive() const { return alive_.load(); }
-    void close();
-    Socket &socket() { return sock_; }
+    // conns sharing this table call these
+    void attach(const std::shared_ptr<MultiplexConn> &conn);
+    void on_conn_dead(); // wake all waiters so they re-check liveness
 
 private:
-    void rx_loop();
+    friend class MultiplexConn;
 
     struct Sink {
         uint8_t *base = nullptr;
         size_t cap = 0;
-        size_t filled = 0;
-        bool busy = false;   // RX thread is writing into base outside the lock
-        bool cancel = false; // unregister requested: stop writing, drain+drop
+        size_t prefix = 0;               // contiguous bytes from offset 0
+        std::map<size_t, size_t> extents; // out-of-order [off,end) past prefix
+        int busy = 0;    // RX/CMA writers currently writing outside the lock
+        bool cancel = false; // unregister requested: stop writing, drop rest
+        void add_extent(size_t off, size_t end);
+    };
+    struct PendingDesc { // CMA descriptor that arrived before its sink
+        std::weak_ptr<MultiplexConn> ack_conn; // conn to pull through and ack on
+        uint32_t pid = 0;
+        uint64_t addr = 0, len = 0, off = 0, tag = 0;
     };
 
-    Socket sock_;
-    std::mutex write_mu_;
-    std::thread rx_thread_;
-    std::atomic<bool> alive_{false};
+    // waits for !busy on sinks matching `pred`; on a 5 s stall kills the
+    // attached conns (peer made no progress at all: last resort)
+    template <typename PredFn> void wait_not_busy(std::unique_lock<std::mutex> &lk,
+                                                  PredFn pred);
+
     std::mutex mu_;
-    std::condition_variable cv_;
+    park::Event ev_;
     std::map<uint64_t, Sink> sinks_;
     std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_;
+    std::multimap<uint64_t, PendingDesc> pending_descs_;
+    std::vector<std::weak_ptr<MultiplexConn>> members_;
+};
+
+// --- MultiplexConn: tag-demuxed bulk data plane over one socket ---
+class MultiplexConn : public std::enable_shared_from_this<MultiplexConn> {
+public:
+    // A fresh SinkTable is created when `table` is null (standalone conn).
+    explicit MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table = nullptr);
+    ~MultiplexConn();
+
+    void run(); // spawn RX + TX threads
+
+    // Async TX. The payload span must stay valid and unmodified until the
+    // returned handle completes. allow_cma lets same-host transfers go
+    // through the CMA descriptor path.
+    SendHandle send_async(uint64_t tag, uint64_t off, std::span<const uint8_t> payload,
+                          bool allow_cma = true);
+    // Owned small frame (metadata): copied into the queue, completes when
+    // written to the kernel.
+    SendHandle send_copy(uint64_t tag, std::vector<uint8_t> payload);
+    // Blocking convenience (tests, small transfers).
+    bool send_bytes(uint64_t tag, std::span<const uint8_t> data, bool allow_cma = true);
+
+    SinkTable &table() { return *table_; }
+    const std::shared_ptr<SinkTable> &table_ptr() { return table_; }
+
+    bool alive() const { return alive_.load(); }
+    void close();
+    void kill_socket() { sock_.shutdown(); } // unblock stalled RX (stall handler)
+    Socket &socket() { return sock_; }
+    bool cma_eligible() const { return cma_ok_.load(); }
+
+private:
+    friend class SinkTable;
+
+    enum Kind : uint8_t { kData = 0, kCmaDesc = 1, kCmaAck = 2, kCmaNack = 3 };
+
+    struct SendReq : mpsc::Node {
+        Kind kind = kData;
+        uint64_t tag = 0, off = 0;
+        std::span<const uint8_t> span;  // borrowed (payload)
+        std::vector<uint8_t> owned;     // or owned (meta/acks)
+        bool allow_cma = false;
+        SendHandle state;               // null for fire-and-forget acks
+    };
+
+    void rx_loop();
+    void tx_loop();
+    void enqueue(SendReq *req);
+    bool write_frame(Kind kind, uint64_t tag, uint64_t off,
+                     std::span<const uint8_t> payload);
+    bool stream_payload(const SendReq &req); // TCP frames of ≤ chunk bytes
+    // receiver side: pull `d` into the registered sink via process_vm_readv,
+    // update the fill level, and ack/nack on this conn
+    void do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d);
+    void send_ctl(Kind kind, uint64_t tag, uint64_t off); // ack/nack via TX queue
+    void fail_all_pending();
+
+    Socket sock_;
+    std::shared_ptr<SinkTable> table_;
+    std::thread rx_thread_, tx_thread_;
+    std::atomic<bool> alive_{false};
+    std::atomic<bool> closing_{false};
+    std::mutex close_mu_; // serializes close(); guards closed_
+    bool closed_ = false;
+
+    mpsc::Queue txq_;
+    park::Event tx_ev_;
+
+    std::atomic<bool> cma_ok_{false}; // same-host CMA negotiated & not failed
+    std::mutex cma_mu_;
+    std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending_cma_; // (tag,off)
+
+    size_t tx_chunk_;
+    size_t cma_min_;
+};
+
+// --- Link: striped send view over a pool of conns sharing one SinkTable ---
+class Link {
+public:
+    Link() = default;
+    Link(std::vector<std::shared_ptr<MultiplexConn>> conns,
+         std::shared_ptr<SinkTable> table)
+        : conns_(std::move(conns)), table_(std::move(table)) {}
+
+    bool valid() const { return !conns_.empty() && table_; }
+    bool alive() const;
+    SinkTable &table() { return *table_; }
+
+    // Send payload for `tag`, striping across the pool when it pays off
+    // (TCP path, large payloads). Same-host CMA sends go as a single
+    // descriptor — there is no wire bottleneck to stripe around. `rot`
+    // rotates the starting conn so concurrent ops spread over the pool.
+    std::vector<SendHandle> send_async(uint64_t tag, std::span<const uint8_t> payload,
+                                       size_t rot = 0, bool allow_cma = true);
+    SendHandle send_meta(uint64_t tag, std::vector<uint8_t> payload);
+    static bool wait_all(const std::vector<SendHandle> &hs, int timeout_ms = -1);
+
+private:
+    std::vector<std::shared_ptr<MultiplexConn>> conns_;
+    std::shared_ptr<SinkTable> table_;
 };
 
 } // namespace pcclt::net
