@@ -1,12 +1,22 @@
 #!/usr/bin/env python
-"""Headline benchmark: fp32 all-reduce busbw, 2 loopback peers.
+"""Headline benchmark + BASELINE.md config sweep.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
+Headline (BASELINE config 1): fp32 all-reduce busbw, 2 loopback peers.
 Baseline: the reference's best sustained all-reduce number is 45 Gbit/s
 (= 5.625 GB/s, collocated nodes, "limited only by NIC speed" —
 /root/reference/docs/md/01_Introduction.md:8; see BASELINE.md). vs_baseline is
 value / 5.625.
+
+"extra" carries the remaining BASELINE configs (all on the native stack):
+  quant4_busbw_gbps     — config 2: int8-ZPS quantized concurrent reduces,
+                          4 peers (reference concurrent_reduce_test workload)
+  shared_state4_step_s  — config 3: SyncSharedState + allreduce per step,
+                          4 peers
+  diloco_outer_step_s   — DiLoCo outer-step wall-clock, 100M params, 2 peers
+
+PCCLT_BENCH_FAST=1 skips the extra configs (headline only).
 """
 
 import json
@@ -21,6 +31,7 @@ def main() -> None:
     iters = int(os.environ.get("PCCLT_BENCH_ITERS", "10"))
 
     busbw = None
+    extra = {}
     try:
         from pccl_tpu.comm import native_bench  # native C++ stack, preferred
 
@@ -34,11 +45,25 @@ def main() -> None:
         busbw = pybench.run_allreduce_bench(nbytes=nbytes, iters=iters)
         path = "python-fallback"
 
+    if path == "native" and os.environ.get("PCCLT_BENCH_FAST", "0") != "1":
+        for key, fn in [
+            ("quant4_busbw_gbps", native_bench.run_quantized_concurrent_bench),
+            ("shared_state4_step_s", native_bench.run_shared_state_bench),
+            ("diloco_outer_step_s", native_bench.run_diloco_outer_bench),
+        ]:
+            try:
+                extra[key] = round(fn(), 4)
+            except Exception as e:  # noqa: BLE001 — extras must not kill headline
+                print(f"bench: {key} failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+                extra[key] = None
+
     print(json.dumps({
         "metric": f"allreduce_busbw_fp32_2peer_loopback({path})",
         "value": round(busbw, 3),
         "unit": "GB/s",
         "vs_baseline": round(busbw / BASELINE_GBPS, 3),
+        "extra": extra,
     }))
 
 

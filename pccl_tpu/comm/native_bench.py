@@ -1,10 +1,19 @@
-"""Native-stack loopback all-reduce benchmark (bench.py's preferred path).
+"""Native-stack loopback benchmarks (bench.py's preferred path).
 
-Matches BASELINE.md config 1: fp32 ring all-reduce, 2 loopback peers, over
-the real native stack (master + 2 communicator processes, PCCP wire
-protocol). busbw for a ring all-reduce = 2*(N-1)/N * bytes / time; N=2 →
-bytes/time. The reference's equivalent harness is
-tests/basic_reduce_test/main.cpp (fp32 loop over loopback peers).
+Covers the BASELINE.md target configs over the real native stack (master +
+communicator processes, PCCP wire protocol):
+
+1. ``run_allreduce_bench``            — fp32 ring all-reduce, 2 loopback
+   peers; busbw = 2*(N-1)/N * bytes/t; N=2 -> bytes/t. Mirrors the
+   reference's tests/basic_reduce_test/main.cpp.
+2. ``run_quantized_concurrent_bench`` — int8 zero-point/scale quantized
+   concurrent reduces, 4 loopback peers. Mirrors the reference's
+   tests/concurrent_reduce_test/main.cpp:48-50 (the
+   pcclAllReduceMultipleWithRetry workload).
+3. ``run_shared_state_bench``         — per-step SyncSharedState + one
+   all-reduce, 4 peers. Mirrors the python examples' training-step shape.
+4. ``run_diloco_outer_bench``         — DiLoCo outer-step wall-clock at
+   ``params_n`` parameters, 2 peers (device staging + AVG ring + outer SGD).
 """
 
 from __future__ import annotations
@@ -12,22 +21,73 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import time
+from typing import Any, Callable, Dict, List
 
 import numpy as np
 
 
-def _peer_main(rank: int, master_port: int, nbytes: int, iters: int, q) -> None:
-    from pccl_tpu.comm.api import Communicator, ReduceOp
+def _port(env: str, dflt: int) -> int:
+    return int(os.environ.get(env, str(dflt)))
+
+
+def _spawn_world(world: int, peer_main: Callable, master_port: int,
+                 args: tuple = (), inline_rank0: bool = True,
+                 timeout_s: int = 300) -> List[Dict[str, Any]]:
+    """Run `peer_main(rank, master_port, q, *args)` in `world` processes
+    (rank 0 inline unless `inline_rank0` is False — peers that mutate global
+    process state, e.g. jax platform config, must not run in the caller) and
+    return each peer's result dict."""
+    from pccl_tpu.comm.api import MasterNode
+
+    master = MasterNode("0.0.0.0", master_port)
+    master.run()
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = []
+        for r in range(0 if not inline_rank0 else 1, world):
+            p = ctx.Process(target=peer_main, args=(r, master.port, q) + args)
+            p.start()
+            procs.append(p)
+        try:
+            if inline_rank0:
+                peer_main(0, master.port, q, *args)
+            results = [q.get(timeout=timeout_s) for _ in range(world)]
+            for p in procs:
+                p.join(timeout=30)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=5)
+        return results
+    finally:
+        master.interrupt()
+        master.destroy()
+
+
+def _connect(rank: int, master_port: int, world: int, port_base: int):
+    """Join and wait until the group reaches `world` peers."""
+    from pccl_tpu.comm.api import Communicator
 
     comm = Communicator("127.0.0.1", master_port,
-                        p2p_port=48700 + rank * 4, ss_port=48740 + rank * 4,
-                        bench_port=48780 + rank * 4)
+                        p2p_port=port_base + rank * 4,
+                        ss_port=port_base + 1000 + rank * 4,
+                        bench_port=port_base + 2000 + rank * 4)
     comm.connect()
-    while comm.world_size < 2:
+    while comm.world_size < world:
         if comm.are_peers_pending():
             comm.update_topology()
         time.sleep(0.02)
+    return comm
 
+
+# ---------------------------------------------------------------- config 1
+
+def _peer_allreduce(rank, master_port, q, nbytes, iters):
+    from pccl_tpu.comm.api import ReduceOp
+
+    comm = _connect(rank, master_port, 2, 48700)
     count = nbytes // 4
     x = np.full(count, float(rank + 1), dtype=np.float32)
     y = np.empty_like(x)
@@ -38,34 +98,122 @@ def _peer_main(rank: int, master_port: int, nbytes: int, iters: int, q) -> None:
         comm.all_reduce(x, y, op=ReduceOp.SUM)
         times.append(time.perf_counter() - t0)
     assert abs(float(y[0]) - 3.0) < 1e-6, f"allreduce wrong: {y[0]}"
-    if q is not None:
-        q.put(times)
+    q.put({"rank": rank, "times": times})
     comm.destroy()
 
 
 def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10) -> float:
     """Returns busbw in GB/s (median over iters)."""
-    from pccl_tpu.comm.api import MasterNode
+    res = _spawn_world(2, _peer_allreduce, _port("PCCLT_BENCH_MASTER_PORT", 48651),
+                       (nbytes, iters))
+    times = next(r["times"] for r in res if r["rank"] == 0)
+    med = sorted(times)[len(times) // 2]
+    return (nbytes / med) / 1e9
 
-    master = MasterNode("0.0.0.0", int(os.environ.get("PCCLT_BENCH_MASTER_PORT",
-                                                      "48651")))
-    master.run()
-    try:
-        ctx = mp.get_context("spawn")
-        q = ctx.Queue()
-        p1 = ctx.Process(target=_peer_main,
-                         args=(1, master.port, nbytes, iters, None))
-        p1.start()
-        try:
-            _peer_main(0, master.port, nbytes, iters, q)
-            times = q.get(timeout=120)
-            p1.join(timeout=30)
-        finally:
-            if p1.is_alive():
-                p1.terminate()
-                p1.join(timeout=5)
-        med = sorted(times)[len(times) // 2]
-        return (nbytes / med) / 1e9
-    finally:
-        master.interrupt()
-        master.destroy()
+
+# ---------------------------------------------------------------- config 2
+
+def _peer_quant(rank, master_port, q, world, n_tensors, elems, iters):
+    from pccl_tpu.comm.api import DataType, QuantizationAlgorithm, ReduceOp
+
+    comm = _connect(rank, master_port, world, 48790)
+    rng = np.random.default_rng(1234 + rank)
+    tensors = [rng.standard_normal(elems).astype(np.float32)
+               for _ in range(n_tensors)]
+    times = []
+    for it in range(iters + 1):  # first iter is warmup
+        t0 = time.perf_counter()
+        comm.all_reduce_multiple_with_retry(
+            tensors, op=ReduceOp.AVG,
+            quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+            quantized_dtype=DataType.INT8)
+        if it > 0:
+            times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_quantized_concurrent_bench(world: int = 4, n_tensors: int = 4,
+                                   elems: int = 2 << 20, iters: int = 5) -> float:
+    """int8-ZPS quantized concurrent reduces; returns payload busbw GB/s:
+    2*(N-1)/N * fp32_bytes / median step time."""
+    res = _spawn_world(world, _peer_quant, _port("PCCLT_BENCH_MASTER_PORT2", 48653),
+                       (world, n_tensors, elems, iters))
+    times = next(r["times"] for r in res if r["rank"] == 0)
+    med = sorted(times)[len(times) // 2]
+    payload = n_tensors * elems * 4
+    return (2 * (world - 1) / world) * payload / med / 1e9
+
+
+# ---------------------------------------------------------------- config 3
+
+def _peer_shared_state(rank, master_port, q, world, elems, iters):
+    from pccl_tpu.comm.api import ReduceOp, SharedState, TensorInfo
+
+    comm = _connect(rank, master_port, world, 48880)
+    params = np.zeros(elems, dtype=np.float32)
+    grad = np.full(elems, float(rank + 1), dtype=np.float32)
+    out = np.empty_like(grad)
+    times = []
+    for it in range(iters + 1):
+        t0 = time.perf_counter()
+        state = SharedState(
+            infos=[TensorInfo.from_numpy("params", params)], revision=it)
+        comm.sync_shared_state(state)
+        comm.all_reduce(grad, out, op=ReduceOp.AVG)
+        params += 0.01 * out  # all peers apply the same update -> stays in sync
+        if it > 0:
+            times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_shared_state_bench(world: int = 4, elems: int = 4 << 20,
+                           iters: int = 5) -> float:
+    """SharedState sync + AVG all-reduce per step; returns median step
+    seconds."""
+    res = _spawn_world(world, _peer_shared_state,
+                       _port("PCCLT_BENCH_MASTER_PORT3", 48655),
+                       (world, elems, iters))
+    times = next(r["times"] for r in res if r["rank"] == 0)
+    return sorted(times)[len(times) // 2]
+
+
+# ---------------------------------------------------------------- config 4
+
+def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # peers must not fight over the chip
+    import jax.numpy as jnp
+
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+
+    comm = _connect(rank, master_port, world, 48960)
+    params = {"w": jnp.zeros((params_n,), jnp.float32)}
+    diloco = Diloco(comm, params, DilocoConfig())
+    # synthetic inner step: outer params minus a fake gradient update.
+    # 2 warmup steps: the first outer steps pay one-time jit compiles of the
+    # param-sized codec/apply graphs
+    times = []
+    cur = diloco.params()
+    for it in range(outer_steps + 2):
+        inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
+        t0 = time.perf_counter()
+        cur = diloco.outer_step(inner)
+        if it >= 2:
+            times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_diloco_outer_bench(world: int = 2, params_n: int = 100_000_000,
+                           outer_steps: int = 3) -> float:
+    """DiLoCo outer-step wall-clock (device staging + AVG ring + outer SGD)
+    at `params_n` parameters; returns median outer-step seconds."""
+    res = _spawn_world(world, _peer_diloco,
+                       _port("PCCLT_BENCH_MASTER_PORT4", 48657),
+                       (world, params_n, outer_steps), inline_rank0=False,
+                       timeout_s=600)
+    times = next(r["times"] for r in res if r["rank"] == 0)
+    return sorted(times)[len(times) // 2]

@@ -10,6 +10,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <ctime>
 
@@ -56,5 +57,28 @@ public:
 private:
     std::atomic<uint32_t> word_{0};
 };
+
+// Wait until `pred()` holds or `timeout_ms` elapses (timeout_ms < 0 = no
+// timeout). The epoch is snapshotted BEFORE each predicate check so a signal
+// between check and sleep is never lost. `pred` is responsible for its own
+// locking. Returns the final predicate value.
+template <typename Pred>
+bool wait_event(const Event &ev, int timeout_ms, Pred &&pred) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+    while (true) {
+        uint32_t e = ev.epoch();
+        if (pred()) return true;
+        int slice = 1000;
+        if (timeout_ms >= 0) {
+            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+            if (left <= 0) return pred();
+            slice = static_cast<int>(left < 1000 ? left : 1000);
+        }
+        ev.wait(e, slice);
+    }
+}
 
 } // namespace pcclt::park

@@ -1,3 +1,14 @@
+// Quantization kernels.
+//
+// Reference parity: the reference implements MinMax with templated SIMD
+// kernels (/root/reference/ccoip/src/cpp/quantize_kernels.cpp:38-83) and
+// delegates ZeroPointScale to piquant, with a fused dequantize+accumulate
+// in reduce_kernels.cpp:361-427. Here both algorithms share one design:
+// typed `#pragma omp simd` template kernels for the f32/f64 -> u8/u16/u32/i8
+// hot paths (single-precision arithmetic for f32 sources — ~8x the scalar
+// double path), with a generic scalar fallback that also covers f16/bf16.
+// All peers run identical code, so cross-peer bit parity of the
+// quantize -> dequantize round trip is preserved by construction.
 #include "quantize.hpp"
 
 #include <algorithm>
@@ -41,6 +52,8 @@ size_t quantized_bytes(DType q_dtype, size_t count) {
 }
 
 namespace {
+
+// ---------- generic scalar fallback (f16/bf16 + exotic combos) ----------
 
 // read element i of a float-typed source as double
 template <typename T> double get_as_double(const void *p, size_t i) {
@@ -105,6 +118,109 @@ double load_quant(DType qd, const void *q, size_t i) {
     }
 }
 
+// ---------- typed SIMD kernels (f32/f64 sources) ----------
+
+// S: float or double. Arithmetic runs in S — for f32 sources that means
+// single precision end to end, which vectorizes 2x wider than double.
+
+template <typename S, typename Q>
+void k_quant_minmax(const S *src, Q *out, size_t n, S lo, S inv, S qmax) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) {
+        S v = (src[i] - lo) * inv;
+        v = v < S(0) ? S(0) : (v > qmax ? qmax : v);
+        out[i] = static_cast<Q>(v + S(0.5)); // v >= 0: floor(v+.5) == round
+    }
+}
+
+template <typename S, typename Q>
+void k_quant_zps(const S *src, Q *out, size_t n, S inv_scale, S zp, S qlo, S qhi) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) {
+        // shift into the non-negative domain so the +0.5 rounding trick holds
+        S v = src[i] * inv_scale + zp - qlo;
+        S span = qhi - qlo;
+        v = v < S(0) ? S(0) : (v > span ? span : v);
+        out[i] = static_cast<Q>(static_cast<S>(static_cast<int64_t>(v + S(0.5))) + qlo);
+    }
+}
+
+template <typename S, typename Q>
+void k_dq_set_minmax(const Q *q, S *dst, size_t n, S lo, S step) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) dst[i] = lo + static_cast<S>(q[i]) * step;
+}
+
+template <typename S, typename Q>
+void k_dq_set_zps(const Q *q, S *dst, size_t n, S scale, S zp) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i) dst[i] = (static_cast<S>(q[i]) - zp) * scale;
+}
+
+struct AddOp {
+    template <typename S> S operator()(S a, S b) const { return a + b; }
+};
+struct MulOp {
+    template <typename S> S operator()(S a, S b) const { return a * b; }
+};
+struct MaxOp {
+    template <typename S> S operator()(S a, S b) const { return a > b ? a : b; }
+};
+struct MinOp {
+    template <typename S> S operator()(S a, S b) const { return a < b ? a : b; }
+};
+
+template <typename S, typename Q, typename Op>
+void k_dq_acc_minmax(const Q *q, S *dst, size_t n, S lo, S step, Op op) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i)
+        dst[i] = op(dst[i], lo + static_cast<S>(q[i]) * step);
+}
+
+template <typename S, typename Q, typename Op>
+void k_dq_acc_zps(const Q *q, S *dst, size_t n, S scale, S zp, Op op) {
+#pragma omp simd
+    for (size_t i = 0; i < n; ++i)
+        dst[i] = op(dst[i], (static_cast<S>(q[i]) - zp) * scale);
+}
+
+// min/max scan; omp simd reduction licenses the reassociation
+template <typename S> void k_minmax_scan(const S *src, size_t n, S &lo_out, S &hi_out) {
+    S lo = src[0], hi = src[0];
+#pragma omp simd reduction(min : lo) reduction(max : hi)
+    for (size_t i = 0; i < n; ++i) {
+        lo = lo < src[i] ? lo : src[i];
+        hi = hi > src[i] ? hi : src[i];
+    }
+    lo_out = lo;
+    hi_out = hi;
+}
+
+// dispatch (src f32/f64) x (q u8/u16/u32/i8) to fn.template operator()<S,Q>;
+// returns false when the combo has no typed kernel (caller uses the scalar
+// fallback)
+template <typename Fn> bool dispatch_typed(DType src, DType q, Fn &&fn) {
+    auto with_q = [&](auto s_tag) {
+        using S = decltype(s_tag);
+        switch (q) {
+        case DType::kU8: fn(S{}, uint8_t{}); return true;
+        case DType::kU16: fn(S{}, uint16_t{}); return true;
+        case DType::kU32:
+            // float cannot represent 2^32-1: the rounding trick would
+            // overflow the cast — that combo takes the scalar double path
+            if constexpr (std::is_same_v<S, float>) return false;
+            else { fn(S{}, uint32_t{}); return true; }
+        case DType::kI8: fn(S{}, int8_t{}); return true;
+        default: return false;
+        }
+    };
+    switch (src) {
+    case DType::kF32: return with_q(float{});
+    case DType::kF64: return with_q(double{});
+    default: return false;
+    }
+}
+
 } // namespace
 
 Meta compute_meta(QuantAlgo algo, DType q_dtype, DType src_dtype, const void *src,
@@ -115,12 +231,22 @@ Meta compute_meta(QuantAlgo algo, DType q_dtype, DType src_dtype, const void *sr
     m.q_dtype = q_dtype;
     if (algo == QuantAlgo::kNone || count == 0) return m;
 
-    double lo = std::numeric_limits<double>::infinity();
-    double hi = -std::numeric_limits<double>::infinity();
-    for (size_t i = 0; i < count; ++i) {
-        double v = load_elem(src_dtype, src, i);
-        lo = std::min(lo, v);
-        hi = std::max(hi, v);
+    double lo, hi;
+    if (src_dtype == DType::kF32) {
+        float l, h;
+        k_minmax_scan(static_cast<const float *>(src), count, l, h);
+        lo = l;
+        hi = h;
+    } else if (src_dtype == DType::kF64) {
+        k_minmax_scan(static_cast<const double *>(src), count, lo, hi);
+    } else {
+        lo = std::numeric_limits<double>::infinity();
+        hi = -lo;
+        for (size_t i = 0; i < count; ++i) {
+            double v = load_elem(src_dtype, src, i);
+            lo = std::min(lo, v);
+            hi = std::max(hi, v);
+        }
     }
     if (!std::isfinite(lo) || !std::isfinite(hi)) {
         lo = 0.0;
@@ -142,9 +268,17 @@ Meta compute_meta(QuantAlgo algo, DType q_dtype, DType src_dtype, const void *sr
 
 void quantize(const Meta &m, const void *src, void *q_out, size_t count) {
     if (m.algo == QuantAlgo::kMinMax) {
-        double range = m.hi - m.lo;
-        double qmax = qmax_of(m.q_dtype);
-        double inv = range > 0 ? qmax / range : 0.0;
+        const double range = m.hi - m.lo;
+        const double qmax = qmax_of(m.q_dtype);
+        const double inv = range > 0 ? qmax / range : 0.0;
+        bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
+            using S = decltype(s_tag);
+            using Q = decltype(q_tag);
+            k_quant_minmax<S, Q>(static_cast<const S *>(src), static_cast<Q *>(q_out),
+                                 count, static_cast<S>(m.lo), static_cast<S>(inv),
+                                 static_cast<S>(qmax));
+        });
+        if (done) return;
         for (size_t i = 0; i < count; ++i) {
             double v = load_elem(m.src_dtype, src, i);
             double q = std::round((v - m.lo) * inv);
@@ -152,9 +286,17 @@ void quantize(const Meta &m, const void *src, void *q_out, size_t count) {
             store_quant(m.q_dtype, q_out, i, q);
         }
     } else { // ZPS: q = round(x/scale) + zp
-        double scale = m.lo, zp = m.hi;
-        double qlo = m.q_dtype == DType::kI8 ? -128.0 : 0.0;
-        double qhi = m.q_dtype == DType::kI8 ? 127.0 : qmax_of(m.q_dtype);
+        const double scale = m.lo, zp = m.hi;
+        const double qlo = m.q_dtype == DType::kI8 ? -128.0 : 0.0;
+        const double qhi = m.q_dtype == DType::kI8 ? 127.0 : qmax_of(m.q_dtype);
+        bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
+            using S = decltype(s_tag);
+            using Q = decltype(q_tag);
+            k_quant_zps<S, Q>(static_cast<const S *>(src), static_cast<Q *>(q_out),
+                              count, static_cast<S>(1.0 / scale), static_cast<S>(zp),
+                              static_cast<S>(qlo), static_cast<S>(qhi));
+        });
+        if (done) return;
         for (size_t i = 0; i < count; ++i) {
             double v = load_elem(m.src_dtype, src, i);
             double q = std::clamp(std::round(v / scale) + zp, qlo, qhi);
@@ -175,14 +317,55 @@ double dequant_elem(const Meta &m, const void *q, size_t i) {
     return (qv - m.hi) * m.lo; // (q - zp) * scale
 }
 
+// step = range/qmax for MinMax (0 when the range collapses)
+double minmax_step(const Meta &m) {
+    double range = m.hi - m.lo;
+    return range > 0 ? range / qmax_of(m.q_dtype) : 0.0;
+}
+
 } // namespace
 
 void dequantize_set(const Meta &m, const void *q, void *dst, size_t count) {
+    bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
+        using S = decltype(s_tag);
+        using Q = decltype(q_tag);
+        if (m.algo == QuantAlgo::kMinMax)
+            k_dq_set_minmax<S, Q>(static_cast<const Q *>(q), static_cast<S *>(dst),
+                                  count, static_cast<S>(m.lo),
+                                  static_cast<S>(minmax_step(m)));
+        else
+            k_dq_set_zps<S, Q>(static_cast<const Q *>(q), static_cast<S *>(dst), count,
+                               static_cast<S>(m.lo), static_cast<S>(m.hi));
+    });
+    if (done) return;
     for (size_t i = 0; i < count; ++i) store_elem(m.src_dtype, dst, i, dequant_elem(m, q, i));
 }
 
 void dequantize_accumulate(const Meta &m, proto::RedOp op, const void *q, void *dst,
                            size_t count) {
+    bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
+        using S = decltype(s_tag);
+        using Q = decltype(q_tag);
+        auto *qs = static_cast<const Q *>(q);
+        auto *ds = static_cast<S *>(dst);
+        auto run = [&](auto red) {
+            if (m.algo == QuantAlgo::kMinMax)
+                k_dq_acc_minmax<S, Q>(qs, ds, count, static_cast<S>(m.lo),
+                                      static_cast<S>(minmax_step(m)), red);
+            else
+                k_dq_acc_zps<S, Q>(qs, ds, count, static_cast<S>(m.lo),
+                                   static_cast<S>(m.hi), red);
+        };
+        switch (op) {
+        case proto::RedOp::kSum:
+        case proto::RedOp::kAvg: run(AddOp{}); break;
+        case proto::RedOp::kProd: run(MulOp{}); break;
+        case proto::RedOp::kMax: run(MaxOp{}); break;
+        case proto::RedOp::kMin: run(MinOp{}); break;
+        default: run(AddOp{});
+        }
+    });
+    if (done) return;
     for (size_t i = 0; i < count; ++i) {
         double v = dequant_elem(m, q, i);
         double d = load_elem(m.src_dtype, dst, i);

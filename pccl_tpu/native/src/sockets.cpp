@@ -12,6 +12,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <random>
 
 #include "log.hpp"
 #include "wire.hpp"
@@ -428,22 +429,9 @@ void ControlClient::close() {
 // ---------- SendState ----------
 
 bool SendState::wait(int timeout_ms) const {
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
-    while (true) {
-        uint32_t e = ev.epoch();
-        int s = status.load(std::memory_order_acquire);
-        if (s != 0) return s == 1;
-        int slice = 1000;
-        if (timeout_ms >= 0) {
-            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
-                            deadline - std::chrono::steady_clock::now())
-                            .count();
-            if (left <= 0) return false;
-            slice = static_cast<int>(std::min<long long>(left, 1000));
-        }
-        ev.wait(e, slice);
-    }
+    park::wait_event(ev, timeout_ms,
+                     [&] { return status.load(std::memory_order_acquire) != 0; });
+    return status.load(std::memory_order_acquire) == 1;
 }
 
 // ---------- SinkTable ----------
@@ -512,28 +500,18 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
 }
 
 size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms) {
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
-    while (true) {
-        uint32_t e = ev_.epoch();
-        size_t cur;
-        {
-            std::lock_guard lk(mu_);
-            auto it = sinks_.find(tag);
-            if (it == sinks_.end()) return 0;
-            cur = it->second.prefix;
+    size_t cur = 0;
+    park::wait_event(ev_, timeout_ms, [&] {
+        std::lock_guard lk(mu_);
+        auto it = sinks_.find(tag);
+        if (it == sinks_.end()) {
+            cur = 0;
+            return true;
         }
-        if (cur >= min_bytes) return cur;
-        int slice = 1000;
-        if (timeout_ms >= 0) {
-            auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
-                            deadline - std::chrono::steady_clock::now())
-                            .count();
-            if (left <= 0) return cur;
-            slice = static_cast<int>(std::min<long long>(left, 1000));
-        }
-        ev_.wait(e, slice);
-    }
+        cur = it->second.prefix;
+        return cur >= min_bytes;
+    });
+    return cur;
 }
 
 template <typename PredFn>
@@ -574,10 +552,8 @@ void SinkTable::unregister_sink(uint64_t tag) {
 
 std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
     uint64_t tag, int timeout_ms, const std::atomic<bool> *abort) {
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
-    while (true) {
-        uint32_t e = ev_.epoch();
+    std::optional<std::vector<uint8_t>> out;
+    park::wait_event(ev_, timeout_ms, [&] {
         bool dead;
         {
             std::lock_guard lk(mu_);
@@ -587,7 +563,8 @@ std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
                 it->second.pop_front();
                 // strip the 8-byte offset prefix queued frames carry
                 if (v.size() >= 8) v.erase(v.begin(), v.begin() + 8);
-                return v;
+                out = std::move(v);
+                return true;
             }
             dead = !members_.empty();
             for (auto &w : members_) {
@@ -598,12 +575,10 @@ std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
                 }
             }
         }
-        if (dead) return std::nullopt;
-        if (abort && abort->load()) return std::nullopt;
-        if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
-            return std::nullopt;
-        ev_.wait(e, 50);
-    }
+        if (dead) return true;                  // no frame will ever arrive
+        return abort && abort->load();          // caller-requested abort
+    });
+    return out;
 }
 
 void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
@@ -687,6 +662,24 @@ void MultiplexConn::run() {
     table_->attach(shared_from_this());
     rx_thread_ = std::thread([this] { rx_loop(); });
     tx_thread_ = std::thread([this] { tx_loop(); });
+    if (cma_ok_.load()) {
+        // announce CMA identity: pid + address of a random in-process token.
+        // The receiver probe-reads the token before every pull, proving the
+        // pid resolves to this process in ITS pid namespace (raw pids are
+        // not namespace-safe and can be reused across restarts).
+        cma_token_ = std::make_unique<std::array<uint8_t, 16>>();
+        std::random_device rd;
+        for (auto &b : *cma_token_) b = static_cast<uint8_t>(rd());
+        wire::Writer w;
+        w.u32(static_cast<uint32_t>(getpid()));
+        w.u64(reinterpret_cast<uint64_t>(cma_token_->data()));
+        w.raw(cma_token_->data(), 16);
+        auto *req = new SendReq;
+        req->kind = kCmaHello;
+        req->owned = w.take();
+        req->span = req->owned;
+        enqueue(req);
+    }
 }
 
 void MultiplexConn::enqueue(SendReq *req) {
@@ -812,6 +805,9 @@ void MultiplexConn::tx_loop() {
         case kCmaNack:
             sock_ok = write_frame(req->kind, req->tag, req->off, {});
             break;
+        case kCmaHello:
+            sock_ok = write_frame(kCmaHello, 0, 0, req->span);
+            break;
         case kCmaDesc:
             break; // never enqueued directly
         }
@@ -854,6 +850,42 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
     if (!dst) {
         send_ctl(drop ? kCmaAck : kCmaNack, tag, d.off);
         return;
+    }
+    // identity probe: read the announced token from the announced pid and
+    // compare with the copy that came over TCP. A pid from another pid
+    // namespace, or reused after a restart, fails here and the sender falls
+    // back to streaming — never a silent read of the wrong process.
+    {
+        uint32_t pid = 0;
+        uint64_t taddr = 0;
+        std::array<uint8_t, 16> expect{};
+        {
+            std::lock_guard lk(cma_mu_);
+            if (cma_peer_valid_) {
+                pid = cma_peer_pid_;
+                taddr = cma_peer_token_addr_;
+                expect = cma_peer_token_;
+            }
+        }
+        std::array<uint8_t, 16> got{};
+        struct iovec liov{got.data(), 16};
+        struct iovec riov{reinterpret_cast<void *>(taddr), 16};
+        bool verified = pid != 0 && pid == d.pid &&
+                        process_vm_readv(static_cast<pid_t>(pid), &liov, 1, &riov,
+                                         1, 0) == 16 &&
+                        got == expect;
+        if (!verified) {
+            {
+                std::lock_guard lk(table_->mu_);
+                auto it = table_->sinks_.find(tag);
+                if (it != table_->sinks_.end()) --it->second.busy;
+            }
+            table_->ev_.signal();
+            send_ctl(kCmaNack, tag, d.off);
+            PLOG(kWarn) << "CMA identity probe failed for pid " << d.pid
+                        << "; falling back to streaming";
+            return;
+        }
     }
     bool ok = true, cancelled = false;
     size_t off = 0;
@@ -943,6 +975,25 @@ void MultiplexConn::rx_loop() {
                     enqueue(req);
                 }
             }
+            continue;
+        }
+
+        if (kind == kCmaHello) {
+            if (n != 28) {
+                PLOG(kError) << "multiplex rx: bad CMA hello";
+                break;
+            }
+            uint8_t buf[28];
+            if (!sock_.recv_all(buf, 28)) break;
+            uint32_t be_pid;
+            uint64_t be_addr;
+            memcpy(&be_pid, buf, 4);
+            memcpy(&be_addr, buf + 4, 8);
+            std::lock_guard lk(cma_mu_);
+            cma_peer_pid_ = wire::from_be(be_pid);
+            cma_peer_token_addr_ = wire::from_be(be_addr);
+            memcpy(cma_peer_token_.data(), buf + 12, 16);
+            cma_peer_valid_ = true;
             continue;
         }
 
@@ -1131,9 +1182,19 @@ SendHandle Link::send_meta(uint64_t tag, std::vector<uint8_t> payload) {
 }
 
 bool Link::wait_all(const std::vector<SendHandle> &hs, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     bool ok = true;
-    for (const auto &h : hs)
-        if (!h->wait(timeout_ms)) ok = false;
+    for (const auto &h : hs) {
+        int left = -1;
+        if (timeout_ms >= 0) {
+            auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                          deadline - std::chrono::steady_clock::now())
+                          .count();
+            left = static_cast<int>(ms < 0 ? 0 : ms);
+        }
+        if (!h->wait(left)) ok = false;
+    }
     return ok;
 }
 

@@ -280,7 +280,13 @@ public:
 private:
     friend class SinkTable;
 
-    enum Kind : uint8_t { kData = 0, kCmaDesc = 1, kCmaAck = 2, kCmaNack = 3 };
+    enum Kind : uint8_t {
+        kData = 0,
+        kCmaDesc = 1,
+        kCmaAck = 2,
+        kCmaNack = 3,
+        kCmaHello = 4, // {pid, token_addr, 16-byte token}: CMA identity proof
+    };
 
     struct SendReq : mpsc::Node {
         Kind kind = kData;
@@ -317,6 +323,17 @@ private:
     std::atomic<bool> cma_ok_{false}; // same-host CMA negotiated & not failed
     std::mutex cma_mu_;
     std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending_cma_; // (tag,off)
+    // Sender side: a random token at a stable address; the receiver
+    // probe-reads it via process_vm_readv before every pull and compares
+    // with the copy received over TCP — proving the pid resolves to THIS
+    // process in the receiver's pid namespace (guards against pid reuse and
+    // cross-pidns pid collisions; raw pids are not namespace-safe).
+    std::unique_ptr<std::array<uint8_t, 16>> cma_token_;
+    // Receiver side: the peer's announced identity (guarded by cma_mu_)
+    bool cma_peer_valid_ = false;
+    uint32_t cma_peer_pid_ = 0;
+    uint64_t cma_peer_token_addr_ = 0;
+    std::array<uint8_t, 16> cma_peer_token_{};
 
     size_t tx_chunk_;
     size_t cma_min_;

@@ -96,9 +96,12 @@ class Diloco:
         self._shardings = codec.leaf_shardings(params)
         # outer params live on device as PRIVATE copies: the caller's train
         # step typically donates its param buffers (train.build_train_step
-        # uses donate_argnums), which would delete aliased arrays under us
-        self.outer_params = jax.tree.map(jnp.copy, params)
-        self._momentum_vec = jnp.zeros((self.count,), jnp.float32)
+        # uses donate_argnums), which would delete aliased arrays under us.
+        # Committed placement from step 0: uncommitted inputs would retrace
+        # the jitted helpers once their outputs come back committed — at
+        # 100M+ params each spurious retrace costs seconds.
+        self.outer_params = self._restore_shardings(jax.tree.map(jnp.copy, params))
+        self._momentum_vec = jax.device_put(jnp.zeros((self.count,), jnp.float32))
 
         lr, mu, nesterov = cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
 
@@ -107,7 +110,10 @@ class Diloco:
             upd = delta + mu * mom if nesterov else mom
             return outer_vec - lr * upd, mom
 
-        self._apply_fn = jax.jit(_apply)
+        # outer_vec and momentum are dead after the call — donate their
+        # buffers so the update runs in place instead of allocating 2 more
+        # param-sized arrays
+        self._apply_fn = jax.jit(_apply, donate_argnums=(0, 1))
 
     # -- the outer step --
 
@@ -133,12 +139,17 @@ class Diloco:
         The returned tree is a fresh copy safe to hand to a donating train
         step; the driver keeps its own buffers for the next pseudo-gradient."""
         delta = self._delta_fn(self.outer_params, inner_params)
-        host = np.array(jax.device_get(delta), dtype=np.float32)
+        # np.asarray: device_get already yields a host ndarray — a second
+        # np.array copy would cost another params-sized memcpy per outer step
+        host = np.asarray(jax.device_get(delta), dtype=np.float32)
+        if not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
+            host = np.array(host, dtype=np.float32)  # ring reduces in place
         if self.comm is not None:
             self._reduce_host(host)
         outer_vec = self._flat_fn(self.outer_params)
         new_vec, self._momentum_vec = self._apply_fn(
-            outer_vec, self._momentum_vec, jnp.asarray(host))
+            outer_vec, self._momentum_vec,
+            jax.device_put(host, outer_vec.sharding))
         self.outer_params = self._restore_shardings(self._unflat_fn(new_vec))
         self.step += 1
         return jax.tree.map(jnp.copy, self.outer_params)

@@ -101,7 +101,11 @@ class HierarchicalAllReduce:
         vec = self._codec.flat(tree)
         if self.comm is None:
             return self._codec.unflat(vec)
-        host = np.array(jax.device_get(vec), dtype=np.float32)
+        # np.asarray: device_get already yields a host ndarray — a second
+        # np.array copy would cost another params-sized memcpy per reduce
+        host = np.asarray(jax.device_get(vec), dtype=np.float32)
+        if not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
+            host = np.array(host, dtype=np.float32)  # ring reduces in place
         self._ring_avg(host)
         out = self._codec.unflat(jnp.asarray(host))
         return restore_shardings(out, self._shardings)
