@@ -2,7 +2,9 @@
 
 #include <chrono>
 #include <cstdlib>
+#include <cstring>
 #include <random>
+#include <thread>
 #include <vector>
 
 #include "log.hpp"
@@ -18,49 +20,99 @@ double probe_seconds() {
         double v = atof(e);
         if (v > 0) return v;
     }
-    return 1.0;
+    return 10.0; // reference default: BENCHMARK_LENGTH_SECONDS = 10
+}
+
+int probe_connections() {
+    if (const char *e = std::getenv("PCCLT_BENCH_CONNECTIONS")) {
+        int v = atoi(e);
+        if (v > 0 && v <= kMaxProbeConnections) return v;
+    }
+    return 4;
 }
 
 double run_probe(const net::Addr &target) {
-    net::Socket sock;
-    if (!sock.connect(target)) return -1.0;
-    std::mutex mu;
-    if (!net::send_frame(sock, mu, proto::kBenchHello, {})) return -1.0;
-    auto ack = net::recv_frame(sock);
-    if (!ack || ack->type != proto::kBenchAck || ack->payload.empty() ||
-        ack->payload[0] == 0)
-        return -2.0; // busy
+    const int ncon = probe_connections();
 
+    // one random token per probe: the server admits connections per-PROBER
+    // (all-or-nothing), so two concurrent probers can never split the
+    // server's capacity and both walk away busy-rejected
+    std::array<uint8_t, 16> token;
+    {
+        std::random_device rd;
+        for (auto &b : token) b = static_cast<uint8_t>(rd());
+    }
+
+    // establish ALL connections before flooding (all-or-nothing, like the
+    // reference's launchBenchmark loop): a partial flood would understate
+    // the link and a busy rejection mid-run would waste the window
+    std::vector<net::Socket> socks(ncon);
+    for (int i = 0; i < ncon; ++i) {
+        if (!socks[i].connect(target)) return -1.0;
+        std::mutex mu;
+        if (!net::send_frame(socks[i], mu, proto::kBenchHello, token)) return -1.0;
+        auto ack = net::recv_frame(socks[i]);
+        if (!ack || ack->type != proto::kBenchAck || ack->payload.empty())
+            return -1.0;
+        if (ack->payload[0] == 0) return -2.0; // busy: another prober holds it
+    }
+
+    // one shared random 8 MB buffer (reference: DEFAULT_SEND_BUFFER_SIZE)
     std::vector<uint8_t> buf(8 << 20);
     std::mt19937_64 rng{0x9E3779B97F4A7C15ull};
     for (size_t i = 0; i + 8 <= buf.size(); i += 8) {
         uint64_t v = rng();
         memcpy(buf.data() + i, &v, 8);
     }
-    double secs = probe_seconds();
-    auto deadline = Clock::now() + std::chrono::duration<double>(secs);
-    uint64_t sent = 0;
-    auto t0 = Clock::now();
-    while (Clock::now() < deadline) {
-        if (!sock.send_all(buf.data(), buf.size())) break;
-        sent += buf.size();
+
+    const double secs = probe_seconds();
+    std::vector<double> mbps(ncon, 0.0);
+    std::vector<std::thread> threads;
+    threads.reserve(ncon);
+    for (int i = 0; i < ncon; ++i) {
+        threads.emplace_back([&, i] {
+            auto deadline = Clock::now() + std::chrono::duration<double>(secs);
+            uint64_t sent = 0;
+            auto t0 = Clock::now();
+            while (Clock::now() < deadline) {
+                if (!socks[i].send_all(buf.data(), buf.size())) break;
+                sent += buf.size();
+            }
+            double elapsed =
+                std::chrono::duration<double>(Clock::now() - t0).count();
+            socks[i].shutdown();
+            socks[i].close();
+            if (elapsed > 0) mbps[i] = static_cast<double>(sent) * 8.0 / 1e6 / elapsed;
+        });
     }
-    double elapsed = std::chrono::duration<double>(Clock::now() - t0).count();
-    sock.shutdown();
-    sock.close();
-    if (elapsed <= 0 || sent == 0) return -1.0;
-    return static_cast<double>(sent) * 8.0 / 1e6 / elapsed;
+    for (auto &t : threads) t.join();
+
+    double total = 0;
+    for (double m : mbps) {
+        if (m <= 0) return -1.0; // a dead connection invalidates the probe
+        total += m;
+    }
+    return total;
 }
 
-void serve_connection(net::Socket sock, std::atomic<int> &active, int max_active) {
+void serve_connection(net::Socket sock, ServeState &state) {
     auto hello = net::recv_frame(sock);
-    if (!hello || hello->type != proto::kBenchHello) return;
-    int cur = active.load();
+    if (!hello || hello->type != proto::kBenchHello ||
+        hello->payload.size() != 16)
+        return;
+
     bool accept = false;
-    while (cur < max_active) {
-        if (active.compare_exchange_weak(cur, cur + 1)) {
+    {
+        std::lock_guard lk(state.mu);
+        if (state.refcount == 0) {
+            memcpy(state.token.data(), hello->payload.data(), 16);
+            state.refcount = 1;
             accept = true;
-            break;
+        } else if (memcmp(state.token.data(), hello->payload.data(), 16) == 0 &&
+                   state.refcount < kMaxProbeConnections) {
+            // same prober adding another flood connection
+            state.refcount++;
+            accept = true;
         }
     }
     std::mutex mu;
@@ -73,7 +125,10 @@ void serve_connection(net::Socket sock, std::atomic<int> &active, int max_active
         ssize_t r = sock.recv_some(buf.data(), buf.size(), 2000);
         if (r == 0 || r == -1) break; // closed or error; -2 timeout keeps waiting
     }
-    active.fetch_sub(1);
+    {
+        std::lock_guard lk(state.mu);
+        state.refcount--; // reaching 0 releases the token for the next prober
+    }
 }
 
 } // namespace pcclt::bench

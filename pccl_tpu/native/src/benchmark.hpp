@@ -1,24 +1,42 @@
 // P2P bandwidth probe for topology optimization.
 // Reference parity: NetworkBenchmarkRunner (/root/reference/ccoip/src/cpp/
-// benchmark_runner.cpp) — client floods random buffers for a fixed window
-// and reports Mbit/s; server side accepts, counts and discards; busy
-// servers reject via the handshake. Duration env: PCCLT_BENCH_SECONDS
-// (default 1.0; the reference uses 10 s).
+// benchmark_runner.cpp:11-13,95-141) — the prober floods 8 MB random
+// buffers over N concurrent connections for a fixed window and reports the
+// SUMMED Mbit/s; the server side accepts, counts and discards. Admission is
+// per-PROBER: every connection of one probe carries the same random 16-byte
+// token, the server grants the floor to one token at a time, and other
+// probers are told "busy" so they back off instead of splitting capacity
+// and halving each other's estimates. Env knobs: PCCLT_BENCH_SECONDS
+// (default 10, like the reference), PCCLT_BENCH_CONNECTIONS (default 4,
+// reference: PCCL_NUM_BENCHMARK_CONNECTIONS).
 #pragma once
 
-#include <atomic>
+#include <array>
+#include <cstdint>
+#include <mutex>
 
 #include "sockets.hpp"
 
 namespace pcclt::bench {
 
-double probe_seconds();
+inline constexpr int kMaxProbeConnections = 64;
 
-// Run one outgoing probe; returns measured Mbit/s or <0 on failure/busy.
+double probe_seconds();
+int probe_connections();
+
+// Run one N-connection flood probe; returns summed Mbit/s across the
+// connections, or <0 on failure (-1) / busy rejection (-2).
 double run_probe(const net::Addr &target);
 
+// Per-server-endpoint admission state: one prober token holds the floor.
+struct ServeState {
+    std::mutex mu;
+    std::array<uint8_t, 16> token{};
+    int refcount = 0;
+};
+
 // Serve one accepted benchmark connection (counts+discards until close).
-// `busy` limits concurrency: if already at limit, the handshake is rejected.
-void serve_connection(net::Socket sock, std::atomic<int> &active, int max_active);
+// Rejects the handshake when a different prober currently holds the floor.
+void serve_connection(net::Socket sock, ServeState &state);
 
 } // namespace pcclt::bench

@@ -5,6 +5,7 @@
 #include <algorithm>
 #include <cstdlib>
 #include <cstring>
+#include <random>
 #include <set>
 
 #include "benchmark.hpp"
@@ -151,17 +152,21 @@ void Client::on_ss_accept(net::Socket sock) {
         if (!ok) return;
         for (const auto &e : entries) {
             size_t nbytes = e.count * proto::dtype_size(e.dtype);
-            if (!sock.send_all(e.data, nbytes)) return;
+            // count BEFORE sending: the requester can complete its fetch and
+            // the whole dist-done handshake the instant the last byte lands,
+            // and the distributor reads this counter right after Done — a
+            // post-send increment could still be pending on this thread
             dist_tx_bytes_.fetch_add(nbytes);
+            if (!sock.send_all(e.data, nbytes)) return;
         }
     });
 }
 
 void Client::on_bench_accept(net::Socket sock) {
-    static std::atomic<int> active{0};
+    static bench::ServeState state;
     spawn_service(std::move(sock), [](net::Socket &sock,
                                       const std::shared_ptr<std::atomic<int>> &) {
-        bench::serve_connection(std::move(sock), active, 4);
+        bench::serve_connection(std::move(sock), state);
     });
 }
 
@@ -430,10 +435,17 @@ Status Client::are_peers_pending(bool &pending) {
 Status Client::optimize_topology() {
     if (!connected_.load()) return Status::kNotConnected;
     if (!master_.send(PacketType::kC2MOptimizeTopology, {})) return Status::kConnectionLost;
+    // the whole-group optimize round serializes probes per target, so a fast
+    // peer may wait roughly (world * window * retry-budget) for the slowest
+    // prober; the wait must scale accordingly or healthy large clusters time out
+    const int optimize_wait_ms = std::max(
+        300'000, static_cast<int>(std::min(3'600'000.0,
+                     2000.0 * std::max<uint32_t>(2, global_world()) *
+                         std::max(1.0, bench::probe_seconds()))));
     while (true) {
         auto fr = master_.recv_match_any(
             {PacketType::kM2COptimizeResponse, PacketType::kM2COptimizeComplete}, nullptr,
-            300'000);
+            optimize_wait_ms);
         if (!fr) {
             auto st = check_kicked();
             return st == Status::kOk ? Status::kMasterUnreachable : st;
@@ -455,13 +467,38 @@ Status Client::optimize_topology() {
         auto resp = proto::OptimizeResponse::decode(fr->payload);
         if (!resp) return Status::kInternal;
         for (const auto &req : resp->requests) {
+            // busy-retry budget must outlast the worst-case queue: the target
+            // admits one prober at a time for probe_seconds() each, and with
+            // W peers up to W-1 probers can be queued ahead of us, so the
+            // deadline scales with the world size
+            const double window = bench::probe_seconds();
+            const uint32_t world = std::max<uint32_t>(2, global_world());
+            const auto busy_deadline =
+                std::chrono::steady_clock::now() +
+                std::chrono::duration<double>(world * window + 3.0);
+            std::mt19937_64 jitter_rng{
+                std::random_device{}() ^
+                static_cast<uint64_t>(reinterpret_cast<uintptr_t>(&req))};
             double mbps = -1.0;
-            for (int attempt = 0; attempt < 5 && mbps < 0; ++attempt) {
+            int hard_failures = 0;
+            while (mbps < 0) {
                 mbps = bench::run_probe(net::Addr{req.ip, req.bench_port});
-                if (mbps == -2.0) { // busy; back off
-                    std::this_thread::sleep_for(std::chrono::milliseconds(200 * (attempt + 1)));
+                if (mbps == -2.0) { // busy; jittered nap, retry until deadline
                     mbps = -1.0;
+                    // jitter desynchronizes probers that got rejected at the
+                    // same instant so they don't re-collide in lockstep
+                    const double nap = std::max(0.2, window / 5.0) *
+                                       (0.5 + std::uniform_real_distribution<>{}(jitter_rng));
+                    if (std::chrono::steady_clock::now() +
+                            std::chrono::duration<double>(nap) < busy_deadline) {
+                        std::this_thread::sleep_for(std::chrono::duration<double>(nap));
+                        continue;
+                    }
+                    break;
                 }
+                // hard failures get 5 tries of their own, independent of how
+                // many busy rejections came before
+                if (mbps < 0 && ++hard_failures >= 5) break;
             }
             if (mbps < 0) mbps = 0.001; // unreachable: report epsilon
             wire::Writer w;
@@ -735,6 +772,20 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
     if (!resp) {
         close_window();
         return Status::kInternal;
+    }
+    if (resp->failed) {
+        // the master could not elect a distributor at the expected revision
+        // (e.g. the only advancing peer was kicked, or no peer incremented);
+        // the round is over — no dist-done handshake follows. Surface the
+        // expected revision so the application can see how far ahead the
+        // master believes the group should be.
+        close_window();
+        if (info) {
+            info->tx_bytes = 0;
+            info->rx_bytes = 0;
+            info->revision = resp->revision;
+        }
+        return Status::kAborted;
     }
 
     uint64_t rx_bytes = 0;

@@ -394,27 +394,13 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
         if (!m->sync_req) return;
     auto &g = groups_[group];
 
-    // key-set agreement: every member must declare the same entry names,
-    // dtypes and counts (content may differ)
-    const auto &ref_entries = members[0]->sync_req->entries;
-    for (auto *m : members) {
-        const auto &e = m->sync_req->entries;
-        bool mismatch = e.size() != ref_entries.size();
-        if (!mismatch)
-            for (size_t i = 0; i < e.size(); ++i)
-                if (e[i].name != ref_entries[i].name || e[i].dtype != ref_entries[i].dtype ||
-                    e[i].count != ref_entries[i].count)
-                    mismatch = true;
-        if (mismatch) {
-            kick(out, *m, "shared-state key-set mismatch");
-            return; // disconnect event will re-run this check
-        }
-    }
-
-    // mask election: candidates are tx-capable peers; canonical revision is
-    // the max among them; winning content is the most popular hash-vector
-    // at the canonical revision (reference: popularity + priority election,
-    // ccoip_master_state.cpp:1139-1184)
+    // Mask election with the reference's priority rules
+    // (ccoip_master_state.cpp:1093-1184, ccoip_master_handler.cpp:632-727):
+    //  - rx-only peers never put their content up for election
+    //  - peers at the expected revision (match) beat revision-outdated peers
+    //  - within the winning class, the most popular full entry list wins
+    //  - key-set disagreement with the elected mask kicks the *disagreeing*
+    //    peer; content-hash disagreement marks dirty keys for retransmission
     std::vector<ClientInfo *> candidates;
     for (auto *m : members)
         if (m->sync_req->strategy != proto::SyncStrategy::kRxOnly) candidates.push_back(m);
@@ -422,15 +408,64 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
         for (auto *m : members) kick(out, *m, "no tx-capable peer for shared-state sync");
         return;
     }
-    uint64_t canonical_rev = 0;
-    for (auto *m : candidates) canonical_rev = std::max(canonical_rev, m->sync_req->revision);
 
-    std::map<std::vector<uint64_t>, std::vector<ClientInfo *>> content_groups;
-    for (auto *m : candidates) {
-        if (m->sync_req->revision != canonical_rev) continue;
-        std::vector<uint64_t> key;
-        for (const auto &e : m->sync_req->entries)
-            key.push_back(e.allow_content_inequality ? 0 : e.hash);
+    // strategy mixing: enforce-popular is all-or-nothing; any peer declaring a
+    // different strategy alongside an enforce-popular peer is kicked
+    // (reference: ccoip_master_handler.cpp:703-731)
+    bool any_enforce = false, any_other = false;
+    for (auto *m : members) {
+        if (m->sync_req->strategy == proto::SyncStrategy::kEnforcePopular) any_enforce = true;
+        else any_other = true;
+    }
+    if (any_enforce && any_other) {
+        for (auto *m : members)
+            if (m->sync_req->strategy != proto::SyncStrategy::kEnforcePopular)
+                kick(out, *m, "shared-state sync strategy mixed with enforce-popular");
+        return; // disconnect events re-run this check for the survivors
+    }
+
+    // expected revision: strict one-increment once initialized; on a fresh
+    // master any revision bootstraps (logical resume), and the highest offer
+    // among candidates sets the bar (reference: ccoip_master_state.cpp:1066-1090)
+    const uint64_t expected =
+        g.revision_initialized ? g.last_revision + 1 : [&] {
+            uint64_t mx = 0;
+            for (auto *m : candidates) mx = std::max(mx, m->sync_req->revision);
+            return mx;
+        }();
+
+    std::vector<ClientInfo *> matched;
+    for (auto *m : candidates)
+        if (m->sync_req->revision == expected) matched.push_back(m);
+    if (matched.empty()) {
+        // nobody offers the expected revision (e.g. the only advancing peer was
+        // just kicked for an increment violation, or the whole group re-offered
+        // an old revision without incrementing): the round fails loudly instead
+        // of silently re-syncing at the stale revision
+        proto::SharedStateSyncResp resp;
+        resp.failed = 1;
+        resp.revision = expected;
+        for (auto *m : members) {
+            out.push_back({m->conn_id, PacketType::kM2CSharedStateSyncResp, resp.encode()});
+            m->sync_req.reset();
+            m->dist_done = false;
+        }
+        PLOG(kWarn) << "shared-state sync failed for group " << group
+                    << ": no candidate at expected revision " << expected;
+        return;
+    }
+
+    // popularity among matched candidates, keyed by the full entry list
+    std::map<std::string, std::vector<ClientInfo *>> content_groups;
+    for (auto *m : matched) {
+        std::string key;
+        for (const auto &e : m->sync_req->entries) {
+            key += e.name;
+            key += '\0';
+            key += std::to_string(static_cast<int>(e.dtype)) + ":" + std::to_string(e.count) +
+                   ":" + std::to_string(e.allow_content_inequality ? 1 : 0) + ":" +
+                   std::to_string(e.allow_content_inequality ? 0 : e.hash) + ";";
+        }
         content_groups[key].push_back(m);
     }
     std::vector<ClientInfo *> mask;
@@ -440,37 +475,69 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
             best = v.size();
             mask = v;
         }
-    if (mask.empty()) return; // cannot happen: candidates nonempty
     ClientInfo *distributor = mask[0];
     const auto &mask_entries = distributor->sync_req->entries;
 
+    // key-set agreement vs the elected mask: name/dtype/count/inequality-flag
+    // disagreement kicks the minority peer (never the mask holders)
     for (auto *m : members) {
-        std::vector<std::string> dirty;
-        std::vector<uint64_t> expected;
-        bool outdated_rev = m->sync_req->revision != canonical_rev;
+        const auto &e = m->sync_req->entries;
+        bool mismatch = e.size() != mask_entries.size();
+        if (!mismatch)
+            for (size_t i = 0; i < e.size(); ++i)
+                if (e[i].name != mask_entries[i].name || e[i].dtype != mask_entries[i].dtype ||
+                    e[i].count != mask_entries[i].count ||
+                    e[i].allow_content_inequality != mask_entries[i].allow_content_inequality)
+                    mismatch = true;
+        if (mismatch) {
+            kick(out, *m, "shared-state key-set mismatch");
+            return; // disconnect event will re-run this check
+        }
+    }
+
+    // dirty keys come from content-hash comparison ONLY: a peer whose
+    // revision lags but whose content matches the mask receives nothing
+    // and just adopts the canonical revision (reference drag-along
+    // semantics, test_shared_state_distribution.cpp:1147-1318)
+    std::vector<std::vector<std::string>> dirty_per(members.size());
+    std::vector<std::vector<uint64_t>> hashes_per(members.size());
+    for (size_t k = 0; k < members.size(); ++k) {
+        auto *m = members[k];
         for (size_t i = 0; i < mask_entries.size(); ++i) {
             if (mask_entries[i].allow_content_inequality) continue;
-            if (outdated_rev || m->sync_req->entries[i].hash != mask_entries[i].hash) {
-                dirty.push_back(mask_entries[i].name);
-                expected.push_back(mask_entries[i].hash);
+            if (m->sync_req->entries[i].hash != mask_entries[i].hash) {
+                dirty_per[k].push_back(mask_entries[i].name);
+                hashes_per[k].push_back(mask_entries[i].hash);
             }
         }
-        bool outdated = !dirty.empty();
-        if (outdated && m->sync_req->strategy == proto::SyncStrategy::kTxOnly) {
+    }
+    // ALL kick decisions happen before ANY response is emitted: a mid-loop
+    // kick after queueing responses would hand survivors a stale resp that
+    // their NEXT sync call consumes, desyncing the request/response protocol
+    for (size_t k = 0; k < members.size(); ++k) {
+        auto *m = members[k];
+        // a tx-only peer that would be assigned to request state (content or
+        // revision behind) is kicked: tx-only is only meaningful when the
+        // declaring peer already holds the winning state
+        if ((!dirty_per[k].empty() || m->sync_req->revision != expected) &&
+            m->sync_req->strategy == proto::SyncStrategy::kTxOnly) {
             kick(out, *m, "tx-only peer has outdated shared state");
-            return;
+            return; // disconnect event re-runs this check
         }
+    }
+    for (size_t k = 0; k < members.size(); ++k) {
+        auto *m = members[k];
         proto::SharedStateSyncResp resp;
-        resp.outdated = outdated ? 1 : 0;
+        resp.outdated = dirty_per[k].empty() ? 0 : 1;
         resp.dist_ip = distributor->ip;
         resp.dist_port = distributor->ss_port;
-        resp.revision = canonical_rev;
-        resp.outdated_keys = dirty;
-        resp.expected_hashes = expected;
+        resp.revision = expected;
+        resp.outdated_keys = dirty_per[k];
+        resp.expected_hashes = hashes_per[k];
         out.push_back({m->conn_id, PacketType::kM2CSharedStateSyncResp, resp.encode()});
     }
     g.sync_in_flight = true;
-    g.sync_revision = canonical_rev;
+    g.sync_revision = expected;
 }
 
 std::vector<Outbox> MasterState::on_dist_done(uint64_t conn) {
@@ -712,6 +779,15 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
         for (auto &[_, op] : git->second.ops) {
             op.initiated.erase(gone.uuid);
             op.completed.erase(gone.uuid);
+        }
+        // last member gone: reset the group's shared-state revision tracking.
+        // A fresh cohort is a logical resume (any first revision legal, like
+        // a restarted master) — without this, workers restarted from an older
+        // checkpoint against a long-lived master could never sync again
+        if (group_members(gone.peer_group).empty()) {
+            git->second = GroupState{};
+            PLOG(kInfo) << "peer group " << gone.peer_group
+                        << " emptied; shared-state revision tracking reset";
         }
     }
     recheck_all(out);

@@ -171,6 +171,7 @@ std::optional<SharedStateSyncC2M> SharedStateSyncC2M::decode(const std::vector<u
 std::vector<uint8_t> SharedStateSyncResp::encode() const {
     wire::Writer w;
     w.u8(outdated);
+    w.u8(failed);
     w.u32(dist_ip);
     w.u16(dist_port);
     w.u64(revision);
@@ -186,6 +187,7 @@ std::optional<SharedStateSyncResp> SharedStateSyncResp::decode(const std::vector
         wire::Reader r(b);
         SharedStateSyncResp s;
         s.outdated = r.u8();
+        s.failed = r.u8();
         s.dist_ip = r.u32();
         s.dist_port = r.u16();
         s.revision = r.u64();

@@ -135,6 +135,7 @@ struct SharedStateSyncC2M {
 
 struct SharedStateSyncResp {
     uint8_t outdated = 0;
+    uint8_t failed = 0; // round could not elect a distributor at the expected revision
     uint32_t dist_ip = 0;
     uint16_t dist_port = 0;
     uint64_t revision = 0;

@@ -1,0 +1,515 @@
+"""Shared-state distribution scenario suite.
+
+Reference parity: ccoip/tests/end_to_end/test_shared_state_distribution.cpp
+(3,216 LoC, 24 scenarios). Each test here mirrors a reference scenario and
+asserts the same accept / kick / retransmit outcome:
+
+- basic distribution + no-retransmit-when-identical   (TestBasic,
+  TestNoSyncIdenticalSharedState)
+- partial dirty-key retransmission                    (TestPartialSync...)
+- popular-hash election, single + multiple keys       (TestPopularHash...)
+- multi-step advancement                              (TestMultiStepAdvancement)
+- drag-along peers with / without advancing content   (TestDragAlongClient...)
+- one-increment rule: violation kick + resume init    (TestOneIncrementRule...)
+- key-set mask mismatch kick                          (TestSharedStateMaskMismatchKick)
+- strategy kicks: both-rx-only, both-tx-only,         (TestBothReceiveOnly...,
+  enforce-popular no-mixing                            TestDifferentSharedStatet...,
+                                                       TestEnforcePopluar...)
+- peer-group isolation with different keys            (TestNoSyncIdentical...PeerGroups...)
+"""
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+LIB = Path(__file__).resolve().parent.parent / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+from conftest import alloc_ports
+
+
+@pytest.fixture
+def master():
+    from pccl_tpu.comm import MasterNode
+
+    m = MasterNode("0.0.0.0", alloc_ports())
+    m.run()
+    yield m
+    m.interrupt()
+    m.destroy()
+
+
+def _run_peers(master_port, world, worker, groups=None, timeout=120):
+    """Run `world` client threads; worker(comm, rank) may return a value.
+    Returns ({rank: result}, {rank: exception}) so scenarios can assert
+    which peers succeeded, which were kicked, and what bytes moved."""
+    from pccl_tpu.comm import Communicator
+
+    results, errors = {}, {}
+
+    def peer(rank):
+        # all-ephemeral listener ports: the handshake advertises the kernel-
+        # assigned ports, so scenario tests can never collide on port ranges
+        comm = Communicator("127.0.0.1", master_port,
+                            peer_group=0 if groups is None else groups[rank])
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.global_world_size < world:
+                if time.time() > deadline:
+                    raise TimeoutError(f"rank {rank}: world never reached {world}")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            results[rank] = worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors[rank] = e
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    return results, errors
+
+
+def _sync(comm, arrays, revision, strategy=None):
+    from pccl_tpu.comm import SharedState, SharedStateSyncStrategy, TensorInfo
+
+    st = SharedState([TensorInfo.from_numpy(k, v) for k, v in arrays.items()],
+                     revision=revision)
+    if strategy is None:
+        strategy = SharedStateSyncStrategy.ENFORCE_POPULAR
+    return comm.sync_shared_state(st, strategy)
+
+
+# ---------------------------------------------------------------- basic
+
+
+def test_basic_distribution(master):
+    """Reference TestBasic: on a 1-vs-1 content split either peer may win the
+    election (the reference accepts both directions); exactly value_size bytes
+    cross the wire once and both peers converge."""
+    nbytes = 1024 * 4
+
+    def worker(comm, rank):
+        w = np.full(1024, 42.0 if rank == 0 else 0.0, dtype=np.float32)
+        info = _sync(comm, {"w": w}, revision=1)
+        return info.tx_bytes, info.rx_bytes, float(w[0])
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert not errors, errors
+    assert results[0][2] == results[1][2]  # converged
+    sent = {0: results[0][:2], 1: results[1][:2]}
+    assert sorted(sent.values()) == [(0, nbytes), (nbytes, 0)]
+
+
+def test_no_retransmit_identical(master):
+    """Reference TestNoSyncIdenticalSharedState: identical content on every
+    peer -> zero bytes in either direction across 5 advancing revisions."""
+
+    def worker(comm, rank):
+        w = np.full(512, 7.0, dtype=np.float32)
+        stats = []
+        for rev in range(1, 6):
+            info = _sync(comm, {"w": w}, revision=rev)
+            stats.append((info.tx_bytes, info.rx_bytes, info.revision))
+        return stats
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert not errors, errors
+    for rank in (0, 1):
+        for step, (tx, rx, rev) in enumerate(results[rank]):
+            assert (tx, rx) == (0, 0)
+            assert rev == step + 1
+
+
+def test_partial_dirty_keys(master):
+    """Reference TestPartialSyncPartiallyDirtyState: peers agree on one key
+    and differ on the other -> only the dirty key is retransmitted."""
+
+    def worker(comm, rank):
+        same = np.full(256, 3.0, dtype=np.float32)
+        diff = np.full(256, 5.0 if rank == 0 else 0.0, dtype=np.float32)
+        info = _sync(comm, {"same": same, "diff": diff}, revision=1)
+        assert same[0] == 3.0
+        return info.tx_bytes, info.rx_bytes, float(diff[0])
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert not errors, errors
+    # only the dirty key's bytes move, in exactly one direction (either peer
+    # may win the 1-vs-1 election, as in the reference)
+    assert results[0][2] == results[1][2]
+    sent = {0: results[0][:2], 1: results[1][:2]}
+    assert sorted(sent.values()) == [(0, 256 * 4), (256 * 4, 0)]
+
+
+# ------------------------------------------------------------- election
+
+
+def test_popular_hash_prevalence(master):
+    """Reference TestPopularHashPrevelance: 2-vs-1 content split; the
+    minority peer adopts the majority content, majority peers move 0 rx."""
+
+    def worker(comm, rank):
+        w = np.full(128, 1.0 if rank < 2 else 9.0, dtype=np.float32)
+        info = _sync(comm, {"w": w}, revision=1)
+        np.testing.assert_allclose(w, np.full(128, 1.0))
+        return info.tx_bytes, info.rx_bytes
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert not errors, errors
+    assert results[2] == (0, 128 * 4)
+    assert results[0][1] == 0 and results[1][1] == 0
+    assert results[0][0] + results[1][0] == 128 * 4  # exactly one distributor
+
+
+def test_popular_prevalence_multiple_keys(master):
+    """Reference TestPopularHashPrevalenceWithMultipleKeys: the minority peer
+    is dirty on both keys; retransmission covers both."""
+
+    def worker(comm, rank):
+        a = np.full(64, 1.0 if rank < 2 else 8.0, dtype=np.float32)
+        b = np.full(32, 2.0 if rank < 2 else 9.0, dtype=np.float64)
+        info = _sync(comm, {"a": a, "b": b}, revision=1)
+        np.testing.assert_allclose(a, 1.0)
+        np.testing.assert_allclose(b, 2.0)
+        return info.tx_bytes, info.rx_bytes
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert not errors, errors
+    assert results[2] == (0, 64 * 4 + 32 * 8)
+
+
+def test_multi_step_advancement(master):
+    """Reference TestMultiStepAdvancement: all peers advance revision and
+    content in lockstep; no retransmissions ever occur."""
+
+    def worker(comm, rank):
+        stats = []
+        for rev in range(1, 6):
+            w = np.full(128, float(rev), dtype=np.float32)
+            info = _sync(comm, {"w": w}, revision=rev)
+            stats.append((info.tx_bytes, info.rx_bytes))
+        return stats
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert not errors, errors
+    for rank in results:
+        assert all(s == (0, 0) for s in results[rank])
+
+
+# ----------------------------------------------------------- drag-along
+
+
+def test_drag_along_no_advance(master):
+    """Reference TestDragAlongClientNoAdvancedStateContents: a peer that
+    re-offers its adopted (now outdated) revision with MATCHING content
+    receives nothing — the revision alone never forces retransmission."""
+    num_steps = 4
+
+    def worker(comm, rank):
+        w = np.full(256, 42.0, dtype=np.float32) if rank < 2 else \
+            np.zeros(256, dtype=np.float32)
+        stats = []
+        rev = 1
+        for step in range(num_steps):
+            if rank < 2:
+                rev = step + 1
+            info = _sync(comm, {"w": w}, revision=rev)
+            rev = info.revision  # drag-along peers adopt the canonical revision
+            stats.append((info.tx_bytes, info.rx_bytes, info.revision))
+            assert w[0] == 42.0
+        return stats
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert not errors, errors
+    # step 0: dragged peer receives the full value once; afterwards content
+    # matches and only the revision advances
+    assert results[2][0][1:] == (256 * 4, 1)
+    for step in range(1, num_steps):
+        assert results[2][step] == (0, 0, step + 1)
+
+
+def test_drag_along_with_advancing_content(master):
+    """Reference TestDragAlongClientWithAdvancedStateContents: content
+    advances every step -> the dragged peer re-receives the full state each
+    step."""
+    num_steps = 4
+
+    def worker(comm, rank):
+        w = np.zeros(256, dtype=np.float32)
+        stats = []
+        rev = 0
+        for step in range(num_steps):
+            if rank < 2:
+                w[:] = float(step + 1)
+                rev = step + 1
+            info = _sync(comm, {"w": w}, revision=rev)
+            rev = info.revision
+            stats.append((info.tx_bytes, info.rx_bytes))
+            assert w[0] == float(step + 1)
+        return stats
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert not errors, errors
+    for step in range(1, num_steps):  # step 0: all peers start at zeros
+        assert results[2][step] == (0, 256 * 4)
+
+
+# ------------------------------------------------------ one-increment rule
+
+
+def test_one_increment_violation_kick(master):
+    """Reference TestOneIncrementRuleViolationSimple: a peer that skips a
+    revision is kicked; the remaining peer's same-round sync fails loudly
+    instead of silently re-syncing at a stale revision."""
+    from pccl_tpu.comm import (ConnectionLostError, KickedError,
+                               OperationAbortedError)
+
+    def worker(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        _sync(comm, {"w": w}, revision=1)  # both at rev 1: ok
+        if rank == 0:
+            _sync(comm, {"w": w}, revision=3)  # skips rev 2: kicked
+        else:
+            _sync(comm, {"w": w}, revision=1)  # re-offer: failed round
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert set(errors) == {0, 1}, (results, errors)
+    assert isinstance(errors[0], (KickedError, ConnectionLostError))
+    assert isinstance(errors[1], OperationAbortedError)
+
+
+def test_one_increment_initialization_resume(master):
+    """Reference TestOneIncrementRuleViolationInitialization: the first-ever
+    sync may use any revision (logical resume); a peer starting at 0 is
+    dragged up to the resumed revision."""
+
+    def worker(comm, rank):
+        w = np.full(128, 42.0, dtype=np.float32) if rank == 0 else \
+            np.zeros(128, dtype=np.float32)
+        info = _sync(comm, {"w": w}, revision=13 if rank == 0 else 0)
+        assert w[0] == 42.0
+        return info.tx_bytes, info.rx_bytes, info.revision
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert not errors, errors
+    assert results[0] == (128 * 4, 0, 13)
+    assert results[1] == (0, 128 * 4, 13)
+
+
+def test_same_revision_reoffer_fails(master):
+    """Strict one-increment: a whole group re-offering an already-synced
+    revision gets a failed round (surfaced error), not a silent re-sync."""
+    from pccl_tpu.comm import OperationAbortedError
+
+    def worker(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        _sync(comm, {"w": w}, revision=1)
+        with pytest.raises(OperationAbortedError):
+            _sync(comm, {"w": w}, revision=1)
+
+    _, errors = _run_peers(master.port, 2, worker)
+    assert not errors, errors
+
+
+# ------------------------------------------------------------ mask kicks
+
+
+def test_mask_mismatch_kick(master):
+    """Reference TestSharedStateMaskMismatchKick: the peer whose key set
+    disagrees with the elected mask is kicked; the majority completes."""
+    from pccl_tpu.comm import ConnectionLostError, KickedError
+
+    def worker(comm, rank):
+        if rank < 2:
+            w = np.full(64, 1.0, dtype=np.float32)
+            info = _sync(comm, {"key1": w}, revision=1)
+            # survivors re-run the round after the kick and succeed
+            info2 = _sync(comm, {"key1": w}, revision=2)
+            return (info.rx_bytes, info2.rx_bytes)
+        w = np.full(64, 1.0, dtype=np.float32)
+        _sync(comm, {"key2": w}, revision=1)
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert set(errors) == {2}, (results, errors)
+    assert isinstance(errors[2], (KickedError, ConnectionLostError))
+    assert results[0] == (0, 0) and results[1] == (0, 0)
+
+
+def test_dtype_mismatch_kick(master):
+    """Key names match but dtypes differ -> key-set mismatch kick for the
+    minority peer (mask comparison includes dtype/count/flags)."""
+    from pccl_tpu.comm import ConnectionLostError, KickedError
+
+    def worker(comm, rank):
+        if rank < 2:
+            w = np.full(64, 1.0, dtype=np.float32)
+        else:
+            w = np.full(64, 1.0, dtype=np.float64)
+        _sync(comm, {"w": w}, revision=1)
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert set(errors) == {2}, (results, errors)
+    assert isinstance(errors[2], (KickedError, ConnectionLostError))
+
+
+# -------------------------------------------------------- strategy kicks
+
+
+def test_both_receive_only_kick_same_content(master):
+    """Reference TestBothReceiveOnlyStrategyKickSameContent: if every peer is
+    rx-only there is no candidate content to elect; all are kicked — even
+    when their contents happen to agree."""
+    from pccl_tpu.comm import (ConnectionLostError, KickedError,
+                               SharedStateSyncStrategy)
+
+    def worker(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        _sync(comm, {"w": w}, revision=1,
+              strategy=SharedStateSyncStrategy.RECEIVE_ONLY)
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert set(errors) == {0, 1}, (results, errors)
+    for e in errors.values():
+        assert isinstance(e, (KickedError, ConnectionLostError))
+
+
+def test_both_send_only_different_content_kick(master):
+    """Reference TestDifferentSharedStatetContentBothSendOnlyStrategyKick:
+    two tx-only peers with different content — the election loser would have
+    to request state, which tx-only forbids, so exactly one peer is kicked."""
+    from pccl_tpu.comm import SharedStateSyncStrategy
+
+    def worker(comm, rank):
+        w = np.full(64, float(rank), dtype=np.float32)
+        _sync(comm, {"w": w}, revision=1,
+              strategy=SharedStateSyncStrategy.SEND_ONLY)
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert len(errors) == 1, (results, errors)
+
+
+def test_both_send_only_same_content_ok(master):
+    """Two tx-only peers with identical content: nothing to distribute, no
+    kick, zero bytes."""
+    from pccl_tpu.comm import SharedStateSyncStrategy
+
+    def worker(comm, rank):
+        w = np.full(64, 5.0, dtype=np.float32)
+        info = _sync(comm, {"w": w}, revision=1,
+                     strategy=SharedStateSyncStrategy.SEND_ONLY)
+        return info.tx_bytes, info.rx_bytes
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert not errors, errors
+    assert results[0] == (0, 0) and results[1] == (0, 0)
+
+
+@pytest.mark.parametrize("other", ["RECEIVE_ONLY", "SEND_ONLY"])
+def test_enforce_popular_no_mixing(master, other):
+    """Reference TestEnforcePopluarSyncStrategyNoMixingWith{ReceiveOnly,
+    SendOnly}: enforce-popular is all-or-nothing; the peer declaring a
+    different strategy is kicked and the enforce-popular peer completes."""
+    from pccl_tpu.comm import (ConnectionLostError, KickedError,
+                               SharedStateSyncStrategy)
+
+    def worker(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        strategy = (SharedStateSyncStrategy.ENFORCE_POPULAR if rank == 0
+                    else SharedStateSyncStrategy[other])
+        info = _sync(comm, {"w": w}, revision=1, strategy=strategy)
+        return info.tx_bytes, info.rx_bytes
+
+    results, errors = _run_peers(master.port, 2, worker)
+    assert set(errors) == {1}, (results, errors)
+    assert isinstance(errors[1], (KickedError, ConnectionLostError))
+    assert results[0] == (0, 0)
+
+
+def test_tx_only_revision_lag_kick(master):
+    """A tx-only peer whose revision lags the group is kicked even when its
+    content matches the mask: tx-only peers may never be assigned to request
+    state, and a revision-outdated peer is such an assignee
+    (reference: ccoip_master_handler.cpp:667-697)."""
+    from pccl_tpu.comm import (ConnectionLostError, KickedError,
+                               SharedStateSyncStrategy)
+
+    def worker(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        strategy = (SharedStateSyncStrategy.SEND_ONLY if rank < 2
+                    else SharedStateSyncStrategy.RECEIVE_ONLY)
+        _sync(comm, {"w": w}, revision=1, strategy=strategy)
+        # round 2: peer 1 advances to revision 2 (content unchanged), peer 2
+        # follows rx-only; peer 0 re-offers revision 1 as tx-only -> kicked
+        # despite matching content
+        rev = 1 if rank == 0 else 2
+        info = _sync(comm, {"w": w}, revision=rev, strategy=strategy)
+        return info.tx_bytes, info.rx_bytes
+
+    results, errors = _run_peers(master.port, 3, worker)
+    assert set(errors) == {0}, (results, errors)
+    assert isinstance(errors[0], (KickedError, ConnectionLostError))
+    # the surviving round moved no bytes: contents already matched
+    assert results[1] == (0, 0) and results[2] == (0, 0)
+
+
+def test_group_restart_resets_revision(master):
+    """A cohort that fully disconnects and returns resumes from any revision
+    (logical resume against a long-lived master) — workers restarted from an
+    OLDER checkpoint must be able to sync again instead of livelocking on
+    the stale expected revision."""
+
+    def first_cohort(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        info = _sync(comm, {"w": w}, revision=5)
+        return info.revision
+
+    results, errors = _run_peers(master.port, 2, first_cohort)
+    assert not errors, errors
+    assert results == {0: 5, 1: 5}
+
+    def restarted_cohort(comm, rank):
+        # restarted from a checkpoint taken at revision 3 (< 5)
+        w = np.full(64, 9.0, dtype=np.float32)
+        info = _sync(comm, {"w": w}, revision=3)
+        return info.revision
+
+    results, errors = _run_peers(master.port, 2, restarted_cohort)
+    assert not errors, errors
+    assert results == {0: 3, 1: 3}
+
+
+# ---------------------------------------------------------- peer groups
+
+
+def test_peer_groups_different_keys_isolated(master):
+    """Reference TestNoSyncIdenticalSharedStateMultiplePeerGroupsDifferentKeys:
+    two peer groups with entirely different key sets sync concurrently and
+    never interfere (no cross-group kicks, correct per-group distribution)."""
+
+    def worker(comm, rank):
+        group = rank // 2
+        leader = rank % 2 == 0
+        key = f"g{group}"
+        w = np.full(128, float(group + 1) if leader else 0.0, dtype=np.float32)
+        info = _sync(comm, {key: w}, revision=1)
+        return info.tx_bytes, info.rx_bytes, float(w[0])
+
+    results, errors = _run_peers(master.port, 4, worker,
+                                 groups=[0, 0, 1, 1])
+    assert not errors, errors
+    for group in (0, 1):
+        leader, follower = results[2 * group], results[2 * group + 1]
+        # within each group exactly one full transfer in either direction
+        # (1-vs-1 election tie, either may win) and both peers converge;
+        # the adopted value proves no cross-group leakage
+        assert leader[2] == follower[2]
+        assert leader[2] in (float(group + 1), 0.0)
+        assert sorted([leader[:2], follower[:2]]) == [(0, 128 * 4), (128 * 4, 0)]
