@@ -287,6 +287,14 @@ bool Listener::listen(uint16_t port, int tries, bool loopback_only) {
             ::listen(fd, 64) == 0) {
             fd_ = fd;
             port_ = static_cast<uint16_t>(port + i);
+            if (port_ == 0) {
+                // port 0 = kernel-assigned ephemeral; report the real port so
+                // callers can advertise it
+                struct sockaddr_in bound{};
+                socklen_t slen = sizeof bound;
+                if (getsockname(fd, reinterpret_cast<sockaddr *>(&bound), &slen) == 0)
+                    port_ = ntohs(bound.sin_port);
+            }
             return true;
         }
         ::close(fd);
@@ -509,7 +517,19 @@ size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms) {
             return true;
         }
         cur = it->second.prefix;
-        return cur >= min_bytes;
+        if (cur >= min_bytes) return true;
+        // all member conns dead: the prefix can never grow again — return
+        // the short count now instead of sleeping out the full timeout
+        // (callers distinguish via Link::alive())
+        bool dead = !members_.empty();
+        for (auto &w : members_) {
+            auto c = w.lock();
+            if (c && c->alive()) {
+                dead = false;
+                break;
+            }
+        }
+        return dead;
     });
     return cur;
 }

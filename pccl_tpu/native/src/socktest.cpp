@@ -1,0 +1,456 @@
+// Socket-layer unit tests: Socket framing, Listener, ControlClient matching,
+// MultiplexConn/SinkTable demux, Link striping, and the bandwidth probe.
+//
+// Reference parity: tinysockets/tests/ (test_server_socket.cpp 1,235 LoC,
+// test_queued_socket.cpp 645 LoC) — the riskiest concurrency code in the
+// tree gets direct coverage: register-while-receiving races, cancel
+// mid-stream, purge under load, queued->sink handoff, death notification.
+// Built as its own binary (pcclt_socktest) and run under ASan/UBSan/TSan
+// configs (reference: cmake/testing.cmake wires sanitizers into every gtest).
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "benchmark.hpp"
+#include "protocol.hpp"
+#include "sockets.hpp"
+#include "wire.hpp"
+
+using namespace pcclt;
+
+static int failures = 0;
+
+#define CHECK(cond)                                                            \
+    do {                                                                       \
+        if (!(cond)) {                                                         \
+            fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, __LINE__,    \
+                    #cond);                                                    \
+            failures++;                                                        \
+        }                                                                      \
+    } while (0)
+
+namespace {
+
+struct ConnPair {
+    std::shared_ptr<net::MultiplexConn> a, b;
+    std::shared_ptr<net::SinkTable> ta, tb;
+};
+
+// Build a connected MultiplexConn pair over loopback. Each side gets its own
+// SinkTable unless shared tables are passed in (pool striping tests). The
+// throwaway listener is stopped before returning, so no accept callback can
+// outlive this scope.
+ConnPair make_pair_conns(std::shared_ptr<net::SinkTable> ta = nullptr,
+                         std::shared_ptr<net::SinkTable> tb = nullptr) {
+    ConnPair p;
+    p.ta = ta ? ta : std::make_shared<net::SinkTable>();
+    p.tb = tb ? tb : std::make_shared<net::SinkTable>();
+    auto accepted = std::make_shared<std::atomic<bool>>(false);
+    auto accepted_sock = std::make_shared<net::Socket>();
+    net::Listener listener;
+    CHECK(listener.listen(0, 1, /*loopback_only=*/true));
+    listener.run_async([accepted, accepted_sock](net::Socket s) {
+        *accepted_sock = std::move(s);
+        accepted->store(true);
+    });
+    net::Socket c;
+    CHECK(c.connect(net::Addr{127u << 24 | 1, listener.port()}, 5000));
+    for (int i = 0; i < 500 && !accepted->load(); ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    CHECK(accepted->load());
+    listener.stop();
+    p.a = std::make_shared<net::MultiplexConn>(std::move(c), p.ta);
+    p.b = std::make_shared<net::MultiplexConn>(std::move(*accepted_sock), p.tb);
+    p.ta->attach(p.a);
+    p.tb->attach(p.b);
+    p.a->run();
+    p.b->run();
+    return p;
+}
+
+std::vector<uint8_t> pattern(size_t n, uint64_t seed) {
+    std::vector<uint8_t> v(n);
+    std::mt19937_64 rng{seed};
+    for (auto &b : v) b = static_cast<uint8_t>(rng());
+    return v;
+}
+
+// ---------------- Socket + framing ----------------
+
+void test_frame_roundtrip() {
+    net::Listener lis;
+    CHECK(lis.listen(0, 1, true));
+    net::Socket srv;
+    std::atomic<bool> got{false};
+    lis.run_async([&](net::Socket s) {
+        srv = std::move(s);
+        got.store(true);
+    });
+    net::Socket cli;
+    CHECK(cli.connect(net::Addr{127u << 24 | 1, lis.port()}, 5000));
+    for (int i = 0; i < 5000 && !got.load(); ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    CHECK(got.load());
+
+    std::mutex mu;
+    // empty payload
+    CHECK(net::send_frame(cli, mu, 7, {}));
+    auto f = net::recv_frame(srv, 2000);
+    CHECK(f && f->type == 7 && f->payload.empty());
+
+    // large payload crosses the coalescing threshold
+    auto big = pattern(1 << 20, 42);
+    CHECK(net::send_frame(cli, mu, 9, big));
+    f = net::recv_frame(srv, 5000);
+    CHECK(f && f->type == 9 && f->payload == big);
+
+    // timeout on silence (bounded recv must not block forever)
+    auto t0 = std::chrono::steady_clock::now();
+    f = net::recv_frame(srv, 150);
+    auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+    CHECK(!f && ms >= 100 && ms < 3000);
+
+    // a frame with an oversized length header is rejected, not allocated
+    uint32_t bad_len = wire::to_be(static_cast<uint32_t>(wire::kMaxControlPacket + 3));
+    uint16_t type = 0;
+    CHECK(cli.send_all(&bad_len, 4));
+    CHECK(cli.send_all(&type, 2));
+    f = net::recv_frame(srv, 2000);
+    CHECK(!f);
+    fprintf(stderr, "frame roundtrip: ok\n");
+}
+
+void test_listener_port_bump() {
+    net::Listener a, b;
+    CHECK(a.listen(0, 1, true));
+    // deliberately collide on a's port; the bump allocator walks upward
+    CHECK(b.listen(a.port(), 8, true));
+    CHECK(b.port() != a.port());
+    CHECK(b.port() > a.port() && b.port() <= a.port() + 8);
+    fprintf(stderr, "listener port bump: ok\n");
+}
+
+// ---------------- ControlClient ----------------
+
+void test_control_client_matching() {
+    net::Listener lis;
+    CHECK(lis.listen(0, 1, true));
+    net::Socket srv;
+    std::atomic<bool> got{false};
+    lis.run_async([&](net::Socket s) {
+        srv = std::move(s);
+        got.store(true);
+    });
+    net::ControlClient cc;
+    CHECK(cc.connect(net::Addr{127u << 24 | 1, lis.port()}));
+    std::atomic<int> disconnects{0};
+    cc.run([&] { disconnects.fetch_add(1); });
+    for (int i = 0; i < 5000 && !got.load(); ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    CHECK(got.load());
+
+    std::mutex mu;
+    std::vector<uint8_t> p1{1}, p2{2}, p3{3};
+    CHECK(net::send_frame(srv, mu, 100, p1));
+    CHECK(net::send_frame(srv, mu, 100, p2));
+    CHECK(net::send_frame(srv, mu, 200, p3));
+
+    // predicate skips p1 and matches p2 even though p1 arrived first
+    auto f = cc.recv_match(100, [](const std::vector<uint8_t> &p) {
+        return !p.empty() && p[0] == 2;
+    }, 2000);
+    CHECK(f && f->payload == p2);
+    // p1 is still queued and matches an unconditional receive
+    f = cc.recv_match(100, nullptr, 2000);
+    CHECK(f && f->payload == p1);
+    // type-based match across types
+    f = cc.recv_match_any({200, 300}, nullptr, 2000);
+    CHECK(f && f->type == 200 && f->payload == p3);
+
+    // no_wait polls: nothing queued -> immediate nullopt
+    auto t0 = std::chrono::steady_clock::now();
+    f = cc.recv_match(100, nullptr, -1, /*no_wait=*/true);
+    CHECK(!f);
+    CHECK(std::chrono::steady_clock::now() - t0 < std::chrono::seconds(1));
+
+    // timeout on empty queue
+    f = cc.recv_match(100, nullptr, 120);
+    CHECK(!f);
+
+    // client->server direction
+    CHECK(cc.send(42, p1));
+    auto sf = net::recv_frame(srv, 2000);
+    CHECK(sf && sf->type == 42 && sf->payload == p1);
+
+    // disconnect wakes blocked waiters and fires the callback exactly once
+    std::thread waiter([&] {
+        auto r = cc.recv_match(999, nullptr, 10'000);
+        CHECK(!r);
+    });
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    srv.shutdown();
+    srv.close();
+    waiter.join();
+    for (int i = 0; i < 500 && cc.connected(); ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    CHECK(!cc.connected());
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    CHECK(disconnects.load() == 1);
+    fprintf(stderr, "control client matching: ok\n");
+}
+
+// ---------------- MultiplexConn / SinkTable ----------------
+
+void test_mux_basic_and_ooo(bool allow_cma) {
+    auto p = make_pair_conns();
+    const size_t n = 256 * 1024;
+    auto data = pattern(n, 7);
+
+    // basic: sink registered first, single send
+    std::vector<uint8_t> dst(n, 0);
+    p.b->table().register_sink(1, dst.data(), n);
+    CHECK(p.a->send_bytes(1, data, allow_cma));
+    CHECK(p.b->table().wait_filled(1, n, 10'000) == n);
+    p.b->table().unregister_sink(1);
+    CHECK(dst == data);
+
+    // out-of-order offsets: second half lands before first half;
+    // prefix tracking must absorb the queued extent
+    std::vector<uint8_t> dst2(n, 0);
+    p.b->table().register_sink(2, dst2.data(), n);
+    auto h1 = p.a->send_async(2, n / 2, {data.data() + n / 2, n / 2}, false);
+    CHECK(h1->wait(10'000));
+    CHECK(p.b->table().wait_filled(2, 1, 2'000) == 0); // gap: no prefix yet
+    auto h2 = p.a->send_async(2, 0, {data.data(), n / 2}, false);
+    CHECK(h2->wait(10'000));
+    CHECK(p.b->table().wait_filled(2, n, 10'000) == n);
+    p.b->table().unregister_sink(2);
+    CHECK(dst2 == data);
+    fprintf(stderr, "mux basic+ooo (cma=%d): ok\n", allow_cma ? 1 : 0);
+}
+
+void test_mux_queued_handoff() {
+    auto p = make_pair_conns();
+    const size_t n = 64 * 1024;
+    auto data = pattern(n, 11);
+
+    // data races ahead of registration: frames for an unregistered tag are
+    // queued with offsets and drained into the sink at register time
+    CHECK(p.a->send_bytes(3, data, /*allow_cma=*/false));
+    std::this_thread::sleep_for(std::chrono::milliseconds(200)); // let RX land
+    std::vector<uint8_t> dst(n, 0);
+    p.b->table().register_sink(3, dst.data(), n);
+    CHECK(p.b->table().wait_filled(3, n, 10'000) == n);
+    p.b->table().unregister_sink(3);
+    CHECK(dst == data);
+
+    // small metadata frames with no sink are received via recv_queued
+    std::vector<uint8_t> meta{9, 8, 7, 6};
+    CHECK(p.a->send_copy(4, meta)->wait(5'000));
+    auto got = p.b->table().recv_queued(4, 5'000);
+    CHECK(got && *got == meta);
+
+    // recv_queued honors its timeout when nothing arrives
+    auto t0 = std::chrono::steady_clock::now();
+    got = p.b->table().recv_queued(5, 150);
+    CHECK(!got);
+    CHECK(std::chrono::steady_clock::now() - t0 < std::chrono::seconds(3));
+    fprintf(stderr, "mux queued handoff: ok\n");
+}
+
+void test_mux_purge_and_cancel() {
+    auto p = make_pair_conns();
+    const size_t n = 4 * 1024 * 1024;
+    auto data = pattern(n, 13);
+
+    // cancel mid-stream: unregister while the sender is still streaming.
+    // Must not crash, must not write into freed memory (ASan would catch),
+    // and the connection must stay usable for the next op.
+    {
+        auto dst = std::make_unique<std::vector<uint8_t>>(n, 0);
+        p.b->table().register_sink(6, dst->data(), n);
+        auto hs = p.a->send_async(6, 0, data, /*allow_cma=*/false);
+        p.b->table().wait_filled(6, 64 * 1024, 5'000); // some bytes flowing
+        p.b->table().unregister_sink(6);               // cancel mid-transfer
+        dst.reset();                                    // buffer gone
+        hs->wait(10'000); // sender completes (stream drained or dropped)
+    }
+
+    // leftover frames for tag 6 may still be queued; purge clears them and
+    // the link still works for fresh tags afterwards
+    p.b->table().purge_range(0, 100);
+    const size_t m = 128 * 1024;
+    auto data2 = pattern(m, 17);
+    std::vector<uint8_t> dst2(m, 0);
+    p.b->table().register_sink(101, dst2.data(), m);
+    CHECK(p.a->send_bytes(101, data2, false));
+    CHECK(p.b->table().wait_filled(101, m, 10'000) == m);
+    p.b->table().unregister_sink(101);
+    CHECK(dst2 == data2);
+    fprintf(stderr, "mux purge+cancel: ok\n");
+}
+
+void test_mux_concurrent_tags() {
+    auto p = make_pair_conns();
+    const int ntags = 8;
+    const size_t n = 128 * 1024;
+    std::vector<std::vector<uint8_t>> payloads, dsts(ntags);
+    payloads.reserve(ntags);
+    for (int t = 0; t < ntags; ++t) {
+        payloads.push_back(pattern(n, 100 + t));
+        dsts[t].assign(n, 0);
+        p.b->table().register_sink(200 + t, dsts[t].data(), n);
+    }
+    std::vector<std::thread> senders;
+    senders.reserve(ntags);
+    for (int t = 0; t < ntags; ++t)
+        senders.emplace_back([&, t] {
+            CHECK(p.a->send_bytes(200 + t, payloads[t], /*allow_cma=*/t % 2 == 0));
+        });
+    for (auto &th : senders) th.join();
+    for (int t = 0; t < ntags; ++t) {
+        CHECK(p.b->table().wait_filled(200 + t, n, 10'000) == n);
+        p.b->table().unregister_sink(200 + t);
+        CHECK(dsts[t] == payloads[t]);
+    }
+    fprintf(stderr, "mux concurrent tags: ok\n");
+}
+
+void test_mux_death_wakes_waiters() {
+    auto p = make_pair_conns();
+    std::vector<uint8_t> dst(1024, 0);
+    p.b->table().register_sink(300, dst.data(), dst.size());
+
+    std::thread waiter([&] {
+        // must return (short prefix) once the only member conn dies, well
+        // before the 30 s timeout
+        auto t0 = std::chrono::steady_clock::now();
+        p.b->table().wait_filled(300, dst.size(), 30'000);
+        auto waited = std::chrono::steady_clock::now() - t0;
+        CHECK(waited < std::chrono::seconds(25));
+    });
+    std::thread qwaiter([&] {
+        auto r = p.b->table().recv_queued(301, 30'000);
+        CHECK(!r); // dead link -> no frame will ever arrive
+    });
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    p.a->close(); // peer goes away; b's RX loop sees EOF
+    // give the death propagation a moment, then make sure waiters finish
+    waiter.join();
+    qwaiter.join();
+    CHECK(!p.b->alive() || !p.a->alive());
+    p.b->table().unregister_sink(300);
+    fprintf(stderr, "mux death wakes waiters: ok\n");
+}
+
+void test_link_striping() {
+    // two conns sharing the receiver-side SinkTable; Link stripes one large
+    // payload across the pool and the sink reassembles a contiguous prefix
+    auto shared_rx = std::make_shared<net::SinkTable>();
+    auto p1 = make_pair_conns(nullptr, shared_rx);
+    auto p2 = make_pair_conns(nullptr, shared_rx);
+    net::Link link({p1.a, p2.a}, p1.ta); // sender-side view
+
+    const size_t n = 8 * 1024 * 1024;
+    auto data = pattern(n, 23);
+    std::vector<uint8_t> dst(n, 0);
+    shared_rx->register_sink(400, dst.data(), n);
+    auto handles = link.send_async(400, data, 0, /*allow_cma=*/false);
+    CHECK(!handles.empty());
+    CHECK(net::Link::wait_all(handles, 30'000));
+    CHECK(shared_rx->wait_filled(400, n, 30'000) == n);
+    shared_rx->unregister_sink(400);
+    CHECK(dst == data);
+    fprintf(stderr, "link striping: ok\n");
+}
+
+// ---------------- bandwidth probe ----------------
+
+void test_bench_probe() {
+    setenv("PCCLT_BENCH_SECONDS", "0.3", 1);
+    setenv("PCCLT_BENCH_CONNECTIONS", "2", 1);
+
+    bench::ServeState state;
+    net::Listener lis;
+    CHECK(lis.listen(0, 1, true));
+    std::vector<std::thread> servers;
+    std::mutex servers_mu;
+    lis.run_async([&](net::Socket s) {
+        std::lock_guard lk(servers_mu);
+        servers.emplace_back(
+            [&state, sock = std::move(s)]() mutable {
+                bench::serve_connection(std::move(sock), state);
+            });
+    });
+
+    net::Addr target{127u << 24 | 1, lis.port()};
+    // a finished probe's serve threads may still be draining (refcount not
+    // yet back to 0), briefly reporting busy — retry like production does
+    auto probe_retry = [&](net::Addr t) {
+        double m = -2.0;
+        for (int i = 0; i < 100 && m == -2.0; ++i) {
+            m = bench::run_probe(t);
+            if (m == -2.0)
+                std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        }
+        return m;
+    };
+    double m1 = probe_retry(target);
+    double m2 = probe_retry(target);
+    CHECK(m1 > 0 && m2 > 0);
+    // stability: consecutive loopback estimates within a factor of 2
+    // (the ±10% production claim needs a real NIC; CI loopback is noisier)
+    CHECK(std::max(m1, m2) / std::min(m1, m2) < 2.0);
+
+    // busy rejection: a fake prober holds the floor with a different token
+    net::Socket holder;
+    CHECK(holder.connect(target, 5000));
+    std::array<uint8_t, 16> token{};
+    token.fill(0xEE);
+    std::mutex mu;
+    CHECK(net::send_frame(holder, mu, proto::kBenchHello, token));
+    auto ack = net::recv_frame(holder, 5000);
+    CHECK(ack && !ack->payload.empty() && ack->payload[0] == 1);
+    CHECK(bench::run_probe(target) == -2.0); // told busy, not halved
+    holder.shutdown();
+    holder.close();
+
+    lis.stop();
+    {
+        std::lock_guard lk(servers_mu);
+        for (auto &t : servers) t.join();
+    }
+    unsetenv("PCCLT_BENCH_SECONDS");
+    unsetenv("PCCLT_BENCH_CONNECTIONS");
+    fprintf(stderr, "bench probe: ok\n");
+}
+
+} // namespace
+
+int main() {
+    test_frame_roundtrip();
+    test_listener_port_bump();
+    test_control_client_matching();
+    test_mux_basic_and_ooo(false);
+    test_mux_basic_and_ooo(true); // same-host CMA path
+    test_mux_queued_handoff();
+    test_mux_purge_and_cancel();
+    test_mux_concurrent_tags();
+    test_mux_death_wakes_waiters();
+    test_link_striping();
+    test_bench_probe();
+    if (failures) {
+        fprintf(stderr, "SOCKTEST FAILED (%d checks)\n", failures);
+        return 1;
+    }
+    fprintf(stderr, "SOCKTEST PASSED\n");
+    return 0;
+}
