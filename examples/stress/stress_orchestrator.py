@@ -7,13 +7,23 @@ them, and watch stdout heartbeats with a stall detector. Progress anywhere
 in the group within the stall window = healthy; no progress = the collective
 runtime wedged and the soak FAILS.
 
+Master churn: with --master-kill-interval > 0 the MASTER process is also
+SIGKILLed on a schedule and restarted on the same port (reference recipe:
+docs/md/05-ImplementationNotes/03_MasterOrchestration.md — restart the
+master, peers reconnect, training resumes). Peers rejoin with fresh
+communicators (tests/ft_peer.py rejoin path) and the stall detector proves
+the group recovers.
+
 Usage:
     python examples/stress/stress_orchestrator.py --duration 120 --peers 3
+    python examples/stress/stress_orchestrator.py --duration 120 --peers 3 \
+        --master-kill-interval 30
 """
 
 from __future__ import annotations
 
 import argparse
+import socket
 import subprocess
 import sys
 import threading
@@ -23,6 +33,34 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent.parent
 PEER = REPO / "tests" / "ft_peer.py"
 sys.path.insert(0, str(REPO))
+
+
+class MasterProc:
+    """The master as a killable subprocess (python -m pccl_tpu.comm.master)."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pccl_tpu.comm.master", "--port", str(port)],
+            cwd=str(REPO), stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    return
+            except OSError:
+                if self.proc.poll() is not None:
+                    raise RuntimeError("master process died on startup")
+                time.sleep(0.1)
+        raise RuntimeError("master never started listening")
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=10)
 
 
 class Peer:
@@ -63,22 +101,27 @@ def main() -> int:
     ap.add_argument("--die-prob", type=float, default=0.002)
     ap.add_argument("--master-port", type=int, default=48900)
     ap.add_argument("--base-port", type=int, default=58000)
+    ap.add_argument("--master-kill-interval", type=float, default=0.0,
+                    help="SIGKILL + restart the master every this many "
+                         "seconds (0 = master never dies)")
+    ap.add_argument("--master-down-time", type=float, default=1.5,
+                    help="how long the master stays dead before restart")
     ap.add_argument("--stall-seconds", type=float, default=120.0,
                     help="fail if NO peer makes progress for this long "
                          "(reference uses 5 minutes)")
     args = ap.parse_args()
 
-    from pccl_tpu.comm import MasterNode
-
-    master = MasterNode("0.0.0.0", args.master_port)
-    master.run()
+    master = MasterProc(args.master_port)
     peers: list[Peer] = []
     seed = 1
     total_relaunches = 0
+    master_restarts = 0
     retired_steps = 0  # steps of peers that died; keeps the total monotone
+    next_master_kill = (time.time() + args.master_kill_interval
+                        if args.master_kill_interval > 0 else None)
     try:
         for i in range(args.peers):
-            peers.append(Peer(master.port, i, args.base_port + i * 16,
+            peers.append(Peer(args.master_port, i, args.base_port + i * 16,
                               args.die_prob, seed))
             seed += 1
         deadline = time.time() + args.duration
@@ -96,6 +139,22 @@ def main() -> int:
                 print(f"STALL: no progress for {args.stall_seconds}s "
                       f"(total steps {total})", flush=True)
                 return 1
+            # scheduled master assassination (the whole point of the
+            # master-churn soak): SIGKILL, leave it dead for a window,
+            # restart on the same port, peers must rejoin
+            if next_master_kill is not None and time.time() >= next_master_kill:
+                master_restarts += 1
+                print(f"killing master (#{master_restarts}); down for "
+                      f"{args.master_down_time:.1f}s", flush=True)
+                master.kill()
+                time.sleep(args.master_down_time)
+                master = MasterProc(args.master_port)
+                print("master restarted", flush=True)
+                next_master_kill = time.time() + args.master_kill_interval
+            elif not master.alive():
+                # master died on its own: that's a soak failure
+                print("MASTER DIED unexpectedly", flush=True)
+                return 1
             # relaunch the dead (the churn is the point)
             for i, p in enumerate(peers):
                 if not p.alive():
@@ -103,7 +162,7 @@ def main() -> int:
                     retired_steps += p.steps
                     print(f"peer {p.idx} died (steps={p.steps}); relaunching "
                           f"(#{total_relaunches})", flush=True)
-                    peers[i] = Peer(master.port, p.idx, p.base_port,
+                    peers[i] = Peer(args.master_port, p.idx, p.base_port,
                                     args.die_prob, seed)
                     seed += 1
         total = retired_steps + sum(p.steps for p in peers)
@@ -111,15 +170,19 @@ def main() -> int:
             print("SOAK FAILED: zero heartbeat steps over the whole run",
                   flush=True)
             return 1
+        if next_master_kill is not None and master_restarts == 0:
+            print("SOAK FAILED: master churn requested but never exercised",
+                  flush=True)
+            return 1
         print(f"SOAK PASSED: {total} heartbeat steps, "
-              f"{total_relaunches} relaunches in {args.duration:.0f}s",
+              f"{total_relaunches} relaunches, "
+              f"{master_restarts} master restarts in {args.duration:.0f}s",
               flush=True)
         return 0
     finally:
         for p in peers:
             p.kill()
-        master.interrupt()
-        master.destroy()
+        master.kill()
 
 
 if __name__ == "__main__":

@@ -44,22 +44,54 @@ def main() -> int:
     from pccl_tpu.comm import (
         Communicator,
         ConnectionLostError,
+        KickedError,
+        MasterUnreachableError,
         OperationAbortedError,
+        PcclError,
         ReduceOp,
         TooFewPeersError,
     )
 
-    comm = Communicator("127.0.0.1", args.master_port,
-                        p2p_port=args.base_port, ss_port=args.base_port + 4,
-                        bench_port=args.base_port + 8)
-    comm.connect()
+    # losing the master link (master crash/restart, or we got kicked) is
+    # recovered by REJOINING with a fresh communicator — the reference
+    # recipe for master orchestration restarts (docs/md/05-ImplementationNotes/
+    # 03_MasterOrchestration.md): restart master, peers reconnect, the
+    # revision-0 master accepts whatever revision the cohort offers
+    master_loss = (ConnectionLostError, MasterUnreachableError, KickedError)
+
+    def build_comm(budget_s: float = 90.0):
+        deadline = time.time() + budget_s
+        while True:
+            c = Communicator("127.0.0.1", args.master_port,
+                             p2p_port=args.base_port, ss_port=args.base_port + 4,
+                             bench_port=args.base_port + 8)
+            try:
+                c.connect()
+                return c
+            except PcclError:
+                c.destroy()
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    def rejoin(old):
+        try:
+            old.destroy()
+        except Exception:  # noqa: BLE001 — link already dead
+            pass
+        return build_comm()
+
+    comm = build_comm()
     deadline = time.time() + 60
     while comm.world_size < args.min_world:
         if time.time() > deadline:
             print("TIMEOUT waiting for world", flush=True)
             return 2
-        if comm.are_peers_pending():
-            comm.update_topology()
+        try:
+            if comm.are_peers_pending():
+                comm.update_topology()
+        except master_loss:
+            comm = rejoin(comm)
         time.sleep(0.02)
 
     rng = np.random.RandomState(args.seed or args.base_port)
@@ -84,14 +116,22 @@ def main() -> int:
         try:
             if comm.are_peers_pending():
                 comm.update_topology()
+        except master_loss:
+            comm = rejoin(comm)
+            continue
         except Exception:  # noqa: BLE001 — churn mid-vote; retry next loop
             time.sleep(0.05)
             continue
         try:
             info = comm.all_reduce(x, y, op=ReduceOp.SUM)
+        except (KickedError, MasterUnreachableError):
+            comm = rejoin(comm)
+            continue
         except (ConnectionLostError, OperationAbortedError):
             try:
                 comm.update_topology()
+            except master_loss:
+                comm = rejoin(comm)
             except Exception:  # noqa: BLE001
                 time.sleep(0.05)
             continue

@@ -138,6 +138,25 @@ def test_churn_soak_smoke():
     assert "SOAK PASSED" in proc.stdout
 
 
+def test_master_churn_soak_smoke():
+    """Master-kill soak: the MASTER process is SIGKILLed and restarted on a
+    schedule while peers churn too; peers must rejoin (fresh communicator
+    against the restarted master, revision-0 resume) and the group must keep
+    making progress (reference recipe: docs/md/05-ImplementationNotes/
+    03_MasterOrchestration.md — restart master, peers reconnect, resume)."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "stress" / "stress_orchestrator.py"),
+         "--duration", "45", "--peers", "3", "--die-prob", "0.003",
+         "--master-kill-interval", "15",
+         "--master-port", str(_next_port()), "--base-port", str(_next_port(64)),
+         "--stall-seconds", "60"],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, \
+        f"soak failed:\nstdout:{proc.stdout[-1500:]}\nstderr:{proc.stderr[-1500:]}"
+    assert "SOAK PASSED" in proc.stdout
+    assert "master restarts" in proc.stdout
+
+
 def test_late_joiner_is_admitted(master):
     """A peer joining mid-training must be admitted by the running peers'
     update_topology votes and participate in subsequent reduces."""
