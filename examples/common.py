@@ -74,6 +74,61 @@ def synth_batch(rng: np.random.RandomState, batch: int, block: int,
     return x[:, :-1].astype(np.int32), x[:, 1:].astype(np.int32)
 
 
+_CORPUS = None
+
+
+def text_corpus(max_bytes: int = 2 << 20) -> np.ndarray:
+    """Real char-level corpus without network egress: concatenated Python
+    standard-library sources (docstring-heavy English + code). This plays
+    the role of the reference's real-dataset e2e runs (mnist_ddp /
+    mnist_diloco, /root/reference/python/tests/end_to_end/) — genuine,
+    structured data rather than a synthetic token rule. Byte-level,
+    vocab 256, deterministic file order."""
+    global _CORPUS
+    if _CORPUS is not None:
+        return _CORPUS
+    import sysconfig
+    from pathlib import Path
+
+    stdlib = Path(sysconfig.get_paths()["stdlib"])
+    buf = bytearray()
+    for f in sorted(stdlib.glob("*.py")):
+        try:
+            buf += f.read_bytes()
+        except OSError:
+            continue
+        if len(buf) >= max_bytes:
+            break
+    assert len(buf) > 64 * 1024, "stdlib corpus unexpectedly small"
+    _CORPUS = np.frombuffer(bytes(buf[:max_bytes]), dtype=np.uint8)
+    return _CORPUS
+
+
+def text_batch(corpus: np.ndarray, rng: np.random.RandomState, batch: int,
+               block: int):
+    """Random contiguous char windows -> (tokens, targets) int32 [B, T]."""
+    idx = rng.randint(0, len(corpus) - block - 1, size=batch)
+    x = np.stack([corpus[i:i + block + 1] for i in idx])
+    return x[:, :-1].astype(np.int32), x[:, 1:].astype(np.int32)
+
+
+def add_data_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--data", choices=["synthetic", "text"],
+                    default="synthetic",
+                    help="synthetic affine tokens, or real char-level text "
+                         "(python stdlib sources)")
+
+
+def make_batch_fn(args, vocab: int):
+    """Per-peer batch sampler for the chosen dataset; the shard is seeded
+    off the peer's base port (data_rng) either way."""
+    rng = data_rng(args)
+    if getattr(args, "data", "synthetic") == "text":
+        corpus = text_corpus()
+        return lambda: text_batch(corpus, rng, args.batch, args.block)
+    return lambda: synth_batch(rng, args.batch, args.block, vocab)
+
+
 def quant_from_arg(name: str):
     """Map the --quantize CLI choice to a QuantizationAlgorithm."""
     from pccl_tpu.comm import QuantizationAlgorithm

@@ -48,6 +48,7 @@ def main() -> int:
                     help="in-slice tensor-parallel degree (0 = auto mesh)")
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
+    common.add_data_args(ap)
     args = ap.parse_args()
 
     common.force_cpu_if_requested()
@@ -98,12 +99,11 @@ def main() -> int:
                                  quantization=common.quant_from_arg(args.quantize),
                                  quantized_dtype=DataType.UINT8)
 
-    rng = common.data_rng(args)  # per-peer data shard
+    next_batch = common.make_batch_fn(args, cfg.vocab_size)  # per-peer shard
     first_loss = last_loss = None
     for step in range(args.steps):
         common.admit_pending(comm)
-        tok, tgt = common.synth_batch(rng, args.batch, args.block,
-                                      cfg.vocab_size)
+        tok, tgt = next_batch()
         tok = jax.device_put(jnp.asarray(tok), data_sharding)
         tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
         loss, grads = loss_and_grad(params, tok, tgt)

@@ -37,6 +37,7 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--block", type=int, default=64)
     ap.add_argument("--inner-lr", type=float, default=1e-3)
+    common.add_data_args(ap)
     ap.add_argument("--outer-lr", type=float, default=0.7)
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
@@ -67,13 +68,12 @@ def main() -> int:
                              quantization=common.quant_from_arg(args.quantize),
                              quantized_dtype=DataType.UINT8))
 
-    rng = common.data_rng(args)
+    next_batch = common.make_batch_fn(args, cfg.vocab_size)
     first_loss = last_loss = None
     for outer in range(args.outer_steps):
         common.admit_pending(comm)
         for _ in range(args.inner_steps):
-            tok, tgt = common.synth_batch(rng, args.batch, args.block,
-                                          cfg.vocab_size)
+            tok, tgt = next_batch()
             tok = jax.device_put(jnp.asarray(tok), data_sharding)
             tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
             params, opt_state, loss = step_fn(params, opt_state, tok, tgt)

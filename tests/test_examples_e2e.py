@@ -99,3 +99,32 @@ def test_async_diloco_two_peers():
         first, last = _final_losses(out)
         assert last < first
         assert "world 2" in out
+
+
+# --- real-data convergence (reference: mnist_ddp / mnist_diloco e2e) ---
+# char-level LM on real text (python stdlib sources, common.text_corpus);
+# the model must actually LEARN — a substantial loss drop is asserted, not
+# just any decrease. Solo calibration: 5.66 -> 2.80 in 60 steps.
+
+
+def test_nanogpt_ddp_chars_convergence():
+    outs = _run_example(
+        REPO / "examples" / "nanogpt_ddp" / "train_ddp.py", 2,
+        ["--data", "text", "--steps", "40", "--batch", "8", "--lr", "3e-3"])
+    for out in outs:
+        first, last = _final_losses(out)
+        assert last < first - 1.0, f"insufficient learning: {first} -> {last}"
+        assert "world 2" in out
+
+
+def test_sync_diloco_chars_convergence():
+    outs = _run_example(
+        REPO / "examples" / "nanogpt_diloco" / "sync_diloco.py", 2,
+        ["--data", "text", "--outer-steps", "5", "--inner-steps", "10",
+         "--batch", "8", "--inner-lr", "3e-3"])
+    for out in outs:
+        first, last = _final_losses(out)
+        # first_loss is captured after warmup inside the first outer round,
+        # so the visible drop is smaller than DDP's full-curve drop
+        assert last < first - 0.5, f"insufficient learning: {first} -> {last}"
+        assert "world 2" in out
