@@ -692,6 +692,12 @@ std::vector<uint8_t> Client::take_scratch() {
 
 void Client::give_scratch(std::vector<uint8_t> v) {
     if (v.empty()) return;
+    // v.size() is what THIS op actually needed; capacity is the historical
+    // high-water mark. Retire far-oversized buffers so one giant reduce
+    // doesn't pin 8x its chunk size in the pool forever (contents are
+    // scratch, so the shrink realloc copies nothing worth keeping)
+    if (v.capacity() > 2 * v.size() + (1u << 20))
+        v.shrink_to_fit();
     std::lock_guard lk(scratch_mu_);
     if (scratch_pool_.size() < 8) scratch_pool_.push_back(std::move(v));
 }

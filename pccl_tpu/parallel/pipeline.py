@@ -88,6 +88,8 @@ def pipeline_blocks(x: jax.Array, layers: Any, cfg: gpt.GPTConfig, mesh: Mesh,
         cur0 = jnp.zeros((mb, *xs_padded.shape[2:]), x.dtype)
         if hasattr(lax, "pcast"):
             cur0 = lax.pcast(cur0, axis, to="varying")
+        elif hasattr(lax, "pvary"):
+            cur0 = lax.pvary(cur0, (axis,))  # older JAX varying-axes tracking
         _, ys = lax.scan(tick, cur0, jnp.arange(M + S - 1))
         # microbatch m finishes on the LAST stage at tick m + S - 1
         done = lax.dynamic_slice_in_dim(ys, S - 1, M, axis=0)

@@ -91,7 +91,8 @@ def test_survivors_recover_from_sigkill(master):
     """SIGKILL one of three peers mid-run; the other two must finish all
     steps with correct sums over the shrunken world (reference recovery
     protocol: abort broadcast -> p2p re-establish -> caller retry)."""
-    peers = [PeerProc(master.port, r, 55000 + r * 16, steps=30, min_world=3,
+    base = _next_port(64)
+    peers = [PeerProc(master.port, r, base + r * 16, steps=30, min_world=3,
                       step_interval=0.2)
              for r in range(3)]
     try:
@@ -110,8 +111,9 @@ def test_survivors_recover_from_sigkill(master):
 def test_abrupt_exit_mid_run(master):
     """A peer that os._exit()s without goodbye (reference stresstest_peer
     exit(0) pattern) must not wedge the group."""
-    peers = [PeerProc(master.port, 0, 55100, steps=25, min_world=2),
-             PeerProc(master.port, 1, 55116, steps=25, min_world=2,
+    base = _next_port(64)
+    peers = [PeerProc(master.port, 0, base, steps=25, min_world=2),
+             PeerProc(master.port, 1, base + 16, steps=25, min_world=2,
                       die_at=6)]
     try:
         assert peers[1].join() == 0
@@ -160,14 +162,15 @@ def test_master_churn_soak_smoke():
 def test_late_joiner_is_admitted(master):
     """A peer joining mid-training must be admitted by the running peers'
     update_topology votes and participate in subsequent reduces."""
-    peers = [PeerProc(master.port, 0, 55200, steps=60, min_world=2,
+    base = _next_port(64)
+    peers = [PeerProc(master.port, 0, base, steps=60, min_world=2,
                       step_interval=0.25),
-             PeerProc(master.port, 1, 55216, steps=60, min_world=2,
+             PeerProc(master.port, 1, base + 16, steps=60, min_world=2,
                       step_interval=0.25)]
     late = None
     try:
         assert peers[0].wait_for_step(3)
-        late = PeerProc(master.port, 2, 55232, steps=10, min_world=3)
+        late = PeerProc(master.port, 2, base + 32, steps=10, min_world=3)
         assert late.join() == 0, f"late joiner failed: {late.lines[-10:]}"
         assert late.last_world() == 3, f"late joiner world: {late.lines[-5:]}"
         assert peers[0].join() == 0, f"peer0 failed: {peers[0].lines[-10:]}"

@@ -79,13 +79,16 @@ def test_shared_state_sync_with_crc32(monkeypatch):
     from pccl_tpu.comm import (MasterNode, Communicator, SharedState,
                                SharedStateSyncStrategy, TensorInfo)
 
-    master = MasterNode("0.0.0.0", 53400)
+    from conftest import alloc_ports
+
+    ports = alloc_ports(64)
+    master = MasterNode("0.0.0.0", ports)
     master.run()
     errors = []
 
     def worker(rank):
         try:
-            base = 53420 + rank * 16
+            base = ports + 8 + rank * 16
             comm = Communicator("127.0.0.1", master.port, p2p_port=base,
                                 ss_port=base + 4, bench_port=base + 8)
             comm.connect()

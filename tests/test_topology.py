@@ -26,14 +26,17 @@ def test_optimize_topology_three_peers(monkeypatch):
     monkeypatch.setenv("PCCLT_MOONSHOT_MS", "300")
     from pccl_tpu.comm import Communicator, MasterNode, ReduceOp
 
-    master = MasterNode("0.0.0.0", 53600)
+    from conftest import alloc_ports
+
+    ports = alloc_ports(64)
+    master = MasterNode("0.0.0.0", ports)
     master.run()
     errors = []
     done = []
 
     def worker(rank):
         try:
-            base = 53620 + rank * 16
+            base = ports + 8 + rank * 16
             comm = Communicator("127.0.0.1", master.port, p2p_port=base,
                                 ss_port=base + 4, bench_port=base + 8)
             comm.connect()
