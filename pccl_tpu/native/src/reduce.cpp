@@ -45,23 +45,58 @@ ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
     return {start, len};
 }
 
-// Wait until `target` bytes for `tag` arrived, reducing/consuming via `on_data`
-// in sub-chunk slices aligned to `elem_size`. Returns false on abort/conn loss.
+// Wait until `target` bytes for `tag` arrived, reducing/consuming via
+// `on_data(src, lo, hi)` in slices aligned to `elem_size`. Two transports:
+//  - same-host fused pull (registered consumer_pull): the peer's bytes are
+//    process_vm_readv'd in cache-sized slices on THIS thread and reduced
+//    while hot — no scratch round-trip through DRAM;
+//  - TCP streaming: the RX thread fills `scratch` (the registered sink) and
+//    slices are reduced from there as the contiguous prefix grows.
+// Returns false on abort/conn loss.
 bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
-                 const std::function<void(size_t lo, size_t hi)> &on_data,
+                 const uint8_t *scratch,
+                 const std::function<void(const uint8_t *src, size_t lo, size_t hi)> &on_data,
                  Prof *prof = nullptr) {
+    using Claim = net::SinkTable::CmaClaim;
     size_t consumed = 0;
     while (consumed < target) {
+        if (consumed == 0) {
+            // a pending same-host descriptor covers the whole payload: pull
+            // it fused with the reduction on this thread
+            auto t0 = Clock::now();
+            Claim c = ctx.rx.table().consume_cma(
+                tag, target, elem_size,
+                [&](const uint8_t *src, size_t lo, size_t n) {
+                    on_data(src, lo, lo + n);
+                    consumed = lo + n;
+                    return !(ctx.should_abort && ctx.should_abort());
+                });
+            if (prof) prof->compute_ms += ms_since(t0);
+            if (c == Claim::kDone) break;
+            if (c == Claim::kCancelled) return false;
+            // kNone: no descriptor (yet) -> TCP path below re-polls;
+            // kFailed: sender falls back to TCP streaming into the sink
+        }
         size_t want = std::min(target, consumed + kSubChunk);
-        // bounded wait so master aborts / peer death interrupt the stream
+        // bounded wait so master aborts / peer death interrupt the stream;
+        // while nothing has streamed in, also wake the moment a claimable
+        // same-host descriptor arrives (the loop claims it above)
         auto t0 = Clock::now();
-        size_t filled = ctx.rx.table().wait_filled(tag, want, 100);
+        bool cma_pending = false;
+        size_t filled = ctx.rx.table().wait_filled(tag, want, 100, &cma_pending);
         if (prof) prof->wait_ms += ms_since(t0);
+        if (cma_pending) {
+            if (consumed == 0) continue; // claim fused at the top of the loop
+            // fused no longer possible (TCP bytes already consumed): a late
+            // CMA stripe must still be filled + acked or both sides hang
+            ctx.rx.table().fill_pending(tag);
+            continue;
+        }
         // consume only whole elements
         size_t usable = (filled / elem_size) * elem_size;
         if (usable > consumed) {
             t0 = Clock::now();
-            on_data(consumed, usable);
+            on_data(scratch + consumed, consumed, usable);
             if (prof) prof->compute_ms += ms_since(t0);
             consumed = usable;
         }
@@ -114,21 +149,28 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // the pooled scratch buffer. On the TX side it acks dropped CMA
     // descriptors so the peer's pending sends complete.
     auto restore = [&] {
-        memcpy(recv, restore_src, count * esz);
+        // purge FIRST: stage-ahead all-gather sinks point into `recv`, and an
+        // RX thread may still be writing through one — the restore memcpy
+        // must not race with (or be overwritten by) such a write
         ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
         ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+        memcpy(recv, restore_src, count * esz);
     };
     auto fail = [&](bool conn_lost) {
         restore();
         return conn_lost ? Result::kConnectionLost : Result::kAborted;
     };
 
-    // scratch buffers (pooled by the caller when possible)
+    // scratch buffers (pooled by the caller when possible). TWO slots,
+    // alternating by stage: the next stage's sink is registered BEFORE this
+    // stage's stream is consumed, so symmetric peers' data never races ahead
+    // of registration into the queued-copy slow path (at most two stages can
+    // be in flight: the peer cannot send stage s+2 before consuming our
+    // stage s+1, which we only send after consuming stage s)
     size_t max_chunk = chunk_of(count, world, 0).n_elems;
     std::vector<uint8_t> scratch_local;
     std::vector<uint8_t> &rx_vec = ctx.scratch ? *ctx.scratch : scratch_local;
-    if (rx_vec.size() < max_chunk * qsz) rx_vec.resize(max_chunk * qsz);
-    uint8_t *rx_scratch = rx_vec.data();
+    if (rx_vec.size() < 2 * max_chunk * qsz) rx_vec.resize(2 * max_chunk * qsz);
     std::vector<uint8_t> tx_scratch(quantized ? max_chunk * qsz : 0);
 
     // Async TX via the conn's dedicated sender thread (or the same-host CMA
@@ -151,11 +193,48 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         if (profp) prof.join_ms += ms_since(t0);
         return ok;
     };
-    auto reg_sink = [&](uint64_t tag, uint8_t *base, size_t cap) {
+    auto reg_sink = [&](uint64_t tag, uint8_t *base, size_t cap, bool consumer_pull) {
         auto t0 = Clock::now();
-        ctx.rx.table().register_sink(tag, base, cap);
+        ctx.rx.table().register_sink(tag, base, cap, consumer_pull);
         if (profp) prof.other_ms += ms_since(t0);
     };
+
+    // stage sequence: reduce-scatter stages seq 0..world-2, then all-gather
+    // stages seq world-1..2*world-3; each has a known tag, scratch slot and
+    // receive size, so sinks can be registered one stage ahead
+    const uint32_t rs_stages = world - 1;
+    const uint32_t total_stages = 2 * (world - 1);
+    auto scratch_at = [&](uint32_t seq) {
+        return rx_vec.data() + (seq % 2) * max_chunk * qsz;
+    };
+    auto reg_stage = [&](uint32_t seq) {
+        if (seq >= total_stages) return;
+        if (seq < rs_stages) {
+            // reduce-scatter: into the stage's scratch slot for streamed
+            // accumulate (quantized: quantized bytes, meta arrives separately).
+            // consumer_pull: same-host descriptors are claimed by the op
+            // thread and reduced fused, skipping the scratch DRAM round-trip
+            const uint32_t recv_c = (rank + world - seq - 1) % world;
+            reg_sink(base_tag | seq, scratch_at(seq),
+                     chunk_of(count, world, recv_c).n_elems * qsz, true);
+            return;
+        }
+        const uint32_t s = seq - rs_stages;
+        const uint64_t tag = base_tag | (0x4000u + s);
+        const auto span = chunk_of(count, world, (rank + world - s) % world);
+        if (quantized) {
+            reg_sink(tag, scratch_at(seq), span.n_elems * qsz, true);
+        } else {
+            // zero-copy all-gather: the reduced chunk lands straight in the
+            // result buffer (NOT consumer_pull: the rx-thread fill into the
+            // result IS the single copy). Registering one stage early is
+            // safe: the peer only sends this chunk after it has consumed
+            // (and for CMA, pulled) everything we previously sent from this
+            // region.
+            reg_sink(tag, out + span.start_elem * esz, span.n_elems * esz, false);
+        }
+    };
+    reg_stage(0); // before ANY tx: inbound bytes always find a live sink
 
     // ---------------- phase 1: reduce-scatter ----------------
     for (uint32_t s = 0; s + 1 < world; ++s) {
@@ -167,6 +246,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         uint8_t *send_ptr = out + send_span.start_elem * esz;
         uint8_t *recv_ptr = out + recv_span.start_elem * esz;
 
+        uint8_t *rx_scratch = scratch_at(s);
         std::vector<net::SendHandle> tx_job;
         quant::Meta rx_meta;
         if (quantized) {
@@ -177,8 +257,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                {tx_scratch.data(), send_span.n_elems * qsz});
             ctx.tx_bytes += send_span.n_elems * qsz;
 
-            // receive peer meta first, then streamed quantized payload
-            reg_sink(tag, rx_scratch, recv_span.n_elems * qsz);
+            // sink for THIS stage was registered a stage ahead; open the
+            // next stage's sink before consuming, then take peer meta
+            reg_stage(s + 1);
             auto mraw = ctx.rx.table().recv_queued(tag | kMetaBit, 60'000);
             if (!mraw) {
                 join_tx(tx_job);
@@ -190,11 +271,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                 return fail(false);
             }
             rx_meta = *m;
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz,
-                                  [&](size_t lo, size_t hi) {
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
+                                  [&](const uint8_t *src, size_t lo, size_t hi) {
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
                                       quant::dequantize_accumulate(
-                                          rx_meta, ctx.op, rx_scratch + lo,
+                                          rx_meta, ctx.op, src,
                                           recv_ptr + e0 * esz, e1 - e0);
                                   }, profp);
             ctx.rx.table().unregister_sink(tag);
@@ -210,15 +291,14 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             ctx.tx_bytes += send_span.n_elems * esz;
             const uint8_t *local_ptr =
                 lazy ? src8 + recv_span.start_elem * esz : recv_ptr;
-            reg_sink(tag, rx_scratch, recv_span.n_elems * esz);
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
-                                  [&](size_t lo, size_t hi) {
+            reg_stage(s + 1); // next stage's sink opens before we consume
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz, rx_scratch,
+                                  [&](const uint8_t *src, size_t lo, size_t hi) {
                                       size_t e0 = lo / esz, e1 = hi / esz;
                                       kernels::accumulate3(ctx.dtype, ctx.op,
                                                            recv_ptr + e0 * esz,
                                                            local_ptr + e0 * esz,
-                                                           rx_scratch + lo,
-                                                           e1 - e0);
+                                                           src, e1 - e0);
                                   }, profp);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
@@ -242,6 +322,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         const auto recv_span = chunk_of(count, world, recv_c);
         uint8_t *send_ptr = out + send_span.start_elem * esz;
         uint8_t *recv_ptr = out + recv_span.start_elem * esz;
+        uint8_t *rx_scratch = scratch_at(rs_stages + s);
 
         std::vector<net::SendHandle> tx_job;
         if (quantized) {
@@ -257,7 +338,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             tx_job = launch_tx(tag, fwd_meta, fwd_q);
             ctx.tx_bytes += fwd_q.size();
 
-            reg_sink(tag, rx_scratch, recv_span.n_elems * qsz);
+            reg_stage(rs_stages + s + 1); // sink for THIS stage opened earlier
             auto mraw = ctx.rx.table().recv_queued(tag | kMetaBit, 60'000);
             if (!mraw) {
                 join_tx(tx_job);
@@ -268,27 +349,35 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                 join_tx(tx_job);
                 return fail(false);
             }
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz,
-                                  [&](size_t lo, size_t hi) {
+            // forwarding stages must keep the raw quantized bytes: the fused
+            // CMA path consumes from a bounce buffer, so mirror each slice
+            // into rx_scratch (cache-hot, and only when actually forwarding)
+            const bool fwd_needed = s + 2 < world;
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
+                                  [&](const uint8_t *src, size_t lo, size_t hi) {
+                                      if (fwd_needed && src != rx_scratch + lo)
+                                          memcpy(rx_scratch + lo, src, hi - lo);
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
-                                      quant::dequantize_set(*m, rx_scratch + lo,
+                                      quant::dequantize_set(*m, src,
                                                             recv_ptr + e0 * esz, e1 - e0);
                                   }, profp);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
-            // forward what we received on the next stage; the send buffer
-            // must be distinct from rx_scratch (next stage writes into it)
-            fwd_q.assign(rx_scratch, rx_scratch + recv_span.n_elems * qsz);
-            fwd_meta = mraw.value();
+            if (fwd_needed) {
+                // forward what we received on the next stage; the send buffer
+                // must be distinct from rx_scratch (next stage writes into it)
+                fwd_q.assign(rx_scratch, rx_scratch + recv_span.n_elems * qsz);
+                fwd_meta = mraw.value();
+            }
         } else {
             tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});
             ctx.tx_bytes += send_span.n_elems * esz;
-            // zero-copy: incoming reduced chunk lands straight in the result
-            reg_sink(tag, recv_ptr, recv_span.n_elems * esz);
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz,
-                                  [](size_t, size_t) {}, profp);
+            // zero-copy sink was registered a stage ahead; open the next
+            reg_stage(rs_stages + s + 1);
+            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz, recv_ptr,
+                                  [](const uint8_t *, size_t, size_t) {}, profp);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());

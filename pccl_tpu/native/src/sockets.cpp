@@ -470,13 +470,15 @@ void SinkTable::attach(const std::shared_ptr<MultiplexConn> &conn) {
 
 void SinkTable::on_conn_dead() { ev_.signal(); }
 
-void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
+void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
+                              bool consumer_pull) {
     std::vector<PendingDesc> descs;
     {
         std::lock_guard lk(mu_);
         Sink s;
         s.base = base;
         s.cap = cap;
+        s.consumer_pull = consumer_pull;
         // frames that raced ahead of registration were queued with their
         // offsets; place them now
         auto qit = queues_.find(tag);
@@ -495,10 +497,13 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
             queues_.erase(qit);
         }
         sinks_[tag] = std::move(s);
-        auto range = pending_descs_.equal_range(tag);
-        for (auto it = range.first; it != range.second; ++it)
-            descs.push_back(it->second);
-        pending_descs_.erase(range.first, range.second);
+        if (!consumer_pull) {
+            auto range = pending_descs_.equal_range(tag);
+            for (auto it = range.first; it != range.second; ++it)
+                descs.push_back(it->second);
+            pending_descs_.erase(range.first, range.second);
+        }
+        // consumer_pull: pendings stay queued for consume_cma()
     }
     ev_.signal();
     // resolve CMA descriptors that arrived before the sink: pull the bytes
@@ -507,10 +512,17 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap) {
         if (auto c = d.ack_conn.lock()) c->do_cma_fill(tag, d);
 }
 
-size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms) {
+size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms,
+                              bool *cma_pending) {
     size_t cur = 0;
     park::wait_event(ev_, timeout_ms, [&] {
         std::lock_guard lk(mu_);
+        if (cma_pending && pending_descs_.count(tag)) {
+            *cma_pending = true; // a claimable same-host descriptor arrived
+            auto it = sinks_.find(tag);
+            cur = it == sinks_.end() ? 0 : it->second.prefix;
+            return true;
+        }
         auto it = sinks_.find(tag);
         if (it == sinks_.end()) {
             cur = 0;
@@ -871,41 +883,17 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         send_ctl(drop ? kCmaAck : kCmaNack, tag, d.off);
         return;
     }
-    // identity probe: read the announced token from the announced pid and
-    // compare with the copy that came over TCP. A pid from another pid
-    // namespace, or reused after a restart, fails here and the sender falls
-    // back to streaming — never a silent read of the wrong process.
-    {
-        uint32_t pid = 0;
-        uint64_t taddr = 0;
-        std::array<uint8_t, 16> expect{};
+    if (!cma_verify_peer(d)) {
         {
-            std::lock_guard lk(cma_mu_);
-            if (cma_peer_valid_) {
-                pid = cma_peer_pid_;
-                taddr = cma_peer_token_addr_;
-                expect = cma_peer_token_;
-            }
+            std::lock_guard lk(table_->mu_);
+            auto it = table_->sinks_.find(tag);
+            if (it != table_->sinks_.end()) --it->second.busy;
         }
-        std::array<uint8_t, 16> got{};
-        struct iovec liov{got.data(), 16};
-        struct iovec riov{reinterpret_cast<void *>(taddr), 16};
-        bool verified = pid != 0 && pid == d.pid &&
-                        process_vm_readv(static_cast<pid_t>(pid), &liov, 1, &riov,
-                                         1, 0) == 16 &&
-                        got == expect;
-        if (!verified) {
-            {
-                std::lock_guard lk(table_->mu_);
-                auto it = table_->sinks_.find(tag);
-                if (it != table_->sinks_.end()) --it->second.busy;
-            }
-            table_->ev_.signal();
-            send_ctl(kCmaNack, tag, d.off);
-            PLOG(kWarn) << "CMA identity probe failed for pid " << d.pid
-                        << "; falling back to streaming";
-            return;
-        }
+        table_->ev_.signal();
+        send_ctl(kCmaNack, tag, d.off);
+        PLOG(kWarn) << "CMA identity probe failed for pid " << d.pid
+                    << "; falling back to streaming";
+        return;
     }
     bool ok = true, cancelled = false;
     size_t off = 0;
@@ -946,6 +934,115 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
     if (!ok && !cancelled)
         PLOG(kWarn) << "CMA read from pid " << d.pid << " failed (errno " << errno
                     << "); peer will fall back to streaming";
+}
+
+bool MultiplexConn::cma_verify_peer(const SinkTable::PendingDesc &d) {
+    // identity probe: read the announced token from the announced pid and
+    // compare with the copy that came over TCP. A pid from another pid
+    // namespace, or reused after a restart, fails here and the sender falls
+    // back to streaming — never a silent read of the wrong process.
+    uint32_t pid = 0;
+    uint64_t taddr = 0;
+    std::array<uint8_t, 16> expect{};
+    {
+        std::lock_guard lk(cma_mu_);
+        if (cma_peer_valid_) {
+            pid = cma_peer_pid_;
+            taddr = cma_peer_token_addr_;
+            expect = cma_peer_token_;
+        }
+    }
+    std::array<uint8_t, 16> got{};
+    struct iovec liov{got.data(), 16};
+    struct iovec riov{reinterpret_cast<void *>(taddr), 16};
+    return pid != 0 && pid == d.pid &&
+           process_vm_readv(static_cast<pid_t>(pid), &liov, 1, &riov, 1, 0) == 16 &&
+           got == expect;
+}
+
+SinkTable::CmaClaim MultiplexConn::consumer_cma_pull(
+    uint64_t tag, const SinkTable::PendingDesc &d, size_t slice_align,
+    const std::function<bool(const uint8_t *, size_t, size_t)> &consume) {
+    if (!cma_verify_peer(d)) {
+        send_ctl(kCmaNack, tag, d.off);
+        PLOG(kWarn) << "CMA identity probe failed for pid " << d.pid
+                    << "; falling back to streaming";
+        return SinkTable::CmaClaim::kFailed;
+    }
+    // cache-sized bounce: each slice is pulled and immediately fed to the
+    // reduction while still cache-hot — no scratch round-trip through DRAM
+    static const size_t bounce_bytes = env_size("PCCLT_CMA_BOUNCE_BYTES", 256u << 10);
+    size_t slice = bounce_bytes;
+    if (slice_align > 1) slice -= slice % slice_align;
+    if (slice == 0) slice = slice_align;
+    thread_local std::vector<uint8_t> bounce;
+    if (bounce.size() < slice) bounce.resize(slice);
+
+    size_t off = 0;
+    while (off < d.len) {
+        size_t want = std::min(slice, d.len - off);
+        size_t got = 0;
+        while (got < want) {
+            struct iovec liov{bounce.data() + got, want - got};
+            struct iovec riov{reinterpret_cast<void *>(d.addr + off + got), want - got};
+            ssize_t r = process_vm_readv(static_cast<pid_t>(d.pid), &liov, 1, &riov, 1, 0);
+            if (r <= 0) {
+                send_ctl(kCmaNack, tag, d.off);
+                PLOG(kWarn) << "CMA read from pid " << d.pid << " failed (errno "
+                            << errno << "); peer will fall back to streaming";
+                return SinkTable::CmaClaim::kFailed;
+            }
+            got += static_cast<size_t>(r);
+        }
+        if (!consume(bounce.data(), d.off + off, want)) {
+            // consumer aborted: ack-drop so the sender's handle completes
+            send_ctl(kCmaAck, tag, d.off);
+            return SinkTable::CmaClaim::kCancelled;
+        }
+        off += want;
+    }
+    send_ctl(kCmaAck, tag, d.off);
+    return SinkTable::CmaClaim::kDone;
+}
+
+void SinkTable::fill_pending(uint64_t tag) {
+    std::vector<PendingDesc> descs;
+    {
+        std::lock_guard lk(mu_);
+        auto range = pending_descs_.equal_range(tag);
+        for (auto it = range.first; it != range.second; ++it)
+            descs.push_back(it->second);
+        pending_descs_.erase(range.first, range.second);
+    }
+    for (auto &d : descs)
+        if (auto c = d.ack_conn.lock()) c->do_cma_fill(tag, d);
+}
+
+SinkTable::CmaClaim SinkTable::consume_cma(
+    uint64_t tag, size_t len, size_t slice_align,
+    const std::function<bool(const uint8_t *, size_t, size_t)> &consume) {
+    PendingDesc d;
+    std::shared_ptr<MultiplexConn> conn;
+    bool mismatch = false;
+    {
+        std::lock_guard lk(mu_);
+        auto it = pending_descs_.find(tag);
+        if (it == pending_descs_.end()) return CmaClaim::kNone;
+        d = it->second;
+        conn = d.ack_conn.lock();
+        pending_descs_.erase(it);
+        mismatch = d.off != 0 || d.len != len;
+    }
+    if (!conn) return CmaClaim::kNone; // conn died; nothing to ack
+    if (mismatch) {
+        // unexpected shape (striped/partial): fill the registered sink the
+        // ordinary way — this one and any other stripes queued behind it —
+        // and let the caller's wait_filled path consume them
+        conn->do_cma_fill(tag, d);
+        fill_pending(tag);
+        return CmaClaim::kNone;
+    }
+    return conn->consumer_cma_pull(tag, d, slice_align, consume);
 }
 
 void MultiplexConn::rx_loop() {
@@ -1036,13 +1133,18 @@ void MultiplexConn::rx_loop() {
             d.addr = wire::from_be(be_addr);
             d.len = wire::from_be(be_dlen);
             d.off = off;
-            bool have_sink;
+            bool fill_now;
             {
                 std::lock_guard lk(table_->mu_);
-                have_sink = table_->sinks_.count(tag) != 0;
-                if (!have_sink) table_->pending_descs_.emplace(tag, d);
+                auto it = table_->sinks_.find(tag);
+                // consumer_pull sinks (and absent sinks) keep the descriptor
+                // pending: the consumer claims it via consume_cma and pulls
+                // fused with its reduction on its own thread
+                fill_now = it != table_->sinks_.end() && !it->second.consumer_pull;
+                if (!fill_now) table_->pending_descs_.emplace(tag, d);
             }
-            if (have_sink) do_cma_fill(tag, d);
+            if (fill_now) do_cma_fill(tag, d);
+            else table_->ev_.signal(); // wake a consumer polling for the claim
             continue;
         }
 

@@ -198,8 +198,16 @@ public:
     // until the CONTIGUOUS prefix reaches min bytes or timeout_ms elapsed
     // (timeout_ms < 0 = forever); returns the current prefix so callers can
     // poll abort conditions between bounded waits.
-    void register_sink(uint64_t tag, uint8_t *base, size_t cap);
-    size_t wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms = -1);
+    // consumer_pull: same-host CMA descriptors for this tag are NOT filled
+    // by the RX thread; they stay pending for the consumer to claim_cma()
+    // and pull fused with its reduction (TCP frames still fill normally).
+    void register_sink(uint64_t tag, uint8_t *base, size_t cap,
+                       bool consumer_pull = false);
+    // cma_pending (optional): also return, setting *cma_pending, as soon as
+    // a same-host descriptor is pending for `tag` — the caller claims it via
+    // consume_cma instead of waiting out the slice
+    size_t wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms = -1,
+                       bool *cma_pending = nullptr);
     // Blocks while any RX thread is mid-write into the sink buffer so the
     // buffer can be freed safely.
     void unregister_sink(uint64_t tag);
@@ -208,6 +216,25 @@ public:
     // frames for tags with no sink land in a per-tag queue.
     std::optional<std::vector<uint8_t>> recv_queued(uint64_t tag, int timeout_ms = -1,
                                                     const std::atomic<bool> *abort = nullptr);
+
+    // Fused same-host consume: if a CMA descriptor covering exactly [0, len)
+    // is pending for `tag` (registered consumer_pull), pull it on the CALLING
+    // thread in cache-sized element-aligned slices, feeding each slice to
+    // consume(src, lo, n) while it is still cache-hot — the peer's bytes go
+    // straight through the reduction without a scratch round-trip to DRAM.
+    //   kNone      — nothing pending: caller should wait_filled (TCP path)
+    //   kDone      — fully pulled + consumed; sender acked
+    //   kCancelled — consume returned false (op abort); sender acked-dropped
+    //   kFailed    — identity/read failure; sender falls back to TCP
+    enum class CmaClaim { kNone, kDone, kCancelled, kFailed };
+    CmaClaim consume_cma(uint64_t tag, size_t len, size_t slice_align,
+                         const std::function<bool(const uint8_t *, size_t, size_t)> &consume);
+
+    // Route any pending descriptors for `tag` through the ordinary sink fill
+    // (rx-thread style, on the calling thread). Used when fused consumption
+    // is no longer possible — e.g. TCP stripes already started streaming for
+    // this tag — so a late CMA stripe can never strand un-acked.
+    void fill_pending(uint64_t tag);
 
     // Drop all sinks, queued frames, and pending CMA descriptors with
     // lo <= tag < hi (end-of-op cleanup).
@@ -227,6 +254,7 @@ private:
         std::map<size_t, size_t> extents; // out-of-order [off,end) past prefix
         int busy = 0;    // RX/CMA writers currently writing outside the lock
         bool cancel = false; // unregister requested: stop writing, drop rest
+        bool consumer_pull = false; // CMA descs held for consume_cma()
         void add_extent(size_t off, size_t end);
     };
     struct PendingDesc { // CMA descriptor that arrived before its sink
@@ -306,6 +334,13 @@ private:
     // receiver side: pull `d` into the registered sink via process_vm_readv,
     // update the fill level, and ack/nack on this conn
     void do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d);
+    // identity probe: the announced pid must still resolve to the announcing
+    // process in OUR pid namespace (token read-back)
+    bool cma_verify_peer(const SinkTable::PendingDesc &d);
+    // consumer-thread fused pull for consume_cma(); bounce-buffer slices
+    SinkTable::CmaClaim consumer_cma_pull(
+        uint64_t tag, const SinkTable::PendingDesc &d, size_t slice_align,
+        const std::function<bool(const uint8_t *, size_t, size_t)> &consume);
     void send_ctl(Kind kind, uint64_t tag, uint64_t off); // ack/nack via TX queue
     void fail_all_pending();
 

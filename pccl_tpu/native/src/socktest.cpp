@@ -411,14 +411,26 @@ void test_bench_probe() {
     CHECK(std::max(m1, m2) / std::min(m1, m2) < 2.0);
 
     // busy rejection: a fake prober holds the floor with a different token
+    // (the previous probe's serve threads may still be draining, so acquiring
+    // the floor can take a few tries)
     net::Socket holder;
-    CHECK(holder.connect(target, 5000));
     std::array<uint8_t, 16> token{};
     token.fill(0xEE);
-    std::mutex mu;
-    CHECK(net::send_frame(holder, mu, proto::kBenchHello, token));
-    auto ack = net::recv_frame(holder, 5000);
-    CHECK(ack && !ack->payload.empty() && ack->payload[0] == 1);
+    bool held = false;
+    for (int i = 0; i < 100 && !held; ++i) {
+        holder = net::Socket{};
+        CHECK(holder.connect(target, 5000));
+        std::mutex mu;
+        CHECK(net::send_frame(holder, mu, proto::kBenchHello, token));
+        auto ack = net::recv_frame(holder, 5000);
+        CHECK(ack && !ack->payload.empty());
+        held = ack && !ack->payload.empty() && ack->payload[0] == 1;
+        if (!held) {
+            holder.close();
+            std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        }
+    }
+    CHECK(held);
     CHECK(bench::run_probe(target) == -2.0); // told busy, not halved
     holder.shutdown();
     holder.close();
