@@ -38,11 +38,18 @@ sys.path.insert(0, str(REPO))
 class MasterProc:
     """The master as a killable subprocess (python -m pccl_tpu.comm.master)."""
 
+    _instance = 0
+
     def __init__(self, port: int):
         self.port = port
+        import os
+        MasterProc._instance += 1
+        log = os.environ.get("MASTER_LOG")
+        out = (open(f"{log}.{MasterProc._instance}", "wb")
+               if log else subprocess.DEVNULL)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "pccl_tpu.comm.master", "--port", str(port)],
-            cwd=str(REPO), stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+            cwd=str(REPO), stdout=out, stderr=subprocess.STDOUT)
         deadline = time.time() + 15
         while time.time() < deadline:
             try:
@@ -153,7 +160,8 @@ def main() -> int:
                 next_master_kill = time.time() + args.master_kill_interval
             elif not master.alive():
                 # master died on its own: that's a soak failure
-                print("MASTER DIED unexpectedly", flush=True)
+                print(f"MASTER DIED unexpectedly (exit code "
+                      f"{master.proc.returncode})", flush=True)
                 return 1
             # relaunch the dead (the churn is the point)
             for i, p in enumerate(peers):

@@ -217,14 +217,17 @@ Status Client::connect() {
 
 void Client::disconnect() {
     connected_ = false;
+    std::unique_ptr<util::WorkerPool> pool;
     {
         std::lock_guard lk(ops_mu_);
         for (auto &[_, op] : ops_) {
             op->abort = true;
-            if (op->worker.joinable()) op->worker.join();
+            op->result.wait();
         }
         ops_.clear();
+        pool = std::move(op_pool_); // taken under the admission lock
     }
+    pool.reset(); // joins the pooled worker threads (they never take ops_mu_)
     master_.close();
     p2p_listener_.stop();
     ss_listener_.stop();
@@ -546,13 +549,22 @@ Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
     if (group_world() < 2) return Status::kTooFewPeers;
     {
         std::lock_guard lk(ops_mu_);
+        // re-check under the lock: a concurrent disconnect() clears ops_ and
+        // tears the pool down under this same mutex, so an op admitted here
+        // can never race the pool's destruction
+        if (!connected_.load()) return Status::kNotConnected;
         if (ops_.count(desc.tag)) return Status::kDuplicateTag;
         if (ops_.size() >= max_concurrent_ops()) return Status::kPendingAsyncOps;
+        // pool sized to the concurrency cap, created on first use: every
+        // admitted op gets a thread immediately (reference: the client
+        // state's pithreadpool, ccoip_client_state.hpp:98)
+        if (!op_pool_)
+            op_pool_ = std::make_unique<util::WorkerPool>(max_concurrent_ops());
         auto op = std::make_unique<AsyncOp>();
         auto promise = std::make_shared<std::promise<Status>>();
         op->result = promise->get_future();
         AsyncOp *op_ptr = op.get();
-        op->worker = std::thread([this, send, recv, count, dtype, desc, op_ptr, promise] {
+        op_pool_->submit([this, send, recv, count, dtype, desc, op_ptr, promise] {
             Status st = run_reduce_worker(send, recv, count, dtype, desc, op_ptr);
             promise->set_value(st);
         });
@@ -618,6 +630,10 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         return true;
     };
 
+    static const bool dbg_phases = std::getenv("PCCLT_DEBUG_PHASES") != nullptr;
+    if (dbg_phases)
+        fprintf(stderr, "[op %llu] commenced seq=%llu\n",
+                (unsigned long long)desc.tag, (unsigned long long)seq);
     Status st = Status::kOk;
     // snapshot the in-place input here (not just inside the ring) so a
     // post-hoc abort verdict can also restore it — all ranks must retry a
@@ -661,6 +677,9 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     }
 
     // 3. report completion; consume the exactly-one abort verdict; await done
+    if (dbg_phases)
+        fprintf(stderr, "[op %llu] ring done st=%d seq=%llu\n",
+                (unsigned long long)desc.tag, int(st), (unsigned long long)seq);
     bool local_failure = st != Status::kOk;
     wire::Writer w;
     w.u64(desc.tag);
@@ -670,8 +689,15 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     if (!consumed_abort) {
         if (!consume_abort(false)) return Status::kConnectionLost;
     }
+    if (dbg_phases)
+        fprintf(stderr, "[op %llu] verdict=%d seq=%llu\n",
+                (unsigned long long)desc.tag, int(verdict_aborted),
+                (unsigned long long)seq);
     auto done = master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
     if (!done) return Status::kConnectionLost;
+    if (dbg_phases)
+        fprintf(stderr, "[op %llu] done seq=%llu\n", (unsigned long long)desc.tag,
+                (unsigned long long)seq);
 
     if (st == Status::kOk && verdict_aborted) {
         // we finished the ring, but the op was aborted group-wide: restore the
@@ -711,7 +737,6 @@ Status Client::await_reduce(uint64_t tag, ReduceInfo *info) {
         op = std::move(it->second);
         ops_.erase(it);
     }
-    if (op->worker.joinable()) op->worker.join();
     Status st = op->result.get();
     if (info) *info = op->info;
     return st;

@@ -19,6 +19,7 @@
 #include <thread>
 #include <vector>
 
+#include "pool.hpp"
 #include "protocol.hpp"
 #include "sockets.hpp"
 
@@ -113,7 +114,6 @@ private:
         std::shared_ptr<net::SinkTable> tx_table, rx_table;
     };
     struct AsyncOp {
-        std::thread worker;
         std::future<Status> result;
         ReduceInfo info;
         std::atomic<bool> abort{false};
@@ -152,6 +152,7 @@ private:
 
     std::mutex ops_mu_;
     std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_;
+    std::unique_ptr<util::WorkerPool> op_pool_; // lazily sized to the op cap
 
     // reuse pool for ring receive scratch: per-op vectors would be
     // page-zeroed by the kernel on every reduce (milliseconds at 10s of MiB)

@@ -100,7 +100,11 @@ void Master::dispatcher_loop() {
             }
             if (conn) {
                 conn->sock.close();
-                if (conn->reader.joinable()) conn->reader.detach();
+                // join, never detach: the reader's last act was pushing this
+                // very disconnect event, so it is instants from exiting — a
+                // detached reader could still be inside push_event when the
+                // Master is destroyed, racing the condvar's destruction
+                if (conn->reader.joinable()) conn->reader.join();
             }
         } else {
             uint32_t src_ip = 0;

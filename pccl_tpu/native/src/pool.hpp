@@ -1,0 +1,69 @@
+// Fixed-size worker pool for async collective ops.
+//
+// Reference parity: pi::threadpool::ThreadPool (vendored pithreadpool,
+// owned by the client state at ccoip_client_state.hpp:98, sized by
+// PCCL_MAX_CONCURRENT_COLLECTIVE_OPS default 16) — collective workers run
+// on pooled threads instead of a fresh std::thread per op, so launching a
+// burst of concurrent reduces costs queue pushes, not thread spawns.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace pcclt::util {
+
+class WorkerPool {
+public:
+    explicit WorkerPool(size_t threads) {
+        threads_.reserve(threads);
+        for (size_t i = 0; i < threads; ++i)
+            threads_.emplace_back([this] { run(); });
+    }
+
+    ~WorkerPool() {
+        {
+            std::lock_guard lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto &t : threads_) t.join();
+    }
+
+    WorkerPool(const WorkerPool &) = delete;
+    WorkerPool &operator=(const WorkerPool &) = delete;
+
+    void submit(std::function<void()> fn) {
+        {
+            std::lock_guard lk(mu_);
+            q_.push_back(std::move(fn));
+        }
+        cv_.notify_one();
+    }
+
+private:
+    void run() {
+        for (;;) {
+            std::function<void()> fn;
+            {
+                std::unique_lock lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !q_.empty(); });
+                if (stop_ && q_.empty()) return;
+                fn = std::move(q_.front());
+                q_.pop_front();
+            }
+            fn();
+        }
+    }
+
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::deque<std::function<void()>> q_;
+    std::vector<std::thread> threads_;
+    bool stop_ = false;
+};
+
+} // namespace pcclt::util

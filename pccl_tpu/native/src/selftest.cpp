@@ -148,14 +148,44 @@ static void test_atsp() {
 
 // ---- end-to-end: master + N clients, fp32 ring allreduce + shared state ----
 
+// Port base below the kernel ephemeral range (32768-60999): an in-range
+// listener can lose its port to any stray outbound socket between binds
+// (same rationale as tests/conftest.py's allocator). The Python suite
+// allocates upward from 20000; this binary starts at 28000 to coexist.
+static uint16_t alloc_test_ports(uint16_t span) {
+    static uint16_t next = 28000;
+    uint16_t p = next;
+    next += span;
+    return p;
+}
+
+// shared e2e plumbing: configured client + join-the-world wait
+static client::ClientConfig peer_cfg(uint16_t master_port, uint16_t base, size_t r) {
+    client::ClientConfig cfg;
+    cfg.master = *net::Addr::parse("127.0.0.1", master_port);
+    cfg.p2p_port = static_cast<uint16_t>(base + r * 24);
+    cfg.ss_port = static_cast<uint16_t>(base + r * 24 + 8);
+    cfg.bench_port = static_cast<uint16_t>(base + r * 24 + 16);
+    return cfg;
+}
+
+static bool wait_world(client::Client &cl, size_t world) {
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(60);
+    while (cl.group_world() < world) {
+        if (std::chrono::steady_clock::now() > deadline) return false;
+        bool pending = false;
+        cl.are_peers_pending(pending);
+        if (pending) cl.update_topology();
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    return true;
+}
+
 static void test_e2e(size_t world, proto::QuantAlgo quant) {
-    master::Master m(0); // port 0 -> bump allocation from 48501 happens in api; use random
-    // use an ephemeral-ish fixed test port
-    static uint16_t port_base = 49400;
-    uint16_t port = port_base;
-    port_base += 16;
+    uint16_t port = alloc_test_ports(512);
     master::Master mm(port);
     CHECK(mm.launch());
+    uint16_t base = static_cast<uint16_t>(port + 16);
     port = mm.port();
 
     const size_t count = 4099; // deliberately not divisible by world
@@ -164,23 +194,13 @@ static void test_e2e(size_t world, proto::QuantAlgo quant) {
 
     for (size_t r = 0; r < world; ++r) {
         threads.emplace_back([&, r] {
-            client::ClientConfig cfg;
-            cfg.master = *net::Addr::parse("127.0.0.1", port);
-            cfg.p2p_port = static_cast<uint16_t>(49600 + r * 8);
-            cfg.ss_port = static_cast<uint16_t>(49700 + r * 8);
-            cfg.bench_port = static_cast<uint16_t>(49800 + r * 8);
-            client::Client cl(cfg);
+            client::Client cl(peer_cfg(port, base, r));
             if (cl.connect() != client::Status::kOk) {
                 fprintf(stderr, "peer %zu: connect failed\n", r);
                 return;
             }
             // wait for all peers to join (reference establishConnections helper)
-            while (cl.group_world() < world) {
-                bool pending = false;
-                cl.are_peers_pending(pending);
-                if (pending) cl.update_topology();
-                std::this_thread::sleep_for(std::chrono::milliseconds(10));
-            }
+            if (!wait_world(cl, world)) return;
 
             std::vector<float> x(count), y(count, 0.0f);
             for (size_t i = 0; i < count; ++i)
@@ -189,7 +209,9 @@ static void test_e2e(size_t world, proto::QuantAlgo quant) {
             desc.tag = 1;
             desc.op = proto::RedOp::kSum;
             desc.quant = quant;
-            desc.quant_dtype = proto::DType::kU8;
+            desc.quant_dtype = quant == proto::QuantAlgo::kZeroPointScale
+                                   ? proto::DType::kI8
+                                   : proto::DType::kU8;
             client::ReduceInfo info;
             auto st = cl.all_reduce(x.data(), y.data(), count, proto::DType::kF32, desc,
                                     &info);
@@ -241,6 +263,195 @@ static void test_e2e(size_t world, proto::QuantAlgo quant) {
     mm.join();
 }
 
+// half-precision e2e: f16/bf16 buffers sum exactly for small integers, so
+// bit-exact verification works without tolerances
+static void test_e2e_halfprec(size_t world, proto::DType dtype) {
+    uint16_t port = alloc_test_ports(512);
+    master::Master mm(port);
+    CHECK(mm.launch());
+    uint16_t base = static_cast<uint16_t>(port + 16);
+    port = mm.port();
+
+    const size_t count = 2053;
+    std::vector<std::thread> threads;
+    std::atomic<int> ok_count{0};
+    for (size_t r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+            client::Client cl(peer_cfg(port, base, r));
+            if (cl.connect() != client::Status::kOk) return;
+            if (!wait_world(cl, world)) return;
+            std::vector<uint16_t> x(count), y(count, 0);
+            for (size_t i = 0; i < count; ++i) {
+                float v = static_cast<float>(i % 97) + static_cast<float>(r);
+                x[i] = dtype == proto::DType::kF16 ? kernels::f32_to_f16(v)
+                                                   : kernels::f32_to_bf16(v);
+            }
+            client::ReduceDesc desc;
+            desc.tag = 1;
+            desc.op = proto::RedOp::kSum;
+            client::ReduceInfo info;
+            auto st = cl.all_reduce(x.data(), y.data(), count, dtype, desc, &info);
+            if (st != client::Status::kOk) {
+                fprintf(stderr, "half peer %zu: allreduce failed st=%d\n", r, int(st));
+                return;
+            }
+            bool correct = true;
+            for (size_t i = 0; i < count; ++i) {
+                float got = dtype == proto::DType::kF16 ? kernels::f16_to_f32(y[i])
+                                                        : kernels::bf16_to_f32(y[i]);
+                float expect = world * float(i % 97) + world * (world - 1) / 2.0f;
+                if (got != expect) { // exact: small integers survive half precision
+                    if (correct)
+                        fprintf(stderr, "half peer %zu: y[%zu]=%f expect %f\n", r, i,
+                                got, expect);
+                    correct = false;
+                }
+            }
+            if (correct) ok_count.fetch_add(1);
+            cl.disconnect();
+        });
+    }
+    for (auto &t : threads) t.join();
+    CHECK(ok_count.load() == static_cast<int>(world));
+    mm.interrupt();
+    mm.join();
+}
+
+// concurrent tags: several async reduces in flight per peer at once,
+// exercising the op worker pool and per-tag demux under contention
+static void test_e2e_concurrent_tags(size_t world, size_t ntags) {
+    uint16_t port = alloc_test_ports(512);
+    master::Master mm(port);
+    CHECK(mm.launch());
+    uint16_t base = static_cast<uint16_t>(port + 16);
+    port = mm.port();
+
+    const size_t count = 65537;
+    std::vector<std::thread> threads;
+    std::atomic<int> ok_count{0};
+    for (size_t r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+            client::Client cl(peer_cfg(port, base, r));
+            if (cl.connect() != client::Status::kOk) return;
+            if (!wait_world(cl, world)) return;
+            std::vector<std::vector<float>> xs(ntags), ys(ntags);
+            for (size_t t = 0; t < ntags; ++t) {
+                xs[t].resize(count);
+                ys[t].assign(count, 0.0f);
+                for (size_t i = 0; i < count; ++i)
+                    xs[t][i] = static_cast<float>((i + t) % 89) + static_cast<float>(r);
+            }
+            for (size_t t = 0; t < ntags; ++t) {
+                client::ReduceDesc desc;
+                desc.tag = 100 + t;
+                desc.op = proto::RedOp::kSum;
+                auto st = cl.all_reduce_async(xs[t].data(), ys[t].data(), count,
+                                              proto::DType::kF32, desc);
+                if (st != client::Status::kOk) {
+                    fprintf(stderr, "peer %zu tag %zu: launch failed st=%d\n", r, t,
+                            int(st));
+                    return;
+                }
+            }
+            bool correct = true;
+            for (size_t t = 0; t < ntags; ++t) {
+                client::ReduceInfo info;
+                auto st = cl.await_reduce(100 + t, &info);
+                if (st != client::Status::kOk) {
+                    fprintf(stderr, "peer %zu tag %zu: await failed st=%d\n", r, t,
+                            int(st));
+                    return;
+                }
+                for (size_t i = 0; i < count && correct; ++i) {
+                    double expect =
+                        world * double((i + t) % 89) + world * (world - 1) / 2.0;
+                    if (std::abs(double(ys[t][i]) - expect) > 1e-4) {
+                        fprintf(stderr, "peer %zu tag %zu: y[%zu]=%f expect %f\n", r, t,
+                                i, ys[t][i], expect);
+                        correct = false;
+                    }
+                }
+            }
+            if (correct) ok_count.fetch_add(1);
+            cl.disconnect();
+        });
+    }
+    for (auto &t : threads) t.join();
+    CHECK(ok_count.load() == static_cast<int>(world));
+    mm.interrupt();
+    mm.join();
+}
+
+// abort mid-ring: one peer launches the collective then abruptly disconnects;
+// the survivors must see a failed op, recover via update_topology, retry, and
+// get a correct world-2 result (reference: SIGKILL churn e2e, done in-process)
+static void test_e2e_abort_mid_ring() {
+    uint16_t port = alloc_test_ports(512);
+    master::Master mm(port);
+    CHECK(mm.launch());
+    uint16_t base = static_cast<uint16_t>(port + 16);
+    port = mm.port();
+
+    const size_t world = 3;
+    const size_t count = 4u << 20; // 16 MB fp32: long enough to abort mid-op
+    std::vector<std::thread> threads;
+    std::atomic<int> ok_count{0};
+    for (size_t r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+            client::Client cl(peer_cfg(port, base, r));
+            if (cl.connect() != client::Status::kOk) return;
+            if (!wait_world(cl, world)) return;
+            std::vector<float> x(count), y(count, 0.0f);
+            for (size_t i = 0; i < count; ++i)
+                x[i] = static_cast<float>(i % 97) + static_cast<float>(r);
+            client::ReduceDesc desc;
+            desc.tag = 5;
+            desc.op = proto::RedOp::kSum;
+
+            if (r == 2) {
+                // deserter: launch, let the ring get going, vanish without
+                // goodbye semantics beyond the TCP closes in disconnect()
+                (void)cl.all_reduce_async(x.data(), y.data(), count,
+                                          proto::DType::kF32, desc);
+                std::this_thread::sleep_for(std::chrono::milliseconds(15));
+                cl.disconnect();
+                ok_count.fetch_add(1);
+                return;
+            }
+            // survivors: retry until a reduce completes; verify against the
+            // world it actually ran over (the deserter may or may not have
+            // contributed depending on abort timing)
+            for (int attempt = 0; attempt < 50; ++attempt) {
+                client::ReduceInfo info;
+                auto st = cl.all_reduce(x.data(), y.data(), count,
+                                        proto::DType::kF32, desc, &info);
+                if (st == client::Status::kOk) {
+                    bool correct = true;
+                    uint32_t w = info.world;
+                    for (size_t i = 0; i < count && correct; ++i) {
+                        double expect = w * double(i % 97) + w * (w - 1) / 2.0;
+                        if (std::abs(double(y[i]) - expect) > 1e-4) {
+                            fprintf(stderr, "survivor %zu: y[%zu]=%f expect %f (w=%u)\n",
+                                    r, i, y[i], expect, w);
+                            correct = false;
+                        }
+                    }
+                    if (correct) ok_count.fetch_add(1);
+                    return;
+                }
+                // failed op: adopt the shrunken world and retry
+                cl.update_topology();
+                std::this_thread::sleep_for(std::chrono::milliseconds(20));
+            }
+            fprintf(stderr, "survivor %zu: never completed a reduce\n", r);
+        });
+    }
+    for (auto &t : threads) t.join();
+    CHECK(ok_count.load() == static_cast<int>(world));
+    mm.interrupt();
+    mm.join();
+}
+
 int main() {
     test_wire();
     test_hash();
@@ -267,6 +478,16 @@ int main() {
     printf("e2e world=4 fp32: %s\n", g_failures ? "FAIL" : "ok");
     test_e2e(3, proto::QuantAlgo::kMinMax);
     printf("e2e world=3 minmax-quantized: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e(3, proto::QuantAlgo::kZeroPointScale);
+    printf("e2e world=3 zps-quantized: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e_halfprec(2, proto::DType::kF16);
+    printf("e2e world=2 f16: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e_halfprec(2, proto::DType::kBF16);
+    printf("e2e world=2 bf16: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e_concurrent_tags(2, 4);
+    printf("e2e world=2 concurrent tags: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e_abort_mid_ring();
+    printf("e2e world=3 abort mid-ring: %s\n", g_failures ? "FAIL" : "ok");
     if (g_failures) {
         printf("SELFTEST FAILED (%d)\n", g_failures);
         return 1;

@@ -636,11 +636,21 @@ void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
                 ++it;
             }
         }
+        // remember the purge: stragglers for these tags arriving from now on
+        // are dropped on receipt (tag ranges are never reused)
+        retired_.emplace_back(lo, hi);
+        if (retired_.size() > 128) retired_.pop_front();
     }
     // ack dropped descriptors so the sender's pending handle completes —
     // the data is unwanted (op aborted), not undeliverable
     for (auto &d : dropped)
         if (auto c = d.ack_conn.lock()) c->send_ctl(MultiplexConn::kCmaAck, d.tag, d.off);
+}
+
+bool SinkTable::is_retired(uint64_t tag) const {
+    for (const auto &[lo, hi] : retired_)
+        if (tag >= lo && tag < hi) return true;
+    return false;
 }
 
 // ---------- MultiplexConn ----------
@@ -871,7 +881,10 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         std::lock_guard lk(table_->mu_);
         auto it = table_->sinks_.find(tag);
         if (it == table_->sinks_.end()) {
-            drop = false; // no sink at all: tell the sender to stream instead
+            // a purge may have landed between the caller's check and here:
+            // retired data is unwanted (ack-drop) — a NACK would trigger a
+            // pointless full streaming retransmit the receiver then discards
+            drop = table_->is_retired(tag);
         } else if (it->second.cancel) {
             drop = true; // op aborted locally: data unwanted, ack-drop
         } else if (d.off + d.len <= it->second.cap) {
@@ -1134,17 +1147,27 @@ void MultiplexConn::rx_loop() {
             d.len = wire::from_be(be_dlen);
             d.off = off;
             bool fill_now;
+            bool retired;
             {
                 std::lock_guard lk(table_->mu_);
+                retired = table_->is_retired(tag);
                 auto it = table_->sinks_.find(tag);
                 // consumer_pull sinks (and absent sinks) keep the descriptor
                 // pending: the consumer claims it via consume_cma and pulls
                 // fused with its reduction on its own thread
-                fill_now = it != table_->sinks_.end() && !it->second.consumer_pull;
-                if (!fill_now) table_->pending_descs_.emplace(tag, d);
+                fill_now = !retired && it != table_->sinks_.end() &&
+                           !it->second.consumer_pull;
+                if (!fill_now && !retired) table_->pending_descs_.emplace(tag, d);
             }
-            if (fill_now) do_cma_fill(tag, d);
-            else table_->ev_.signal(); // wake a consumer polling for the claim
+            if (retired) {
+                // straggler for a purged op: ack-drop NOW so the sender's
+                // handle completes — nobody is left to claim it later
+                send_ctl(kCmaAck, tag, d.off);
+            } else if (fill_now) {
+                do_cma_fill(tag, d);
+            } else {
+                table_->ev_.signal(); // wake a consumer polling for the claim
+            }
             continue;
         }
 
@@ -1204,13 +1227,14 @@ void MultiplexConn::rx_loop() {
                     off + n <= it->second.cap) {
                     memcpy(it->second.base + off, scratch.data(), n);
                     it->second.add_extent(off, off + n);
-                } else {
+                } else if (!table_->is_retired(tag)) {
                     // queued frames carry their offset in the first 8 bytes
                     std::vector<uint8_t> qf(8 + n);
                     memcpy(qf.data(), &off, 8);
                     if (n > 0) memcpy(qf.data() + 8, scratch.data(), n);
                     table_->queues_[tag].push_back(std::move(qf));
                 }
+                // retired tag: straggler from a purged op — drop the bytes
             }
             table_->ev_.signal();
         }

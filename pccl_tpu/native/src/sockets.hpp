@@ -268,12 +268,20 @@ private:
     template <typename PredFn> void wait_not_busy(std::unique_lock<std::mutex> &lk,
                                                   PredFn pred);
 
+    bool is_retired(uint64_t tag) const; // caller holds mu_
+
     std::mutex mu_;
     park::Event ev_;
     std::map<uint64_t, Sink> sinks_;
     std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_;
     std::multimap<uint64_t, PendingDesc> pending_descs_;
     std::vector<std::weak_ptr<MultiplexConn>> members_;
+    // recently purged tag ranges: data/descriptors that straggle in AFTER an
+    // op's end-of-life purge are dropped (and CMA descs ack-dropped) instead
+    // of queueing forever — otherwise the sender's handle never completes.
+    // Tag ranges are op-seq scoped and never reused, so a bounded memory of
+    // past purges is safe.
+    std::deque<std::pair<uint64_t, uint64_t>> retired_;
 };
 
 // --- MultiplexConn: tag-demuxed bulk data plane over one socket ---

@@ -30,8 +30,11 @@ def eight_devices():
 
 
 # single in-process port allocator: every test file draws disjoint ranges
-# from here instead of hand-picking bases that can silently collide
-_PORT_COUNTER = [49000]
+# from here instead of hand-picking bases that can silently collide.
+# The base sits BELOW the kernel ephemeral range (32768-60999): a listener
+# in that range can lose its port to any stray outbound socket while down
+# (e.g. the master-restart soak), making binds flaky under suite load.
+_PORT_COUNTER = [20000]
 
 
 def alloc_ports(span: int = 64) -> int:
