@@ -158,7 +158,31 @@ def _check(code: int, what: str = "") -> None:
     raise cls(r, what)
 
 
-# ---------------------------------------------------------------- master
+# ------------------------------------------------- registered shm buffers
+
+def shm_ndarray(shape, dtype=np.float32) -> np.ndarray:
+    """Allocate a numpy array in a REGISTERED shared-memory region
+    (pccltShmAlloc). Collectives whose payload lives in a registered region
+    take the same-host zero-copy path: local peers map the region and reduce
+    straight out of it, skipping even the one-copy CMA pull. Use for
+    communication-heavy staging tensors (DiLoCo outer-step flats, bench
+    buffers); ordinary arrays work with every op regardless.
+
+    The region is freed when the returned array (and all its views) are
+    garbage collected. pcclt extension — the reference (jundi69/pccl) always
+    streams payloads over TCP and has no registered-buffer concept.
+    """
+    import weakref
+
+    lib = _native.load()
+    shape = tuple(np.atleast_1d(np.asarray(shape, dtype=np.int64)).tolist()) \
+        if not isinstance(shape, (tuple, list)) else tuple(int(s) for s in shape)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    ptr = ctypes.c_void_p()
+    _check(lib.pccltShmAlloc(max(1, nbytes), ctypes.byref(ptr)), "shm alloc")
+    buf = (ctypes.c_uint8 * max(1, nbytes)).from_address(ptr.value)
+    weakref.finalize(buf, lib.pccltShmFree, ctypes.c_void_p(ptr.value))
+    return np.ndarray(shape, dtype=dtype, buffer=buf)
 
 class MasterNode:
     """Standalone orchestration master (reference: pccl.MasterNode /

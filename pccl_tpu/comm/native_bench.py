@@ -85,12 +85,14 @@ def _connect(rank: int, master_port: int, world: int, port_base: int):
 # ---------------------------------------------------------------- config 1
 
 def _peer_allreduce(rank, master_port, q, nbytes, iters):
-    from pccl_tpu.comm.api import ReduceOp
+    from pccl_tpu.comm.api import ReduceOp, shm_ndarray
 
     comm = _connect(rank, master_port, 2, 48700)
     count = nbytes // 4
-    x = np.full(count, float(rank + 1), dtype=np.float32)
-    y = np.empty_like(x)
+    # registered shm buffers: same-host peers map them and reduce zero-copy
+    x = shm_ndarray(count, np.float32)
+    x[:] = float(rank + 1)
+    y = shm_ndarray(count, np.float32)
     comm.all_reduce(x, y, op=ReduceOp.SUM)  # warmup
     times = []
     for _ in range(iters):
@@ -191,7 +193,8 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
 
     comm = _connect(rank, master_port, world, 48960)
     params = {"w": jnp.zeros((params_n,), jnp.float32)}
-    diloco = Diloco(comm, params, DilocoConfig())
+    # shm_staging: bench peers share this host, so the ring is zero-copy
+    diloco = Diloco(comm, params, DilocoConfig(shm_staging=True))
     # synthetic inner step: outer params minus a fake gradient update.
     # 2 warmup steps: the first outer steps pay one-time jit compiles of the
     # param-sized codec/apply graphs

@@ -180,6 +180,16 @@ PCCLT_EXPORT pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c,
 PCCLT_EXPORT uint64_t pccltHashBuffer(int hash_type, const void *data,
                                       uint64_t nbytes);
 
+/* Registered shared-memory buffers (pcclt extension; no reference
+ * counterpart — the reference always streams over TCP). Collective payloads
+ * living in a registered buffer take the same-host ZERO-copy path: peers on
+ * this host map the region and read it directly instead of pulling through
+ * the kernel. Allocate communication-heavy tensors (DiLoCo staging, bench
+ * buffers) here for maximum same-host bandwidth; any pointer works with the
+ * collectives either way. Free only when no collective is using the buffer. */
+PCCLT_EXPORT pccltResult_t pccltShmAlloc(uint64_t nbytes, void **out);
+PCCLT_EXPORT pccltResult_t pccltShmFree(void *ptr);
+
 #ifdef __cplusplus
 }
 #endif

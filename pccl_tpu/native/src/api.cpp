@@ -10,6 +10,7 @@
 #include "hash.hpp"
 #include "log.hpp"
 #include "master.hpp"
+#include "shm.hpp"
 
 using pcclt::client::Client;
 using pcclt::client::ClientConfig;
@@ -257,6 +258,19 @@ pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const 
 uint64_t pccltHashBuffer(int hash_type, const void *data, uint64_t nbytes) {
     auto t = hash_type == 1 ? pcclt::hash::Type::kCrc32 : pcclt::hash::Type::kSimple;
     return pcclt::hash::content_hash(t, data, nbytes);
+}
+
+pccltResult_t pccltShmAlloc(uint64_t nbytes, void **out) {
+    if (!out || nbytes == 0) return pccltInvalidArgument;
+    void *p = pcclt::shm::alloc(nbytes);
+    if (!p) return pccltInternalError;
+    *out = p;
+    return pccltSuccess;
+}
+
+pccltResult_t pccltShmFree(void *ptr) {
+    if (!ptr) return pccltInvalidArgument;
+    return pcclt::shm::free_buf(ptr) ? pccltSuccess : pccltInvalidArgument;
 }
 
 pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *state,

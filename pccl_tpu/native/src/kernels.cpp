@@ -224,6 +224,41 @@ void assign(proto::DType dt, void *dst, const void *src, size_t count) {
     memcpy(dst, src, count * proto::dtype_size(dt));
 }
 
+void copy_stream(void *dst, const void *src, size_t n) {
+#if defined(__SSE2__)
+    // NT stores skip the destination read-for-ownership: a cache-exceeding
+    // copy becomes 1-read-1-write instead of 2-read-1-write. Only worth it
+    // when the destination won't be re-read from cache (all-gather results,
+    // mapped-region fills).
+    if (n >= (256u << 10)) {
+        auto *d = static_cast<uint8_t *>(dst);
+        auto *s = static_cast<const uint8_t *>(src);
+        size_t head = (16 - (reinterpret_cast<uintptr_t>(d) & 15u)) & 15u;
+        if (head) {
+            memcpy(d, s, head);
+            d += head;
+            s += head;
+            n -= head;
+        }
+        size_t i = 0;
+        for (; i + 64 <= n; i += 64) {
+            __m128i a = _mm_loadu_si128(reinterpret_cast<const __m128i *>(s + i));
+            __m128i b = _mm_loadu_si128(reinterpret_cast<const __m128i *>(s + i + 16));
+            __m128i c = _mm_loadu_si128(reinterpret_cast<const __m128i *>(s + i + 32));
+            __m128i e = _mm_loadu_si128(reinterpret_cast<const __m128i *>(s + i + 48));
+            _mm_stream_si128(reinterpret_cast<__m128i *>(d + i), a);
+            _mm_stream_si128(reinterpret_cast<__m128i *>(d + i + 16), b);
+            _mm_stream_si128(reinterpret_cast<__m128i *>(d + i + 32), c);
+            _mm_stream_si128(reinterpret_cast<__m128i *>(d + i + 48), e);
+        }
+        _mm_sfence();
+        if (i < n) memcpy(d + i, s + i, n - i);
+        return;
+    }
+#endif
+    memcpy(dst, src, n);
+}
+
 namespace {
 
 template <typename T> void div_loop(T *dst, size_t n, uint64_t world) {

@@ -23,6 +23,12 @@ void accumulate3(proto::DType dt, proto::RedOp op, void *dst, const void *a,
 // dst[i] = src[i]
 void assign(proto::DType dt, void *dst, const void *src, size_t count);
 
+// Bulk copy with non-temporal stores on cache-exceeding sizes (the
+// destination is written once and consumed later, so skipping the
+// read-for-ownership saves a third of the bus traffic). Falls back to
+// memcpy below 256 KiB or without SSE2.
+void copy_stream(void *dst, const void *src, size_t n);
+
 // Avg finalization: dst[i] /= world (float dtypes; integer dtypes divide)
 void finalize_avg(proto::DType dt, void *dst, size_t count, uint64_t world);
 

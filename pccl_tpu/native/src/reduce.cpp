@@ -56,7 +56,7 @@ ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
 bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
                  const uint8_t *scratch,
                  const std::function<void(const uint8_t *src, size_t lo, size_t hi)> &on_data,
-                 Prof *prof = nullptr) {
+                 Prof *prof = nullptr, bool fill_if_unmapped = false) {
     using Claim = net::SinkTable::CmaClaim;
     size_t consumed = 0;
     while (consumed < target) {
@@ -70,7 +70,8 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
                     on_data(src, lo, lo + n);
                     consumed = lo + n;
                     return !(ctx.should_abort && ctx.should_abort());
-                });
+                },
+                fill_if_unmapped);
             if (prof) prof->compute_ms += ms_since(t0);
             if (c == Claim::kDone) break;
             if (c == Claim::kCancelled) return false;
@@ -226,12 +227,14 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             reg_sink(tag, scratch_at(seq), span.n_elems * qsz, true);
         } else {
             // zero-copy all-gather: the reduced chunk lands straight in the
-            // result buffer (NOT consumer_pull: the rx-thread fill into the
-            // result IS the single copy). Registering one stage early is
+            // result buffer. consumer_pull so the single copy runs on the OP
+            // thread (mapped-region memcpy, or — via fill_if_unmapped — a
+            // process_vm_readv pull into the sink), not on the RX thread
+            // with a park/wake per slice. Registering one stage early is
             // safe: the peer only sends this chunk after it has consumed
             // (and for CMA, pulled) everything we previously sent from this
             // region.
-            reg_sink(tag, out + span.start_elem * esz, span.n_elems * esz, false);
+            reg_sink(tag, out + span.start_elem * esz, span.n_elems * esz, true);
         }
     };
     reg_stage(0); // before ANY tx: inbound bytes always find a live sink
@@ -377,7 +380,14 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             // zero-copy sink was registered a stage ahead; open the next
             reg_stage(rs_stages + s + 1);
             bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz, recv_ptr,
-                                  [](const uint8_t *, size_t, size_t) {}, profp);
+                                  [&](const uint8_t *src, size_t lo, size_t hi) {
+                                      // mapped-region consume: the copy into
+                                      // the result IS the stage; TCP/pulled
+                                      // bytes already landed in the sink
+                                      if (src != recv_ptr + lo)
+                                          kernels::copy_stream(recv_ptr + lo, src,
+                                                               hi - lo);
+                                  }, profp, /*fill_if_unmapped=*/true);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());

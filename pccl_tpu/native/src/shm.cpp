@@ -1,0 +1,127 @@
+#include "shm.hpp"
+
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+#include "log.hpp"
+
+namespace pcclt::shm {
+
+namespace {
+
+struct Registry {
+    std::mutex mu;
+    std::map<uintptr_t, Region> live;           // by base address
+    uint64_t next_id = 1;
+    uint64_t retire_seq = 0;
+    uint64_t trimmed_seq = 0;                   // retires <= this were dropped
+    std::vector<std::pair<uint64_t, uint64_t>> retires; // (seq, base)
+};
+
+Registry &reg() {
+    static Registry r;
+    return r;
+}
+
+int memfd(size_t len) {
+    char name[64];
+    snprintf(name, sizeof name, "pcclt-shm-%d", static_cast<int>(getpid()));
+    int fd = static_cast<int>(syscall(SYS_memfd_create, name, 0u));
+    if (fd < 0) return -1;
+    if (ftruncate(fd, static_cast<off_t>(len)) != 0) {
+        ::close(fd);
+        return -1;
+    }
+    return fd;
+}
+
+} // namespace
+
+void *alloc(size_t len) {
+    if (len == 0) return nullptr;
+    int fd = memfd(len);
+    if (fd < 0) {
+        PLOG(kWarn) << "shm: memfd_create failed (errno " << errno << ")";
+        return nullptr;
+    }
+    void *p = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (p == MAP_FAILED) {
+        ::close(fd);
+        PLOG(kWarn) << "shm: mmap failed (errno " << errno << ")";
+        return nullptr;
+    }
+    madvise(p, len, MADV_HUGEPAGE); // advisory; fewer TLB misses on big pulls
+    auto &r = reg();
+    std::lock_guard lk(r.mu);
+    Region region;
+    region.id = r.next_id++;
+    region.fd = fd;
+    region.base = static_cast<uint8_t *>(p);
+    region.len = len;
+    r.live.emplace(reinterpret_cast<uintptr_t>(p), region);
+    return p;
+}
+
+bool free_buf(void *p) {
+    auto &r = reg();
+    Region region;
+    {
+        std::lock_guard lk(r.mu);
+        auto it = r.live.find(reinterpret_cast<uintptr_t>(p));
+        if (it == r.live.end()) return false;
+        region = it->second;
+        r.live.erase(it);
+        r.retires.emplace_back(++r.retire_seq, reinterpret_cast<uint64_t>(p));
+        if (r.retires.size() > 4096) {
+            // compact: conns whose cursor is behind the trim point get a
+            // reset feed (retire-everything) instead of silently missing
+            // the dropped entries
+            r.trimmed_seq = r.retires.front().first;
+            r.retires.erase(r.retires.begin());
+        }
+    }
+    // release the pages but burn the virtual range: a peer that has not yet
+    // drained the retire can never resolve a future buffer at this address
+    mmap(region.base, region.len, PROT_NONE,
+         MAP_FIXED | MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+    ::close(region.fd);
+    return true;
+}
+
+std::optional<Region> find(const void *p, size_t len) {
+    auto &r = reg();
+    std::lock_guard lk(r.mu);
+    auto addr = reinterpret_cast<uintptr_t>(p);
+    auto it = r.live.upper_bound(addr);
+    if (it == r.live.begin()) return std::nullopt;
+    --it;
+    const Region &region = it->second;
+    if (addr >= it->first && addr + len <= it->first + region.len) return region;
+    return std::nullopt;
+}
+
+RetireFeed drain_retires(uint64_t *cursor) {
+    auto &r = reg();
+    std::lock_guard lk(r.mu);
+    RetireFeed out;
+    out.reset = *cursor < r.trimmed_seq;
+    if (!out.reset)
+        for (const auto &[seq, base] : r.retires)
+            if (seq > *cursor) out.bases.push_back(base);
+    *cursor = r.retire_seq;
+    return out;
+}
+
+size_t live_regions() {
+    auto &r = reg();
+    std::lock_guard lk(r.mu);
+    return r.live.size();
+}
+
+} // namespace pcclt::shm

@@ -1,0 +1,76 @@
+// Registered shared-memory regions: the same-host ZERO-copy transport.
+//
+// pcclt's CMA fast path (sockets.hpp) moves same-host payloads with ONE
+// kernel copy (process_vm_readv). Buffers allocated through this registry go
+// further: they live in memfd-backed shared memory, the owning process
+// announces {pid, fd, base, len} to each same-host peer connection, and the
+// peer maps the region via /proc/<pid>/fd/<fd> — the SAME ptrace-permission
+// model process_vm_readv already requires. From then on any CMA descriptor
+// whose span lies inside a registered region resolves to a direct local
+// pointer on the receiver: ring reduce-scatter accumulates straight out of
+// the sender's buffer (no copy at all), and all-gather fills are a plain
+// memcpy instead of a syscall pull.
+//
+// This is the registered-buffer concept of NCCL (ncclCommRegister) and
+// MPI-3 RMA windows, redesigned for pcclt's descriptor/ack protocol. The
+// reference (jundi69/pccl) has no same-host fast path at all — its
+// MultiplexedIOSocket always streams over TCP (reference
+// tinysockets/src/multiplexed_socket.cpp) — so this subsystem is a
+// pcclt-specific performance layer, not a port.
+//
+// Lifecycle rules:
+//  - alloc() creates + registers a region (memfd, MAP_SHARED).
+//  - free_buf() retires it: the registry bumps a retire sequence that every
+//    conn's TX thread drains into kShmRetire frames BEFORE its next data
+//    send, so peers unmap before the address range can be reused by a
+//    later allocation. The memory itself is unmapped immediately.
+//  - a SIGKILL'd owner leaks nothing persistent: memfds die with the
+//    process (peer mappings stay readable until they unmap — exactly what
+//    an in-flight consumer needs to fail soft).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <optional>
+#include <vector>
+
+namespace pcclt::shm {
+
+struct Region {
+    uint64_t id = 0;   // process-unique, never reused
+    int fd = -1;       // memfd (owner process)
+    uint8_t *base = nullptr;
+    size_t len = 0;
+};
+
+// Allocate `len` bytes of registered shared memory (nullptr on failure).
+void *alloc(size_t len);
+
+// Retire a registered region by base pointer. Returns false if `p` is not
+// a live registered base. The pages are released immediately, but the
+// virtual range stays reserved PROT_NONE forever — a later allocation can
+// never occupy an address a peer might still resolve through a stale
+// mapping, so a straggling descriptor can fault soft but never read the
+// wrong buffer. (Virtual-only cost; 64-bit address space is not scarce.)
+bool free_buf(void *p);
+
+// Region containing [p, p+len), if any.
+std::optional<Region> find(const void *p, size_t len);
+
+// Retire feed for conn TX threads: all retires with seq > *cursor, oldest
+// first; advances *cursor. Each entry is the retired region's base address
+// in THIS process (the peer resolves it against its announce records).
+// `reset` is set when the feed was compacted past the caller's cursor
+// (a conn that lagged thousands of frees behind): the caller must then
+// retire EVERYTHING it has announced on its conn — losing individual
+// entries can never silently leak a peer mapping.
+struct RetireFeed {
+    bool reset = false;
+    std::vector<uint64_t> bases;
+};
+RetireFeed drain_retires(uint64_t *cursor);
+
+// Number of live registered regions (tests / introspection).
+size_t live_regions();
+
+} // namespace pcclt::shm

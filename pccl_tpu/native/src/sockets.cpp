@@ -7,6 +7,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -15,6 +16,8 @@
 #include <random>
 
 #include "log.hpp"
+#include "kernels.hpp"
+#include "shm.hpp"
 #include "wire.hpp"
 
 namespace pcclt::net {
@@ -673,16 +676,13 @@ bool cma_enabled_env() {
 constexpr size_t kRxSlice = 256 << 10;  // TCP sink write slice (cancel latency)
 constexpr uint32_t kMaxDataFrame = 272u << 20;
 
-// CMA read slice: cancel latency + streaming-consumer overlap granularity.
-// On a single-core host, per-slice publishing only causes context-switch
-// ping-pong between the puller and the consumer — pull in one shot there;
-// with real parallelism, 8 MiB slices let the reduction overlap the pull.
+// process_vm_readv slice. Measured on the target host class, the kernel's
+// pin-and-copy path peaks at small-to-mid slices (64K–512K ≈ 4.4 GB/s) and
+// collapses on multi-MB iovecs without huge pages (8M ≈ 0.8 GB/s), so a
+// mid-size default wins on both THP and non-THP buffers. Also bounds cancel
+// latency and gives streaming consumers their overlap granularity.
 size_t cma_slice() {
-    static const size_t v = [] {
-        long cores = sysconf(_SC_NPROCESSORS_ONLN);
-        return env_size("PCCLT_CMA_SLICE_BYTES",
-                        cores > 1 ? (8 << 20) : (256u << 20));
-    }();
+    static const size_t v = env_size("PCCLT_CMA_SLICE_BYTES", 512 << 10);
     return v;
 }
 
@@ -695,20 +695,32 @@ MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
     cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
 }
 
-MultiplexConn::~MultiplexConn() { close(); }
+MultiplexConn::~MultiplexConn() {
+    close();
+    // safe now: no thread can hold a shared_ptr to us (we are being
+    // destroyed), so no shm_resolve pointer can still be in use
+    std::lock_guard lk(shm_mu_);
+    for (auto &[base, m] : shm_maps_)
+        if (m.local) munmap(m.local, m.len);
+    shm_maps_.clear();
+    for (auto &m : shm_zombies_)
+        if (m.local) munmap(m.local, m.len);
+    shm_zombies_.clear();
+}
 
 void MultiplexConn::run() {
     alive_ = true;
     cma_ok_ = cma_enabled_env() && sock_.peer_is_loopback();
     sock_.set_quickack();
     table_->attach(shared_from_this());
-    rx_thread_ = std::thread([this] { rx_loop(); });
-    tx_thread_ = std::thread([this] { tx_loop(); });
     if (cma_ok_.load()) {
         // announce CMA identity: pid + address of a random in-process token.
         // The receiver probe-reads the token before every pull, proving the
         // pid resolves to this process in ITS pid namespace (raw pids are
-        // not namespace-safe and can be reused across restarts).
+        // not namespace-safe and can be reused across restarts). Written
+        // synchronously BEFORE any other traffic can start: descriptors are
+        // posted inline by op threads, and the identity gate on the peer
+        // drops announces that precede the hello.
         cma_token_ = std::make_unique<std::array<uint8_t, 16>>();
         std::random_device rd;
         for (auto &b : *cma_token_) b = static_cast<uint8_t>(rd());
@@ -716,12 +728,10 @@ void MultiplexConn::run() {
         w.u32(static_cast<uint32_t>(getpid()));
         w.u64(reinterpret_cast<uint64_t>(cma_token_->data()));
         w.raw(cma_token_->data(), 16);
-        auto *req = new SendReq;
-        req->kind = kCmaHello;
-        req->owned = w.take();
-        req->span = req->owned;
-        enqueue(req);
+        write_frame(kCmaHello, 0, 0, w.data());
     }
+    rx_thread_ = std::thread([this] { rx_loop(); });
+    tx_thread_ = std::thread([this] { tx_loop(); });
 }
 
 void MultiplexConn::enqueue(SendReq *req) {
@@ -743,6 +753,12 @@ SendHandle MultiplexConn::send_async(uint64_t tag, uint64_t off,
     st->tag = tag;
     st->off = off;
     st->span = payload;
+    if (allow_cma && cma_ok_.load() && payload.size() >= cma_min_ && alive_.load()) {
+        // same-host: post the descriptor inline on THIS thread — the TX
+        // thread (and its wakeup latency) never enters the data path
+        cma_post_desc(tag, off, payload, st);
+        return st;
+    }
     auto *req = new SendReq;
     req->kind = kData;
     req->tag = tag;
@@ -757,6 +773,12 @@ SendHandle MultiplexConn::send_async(uint64_t tag, uint64_t off,
 SendHandle MultiplexConn::send_copy(uint64_t tag, std::vector<uint8_t> payload) {
     auto st = std::make_shared<SendState>();
     st->tag = tag;
+    if (payload.size() <= (64u << 10) && alive_.load()) {
+        // small owned frame (quant metadata, control blobs): write inline —
+        // cheaper than a TX-thread wakeup, and the write completes the send
+        st->complete(write_frame(kData, tag, 0, payload));
+        return st;
+    }
     auto *req = new SendReq;
     req->kind = kData;
     req->tag = tag;
@@ -774,11 +796,10 @@ bool MultiplexConn::send_bytes(uint64_t tag, std::span<const uint8_t> data,
 }
 
 void MultiplexConn::send_ctl(Kind kind, uint64_t tag, uint64_t off) {
-    auto *req = new SendReq;
-    req->kind = kind;
-    req->tag = tag;
-    req->off = off;
-    enqueue(req); // fire-and-forget: no state
+    // inline fire-and-forget: a 21-byte frame under wr_mu_ — cheaper than a
+    // TX-thread wakeup, and ack latency is the peer's stage-join latency.
+    // Failure is ignored: the conn is dying and rx/close fail the pendings.
+    write_frame(kind, tag, off, {});
 }
 
 bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
@@ -791,7 +812,34 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
     hdr[4] = static_cast<uint8_t>(kind);
     memcpy(hdr + 5, &be_tag, 8);
     memcpy(hdr + 13, &be_off, 8);
+    std::lock_guard lk(wr_mu_);
     return sock_.send_all2(hdr, 21, payload.data(), payload.size());
+}
+
+// Post a CMA descriptor for `span` inline on the calling thread: register
+// the pending ack, sync shm announce frames, write the descriptor. The TX
+// thread is not involved — on the same-host path this removes two thread
+// wakeups per ring stage. Completes `st` with failure on socket error.
+bool MultiplexConn::cma_post_desc(uint64_t tag, uint64_t off,
+                                  std::span<const uint8_t> span, const SendHandle &st) {
+    {
+        std::lock_guard lk(cma_mu_);
+        pending_cma_[{tag, off}] = st;
+    }
+    wire::Writer w;
+    w.u32(static_cast<uint32_t>(getpid()));
+    w.u64(reinterpret_cast<uint64_t>(span.data()));
+    w.u64(span.size());
+    bool ok = shm_sync_tx(span) && write_frame(kCmaDesc, tag, off, w.data());
+    if (!ok) {
+        bool mine;
+        {
+            std::lock_guard lk(cma_mu_);
+            mine = pending_cma_.erase({tag, off}) > 0;
+        }
+        if (mine) st->complete(false); // else rx/close already failed it
+    }
+    return ok;
 }
 
 bool MultiplexConn::stream_payload(const SendReq &req) {
@@ -821,23 +869,10 @@ void MultiplexConn::tx_loop() {
         switch (req->kind) {
         case kData:
             if (req->allow_cma && cma_ok_.load() && req->span.size() >= cma_min_) {
-                // same-host fast path: ship a descriptor; the receiver pulls
-                // the payload via process_vm_readv and acks. Completion is
-                // deferred to the ack (rx_loop).
-                {
-                    std::lock_guard lk(cma_mu_);
-                    pending_cma_[{req->tag, req->off}] = req->state;
-                }
-                wire::Writer w;
-                w.u32(static_cast<uint32_t>(getpid()));
-                w.u64(reinterpret_cast<uint64_t>(req->span.data()));
-                w.u64(req->span.size());
-                sock_ok = write_frame(kCmaDesc, req->tag, req->off, w.data());
-                if (!sock_ok) {
-                    std::lock_guard lk(cma_mu_);
-                    pending_cma_.erase({req->tag, req->off});
-                    req->state->complete(false);
-                }
+                // same-host fast path (queued variant; the common route is
+                // the inline post in send_async). Completion is deferred to
+                // the receiver's ack (rx_loop).
+                sock_ok = cma_post_desc(req->tag, req->off, req->span, req->state);
             } else {
                 sock_ok = stream_payload(*req);
                 if (req->state) req->state->complete(sock_ok);
@@ -851,7 +886,9 @@ void MultiplexConn::tx_loop() {
             sock_ok = write_frame(kCmaHello, 0, 0, req->span);
             break;
         case kCmaDesc:
-            break; // never enqueued directly
+        case kShmAnnounce:
+        case kShmRetire:
+            break; // never enqueued directly (shm frames go via shm_sync_tx)
         }
         delete req;
         if (!sock_ok) break;
@@ -874,6 +911,52 @@ void MultiplexConn::tx_loop() {
     table_->on_conn_dead();
 }
 
+bool MultiplexConn::shm_sync_tx(std::span<const uint8_t> span) {
+    // serializes announce bookkeeping across inline writers + the TX thread;
+    // held across the frame writes so a racing writer cannot see "announced"
+    // and ship a descriptor before the announce actually hit the wire
+    // (lock order: shm_tx_mu_ -> wr_mu_, nowhere reversed)
+    std::lock_guard lk(shm_tx_mu_);
+    // retires first: they must reach the peer before the address range can
+    // be re-announced (alloc never reuses a retired range, but the peer's
+    // resolution map must not keep stale entries alive indefinitely)
+    auto feed = shm::drain_retires(&shm_retire_cursor_);
+    if (feed.reset) {
+        // the registry compacted past our cursor: retire everything we have
+        // announced (live regions re-announce on next use)
+        for (const auto &[base, len] : shm_announced_)
+            if (!write_frame(kShmRetire, 0, base, {})) return false;
+        shm_announced_.clear();
+    }
+    for (uint64_t base : feed.bases) {
+        shm_announced_.erase(base);
+        if (!write_frame(kShmRetire, 0, base, {})) return false;
+    }
+    auto r = shm::find(span.data(), span.size());
+    if (!r) return true;
+    auto base = reinterpret_cast<uint64_t>(r->base);
+    auto it = shm_announced_.find(base);
+    if (it != shm_announced_.end() && it->second == r->len) return true;
+    wire::Writer w;
+    w.u32(static_cast<uint32_t>(getpid()));
+    w.u32(static_cast<uint32_t>(r->fd));
+    w.u64(base);
+    w.u64(r->len);
+    if (!write_frame(kShmAnnounce, 0, 0, w.data())) return false;
+    shm_announced_[base] = r->len;
+    return true;
+}
+
+const uint8_t *MultiplexConn::shm_resolve(uint64_t addr, uint64_t len) {
+    std::lock_guard lk(shm_mu_);
+    auto it = shm_maps_.upper_bound(addr);
+    if (it == shm_maps_.begin()) return nullptr;
+    --it;
+    if (addr >= it->first && addr + len <= it->first + it->second.len)
+        return it->second.local + (addr - it->first);
+    return nullptr;
+}
+
 void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
     uint8_t *dst = nullptr;
     bool drop = false;
@@ -894,6 +977,33 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
     }
     if (!dst) {
         send_ctl(drop ? kCmaAck : kCmaNack, tag, d.off);
+        return;
+    }
+    if (const uint8_t *mapped = shm_resolve(d.addr, d.len)) {
+        // registered-region fast path: the peer's bytes are already mapped
+        // here — fill is a plain memcpy (identity was gated at announce)
+        bool cancelled = false;
+        size_t off = 0;
+        while (off < d.len && !cancelled) {
+            size_t want = std::min<size_t>(2u << 20, d.len - off);
+            kernels::copy_stream(dst + off, mapped + off, want);
+            std::lock_guard lk(table_->mu_);
+            auto it = table_->sinks_.find(tag);
+            if (it == table_->sinks_.end() || it->second.cancel) {
+                cancelled = true;
+            } else {
+                it->second.add_extent(d.off + off, d.off + off + want);
+                off += want;
+            }
+            table_->ev_.signal();
+        }
+        {
+            std::lock_guard lk(table_->mu_);
+            auto it = table_->sinks_.find(tag);
+            if (it != table_->sinks_.end()) --it->second.busy;
+        }
+        table_->ev_.signal();
+        send_ctl(kCmaAck, tag, d.off);
         return;
     }
     if (!cma_verify_peer(d)) {
@@ -976,6 +1086,26 @@ bool MultiplexConn::cma_verify_peer(const SinkTable::PendingDesc &d) {
 SinkTable::CmaClaim MultiplexConn::consumer_cma_pull(
     uint64_t tag, const SinkTable::PendingDesc &d, size_t slice_align,
     const std::function<bool(const uint8_t *, size_t, size_t)> &consume) {
+    if (const uint8_t *mapped = shm_resolve(d.addr, d.len)) {
+        // registered-region fast path: feed the consumer straight out of the
+        // sender's mapped buffer — no bounce, no kernel copy. The reduction
+        // IS the only pass over the bytes. Identity was gated at announce.
+        static const size_t dslice = env_size("PCCLT_SHM_SLICE_BYTES", 2u << 20);
+        size_t slice = dslice;
+        if (slice_align > 1) slice -= slice % slice_align;
+        if (slice == 0) slice = slice_align;
+        size_t off = 0;
+        while (off < d.len) {
+            size_t want = std::min(slice, d.len - off);
+            if (!consume(mapped + off, d.off + off, want)) {
+                send_ctl(kCmaAck, tag, d.off); // ack-drop: op aborted locally
+                return SinkTable::CmaClaim::kCancelled;
+            }
+            off += want;
+        }
+        send_ctl(kCmaAck, tag, d.off);
+        return SinkTable::CmaClaim::kDone;
+    }
     if (!cma_verify_peer(d)) {
         send_ctl(kCmaNack, tag, d.off);
         PLOG(kWarn) << "CMA identity probe failed for pid " << d.pid
@@ -984,7 +1114,9 @@ SinkTable::CmaClaim MultiplexConn::consumer_cma_pull(
     }
     // cache-sized bounce: each slice is pulled and immediately fed to the
     // reduction while still cache-hot — no scratch round-trip through DRAM
-    static const size_t bounce_bytes = env_size("PCCLT_CMA_BOUNCE_BYTES", 256u << 10);
+    // 128K: measured sweet spot for the kernel's pin-and-copy path on 4K
+    // pages, and comfortably L2-resident for the fused consumer
+    static const size_t bounce_bytes = env_size("PCCLT_CMA_BOUNCE_BYTES", 128u << 10);
     size_t slice = bounce_bytes;
     if (slice_align > 1) slice -= slice % slice_align;
     if (slice == 0) slice = slice_align;
@@ -1033,7 +1165,8 @@ void SinkTable::fill_pending(uint64_t tag) {
 
 SinkTable::CmaClaim SinkTable::consume_cma(
     uint64_t tag, size_t len, size_t slice_align,
-    const std::function<bool(const uint8_t *, size_t, size_t)> &consume) {
+    const std::function<bool(const uint8_t *, size_t, size_t)> &consume,
+    bool fill_if_unmapped) {
     PendingDesc d;
     std::shared_ptr<MultiplexConn> conn;
     bool mismatch = false;
@@ -1047,10 +1180,11 @@ SinkTable::CmaClaim SinkTable::consume_cma(
         mismatch = d.off != 0 || d.len != len;
     }
     if (!conn) return CmaClaim::kNone; // conn died; nothing to ack
-    if (mismatch) {
-        // unexpected shape (striped/partial): fill the registered sink the
-        // ordinary way — this one and any other stripes queued behind it —
-        // and let the caller's wait_filled path consume them
+    if (mismatch || (fill_if_unmapped && !conn->shm_resolve(d.addr, d.len))) {
+        // unexpected shape (striped/partial), or a copy-consumer whose
+        // descriptor is not zero-copy reachable: fill the registered sink
+        // the ordinary way — this one and any other stripes queued behind
+        // it — and let the caller's wait_filled path consume them
         conn->do_cma_fill(tag, d);
         fill_pending(tag);
         return CmaClaim::kNone;
@@ -1124,6 +1258,63 @@ void MultiplexConn::rx_loop() {
             cma_peer_token_addr_ = wire::from_be(be_addr);
             memcpy(cma_peer_token_.data(), buf + 12, 16);
             cma_peer_valid_ = true;
+            continue;
+        }
+
+        if (kind == kShmAnnounce) {
+            if (n != 24) {
+                PLOG(kError) << "multiplex rx: bad shm announce";
+                break;
+            }
+            uint8_t buf[24];
+            if (!sock_.recv_all(buf, 24)) break;
+            uint32_t be_pid, be_fd;
+            uint64_t be_base, be_rlen;
+            memcpy(&be_pid, buf, 4);
+            memcpy(&be_fd, buf + 4, 4);
+            memcpy(&be_base, buf + 8, 8);
+            memcpy(&be_rlen, buf + 16, 8);
+            uint32_t pid = wire::from_be(be_pid);
+            uint64_t base = wire::from_be(be_base);
+            uint64_t rlen = wire::from_be(be_rlen);
+            // identity gate: only map regions of the verified hello peer
+            // (same trust model as every process_vm_readv pull)
+            bool pid_ok;
+            {
+                std::lock_guard lk(cma_mu_);
+                pid_ok = cma_peer_valid_ && cma_peer_pid_ == pid;
+            }
+            if (pid_ok && rlen > 0 && rlen <= (64ull << 30)) {
+                char path[64];
+                snprintf(path, sizeof path, "/proc/%u/fd/%u", pid,
+                         wire::from_be(be_fd));
+                int fd = open(path, O_RDONLY);
+                if (fd >= 0) {
+                    void *m = mmap(nullptr, rlen, PROT_READ, MAP_SHARED, fd, 0);
+                    ::close(fd);
+                    if (m != MAP_FAILED) {
+                        std::lock_guard lk(shm_mu_);
+                        auto [it, fresh] = shm_maps_.try_emplace(base);
+                        if (!fresh && it->second.local)
+                            shm_zombies_.push_back(it->second); // reader-safe
+                        it->second = {rlen, static_cast<uint8_t *>(m)};
+                    }
+                }
+                // open/mmap failure is soft: descriptors in the region fall
+                // back to the process_vm_readv pull path
+            }
+            continue;
+        }
+
+        if (kind == kShmRetire) {
+            std::lock_guard lk(shm_mu_);
+            auto it = shm_maps_.find(off); // retire carries base in `off`
+            if (it != shm_maps_.end()) {
+                // no munmap here: an op thread may hold a shm_resolve
+                // pointer mid-copy — zombie until the destructor
+                shm_zombies_.push_back(it->second);
+                shm_maps_.erase(it);
+            }
             continue;
         }
 
@@ -1279,6 +1470,10 @@ void MultiplexConn::close() {
     fail_all_pending();
     sock_.close();
     table_->on_conn_dead();
+    // mappings stay alive (see ShmMap comment): an op thread that resolved
+    // a pointer before close() may still be mid-copy. ~MultiplexConn —
+    // which cannot run until every such thread drops its shared_ptr —
+    // does the actual munmaps.
     closed_ = true;
 }
 

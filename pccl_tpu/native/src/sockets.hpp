@@ -226,9 +226,15 @@ public:
     //   kDone      — fully pulled + consumed; sender acked
     //   kCancelled — consume returned false (op abort); sender acked-dropped
     //   kFailed    — identity/read failure; sender falls back to TCP
+    // fill_if_unmapped: when the descriptor does NOT resolve to a mapped
+    // registered region, pull it into the sink on the CALLING thread (one
+    // copy, no RX-thread handoff) instead of bouncing it through the
+    // consumer — callers whose consume is a plain copy into the sink use
+    // this to avoid a double copy on the process_vm_readv path.
     enum class CmaClaim { kNone, kDone, kCancelled, kFailed };
     CmaClaim consume_cma(uint64_t tag, size_t len, size_t slice_align,
-                         const std::function<bool(const uint8_t *, size_t, size_t)> &consume);
+                         const std::function<bool(const uint8_t *, size_t, size_t)> &consume,
+                         bool fill_if_unmapped = false);
 
     // Route any pending descriptors for `tag` through the ordinary sink fill
     // (rx-thread style, on the calling thread). Used when fused consumption
@@ -322,6 +328,12 @@ private:
         kCmaAck = 2,
         kCmaNack = 3,
         kCmaHello = 4, // {pid, token_addr, 16-byte token}: CMA identity proof
+        // registered shm regions (shm.hpp): zero-copy same-host transport.
+        // Announce {pid, fd, base, len} lets the peer map the region via
+        // /proc/<pid>/fd/<fd>; afterwards CMA descriptors inside [base,len)
+        // resolve to direct local pointers. Retire {base} unmaps peer-side.
+        kShmAnnounce = 5,
+        kShmRetire = 6,
     };
 
     struct SendReq : mpsc::Node {
@@ -336,6 +348,10 @@ private:
     void rx_loop();
     void tx_loop();
     void enqueue(SendReq *req);
+    // All frame writes serialize on wr_mu_ so small control frames (CMA
+    // descriptors, acks, shm announces) can be written INLINE from the
+    // calling thread — on the same-host path the TX thread never enters the
+    // critical path at all (no wakeup/context-switch per stage).
     bool write_frame(Kind kind, uint64_t tag, uint64_t off,
                      std::span<const uint8_t> payload);
     bool stream_payload(const SendReq &req); // TCP frames of ≤ chunk bytes
@@ -351,6 +367,16 @@ private:
         const std::function<bool(const uint8_t *, size_t, size_t)> &consume);
     void send_ctl(Kind kind, uint64_t tag, uint64_t off); // ack/nack via TX queue
     void fail_all_pending();
+    // Emit pending kShmRetire frames, then announce the region containing
+    // `span` if it is registered and not yet announced on this conn.
+    // Thread-safe (shm_tx_mu_). Returns false on socket failure.
+    bool shm_sync_tx(std::span<const uint8_t> span);
+    // inline same-host descriptor post (no TX-thread hop); see sockets.cpp
+    bool cma_post_desc(uint64_t tag, uint64_t off, std::span<const uint8_t> span,
+                       const SendHandle &st);
+    // Resolve a peer address range against mapped announce records (null if
+    // not covered). Safe from any thread.
+    const uint8_t *shm_resolve(uint64_t addr, uint64_t len);
 
     Socket sock_;
     std::shared_ptr<SinkTable> table_;
@@ -362,6 +388,7 @@ private:
 
     mpsc::Queue txq_;
     park::Event tx_ev_;
+    std::mutex wr_mu_; // serializes write_frame across tx thread + inline writers
 
     std::atomic<bool> cma_ok_{false}; // same-host CMA negotiated & not failed
     std::mutex cma_mu_;
@@ -377,6 +404,27 @@ private:
     uint32_t cma_peer_pid_ = 0;
     uint64_t cma_peer_token_addr_ = 0;
     std::array<uint8_t, 16> cma_peer_token_{};
+
+    // registered-shm transport state (shm.hpp).
+    // TX side (guarded by shm_tx_mu_): regions already announced on this
+    // conn and the retire-feed cursor.
+    std::mutex shm_tx_mu_;
+    std::map<uint64_t, uint64_t> shm_announced_; // base -> len
+    uint64_t shm_retire_cursor_ = 0;
+    // RX side (guarded by shm_mu_): peer base addr -> {len, local mapping}.
+    // Mappings are NEVER munmapped while the conn is alive — shm_resolve
+    // hands out raw pointers that op threads read lock-free, so a retire or
+    // close only moves the entry to shm_zombies_; the actual munmap happens
+    // in the destructor, when no thread can still hold a shared_ptr to us
+    // mid-read. (A straggling reader on a retired region reads stale bytes
+    // from pages the memfd keeps alive — never a SIGSEGV.)
+    struct ShmMap {
+        uint64_t len = 0;
+        uint8_t *local = nullptr;
+    };
+    std::mutex shm_mu_;
+    std::map<uint64_t, ShmMap> shm_maps_;
+    std::vector<ShmMap> shm_zombies_;
 
     size_t tx_chunk_;
     size_t cma_min_;

@@ -61,6 +61,11 @@ class DilocoConfig:
     quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE
     quantized_dtype: DataType = DataType.UINT8
     max_retries: int = 16
+    # Stage the pseudo-gradient in a REGISTERED shm buffer (comm.shm_ndarray)
+    # so same-host peers take the zero-copy collective path. Costs one extra
+    # params-sized copy per outer step, so enable it when peers share hosts
+    # (workers per TPU host, bench loops); leave off for pure-WAN rings.
+    shm_staging: bool = False
 
 
 from .codec import build_codec
@@ -91,6 +96,7 @@ class Diloco:
         self.cfg = cfg
         self.step = 0
         self._delta_fn, self._flat_fn, self._unflat_fn, self.count = build_codec(params)
+        self._shm_stage = None  # lazy registered staging buffer (cfg.shm_staging)
         # leaf shardings of the template, reapplied after every unflatten so
         # outer params keep the caller's TP/DP layout
         self._shardings = codec.leaf_shardings(params)
@@ -142,7 +148,18 @@ class Diloco:
         # np.asarray: device_get already yields a host ndarray — a second
         # np.array copy would cost another params-sized memcpy per outer step
         host = np.asarray(jax.device_get(delta), dtype=np.float32)
-        if not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
+        # quantized rings send from quantize scratch, not from the staged
+        # buffer — shm staging would be a pure extra copy there, so gate it
+        use_shm = (self.cfg.shm_staging and self.comm is not None
+                   and self.cfg.quantization == QuantizationAlgorithm.NONE)
+        if use_shm:
+            if self._shm_stage is None:
+                from pccl_tpu.comm.api import shm_ndarray
+
+                self._shm_stage = shm_ndarray(self.count, np.float32)
+            np.copyto(self._shm_stage, host)
+            host = self._shm_stage  # same-host peers reduce zero-copy
+        elif not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
             host = np.array(host, dtype=np.float32)  # ring reduces in place
         if self.comm is not None:
             self._reduce_host(host)

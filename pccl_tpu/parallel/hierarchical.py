@@ -76,11 +76,16 @@ class HierarchicalAllReduce:
     def __init__(self, comm: Optional[Communicator], template: Any, *,
                  quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
                  quantized_dtype: DataType = DataType.UINT8,
-                 max_retries: int = 16):
+                 max_retries: int = 16, shm_staging: bool = False):
         self.comm = comm
         self.quantization = quantization
         self.quantized_dtype = quantized_dtype
         self.max_retries = max_retries
+        # shm_staging: stage the flat vector in a registered shm buffer so
+        # same-host slices ring-reduce zero-copy (one extra copy per reduce;
+        # see DilocoConfig.shm_staging for the trade-off)
+        self.shm_staging = shm_staging
+        self._shm_stage = None
         self._codec = build_codec(template)
         # sharding of the template leaves, reapplied on the way back
         self._shardings = leaf_shardings(template)
@@ -104,7 +109,16 @@ class HierarchicalAllReduce:
         # np.asarray: device_get already yields a host ndarray — a second
         # np.array copy would cost another params-sized memcpy per reduce
         host = np.asarray(jax.device_get(vec), dtype=np.float32)
-        if not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
+        # quantized rings send from quantize scratch, not the staged buffer —
+        # shm staging would be a pure extra copy there (see DilocoConfig)
+        if self.shm_staging and self.quantization == QuantizationAlgorithm.NONE:
+            if self._shm_stage is None:
+                from pccl_tpu.comm.api import shm_ndarray
+
+                self._shm_stage = shm_ndarray(self._codec.count, np.float32)
+            np.copyto(self._shm_stage, host)
+            host = self._shm_stage  # same-host slices reduce zero-copy
+        elif not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
             host = np.array(host, dtype=np.float32)  # ring reduces in place
         self._ring_avg(host)
         out = self._codec.unflat(jnp.asarray(host))

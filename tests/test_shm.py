@@ -1,0 +1,210 @@
+"""Registered shared-memory buffer tests (pccltShmAlloc / shm_ndarray).
+
+pcclt extension: buffers allocated through the shm registry take the
+same-host ZERO-copy collective path — peers map the owner's memfd region
+(announced over the data conn) and reduce straight out of it. The reference
+(jundi69/pccl) has no registered-buffer concept; these tests assert the
+pcclt-specific contract: bit-identical results vs ordinary buffers, safe
+mixing of registered and unregistered peers, and retire-on-free semantics.
+"""
+
+import gc
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+LIB = Path(__file__).resolve().parent.parent / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+from conftest import alloc_ports
+
+
+def _ports(n=1):
+    return alloc_ports(64 * n)
+
+
+@pytest.fixture
+def master():
+    from pccl_tpu.comm import MasterNode
+
+    m = MasterNode("0.0.0.0", _ports())
+    m.run()
+    yield m
+    m.interrupt()
+    m.destroy()
+
+
+def _run_peers(master_port, world, worker, base):
+    from pccl_tpu.comm import Communicator
+
+    errors = []
+
+    def peer(rank):
+        comm = Communicator("127.0.0.1", master_port,
+                            p2p_port=base + rank * 8, ss_port=base + 512 + rank * 8,
+                            bench_port=base + 1024 + rank * 8)
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < world:
+                if time.time() > deadline:
+                    raise TimeoutError(f"rank {rank}: world never reached {world}")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, f"peer failures: {errors}"
+
+
+def test_shm_ndarray_alloc_rw_free():
+    from pccl_tpu.comm import _native
+    from pccl_tpu.comm.api import shm_ndarray
+
+    lib = _native.load()
+    a = shm_ndarray((256, 33), np.float64)
+    assert a.shape == (256, 33) and a.dtype == np.float64
+    a[:] = 7.5
+    assert float(a.sum()) == 7.5 * 256 * 33
+    # int shape form + dtype default
+    b = shm_ndarray(100)
+    assert b.shape == (100,) and b.dtype == np.float32
+    del a, b
+    gc.collect()
+    # double free through the C API must be rejected, not crash
+    import ctypes
+
+    assert lib.pccltShmFree(ctypes.c_void_p(0)) != 0
+
+
+# count > CMA threshold (64 KiB) so the descriptor/zero-copy path engages
+COUNT = (1 << 20) + 173
+
+
+def test_allreduce_shm_both_peers(master):
+    from pccl_tpu.comm import ReduceOp
+    from pccl_tpu.comm.api import shm_ndarray
+
+    rng = np.random.default_rng(7)
+    inputs = [rng.standard_normal(COUNT).astype(np.float32) for _ in range(2)]
+    expect = inputs[0] + inputs[1]
+    results = {}
+
+    def worker(comm, rank):
+        x = shm_ndarray(COUNT, np.float32)
+        x[:] = inputs[rank]
+        y = shm_ndarray(COUNT, np.float32)
+        for _ in range(3):  # repeat: sink reuse + announce dedup
+            comm.all_reduce(x, y, op=ReduceOp.SUM)
+        results[rank] = np.array(y)
+
+    _run_peers(master.port, 2, worker, _ports(4))
+    for r in range(2):
+        np.testing.assert_allclose(results[r], expect, rtol=1e-6)
+    assert np.array_equal(results[0], results[1]), "peers must agree bitwise"
+
+
+def test_allreduce_mixed_registered_unregistered(master):
+    from pccl_tpu.comm import ReduceOp
+    from pccl_tpu.comm.api import shm_ndarray
+
+    rng = np.random.default_rng(11)
+    inputs = [rng.standard_normal(COUNT).astype(np.float32) for _ in range(2)]
+    expect = inputs[0] + inputs[1]
+    results = {}
+
+    def worker(comm, rank):
+        if rank == 0:  # registered sender, plain receiver buffers
+            x = shm_ndarray(COUNT, np.float32)
+            x[:] = inputs[rank]
+            y = np.empty(COUNT, np.float32)
+        else:  # plain buffers: peer falls back to the pull path
+            x = inputs[rank].copy()
+            y = np.empty(COUNT, np.float32)
+        comm.all_reduce(x, y, op=ReduceOp.SUM)
+        results[rank] = np.array(y)
+
+    _run_peers(master.port, 2, worker, _ports(4))
+    for r in range(2):
+        np.testing.assert_allclose(results[r], expect, rtol=1e-6)
+
+
+def test_shm_in_place_and_avg(master):
+    from pccl_tpu.comm import ReduceOp
+    from pccl_tpu.comm.api import shm_ndarray
+
+    rng = np.random.default_rng(13)
+    inputs = [rng.standard_normal(COUNT).astype(np.float32) for _ in range(2)]
+    expect = (inputs[0] + inputs[1]) / 2.0
+    results = {}
+
+    def worker(comm, rank):
+        x = shm_ndarray(COUNT, np.float32)
+        x[:] = inputs[rank]
+        comm.all_reduce(x, x, op=ReduceOp.AVG)  # in-place
+        results[rank] = np.array(x)
+
+    _run_peers(master.port, 2, worker, _ports(4))
+    for r in range(2):
+        np.testing.assert_allclose(results[r], expect, rtol=1e-6)
+
+
+def test_shm_free_retires_then_fresh_buffer_works(master):
+    """Free a registered buffer between ops: the retire must propagate and a
+    fresh buffer (possibly at a new address) must still reduce correctly."""
+    from pccl_tpu.comm import ReduceOp
+    from pccl_tpu.comm.api import shm_ndarray
+
+    results = {}
+
+    def worker(comm, rank):
+        x = shm_ndarray(COUNT, np.float32)
+        x[:] = float(rank + 1)
+        y = shm_ndarray(COUNT, np.float32)
+        comm.all_reduce(x, y, op=ReduceOp.SUM)
+        assert float(y[0]) == 3.0
+        del x
+        gc.collect()  # frees + queues the retire for every conn
+        x2 = shm_ndarray(COUNT, np.float32)
+        x2[:] = float(10 * (rank + 1))
+        comm.all_reduce(x2, y, op=ReduceOp.SUM)
+        results[rank] = float(y[0])
+
+    _run_peers(master.port, 2, worker, _ports(4))
+    assert results[0] == results[1] == 30.0
+
+
+def test_shm_quantized_allreduce(master):
+    """Quantized path with registered buffers: the quantized wire bytes are
+    produced into ordinary scratch, so this exercises registered send +
+    unregistered scratch in one op."""
+    from pccl_tpu.comm import DataType, QuantizationAlgorithm, ReduceOp
+    from pccl_tpu.comm.api import shm_ndarray
+
+    results = {}
+
+    def worker(comm, rank):
+        x = shm_ndarray(COUNT, np.float32)
+        x[:] = np.linspace(0.0, 1.0, COUNT, dtype=np.float32) + rank
+        y = shm_ndarray(COUNT, np.float32)
+        comm.all_reduce(x, y, op=ReduceOp.SUM,
+                        quantization=QuantizationAlgorithm.MIN_MAX,
+                        quantized_dtype=DataType.UINT8)
+        results[rank] = np.array(y)
+
+    _run_peers(master.port, 2, worker, _ports(4))
+    assert np.array_equal(results[0], results[1]), "bit parity across peers"
+    expect = np.linspace(0.0, 1.0, COUNT, dtype=np.float32) * 2 + 1
+    np.testing.assert_allclose(results[0], expect, atol=2e-2)
