@@ -19,6 +19,7 @@
 
 #include "benchmark.hpp"
 #include "protocol.hpp"
+#include "shm.hpp"
 #include "sockets.hpp"
 #include "wire.hpp"
 
@@ -351,6 +352,106 @@ void test_mux_death_wakes_waiters() {
     fprintf(stderr, "mux death wakes waiters: ok\n");
 }
 
+// ---------------- registered shm regions (shm.hpp zero-copy path) --------
+
+void test_shm_zero_copy_paths() {
+    const size_t n = 512 * 1024; // > cma_min so the descriptor path engages
+
+    // 1) sink-fill route: registered source buffer, plain sink. The receiver
+    //    resolves the descriptor to its mapping and memcpys (no pvr).
+    {
+        auto p = make_pair_conns();
+        auto *src = static_cast<uint8_t *>(shm::alloc(n));
+        CHECK(src != nullptr);
+        auto data = pattern(n, 23);
+        memcpy(src, data.data(), n);
+        std::vector<uint8_t> dst(n, 0);
+        p.b->table().register_sink(1, dst.data(), n);
+        CHECK(p.a->send_bytes(1, {src, n}, /*allow_cma=*/true));
+        CHECK(p.b->table().wait_filled(1, n, 10'000) == n);
+        p.b->table().unregister_sink(1);
+        CHECK(dst == data);
+
+        // 2) consumer-pull route: the consume callback must see the bytes in
+        //    order, front to back, summing to the exact payload
+        std::vector<uint8_t> scratch(n, 0);
+        p.b->table().register_sink(2, scratch.data(), n, /*consumer_pull=*/true);
+        auto h = p.a->send_async(2, 0, {src, n}, true);
+        std::vector<uint8_t> got(n, 0);
+        size_t seen = 0;
+        auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+        while (seen < n && std::chrono::steady_clock::now() < deadline) {
+            bool pending = false;
+            p.b->table().wait_filled(2, n, 100, &pending);
+            if (!pending) continue;
+            auto claim = p.b->table().consume_cma(
+                2, n, 1, [&](const uint8_t *s, size_t lo, size_t len) {
+                    memcpy(got.data() + lo, s, len);
+                    seen = lo + len;
+                    return true;
+                });
+            CHECK(claim == net::SinkTable::CmaClaim::kDone);
+        }
+        CHECK(seen == n);
+        CHECK(got == data);
+        CHECK(h->wait(10'000));
+        p.b->table().unregister_sink(2);
+
+        // 3) retire: free the region mid-connection; the NEXT send (from a
+        //    fresh region) must still land correctly, and the freed base
+        //    must be rejected on double free
+        CHECK(shm::free_buf(src));
+        CHECK(!shm::free_buf(src));
+        auto *src2 = static_cast<uint8_t *>(shm::alloc(n));
+        CHECK(src2 != nullptr);
+        auto data2 = pattern(n, 29);
+        memcpy(src2, data2.data(), n);
+        std::vector<uint8_t> dst2(n, 0);
+        p.b->table().register_sink(3, dst2.data(), n);
+        CHECK(p.a->send_bytes(3, {src2, n}, true));
+        CHECK(p.b->table().wait_filled(3, n, 10'000) == n);
+        p.b->table().unregister_sink(3);
+        CHECK(dst2 == data2);
+        CHECK(shm::free_buf(src2));
+    }
+
+    // 4) fill_if_unmapped: a copy-consumer whose descriptor is NOT in any
+    //    registered region gets routed into the sink on the calling thread
+    //    (single pvr copy) instead of bouncing through the callback
+    {
+        auto p = make_pair_conns();
+        auto data = pattern(n, 31); // plain heap buffer: unmapped
+        std::vector<uint8_t> dst(n, 0);
+        p.b->table().register_sink(4, dst.data(), n, /*consumer_pull=*/true);
+        auto h = p.a->send_async(4, 0, data, true);
+        size_t filled = 0;
+        auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+        bool callback_hit = false;
+        while (filled < n && std::chrono::steady_clock::now() < deadline) {
+            bool pending = false;
+            filled = p.b->table().wait_filled(4, n, 100, &pending);
+            if (pending) {
+                auto claim = p.b->table().consume_cma(
+                    4, n, 1,
+                    [&](const uint8_t *, size_t, size_t) {
+                        callback_hit = true;
+                        return true;
+                    },
+                    /*fill_if_unmapped=*/true);
+                // unmapped: must route to the sink, never the callback
+                CHECK(claim == net::SinkTable::CmaClaim::kNone);
+            }
+        }
+        CHECK(!callback_hit);
+        CHECK(filled == n);
+        CHECK(h->wait(10'000));
+        p.b->table().unregister_sink(4);
+        CHECK(dst == data);
+    }
+    CHECK(shm::live_regions() == 0);
+    fprintf(stderr, "shm zero-copy paths: ok\n");
+}
+
 void test_link_striping() {
     // two conns sharing the receiver-side SinkTable; Link stripes one large
     // payload across the pool and the sink reassembles a contiguous prefix
@@ -457,6 +558,7 @@ int main() {
     test_mux_purge_and_cancel();
     test_mux_concurrent_tags();
     test_mux_death_wakes_waiters();
+    test_shm_zero_copy_paths();
     test_link_striping();
     test_bench_probe();
     if (failures) {
