@@ -365,10 +365,25 @@ void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid
     topo_revision_ = info.revision;
 }
 
-Status Client::establish_loop() {
+Status Client::establish_loop(bool vote_deferrable) {
     while (true) {
         if (auto st = check_kicked(); st != Status::kOk) return st;
-        auto fr = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 120'000);
+        std::optional<net::Frame> fr;
+        if (vote_deferrable) {
+            // the master declines the vote (kM2CTopologyDeferred) when our
+            // group is mid-collective/sync commence: a parked voter would
+            // cross-wait with the round forever. Deferred = no-op success;
+            // the caller's admit-pending loop re-votes after its next op.
+            fr = master_.recv_match_any(
+                {static_cast<uint16_t>(PacketType::kM2CP2PConnInfo),
+                 static_cast<uint16_t>(PacketType::kM2CTopologyDeferred)},
+                nullptr, 120'000);
+            if (fr && fr->type == static_cast<uint16_t>(PacketType::kM2CTopologyDeferred))
+                return Status::kOk;
+            vote_deferrable = false; // only the first wait can be deferred
+        } else {
+            fr = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 120'000);
+        }
         if (!fr) {
             auto st = check_kicked();
             return st == Status::kOk ? Status::kMasterUnreachable : st;
@@ -423,7 +438,7 @@ Status Client::establish_loop() {
 Status Client::update_topology() {
     if (!connected_.load()) return Status::kNotConnected;
     if (!master_.send(PacketType::kC2MTopologyUpdate, {})) return Status::kConnectionLost;
-    return establish_loop();
+    return establish_loop(/*vote_deferrable=*/true);
 }
 
 Status Client::are_peers_pending(bool &pending) {
@@ -645,9 +660,24 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         memcpy(snapshot.data(), recv, nbytes);
     }
     auto tx = tx_link(next);
-    auto rx = rx_link(prev, 10'000);
-    if (!tx.valid() || !rx.valid() || !tx.alive()) {
-        st = Status::kConnectionLost;
+    // wait for the inbound link in short slices so an abort that already
+    // landed (our prev died before establishing) fails the op immediately
+    // instead of sitting out the whole mesh-formation timeout
+    net::Link rx;
+    for (auto rx_deadline = std::chrono::steady_clock::now() +
+                            std::chrono::seconds(10);;) {
+        rx = rx_link(prev, 250);
+        if (rx.valid() || std::chrono::steady_clock::now() >= rx_deadline) break;
+        if (op->abort.load() || consume_abort(true)) break;
+    }
+    if (dbg_phases)
+        fprintf(stderr, "[op %llu] links tx=%d rx=%d abort=%d seq=%llu\n",
+                (unsigned long long)desc.tag, tx.valid(), rx.valid(),
+                int(consumed_abort), (unsigned long long)seq);
+    if (!tx.valid() || !rx.valid() || !tx.alive() ||
+        (consumed_abort && verdict_aborted)) {
+        st = consumed_abort && verdict_aborted ? Status::kAborted
+                                               : Status::kConnectionLost;
     } else {
         reduce::RingCtx ctx;
         ctx.tx = tx;

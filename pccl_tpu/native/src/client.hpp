@@ -122,7 +122,10 @@ private:
         const SharedStateEntry *e;
     };
 
-    Status establish_loop(); // wait conn-info, connect mesh, confirm; until ok
+    // wait conn-info, connect mesh, confirm; until ok. vote_deferrable: the
+    // first wait may be answered with kM2CTopologyDeferred (vote declined
+    // mid-round, returns kOk no-op) — only used by update_topology.
+    Status establish_loop(bool vote_deferrable = false);
     Status establish_from_info(const proto::P2PConnInfo &info,
                                std::vector<proto::Uuid> &failed);
     void adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid> &ring);

@@ -16,18 +16,24 @@ bool Master::launch() {
     dispatcher_ = std::thread([this] { dispatcher_loop(); });
 
     listener_.run_async([this](net::Socket sock) {
-        uint64_t id;
-        std::shared_ptr<Conn> conn;
-        {
-            std::lock_guard lk(conns_mu_);
-            id = next_conn_id_++;
-            conn = std::make_shared<Conn>();
-            conn->src_ip = sock.peer_addr().ip;
-            conn->sock = std::move(sock);
-            conns_[id] = conn;
-        }
+        // the reader handle must be assigned BEFORE any event from this conn
+        // can reach the dispatcher: a probe connection that connects and
+        // instantly closes (health checks, MasterProc restart polls) lets
+        // the reader push its disconnect while `conn->reader` is still
+        // empty — the dispatcher then sees joinable()==false, skips the
+        // join, and the last reference later destroys a joinable thread
+        // (std::terminate). Assign under conns_mu_ and make the reader's
+        // first action acquire the same mutex: its events now happen-after
+        // the assignment for anyone who locked conns_mu_ in between.
+        std::lock_guard lk(conns_mu_);
+        uint64_t id = next_conn_id_++;
+        auto conn = std::make_shared<Conn>();
+        conn->src_ip = sock.peer_addr().ip;
+        conn->sock = std::move(sock);
         conn->sock.set_keepalive();
+        conns_[id] = conn;
         conn->reader = std::thread([this, id, conn] {
+            { std::lock_guard gate(conns_mu_); } // wait out the assignment
             while (running_.load()) {
                 auto f = net::recv_frame(conn->sock);
                 if (!f) break;

@@ -115,10 +115,51 @@ std::vector<Outbox> MasterState::on_hello(uint64_t conn, uint32_t src_ip,
 
 // ---------- topology update / peer accept round ----------
 
+// Deadlock tie-break. A topology vote only completes when EVERY accepted
+// client has voted, and a collective/sync round only commences when every
+// group member has initiated. When peers race a joiner's admission (one
+// sees are_peers_pending() before the join lands, the other after), one
+// peer parks in the vote while another parks in the commence wait — a
+// cross-wait neither side can resolve. The master breaks the tie in favor
+// of the IN-FLIGHT round: voters in a group with outstanding initiates are
+// sent kM2CTopologyDeferred (their update_topology returns no-op and the
+// app's admit-pending loop re-votes after its next collective, when the
+// whole group can reach the vote together).
+void MasterState::defer_topology_voters(std::vector<Outbox> &out, uint32_t group) {
+    for (auto *m : group_members(group))
+        if (m->vote_topology) {
+            m->vote_topology = false;
+            out.push_back({m->conn_id, PacketType::kM2CTopologyDeferred, {}});
+            PLOG(kDebug) << "topology vote of " << proto::uuid_str(m->uuid)
+                         << " deferred: group " << group << " is mid-round";
+        }
+}
+
+// true when `c`'s group has a round in flight that `c` is not part of yet —
+// voting now would park `c` while the round waits for it (see above)
+bool MasterState::group_mid_round(const ClientInfo &c) {
+    auto git = groups_.find(c.peer_group);
+    if (git == groups_.end()) return false;
+    for (auto &[tag, op] : git->second.ops)
+        if (!op.commenced && !op.initiated.empty() && !op.initiated.count(c.uuid))
+            return true;
+    if (!git->second.sync_in_flight && !c.sync_req)
+        for (auto *m : group_members(c.peer_group))
+            if (m->uuid != c.uuid && m->sync_req) return true;
+    return false;
+}
+
 std::vector<Outbox> MasterState::on_topology_update(uint64_t conn) {
     std::vector<Outbox> out;
     auto *c = by_conn(conn);
     if (!c) return out;
+    if (c->accepted && group_mid_round(*c)) {
+        // the group is already committing to a collective/sync round this
+        // voter is not part of: parking the vote would deadlock (cross-wait
+        // with the commence) — decline, the caller re-votes next loop
+        out.push_back({c->conn_id, PacketType::kM2CTopologyDeferred, {}});
+        return out;
+    }
     c->vote_topology = true;
     check_topology(out);
     return out;
@@ -267,6 +308,9 @@ std::vector<Outbox> MasterState::on_collective_init(uint64_t conn,
     }
     it->second.initiated.insert(c->uuid);
     check_collective(out, c->peer_group, ci.tag);
+    // the op is waiting on members that may be parked in a topology vote —
+    // release them or neither the vote nor the commence can ever complete
+    if (!it->second.commenced) defer_topology_voters(out, c->peer_group);
     return out;
 }
 
@@ -383,6 +427,10 @@ std::vector<Outbox> MasterState::on_shared_state_sync(uint64_t conn,
     c->sync_req = req;
     c->dist_done = false;
     check_shared_state(out, c->peer_group);
+    // same cross-wait tie-break as collectives: members parked in a
+    // topology vote can never offer their sync_req — release them
+    if (!groups_[c->peer_group].sync_in_flight)
+        defer_topology_voters(out, c->peer_group);
     return out;
 }
 

@@ -118,6 +118,9 @@ private:
 
     // consensus checks — called after votes change AND after disconnects
     void check_topology(std::vector<Outbox> &out);
+    // vote-vs-commence deadlock tie-break (see master_state.cpp)
+    void defer_topology_voters(std::vector<Outbox> &out, uint32_t group);
+    bool group_mid_round(const ClientInfo &c);
     void check_establish(std::vector<Outbox> &out);
     void check_collective(std::vector<Outbox> &out, uint32_t group, uint64_t tag);
     void check_shared_state(std::vector<Outbox> &out, uint32_t group);

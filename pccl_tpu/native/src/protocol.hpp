@@ -56,6 +56,13 @@ enum PacketType : uint16_t {
     kM2COptimizeResponse = 0x200A,
     kM2COptimizeComplete = 0x200B,
     kM2CKicked = 0x200C,
+    // topology vote declined because the voter's group is mid-collective /
+    // mid-sync commence: a parked voter can never join that round, and the
+    // round can never complete while the vote holds members back — the
+    // voter's update_topology returns no-op and the app's admit-pending
+    // loop retries after its next collective (deadlock tie-break; see
+    // MasterState::defer_topology_voters)
+    kM2CTopologyDeferred = 0x200D,
 
     // p2p handshake
     kP2PHello = 0x3001,

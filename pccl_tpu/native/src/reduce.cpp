@@ -158,7 +158,10 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         memcpy(recv, restore_src, count * esz);
     };
     auto fail = [&](bool conn_lost) {
+        PLOG(kDebug) << "ring seq=" << ctx.op_seq << " failing (conn_lost="
+                     << conn_lost << "), purging";
         restore();
+        PLOG(kDebug) << "ring seq=" << ctx.op_seq << " fail restore done";
         return conn_lost ? Result::kConnectionLost : Result::kAborted;
     };
 
@@ -241,6 +244,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
 
     // ---------------- phase 1: reduce-scatter ----------------
     for (uint32_t s = 0; s + 1 < world; ++s) {
+        PLOG(kDebug) << "ring seq=" << ctx.op_seq << " rs stage " << s;
         const uint64_t tag = base_tag | s;
         const uint32_t send_c = (rank + world - s) % world;
         const uint32_t recv_c = (rank + world - s - 1) % world;
@@ -318,6 +322,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     std::vector<uint8_t> fwd_q;      // quantized bytes to forward next stage
     std::vector<uint8_t> fwd_meta;   // encoded meta to forward
     for (uint32_t s = 0; s + 1 < world; ++s) {
+        PLOG(kDebug) << "ring seq=" << ctx.op_seq << " ag stage " << s;
         const uint64_t tag = base_tag | (0x4000u + s);
         const uint32_t send_c = (rank + 1 + world - s) % world;
         const uint32_t recv_c = (rank + world - s) % world;

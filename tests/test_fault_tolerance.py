@@ -180,3 +180,86 @@ def test_late_joiner_is_admitted(master):
     finally:
         for p in peers + ([late] if late else []):
             p.kill()
+
+
+def test_vote_vs_commence_no_deadlock():
+    """Regression: one peer parked in a collective commence while the other
+    votes update_topology used to cross-wait forever (the vote waits for the
+    initiator, the commence waits for the voter). The master must DEFER the
+    vote (kM2CTopologyDeferred): update_topology returns no-op, the voter
+    joins the collective, and both finish."""
+    import numpy as np
+
+    from pccl_tpu.comm import Communicator, MasterNode, ReduceOp
+
+    master = MasterNode("0.0.0.0", _next_port())
+    master.run()
+    base = _next_port(64)
+    comms, errors = [], []
+
+    def mk(rank):
+        c = Communicator("127.0.0.1", master.port, p2p_port=base + rank * 8,
+                         ss_port=base + 256 + rank * 8,
+                         bench_port=base + 512 + rank * 8)
+        c.connect()
+        return c
+
+    try:
+        # connect concurrently: a pending joiner is only admitted once an
+        # incumbent votes, so b's connect() blocks until a's admit loop runs
+        slots = {}
+
+        def joiner(rank):
+            try:
+                slots[rank] = mk(rank)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=joiner, args=(r,)) for r in range(2)]
+        for th in threads:
+            th.start()
+        deadline = time.time() + 60
+        while len(slots) < 2 or any(c.world_size < 2 for c in slots.values()):
+            assert time.time() < deadline, f"world never formed: {errors}"
+            for c in list(slots.values()):
+                if c.are_peers_pending():
+                    c.update_topology()
+            time.sleep(0.02)
+        for th in threads:
+            th.join()
+        assert not errors, f"connect failed: {errors}"
+        a, b = slots[0], slots[1]
+        comms.extend([a, b])
+
+        n = 1 << 16
+        results = {}
+
+        def reduce_b():
+            try:
+                x = np.full(n, 2.0, dtype=np.float32)
+                b.all_reduce(x, x, op=ReduceOp.SUM)  # parks awaiting commence
+                results["b"] = float(x[0])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=reduce_b)
+        t.start()
+        time.sleep(0.5)  # b is now parked in the commence wait
+
+        # without the tie-break this vote deadlocks the group
+        t0 = time.time()
+        a.update_topology()  # must return promptly (deferred no-op)
+        assert time.time() - t0 < 30, "update_topology wedged"
+
+        x = np.full(n, 1.0, dtype=np.float32)
+        a.all_reduce(x, x, op=ReduceOp.SUM)
+        results["a"] = float(x[0])
+        t.join(timeout=60)
+        assert not t.is_alive(), "peer b never unparked"
+        assert not errors, f"peer b failed: {errors}"
+        assert results == {"a": 3.0, "b": 3.0}
+    finally:
+        for c in comms:
+            c.destroy()
+        master.interrupt()
+        master.destroy()
