@@ -47,6 +47,7 @@ def main() -> None:
 
     if path == "native" and os.environ.get("PCCLT_BENCH_FAST", "0") != "1":
         for key, fn in [
+            ("bf16_busbw_gbps", native_bench.run_allreduce_bench_bf16),
             ("quant4_busbw_gbps", native_bench.run_quantized_concurrent_bench),
             ("shared_state4_step_s", native_bench.run_shared_state_bench),
             ("diloco_outer_step_s", native_bench.run_diloco_outer_bench),

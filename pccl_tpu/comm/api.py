@@ -502,21 +502,27 @@ class Communicator:
     def all_reduce(self, send, recv=None, *, op: ReduceOp = ReduceOp.SUM,
                    tag: int = 0,
                    quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
-                   quantized_dtype: DataType = DataType.UINT8) -> ReduceInfo:
+                   quantized_dtype: DataType = DataType.UINT8,
+                   dtype: Optional[DataType] = None) -> ReduceInfo:
         """Blocking ring all-reduce. recv=None → in place. Raises
         ConnectionLostError / OperationAbortedError on peer churn.
 
         The tag identifies the op ACROSS peers: every group member must call
         with the same tag for the op to commence (reference descriptor tags).
         The default tag 0 is stable, so late joiners match incumbents; pass
-        distinct explicit tags only for concurrent reduces."""
+        distinct explicit tags only for concurrent reduces.
+
+        dtype overrides the wire dtype when numpy cannot express it —
+        e.g. pass DataType.BFLOAT16 with uint16 arrays holding bf16 bit
+        patterns (numpy has no bfloat16)."""
         send, recv = self._buffers(send, recv)
         desc = ReduceDescriptor(tag, op, quantization, quantized_dtype)._as_c()
         info = _native.ReduceInfo()
+        wire_dtype = dtype if dtype is not None else _np_dtype_of(send)
         code = self._lib.pccltAllReduce(
             self._h, send.ctypes.data_as(ctypes.c_void_p),
             recv.ctypes.data_as(ctypes.c_void_p), send.size,
-            int(_np_dtype_of(send)), ctypes.byref(desc), ctypes.byref(info))
+            int(wire_dtype), ctypes.byref(desc), ctypes.byref(info))
         _check(code, "all_reduce")
         return ReduceInfo(info.tx_bytes, info.rx_bytes, info.world_size)
 

@@ -84,33 +84,49 @@ def _connect(rank: int, master_port: int, world: int, port_base: int):
 
 # ---------------------------------------------------------------- config 1
 
-def _peer_allreduce(rank, master_port, q, nbytes, iters):
-    from pccl_tpu.comm.api import ReduceOp, shm_ndarray
+def _peer_allreduce(rank, master_port, q, nbytes, iters, dtype_name, port_base):
+    from pccl_tpu.comm.api import DataType, ReduceOp, shm_ndarray
 
-    comm = _connect(rank, master_port, 2, 48700)
-    count = nbytes // 4
-    # registered shm buffers: same-host peers map them and reduce zero-copy
-    x = shm_ndarray(count, np.float32)
-    x[:] = float(rank + 1)
-    y = shm_ndarray(count, np.float32)
-    comm.all_reduce(x, y, op=ReduceOp.SUM)  # warmup
+    bf16 = dtype_name == "bfloat16"
+    dtype = np.uint16 if bf16 else np.dtype(dtype_name)
+    comm = _connect(rank, master_port, 2, port_base)
+    count = nbytes // np.dtype(dtype).itemsize
+    # registered shm buffers: same-host peers map them and reduce zero-copy.
+    # bf16 rides as uint16 bit patterns (numpy has no bfloat16): 1.0 is
+    # 0x3F80, and 1.0 + 1.0 = 2.0 is 0x4000 — exact, so the check is exact.
+    x = shm_ndarray(count, dtype)
+    x[:] = 0x3F80 if bf16 else float(rank + 1)
+    y = shm_ndarray(count, dtype)
+    wire = DataType.BFLOAT16 if bf16 else None
+    comm.all_reduce(x, y, op=ReduceOp.SUM, dtype=wire)  # warmup
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        comm.all_reduce(x, y, op=ReduceOp.SUM)
+        comm.all_reduce(x, y, op=ReduceOp.SUM, dtype=wire)
         times.append(time.perf_counter() - t0)
-    assert abs(float(y[0]) - 3.0) < 1e-6, f"allreduce wrong: {y[0]}"
+    expect = 0x4000 if bf16 else 3.0
+    assert float(y[0]) == expect, f"allreduce wrong: {y[0]} != {expect}"
     q.put({"rank": rank, "times": times})
     comm.destroy()
 
 
-def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10) -> float:
+def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10,
+                        dtype_name: str = "float32", port_env: str =
+                        "PCCLT_BENCH_MASTER_PORT", master_port: int = 48651,
+                        port_base: int = 48700) -> float:
     """Returns busbw in GB/s (median over iters)."""
-    res = _spawn_world(2, _peer_allreduce, _port("PCCLT_BENCH_MASTER_PORT", 48651),
-                       (nbytes, iters))
+    res = _spawn_world(2, _peer_allreduce, _port(port_env, master_port),
+                       (nbytes, iters, dtype_name, port_base))
     times = next(r["times"] for r in res if r["rank"] == 0)
     med = sorted(times)[len(times) // 2]
     return (nbytes / med) / 1e9
+
+
+def run_allreduce_bench_bf16(nbytes: int = 64 << 20, iters: int = 10) -> float:
+    """bf16 (TPU-native gradient dtype) busbw GB/s, 2 loopback peers."""
+    return run_allreduce_bench(nbytes, iters, dtype_name="bfloat16",
+                               port_env="PCCLT_BENCH_MASTER_PORT5",
+                               master_port=48659, port_base=48770)
 
 
 # ---------------------------------------------------------------- config 2

@@ -11,6 +11,8 @@
 #include <xmmintrin.h>
 #endif
 
+#include "kernels_avx2.hpp"
+
 namespace pcclt::kernels {
 
 float f16_to_f32(uint16_t h) {
@@ -132,11 +134,22 @@ void dispatch_op(proto::RedOp op, T *dst, const T *src, size_t n) {
     }
 }
 
+bool avx2_ok() {
+    static const bool ok = avx2::available();
+    return ok;
+}
+
 void dispatch_op16(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *src,
                    size_t n) {
     switch (op) {
     case proto::RedOp::kSum:
-    case proto::RedOp::kAvg: loop16(bf16, dst, src, n, Add{}); break;
+    case proto::RedOp::kAvg:
+        if (bf16 && avx2_ok()) {
+            avx2::bf16_add2(dst, src, n);
+            break;
+        }
+        loop16(bf16, dst, src, n, Add{});
+        break;
     case proto::RedOp::kProd: loop16(bf16, dst, src, n, Mul{}); break;
     case proto::RedOp::kMax: loop16(bf16, dst, src, n, Max{}); break;
     case proto::RedOp::kMin: loop16(bf16, dst, src, n, Min{}); break;
@@ -173,7 +186,13 @@ void dispatch_op16_3(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *
     };
     switch (op) {
     case proto::RedOp::kSum:
-    case proto::RedOp::kAvg: go(Add{}); break;
+    case proto::RedOp::kAvg:
+        if (bf16 && avx2_ok()) {
+            avx2::bf16_add3(dst, a, b, n);
+            break;
+        }
+        go(Add{});
+        break;
     case proto::RedOp::kProd: go(Mul{}); break;
     case proto::RedOp::kMax: go(Max{}); break;
     case proto::RedOp::kMin: go(Min{}); break;

@@ -1,0 +1,93 @@
+// AVX2 bf16 reduction kernels, compiled as their own TU with -mavx2 and
+// gated at runtime by __builtin_cpu_supports — the rest of the library
+// stays baseline x86-64. bf16 is the TPU-native gradient dtype, so the
+// DCN all-reduce hot path for TPU training is bf16 sums: the generic path
+// converts element-by-element through scalar helpers (kernels.cpp loop16),
+// which is an order of magnitude below memory bandwidth.
+//
+// Reference parity note: the reference keeps arch-specific kernels as
+// separate static libs selected at configure time (its CRC32 SSE4.2/PCLMUL
+// variants); pcclt uses one TU + runtime dispatch instead, which also
+// covers heterogeneous fleets with a single binary.
+//
+// Conversion scheme (matches the scalar helpers bit-for-bit):
+//   bf16 -> f32: u32(b) << 16, reinterpret as float
+//   f32 -> bf16: round-to-nearest-even on bit 16: (u + 0x7FFF + ((u>>16)&1)) >> 16
+#include <cstddef>
+#include <cstdint>
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+#define PCCLT_X86 1
+#endif
+
+namespace pcclt::kernels::avx2 {
+
+bool available() {
+#if defined(PCCLT_X86) && defined(__GNUC__)
+    return __builtin_cpu_supports("avx2");
+#else
+    return false;
+#endif
+}
+
+#if defined(PCCLT_X86)
+
+namespace {
+
+// widen the low 8 bf16 lanes of `v` to 8 f32
+inline __m256 bf16lo_to_f32(__m128i v) {
+    __m256i w = _mm256_cvtepu16_epi32(v);
+    return _mm256_castsi256_ps(_mm256_slli_epi32(w, 16));
+}
+
+// round-to-nearest-even f32 -> bf16 for 8 lanes; result in the low 8 u16
+// of the return (packed, lane-crossing fixed up)
+inline __m128i f32_to_bf16_8(__m256 f) {
+    __m256i u = _mm256_castps_si256(f);
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(u, 16), _mm256_set1_epi32(1));
+    __m256i bias = _mm256_add_epi32(_mm256_set1_epi32(0x7FFF), lsb);
+    __m256i r = _mm256_srli_epi32(_mm256_add_epi32(u, bias), 16);
+    // pack 8x u32 (values fit u16) -> 8x u16; packus works per 128-bit lane,
+    // so permute the two halves back into order afterwards
+    __m256i packed = _mm256_packus_epi32(r, _mm256_setzero_si256());
+    packed = _mm256_permute4x64_epi64(packed, _MM_SHUFFLE(3, 1, 2, 0));
+    return _mm256_castsi256_si128(packed);
+}
+
+} // namespace
+
+void bf16_add3(uint16_t *dst, const uint16_t *a, const uint16_t *b, size_t n) {
+    size_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        __m128i va = _mm_loadu_si128(reinterpret_cast<const __m128i *>(a + i));
+        __m128i vb = _mm_loadu_si128(reinterpret_cast<const __m128i *>(b + i));
+        __m256 s = _mm256_add_ps(bf16lo_to_f32(va), bf16lo_to_f32(vb));
+        _mm_storeu_si128(reinterpret_cast<__m128i *>(dst + i), f32_to_bf16_8(s));
+    }
+    for (; i < n; ++i) {
+        // scalar tail, identical rounding
+        uint32_t ua = static_cast<uint32_t>(a[i]) << 16;
+        uint32_t ub = static_cast<uint32_t>(b[i]) << 16;
+        float fa, fb;
+        __builtin_memcpy(&fa, &ua, 4);
+        __builtin_memcpy(&fb, &ub, 4);
+        float fr = fa + fb;
+        uint32_t ur;
+        __builtin_memcpy(&ur, &fr, 4);
+        dst[i] = static_cast<uint16_t>((ur + 0x7FFF + ((ur >> 16) & 1)) >> 16);
+    }
+}
+
+void bf16_add2(uint16_t *dst, const uint16_t *src, size_t n) {
+    bf16_add3(dst, dst, src, n);
+}
+
+#else
+
+void bf16_add3(uint16_t *, const uint16_t *, const uint16_t *, size_t) {}
+void bf16_add2(uint16_t *, const uint16_t *, size_t) {}
+
+#endif
+
+} // namespace pcclt::kernels::avx2

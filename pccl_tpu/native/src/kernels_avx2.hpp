@@ -1,0 +1,17 @@
+// Runtime-dispatched AVX2 bf16 kernels (see kernels_avx2.cpp). Call
+// available() once and cache; the add functions are only valid when it
+// returned true.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pcclt::kernels::avx2 {
+
+bool available();
+// dst[i] = bf16(f32(a[i]) + f32(b[i])), round-to-nearest-even — bit-equal
+// to the scalar helpers in kernels.hpp
+void bf16_add3(uint16_t *dst, const uint16_t *a, const uint16_t *b, size_t n);
+void bf16_add2(uint16_t *dst, const uint16_t *src, size_t n);
+
+} // namespace pcclt::kernels::avx2

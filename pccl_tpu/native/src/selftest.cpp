@@ -7,6 +7,7 @@
 #include <cstdio>
 #include <cstring>
 #include <thread>
+#include <random>
 #include <vector>
 
 #include "atsp.hpp"
@@ -81,6 +82,35 @@ static void test_kernels() {
     int32_t ia[3] = {3, 7, 9}, ib[3] = {5, 2, 9};
     kernels::accumulate(proto::DType::kI32, proto::RedOp::kMax, ia, ib, 3);
     CHECK(ia[0] == 5 && ia[1] == 7 && ia[2] == 9);
+
+    // bf16 sum: the AVX2 fast path (when available) must be BIT-identical
+    // to the scalar round-to-nearest-even reference across magnitudes,
+    // signs, denormals, and an odd tail length
+    {
+        const size_t n = 1003;
+        std::vector<uint16_t> va(n), vb(n), fast(n), slow(n);
+        std::mt19937 rng{42};
+        for (size_t i = 0; i < n; ++i) {
+            va[i] = static_cast<uint16_t>(rng());
+            vb[i] = static_cast<uint16_t>(rng());
+            // avoid NaN/Inf encodings (exp all-ones): reductions over them
+            // are not bit-stable across fused vs separate rounding anyway
+            if ((va[i] & 0x7F80) == 0x7F80) va[i] &= 0x7F7F;
+            if ((vb[i] & 0x7F80) == 0x7F80) vb[i] &= 0x7F7F;
+        }
+        for (size_t i = 0; i < n; ++i) {
+            float s = kernels::bf16_to_f32(va[i]) + kernels::bf16_to_f32(vb[i]);
+            slow[i] = kernels::f32_to_bf16(s);
+        }
+        fast = va;
+        kernels::accumulate(proto::DType::kBF16, proto::RedOp::kSum, fast.data(),
+                            vb.data(), n);
+        CHECK(fast == slow);
+        std::vector<uint16_t> out(n, 0);
+        kernels::accumulate3(proto::DType::kBF16, proto::RedOp::kSum, out.data(),
+                             va.data(), vb.data(), n);
+        CHECK(out == slow);
+    }
 }
 
 static void test_quant() {
