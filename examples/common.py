@@ -119,6 +119,39 @@ def add_data_args(ap: argparse.ArgumentParser) -> None:
                          "(python stdlib sources)")
 
 
+def add_model_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--model", default="nano",
+                    help="model preset: nano (CI default) | tiny | gpt2 | "
+                         "gpt2-medium | gpt2-large | gpt2-xl "
+                         "(pccl_tpu.models.gpt.PRESETS)")
+    ap.add_argument("--profile", action="store_true",
+                    help="print a per-section time table at the end "
+                         "(pccl_tpu.utils.profiler)")
+    ap.add_argument("--trace-out", default=None,
+                    help="write a Chrome trace-event JSON of the run")
+
+
+def model_config(args, *, char_level: bool):
+    """GPTConfig from the --model preset, with --block as the sequence
+    length; char-level text data caps the vocab at 256 bytes."""
+    from pccl_tpu.models import gpt
+
+    overrides = {"block_size": args.block}
+    if char_level:
+        overrides["vocab_size"] = 256
+    return gpt.named_config(args.model, **overrides)
+
+
+def finish_profile(args, prof) -> None:
+    if prof is None:
+        return
+    if args.trace_out:
+        prof.export_chrome_trace(args.trace_out)
+        print(f"trace written to {args.trace_out}", flush=True)
+    if args.profile:
+        print(prof.summary(), flush=True)
+
+
 def make_batch_fn(args, vocab: int):
     """Per-peer batch sampler for the chosen dataset; the shard is seeded
     off the peer's base port (data_rng) either way."""

@@ -48,7 +48,11 @@ def main() -> int:
                     help="in-slice tensor-parallel degree (0 = auto mesh)")
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shm-staging", action="store_true",
+                    help="stage the flat gradient in a registered shm buffer "
+                         "(zero-copy ring when peers share this host)")
     common.add_data_args(ap)
+    common.add_model_args(ap)
     args = ap.parse_args()
 
     common.force_cpu_if_requested()
@@ -71,8 +75,7 @@ def main() -> int:
                                   shape)
     else:
         mesh = mesh_lib.make_mesh(devices, ("dp", "tp"))
-    cfg = gpt.tiny_config(vocab_size=256, n_layer=2, n_head=4, n_embd=64,
-                          block_size=args.block)
+    cfg = common.model_config(args, char_level=args.data == "text")
     param_sharding = mesh_lib.gpt_param_sharding(mesh)
     data_sharding = mesh_lib.batch_sharding(mesh)
 
@@ -97,8 +100,12 @@ def main() -> int:
     # params serve as the gradient template: same shapes/dtypes/shardings
     ring = HierarchicalAllReduce(comm, params,
                                  quantization=common.quant_from_arg(args.quantize),
-                                 quantized_dtype=DataType.UINT8)
+                                 quantized_dtype=DataType.UINT8,
+                                 shm_staging=args.shm_staging)
 
+    from pccl_tpu.utils.profiler import Profiler
+
+    prof = Profiler(enabled=args.profile or bool(args.trace_out))
     next_batch = common.make_batch_fn(args, cfg.vocab_size)  # per-peer shard
     first_loss = last_loss = None
     for step in range(args.steps):
@@ -106,15 +113,19 @@ def main() -> int:
         tok, tgt = next_batch()
         tok = jax.device_put(jnp.asarray(tok), data_sharding)
         tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
-        loss, grads = loss_and_grad(params, tok, tgt)
-        grads = ring.all_reduce(grads)  # global mean (identity when solo)
-        params, opt_state = apply(params, opt_state, grads)
+        with prof.section("fwd+bwd"):
+            loss, grads = loss_and_grad(params, tok, tgt)
+        with prof.section("ring/all_reduce"):
+            grads = ring.all_reduce(grads)  # global mean (identity when solo)
+        with prof.section("apply"):
+            params, opt_state = apply(params, opt_state, grads)
         loss = float(loss)
         first_loss = first_loss if first_loss is not None else loss
         last_loss = loss
         world = comm.world_size if comm is not None else 1
         print(f"step {step} loss {loss:.4f} world {world}", flush=True)
 
+    common.finish_profile(args, prof)
     return common.report_final(first_loss, last_loss, comm)
 
 

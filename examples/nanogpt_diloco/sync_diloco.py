@@ -41,6 +41,10 @@ def main() -> int:
     ap.add_argument("--outer-lr", type=float, default=0.7)
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shm-staging", action="store_true",
+                    help="stage pseudo-gradients in a registered shm buffer "
+                         "(zero-copy ring when peers share this host)")
+    common.add_model_args(ap)
     args = ap.parse_args()
 
     common.force_cpu_if_requested()
@@ -48,15 +52,13 @@ def main() -> int:
     import jax.numpy as jnp
 
     from pccl_tpu.comm import DataType
-    from pccl_tpu.models import gpt
     from pccl_tpu.parallel import mesh as mesh_lib, train as train_lib
     from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
 
     comm = common.connect(args)
 
     mesh = mesh_lib.make_mesh(jax.devices(), ("dp", "tp"))
-    cfg = gpt.tiny_config(vocab_size=256, n_layer=2, n_head=4, n_embd=64,
-                          block_size=args.block)
+    cfg = common.model_config(args, char_level=args.data == "text")
     params, tx, opt_state = train_lib.make_train_state(
         jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.inner_lr)
     step_fn = train_lib.build_train_step(cfg, tx, mesh)
@@ -66,18 +68,24 @@ def main() -> int:
                 DilocoConfig(inner_steps=args.inner_steps,
                              outer_lr=args.outer_lr,
                              quantization=common.quant_from_arg(args.quantize),
-                             quantized_dtype=DataType.UINT8))
+                             quantized_dtype=DataType.UINT8,
+                             shm_staging=args.shm_staging))
 
+    from pccl_tpu.utils.profiler import Profiler
+
+    prof = Profiler(enabled=args.profile or bool(args.trace_out))
     next_batch = common.make_batch_fn(args, cfg.vocab_size)
     first_loss = last_loss = None
     for outer in range(args.outer_steps):
         common.admit_pending(comm)
-        for _ in range(args.inner_steps):
-            tok, tgt = next_batch()
-            tok = jax.device_put(jnp.asarray(tok), data_sharding)
-            tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
-            params, opt_state, loss = step_fn(params, opt_state, tok, tgt)
-        params = dl.outer_step(params)  # ring AVG of pseudo-grads + outer SGD
+        with prof.section("inner"):
+            for _ in range(args.inner_steps):
+                tok, tgt = next_batch()
+                tok = jax.device_put(jnp.asarray(tok), data_sharding)
+                tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
+                params, opt_state, loss = step_fn(params, opt_state, tok, tgt)
+        with prof.section("outer/ring+sgd"):
+            params = dl.outer_step(params)  # ring AVG of pseudo-grads + SGD
         loss = float(loss)
         first_loss = first_loss if first_loss is not None else loss
         last_loss = loss
@@ -85,6 +93,7 @@ def main() -> int:
         print(f"outer {outer} loss {loss:.4f} world {world} "
               f"revision {dl.step}", flush=True)
 
+    common.finish_profile(args, prof)
     return common.report_final(first_loss, last_loss, comm)
 
 

@@ -162,3 +162,30 @@ def tiny_config(**overrides) -> GPTConfig:
     base = dict(vocab_size=512, n_layer=2, n_head=4, n_embd=128, block_size=128)
     base.update(overrides)
     return GPTConfig(**base)
+
+
+# Named presets: tiny for CI, the GPT-2 ladder for real runs (the reference's
+# examples train nanoGPT at gpt2/124M scale — train_pccl.py model args).
+# vocab 50304 = GPT-2's 50257 padded to a multiple of 64 for MXU-friendly
+# embedding/unembedding matmuls.
+PRESETS = {
+    # nano: the examples' CI default — small enough that a 2-peer loopback
+    # convergence run fits a single-core test budget
+    "nano": dict(vocab_size=256, n_layer=2, n_head=4, n_embd=64, block_size=64),
+    "tiny": dict(vocab_size=512, n_layer=2, n_head=4, n_embd=128, block_size=128),
+    "gpt2": dict(vocab_size=50304, n_layer=12, n_head=12, n_embd=768,
+                 block_size=1024),
+    "gpt2-medium": dict(vocab_size=50304, n_layer=24, n_head=16, n_embd=1024,
+                        block_size=1024),
+    "gpt2-large": dict(vocab_size=50304, n_layer=36, n_head=20, n_embd=1280,
+                       block_size=1024),
+    "gpt2-xl": dict(vocab_size=50304, n_layer=48, n_head=25, n_embd=1600,
+                    block_size=1024),
+}
+
+
+def named_config(name: str, **overrides) -> GPTConfig:
+    """Preset config by name (see PRESETS); overrides win."""
+    base = dict(PRESETS[name])
+    base.update(overrides)
+    return GPTConfig(**base)

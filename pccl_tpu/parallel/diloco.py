@@ -46,7 +46,7 @@ from ..comm import (
     TensorInfo,
 )
 from . import codec
-from .ring import avg_all_reduce_with_retry
+from .ring import avg_all_reduce_windowed
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +66,10 @@ class DilocoConfig:
     # params-sized copy per outer step, so enable it when peers share hosts
     # (workers per TPU host, bench loops); leave off for pure-WAN rings.
     shm_staging: bool = False
+    # Split the outer reduce into this many concurrent tagged collectives
+    # (ring.avg_all_reduce_windowed) — the reference's MultipleWithRetry
+    # recipe for saturating fat pipes with multiple flows. 1 = single op.
+    comm_windows: int = 1
 
 
 from .codec import build_codec
@@ -133,8 +137,9 @@ class Diloco:
 
     def _reduce_host(self, vec: np.ndarray) -> int:
         assert self.comm is not None
-        return avg_all_reduce_with_retry(
-            self.comm, vec, quantization=self.cfg.quantization,
+        return avg_all_reduce_windowed(
+            self.comm, vec, windows=self.cfg.comm_windows,
+            quantization=self.cfg.quantization,
             quantized_dtype=self.cfg.quantized_dtype,
             max_retries=self.cfg.max_retries)
 

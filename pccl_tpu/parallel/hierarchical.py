@@ -38,7 +38,7 @@ import numpy as np
 
 from ..comm import Communicator, DataType, QuantizationAlgorithm
 from .codec import build_codec, leaf_shardings, restore_shardings
-from .ring import avg_all_reduce_with_retry
+from .ring import avg_all_reduce_windowed
 
 
 def local_mean(tree: Any, mesh, axis: str = "dp") -> Any:
@@ -76,11 +76,15 @@ class HierarchicalAllReduce:
     def __init__(self, comm: Optional[Communicator], template: Any, *,
                  quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
                  quantized_dtype: DataType = DataType.UINT8,
-                 max_retries: int = 16, shm_staging: bool = False):
+                 max_retries: int = 16, shm_staging: bool = False,
+                 windows: int = 1):
         self.comm = comm
         self.quantization = quantization
         self.quantized_dtype = quantized_dtype
         self.max_retries = max_retries
+        # windows>1: split the reduce into concurrent tagged collectives
+        # (ring.avg_all_reduce_windowed) to saturate fat pipes
+        self.windows = windows
         # shm_staging: stage the flat vector in a registered shm buffer so
         # same-host slices ring-reduce zero-copy (one extra copy per reduce;
         # see DilocoConfig.shm_staging for the trade-off)
@@ -96,8 +100,9 @@ class HierarchicalAllReduce:
 
     def _ring_avg(self, vec: np.ndarray) -> int:
         assert self.comm is not None
-        return avg_all_reduce_with_retry(
-            self.comm, vec, quantization=self.quantization,
+        return avg_all_reduce_windowed(
+            self.comm, vec, windows=self.windows,
+            quantization=self.quantization,
             quantized_dtype=self.quantized_dtype, max_retries=self.max_retries)
 
     def all_reduce(self, tree: Any) -> Any:

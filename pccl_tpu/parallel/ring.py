@@ -44,3 +44,43 @@ def avg_all_reduce_with_retry(
     raise ConnectionLostError(
         Result.CONNECTION_LOST,
         f"all_reduce failed after {max_retries} retries")
+
+
+# below this, windowing costs more in per-op overhead than it buys in
+# concurrency (each window is its own tagged collective with its own
+# consensus round)
+_MIN_WINDOW_ELEMS = 1 << 20
+
+
+def avg_all_reduce_windowed(
+        comm: Communicator, vec: np.ndarray, *, windows: int = 1,
+        quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
+        quantized_dtype: DataType = DataType.UINT8,
+        max_retries: int = 16) -> int:
+    """AVG all-reduce `vec` in place, split into `windows` concurrent
+    tagged collectives over the connection pool (the reference's
+    pcclAllReduceMultipleWithRetry recipe — its DiLoCo loop reduces
+    per-parameter tensors concurrently to saturate fat pipes; here the flat
+    vector is windowed instead). windows<=1 or a small vec degrades to the
+    single-op path. Returns the smallest world size any window completed
+    with (1 = alone). On churn mid-batch, completed windows stand (averaged
+    over the old world) while failed ones retry over the survivors — the
+    same mixed-world semantics the reference's retry loop has.
+
+    max_retries only bounds the single-op path: the windowed path uses the
+    native MultipleWithRetry policy, which retries failed windows until
+    they succeed or the caller is alone (the reference's unbounded
+    contract)."""
+    windows = min(windows, max(1, vec.size // _MIN_WINDOW_ELEMS))
+    if windows <= 1:
+        return avg_all_reduce_with_retry(
+            comm, vec, quantization=quantization,
+            quantized_dtype=quantized_dtype, max_retries=max_retries)
+    views = np.array_split(vec, windows)  # contiguous views into vec
+    try:
+        infos = comm.all_reduce_multiple_with_retry(
+            views, op=ReduceOp.AVG, quantization=quantization,
+            quantized_dtype=quantized_dtype)
+        return min(i.world_size for i in infos)
+    except TooFewPeersError:
+        return 1

@@ -1,0 +1,110 @@
+"""Lightweight section profiler for training loops.
+
+Reference parity: python/examples/nanogpt_diloco/profiler.py of the
+reference (a session wrapper timing named spans around the DiLoCo loop,
+used at sync_diloco.py:396-497) — promoted here from example code to a
+library utility, with aggregation and an optional Chrome-trace export the
+reference lacks.
+
+Usage::
+
+    from pccl_tpu.utils.profiler import Profiler
+
+    prof = Profiler()
+    for step in range(steps):
+        with prof.section("inner"):
+            params, loss = train_step(params, batch)
+        with prof.section("outer/allreduce"):
+            params = diloco.outer_step(params)
+    print(prof.summary())
+    prof.export_chrome_trace("trace.json")   # chrome://tracing / perfetto
+
+Sections nest; wall time is attributed to the innermost active section.
+Zero dependencies, threadsafe for disjoint section names.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class _Stat:
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.min_s = min(self.min_s, dt)
+        self.max_s = max(self.max_s, dt)
+
+
+@dataclass
+class Profiler:
+    enabled: bool = True
+    _stats: Dict[str, _Stat] = field(default_factory=dict)
+    _events: List[dict] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _t0: float = field(default_factory=time.perf_counter)
+    max_events: int = 100_000  # chrome-trace ring guard
+
+    @contextmanager
+    def section(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            with self._lock:
+                self._stats.setdefault(name, _Stat()).add(end - start)
+                if len(self._events) < self.max_events:
+                    self._events.append({
+                        "name": name, "ph": "X", "pid": 0,
+                        "tid": threading.get_ident() & 0xFFFF,
+                        "ts": (start - self._t0) * 1e6,
+                        "dur": (end - start) * 1e6,
+                    })
+
+    def stats(self) -> Dict[str, _Stat]:
+        with self._lock:
+            return dict(self._stats)
+
+    def summary(self) -> str:
+        """Aligned per-section table: count, total, mean, min, max."""
+        with self._lock:
+            if not self._stats:
+                return "(no sections recorded)"
+            rows = [("section", "count", "total_s", "mean_ms", "min_ms", "max_ms")]
+            for name in sorted(self._stats, key=lambda n: -self._stats[n].total_s):
+                s = self._stats[name]
+                rows.append((name, str(s.count), f"{s.total_s:.3f}",
+                             f"{1e3 * s.total_s / s.count:.2f}",
+                             f"{1e3 * s.min_s:.2f}", f"{1e3 * s.max_s:.2f}"))
+            widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+            return "\n".join(
+                "  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows)
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Write accumulated spans as a Chrome trace-event JSON file
+        (load in chrome://tracing or ui.perfetto.dev)."""
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+            self._events.clear()
+            self._t0 = time.perf_counter()

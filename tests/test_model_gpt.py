@@ -46,3 +46,38 @@ def test_graft_entry_and_dryrun(eight_devices):
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 2
     ge.dryrun_multichip(8)
+
+
+def test_named_configs():
+    """Preset ladder: GPT-2 124M dims and MXU-padded vocab; overrides win."""
+    c = gpt.named_config("gpt2")
+    assert (c.n_layer, c.n_head, c.n_embd, c.block_size) == (12, 12, 768, 1024)
+    assert c.vocab_size % 64 == 0  # padded for MXU-friendly embed matmuls
+    c2 = gpt.named_config("gpt2", block_size=256, vocab_size=256)
+    assert c2.block_size == 256 and c2.vocab_size == 256
+    assert set(gpt.PRESETS) >= {"tiny", "gpt2", "gpt2-medium", "gpt2-large",
+                                "gpt2-xl"}
+
+
+def test_profiler_sections():
+    from pccl_tpu.utils.profiler import Profiler
+
+    prof = Profiler()
+    with prof.section("a"):
+        with prof.section("b"):
+            pass
+    with prof.section("a"):
+        pass
+    stats = prof.stats()
+    assert stats["a"].count == 2 and stats["b"].count == 1
+    table = prof.summary()
+    assert "a" in table and "mean_ms" in table
+    import json as _json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", mode="r") as f:
+        prof.export_chrome_trace(f.name)
+        trace = _json.load(open(f.name))
+    assert len(trace["traceEvents"]) == 3
+    prof.reset()
+    assert prof.stats() == {}

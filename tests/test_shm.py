@@ -208,3 +208,28 @@ def test_shm_quantized_allreduce(master):
     assert np.array_equal(results[0], results[1]), "bit parity across peers"
     expect = np.linspace(0.0, 1.0, COUNT, dtype=np.float32) * 2 + 1
     np.testing.assert_allclose(results[0], expect, atol=2e-2)
+
+
+def test_windowed_avg_reduce(master):
+    """avg_all_reduce_windowed splits into concurrent tagged collectives
+    (reference MultipleWithRetry recipe); result must equal the single-op
+    mean bitwise across peers."""
+    from pccl_tpu.comm.api import shm_ndarray
+    from pccl_tpu.parallel.ring import avg_all_reduce_windowed
+
+    n = (2 << 20) + 577  # two windows and a ragged tail
+    rng = np.random.default_rng(17)
+    inputs = [rng.standard_normal(n).astype(np.float32) for _ in range(2)]
+    expect = (inputs[0] + inputs[1]) / 2.0
+    results = {}
+
+    def worker(comm, rank):
+        vec = shm_ndarray(n, np.float32)
+        vec[:] = inputs[rank]
+        world = avg_all_reduce_windowed(comm, vec, windows=2)
+        assert world == 2
+        results[rank] = np.array(vec)
+
+    _run_peers(master.port, 2, worker, _ports(4))
+    assert np.array_equal(results[0], results[1])
+    np.testing.assert_allclose(results[0], expect, rtol=1e-6)
