@@ -830,6 +830,8 @@ bool MultiplexConn::cma_post_desc(uint64_t tag, uint64_t off,
     w.u32(static_cast<uint32_t>(getpid()));
     w.u64(reinterpret_cast<uint64_t>(span.data()));
     w.u64(span.size());
+    PLOG(kTrace) << "tx cma-desc tag=" << tag << " off=" << off
+                 << " len=" << span.size();
     bool ok = shm_sync_tx(span) && write_frame(kCmaDesc, tag, off, w.data());
     if (!ok) {
         bool mine;
@@ -943,6 +945,8 @@ bool MultiplexConn::shm_sync_tx(std::span<const uint8_t> span) {
     w.u64(base);
     w.u64(r->len);
     if (!write_frame(kShmAnnounce, 0, 0, w.data())) return false;
+    PLOG(kTrace) << "tx shm-announce base=" << std::hex << base << std::dec
+                 << " len=" << r->len;
     shm_announced_[base] = r->len;
     return true;
 }
@@ -1367,6 +1371,7 @@ void MultiplexConn::rx_loop() {
         // unregister/purge while we write outside the lock; the frame is
         // read in bounded slices so a cancel request (op abort) is honoured
         // promptly without killing the connection.
+        PLOG(kTrace) << "rx data tag=" << tag << " off=" << off << " len=" << n;
         uint8_t *dst = nullptr;
         {
             std::lock_guard lk(table_->mu_);
