@@ -176,13 +176,19 @@ def data_rng(args) -> np.random.RandomState:
     return np.random.RandomState(1000 + (args.base_port % 997))
 
 
-def report_final(first_loss: float, last_loss: float, comm) -> int:
+def report_final(first_loss, last_loss, comm) -> int:
     """Print the FINAL line (parsed by tests/test_examples_e2e.py) and
-    return the process exit code (0 = loss decreased)."""
-    print(f"FINAL first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
-          flush=True)
+    return the process exit code (0 = loss decreased). None losses mean no
+    step ran (e.g. a checkpoint resume at/past --outer-steps) — report
+    cleanly and exit 0."""
     if comm is not None:
         comm.destroy()
+    if first_loss is None or last_loss is None:
+        print("FINAL no steps ran (resumed at or past the step budget)",
+              flush=True)
+        return 0
+    print(f"FINAL first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
+          flush=True)
     return 0 if last_loss < first_loss else 4
 
 

@@ -44,6 +44,10 @@ def main() -> int:
     ap.add_argument("--shm-staging", action="store_true",
                     help="stage pseudo-gradients in a registered shm buffer "
                          "(zero-copy ring when peers share this host)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="save outer state here every --checkpoint-every "
+                         "steps and resume from the newest snapshot")
+    ap.add_argument("--checkpoint-every", type=int, default=10)
     common.add_model_args(ap)
     args = ap.parse_args()
 
@@ -73,10 +77,19 @@ def main() -> int:
 
     from pccl_tpu.utils.profiler import Profiler
 
+    ckpt = start = None
+    if args.checkpoint_dir:
+        from pccl_tpu.utils.checkpoint import DilocoCheckpoint
+
+        ckpt = DilocoCheckpoint(args.checkpoint_dir)
+        start = ckpt.maybe_restore(dl)
+        if start:
+            print(f"resumed from outer step {start}", flush=True)
+
     prof = Profiler(enabled=args.profile or bool(args.trace_out))
     next_batch = common.make_batch_fn(args, cfg.vocab_size)
     first_loss = last_loss = None
-    for outer in range(args.outer_steps):
+    for outer in range(start or 0, args.outer_steps):
         common.admit_pending(comm)
         with prof.section("inner"):
             for _ in range(args.inner_steps):
@@ -92,6 +105,8 @@ def main() -> int:
         world = comm.world_size if comm is not None else 1
         print(f"outer {outer} loss {loss:.4f} world {world} "
               f"revision {dl.step}", flush=True)
+        if ckpt is not None and (outer + 1) % args.checkpoint_every == 0:
+            ckpt.save(dl)
 
     common.finish_profile(args, prof)
     return common.report_final(first_loss, last_loss, comm)

@@ -210,7 +210,7 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
     comm = _connect(rank, master_port, world, 48960)
     params = {"w": jnp.zeros((params_n,), jnp.float32)}
     # shm_staging: bench peers share this host, so the ring is zero-copy
-    diloco = Diloco(comm, params, DilocoConfig(shm_staging=True))
+    diloco = Diloco(comm, params, DilocoConfig(shm_staging=True, comm_windows=4))
     # synthetic inner step: outer params minus a fake gradient update.
     # 2 warmup steps: the first outer steps pay one-time jit compiles of the
     # param-sized codec/apply graphs

@@ -41,6 +41,7 @@ from ..comm import (
     Communicator,
     DataType,
     QuantizationAlgorithm,
+    ReduceOp,
     SharedState,
     SharedStateSyncStrategy,
     TensorInfo,
@@ -143,6 +144,68 @@ class Diloco:
             quantized_dtype=self.cfg.quantized_dtype,
             max_retries=self.cfg.max_retries)
 
+    # tag band for pipelined window reduces: disjoint from the blocking
+    # default 0, user small tags, the MultipleWithRetry band (1<<16), and
+    # the auto band (1<<32); deterministic so every peer matches by window
+    _WINDOW_TAG_BASE = 1 << 20
+
+    def _ensure_shm_stage(self) -> None:
+        if self._shm_stage is None:
+            from pccl_tpu.comm.api import shm_ndarray
+
+            self._shm_stage = shm_ndarray(self.count, np.float32)
+
+    def _reduce_pipelined(self, delta) -> bool:
+        """Overlapped outer reduce: device->host of window k+1 overlaps the
+        ring reduce of window k (the windows are independent tagged
+        collectives). Falls back (returns False) when windowing is off or
+        the vector is too small; failed windows retry over the survivor
+        world via MultipleWithRetry, completed ones stand — the documented
+        mixed-world windowed semantics."""
+        from pccl_tpu.comm import PcclError, TooFewPeersError
+        from .ring import _MIN_WINDOW_ELEMS
+
+        k = min(self.cfg.comm_windows, max(1, self.count // _MIN_WINDOW_ELEMS), 8)
+        if k <= 1:
+            return False
+        self._ensure_shm_stage()
+        bounds = [self.count * i // k for i in range(k + 1)]
+        # slice on device and start every D2H up front; np.asarray(win)
+        # then only blocks for ITS window while later windows keep copying
+        wins = [jax.lax.slice_in_dim(delta, bounds[i], bounds[i + 1], axis=0)
+                for i in range(k)]
+        for w in wins:
+            try:
+                w.copy_to_host_async()
+            except AttributeError:  # older jax: device_get blocks per window
+                break
+        handles, views = [], []
+        for i, w in enumerate(wins):
+            view = self._shm_stage[bounds[i]:bounds[i + 1]]
+            np.copyto(view, np.asarray(w, dtype=np.float32))
+            views.append(view)
+            # launch this window's ring while the next window's D2H runs
+            handles.append(self.comm.all_reduce_async(
+                view, view, op=ReduceOp.AVG, tag=self._WINDOW_TAG_BASE + i))
+        failed = []
+        for i, h in enumerate(handles):
+            try:
+                h.wait()
+            except TooFewPeersError:
+                pass  # alone: the window is its own average
+            except PcclError:
+                failed.append(i)
+        if failed:
+            # survivors agree on the failed set (exactly-one-abort
+            # accounting), so the retry batch lines up across peers
+            self.comm.update_topology()
+            try:
+                self.comm.all_reduce_multiple_with_retry(
+                    [views[i] for i in failed], op=ReduceOp.AVG)
+            except TooFewPeersError:
+                pass
+        return True
+
     def outer_step(self, inner_params: Any) -> Any:
         """Average pseudo-gradients across peers, apply outer Nesterov SGD,
         return the new global params (device pytree).
@@ -150,24 +213,24 @@ class Diloco:
         The returned tree is a fresh copy safe to hand to a donating train
         step; the driver keeps its own buffers for the next pseudo-gradient."""
         delta = self._delta_fn(self.outer_params, inner_params)
-        # np.asarray: device_get already yields a host ndarray — a second
-        # np.array copy would cost another params-sized memcpy per outer step
-        host = np.asarray(jax.device_get(delta), dtype=np.float32)
         # quantized rings send from quantize scratch, not from the staged
         # buffer — shm staging would be a pure extra copy there, so gate it
         use_shm = (self.cfg.shm_staging and self.comm is not None
                    and self.cfg.quantization == QuantizationAlgorithm.NONE)
-        if use_shm:
-            if self._shm_stage is None:
-                from pccl_tpu.comm.api import shm_ndarray
-
-                self._shm_stage = shm_ndarray(self.count, np.float32)
-            np.copyto(self._shm_stage, host)
-            host = self._shm_stage  # same-host peers reduce zero-copy
-        elif not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
-            host = np.array(host, dtype=np.float32)  # ring reduces in place
-        if self.comm is not None:
-            self._reduce_host(host)
+        if use_shm and self.cfg.comm_windows > 1 and self._reduce_pipelined(delta):
+            host = self._shm_stage
+        else:
+            # np.asarray: device_get already yields a host ndarray — a second
+            # np.array copy would cost another params-sized memcpy per step
+            host = np.asarray(jax.device_get(delta), dtype=np.float32)
+            if use_shm:
+                self._ensure_shm_stage()
+                np.copyto(self._shm_stage, host)
+                host = self._shm_stage  # same-host peers reduce zero-copy
+            elif not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
+                host = np.array(host, dtype=np.float32)  # reduces in place
+            if self.comm is not None:
+                self._reduce_host(host)
         outer_vec = self._flat_fn(self.outer_params)
         new_vec, self._momentum_vec = self._apply_fn(
             outer_vec, self._momentum_vec,

@@ -1,0 +1,119 @@
+"""Checkpoint/resume for fault-tolerant training (orbax-backed).
+
+The reference keeps checkpointing an application contract: apps
+periodically dump state, and a restarted master accepts whatever revision
+the cohort offers (reference ccoip_master_state.cpp:1083-1086 — revision-0
+bootstrap; docs/md/04-API Overview/01_PCCL_API_Overview.md:341-347). This
+module implements that contract as a library:
+
+- ``Checkpointer`` saves/restores a pytree (params, opt state, ...) plus a
+  step counter, with retention, using orbax (the TPU-ecosystem
+  checkpointing library — async-friendly, sharding-aware).
+- ``DilocoCheckpoint`` snapshots a Diloco driver (outer params, outer
+  momentum, step) so a fully-restarted cohort resumes at the exact outer
+  revision: every peer restores the same snapshot, offers the same
+  revision to the fresh master, and the one-increment rule carries on.
+
+The shared-state path (pccl_tpu.comm.SharedState) remains the LIVE-cohort
+catch-up mechanism (late joiners fetch from incumbents over TCP);
+checkpoints cover the cold-start case where no incumbent survives.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class Checkpointer:
+    """Save/restore a pytree + step under a directory, keeping the last
+    ``keep`` checkpoints. Thin, deliberate wrapper over
+    ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir, options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True))
+
+    def save(self, step: int, tree: Any, *, wait: bool = True) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of ``template``. step=None
+        restores the latest; raises FileNotFoundError when none exist."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                           sharding=getattr(x, "sharding", None)),
+            template)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(shapes))
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+class DilocoCheckpoint:
+    """Snapshot/restore a Diloco driver's outer state.
+
+    Usage::
+
+        ck = DilocoCheckpoint("ckpt/", keep=2)
+        dl = Diloco(comm, params, cfg)
+        start = ck.maybe_restore(dl)           # cold start resumes here
+        for outer in range(start, total):
+            ...inner steps...
+            params = dl.outer_step(params)
+            if outer % 10 == 9:
+                ck.save(dl)
+
+    After a full-cohort restart, every peer restores the same outer
+    revision; the first sync_shared_state against the fresh master
+    re-seeds revision tracking (revision-0 bootstrap)."""
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        self._ck = Checkpointer(directory, keep=keep)
+
+    def save(self, diloco, *, wait: bool = True) -> None:
+        state = {
+            "outer_params": diloco.outer_params,
+            "momentum": diloco._momentum_vec,
+            "step": np.int64(diloco.step),
+        }
+        self._ck.save(diloco.step, state, wait=wait)
+
+    def maybe_restore(self, diloco) -> int:
+        """Restore the newest snapshot into ``diloco`` if one exists.
+        Returns the outer step to resume from (0 on a fresh start)."""
+        if self._ck.latest_step() is None:
+            return 0
+        template = {
+            "outer_params": diloco.outer_params,
+            "momentum": diloco._momentum_vec,
+            "step": np.int64(0),
+        }
+        state = self._ck.restore(template)
+        diloco.outer_params = diloco._restore_shardings(state["outer_params"])
+        diloco._momentum_vec = state["momentum"]
+        diloco.step = int(state["step"])
+        return diloco.step
+
+    def close(self) -> None:
+        self._ck.close()

@@ -1,0 +1,68 @@
+"""Checkpoint/resume (pccl_tpu.utils.checkpoint, orbax-backed).
+
+The reference keeps checkpointing an app contract (revision-0 master
+bootstrap + periodic dumps); these tests assert the library implementation:
+round-trip fidelity, retention, and DiLoCo outer-state resume at the exact
+revision.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+pytest.importorskip("orbax.checkpoint")  # the library defers this import
+import jax.numpy as jnp  # noqa: E402
+
+
+def test_checkpointer_roundtrip_and_retention(tmp_path):
+    from pccl_tpu.utils.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path / "ck"), keep=2)
+    tree = {"w": jnp.arange(8, dtype=jnp.float32), "b": jnp.float32(3.5)}
+    for step in (1, 2, 3):
+        ck.save(step, jax.tree.map(lambda x: x * step, tree))
+    assert ck.latest_step() == 3
+    out = ck.restore(tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.arange(8, dtype=np.float32) * 3)
+    assert float(out["b"]) == 3.5 * 3
+    # retention: keep=2 -> step 1 is gone, step 2 restorable
+    out2 = ck.restore(tree, step=2)
+    assert float(out2["b"]) == 7.0
+    with pytest.raises(Exception):
+        ck.restore(tree, step=1)
+    ck.close()
+
+
+def test_diloco_checkpoint_resume(tmp_path):
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+    from pccl_tpu.utils.checkpoint import DilocoCheckpoint
+
+    params = {"w": jnp.zeros((64,), jnp.float32)}
+    cfg = DilocoConfig(outer_lr=1.0, outer_momentum=0.9)
+    dl = Diloco(None, params, cfg)  # solo: outer_step still applies SGD
+    ckpt = DilocoCheckpoint(str(tmp_path / "dck"))
+    assert ckpt.maybe_restore(dl) == 0  # fresh start
+
+    p = dl.params()
+    for _ in range(3):
+        inner = {"w": p["w"] - 0.5}
+        p = dl.outer_step(inner)
+    ckpt.save(dl)
+    want_w = np.asarray(dl.outer_params["w"])
+    want_mom = np.asarray(dl._momentum_vec)
+
+    # cold restart: a brand-new driver restores the exact outer revision
+    dl2 = Diloco(None, params, cfg)
+    resumed = ckpt.maybe_restore(dl2)
+    assert resumed == 3 and dl2.step == 3
+    np.testing.assert_array_equal(np.asarray(dl2.outer_params["w"]), want_w)
+    np.testing.assert_array_equal(np.asarray(dl2._momentum_vec), want_mom)
+
+    # training continues identically from the restored state
+    inner = {"w": dl2.params()["w"] - 0.5}
+    a = np.asarray(dl2.outer_step(inner)["w"])
+    inner_ref = {"w": dl.params()["w"] - 0.5}
+    b = np.asarray(dl.outer_step(inner_ref)["w"])
+    np.testing.assert_array_equal(a, b)
+    ckpt.close()

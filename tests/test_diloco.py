@@ -199,3 +199,60 @@ def test_diloco_shared_state_joiner_catchup():
     assert not errors, f"peer failures: {errors}"
     np.testing.assert_array_equal(adopted[0], adopted[1])
     np.testing.assert_allclose(adopted[1], np.full(8, 3.25))
+
+
+def test_diloco_pipelined_windowed_reduce():
+    """comm_windows>1 + shm_staging takes the pipelined path (per-window
+    D2H overlapped with per-window tagged reduces); the averaged result
+    must be exact and bit-identical across peers."""
+    import jax.numpy as jnp
+
+    from pccl_tpu.comm import MasterNode
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+
+    n = (2 << 20) + 321  # two windows and a ragged tail
+    master = MasterNode("0.0.0.0", 52400)
+    master.run()
+    results = {}
+    errors = []
+
+    def peer(rank):
+        try:
+            from pccl_tpu.comm import Communicator
+
+            base = 53800 + rank * 16
+            comm = Communicator("127.0.0.1", master.port, p2p_port=base,
+                                ss_port=base + 4, bench_port=base + 8)
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < 2:
+                if time.time() > deadline:
+                    raise TimeoutError("world never reached 2")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+
+            params = {"w": jnp.zeros((n,), jnp.float32)}
+            cfg = DilocoConfig(outer_lr=1.0, outer_momentum=0.0,
+                               nesterov=False, shm_staging=True,
+                               comm_windows=2)
+            dl = Diloco(comm, params, cfg)
+            # pseudo-gradient = outer - inner = rank+1 everywhere
+            inner = {"w": params["w"] - float(rank + 1)}
+            out = dl.outer_step(inner)
+            results[rank] = np.asarray(out["w"])
+            comm.destroy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    ts = [threading.Thread(target=peer, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    master.interrupt()
+    master.destroy()
+    assert not errors, f"peer failures: {errors}"
+    # avg pseudo-gradient = 1.5; lr=1, momentum 0 -> new = 0 - 1.5
+    assert np.array_equal(results[0], results[1]), "bit parity across peers"
+    np.testing.assert_allclose(results[0], -1.5, rtol=0)
