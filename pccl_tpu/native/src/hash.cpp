@@ -10,6 +10,12 @@
 
 namespace pcclt::hash {
 
+// hardware CRC (hash_clmul.cpp, its own -mpclmul TU; runtime-gated)
+namespace clmul {
+bool available();
+uint32_t crc32(const void *data, size_t nbytes, uint32_t crc);
+} // namespace clmul
+
 uint64_t avalanche64(uint64_t x) {
     x ^= x >> 33;
     x *= 0xFF51AFD7ED558CCDull;
@@ -89,6 +95,10 @@ Type type_from_env() {
 }
 
 uint32_t crc32(const void *data, size_t nbytes, uint32_t crc) {
+    // hardware path: PCLMUL folding (hash_clmul.cpp), ~10x the table CRC
+    // on large shared-state buffers; bit parity enforced by selftest
+    static const bool hw = clmul::available();
+    if (hw && nbytes >= 64) return clmul::crc32(data, nbytes, crc);
     static const Crc32Tables tbl;
     const auto *p = static_cast<const uint8_t *>(data);
     crc = ~crc;

@@ -67,6 +67,28 @@ static void test_hash() {
     CHECK(hash::simplehash(big.data(), big.size() * 4) != hb);
     // crc32 known vector: crc32("123456789") == 0xCBF43926
     CHECK(hash::crc32("123456789", 9) == 0xCBF43926u);
+
+    // hardware (PCLMUL) and table CRC must agree bit-for-bit across sizes,
+    // alignments, and chained seeds (the dispatcher picks the HW path for
+    // n >= 64, so compare against a bitwise reference)
+    {
+        auto ref_crc = [](const uint8_t *p, size_t n, uint32_t crc) {
+            crc = ~crc;
+            while (n--) {
+                crc ^= *p++;
+                for (int i = 0; i < 8; ++i)
+                    crc = (crc >> 1) ^ (0xEDB88320u & (0u - (crc & 1)));
+            }
+            return ~crc;
+        };
+        std::mt19937_64 rng{7};
+        std::vector<uint8_t> buf(100003 + 3);
+        for (auto &b : buf) b = static_cast<uint8_t>(rng());
+        for (size_t n : {0u, 1u, 63u, 64u, 65u, 255u, 4096u, 100003u})
+            for (int off = 0; off < 3; ++off)
+                CHECK(hash::crc32(buf.data() + off, n, 0x12345678u) ==
+                      ref_crc(buf.data() + off, n, 0x12345678u));
+    }
 }
 
 static void test_kernels() {
