@@ -181,15 +181,19 @@ def report_final(first_loss, last_loss, comm) -> int:
     return the process exit code (0 = loss decreased). None losses mean no
     step ran (e.g. a checkpoint resume at/past --outer-steps) — report
     cleanly and exit 0."""
-    if comm is not None:
-        comm.destroy()
+    # FINAL goes out BEFORE destroy: a churn-wedged teardown must not
+    # suppress the result line the e2e harness parses
     if first_loss is None or last_loss is None:
         print("FINAL no steps ran (resumed at or past the step budget)",
               flush=True)
-        return 0
-    print(f"FINAL first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
-          flush=True)
-    return 0 if last_loss < first_loss else 4
+        code = 0
+    else:
+        print(f"FINAL first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
+              flush=True)
+        code = 0 if last_loss < first_loss else 4
+    if comm is not None:
+        comm.destroy()
+    return code
 
 
 def force_cpu_if_requested() -> None:

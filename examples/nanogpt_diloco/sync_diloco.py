@@ -47,7 +47,8 @@ def main() -> int:
     ap.add_argument("--checkpoint-dir", default=None,
                     help="save outer state here every --checkpoint-every "
                          "steps and resume from the newest snapshot")
-    ap.add_argument("--checkpoint-every", type=int, default=10)
+    ap.add_argument("--checkpoint-every", default=10,
+                    type=lambda v: max(1, int(v)))
     common.add_model_args(ap)
     args = ap.parse_args()
 

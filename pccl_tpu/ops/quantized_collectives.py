@@ -1,0 +1,131 @@
+"""In-jit quantized ring all-reduce over a mesh axis (ICI).
+
+The native stack quantizes the DCN hop (reference piquant path, SURVEY.md
+§2 #12); this module brings the same wire-shrink to the IN-JIT dimension:
+an int8 ring all-reduce built from `lax.ppermute`, so gradient syncs over
+a mesh axis move ~4x fewer bytes across ICI at a bounded precision cost.
+(Technique family: EQuARX — quantized all-reduce inside XLA,
+arXiv 2506.17615, PAPERS.md; re-designed here around pcclt's bit-parity
+invariant rather than ported.)
+
+Algorithm (mirrors the native ring, reduce.cpp):
+
+- reduce-scatter: N-1 `ppermute` steps; each hop carries blockwise
+  symmetric int8 codes + one fp32 scale per block. The receiver
+  dequantizes and accumulates in fp32, then REQUANTIZES the partial sum
+  for the next hop (fresh scales — partial sums outgrow input scales).
+- all-gather: the fully-reduced chunk is quantized ONCE by its owner and
+  forwarded VERBATIM; the owner self-dequantizes its own chunk. Every
+  rank therefore decodes byte-identical codes — the same bit-parity
+  invariant the native path keeps (reference reduce.cpp:673-738), which
+  the shared-state hash machinery depends on.
+
+Use when the axis is bandwidth-bound (big flat gradient vectors over a
+large `dp` axis); for small tensors plain `lax.pmean` wins.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _quantize_block(x: jax.Array, block: int):
+    """Blockwise symmetric int8: codes in [-127,127], one fp32 scale per
+    block. x is 1-D with size % block == 0."""
+    xb = x.reshape(-1, block)
+    s = jnp.max(jnp.abs(xb), axis=1, keepdims=True) / 127.0
+    s = jnp.where(s > 0, s, 1.0)
+    q = jnp.clip(jnp.round(xb / s), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), s.reshape(-1).astype(jnp.float32)
+
+
+def _dequantize_block(q: jax.Array, s: jax.Array, block: int) -> jax.Array:
+    return (q.reshape(-1, block).astype(jnp.float32) *
+            s.reshape(-1, 1)).reshape(-1)
+
+
+def quantized_ring_all_reduce(x: jax.Array, axis_name: str, *,
+                              block: int = 256, mean: bool = False) -> jax.Array:
+    """int8 ring all-reduce of `x` (any shape, fp32/bf16) over `axis_name`.
+    Call inside shard_map/pjit manual context. Returns fp32 cast back to
+    x.dtype; every rank returns bit-identical values."""
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    orig_dtype = x.dtype
+    orig_shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    # pad so the vector splits into n chunks of whole blocks
+    chunk = -(-flat.size // (n * block)) * block  # ceil to block multiple
+    flat = jnp.pad(flat, (0, n * chunk - flat.size))
+    chunks = flat.reshape(n, chunk)
+    nblocks = chunk // block
+
+    if n == 1:
+        out = chunks.reshape(-1)[: _size(orig_shape)]
+        return out.reshape(orig_shape).astype(orig_dtype)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    # ---- reduce-scatter: after step s, the partial sum of chunk
+    # (rank - s - 1) has visited ranks rank-s-1..rank ----
+    def rs_step(s, carry):
+        acc_q, acc_s = carry  # quantized partial for the chunk we just sent
+        q = lax.ppermute(acc_q, axis_name, fwd)
+        sc = lax.ppermute(acc_s, axis_name, fwd)
+        # we now hold the partial for chunk (rank - s - 1); fold in ours
+        idx = (rank - s - 1) % n
+        mine = lax.dynamic_index_in_dim(chunks, idx, axis=0, keepdims=False)
+        acc = _dequantize_block(q, sc, block) + mine
+        return _quantize_block(acc, block)
+
+    q0, s0 = _quantize_block(
+        lax.dynamic_index_in_dim(chunks, rank, axis=0, keepdims=False), block)
+    qf, sf = lax.fori_loop(0, n - 1, rs_step, (q0, s0))
+    # qf/sf: fully-reduced chunk (rank + 1) % n, quantized by THIS rank —
+    # exactly once, so the all-gather can forward it verbatim
+
+    # ---- all-gather: verbatim forwarding for bit parity ----
+    own_idx = (rank + 1) % n
+    out_chunks = jnp.zeros((n, chunk), jnp.float32)
+    own_deq = _dequantize_block(qf, sf, block)  # owner self-dequantizes
+    out_chunks = lax.dynamic_update_index_in_dim(out_chunks, own_deq, own_idx,
+                                                 axis=0)
+
+    def ag_step(s, carry):
+        out, q, sc = carry
+        q = lax.ppermute(q, axis_name, fwd)
+        sc = lax.ppermute(sc, axis_name, fwd)
+        # arrived: the packet forwarded s hops originated at rank (r - s),
+        # which owns chunk (r - s + 1)
+        idx = (rank - s + 1) % n
+        out = lax.dynamic_update_index_in_dim(
+            out, _dequantize_block(q, sc, block), idx, axis=0)
+        return out, q, sc
+
+    out_chunks, _, _ = lax.fori_loop(
+        1, n, lambda s, c: ag_step(s, c), (out_chunks, qf, sf))
+
+    out = out_chunks.reshape(-1)[: _size(orig_shape)]
+    if mean:
+        out = out / n
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def _size(shape) -> int:
+    sz = 1
+    for d in shape:
+        sz *= int(d)
+    return sz
+
+
+def quantized_pmean(tree, axis_name: str, *, block: int = 256):
+    """Tree-mapped quantized mean over a mesh axis — drop-in for
+    `jax.lax.pmean` where ICI bandwidth dominates and int8 precision is
+    acceptable (DiLoCo outer averaging, gradient sync on fat axes)."""
+    return jax.tree.map(
+        partial(quantized_ring_all_reduce, axis_name=axis_name, block=block,
+                mean=True), tree)

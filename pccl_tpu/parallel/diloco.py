@@ -179,20 +179,28 @@ class Diloco:
                 w.copy_to_host_async()
             except AttributeError:  # older jax: device_get blocks per window
                 break
-        handles, views = [], []
+        handles, views, failed = [], [], []
         for i, w in enumerate(wins):
             view = self._shm_stage[bounds[i]:bounds[i + 1]]
             np.copyto(view, np.asarray(w, dtype=np.float32))
             views.append(view)
-            # launch this window's ring while the next window's D2H runs
-            handles.append(self.comm.all_reduce_async(
-                view, view, op=ReduceOp.AVG, tag=self._WINDOW_TAG_BASE + i))
-        failed = []
-        for i, h in enumerate(handles):
+            # launch this window's ring while the next window's D2H runs.
+            # A launch-time failure must NOT escape with earlier windows
+            # still in flight on this shared buffer — record it for the
+            # retry batch and keep going to the join below.
+            try:
+                handles.append((i, self.comm.all_reduce_async(
+                    view, view, op=ReduceOp.AVG,
+                    tag=self._WINDOW_TAG_BASE + i)))
+            except TooFewPeersError:
+                pass  # alone: the window is its own average
+            except PcclError:
+                failed.append(i)
+        for i, h in handles:
             try:
                 h.wait()
             except TooFewPeersError:
-                pass  # alone: the window is its own average
+                pass
             except PcclError:
                 failed.append(i)
         if failed:
