@@ -28,7 +28,9 @@ BASELINE_GBPS = 45.0 / 8.0  # 45 Gbit/s → GB/s
 
 def main() -> None:
     nbytes = int(os.environ.get("PCCLT_BENCH_BYTES", str(64 << 20)))
-    iters = int(os.environ.get("PCCLT_BENCH_ITERS", "10"))
+    # 16 iterations: the median is stable to ~5% on a loaded single-core
+    # host (10 left ~15% run-to-run spread)
+    iters = int(os.environ.get("PCCLT_BENCH_ITERS", "16"))
 
     busbw = None
     extra = {}

@@ -32,4 +32,5 @@ from .api import (  # noqa: F401
     SharedStateSyncStrategy,
     TooFewPeersError,
     TensorInfo,
+    shm_ndarray,
 )

@@ -60,12 +60,12 @@ def quantized_ring_all_reduce(x: jax.Array, axis_name: str, *,
     flat = x.reshape(-1).astype(jnp.float32)
     # pad so the vector splits into n chunks of whole blocks
     chunk = -(-flat.size // (n * block)) * block  # ceil to block multiple
+    orig_size = flat.size
     flat = jnp.pad(flat, (0, n * chunk - flat.size))
     chunks = flat.reshape(n, chunk)
-    nblocks = chunk // block
 
     if n == 1:
-        out = chunks.reshape(-1)[: _size(orig_shape)]
+        out = chunks.reshape(-1)[:orig_size]
         return out.reshape(orig_shape).astype(orig_dtype)
 
     fwd = [(i, (i + 1) % n) for i in range(n)]
@@ -109,17 +109,10 @@ def quantized_ring_all_reduce(x: jax.Array, axis_name: str, *,
     out_chunks, _, _ = lax.fori_loop(
         1, n, lambda s, c: ag_step(s, c), (out_chunks, qf, sf))
 
-    out = out_chunks.reshape(-1)[: _size(orig_shape)]
+    out = out_chunks.reshape(-1)[:orig_size]
     if mean:
         out = out / n
     return out.reshape(orig_shape).astype(orig_dtype)
-
-
-def _size(shape) -> int:
-    sz = 1
-    for d in shape:
-        sz *= int(d)
-    return sz
 
 
 def quantized_pmean(tree, axis_name: str, *, block: int = 256):

@@ -204,8 +204,12 @@ class Diloco:
             except PcclError:
                 failed.append(i)
         if failed:
-            # survivors agree on the failed set (exactly-one-abort
-            # accounting), so the retry batch lines up across peers
+            # survivors agree on the failed SET (exactly-one-abort
+            # accounting), but not necessarily its order (launch-time vs
+            # wait-time detection interleave differently per peer) — and
+            # MultipleWithRetry assigns tags by list POSITION. Sort so the
+            # retry batch pairs the same window across all peers.
+            failed = sorted(set(failed))
             self.comm.update_topology()
             try:
                 self.comm.all_reduce_multiple_with_retry(

@@ -33,6 +33,8 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--base-port", type=int, required=True)
     ap.add_argument("--count", type=int, default=4096)
+    ap.add_argument("--quantize", choices=["none", "minmax"], default="none",
+                    help="exercise the quantized wire path under churn")
     ap.add_argument("--step-interval", type=float, default=0.0,
                     help="sleep between steps (paces incumbents so churn "
                          "events land mid-run)")
@@ -123,7 +125,15 @@ def main() -> int:
             time.sleep(0.05)
             continue
         try:
-            info = comm.all_reduce(x, y, op=ReduceOp.SUM)
+            if args.quantize == "minmax":
+                from pccl_tpu.comm import DataType, QuantizationAlgorithm
+
+                info = comm.all_reduce(
+                    x, y, op=ReduceOp.SUM,
+                    quantization=QuantizationAlgorithm.MIN_MAX,
+                    quantized_dtype=DataType.UINT8)
+            else:
+                info = comm.all_reduce(x, y, op=ReduceOp.SUM)
         except (KickedError, MasterUnreachableError):
             comm = rejoin(comm)
             continue
@@ -140,7 +150,8 @@ def main() -> int:
             y[:] = x
             info = None
         world = info.world_size if info is not None else 1
-        if info is not None and abs(float(y[0]) - world) > 1e-5:
+        tol = 1e-5 if args.quantize == "none" else 2e-2 * world
+        if info is not None and abs(float(y[0]) - world) > tol:
             print(f"WRONG RESULT step={step} y={y[0]} world={world}", flush=True)
             return 3
         print(f"STEP {step} world={world} rank={args.rank}", flush=True)

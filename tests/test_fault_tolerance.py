@@ -263,3 +263,76 @@ def test_vote_vs_commence_no_deadlock():
             c.destroy()
         master.interrupt()
         master.destroy()
+
+
+def test_master_survives_protocol_garbage():
+    """Robustness: raw garbage, truncated frames, huge declared lengths, and
+    valid-type/malformed-payload packets at the master port must never kill
+    the master; a legitimate peer must still join and reduce afterwards."""
+    import socket
+    import struct
+
+    import numpy as np
+
+    from pccl_tpu.comm import Communicator, MasterNode, ReduceOp
+
+    master = MasterNode("0.0.0.0", _next_port())
+    master.run()
+    try:
+        attacks = [
+            b"\x00" * 64,                       # zero frames
+            b"GET / HTTP/1.1\r\n\r\n",          # wrong protocol entirely
+            struct.pack(">IH", 2 + 6, 0x1001),  # hello with missing payload
+            struct.pack(">IH", 0xFFFFFFF, 0x1001),  # absurd declared length
+            struct.pack(">IH", 2 + 4, 0x1004) + b"\x01\x02\x03\x04",  # short established
+            struct.pack(">IH", 2, 0x9999),      # unknown type, empty payload
+            bytes(range(256)),                  # binary noise
+        ]
+        for payload in attacks:
+            with socket.create_connection(("127.0.0.1", master.port),
+                                          timeout=5) as s:
+                s.sendall(payload)
+                s.settimeout(0.3)
+                try:
+                    s.recv(256)
+                except (TimeoutError, OSError):
+                    pass
+        # instant connect+close probes (the accept-race regression shape)
+        for _ in range(20):
+            socket.create_connection(("127.0.0.1", master.port), timeout=5).close()
+
+        base = _next_port(32)
+        comm = Communicator("127.0.0.1", master.port, p2p_port=base,
+                            ss_port=base + 4, bench_port=base + 8)
+        comm.connect()  # master must still be alive and sane
+        assert comm.world_size == 1
+        x = np.ones(16, np.float32)
+        try:
+            comm.all_reduce(x, x, op=ReduceOp.SUM)
+        except Exception:  # noqa: BLE001 — solo reduce returns TooFewPeers
+            pass
+        comm.destroy()
+    finally:
+        master.interrupt()
+        master.destroy()
+
+
+def test_quantized_churn_recovery(master):
+    """SIGKILL a peer mid-run while the group reduces over the QUANTIZED
+    wire path: the abort/restore machinery must recover it exactly like the
+    fp32 path (quantized sends ride scratch buffers with their own restore
+    semantics, so churn coverage is separate)."""
+    base = _next_port(64)
+    peers = [PeerProc(master.port, r, base + r * 16, steps=25, min_world=3,
+                      step_interval=0.2, quantize="minmax")
+             for r in range(3)]
+    try:
+        assert peers[2].wait_for_step(4), f"peer2 stalled: {peers[2].lines[-5:]}"
+        peers[2].kill()
+        assert peers[0].join() == 0, f"peer0 failed: {peers[0].lines[-10:]}"
+        assert peers[1].join() == 0, f"peer1 failed: {peers[1].lines[-10:]}"
+        assert peers[0].last_world() == 2
+        assert peers[1].last_world() == 2
+    finally:
+        for p in peers:
+            p.kill()
