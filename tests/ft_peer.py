@@ -35,6 +35,8 @@ def main() -> int:
     ap.add_argument("--count", type=int, default=4096)
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none",
                     help="exercise the quantized wire path under churn")
+    ap.add_argument("--peer-group", type=int, default=0,
+                    help="collectives/shared-state partition (grid pattern)")
     ap.add_argument("--step-interval", type=float, default=0.0,
                     help="sleep between steps (paces incumbents so churn "
                          "events land mid-run)")
@@ -66,7 +68,8 @@ def main() -> int:
         while True:
             c = Communicator("127.0.0.1", args.master_port,
                              p2p_port=args.base_port, ss_port=args.base_port + 4,
-                             bench_port=args.base_port + 8)
+                             bench_port=args.base_port + 8,
+                             peer_group=args.peer_group)
             try:
                 c.connect()
                 return c

@@ -336,3 +336,33 @@ def test_quantized_churn_recovery(master):
     finally:
         for p in peers:
             p.kill()
+
+
+def test_peer_group_isolation_under_churn(master):
+    """Grid pattern under churn: killing a peer in group 0 must not disturb
+    group 1 — its peers keep reducing over their own 2-world while group
+    0's survivor degrades to solo (collectives and aborts are group-scoped;
+    only membership/topology rounds are global)."""
+    base = _next_port(96)
+    g0 = [PeerProc(master.port, r, base + r * 16, steps=30, min_world=2,
+                   step_interval=0.2, peer_group=0) for r in range(2)]
+    g1 = [PeerProc(master.port, 2 + r, base + 32 + r * 16, steps=30,
+                   min_world=2, step_interval=0.2, peer_group=1)
+          for r in range(2)]
+    try:
+        assert g0[0].wait_for_step(4), f"g0 stalled: {g0[0].lines[-5:]}"
+        assert g1[0].wait_for_step(4), f"g1 stalled: {g1[0].lines[-5:]}"
+        g0[1].kill()
+        # group 1 completes at full strength; group 0's survivor finishes.
+        # EVERY group-1 step must be world=2: a transient drop would mean
+        # group 0's churn leaked across the group boundary.
+        for p in g1:
+            assert p.join() == 0, f"group-1 peer failed: {p.lines[-10:]}"
+            worlds = {ln.split("world=")[1].split()[0]
+                      for ln in p.lines if ln.startswith("STEP ")}
+            assert worlds == {"2"}, f"group-1 disturbed: worlds={worlds}"
+        assert g0[0].join() == 0, f"group-0 survivor failed: {g0[0].lines[-10:]}"
+        assert g0[0].last_world() == 1
+    finally:
+        for p in g0 + g1:
+            p.kill()
