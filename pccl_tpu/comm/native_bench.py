@@ -227,7 +227,7 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
 
 
 def run_diloco_outer_bench(world: int = 2, params_n: int = 100_000_000,
-                           outer_steps: int = 3) -> float:
+                           outer_steps: int = 5) -> float:
     """DiLoCo outer-step wall-clock (device staging + AVG ring + outer SGD)
     at `params_n` parameters; returns median outer-step seconds."""
     res = _spawn_world(world, _peer_diloco,

@@ -140,7 +140,8 @@ def main() -> int:
         except (KickedError, MasterUnreachableError):
             comm = rejoin(comm)
             continue
-        except (ConnectionLostError, OperationAbortedError):
+        except (ConnectionLostError, OperationAbortedError) as e:
+            print(f"RETRY step={step} cause={type(e).__name__}", flush=True)
             try:
                 comm.update_topology()
             except master_loss:

@@ -118,10 +118,12 @@ def test_nanogpt_ddp_chars_convergence():
 
 
 def test_sync_diloco_chars_convergence():
+    # --shm-staging: the real-training loop also exercises the registered
+    # zero-copy transport (peers share this host)
     outs = _run_example(
         REPO / "examples" / "nanogpt_diloco" / "sync_diloco.py", 2,
         ["--data", "text", "--outer-steps", "5", "--inner-steps", "10",
-         "--batch", "8", "--inner-lr", "3e-3"])
+         "--batch", "8", "--inner-lr", "3e-3", "--shm-staging"])
     for out in outs:
         first, last = _final_losses(out)
         # first_loss is captured after warmup inside the first outer round,

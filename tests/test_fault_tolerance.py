@@ -54,11 +54,19 @@ class PeerProc:
             time.sleep(0.05)
         return False
 
+    @staticmethod
+    def _world_of(line: str) -> int:
+        return int(line.split("world=")[1].split()[0])
+
     def last_world(self) -> int:
         for ln in reversed(self.lines):
             if ln.startswith("STEP "):
-                return int(ln.split("world=")[1].split()[0])
+                return self._world_of(ln)
         return -1
+
+    def worlds(self) -> set[int]:
+        return {self._world_of(ln) for ln in self.lines
+                if ln.startswith("STEP ")}
 
     def kill(self) -> None:
         if self.proc.poll() is None:
@@ -339,10 +347,12 @@ def test_quantized_churn_recovery(master):
 
 
 def test_peer_group_isolation_under_churn(master):
-    """Grid pattern under churn: killing a peer in group 0 must not disturb
-    group 1 — its peers keep reducing over their own 2-world while group
-    0's survivor degrades to solo (collectives and aborts are group-scoped;
-    only membership/topology rounds are global)."""
+    """Grid pattern under churn: killing a peer in group 0 must not change
+    group 1's WORLD — every group-1 step completes over its own 2-world
+    while group 0's survivor degrades to solo. (Membership/topology rounds
+    are global, so group-1 ops may transiently retry during the
+    re-establish; what must never leak across groups is the world
+    accounting this asserts.)"""
     base = _next_port(96)
     g0 = [PeerProc(master.port, r, base + r * 16, steps=30, min_world=2,
                    step_interval=0.2, peer_group=0) for r in range(2)]
@@ -358,9 +368,7 @@ def test_peer_group_isolation_under_churn(master):
         # group 0's churn leaked across the group boundary.
         for p in g1:
             assert p.join() == 0, f"group-1 peer failed: {p.lines[-10:]}"
-            worlds = {ln.split("world=")[1].split()[0]
-                      for ln in p.lines if ln.startswith("STEP ")}
-            assert worlds == {"2"}, f"group-1 disturbed: worlds={worlds}"
+            assert p.worlds() == {2}, f"group-1 disturbed: {p.worlds()}"
         assert g0[0].join() == 0, f"group-0 survivor failed: {g0[0].lines[-10:]}"
         assert g0[0].last_world() == 1
     finally:
