@@ -28,13 +28,15 @@ pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
 class PeerProc:
     """Subprocess peer with a live stdout line buffer."""
 
-    def __init__(self, master_port: int, rank: int, base_port: int, **kw):
+    def __init__(self, master_port: int, rank: int, base_port: int,
+                 env: dict | None = None, **kw):
         cmd = [sys.executable, str(PEER), "--master-port", str(master_port),
                "--rank", str(rank), "--base-port", str(base_port)]
         for k, v in kw.items():
             cmd += [f"--{k.replace('_', '-')}", str(v)]
         self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                     stderr=subprocess.STDOUT, text=True)
+                                     stderr=subprocess.STDOUT, text=True,
+                                     env=env)
         self.lines: list[str] = []
         self._t = threading.Thread(target=self._pump, daemon=True)
         self._t.start()

@@ -233,3 +233,26 @@ def test_windowed_avg_reduce(master):
     _run_peers(master.port, 2, worker, _ports(4))
     assert np.array_equal(results[0], results[1])
     np.testing.assert_allclose(results[0], expect, rtol=1e-6)
+
+
+def test_pure_tcp_path_cma_disabled(master):
+    """PCCLT_CMA=0 forces the WAN wire path (chunked TCP streaming into
+    registered sinks, no same-host shortcuts) even on loopback — the ring
+    must produce correct results there too. This is the only loopback-CI
+    coverage the real cross-host path gets."""
+    import os
+
+    from test_fault_tolerance import PeerProc
+
+    base = _ports(4)
+    env = {**os.environ, "PCCLT_CMA": "0"}
+    peers = [PeerProc(master.port, r, base + r * 16, env=env, steps=6,
+                      min_world=2, count=(4 << 20) // 4 + 333)  # multi-chunk
+             for r in range(2)]
+    try:
+        for p in peers:
+            assert p.join() == 0, f"pure-TCP peer failed: {p.lines[-10:]}"
+            assert p.wait_for_step(5), f"did not finish: {p.lines[-5:]}"
+    finally:
+        for p in peers:
+            p.kill()
