@@ -151,6 +151,13 @@ def _declare(lib):
     lib.pccltHashBuffer.restype = c.c_uint64
     lib.pccltHashBuffer.argtypes = [c.c_int, c.c_void_p, c.c_uint64]
 
+    lib.pccltAllGather.restype = c.c_int
+    lib.pccltAllGather.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                   c.c_uint64, c.c_uint64, c.c_int,
+                                   c.c_uint64, P(ReduceInfo)]
+    lib.pccltGatherSlot.restype = c.c_int
+    lib.pccltGatherSlot.argtypes = [c.c_void_p, P(c.c_uint64)]
+
     lib.pccltShmAlloc.restype = c.c_int
     lib.pccltShmAlloc.argtypes = [c.c_uint64, P(c.c_void_p)]
     lib.pccltShmFree.restype = c.c_int

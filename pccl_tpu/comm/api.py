@@ -526,6 +526,44 @@ class Communicator:
         _check(code, "all_reduce")
         return ReduceInfo(info.tx_bytes, info.rx_bytes, info.world_size)
 
+    def all_gather(self, send, recv=None, *, tag: int = 0) -> tuple:
+        """Ring all-gather (pcclt extension; the reference lists All-Gather
+        as unshipped roadmap work). Every peer contributes `send`; returns
+        (recv, ReduceInfo) where segment i belongs to the peer at sorted-
+        uuid position i (stable across ring re-orderings; your own index is
+        `gather_slot`). recv=None allocates (world_size, *send.shape); a
+        caller-provided recv must be a writable C-contiguous array of
+        send's dtype with capacity >= world_size * send.size. The native
+        side re-checks capacity against the commence-time world, so a
+        joiner admitted mid-call aborts the op instead of overflowing."""
+        send = np.ascontiguousarray(send)
+        world = self.world_size
+        if recv is None:
+            recv = np.empty((world,) + send.shape, dtype=send.dtype)
+        if recv.dtype != send.dtype:
+            raise ValueError(f"recv dtype {recv.dtype} != send {send.dtype}")
+        if not recv.flags["C_CONTIGUOUS"] or not recv.flags["WRITEABLE"]:
+            raise ValueError("recv must be writable and C-contiguous")
+        if recv.size < world * send.size:
+            raise ValueError(f"recv capacity {recv.size} < world*send "
+                             f"{world * send.size}")
+        info = _native.ReduceInfo()
+        code = self._lib.pccltAllGather(
+            self._h, send.ctypes.data_as(ctypes.c_void_p),
+            recv.ctypes.data_as(ctypes.c_void_p), send.size, recv.size,
+            int(_np_dtype_of(send)), tag, ctypes.byref(info))
+        _check(code, "all_gather")
+        return recv, ReduceInfo(info.tx_bytes, info.rx_bytes, info.world_size)
+
+    @property
+    def gather_slot(self) -> int:
+        """This peer's segment index in all_gather output (position among
+        the current ring's sorted peer UUIDs; re-query after churn)."""
+        slot = ctypes.c_uint64()
+        _check(self._lib.pccltGatherSlot(self._h, ctypes.byref(slot)),
+               "gather_slot")
+        return int(slot.value)
+
     def all_reduce_async(self, send, recv=None, *, op: ReduceOp = ReduceOp.SUM,
                          tag: Optional[int] = None,
                          quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,

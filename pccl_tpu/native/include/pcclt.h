@@ -169,6 +169,22 @@ PCCLT_EXPORT pccltResult_t pccltAllReduceMultipleWithRetry(
     const uint64_t *counts, pccltDataType_t dtype,
     const pccltReduceDescriptor_t *descs, uint64_t n_ops, pccltReduceInfo_t *infos);
 
+/* Ring all-gather (pcclt extension; the reference lists All-Gather as
+ * unshipped roadmap work). Each peer contributes send_count elements;
+ * recvbuf (capacity >= world * send_count) receives every peer's segment,
+ * ordered by SORTED peer UUID — stable across ring re-orderings. tag
+ * semantics match pccltAllReduce; quantization is not applicable. */
+PCCLT_EXPORT pccltResult_t pccltAllGather(pccltComm_t *c, const void *sendbuf,
+                                          void *recvbuf, uint64_t send_count,
+                                          uint64_t recv_capacity,
+                                          pccltDataType_t dtype, uint64_t tag,
+                                          pccltReduceInfo_t *info);
+
+/* This peer's segment index in pccltAllGather output (its position among
+ * the current ring's SORTED peer UUIDs). Valid for the current topology;
+ * re-query after churn. */
+PCCLT_EXPORT pccltResult_t pccltGatherSlot(pccltComm_t *c, uint64_t *slot);
+
 PCCLT_EXPORT pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c,
                                                        pccltSharedState_t *state,
                                                        pccltSyncStrategy_t strategy,

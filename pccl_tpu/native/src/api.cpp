@@ -178,11 +178,16 @@ pccltResult_t pccltOptimizeTopology(pccltComm_t *c) {
     return to_result(c->client->optimize_topology());
 }
 
+// RedOp::kGather (5) is deliberately NOT reachable through the reduce
+// descriptor: its recv sizing differs (world*count), and only
+// pccltAllGather — which carries recv_capacity — may select it.
+static bool valid_reduce_op(const pccltReduceDescriptor_t *d) { return d->op <= 4; }
+
 pccltResult_t pccltAllReduce(pccltComm_t *c, const void *sendbuf, void *recvbuf,
                              uint64_t count, pccltDataType_t dtype,
                              const pccltReduceDescriptor_t *desc,
                              pccltReduceInfo_t *info) {
-    if (!c || !desc) return pccltInvalidArgument;
+    if (!c || !desc || !valid_reduce_op(desc)) return pccltInvalidArgument;
     pcclt::client::ReduceInfo ri;
     auto st = c->client->all_reduce(sendbuf, recvbuf, count, to_dtype(dtype),
                                     to_desc(desc), &ri);
@@ -190,10 +195,31 @@ pccltResult_t pccltAllReduce(pccltComm_t *c, const void *sendbuf, void *recvbuf,
     return to_result(st);
 }
 
+pccltResult_t pccltAllGather(pccltComm_t *c, const void *sendbuf, void *recvbuf,
+                             uint64_t send_count, uint64_t recv_capacity,
+                             pccltDataType_t dtype, uint64_t tag,
+                             pccltReduceInfo_t *info) {
+    if (!c) return pccltInvalidArgument;
+    pcclt::client::ReduceDesc d;
+    d.tag = tag;
+    d.op = pcclt::proto::RedOp::kGather;
+    d.recv_capacity = recv_capacity;
+    pcclt::client::ReduceInfo ri;
+    auto st = c->client->all_reduce(sendbuf, recvbuf, send_count,
+                                    to_dtype(dtype), d, &ri);
+    fill_info(info, ri);
+    return to_result(st);
+}
+
+pccltResult_t pccltGatherSlot(pccltComm_t *c, uint64_t *slot) {
+    if (!c || !slot) return pccltInvalidArgument;
+    return to_result(c->client->gather_slot(slot));
+}
+
 pccltResult_t pccltAllReduceAsync(pccltComm_t *c, const void *sendbuf, void *recvbuf,
                                   uint64_t count, pccltDataType_t dtype,
                                   const pccltReduceDescriptor_t *desc) {
-    if (!c || !desc) return pccltInvalidArgument;
+    if (!c || !desc || !valid_reduce_op(desc)) return pccltInvalidArgument;
     return to_result(
         c->client->all_reduce_async(sendbuf, recvbuf, count, to_dtype(dtype), to_desc(desc)));
 }
@@ -213,6 +239,8 @@ pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const 
                                               const pccltReduceDescriptor_t *descs,
                                               uint64_t n_ops, pccltReduceInfo_t *infos) {
     if (!c || !sendbufs || !recvbufs || !counts || !descs) return pccltInvalidArgument;
+    for (uint64_t i = 0; i < n_ops; ++i)
+        if (!valid_reduce_op(&descs[i])) return pccltInvalidArgument;
     std::vector<bool> done(n_ops, false);
     while (true) {
         // launch all outstanding ops, await them, retry failures with the

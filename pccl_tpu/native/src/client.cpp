@@ -532,6 +532,18 @@ Status Client::optimize_topology() {
 
 // ---------------- conn lookup ----------------
 
+Status Client::gather_slot(uint64_t *slot) {
+    if (!connected_.load()) return Status::kNotConnected;
+    std::lock_guard lk(state_mu_);
+    if (ring_.empty()) return Status::kInvalid;
+    std::vector<proto::Uuid> sorted = ring_;
+    std::sort(sorted.begin(), sorted.end());
+    auto it = std::find(sorted.begin(), sorted.end(), uuid_);
+    if (it == sorted.end()) return Status::kInternal;
+    *slot = static_cast<uint64_t>(it - sorted.begin());
+    return Status::kOk;
+}
+
 net::Link Client::tx_link(const proto::Uuid &peer) {
     std::lock_guard lk(state_mu_);
     auto it = peers_.find(peer);
@@ -561,6 +573,9 @@ Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
                                 proto::DType dtype, const ReduceDesc &desc) {
     if (!connected_.load()) return Status::kNotConnected;
     if (!send || !recv || count == 0) return Status::kInvalid;
+    // gather forwards verbatim: quantization has no meaning on this op
+    if (desc.op == proto::RedOp::kGather && desc.quant != proto::QuantAlgo::kNone)
+        return Status::kInvalid;
     if (group_world() < 2) return Status::kTooFewPeers;
     {
         std::lock_guard lk(ops_mu_);
@@ -697,7 +712,32 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
             if (consume_abort(true) && verdict_aborted) return true;
             return false;
         };
-        auto res = reduce::ring_allreduce(ctx, send, recv, count);
+        reduce::Result res;
+        if (desc.op == proto::RedOp::kGather &&
+            static_cast<uint64_t>(world) * count > desc.recv_capacity) {
+            // membership grew between the caller sizing recv and commence:
+            // fail OUR leg through the normal complete/abort protocol (a
+            // silent overflow or a unilateral bail would wedge the group).
+            // Retire the op's tag range so peers' in-flight sends to us get
+            // ack-dropped instead of waiting out the conn teardown.
+            const uint64_t base_tag = seq << 16;
+            rx.table().purge_range(base_tag, base_tag + 0x10000);
+            res = reduce::Result::kAborted;
+        } else if (desc.op == proto::RedOp::kGather) {
+            // all-gather: segment order is by SORTED peer uuid (ring
+            // positions reshuffle across topology rounds and would leak
+            // that instability into the user-visible layout)
+            std::vector<proto::Uuid> sorted = ring;
+            std::sort(sorted.begin(), sorted.end());
+            ctx.slots.resize(world);
+            for (uint32_t i = 0; i < world; ++i)
+                ctx.slots[i] = static_cast<uint32_t>(
+                    std::find(sorted.begin(), sorted.end(), ring[i]) -
+                    sorted.begin());
+            res = reduce::ring_allgather(ctx, send, recv, count);
+        } else {
+            res = reduce::ring_allreduce(ctx, send, recv, count);
+        }
         give_scratch(std::move(scratch));
         op->info.tx_bytes = ctx.tx_bytes;
         op->info.rx_bytes = ctx.rx_bytes;
@@ -730,9 +770,11 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
                 (unsigned long long)seq);
 
     if (st == Status::kOk && verdict_aborted) {
-        // we finished the ring, but the op was aborted group-wide: restore the
-        // input so every rank retries from identical buffers
-        memcpy(recv, snapshot.empty() ? send : snapshot.data(), nbytes);
+        // we finished the ring, but the op was aborted group-wide: restore
+        // the input so every rank retries from identical buffers (gather
+        // never reduces in place — a retry simply rewrites every segment)
+        if (desc.op != proto::RedOp::kGather)
+            memcpy(recv, snapshot.empty() ? send : snapshot.data(), nbytes);
         st = Status::kAborted;
     }
     return st;

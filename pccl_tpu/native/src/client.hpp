@@ -55,6 +55,12 @@ struct ReduceDesc {
     proto::RedOp op = proto::RedOp::kSum;
     proto::QuantAlgo quant = proto::QuantAlgo::kNone;
     proto::DType quant_dtype = proto::DType::kU8;
+    // gather only (client-side, not on the wire): recv capacity in
+    // ELEMENTS. The commence-time world can exceed the world the caller
+    // sized recv for (a pending joiner admitted in between); the worker
+    // fails the op through the normal abort protocol instead of writing
+    // world*count elements past the buffer.
+    uint64_t recv_capacity = ~0ull;
 };
 
 struct ReduceInfo {
@@ -85,6 +91,9 @@ public:
 
     Status update_topology();
     Status are_peers_pending(bool &pending);
+    // own segment index in all-gather output: position among the current
+    // ring's sorted peer uuids (re-query after churn)
+    Status gather_slot(uint64_t *slot);
     Status optimize_topology();
 
     Status all_reduce_async(const void *send, void *recv, uint64_t count,

@@ -83,7 +83,10 @@ enum class DType : uint8_t {
 };
 size_t dtype_size(DType d);
 
-enum class RedOp : uint8_t { kSum = 0, kAvg, kProd, kMax, kMin };
+// kGather: not a reduction — the all-gather collective rides the same
+// consensus/abort machinery with this op id (pcclt extension; the
+// reference lists All-Gather as unshipped roadmap work)
+enum class RedOp : uint8_t { kSum = 0, kAvg, kProd, kMax, kMin, kGather };
 enum class QuantAlgo : uint8_t { kNone = 0, kMinMax, kZeroPointScale };
 enum class SyncStrategy : uint8_t { kEnforcePopular = 0, kRxOnly, kTxOnly };
 

@@ -412,4 +412,60 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     return Result::kOk;
 }
 
+Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) {
+    const size_t esz = proto::dtype_size(ctx.dtype);
+    const uint32_t world = ctx.world, rank = ctx.rank;
+    const size_t seg = count * esz;
+    auto *out = static_cast<uint8_t *>(recv);
+    auto slot = [&](uint32_t ring_rank) -> size_t {
+        return ctx.slots.empty() ? ring_rank : ctx.slots[ring_rank];
+    };
+    // own segment lands at its slot regardless of world size
+    if (out + slot(rank) * seg != send)
+        kernels::copy_stream(out + slot(rank) * seg, send, seg);
+    if (world < 2) return Result::kOk;
+
+    const uint64_t base_tag = ctx.op_seq << 16;
+    auto fail = [&](bool conn_lost) {
+        // no restore: the gather only writes recv, and a retry overwrites
+        // every segment — but sinks must not outlive this frame's buffers
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+        return conn_lost ? Result::kConnectionLost : Result::kAborted;
+    };
+    // stage s receives the segment of ring rank (rank - s - 1); register one
+    // stage ahead so symmetric peers never race registration (same protocol
+    // as the all-reduce's gather phase)
+    auto reg_stage = [&](uint32_t s) {
+        if (s >= world - 1) return;
+        const uint32_t src_rank = (rank + world - s - 1) % world;
+        ctx.rx.table().register_sink(base_tag | s, out + slot(src_rank) * seg,
+                                     seg, /*consumer_pull=*/true);
+    };
+    reg_stage(0);
+    for (uint32_t s = 0; s + 1 < world; ++s) {
+        const uint64_t tag = base_tag | s;
+        const uint32_t fwd_rank = (rank + world - s) % world; // own at s=0
+        const uint8_t *src = s == 0 ? static_cast<const uint8_t *>(send)
+                                    : out + slot(fwd_rank) * seg;
+        auto tx_job = ctx.tx.send_async(tag, {src, seg}, ctx.op_seq);
+        ctx.tx_bytes += seg;
+        const uint32_t src_rank = (rank + world - s - 1) % world;
+        uint8_t *dst = out + slot(src_rank) * seg;
+        reg_stage(s + 1);
+        bool ok = stream_recv(ctx, tag, seg, esz, dst,
+                              [&](const uint8_t *p, size_t lo, size_t hi) {
+                                  if (p != dst + lo)
+                                      kernels::copy_stream(dst + lo, p, hi - lo);
+                              }, nullptr, /*fill_if_unmapped=*/true);
+        ctx.rx.table().unregister_sink(tag);
+        bool tx_ok = net::Link::wait_all(tx_job);
+        if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
+        ctx.rx_bytes += seg;
+    }
+    ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+    ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    return Result::kOk;
+}
+
 } // namespace pcclt::reduce

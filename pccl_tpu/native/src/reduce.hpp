@@ -36,8 +36,20 @@ struct RingCtx {
     // client keeps a reuse pool and lends a buffer for the op's lifetime
     std::vector<uint8_t> *scratch = nullptr;
     uint64_t tx_bytes = 0, rx_bytes = 0;
+    // all-gather only: destination slot per ring position (stable ordering
+    // by sorted peer uuid — ring positions reshuffle across topology
+    // rounds, so they cannot define the user-visible segment order)
+    std::vector<uint32_t> slots;
 };
 
 Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count);
+
+// Ring all-gather: each peer contributes `count` elements; `recv`
+// (capacity world*count) ends with every peer's segment at
+// slots[ring_rank]. Forward-only (no reduction, no quantization); the
+// reference lists All-Gather as unshipped roadmap work
+// (docs/md/04-API Overview/01_PCCL_API_Overview.md:176-177), so this is a
+// pcclt extension built on the same consensus + tag machinery.
+Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count);
 
 } // namespace pcclt::reduce

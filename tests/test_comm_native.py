@@ -207,3 +207,31 @@ def test_errors():
     with pytest.raises(PcclError):
         comm2.all_reduce(np.zeros(4, dtype=np.float32))  # not connected
     comm2.destroy()
+
+
+def test_all_gather_three_peers(master):
+    """Ring all-gather (pcclt extension): every peer ends with all three
+    segments, ordered identically everywhere (sorted peer uuid), including
+    a large multi-chunk segment size."""
+    count = (1 << 20) + 77  # > CMA threshold: exercises the descriptor path
+    results = {}
+
+    def worker(comm, rank):
+        x = np.full(count, float(rank + 1), dtype=np.float32)
+        out, info = comm.all_gather(x)
+        assert info.world_size == 3
+        # own segment must sit at gather_slot
+        assert float(out[comm.gather_slot][0]) == float(rank + 1)
+        results[rank] = np.array(out)
+
+    _run_peers(master.port, 3, worker, _ports(6))
+    base = results[0]
+    assert base.shape == (3, count)
+    # all peers agree bitwise on the same ordering
+    for r in (1, 2):
+        assert np.array_equal(base, results[r]), f"rank {r} ordering differs"
+    # the multiset of segments is exactly the three contributions
+    seen = sorted(float(base[i][0]) for i in range(3))
+    assert seen == [1.0, 2.0, 3.0]
+    for i in range(3):
+        assert np.all(base[i] == base[i][0]), "segment interior corrupted"
