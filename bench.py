@@ -60,6 +60,15 @@ def main() -> None:
                 print(f"bench: {key} failed ({type(e).__name__}: {e})",
                       file=sys.stderr)
                 extra[key] = None
+        # the constrained-wire A/B: quantization's reason to exist. 4-peer
+        # ring over an emulated 100 Mbit/s WAN egress (PCCLT_WIRE_MBPS),
+        # fp32 vs u8-ZPS, both reported as fp32-equivalent busbw.
+        try:
+            for k, v in native_bench.run_wan_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: wan failed ({type(e).__name__}: {e})", file=sys.stderr)
+            extra["wan_quant_speedup"] = None
 
     print(json.dumps({
         "metric": f"allreduce_busbw_fp32_2peer_loopback({path})",

@@ -226,6 +226,59 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
     comm.destroy()
 
 
+def _peer_wan(rank, master_port, q, world, nbytes, iters, quantize, port_base):
+    from pccl_tpu.comm.api import DataType, QuantizationAlgorithm, ReduceOp
+
+    comm = _connect(rank, master_port, world, port_base)
+    rng = np.random.default_rng(7 + rank)
+    x = rng.standard_normal(nbytes // 4).astype(np.float32)
+    y = np.empty_like(x)
+    kw = {}
+    if quantize:
+        kw = dict(quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+                  quantized_dtype=DataType.UINT8)
+    comm.all_reduce(x, y, op=ReduceOp.AVG, **kw)  # warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        comm.all_reduce(x, y, op=ReduceOp.AVG, **kw)
+        times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
+                  mbps: float = 100.0) -> Dict[str, float]:
+    """The constrained-wire A/B that justifies quantization's existence
+    (reference WAN pitch: docs/md/01_Introduction.md:8). Runs the same
+    ``world``-peer AVG ring twice over an emulated ``mbps``-megabit wire
+    (PCCLT_WIRE_MBPS egress pacing; CMA/shm force-disabled): once fp32,
+    once u8 zero-point/scale. Returns fp32-equivalent busbw GB/s for both
+    — 2*(N-1)/N * fp32_bytes / t, i.e. "how fast the logical gradient
+    moved" — plus the speedup ratio."""
+    out: Dict[str, float] = {}
+    old = os.environ.get("PCCLT_WIRE_MBPS")
+    os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
+    try:
+        for name, quant, mport, base in (
+                ("wan_fp32_busbw_gbps", False, 48671, 49100),
+                ("wan_u8zps_busbw_gbps", True, 48673, 49300)):
+            res = _spawn_world(world, _peer_wan,
+                               _port("PCCLT_BENCH_MASTER_PORT_WAN", mport),
+                               (world, nbytes, iters, quant, base),
+                               inline_rank0=False)
+            times = next(r["times"] for r in res if r["rank"] == 0)
+            med = sorted(times)[len(times) // 2]
+            out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
+    finally:
+        if old is None:
+            os.environ.pop("PCCLT_WIRE_MBPS", None)
+        else:
+            os.environ["PCCLT_WIRE_MBPS"] = old
+    out["wan_quant_speedup"] = out["wan_u8zps_busbw_gbps"] / out["wan_fp32_busbw_gbps"]
+    return out
+
+
 def run_diloco_outer_bench(world: int = 2, params_n: int = 100_000_000,
                            outer_steps: int = 5) -> float:
     """DiLoCo outer-step wall-clock (device staging + AVG ring + outer SGD)

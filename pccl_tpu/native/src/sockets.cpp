@@ -673,6 +673,85 @@ bool cma_enabled_env() {
     return !(e && e[0] == '0');
 }
 
+// --- emulated-WAN pacing ------------------------------------------------
+// PCCLT_WIRE_MBPS=<megabits/s> models the peer's NIC egress rate: a
+// process-global leaky bucket over every multiplexer TCP write. This exists
+// to validate the library's reason-to-be — on-the-wire compression for
+// bandwidth-constrained WANs (reference: docs/md/01_Introduction.md:8) — on
+// a loopback host where the wire is otherwise free. Semantics:
+//  * global, not per-conn: Link striping across a conn pool cannot
+//    manufacture bandwidth, and in a ring each peer's egress IS its link
+//  * reservation-based: a writer reserves [next, next+cost) under the
+//    bucket lock, then sleeps until its slot OUTSIDE the lock (holding its
+//    conn's wr_mu_, which is correct — that conn's wire is serial)
+//  * no burst credit: idle time does not accumulate (next never lags now)
+//  * same-host zero-copy transports (CMA, registered shm) are force-
+//    disabled while pacing: an emulated WAN cannot bypass the wire
+class WirePacer {
+public:
+    static WirePacer &inst() {
+        static WirePacer p;
+        return p;
+    }
+    bool enabled() const { return ns_per_byte_.load(std::memory_order_relaxed) > 0; }
+    // Re-read PCCLT_WIRE_MBPS; called per conn construction so a process
+    // that flips the env between connections (tests, bench legs) gets the
+    // new rate without a restart.
+    void refresh() {
+        double npb = 0;
+        if (const char *e = std::getenv("PCCLT_WIRE_MBPS")) {
+            double mbps = atof(e);
+            if (mbps > 0) npb = 8000.0 / mbps;
+        }
+        ns_per_byte_.store(npb, std::memory_order_relaxed);
+    }
+    void pace(size_t bytes) {
+        double npb = ns_per_byte_.load(std::memory_order_relaxed);
+        if (npb <= 0) return;
+        uint64_t end;
+        {
+            std::lock_guard lk(mu_);
+            uint64_t now = mono_ns();
+            // reserve the transmission slot [start, end) and sleep until the
+            // frame has fully drained — a sender cannot complete a send
+            // faster than the wire carries it (no first-frame burst credit)
+            uint64_t start = std::max(next_ns_, now);
+            end = start + static_cast<uint64_t>(
+                static_cast<double>(bytes) * npb);
+            next_ns_ = end;
+        }
+        // small frames (ctl, quant metadata) charge the bucket but may run a
+        // bounded window ahead of the wire: a real qdisc interleaves a
+        // sub-MTU packet ~one chunk behind the current queue, not the full
+        // depth. The bound matters — traffic composed ENTIRELY of small
+        // frames (tiny chunk sizes, tiny tensors) must still be throttled,
+        // so beyond the window small frames pace like everything else.
+        if (bytes <= 4096) {
+            constexpr uint64_t kAheadNs = 40'000'000; // ~2 chunk-times @ 100 Mbit
+            if (end <= mono_ns() + kAheadNs) return;
+            end -= kAheadNs;
+        }
+        for (uint64_t now = mono_ns(); now < end; now = mono_ns()) {
+            uint64_t gap = end - now;
+            struct timespec ts{static_cast<time_t>(gap / 1000000000ull),
+                               static_cast<long>(gap % 1000000000ull)};
+            nanosleep(&ts, nullptr);
+        }
+    }
+
+private:
+    WirePacer() { refresh(); }
+    static uint64_t mono_ns() {
+        struct timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+               static_cast<uint64_t>(ts.tv_nsec);
+    }
+    std::atomic<double> ns_per_byte_{0};
+    uint64_t next_ns_ = 0;
+    std::mutex mu_;
+};
+
 constexpr size_t kRxSlice = 256 << 10;  // TCP sink write slice (cancel latency)
 constexpr uint32_t kMaxDataFrame = 272u << 20;
 
@@ -693,6 +772,12 @@ MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
       table_(table ? std::move(table) : std::make_shared<SinkTable>()) {
     tx_chunk_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
     cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
+    WirePacer::inst().refresh();
+    // under pacing, cap the wire chunk: a streamed receiver consumes as
+    // frames land, and at WAN rates an 8 MB frame is ~60 ms of pipeline
+    // stall before the first byte of a ring slice can be reduced
+    if (WirePacer::inst().enabled())
+        tx_chunk_ = std::min(tx_chunk_, size_t{256} << 10);
 }
 
 MultiplexConn::~MultiplexConn() {
@@ -710,7 +795,8 @@ MultiplexConn::~MultiplexConn() {
 
 void MultiplexConn::run() {
     alive_ = true;
-    cma_ok_ = cma_enabled_env() && sock_.peer_is_loopback();
+    cma_ok_ = cma_enabled_env() && !WirePacer::inst().enabled() &&
+              sock_.peer_is_loopback();
     sock_.set_quickack();
     table_->attach(shared_from_this());
     if (cma_ok_.load()) {
@@ -812,6 +898,11 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
     hdr[4] = static_cast<uint8_t>(kind);
     memcpy(hdr + 5, &be_tag, 8);
     memcpy(hdr + 13, &be_off, 8);
+    // pace BEFORE taking wr_mu_: the sleep must only delay this writer, not
+    // head-of-line-block other frames on the conn. Reordering is safe —
+    // within a tag only one thread streams (offsets carried per frame), and
+    // the order-sensitive shm announce path is disabled under pacing.
+    WirePacer::inst().pace(21 + payload.size());
     std::lock_guard lk(wr_mu_);
     return sock_.send_all2(hdr, 21, payload.data(), payload.size());
 }

@@ -546,6 +546,32 @@ void test_bench_probe() {
     fprintf(stderr, "bench probe: ok\n");
 }
 
+void test_wire_pacing() {
+    // PCCLT_WIRE_MBPS throttles egress to the emulated rate and must defeat
+    // the same-host zero-copy transports (a WAN cannot be bypassed). Rate is
+    // re-read per conn construction, so setting it here affects this pair.
+    setenv("PCCLT_WIRE_MBPS", "200", 1); // 25 MB/s
+    auto p = make_pair_conns();
+    CHECK(!p.a->cma_eligible()); // pacing forces the TCP wire path
+    const size_t n = 4 * 1024 * 1024;
+    auto data = pattern(n, 23);
+    std::vector<uint8_t> dst(n, 0);
+    p.b->table().register_sink(30, dst.data(), n);
+    auto t0 = std::chrono::steady_clock::now();
+    CHECK(p.a->send_bytes(30, data, /*allow_cma=*/true));
+    CHECK(p.b->table().wait_filled(30, n, 10'000) == n);
+    double s = std::chrono::duration<double>(
+                   std::chrono::steady_clock::now() - t0).count();
+    p.b->table().unregister_sink(30);
+    CHECK(dst == data);
+    // 4 MB at 25 MB/s = 160 ms minimum; loopback unpaced would be < 10 ms.
+    CHECK(s >= 0.140);
+    CHECK(s < 2.0); // and the pacer must not be wildly over-throttling
+    unsetenv("PCCLT_WIRE_MBPS");
+    auto q = make_pair_conns(); // refreshes the pacer off for later tests
+    fprintf(stderr, "wire pacing: ok (%.0f ms for 4 MB @ 25 MB/s)\n", s * 1e3);
+}
+
 } // namespace
 
 int main() {
@@ -560,6 +586,7 @@ int main() {
     test_mux_death_wakes_waiters();
     test_shm_zero_copy_paths();
     test_link_striping();
+    test_wire_pacing();
     test_bench_probe();
     if (failures) {
         fprintf(stderr, "SOCKTEST FAILED (%d checks)\n", failures);

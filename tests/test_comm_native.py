@@ -235,3 +235,41 @@ def test_all_gather_three_peers(master):
     assert seen == [1.0, 2.0, 3.0]
     for i in range(3):
         assert np.all(base[i] == base[i][0]), "segment interior corrupted"
+
+
+def test_wan_pacing_quantization_wins(master, monkeypatch):
+    """The library's reason to exist: on a bandwidth-constrained wire,
+    u8-ZPS quantization must beat fp32 (reference WAN pitch:
+    docs/md/01_Introduction.md:8). PCCLT_WIRE_MBPS emulates a slow egress
+    (process-global bucket — in-process peers share it, which preserves
+    the A/B ratio); CMA/shm are force-disabled so bytes really ride the
+    paced wire. Ratio-only assert: robust to host load."""
+    from pccl_tpu.comm import DataType, QuantizationAlgorithm, ReduceOp
+
+    monkeypatch.setenv("PCCLT_WIRE_MBPS", "200")  # 25 MB/s shared
+    count = 1 << 20  # 4 MB fp32
+    times = {}
+
+    def run(quantize):
+        def worker(comm, rank):
+            rng = np.random.default_rng(3 + rank)
+            x = rng.standard_normal(count).astype(np.float32)
+            y = np.empty_like(x)
+            kw = {}
+            if quantize:
+                kw = dict(quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+                          quantized_dtype=DataType.UINT8)
+            comm.all_reduce(x, y, op=ReduceOp.AVG, tag=31, **kw)  # warmup
+            t0 = time.perf_counter()
+            for _ in range(2):
+                comm.all_reduce(x, y, op=ReduceOp.AVG, tag=31, **kw)
+            if rank == 0:
+                times[quantize] = time.perf_counter() - t0
+
+        _run_peers(master.port, 2, worker, _ports(4))
+
+    run(False)
+    run(True)
+    speedup = times[False] / times[True]
+    assert speedup > 1.8, f"quantized ring only {speedup:.2f}x faster " \
+        f"(fp32 {times[False]:.2f}s vs u8 {times[True]:.2f}s) on the paced wire"
