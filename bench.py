@@ -52,7 +52,6 @@ def main() -> None:
             ("bf16_busbw_gbps", native_bench.run_allreduce_bench_bf16),
             ("quant4_busbw_gbps", native_bench.run_quantized_concurrent_bench),
             ("shared_state4_step_s", native_bench.run_shared_state_bench),
-            ("diloco_outer_step_s", native_bench.run_diloco_outer_bench),
         ]:
             try:
                 extra[key] = round(fn(), 4)
@@ -60,6 +59,15 @@ def main() -> None:
                 print(f"bench: {key} failed ({type(e).__name__}: {e})",
                       file=sys.stderr)
                 extra[key] = None
+        try:
+            med, phases = native_bench.run_diloco_outer_bench()
+            extra["diloco_outer_step_s"] = round(med, 4)
+            extra["diloco_phases_s"] = phases  # one fenced step's breakdown
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: diloco failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["diloco_outer_step_s"] = None
+            extra["diloco_phases_s"] = None
         # the constrained-wire A/B: quantization's reason to exist. 4-peer
         # ring over an emulated 100 Mbit/s WAN egress (PCCLT_WIRE_MBPS),
         # fp32 vs u8-ZPS, both reported as fp32-equivalent busbw.

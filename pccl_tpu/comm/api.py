@@ -114,6 +114,16 @@ def _np_dtype_of(arr: np.ndarray) -> DataType:
         raise ValueError(f"unsupported dtype {arr.dtype}") from None
 
 
+_DTYPE_ITEMSIZE = {
+    DataType.UINT8: 1, DataType.INT8: 1,
+    DataType.UINT16: 2, DataType.INT16: 2,
+    DataType.UINT32: 4, DataType.INT32: 4,
+    DataType.UINT64: 8, DataType.INT64: 8,
+    DataType.FLOAT16: 2, DataType.BFLOAT16: 2,
+    DataType.FLOAT32: 4, DataType.FLOAT64: 8,
+}
+
+
 # ---------------------------------------------------------------- exceptions
 
 class PcclError(RuntimeError):
@@ -519,6 +529,14 @@ class Communicator:
         desc = ReduceDescriptor(tag, op, quantization, quantized_dtype)._as_c()
         info = _native.ReduceInfo()
         wire_dtype = dtype if dtype is not None else _np_dtype_of(send)
+        if dtype is not None and \
+                _DTYPE_ITEMSIZE[wire_dtype] != send.dtype.itemsize:
+            # a mismatched override would silently reinterpret a fraction of
+            # the buffer (element COUNT is passed, not bytes)
+            raise ValueError(
+                f"wire dtype {wire_dtype.name} is "
+                f"{_DTYPE_ITEMSIZE[wire_dtype]} bytes/elem but the arrays "
+                f"hold {send.dtype.itemsize}-byte elements")
         code = self._lib.pccltAllReduce(
             self._h, send.ctypes.data_as(ctypes.c_void_p),
             recv.ctypes.data_as(ctypes.c_void_p), send.size,
@@ -547,6 +565,12 @@ class Communicator:
         if recv.size < world * send.size:
             raise ValueError(f"recv capacity {recv.size} < world*send "
                              f"{world * send.size}")
+        if world <= 1:
+            # solo: own segment at slot 0, zero wire traffic — honoring the
+            # docstring's unconditional contract instead of surfacing the
+            # native layer's group_world<2 rejection
+            np.copyto(recv.reshape(-1)[:send.size].reshape(send.shape), send)
+            return recv, ReduceInfo(0, 0, 1)
         info = _native.ReduceInfo()
         code = self._lib.pccltAllGather(
             self._h, send.ctypes.data_as(ctypes.c_void_p),

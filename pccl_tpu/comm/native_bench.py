@@ -21,7 +21,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import time
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Tuple
 
 import numpy as np
 
@@ -199,7 +199,9 @@ def run_shared_state_bench(world: int = 4, elems: int = 4 << 20,
 
 # ---------------------------------------------------------------- config 4
 
-def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
+def _peer_diloco(rank, master_port, q, world, params_n, outer_steps, windows=1):
+    import dataclasses
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # peers must not fight over the chip
@@ -209,8 +211,12 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
 
     comm = _connect(rank, master_port, world, 48960)
     params = {"w": jnp.zeros((params_n,), jnp.float32)}
-    # shm_staging: bench peers share this host, so the ring is zero-copy
-    diloco = Diloco(comm, params, DilocoConfig(shm_staging=True, comm_windows=4))
+    # shm_staging: bench peers share this host, so the ring is zero-copy.
+    # windows=1 by default: concurrent tagged ops lose ~10x on a 1-core
+    # host (see docs/08_performance.md) — windowing pays on real WAN pipes
+    shm = os.environ.get("PCCLT_BENCH_DILOCO_SHM", "1") != "0"
+    diloco = Diloco(comm, params, DilocoConfig(shm_staging=shm,
+                                               comm_windows=windows))
     # synthetic inner step: outer params minus a fake gradient update.
     # 2 warmup steps: the first outer steps pay one-time jit compiles of the
     # param-sized codec/apply graphs
@@ -218,11 +224,23 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps):
     cur = diloco.params()
     for it in range(outer_steps + 2):
         inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
+        jax.block_until_ready(inner)  # keep inner compute out of the timing
         t0 = time.perf_counter()
         cur = diloco.outer_step(inner)
+        jax.block_until_ready(cur)
         if it >= 2:
             times.append(time.perf_counter() - t0)
-    q.put({"rank": rank, "times": times})
+    # one more step with rank 0 profiled for the phase breakdown. Only ONE
+    # rank fences: when both do, their lockstep 400 MB allocation bursts
+    # trigger a kernel-level pathology on this host (page-fault/THP storms
+    # inflate each phase's CPU time ~10x) and the breakdown stops describing
+    # production behavior. Rank 1 runs the step unprofiled alongside.
+    if rank == 0:
+        diloco.cfg = dataclasses.replace(diloco.cfg, profile=True)
+    inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
+    jax.block_until_ready(inner)  # same step shape as the timed loop
+    diloco.outer_step(inner)
+    q.put({"rank": rank, "times": times, "phases": diloco.last_profile})
     comm.destroy()
 
 
@@ -280,12 +298,17 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
 
 
 def run_diloco_outer_bench(world: int = 2, params_n: int = 100_000_000,
-                           outer_steps: int = 5) -> float:
+                           outer_steps: int = 5,
+                           windows: int = 1) -> "Tuple[float, Dict]":
     """DiLoCo outer-step wall-clock (device staging + AVG ring + outer SGD)
-    at `params_n` parameters; returns median outer-step seconds."""
+    at `params_n` parameters; returns (median outer-step seconds, per-phase
+    breakdown of one fenced step — delta compute, D2H, stage copy, ring,
+    H2D+apply, unflatten)."""
     res = _spawn_world(world, _peer_diloco,
                        _port("PCCLT_BENCH_MASTER_PORT4", 48657),
-                       (world, params_n, outer_steps), inline_rank0=False,
-                       timeout_s=600)
-    times = next(r["times"] for r in res if r["rank"] == 0)
-    return sorted(times)[len(times) // 2]
+                       (world, params_n, outer_steps, windows),
+                       inline_rank0=False, timeout_s=600)
+    r0 = next(r for r in res if r["rank"] == 0)
+    med = sorted(r0["times"])[len(r0["times"]) // 2]
+    phases = {k: round(v, 3) for k, v in (r0.get("phases") or {}).items()}
+    return med, phases

@@ -20,6 +20,10 @@ class PytreeCodec(NamedTuple):
     flat: Callable[[Any], jax.Array]             # tree -> fp32 vec
     unflat: Callable[[jax.Array], Any]           # fp32 vec -> tree
     count: int
+    # (outer_vec, inner_tree) -> fp32 delta vec: the form DiLoCo wants when
+    # the outer state is held flat — one flatten instead of two, and no
+    # tree materialization of the outer side at all
+    flat_delta_vec: Callable[[jax.Array, Any], jax.Array] = None
 
 
 def leaf_shardings(tree: Any) -> Any:
@@ -62,5 +66,8 @@ def build_codec(template: Any) -> PytreeCodec:
             off += sz
         return jax.tree.unflatten(treedef, out)
 
+    def _flat_delta_vec(outer_vec, inner):
+        return outer_vec - _flat(inner)
+
     return PytreeCodec(jax.jit(_flat_delta), jax.jit(_flat), jax.jit(_unflat),
-                       total)
+                       total, jax.jit(_flat_delta_vec))

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -71,6 +72,10 @@ class DilocoConfig:
     # (ring.avg_all_reduce_windowed) — the reference's MultipleWithRetry
     # recipe for saturating fat pipes with multiple flows. 1 = single op.
     comm_windows: int = 1
+    # Record a per-phase wall-clock breakdown of each outer step in
+    # Diloco.last_profile (fences phases with block_until_ready, so leave
+    # off in production — it defeats the pipelined reduce overlap).
+    profile: bool = False
 
 
 from .codec import build_codec
@@ -100,19 +105,32 @@ class Diloco:
         self.comm = comm
         self.cfg = cfg
         self.step = 0
-        self._delta_fn, self._flat_fn, self._unflat_fn, self.count = build_codec(params)
-        self._shm_stage = None  # lazy registered staging buffer (cfg.shm_staging)
+        c = build_codec(params)
+        self._delta_fn, self._flat_fn, self._unflat_fn = c.flat_delta, c.flat, c.unflat
+        self._delta_vec_fn, self.count = c.flat_delta_vec, c.count
+        self._shm_stage = None  # lazy registered staging buffers (cfg.shm_staging)
+        self._shm_out = None
+        self._host_out = None  # pooled recv for the unstaged out-of-place ring
         # leaf shardings of the template, reapplied after every unflatten so
         # outer params keep the caller's TP/DP layout
         self._shardings = codec.leaf_shardings(params)
-        # outer params live on device as PRIVATE copies: the caller's train
-        # step typically donates its param buffers (train.build_train_step
-        # uses donate_argnums), which would delete aliased arrays under us.
-        # Committed placement from step 0: uncommitted inputs would retrace
-        # the jitted helpers once their outputs come back committed — at
-        # 100M+ params each spurious retrace costs seconds.
-        self.outer_params = self._restore_shardings(jax.tree.map(jnp.copy, params))
+        # The CANONICAL outer state is the flat fp32 vector — the form every
+        # per-step consumer wants (pseudo-gradient subtract, ring reduce,
+        # outer SGD, shared-state offer). The param TREE is materialized only
+        # at the API boundary (params(), outer_step return, the outer_params
+        # property), where _unflat_fn's jit outputs are fresh buffers and so
+        # donation-safe without a defensive full-tree copy. This removes two
+        # params-sized copies and one flatten per outer step vs. keeping the
+        # tree canonical. Committed placement from step 0: uncommitted inputs
+        # would retrace the jitted helpers once their outputs come back
+        # committed — at 100M+ params each spurious retrace costs seconds.
+        self._outer_vec = self._flat_fn(params)
         self._momentum_vec = jax.device_put(jnp.zeros((self.count,), jnp.float32))
+        # last in-flight apply output: overwriting the reused shm staging
+        # buffer must wait for it (device_put on the CPU backend can alias
+        # staged host memory zero-copy, so a pending apply may still read it)
+        self._applied = None
+        self.last_profile: Optional[dict] = None
 
         lr, mu, nesterov = cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
 
@@ -128,18 +146,29 @@ class Diloco:
 
     # -- the outer step --
 
+    @property
+    def outer_params(self) -> Any:
+        """Current outer params as a device pytree (fresh buffers, laid out
+        with the caller's shardings). Assignment flattens back into the
+        canonical vector."""
+        return self._restore_shardings(self._unflat_fn(self._outer_vec))
+
+    @outer_params.setter
+    def outer_params(self, tree: Any) -> None:
+        self._outer_vec = self._flat_fn(tree)
+
     def params(self) -> Any:
-        """Fresh copy of the current outer params, safe to hand to a
-        donating train step (the driver keeps its own private buffers)."""
-        return jax.tree.map(jnp.copy, self.outer_params)
+        """Current outer params, safe to hand to a donating train step (the
+        driver keeps only the flat vector; these buffers are fresh)."""
+        return self.outer_params
 
     def _restore_shardings(self, tree: Any) -> Any:
         return codec.restore_shardings(tree, self._shardings)
 
-    def _reduce_host(self, vec: np.ndarray) -> int:
+    def _reduce_host(self, vec: np.ndarray, out: np.ndarray = None) -> int:
         assert self.comm is not None
         return avg_all_reduce_windowed(
-            self.comm, vec, windows=self.cfg.comm_windows,
+            self.comm, vec, windows=self.cfg.comm_windows, out=out,
             quantization=self.cfg.quantization,
             quantized_dtype=self.cfg.quantized_dtype,
             max_retries=self.cfg.max_retries)
@@ -153,7 +182,11 @@ class Diloco:
         if self._shm_stage is None:
             from pccl_tpu.comm.api import shm_ndarray
 
+            # double-buffered: the ring reduces stage -> out out-of-place,
+            # which skips the native in-place abort-restore backup (a full
+            # params-sized memcpy per outer step)
             self._shm_stage = shm_ndarray(self.count, np.float32)
+            self._shm_out = shm_ndarray(self.count, np.float32)
 
     def _reduce_pipelined(self, delta) -> bool:
         """Overlapped outer reduce: device->host of window k+1 overlaps the
@@ -169,6 +202,11 @@ class Diloco:
         if k <= 1:
             return False
         self._ensure_shm_stage()
+        # the stage may still be read by the previous step's apply (CPU
+        # backend device_put can alias it zero-copy) — wait it out
+        if self._applied is not None:
+            jax.block_until_ready(self._applied)
+            self._applied = None
         bounds = [self.count * i // k for i in range(k + 1)]
         # slice on device and start every D2H up front; np.asarray(win)
         # then only blocks for ITS window while later windows keep copying
@@ -182,26 +220,34 @@ class Diloco:
         handles, views, failed = [], [], []
         for i, w in enumerate(wins):
             view = self._shm_stage[bounds[i]:bounds[i + 1]]
+            out_view = self._shm_out[bounds[i]:bounds[i + 1]]
             np.copyto(view, np.asarray(w, dtype=np.float32))
-            views.append(view)
-            # launch this window's ring while the next window's D2H runs.
-            # A launch-time failure must NOT escape with earlier windows
-            # still in flight on this shared buffer — record it for the
-            # retry batch and keep going to the join below.
+            views.append(out_view)
+            # launch this window's ring while the next window's D2H runs —
+            # out-of-place into the second stage, so the native ring skips
+            # its in-place abort-restore backup copy. A launch-time failure
+            # must NOT escape with earlier windows still in flight on this
+            # shared buffer — record it for the retry batch and keep going
+            # to the join below.
             try:
                 handles.append((i, self.comm.all_reduce_async(
-                    view, view, op=ReduceOp.AVG,
+                    view, out_view, op=ReduceOp.AVG,
                     tag=self._WINDOW_TAG_BASE + i)))
             except TooFewPeersError:
-                pass  # alone: the window is its own average
+                np.copyto(out_view, view)  # alone: the window is its own avg
             except PcclError:
+                # never launched: the out view holds stale bytes — seed it
+                # with the input so the in-place retry below reduces real data
+                np.copyto(out_view, view)
                 failed.append(i)
         for i, h in handles:
             try:
                 h.wait()
             except TooFewPeersError:
-                pass
+                np.copyto(views[i], self._shm_stage[bounds[i]:bounds[i + 1]])
             except PcclError:
+                # aborted mid-op: the native ring restored the out view from
+                # the untouched staged input, so the retry sees real data
                 failed.append(i)
         if failed:
             # survivors agree on the failed SET (exactly-one-abort
@@ -222,34 +268,86 @@ class Diloco:
         """Average pseudo-gradients across peers, apply outer Nesterov SGD,
         return the new global params (device pytree).
 
-        The returned tree is a fresh copy safe to hand to a donating train
-        step; the driver keeps its own buffers for the next pseudo-gradient."""
-        delta = self._delta_fn(self.outer_params, inner_params)
+        The returned tree has fresh buffers, safe to hand to a donating
+        train step; the driver keeps only the canonical flat vector.
+
+        With ``cfg.profile`` set, ``self.last_profile`` holds a per-phase
+        wall-clock breakdown (seconds) of this step — each phase is fenced
+        with block_until_ready, which serializes the device pipeline, so
+        profiled steps run slightly slower than unprofiled ones."""
+        prof: Optional[dict] = {} if self.cfg.profile else None
+        cpu_mark = [time.process_time()]
+
+        def mark(name, t0, *sync):
+            if prof is not None:
+                for a in sync:
+                    jax.block_until_ready(a)
+                t1 = time.perf_counter()
+                prof[name] = t1 - t0
+                # cpu seconds alongside wall: on a contended host the gap
+                # between them is scheduler wait / peer wait, not phase work
+                c1 = time.process_time()
+                prof[name + "_cpu"] = c1 - cpu_mark[0]
+                cpu_mark[0] = c1
+                return t1
+            return t0
+
+        t = time.perf_counter()
+        delta = self._delta_vec_fn(self._outer_vec, inner_params)
+        t = mark("delta_compute", t, delta)
         # quantized rings send from quantize scratch, not from the staged
         # buffer — shm staging would be a pure extra copy there, so gate it
         use_shm = (self.cfg.shm_staging and self.comm is not None
                    and self.cfg.quantization == QuantizationAlgorithm.NONE)
-        if use_shm and self.cfg.comm_windows > 1 and self._reduce_pipelined(delta):
-            host = self._shm_stage
+        if (use_shm and self.cfg.comm_windows > 1
+                and self._reduce_pipelined(delta)):
+            # pipelined: D2H of window k+1 overlaps the ring of window k, so
+            # the phases are not separable — profiled, this records as one
+            # combined phase. The branch must NOT depend on cfg.profile:
+            # the reduce path is a cross-peer protocol (window tags must
+            # match on every rank), and profile is a local flag.
+            host = self._shm_out
+            t = mark("d2h_stage_ring_pipelined", t)
         else:
             # np.asarray: device_get already yields a host ndarray — a second
             # np.array copy would cost another params-sized memcpy per step
             host = np.asarray(jax.device_get(delta), dtype=np.float32)
+            t = mark("d2h", t)
+            if self._applied is not None:  # see _reduce_pipelined
+                jax.block_until_ready(self._applied)
+                self._applied = None
             if use_shm:
                 self._ensure_shm_stage()
                 np.copyto(self._shm_stage, host)
-                host = self._shm_stage  # same-host peers reduce zero-copy
-            elif not host.flags["WRITEABLE"] or not host.flags["C_CONTIGUOUS"]:
-                host = np.array(host, dtype=np.float32)  # reduces in place
-            if self.comm is not None:
-                self._reduce_host(host)
-        outer_vec = self._flat_fn(self.outer_params)
+                t = mark("stage_copy", t)
+                if self.comm is not None:
+                    # out-of-place between the two registered stages: the
+                    # same-host ring reduces zero-copy AND skips the native
+                    # in-place backup memcpy
+                    self._reduce_host(self._shm_stage, out=self._shm_out)
+                host = self._shm_out
+            else:
+                if not host.flags["C_CONTIGUOUS"]:
+                    host = np.ascontiguousarray(host, dtype=np.float32)
+                t = mark("stage_copy", t)
+                if self.comm is not None:
+                    if self._host_out is None or self._host_out.size != self.count:
+                        self._host_out = np.empty(self.count, np.float32)
+                    self._reduce_host(host, out=self._host_out)
+                    host = self._host_out
+            t = mark("ring_reduce", t)
         new_vec, self._momentum_vec = self._apply_fn(
-            outer_vec, self._momentum_vec,
-            jax.device_put(host, outer_vec.sharding))
-        self.outer_params = self._restore_shardings(self._unflat_fn(new_vec))
+            self._outer_vec, self._momentum_vec,
+            jax.device_put(host, self._outer_vec.sharding))
+        self._outer_vec = self._applied = new_vec
+        t = mark("h2d_apply", t, new_vec)
         self.step += 1
-        return jax.tree.map(jnp.copy, self.outer_params)
+        out = self.outer_params
+        mark("unflat_out", t, out)
+        if prof is not None:
+            prof["total"] = sum(v for k, v in prof.items() if not k.endswith("_cpu"))
+            self.last_profile = prof
+        return out
 
     # -- shared state --
 
@@ -258,7 +356,7 @@ class Diloco:
         Revision = outer step count (one-increment rule of the master,
         reference ccoip_master_state.cpp:1066-1090)."""
         self._ss_vec = np.array(
-            jax.device_get(self._flat_fn(self.outer_params)), dtype=np.float32)
+            jax.device_get(self._outer_vec), dtype=np.float32)
         self._ss_mom = np.array(jax.device_get(self._momentum_vec),
                                   dtype=np.float32)
         self._ss_step = np.array([self.step], dtype=np.uint64)
@@ -272,18 +370,16 @@ class Diloco:
             self,
             strategy: SharedStateSyncStrategy = SharedStateSyncStrategy.ENFORCE_POPULAR):
         """Sync outer state with the group; adopt whatever wins the election
-        into self.outer_params / momentum / step. Returns the
+        into the outer vector / momentum / step. Returns the
         SharedStateSyncInfo (tx/rx bytes, revision); take the adopted params
-        via self.params() — a donation-safe copy, NOT self.outer_params,
-        which aliases the driver's private buffers."""
+        via self.params()."""
         assert self.comm is not None
         st = self.shared_state()
         info = self.comm.sync_shared_state(st, strategy)
         # adopt (possibly received) content
         self.step = int(self._ss_step[0])
         self._momentum_vec = jnp.asarray(self._ss_mom)
-        self.outer_params = self._restore_shardings(
-            self._unflat_fn(jnp.asarray(self._ss_vec)))
+        self._outer_vec = jnp.asarray(self._ss_vec)
         return info
 
 
@@ -303,13 +399,20 @@ class AsyncDiloco(Diloco):
         super().__init__(comm, params, cfg)
         self._inflight: Optional[threading.Thread] = None
         self._inflight_host: Optional[np.ndarray] = None
+        self._async_out: Optional[np.ndarray] = None  # pooled reduce output
         self._err: Optional[BaseException] = None
-        self._baseline: Optional[Any] = None  # outer params inner started from
+        # flat outer vector the inner phase started from (pseudo-gradient
+        # baseline — before the delayed update from step t-1 lands)
+        self._baseline: Optional[jax.Array] = None
 
-    def _reduce_bg(self, host: np.ndarray) -> None:
+    def _reduce_bg(self, host: np.ndarray, out: np.ndarray) -> None:
         try:
             if self.comm is not None:
-                self._reduce_host(host)
+                # out-of-place into the pooled buffer: skips the native
+                # in-place snapshot memcpy (same win as the sync path)
+                self._reduce_host(host, out=out)
+            else:
+                np.copyto(out, host)
         except BaseException as e:  # noqa: BLE001 — surfaced on join
             self._err = e
 
@@ -322,31 +425,38 @@ class AsyncDiloco(Diloco):
             err, self._err = self._err, None
             self._inflight_host = None
             raise err
-        host = self._inflight_host
         self._inflight_host = None
-        outer_vec = self._flat_fn(self.outer_params)
         new_vec, self._momentum_vec = self._apply_fn(
-            outer_vec, self._momentum_vec, jnp.asarray(host))
-        self.outer_params = self._restore_shardings(self._unflat_fn(new_vec))
+            self._outer_vec, self._momentum_vec, jnp.asarray(self._async_out))
+        self._outer_vec = self._applied = new_vec
         self.step += 1
 
     def outer_step_async(self, inner_params: Any) -> Any:
         """Apply the previous in-flight reduce (if any), launch the reduce of
         this step's pseudo-gradient, return params to continue from."""
-        # the pseudo-gradient baseline is the outer params the inner phase
+        # the pseudo-gradient baseline is the outer vector the inner phase
         # STARTED from — before the delayed update from step t-1 lands
         # (reference async semantics, docs/md/07-.../03-AsyncDiloco.md)
-        baseline = self._baseline if self._baseline is not None else self.outer_params
-        delta = self._delta_fn(baseline, inner_params)
+        baseline = self._baseline if self._baseline is not None else self._outer_vec
+        delta = self._delta_vec_fn(baseline, inner_params)
         host = np.array(jax.device_get(delta), dtype=np.float32)
         self._join_inflight()
+        if self._async_out is None:
+            self._async_out = np.empty(self.count, np.float32)
+        # the pooled out buffer may still feed the apply just dispatched
+        # (jnp.asarray can alias it zero-copy on the CPU backend) — the
+        # background ring must not overwrite it until that apply lands
+        if self._applied is not None:
+            jax.block_until_ready(self._applied)
+            self._applied = None
         self._inflight_host = host
-        self._inflight = threading.Thread(target=self._reduce_bg, args=(host,),
+        self._inflight = threading.Thread(target=self._reduce_bg,
+                                          args=(host, self._async_out),
                                           daemon=True)
         self._inflight.start()
-        self._baseline = self.outer_params
-        # fresh copy: the caller's train step may donate what we return
-        return jax.tree.map(jnp.copy, self.outer_params)
+        self._baseline = self._outer_vec
+        # fresh jit-output buffers: safe for a donating train step
+        return self.outer_params
 
     def sync_shared_state(
             self,
@@ -362,6 +472,6 @@ class AsyncDiloco(Diloco):
 
     def finish(self) -> Any:
         """Join any in-flight reduce and apply it; returns final outer params
-        (fresh copy, donation-safe)."""
+        (fresh buffers, donation-safe)."""
         self._join_inflight()
-        return jax.tree.map(jnp.copy, self.outer_params)
+        return self.outer_params

@@ -23,23 +23,29 @@ from ..comm import (
 
 
 def avg_all_reduce_with_retry(
-        comm: Communicator, vec: np.ndarray, *,
+        comm: Communicator, vec: np.ndarray, *, out: np.ndarray = None,
         quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
         quantized_dtype: DataType = DataType.UINT8,
         max_retries: int = 16) -> int:
-    """AVG all-reduce `vec` in place over the ring, retrying across peer
-    churn. Returns the world size that completed the reduce (1 = alone)."""
+    """AVG all-reduce `vec` over the ring, retrying across peer churn.
+    With `out`, the reduce runs out-of-place into it — the native ring then
+    skips its in-place abort-restore backup (a full params-sized memcpy per
+    op) and `vec` is left untouched. Returns the world size that completed
+    the reduce (1 = alone)."""
+    recv = vec if out is None else out
     for _ in range(max_retries):
         try:
-            info = comm.all_reduce(vec, op=ReduceOp.AVG,
+            info = comm.all_reduce(vec, recv, op=ReduceOp.AVG,
                                    quantization=quantization,
                                    quantized_dtype=quantized_dtype)
             return info.world_size
         except (ConnectionLostError, OperationAbortedError):
-            # world shrank mid-op; the native core restored the src buffer —
-            # adopt the survivor ring and go again
+            # world shrank mid-op; the native core restored the recv buffer
+            # from the untouched send — adopt the survivor ring and go again
             comm.update_topology()
         except TooFewPeersError:
+            if recv is not vec:
+                np.copyto(recv, vec)  # alone: the reduction is the input
             return 1
     raise ConnectionLostError(
         Result.CONNECTION_LOST,
@@ -54,6 +60,7 @@ _MIN_WINDOW_ELEMS = 1 << 20
 
 def avg_all_reduce_windowed(
         comm: Communicator, vec: np.ndarray, *, windows: int = 1,
+        out: np.ndarray = None,
         quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,
         quantized_dtype: DataType = DataType.UINT8,
         max_retries: int = 16) -> int:
@@ -74,8 +81,14 @@ def avg_all_reduce_windowed(
     windows = min(windows, max(1, vec.size // _MIN_WINDOW_ELEMS))
     if windows <= 1:
         return avg_all_reduce_with_retry(
-            comm, vec, quantization=quantization,
+            comm, vec, out=out, quantization=quantization,
             quantized_dtype=quantized_dtype, max_retries=max_retries)
+    if out is not None:
+        # the MultipleWithRetry band reduces in place; land the batch in
+        # `out` so the caller's contract (result in out, vec untouched)
+        # holds — at the cost of one staging copy
+        np.copyto(out, vec)
+        vec = out
     views = np.array_split(vec, windows)  # contiguous views into vec
     try:
         infos = comm.all_reduce_multiple_with_retry(

@@ -19,7 +19,9 @@ Usage::
     print(prof.summary())
     prof.export_chrome_trace("trace.json")   # chrome://tracing / perfetto
 
-Sections nest; wall time is attributed to the innermost active section.
+Sections nest; each section records its full INCLUSIVE duration (a parent's
+total contains its children's time — summary() rows are not additive across
+nesting levels; the chrome trace shows the nesting explicitly).
 Zero dependencies, threadsafe for disjoint section names.
 """
 

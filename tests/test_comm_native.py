@@ -273,3 +273,35 @@ def test_wan_pacing_quantization_wins(master, monkeypatch):
     speedup = times[False] / times[True]
     assert speedup > 1.8, f"quantized ring only {speedup:.2f}x faster " \
         f"(fp32 {times[False]:.2f}s vs u8 {times[True]:.2f}s) on the paced wire"
+
+
+def test_wire_dtype_override_validation(master):
+    """A wire-dtype override whose element size mismatches the array's must
+    raise, not silently reinterpret half the buffer (element COUNT crosses
+    the C ABI, not bytes)."""
+    from pccl_tpu.comm import DataType
+
+    def worker(comm, rank):
+        x = np.zeros(64, dtype=np.float32)
+        with pytest.raises(ValueError, match="bytes/elem"):
+            comm.all_reduce(x, dtype=DataType.BFLOAT16)  # 2-byte wire, 4-byte array
+        # matching override passes (uint16 bit patterns as bf16)
+        y = np.full(64, 0x3F80, dtype=np.uint16)  # bf16 1.0
+        comm.all_reduce(y, dtype=DataType.BFLOAT16)
+        assert int(y[0]) == 0x4000  # 1.0 + 1.0 = 2.0 exactly in bf16
+
+    _run_peers(master.port, 2, worker, _ports(4))
+
+
+def test_all_gather_solo(master):
+    """A solo peer's all_gather returns its own segment (docstring contract)
+    instead of surfacing the native TooFewPeers rejection."""
+
+    def worker(comm, rank):
+        x = np.arange(17, dtype=np.float32)
+        out, info = comm.all_gather(x)
+        assert info.world_size == 1 and info.tx_bytes == 0
+        assert out.shape == (1, 17)
+        np.testing.assert_array_equal(out[0], x)
+
+    _run_peers(master.port, 1, worker, _ports(4))
