@@ -77,6 +77,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             print(f"bench: wan failed ({type(e).__name__}: {e})", file=sys.stderr)
             extra["wan_quant_speedup"] = None
+        # bf16 twin: the TPU gradient dtype, plain vs u8-ZPS (typed SIMD
+        # widen-to-f32 kernels), bytes-adjusted on the same paced wire
+        try:
+            for k, v in native_bench.run_wan_bf16_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: wan bf16 failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["wan_bf16_quant_speedup"] = None
 
     print(json.dumps({
         "metric": f"allreduce_busbw_fp32_2peer_loopback({path})",

@@ -244,16 +244,24 @@ def _peer_diloco(rank, master_port, q, world, params_n, outer_steps, windows=1):
     comm.destroy()
 
 
-def _peer_wan(rank, master_port, q, world, nbytes, iters, quantize, port_base):
+def _peer_wan(rank, master_port, q, world, nbytes, iters, quantize, port_base,
+              bf16=False):
     from pccl_tpu.comm.api import DataType, QuantizationAlgorithm, ReduceOp
 
     comm = _connect(rank, master_port, world, port_base)
     rng = np.random.default_rng(7 + rank)
-    x = rng.standard_normal(nbytes // 4).astype(np.float32)
-    y = np.empty_like(x)
     kw = {}
+    if bf16:
+        # bf16 bit patterns ride in uint16 arrays (numpy has no bfloat16);
+        # truncating f32 -> bf16 is fine for a throughput bench
+        f = rng.standard_normal(nbytes // 2).astype(np.float32)
+        x = (f.view(np.uint32) >> 16).astype(np.uint16)
+        kw["dtype"] = DataType.BFLOAT16
+    else:
+        x = rng.standard_normal(nbytes // 4).astype(np.float32)
+    y = np.empty_like(x)
     if quantize:
-        kw = dict(quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+        kw.update(quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
                   quantized_dtype=DataType.UINT8)
     comm.all_reduce(x, y, op=ReduceOp.AVG, **kw)  # warmup
     times = []
@@ -294,6 +302,39 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
         else:
             os.environ["PCCLT_WIRE_MBPS"] = old
     out["wan_quant_speedup"] = out["wan_u8zps_busbw_gbps"] / out["wan_fp32_busbw_gbps"]
+    return out
+
+
+def run_wan_bf16_bench(world: int = 4, nbytes: int = 16 << 20, iters: int = 3,
+                       mbps: float = 100.0) -> Dict[str, float]:
+    """bf16 twin of run_wan_bench: same paced wire, bf16 gradients plain
+    (2 B/elem) vs u8-ZPS quantized from bf16 sources (1 B/elem; the typed
+    widen-to-f32 SIMD kernels in quantize.cpp). Returns bf16-payload-basis
+    busbw for both plus the speedup — the bytes-adjusted proof that
+    quantizing the TPU gradient dtype pays on a constrained wire."""
+    out: Dict[str, float] = {}
+    old = os.environ.get("PCCLT_WIRE_MBPS")
+    os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
+    try:
+        for name, quant, mport, base in (
+                # bases chosen clear of the 48xxx bench bands and the
+                # 50000-51800 fixed ports in tests/test_comm_native.py
+                ("wan_bf16_busbw_gbps", False, 48675, 52300),
+                ("wan_bf16_u8zps_busbw_gbps", True, 48677, 52500)):
+            res = _spawn_world(world, _peer_wan,
+                               _port("PCCLT_BENCH_MASTER_PORT_WANB", mport),
+                               (world, nbytes, iters, quant, base, True),
+                               inline_rank0=False)
+            times = next(r["times"] for r in res if r["rank"] == 0)
+            med = sorted(times)[len(times) // 2]
+            out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
+    finally:
+        if old is None:
+            os.environ.pop("PCCLT_WIRE_MBPS", None)
+        else:
+            os.environ["PCCLT_WIRE_MBPS"] = old
+    out["wan_bf16_quant_speedup"] = (out["wan_bf16_u8zps_busbw_gbps"] /
+                                     out["wan_bf16_busbw_gbps"])
     return out
 
 

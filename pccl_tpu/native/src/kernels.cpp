@@ -15,56 +15,6 @@
 
 namespace pcclt::kernels {
 
-float f16_to_f32(uint16_t h) {
-    uint32_t sign = (h & 0x8000u) << 16;
-    uint32_t exp = (h >> 10) & 0x1F;
-    uint32_t mant = h & 0x3FF;
-    uint32_t u;
-    if (exp == 0) {
-        if (mant == 0) {
-            u = sign;
-        } else { // subnormal
-            int e = -1;
-            do {
-                ++e;
-                mant <<= 1;
-            } while (!(mant & 0x400));
-            mant &= 0x3FF;
-            u = sign | ((127 - 15 - e) << 23) | (mant << 13);
-        }
-    } else if (exp == 0x1F) {
-        u = sign | 0x7F800000u | (mant << 13);
-    } else {
-        u = sign | ((exp - 15 + 127) << 23) | (mant << 13);
-    }
-    float f;
-    memcpy(&f, &u, 4);
-    return f;
-}
-
-uint16_t f32_to_f16(float f) {
-    uint32_t u;
-    memcpy(&u, &f, 4);
-    uint32_t sign = (u >> 16) & 0x8000u;
-    int32_t exp = static_cast<int32_t>((u >> 23) & 0xFF) - 127 + 15;
-    uint32_t mant = u & 0x7FFFFF;
-    if (exp >= 0x1F) return static_cast<uint16_t>(sign | 0x7C00 | (((u & 0x7F800000) == 0x7F800000 && mant) ? 0x200 : 0));
-    if (exp <= 0) {
-        if (exp < -10) return static_cast<uint16_t>(sign);
-        mant |= 0x800000;
-        uint32_t shift = static_cast<uint32_t>(14 - exp);
-        uint32_t half = mant >> shift;
-        uint32_t rem = mant & ((1u << shift) - 1);
-        uint32_t halfway = 1u << (shift - 1);
-        if (rem > halfway || (rem == halfway && (half & 1))) ++half;
-        return static_cast<uint16_t>(sign | half);
-    }
-    uint32_t half = (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
-    uint32_t rem = mant & 0x1FFF;
-    if (rem > 0x1000 || (rem == 0x1000 && (half & 1))) ++half;
-    return static_cast<uint16_t>(sign | half);
-}
-
 namespace {
 
 template <typename T, typename Op> void loop(T *dst, const T *src, size_t n, Op op) {

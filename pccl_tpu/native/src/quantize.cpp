@@ -4,9 +4,11 @@
 // kernels (/root/reference/ccoip/src/cpp/quantize_kernels.cpp:38-83) and
 // delegates ZeroPointScale to piquant, with a fused dequantize+accumulate
 // in reduce_kernels.cpp:361-427. Here both algorithms share one design:
-// typed `#pragma omp simd` template kernels for the f32/f64 -> u8/u16/u32/i8
-// hot paths (single-precision arithmetic for f32 sources — ~8x the scalar
-// double path), with a generic scalar fallback that also covers f16/bf16.
+// typed `#pragma omp simd` template kernels for the
+// {f32, f64, bf16, f16} -> u8/u16/u32/i8 hot paths (bf16/f16 widen to f32
+// in the lanes; the reference never had 16-bit float quantize sources at
+// all — quantize_kernels.cpp is float/double only), with a generic scalar
+// double fallback for the remaining combos.
 // All peers run identical code, so cross-peer bit parity of the
 // quantize -> dequantize round trip is preserved by construction.
 #include "quantize.hpp"
@@ -118,43 +120,82 @@ double load_quant(DType qd, const void *q, size_t i) {
     }
 }
 
-// ---------- typed SIMD kernels (f32/f64 sources) ----------
+// ---------- typed SIMD kernels (f32/f64/bf16/f16 sources) ----------
 
-// S: float or double. Arithmetic runs in S — for f32 sources that means
-// single precision end to end, which vectorizes 2x wider than double.
+// T: storage type of the source buffer; SrcTraits<T>::S is the compute
+// type the lanes run in. f32 computes in f32 (vectorizes 2x wider than
+// double), f64 in double, and the 16-bit float formats widen to f32 in
+// the lanes — bf16's converters are branch-free inline bit shifts that
+// vectorize cleanly (the TPU gradient dtype must not fall to the scalar
+// double path; see kernels_avx2.cpp for the same reasoning on reduction).
 
-template <typename S, typename Q>
-void k_quant_minmax(const S *src, Q *out, size_t n, S lo, S inv, S qmax) {
+struct bf16_t {
+    uint16_t bits;
+};
+struct f16_t {
+    uint16_t bits;
+};
+
+template <typename T> struct SrcTraits {
+    using S = T;
+    static S load(const T *p, size_t i) { return p[i]; }
+    static void store(T *p, size_t i, S v) { p[i] = v; }
+};
+template <> struct SrcTraits<bf16_t> {
+    using S = float;
+    static S load(const bf16_t *p, size_t i) { return kernels::bf16_to_f32(p[i].bits); }
+    static void store(bf16_t *p, size_t i, S v) { p[i].bits = kernels::f32_to_bf16(v); }
+};
+template <> struct SrcTraits<f16_t> {
+    using S = float;
+    static S load(const f16_t *p, size_t i) { return kernels::f16_to_f32(p[i].bits); }
+    static void store(f16_t *p, size_t i, S v) { p[i].bits = kernels::f32_to_f16(v); }
+};
+
+template <typename T, typename Q>
+void k_quant_minmax(const T *src, Q *out, size_t n,
+                    typename SrcTraits<T>::S lo, typename SrcTraits<T>::S inv,
+                    typename SrcTraits<T>::S qmax) {
+    using S = typename SrcTraits<T>::S;
 #pragma omp simd
     for (size_t i = 0; i < n; ++i) {
-        S v = (src[i] - lo) * inv;
+        S v = (SrcTraits<T>::load(src, i) - lo) * inv;
         v = v < S(0) ? S(0) : (v > qmax ? qmax : v);
         out[i] = static_cast<Q>(v + S(0.5)); // v >= 0: floor(v+.5) == round
     }
 }
 
-template <typename S, typename Q>
-void k_quant_zps(const S *src, Q *out, size_t n, S inv_scale, S zp, S qlo, S qhi) {
+template <typename T, typename Q>
+void k_quant_zps(const T *src, Q *out, size_t n,
+                 typename SrcTraits<T>::S inv_scale, typename SrcTraits<T>::S zp,
+                 typename SrcTraits<T>::S qlo, typename SrcTraits<T>::S qhi) {
+    using S = typename SrcTraits<T>::S;
 #pragma omp simd
     for (size_t i = 0; i < n; ++i) {
         // shift into the non-negative domain so the +0.5 rounding trick holds
-        S v = src[i] * inv_scale + zp - qlo;
+        S v = SrcTraits<T>::load(src, i) * inv_scale + zp - qlo;
         S span = qhi - qlo;
         v = v < S(0) ? S(0) : (v > span ? span : v);
         out[i] = static_cast<Q>(static_cast<S>(static_cast<int64_t>(v + S(0.5))) + qlo);
     }
 }
 
-template <typename S, typename Q>
-void k_dq_set_minmax(const Q *q, S *dst, size_t n, S lo, S step) {
+template <typename T, typename Q>
+void k_dq_set_minmax(const Q *q, T *dst, size_t n,
+                     typename SrcTraits<T>::S lo, typename SrcTraits<T>::S step) {
+    using S = typename SrcTraits<T>::S;
 #pragma omp simd
-    for (size_t i = 0; i < n; ++i) dst[i] = lo + static_cast<S>(q[i]) * step;
+    for (size_t i = 0; i < n; ++i)
+        SrcTraits<T>::store(dst, i, lo + static_cast<S>(q[i]) * step);
 }
 
-template <typename S, typename Q>
-void k_dq_set_zps(const Q *q, S *dst, size_t n, S scale, S zp) {
+template <typename T, typename Q>
+void k_dq_set_zps(const Q *q, T *dst, size_t n,
+                  typename SrcTraits<T>::S scale, typename SrcTraits<T>::S zp) {
+    using S = typename SrcTraits<T>::S;
 #pragma omp simd
-    for (size_t i = 0; i < n; ++i) dst[i] = (static_cast<S>(q[i]) - zp) * scale;
+    for (size_t i = 0; i < n; ++i)
+        SrcTraits<T>::store(dst, i, (static_cast<S>(q[i]) - zp) * scale);
 }
 
 struct AddOp {
@@ -170,53 +211,68 @@ struct MinOp {
     template <typename S> S operator()(S a, S b) const { return a < b ? a : b; }
 };
 
-template <typename S, typename Q, typename Op>
-void k_dq_acc_minmax(const Q *q, S *dst, size_t n, S lo, S step, Op op) {
+template <typename T, typename Q, typename Op>
+void k_dq_acc_minmax(const Q *q, T *dst, size_t n,
+                     typename SrcTraits<T>::S lo, typename SrcTraits<T>::S step,
+                     Op op) {
+    using S = typename SrcTraits<T>::S;
 #pragma omp simd
     for (size_t i = 0; i < n; ++i)
-        dst[i] = op(dst[i], lo + static_cast<S>(q[i]) * step);
+        SrcTraits<T>::store(
+            dst, i, op(SrcTraits<T>::load(dst, i), lo + static_cast<S>(q[i]) * step));
 }
 
-template <typename S, typename Q, typename Op>
-void k_dq_acc_zps(const Q *q, S *dst, size_t n, S scale, S zp, Op op) {
+template <typename T, typename Q, typename Op>
+void k_dq_acc_zps(const Q *q, T *dst, size_t n,
+                  typename SrcTraits<T>::S scale, typename SrcTraits<T>::S zp,
+                  Op op) {
+    using S = typename SrcTraits<T>::S;
 #pragma omp simd
     for (size_t i = 0; i < n; ++i)
-        dst[i] = op(dst[i], (static_cast<S>(q[i]) - zp) * scale);
+        SrcTraits<T>::store(
+            dst, i, op(SrcTraits<T>::load(dst, i), (static_cast<S>(q[i]) - zp) * scale));
 }
 
 // min/max scan; omp simd reduction licenses the reassociation
-template <typename S> void k_minmax_scan(const S *src, size_t n, S &lo_out, S &hi_out) {
-    S lo = src[0], hi = src[0];
+template <typename T>
+void k_minmax_scan(const T *src, size_t n,
+                   typename SrcTraits<T>::S &lo_out, typename SrcTraits<T>::S &hi_out) {
+    using S = typename SrcTraits<T>::S;
+    S lo = SrcTraits<T>::load(src, 0), hi = lo;
 #pragma omp simd reduction(min : lo) reduction(max : hi)
     for (size_t i = 0; i < n; ++i) {
-        lo = lo < src[i] ? lo : src[i];
-        hi = hi > src[i] ? hi : src[i];
+        S v = SrcTraits<T>::load(src, i);
+        lo = lo < v ? lo : v;
+        hi = hi > v ? hi : v;
     }
     lo_out = lo;
     hi_out = hi;
 }
 
-// dispatch (src f32/f64) x (q u8/u16/u32/i8) to fn.template operator()<S,Q>;
+// dispatch (src f32/f64/bf16/f16) x (q u8/u16/u32/i8) to fn(T{}, Q{});
 // returns false when the combo has no typed kernel (caller uses the scalar
 // fallback)
 template <typename Fn> bool dispatch_typed(DType src, DType q, Fn &&fn) {
-    auto with_q = [&](auto s_tag) {
-        using S = decltype(s_tag);
+    auto with_q = [&](auto t_tag) {
+        using T = decltype(t_tag);
+        using S = typename SrcTraits<T>::S;
         switch (q) {
-        case DType::kU8: fn(S{}, uint8_t{}); return true;
-        case DType::kU16: fn(S{}, uint16_t{}); return true;
+        case DType::kU8: fn(T{}, uint8_t{}); return true;
+        case DType::kU16: fn(T{}, uint16_t{}); return true;
         case DType::kU32:
             // float cannot represent 2^32-1: the rounding trick would
             // overflow the cast — that combo takes the scalar double path
             if constexpr (std::is_same_v<S, float>) return false;
-            else { fn(S{}, uint32_t{}); return true; }
-        case DType::kI8: fn(S{}, int8_t{}); return true;
+            else { fn(T{}, uint32_t{}); return true; }
+        case DType::kI8: fn(T{}, int8_t{}); return true;
         default: return false;
         }
     };
     switch (src) {
     case DType::kF32: return with_q(float{});
     case DType::kF64: return with_q(double{});
+    case DType::kBF16: return with_q(bf16_t{});
+    case DType::kF16: return with_q(f16_t{});
     default: return false;
     }
 }
@@ -232,13 +288,19 @@ Meta compute_meta(QuantAlgo algo, DType q_dtype, DType src_dtype, const void *sr
     if (algo == QuantAlgo::kNone || count == 0) return m;
 
     double lo, hi;
-    if (src_dtype == DType::kF32) {
-        float l, h;
-        k_minmax_scan(static_cast<const float *>(src), count, l, h);
+    if (src_dtype == DType::kF64) {
+        k_minmax_scan(static_cast<const double *>(src), count, lo, hi);
+    } else if (src_dtype == DType::kF32 || src_dtype == DType::kBF16 ||
+               src_dtype == DType::kF16) {
+        float l = 0, h = 0;
+        if (src_dtype == DType::kF32)
+            k_minmax_scan(static_cast<const float *>(src), count, l, h);
+        else if (src_dtype == DType::kBF16)
+            k_minmax_scan(static_cast<const bf16_t *>(src), count, l, h);
+        else
+            k_minmax_scan(static_cast<const f16_t *>(src), count, l, h);
         lo = l;
         hi = h;
-    } else if (src_dtype == DType::kF64) {
-        k_minmax_scan(static_cast<const double *>(src), count, lo, hi);
     } else {
         lo = std::numeric_limits<double>::infinity();
         hi = -lo;
@@ -271,10 +333,11 @@ void quantize(const Meta &m, const void *src, void *q_out, size_t count) {
         const double range = m.hi - m.lo;
         const double qmax = qmax_of(m.q_dtype);
         const double inv = range > 0 ? qmax / range : 0.0;
-        bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
-            using S = decltype(s_tag);
+        bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto t_tag, auto q_tag) {
+            using T = decltype(t_tag);
+            using S = typename SrcTraits<T>::S;
             using Q = decltype(q_tag);
-            k_quant_minmax<S, Q>(static_cast<const S *>(src), static_cast<Q *>(q_out),
+            k_quant_minmax<T, Q>(static_cast<const T *>(src), static_cast<Q *>(q_out),
                                  count, static_cast<S>(m.lo), static_cast<S>(inv),
                                  static_cast<S>(qmax));
         });
@@ -289,10 +352,11 @@ void quantize(const Meta &m, const void *src, void *q_out, size_t count) {
         const double scale = m.lo, zp = m.hi;
         const double qlo = m.q_dtype == DType::kI8 ? -128.0 : 0.0;
         const double qhi = m.q_dtype == DType::kI8 ? 127.0 : qmax_of(m.q_dtype);
-        bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
-            using S = decltype(s_tag);
+        bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto t_tag, auto q_tag) {
+            using T = decltype(t_tag);
+            using S = typename SrcTraits<T>::S;
             using Q = decltype(q_tag);
-            k_quant_zps<S, Q>(static_cast<const S *>(src), static_cast<Q *>(q_out),
+            k_quant_zps<T, Q>(static_cast<const T *>(src), static_cast<Q *>(q_out),
                               count, static_cast<S>(1.0 / scale), static_cast<S>(zp),
                               static_cast<S>(qlo), static_cast<S>(qhi));
         });
@@ -326,15 +390,16 @@ double minmax_step(const Meta &m) {
 } // namespace
 
 void dequantize_set(const Meta &m, const void *q, void *dst, size_t count) {
-    bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
-        using S = decltype(s_tag);
+    bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto t_tag, auto q_tag) {
+        using T = decltype(t_tag);
+        using S = typename SrcTraits<T>::S;
         using Q = decltype(q_tag);
         if (m.algo == QuantAlgo::kMinMax)
-            k_dq_set_minmax<S, Q>(static_cast<const Q *>(q), static_cast<S *>(dst),
+            k_dq_set_minmax<T, Q>(static_cast<const Q *>(q), static_cast<T *>(dst),
                                   count, static_cast<S>(m.lo),
                                   static_cast<S>(minmax_step(m)));
         else
-            k_dq_set_zps<S, Q>(static_cast<const Q *>(q), static_cast<S *>(dst), count,
+            k_dq_set_zps<T, Q>(static_cast<const Q *>(q), static_cast<T *>(dst), count,
                                static_cast<S>(m.lo), static_cast<S>(m.hi));
     });
     if (done) return;
@@ -343,17 +408,18 @@ void dequantize_set(const Meta &m, const void *q, void *dst, size_t count) {
 
 void dequantize_accumulate(const Meta &m, proto::RedOp op, const void *q, void *dst,
                            size_t count) {
-    bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto s_tag, auto q_tag) {
-        using S = decltype(s_tag);
+    bool done = dispatch_typed(m.src_dtype, m.q_dtype, [&](auto t_tag, auto q_tag) {
+        using T = decltype(t_tag);
+        using S = typename SrcTraits<T>::S;
         using Q = decltype(q_tag);
         auto *qs = static_cast<const Q *>(q);
-        auto *ds = static_cast<S *>(dst);
+        auto *ds = static_cast<T *>(dst);
         auto run = [&](auto red) {
             if (m.algo == QuantAlgo::kMinMax)
-                k_dq_acc_minmax<S, Q>(qs, ds, count, static_cast<S>(m.lo),
+                k_dq_acc_minmax<T, Q>(qs, ds, count, static_cast<S>(m.lo),
                                       static_cast<S>(minmax_step(m)), red);
             else
-                k_dq_acc_zps<S, Q>(qs, ds, count, static_cast<S>(m.lo),
+                k_dq_acc_zps<T, Q>(qs, ds, count, static_cast<S>(m.lo),
                                    static_cast<S>(m.hi), red);
         };
         switch (op) {

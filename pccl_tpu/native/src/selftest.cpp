@@ -159,6 +159,71 @@ static void test_quant() {
     }
 }
 
+// bf16/f16 typed kernels: every 16-bit float value is exactly representable
+// in f32, so the 16-bit path on values V must produce bit-identical
+// quantized codes to the f32 path on widen(V) — same lanes, same arithmetic.
+// Round-trips back to 16-bit must equal the f32 result narrowed.
+static void test_quant_16bit_parity() {
+    const size_t n = 4099; // odd: exercises the SIMD tail
+    std::vector<uint16_t> hb(n), hf(n);
+    std::vector<float> wb(n), wf(n);
+    for (size_t i = 0; i < n; ++i) {
+        float v = std::sin(i * 0.05f) * 3.0f + 0.25f;
+        hb[i] = kernels::f32_to_bf16(v);
+        hf[i] = kernels::f32_to_f16(v);
+        wb[i] = kernels::bf16_to_f32(hb[i]);
+        wf[i] = kernels::f16_to_f32(hf[i]);
+    }
+    struct Cfg {
+        proto::DType src;
+        const void *half;
+        const float *wide;
+    };
+    for (auto algo : {proto::QuantAlgo::kMinMax, proto::QuantAlgo::kZeroPointScale}) {
+        for (auto qd : {proto::DType::kU8, proto::DType::kU16, proto::DType::kI8}) {
+            for (const Cfg &c : {Cfg{proto::DType::kBF16, hb.data(), wb.data()},
+                                 Cfg{proto::DType::kF16, hf.data(), wf.data()}}) {
+                auto mh = quant::compute_meta(algo, qd, c.src, c.half, n);
+                auto mw = quant::compute_meta(algo, qd, proto::DType::kF32, c.wide, n);
+                CHECK(mh.lo == mw.lo && mh.hi == mw.hi); // same min/max seen
+                std::vector<uint8_t> qh(quant::quantized_bytes(qd, n));
+                std::vector<uint8_t> qw(qh.size());
+                quant::quantize(mh, c.half, qh.data(), n);
+                quant::quantize(mw, c.wide, qw.data(), n);
+                CHECK(qh == qw); // bit-identical codes
+                // dequantize back to 16-bit == f32 dequant narrowed
+                std::vector<uint16_t> back(n);
+                std::vector<float> backw(n);
+                quant::dequantize_set(mh, qh.data(), back.data(), n);
+                quant::dequantize_set(mw, qw.data(), backw.data(), n);
+                const bool bf16 = c.src == proto::DType::kBF16;
+                for (size_t i = 0; i < n; ++i) {
+                    uint16_t want = bf16 ? kernels::f32_to_bf16(backw[i])
+                                         : kernels::f32_to_f16(backw[i]);
+                    CHECK(back[i] == want);
+                    if (back[i] != want) return; // don't spam 4k failures
+                }
+                // fused accumulate: acc = narrow(widen(acc0) + dq) per element
+                std::vector<uint16_t> acc(n), acc0(n);
+                for (size_t i = 0; i < n; ++i)
+                    acc0[i] = acc[i] = bf16 ? kernels::f32_to_bf16(0.5f + i * 1e-4f)
+                                            : kernels::f32_to_f16(0.5f + i * 1e-4f);
+                quant::dequantize_accumulate(mh, proto::RedOp::kSum, qh.data(),
+                                             acc.data(), n);
+                for (size_t i = 0; i < n; ++i) {
+                    float a = bf16 ? kernels::bf16_to_f32(acc0[i])
+                                   : kernels::f16_to_f32(acc0[i]);
+                    float d = backw[i];
+                    uint16_t want = bf16 ? kernels::f32_to_bf16(a + d)
+                                         : kernels::f32_to_f16(a + d);
+                    CHECK(acc[i] == want);
+                    if (acc[i] != want) return;
+                }
+            }
+        }
+    }
+}
+
 static void test_atsp() {
     // 4-node asymmetric instance with a known-best ring 0->1->2->3->0
     const double INF = 100;
@@ -509,6 +574,7 @@ int main() {
     test_hash();
     test_kernels();
     test_quant();
+    test_quant_16bit_parity();
     test_atsp();
     {
         // guarded allocator: bytes usable end-to-end, balanced live count
