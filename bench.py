@@ -68,6 +68,26 @@ def main() -> None:
                   file=sys.stderr)
             extra["diloco_outer_step_s"] = None
             extra["diloco_phases_s"] = None
+        # BASELINE config 5 churn clause: 4 peers, one SIGKILL + rejoin
+        # mid-run; steady vs churn-window outer-step time
+        try:
+            for k, v in native_bench.run_diloco_churn_bench().items():
+                extra[k] = round(v, 4) if isinstance(v, float) else v
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: diloco churn failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            for k in ("diloco_steady_step_s", "diloco_churn_step_s",
+                      "worlds_seen", "steps_completed", "rejoiner_joined"):
+                extra[k] = None
+        # BASELINE config 4 shape: 2 emulated slices, plain vs quantized DCN
+        try:
+            for k, v in native_bench.run_hierarchical_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: hierarchical failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["hier2_step_s"] = None
+            extra["hier2_q8_step_s"] = None
         # the constrained-wire A/B: quantization's reason to exist. 4-peer
         # ring over an emulated 100 Mbit/s WAN egress (PCCLT_WIRE_MBPS),
         # fp32 vs u8-ZPS, both reported as fp32-equivalent busbw.

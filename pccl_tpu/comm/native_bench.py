@@ -286,9 +286,12 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
     old = os.environ.get("PCCLT_WIRE_MBPS")
     os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
     try:
+        # bases sit in 45xxx: every derived port (p2p, ss=+1000, bench=+2000)
+        # stays below the 48500+ bench masters and the 50000+ fixed test
+        # ports, so a bench can run concurrently with the pytest suite
         for name, quant, mport, base in (
-                ("wan_fp32_busbw_gbps", False, 48671, 49100),
-                ("wan_u8zps_busbw_gbps", True, 48673, 49300)):
+                ("wan_fp32_busbw_gbps", False, 48671, 45000),
+                ("wan_u8zps_busbw_gbps", True, 48673, 45400)):
             res = _spawn_world(world, _peer_wan,
                                _port("PCCLT_BENCH_MASTER_PORT_WAN", mport),
                                (world, nbytes, iters, quant, base),
@@ -317,10 +320,9 @@ def run_wan_bf16_bench(world: int = 4, nbytes: int = 16 << 20, iters: int = 3,
     os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
     try:
         for name, quant, mport, base in (
-                # bases chosen clear of the 48xxx bench bands and the
-                # 50000-51800 fixed ports in tests/test_comm_native.py
-                ("wan_bf16_busbw_gbps", False, 48675, 52300),
-                ("wan_bf16_u8zps_busbw_gbps", True, 48677, 52500)):
+                # same 45xxx reasoning as run_wan_bench
+                ("wan_bf16_busbw_gbps", False, 48675, 45800),
+                ("wan_bf16_u8zps_busbw_gbps", True, 48677, 46200)):
             res = _spawn_world(world, _peer_wan,
                                _port("PCCLT_BENCH_MASTER_PORT_WANB", mport),
                                (world, nbytes, iters, quant, base, True),
@@ -335,6 +337,207 @@ def run_wan_bf16_bench(world: int = 4, nbytes: int = 16 << 20, iters: int = 3,
             os.environ["PCCLT_WIRE_MBPS"] = old
     out["wan_bf16_quant_speedup"] = (out["wan_bf16_u8zps_busbw_gbps"] /
                                      out["wan_bf16_busbw_gbps"])
+    return out
+
+
+def _peer_diloco_churn(rank, master_port, q, world, params_n, n_steps, port_base):
+    """DiLoCo peer for the churn bench: runs a FIXED number of outer steps
+    (the tag-0 collective keeps live peers in lockstep, so everyone exits
+    together — a wall-clock deadline would strand the last peer mid-op in
+    slow retries), admitting pending joiners between steps and riding out
+    churn via the ring's retry contract. rank 0 streams per-step progress
+    so the orchestrator can time the SIGKILL against real steps."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from pccl_tpu.comm.api import (Communicator, MasterUnreachableError,
+                                   TooFewPeersError)
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+
+    # connect with retries: on a saturated 1-core host the master thread can
+    # miss an accept window while peer processes churn through jax imports
+    for attempt in range(10):
+        comm = Communicator("127.0.0.1", master_port,
+                            p2p_port=port_base + rank * 8,
+                            ss_port=port_base + 1000 + rank * 8,
+                            bench_port=port_base + 2000 + rank * 8)
+        try:
+            comm.connect()
+            break
+        except MasterUnreachableError:
+            comm.destroy()
+            if attempt == 9:
+                raise
+            time.sleep(1.0)
+    # incumbents wait for the initial world; the rejoiner (rank >= world)
+    # joins whoever is alive
+    deadline = time.time() + 120
+    while rank < world and comm.world_size < world and time.time() < deadline:
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+    params = {"w": jnp.zeros((params_n,), jnp.float32)}
+    diloco = Diloco(comm, params, DilocoConfig(shm_staging=True))
+    cur = diloco.params()
+    steps = []
+    solo = False
+    for it in range(n_steps):
+        if comm.are_peers_pending():
+            comm.update_topology()
+        inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
+        jax.block_until_ready(inner)
+        t0 = time.perf_counter()
+        try:
+            cur = diloco.outer_step(inner)
+            jax.block_until_ready(cur)
+        except TooFewPeersError:
+            solo = True  # everyone else finished/died; remaining steps are moot
+            break
+        steps.append((time.perf_counter() - t0, comm.world_size))
+        if rank == 0:
+            q.put({"progress": it + 1})
+    q.put({"rank": rank, "steps": steps, "solo": solo})
+    comm.destroy()
+
+
+def run_diloco_churn_bench(world: int = 4, params_n: int = 12_500_000,
+                           n_steps: int = 8, kill_after: int = 3,
+                           master_port: int = 48679,
+                           base: int = 41000) -> Dict[str, Any]:
+    """BASELINE config 5's churn clause: DiLoCo outer steps at `world`
+    peers with one SIGKILL mid-run and a fresh peer rejoining (the
+    reference stress recipe, stresstest_orchestrator.py:9-41). The kill
+    fires once rank 0 has completed `kill_after` steady steps. Returns
+    steady-state median step seconds (full world), the worst churn-window
+    step (absorbs abort + retry + re-establish), and the worlds rank 0
+    saw."""
+    import queue as queue_mod
+    import signal
+
+    from pccl_tpu.comm.api import MasterNode
+
+    # default base 41000: derived bands span 41000-43064, clear of the hier
+    # bench (38xxx-40xxx) and the wan legs (45xxx-48xxx). Callers that may
+    # run concurrently with bench.py (the pytest wedge regression) pass
+    # their own master_port and base.
+    master = MasterNode("0.0.0.0",
+                        _port("PCCLT_BENCH_MASTER_PORT_CHURN", master_port))
+    master.run()
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_peer_diloco_churn,
+                             args=(r, master.port, q, world, params_n, n_steps,
+                                   base))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        # collect rank 0's progress stream; once the ring has done
+        # `kill_after` steady steps, SIGKILL the last rank mid-step and
+        # bring a fresh peer into the group
+        results = []
+        killed = False
+        rejoiner = None
+        deadline = time.time() + 600
+        while len(results) < world and time.time() < deadline:
+            try:
+                msg = q.get(timeout=10)
+            except queue_mod.Empty:
+                continue
+            if "progress" in msg:
+                if not killed and msg["progress"] >= kill_after:
+                    os.kill(procs[-1].pid, signal.SIGKILL)
+                    killed = True
+                    rejoiner = ctx.Process(
+                        target=_peer_diloco_churn,
+                        args=(world, master.port, q, world, params_n, n_steps,
+                              base))
+                    rejoiner.start()
+            else:
+                results.append(msg)
+        for p in procs + ([rejoiner] if rejoiner else []):
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+    finally:
+        master.interrupt()
+        master.destroy()
+    r0 = next((r for r in results if r["rank"] == 0), None)
+    if r0 is None:
+        raise RuntimeError(
+            f"churn bench: rank 0 never reported (wedged?); got results from "
+            f"ranks {sorted(r['rank'] for r in results)}")
+    if not r0["steps"]:
+        raise RuntimeError(f"churn bench: rank 0 completed no steps: {r0}")
+    times = [t for t, w in r0["steps"]]
+    worlds = [w for t, w in r0["steps"]]
+    # steady = steps at full world; churn window = the slowest step (the one
+    # that ate the abort + retry + rejoin establish)
+    steady = sorted(t for t, w in r0["steps"] if w >= world) or sorted(times)
+    return {
+        "diloco_steady_step_s": steady[len(steady) // 2],
+        "diloco_churn_step_s": max(times),
+        "worlds_seen": sorted(set(worlds)),
+        "steps_completed": len(times),
+        "rejoiner_joined": any(r["rank"] == world for r in results),
+    }
+
+
+def _peer_hier(rank, master_port, q, elems, iters, quantize, port_base):
+    """One emulated TPU slice (4 virtual CPU devices) of the hierarchical
+    all-reduce: ICI staging on the slice mesh, the native ring across
+    slices, optional u8-ZPS on the DCN hop (BASELINE config 4 shape)."""
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.comm.api import DataType, QuantizationAlgorithm
+    from pccl_tpu.parallel import mesh as mesh_lib
+    from pccl_tpu.parallel.hierarchical import HierarchicalAllReduce
+
+    comm = _connect(rank, master_port, 2, port_base)
+    mesh = mesh_lib.make_mesh(jax.devices()[:4], axis_names=("dp",), shape=(4,))
+    sharding = NamedSharding(mesh, P("dp"))
+    g = jax.device_put(jnp.full((elems,), float(rank + 1), jnp.float32), sharding)
+    tree = {"g": g}
+    kw = {}
+    if quantize:
+        kw = dict(quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+                  quantized_dtype=DataType.UINT8)
+    h = HierarchicalAllReduce(comm, tree, shm_staging=not quantize, **kw)
+    times = []
+    for it in range(iters + 1):  # first is warmup (jit compiles)
+        t0 = time.perf_counter()
+        out = h.all_reduce(tree)
+        jax.block_until_ready(out)
+        if it > 0:
+            times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_hierarchical_bench(elems: int = 8 << 20, iters: int = 3) -> Dict[str, float]:
+    """BASELINE config 4 shape: 2 slices x 4 virtual devices, global mean of
+    an `elems` fp32 tree — plain DCN hop vs u8-ZPS quantized. Returns median
+    step seconds for both."""
+    out = {}
+    # base 38000: derived bands (p2p/ss +1000/bench +2000) span 38000-40032,
+    # clear of the churn bench (41xxx-43xxx), the wan legs (45xxx-48xxx),
+    # the 48500+ masters and the 50000+ test ports
+    for name, quant, mport, base in (("hier2_step_s", False, 48681, 38000),
+                                     ("hier2_q8_step_s", True, 48683, 38400)):
+        res = _spawn_world(2, _peer_hier,
+                           _port("PCCLT_BENCH_MASTER_PORT_HIER", mport),
+                           (elems, iters, quant, base), inline_rank0=False)
+        times = next(r["times"] for r in res if r["rank"] == 0)
+        out[name] = sorted(times)[len(times) // 2]
     return out
 
 

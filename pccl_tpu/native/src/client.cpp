@@ -690,9 +690,21 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
                 (unsigned long long)desc.tag, tx.valid(), rx.valid(),
                 int(consumed_abort), (unsigned long long)seq);
     if (!tx.valid() || !rx.valid() || !tx.alive() ||
-        (consumed_abort && verdict_aborted)) {
-        st = consumed_abort && verdict_aborted ? Status::kAborted
-                                               : Status::kConnectionLost;
+        (consumed_abort && verdict_aborted) || op->abort.load()) {
+        st = (consumed_abort && verdict_aborted) || op->abort.load()
+                 ? Status::kAborted
+                 : Status::kConnectionLost;
+        // Bailing WITHOUT running the ring, but the op commenced group-wide:
+        // a peer that made it into the ring may already have raced data for
+        // this seq into our tables — same-host CMA descriptors wait for our
+        // ack, and its stage-end join blocks until they complete. Retire the
+        // op's tag range so those sends get ack-dropped. Without this, an
+        // abort delivered to some members before ring entry wedges the
+        // member that entered (churn repro: SIGKILL a 4th peer right after
+        // the survivors' retry op commences).
+        const uint64_t base_tag = seq << 16;
+        if (rx.valid()) rx.table().purge_range(base_tag, base_tag + 0x10000);
+        if (tx.valid()) tx.table().purge_range(base_tag, base_tag + 0x10000);
     } else {
         reduce::RingCtx ctx;
         ctx.tx = tx;

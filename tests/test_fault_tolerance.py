@@ -376,3 +376,22 @@ def test_peer_group_isolation_under_churn(master):
     finally:
         for p in g0 + g1:
             p.kill()
+
+
+def test_churn_abort_before_ring_no_wedge():
+    """Regression: SIGKILL a peer right as the survivors' retry collective
+    commences. Members that receive the abort BEFORE entering the ring must
+    still retire the op's tag range — otherwise the member that did enter
+    waits forever on CMA acks for its staged sends (join_tx wedge; the
+    group then never admits the rejoiner). The orchestrated churn bench is
+    the repro harness: it must complete all steps with the rejoiner
+    admitted, well inside the wedge-detection timeout."""
+    from pccl_tpu.comm.native_bench import run_diloco_churn_bench
+
+    # own master port + port band (35xxx-37xxx): this test may run while
+    # bench.py exercises the same helper on its default ports
+    r = run_diloco_churn_bench(world=4, params_n=2_000_000, n_steps=4,
+                               kill_after=1, master_port=48685, base=35000)
+    assert r["steps_completed"] == 4, r
+    assert r["rejoiner_joined"], r
+    assert 3 in r["worlds_seen"] and 4 in r["worlds_seen"], r

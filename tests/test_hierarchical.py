@@ -100,3 +100,72 @@ def test_two_slices_global_mean(eight_devices):
     # global mean of 1.0 and 2.0 → 1.5, identical bytes on both slices
     np.testing.assert_array_equal(results[0], results[1])
     np.testing.assert_allclose(results[0], np.full((8, 8), 1.5))
+
+
+@needs_native
+def test_two_slices_quantized_dcn_hop(eight_devices):
+    """BASELINE config 4's quantized variant: the cross-slice (DCN) hop runs
+    u8 zero-point/scale on the wire while ICI layout/restore stays exact.
+    Both slices must end bit-identical (the shared-state hash invariant) and
+    within 8-bit range error of the true mean."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.comm import (Communicator, DataType, MasterNode,
+                               QuantizationAlgorithm)
+    from pccl_tpu.parallel import mesh as mesh_lib
+    from pccl_tpu.parallel.hierarchical import HierarchicalAllReduce
+
+    master = MasterNode("0.0.0.0", 52600)
+    master.run()
+    errors = []
+    results = {}
+
+    def slice_proc(slice_id):
+        try:
+            devs = eight_devices[slice_id * 4:(slice_id + 1) * 4]
+            mesh = mesh_lib.make_mesh(devs, axis_names=("dp",), shape=(4,))
+            sharding = NamedSharding(mesh, P("dp"))
+            rng = np.random.default_rng(11)  # SAME base values on both slices
+            base = rng.standard_normal(4096).astype(np.float32)
+            g = jax.device_put(jnp.asarray(base) + float(slice_id), sharding)
+
+            port = 54700 + slice_id * 16
+            comm = Communicator("127.0.0.1", master.port, p2p_port=port,
+                                ss_port=port + 4, bench_port=port + 8)
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < 2:
+                if time.time() > deadline:
+                    raise TimeoutError("world never reached 2")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+
+            h = HierarchicalAllReduce(
+                comm, {"g": g},
+                quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+                quantized_dtype=DataType.UINT8)
+            out = h.all_reduce({"g": g})
+            assert out["g"].sharding.is_equivalent_to(sharding, 1)
+            results[slice_id] = np.asarray(out["g"])
+            comm.destroy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((slice_id, e))
+
+    ts = [threading.Thread(target=slice_proc, args=(s,)) for s in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    master.interrupt()
+    master.destroy()
+    assert not errors, f"slice failures: {errors}"
+    # bit-identical across slices (quantized wire bytes forwarded verbatim)
+    np.testing.assert_array_equal(results[0], results[1])
+    # true mean = base + 0.5; u8-ZPS over the values' range bounds the error
+    rng = np.random.default_rng(11)
+    want = rng.standard_normal(4096).astype(np.float32) + 0.5
+    span = want.max() - want.min() + 1.0  # + slice offsets widen the range
+    assert np.abs(results[0] - want).max() < span / 255 * 2
