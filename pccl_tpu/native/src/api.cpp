@@ -3,6 +3,7 @@
 #include "../include/pcclt.h"
 
 #include <cstring>
+#include <deque>
 #include <string>
 #include <vector>
 
@@ -243,38 +244,58 @@ pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const 
         if (!valid_reduce_op(&descs[i])) return pccltInvalidArgument;
     std::vector<bool> done(n_ops, false);
     while (true) {
-        // launch all outstanding ops, await them, retry failures with the
-        // (possibly shrunken) world — reference pcclAllReduceMultipleWithRetry
+        // launch outstanding ops windowed over the concurrent-op cap (a
+        // batch larger than PCCLT_MAX_CONCURRENT_COLLECTIVE_OPS drains the
+        // oldest in-flight op to free a worker slot — the reference never
+        // windows because its pool of 32 exceeds its test batches), await
+        // them, retry failures with the (possibly shrunken) world —
+        // reference pcclAllReduceMultipleWithRetry
         bool any_launched = false;
-        for (uint64_t i = 0; i < n_ops; ++i) {
+        bool all_ok = true;
+        std::deque<uint64_t> inflight;
+        pccltResult_t hard_rc = pccltSuccess;
+        auto drain_one = [&]() {
+            uint64_t j = inflight.front();
+            inflight.pop_front();
+            pcclt::client::ReduceInfo ri;
+            auto st = c->client->await_reduce(descs[j].tag, &ri);
+            if (st == Status::kOk) {
+                done[j] = true;
+                fill_info(infos ? &infos[j] : nullptr, ri);
+            } else if (st == Status::kAborted || st == Status::kConnectionLost) {
+                all_ok = false; // retried next round
+            } else if (hard_rc == pccltSuccess) {
+                hard_rc = to_result(st);
+            }
+        };
+        for (uint64_t i = 0; i < n_ops && hard_rc == pccltSuccess; ++i) {
             if (done[i]) continue;
-            auto st = c->client->all_reduce_async(sendbufs[i], recvbufs[i], counts[i],
-                                                  to_dtype(dtype), to_desc(&descs[i]));
-            if (st != Status::kOk) {
-                // await whatever we already launched this round — returning
-                // with in-flight ops would leave workers referencing caller
-                // buffers and their tags permanently "duplicate"
-                for (uint64_t j = 0; j < i; ++j)
-                    if (!done[j]) c->client->await_reduce(descs[j].tag, nullptr);
+            for (;;) {
+                auto st = c->client->all_reduce_async(sendbufs[i], recvbufs[i],
+                                                      counts[i], to_dtype(dtype),
+                                                      to_desc(&descs[i]));
+                if (st == Status::kOk) {
+                    inflight.push_back(i);
+                    any_launched = true;
+                    break;
+                }
+                if (st == Status::kPendingAsyncOps && !inflight.empty()) {
+                    drain_one();
+                    if (hard_rc != pccltSuccess) break;
+                    continue;
+                }
+                // genuine launch failure (or the pool is full of OTHER
+                // callers' ops): await whatever we already launched —
+                // returning with in-flight ops would leave workers
+                // referencing caller buffers and their tags permanently
+                // "duplicate"
+                while (!inflight.empty()) drain_one();
                 return st == Status::kTooFewPeers ? pccltTooFewPeers : to_result(st);
             }
-            any_launched = true;
         }
+        while (!inflight.empty()) drain_one();
+        if (hard_rc != pccltSuccess) return hard_rc;
         if (!any_launched) return pccltSuccess;
-        bool all_ok = true;
-        for (uint64_t i = 0; i < n_ops; ++i) {
-            if (done[i]) continue;
-            pcclt::client::ReduceInfo ri;
-            auto st = c->client->await_reduce(descs[i].tag, &ri);
-            if (st == Status::kOk) {
-                done[i] = true;
-                fill_info(infos ? &infos[i] : nullptr, ri);
-            } else if (st == Status::kAborted || st == Status::kConnectionLost) {
-                all_ok = false;
-            } else {
-                return to_result(st);
-            }
-        }
         if (all_ok) return pccltSuccess;
         // re-establish the mesh before retrying
         auto st = c->client->update_topology();

@@ -53,6 +53,8 @@ def _run_peers(master_port, world, worker, base):
         t.start()
     for t in threads:
         t.join(timeout=120)
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"peers still running after 120s (wedged?): {hung}"
     assert not errors, f"peer failures: {errors}"
 
 
@@ -305,3 +307,44 @@ def test_all_gather_solo(master):
         np.testing.assert_array_equal(out[0], x)
 
     _run_peers(master.port, 1, worker, _ports(4))
+
+
+_soak_step_times = {}
+
+
+@pytest.mark.parametrize("world", [4, 8])
+def test_large_world_concurrent_soak(master, world, monkeypatch):
+    """The reference's concurrent_reduce_test workload at scale (its
+    main.cpp runs 12 concurrent 8M-element reduces): world 8 with 12
+    in-flight tagged collectives per peer over a connection pool. This is
+    the first thing that exposes SinkTable wakeup herding and master
+    consensus cost at large worlds — parametrized over world 4 vs 8 so a
+    super-linear per-step blowup shows up as the 8-leg timing out rather
+    than as silent degradation. Values are checked exactly (integer sums
+    in fp32 range)."""
+    # pool of 4 << batch of 12: forces MultipleWithRetry's windowed launch
+    # (drain-oldest at the concurrent-op cap) on every run
+    monkeypatch.setenv("PCCLT_MAX_CONCURRENT_COLLECTIVE_OPS", "4")
+    n_tensors, elems = 12, 8 << 20
+    step_times = _soak_step_times  # module-level: world 4 runs first
+
+    def worker(comm, rank):
+        xs = [np.full(elems, float(rank + 1 + i), dtype=np.float32)
+              for i in range(n_tensors)]
+        t0 = time.perf_counter()
+        comm.all_reduce_multiple_with_retry(xs)
+        if rank == 0:
+            step_times[world] = time.perf_counter() - t0
+        base = world * (world + 1) / 2  # sum of (rank+1)
+        for i, x in enumerate(xs):
+            assert float(x[0]) == base + world * i, \
+                f"tensor {i}: {x[0]} != {base + world * i}"
+            assert float(x[-1]) == base + world * i
+
+    _run_peers(master.port, world, worker, _ports(world * 8))
+    # no super-linear per-step blowup: world 8 moves ~1.17x the bytes per
+    # peer (2(N-1)/N) over 2x the peers on one core — 8x the world-4 wall
+    # time is a generous linear-ish bound that still catches wakeup herding
+    # or consensus-cost explosions
+    if world == 8 and 4 in step_times:
+        assert step_times[8] < 8 * step_times[4], step_times
