@@ -19,9 +19,12 @@ def _candidate_paths():
     env = os.environ.get("PCCLT_LIB")
     if env:
         yield Path(env)
-    here = Path(__file__).resolve().parent.parent / "native"
-    yield here / "build" / "libpcclt.so"
-    yield here / "libpcclt.so"
+    pkg = Path(__file__).resolve().parent.parent
+    # packaged install (pip): setup.py's CMake build drops the core here
+    yield pkg / "_lib" / "libpcclt.so"
+    # source tree: the documented cmake -B build layout
+    yield pkg / "native" / "build" / "libpcclt.so"
+    yield pkg / "native" / "libpcclt.so"
 
 
 def load():

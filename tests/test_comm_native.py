@@ -48,7 +48,10 @@ def _run_peers(master_port, world, worker, base):
         finally:
             comm.destroy()
 
-    threads = [threading.Thread(target=peer, args=(r,)) for r in range(world)]
+    # daemon: a wedged peer must fail the test via the liveness assert below,
+    # not hang interpreter shutdown waiting on a non-daemon thread
+    threads = [threading.Thread(target=peer, args=(r,), daemon=True)
+               for r in range(world)]
     for t in threads:
         t.start()
     for t in threads:
@@ -312,6 +315,7 @@ def test_all_gather_solo(master):
 _soak_step_times = {}
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("world", [4, 8])
 def test_large_world_concurrent_soak(master, world, monkeypatch):
     """The reference's concurrent_reduce_test workload at scale (its
