@@ -73,7 +73,7 @@ extern "C" {
 pccltResult_t pccltInit(void) { return pccltSuccess; }
 
 const char *pccltGetBuildInfo(void) {
-    return "pcclt 0.1.0 (PCCP/1, tpu-native pccl-capability core)";
+    return "pcclt 0.1.0 (PCCP/2, tpu-native pccl-capability core)";
 }
 
 // ---------------- master ----------------

@@ -199,7 +199,15 @@ Status Client::connect() {
     if (!welcome) return Status::kMasterUnreachable;
     try {
         wire::Reader r(welcome->payload);
-        if (r.u8() != 1) return Status::kMasterUnreachable;
+        if (r.u8() != 1) {
+            std::string reason;
+            try {
+                reason = r.str();
+            } catch (...) {}
+            PLOG(kError) << "master rejected join"
+                         << (reason.empty() ? "" : ": " + reason);
+            return Status::kMasterUnreachable;
+        }
         uuid_ = proto::get_uuid(r);
     } catch (...) { return Status::kInternal; }
     connected_ = true;

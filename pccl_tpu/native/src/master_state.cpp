@@ -89,6 +89,19 @@ std::vector<uint64_t> MasterState::take_pending_closes() {
 std::vector<Outbox> MasterState::on_hello(uint64_t conn, uint32_t src_ip,
                                           const proto::HelloC2M &h) {
     std::vector<Outbox> out;
+    if (h.wire_rev != proto::kWireRev) {
+        // mixed-version peer: reject with a diagnosable error instead of
+        // letting it misparse every later packet (a rev-1 client's hello
+        // has no rev byte, so this reads its peer-group high byte = 0)
+        PLOG(kWarn) << "rejecting client on conn " << conn << ": wire rev "
+                    << int(h.wire_rev) << " != PCCP/" << int(proto::kWireRev);
+        wire::Writer w;
+        w.u8(0);
+        w.str("wire protocol revision mismatch (master speaks PCCP/" +
+              std::to_string(int(proto::kWireRev)) + ")");
+        out.push_back({conn, PacketType::kM2CWelcome, w.take()});
+        return out;
+    }
     ClientInfo c;
     c.uuid = proto::uuid_random();
     c.conn_id = conn;

@@ -92,7 +92,14 @@ enum class SyncStrategy : uint8_t { kEnforcePopular = 0, kRxOnly, kTxOnly };
 
 // --- typed payloads for the structured packets ---
 
+// PCCP wire revision. Rev 2: family-tagged addresses in every
+// address-carrying packet, and this byte LEADING the hello so the master
+// can kick a mismatched client with a clear error instead of misparsing
+// its packets (a rev-1 client's first hello byte lands here as 0).
+inline constexpr uint8_t kWireRev = 2;
+
 struct HelloC2M {
+    uint8_t wire_rev = kWireRev;
     uint32_t peer_group = 0;
     uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
     std::string adv_ip; // empty = use source address of the connection

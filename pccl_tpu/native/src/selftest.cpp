@@ -49,6 +49,46 @@ static void test_wire() {
     CHECK(r.str() == "hello");
     CHECK(r.f64() == 3.25);
     CHECK(r.done());
+
+    // family-tagged wire addresses (PCCP/2): v4 roundtrips; a v6 payload
+    // fails the decode loudly (IPv4-first plumbing must not connect to a
+    // placeholder address); an unknown family fails too
+    proto::SharedStateSyncResp resp;
+    resp.outdated = 1;
+    resp.dist_ip = 0x7F000001;
+    resp.dist_port = 1234;
+    resp.revision = 9;
+    auto dec = proto::SharedStateSyncResp::decode(resp.encode());
+    CHECK(dec && dec->dist_ip == 0x7F000001 && dec->dist_port == 1234 &&
+          dec->revision == 9);
+    {
+        wire::Writer w6;
+        w6.u8(1);  // outdated
+        w6.u8(0);  // failed
+        w6.u8(6);  // family 6
+        for (int i = 0; i < 16; ++i) w6.u8(static_cast<uint8_t>(i));
+        w6.u16(4321);
+        w6.u64(11);
+        w6.u32(0);
+        w6.u32(0);
+        auto d6 = proto::SharedStateSyncResp::decode(w6.take());
+        CHECK(!d6);
+    }
+    {
+        // hello carries the wire rev first; roundtrip keeps it
+        proto::HelloC2M h;
+        h.peer_group = 3;
+        auto hd = proto::HelloC2M::decode(h.encode());
+        CHECK(hd && hd->wire_rev == proto::kWireRev && hd->peer_group == 3);
+    }
+    {
+        wire::Writer wb;
+        wb.u8(1);
+        wb.u8(0);
+        wb.u8(9);  // unknown family: structurally invalid, decode must fail
+        auto db = proto::SharedStateSyncResp::decode(wb.take());
+        CHECK(!db);
+    }
 }
 
 static void test_hash() {

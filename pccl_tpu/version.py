@@ -8,5 +8,7 @@ __version__ = "0.1.0"
 BUILD_INFO = {
     "name": "pccl_tpu",
     "version": __version__,
-    "protocol": "PCCP/1",  # Pod Collective Communication Protocol, wire rev 1
+    # Pod Collective Communication Protocol; rev 2 = family-tagged wire
+    # addresses (IPv6-ready format, IPv4-first plumbing)
+    "protocol": "PCCP/2",
 }

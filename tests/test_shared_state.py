@@ -513,3 +513,43 @@ def test_peer_groups_different_keys_isolated(master):
         assert leader[2] == follower[2]
         assert leader[2] in (float(group + 1), 0.0)
         assert sorted([leader[:2], follower[:2]]) == [(0, 128 * 4), (128 * 4, 0)]
+
+
+def test_distributor_fanout_outdated_majority(master):
+    """Reference outdated-majority scenario class
+    (test_shared_state_distribution.cpp): ONE peer holds the winning
+    content and FIVE peers are simultaneously outdated. The elected
+    distributor serves every outdated peer's full-state fetch (fan-out is
+    serial per distributor socket — this measures it instead of assuming
+    it): all six converge bitwise, the distributor's tx_bytes ≈ 5x the
+    state size, each outdated peer receives exactly one state's worth,
+    and nobody retransmits sideways."""
+    world, elems = 6, 256 * 1024
+    nbytes = elems * 4
+
+    def worker(comm, rank):
+        rng = np.random.default_rng(99)  # the POPULAR content (5 agree at rev 0)
+        if rank == 0:
+            # the advanced peer: different content at a higher revision wins
+            # the election outright (revision precedence)
+            w = rng.standard_normal(elems).astype(np.float32) * 2 + 1
+            info = _sync(comm, {"w": w}, revision=3)
+        else:
+            w = rng.standard_normal(elems).astype(np.float32)
+            info = _sync(comm, {"w": w}, revision=0)
+        return info.tx_bytes, info.rx_bytes, info.revision, w.tobytes()
+
+    results, errors = _run_peers(master.port, world, worker, timeout=180)
+    assert not errors, errors
+    # everyone converged bitwise on the winner's content at its revision
+    winner = results[0][3]
+    for r in range(world):
+        assert results[r][2] == 3, f"rank {r} revision {results[r][2]}"
+        assert results[r][3] == winner, f"rank {r} content differs"
+    # the distributor fanned the full state to each of the 5 outdated peers
+    tx0, rx0 = results[0][0], results[0][1]
+    assert rx0 == 0
+    assert tx0 == (world - 1) * nbytes, (tx0, nbytes)
+    for r in range(1, world):
+        assert results[r][0] == 0, f"rank {r} sent {results[r][0]} bytes"
+        assert results[r][1] == nbytes, f"rank {r} received {results[r][1]}"
