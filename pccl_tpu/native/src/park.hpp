@@ -30,10 +30,16 @@ public:
     uint32_t epoch() const { return word_.load(std::memory_order_acquire); }
 
     // Wake all waiters (and bump the epoch so racing waiters don't sleep).
+    // The wake syscall is skipped when no thread is parked: the epoch bump
+    // is sequenced before the waiter-count load, and a waiter registers
+    // BEFORE its kernel-side word re-check, so a racing waiter either sees
+    // the bumped epoch (and never sleeps) or is counted (and gets woken).
+    // This makes multi-event signalling (sharded tables) ~one atomic each.
     void signal() {
-        word_.fetch_add(1, std::memory_order_release);
-        syscall(SYS_futex, reinterpret_cast<uint32_t *>(&word_), FUTEX_WAKE_PRIVATE,
-                INT32_MAX, nullptr, nullptr, 0);
+        word_.fetch_add(1, std::memory_order_seq_cst);
+        if (waiters_.load(std::memory_order_seq_cst) != 0)
+            syscall(SYS_futex, reinterpret_cast<uint32_t *>(&word_),
+                    FUTEX_WAKE_PRIVATE, INT32_MAX, nullptr, nullptr, 0);
     }
 
     // Sleep until the epoch moves past `seen` or timeout_ms elapses
@@ -46,16 +52,19 @@ public:
             ts.tv_nsec = static_cast<long>(timeout_ms % 1000) * 1'000'000L;
             tsp = &ts;
         }
+        waiters_.fetch_add(1, std::memory_order_seq_cst);
         long rc = syscall(SYS_futex,
                           reinterpret_cast<uint32_t *>(
                               const_cast<std::atomic<uint32_t> *>(&word_)),
                           FUTEX_WAIT_PRIVATE, seen, tsp, nullptr, 0);
+        waiters_.fetch_sub(1, std::memory_order_seq_cst);
         (void)rc; // EAGAIN (word moved) and EINTR both mean "re-check"
         return word_.load(std::memory_order_acquire) != seen;
     }
 
 private:
     std::atomic<uint32_t> word_{0};
+    mutable std::atomic<uint32_t> waiters_{0};
 };
 
 // Wait until `pred()` holds or `timeout_ms` elapses (timeout_ms < 0 = no

@@ -471,7 +471,7 @@ void SinkTable::attach(const std::shared_ptr<MultiplexConn> &conn) {
     members_.push_back(conn);
 }
 
-void SinkTable::on_conn_dead() { ev_.signal(); }
+void SinkTable::on_conn_dead() { signal_all(); }
 
 void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
                               bool consumer_pull) {
@@ -508,7 +508,7 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
         }
         // consumer_pull: pendings stay queued for consume_cma()
     }
-    ev_.signal();
+    signal_tag(tag);
     // resolve CMA descriptors that arrived before the sink: pull the bytes
     // now, on the registering thread (it is about to wait for them anyway)
     for (auto &d : descs)
@@ -518,7 +518,7 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
 size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms,
                               bool *cma_pending) {
     size_t cur = 0;
-    park::wait_event(ev_, timeout_ms, [&] {
+    park::wait_event(shard_ev(tag), timeout_ms, [&] {
         std::lock_guard lk(mu_);
         if (cma_pending && pending_descs_.count(tag)) {
             *cma_pending = true; // a claimable same-host descriptor arrived
@@ -588,7 +588,7 @@ void SinkTable::unregister_sink(uint64_t tag) {
 std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
     uint64_t tag, int timeout_ms, const std::atomic<bool> *abort) {
     std::optional<std::vector<uint8_t>> out;
-    park::wait_event(ev_, timeout_ms, [&] {
+    park::wait_event(shard_ev(tag), timeout_ms, [&] {
         bool dead;
         {
             std::lock_guard lk(mu_);
@@ -644,6 +644,9 @@ void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
         retired_.emplace_back(lo, hi);
         if (retired_.size() > 128) retired_.pop_front();
     }
+    // wake every waiter: a consumer parked on a purged tag must notice the
+    // missing sink now, not at its next poll slice
+    signal_all();
     // ack dropped descriptors so the sender's pending handle completes —
     // the data is unwanted (op aborted), not undeliverable
     for (auto &d : dropped)
@@ -1090,14 +1093,14 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
                 it->second.add_extent(d.off + off, d.off + off + want);
                 off += want;
             }
-            table_->ev_.signal();
+            table_->signal_tag(tag);
         }
         {
             std::lock_guard lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it != table_->sinks_.end()) --it->second.busy;
         }
-        table_->ev_.signal();
+        table_->signal_tag(tag);
         send_ctl(kCmaAck, tag, d.off);
         return;
     }
@@ -1107,7 +1110,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
             auto it = table_->sinks_.find(tag);
             if (it != table_->sinks_.end()) --it->second.busy;
         }
-        table_->ev_.signal();
+        table_->signal_tag(tag);
         send_ctl(kCmaNack, tag, d.off);
         PLOG(kWarn) << "CMA identity probe failed for pid " << d.pid
                     << "; falling back to streaming";
@@ -1140,14 +1143,14 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
             }
         }
         off += want;
-        if (ok && !cancelled) table_->ev_.signal();
+        if (ok && !cancelled) table_->signal_tag(tag);
     }
     {
         std::lock_guard lk(table_->mu_);
         auto it = table_->sinks_.find(tag);
         if (it != table_->sinks_.end()) --it->second.busy;
     }
-    table_->ev_.signal();
+    table_->signal_tag(tag);
     send_ctl(ok || cancelled ? kCmaAck : kCmaNack, tag, d.off);
     if (!ok && !cancelled)
         PLOG(kWarn) << "CMA read from pid " << d.pid << " failed (errno " << errno
@@ -1452,7 +1455,7 @@ void MultiplexConn::rx_loop() {
             } else if (fill_now) {
                 do_cma_fill(tag, d);
             } else {
-                table_->ev_.signal(); // wake a consumer polling for the claim
+                table_->signal_tag(tag); // wake a consumer polling for the claim
             }
             continue;
         }
@@ -1499,7 +1502,7 @@ void MultiplexConn::rx_loop() {
                     if (ok && !cancelled) it->second.add_extent(off, off + n);
                 }
             }
-            table_->ev_.signal();
+            table_->signal_tag(tag);
             if (!ok) break;
         } else {
             scratch.resize(n);
@@ -1523,7 +1526,7 @@ void MultiplexConn::rx_loop() {
                 }
                 // retired tag: straggler from a purged op — drop the bytes
             }
-            table_->ev_.signal();
+            table_->signal_tag(tag);
         }
     }
     alive_ = false;

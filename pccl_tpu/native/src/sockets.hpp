@@ -277,7 +277,29 @@ private:
     bool is_retired(uint64_t tag) const; // caller holds mu_
 
     std::mutex mu_;
+    // Sharded wakeups: per-tag waiters (wait_filled, recv_queued, the
+    // consume_cma poll) park on their tag's shard so a fill for one tag
+    // does not thundering-herd every concurrent op's consumer (the
+    // reference reaches the same goal with per-tag lock-free inboxes).
+    // Shards are array members — no lifetime hazard when a purge erases a
+    // sink under a parked waiter, unlike true per-sink events. The global
+    // ev_ is kept for whole-table waiters (wait_not_busy, conn death);
+    // tag-signals bump both, which is ~free now that park::Event skips the
+    // wake syscall without waiters.
+    static constexpr size_t kEvShards = 16;
     park::Event ev_;
+    park::Event shard_evs_[kEvShards];
+    park::Event &shard_ev(uint64_t tag) {
+        return shard_evs_[(tag ^ (tag >> 16) ^ (tag >> 32)) & (kEvShards - 1)];
+    }
+    void signal_tag(uint64_t tag) {
+        shard_ev(tag).signal();
+        ev_.signal();
+    }
+    void signal_all() {
+        for (auto &e : shard_evs_) e.signal();
+        ev_.signal();
+    }
     std::map<uint64_t, Sink> sinks_;
     std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_;
     std::multimap<uint64_t, PendingDesc> pending_descs_;

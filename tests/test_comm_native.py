@@ -312,9 +312,6 @@ def test_all_gather_solo(master):
     _run_peers(master.port, 1, worker, _ports(4))
 
 
-_soak_step_times = {}
-
-
 @pytest.mark.slow
 @pytest.mark.parametrize("world", [4, 8])
 def test_large_world_concurrent_soak(master, world, monkeypatch):
@@ -322,15 +319,15 @@ def test_large_world_concurrent_soak(master, world, monkeypatch):
     main.cpp runs 12 concurrent 8M-element reduces): world 8 with 12
     in-flight tagged collectives per peer over a connection pool. This is
     the first thing that exposes SinkTable wakeup herding and master
-    consensus cost at large worlds — parametrized over world 4 vs 8 so a
-    super-linear per-step blowup shows up as the 8-leg timing out rather
-    than as silent degradation. Values are checked exactly (integer sums
-    in fp32 range)."""
+    consensus cost at large worlds. A blowup is caught by the absolute
+    per-leg ceiling below (a ratio between the two legs proved too noisy
+    on a loaded 1-core host: both measurements swing with suite load).
+    Values are checked exactly (integer sums in fp32 range)."""
     # pool of 4 << batch of 12: forces MultipleWithRetry's windowed launch
     # (drain-oldest at the concurrent-op cap) on every run
     monkeypatch.setenv("PCCLT_MAX_CONCURRENT_COLLECTIVE_OPS", "4")
     n_tensors, elems = 12, 8 << 20
-    step_times = _soak_step_times  # module-level: world 4 runs first
+    step_times = {}
 
     def worker(comm, rank):
         xs = [np.full(elems, float(rank + 1 + i), dtype=np.float32)
@@ -346,9 +343,6 @@ def test_large_world_concurrent_soak(master, world, monkeypatch):
             assert float(x[-1]) == base + world * i
 
     _run_peers(master.port, world, worker, _ports(world * 8))
-    # no super-linear per-step blowup: world 8 moves ~1.17x the bytes per
-    # peer (2(N-1)/N) over 2x the peers on one core — 8x the world-4 wall
-    # time is a generous linear-ish bound that still catches wakeup herding
-    # or consensus-cost explosions
-    if world == 8 and 4 in step_times:
-        assert step_times[8] < 8 * step_times[4], step_times
+    # the step moves 2(N-1)/N * 384 MB per peer; healthy runs take 2-20 s
+    # even under full-suite load — 90 s means herding/consensus collapse
+    assert step_times[world] < 90, step_times

@@ -74,3 +74,151 @@ def test_optimize_topology_three_peers(monkeypatch):
     assert not stuck, "worker threads hung"
     assert not errors, f"peer failures: {errors}"
     assert sorted(done) == [0, 1, 2]
+
+
+def test_optimize_survives_peer_departure(monkeypatch):
+    """Optimize-protocol failure path (reference exercises this surface in
+    ccoip_master_handler.cpp:392-563): a peer leaves BETWEEN the optimize
+    votes — the master's disconnect recheck must complete the round with
+    the survivors instead of waiting forever for the missing vote, and the
+    adopted ring must still carry collectives."""
+    monkeypatch.setenv("PCCLT_BENCH_SECONDS", "0.5")  # measurable probe window
+    from pccl_tpu.comm import Communicator, MasterNode, ReduceOp
+
+    from conftest import alloc_ports
+
+    ports = alloc_ports(96)
+    master = MasterNode("0.0.0.0", ports)
+    master.run()
+    errors = []
+    done = []
+
+    def worker(rank):
+        try:
+            base = ports + 8 + rank * 16
+            comm = Communicator("127.0.0.1", master.port, p2p_port=base,
+                                ss_port=base + 4, bench_port=base + 8)
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < 4:
+                if time.time() > deadline:
+                    raise TimeoutError("world never reached 4")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+
+            if rank == 3:
+                # deserter: never votes optimize, leaves while the others'
+                # votes are parked at the master
+                time.sleep(1.0)
+                comm.destroy()
+                done.append(rank)
+                return
+            comm.optimize_topology()  # blocks on rank 3's vote until it dies
+            x = np.ones(512, dtype=np.float32)
+            info = comm.all_reduce(x, op=ReduceOp.SUM)
+            assert info.world_size == 3 and x[0] == 3.0
+            done.append(rank)
+            comm.destroy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+          for r in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    stuck = [t for t in ts if t.is_alive()]
+    master.interrupt()
+    master.destroy()
+    assert not stuck, "worker threads hung"
+    assert not errors, f"peer failures: {errors}"
+    assert sorted(done) == [0, 1, 2, 3]
+
+
+def test_optimize_isolated_from_garbage_reports(monkeypatch):
+    """Bad-estimate robustness through the real protocol: a pending client
+    (joined, never admitted) floods the master with bandwidth reports —
+    NaN, inf, zero, negative, unknown target uuids. None of it may poison
+    or wedge the accepted group's optimize round, and the master must stay
+    alive throughout."""
+    import math
+    import socket
+    import struct
+
+    monkeypatch.setenv("PCCLT_BENCH_SECONDS", "0.2")
+    from pccl_tpu.comm import Communicator, MasterNode, ReduceOp
+
+    from conftest import alloc_ports
+
+    ports = alloc_ports(96)
+    master = MasterNode("0.0.0.0", ports)
+    master.run()
+
+    def frame(ptype, payload=b""):
+        return struct.pack(">IH", 2 + len(payload), ptype) + payload
+
+    def hello(peer_group):
+        # HelloC2M: wire_rev u8, peer_group u32, 3x u16 ports, str adv_ip
+        return (struct.pack(">BIHHH", 2, peer_group, 1, 2, 3) +
+                struct.pack(">I", 0))
+
+    errors = []
+    done = []
+
+    def worker(rank):
+        try:
+            base = ports + 8 + rank * 16
+            comm = Communicator("127.0.0.1", master.port, p2p_port=base,
+                                ss_port=base + 4, bench_port=base + 8)
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < 3:
+                if time.time() > deadline:
+                    raise TimeoutError("world never reached 3")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            barrier.wait(timeout=30)  # 1: formation done — garbage may join
+            barrier.wait(timeout=30)  # 2: garbage landed — optimize now
+            comm.optimize_topology()
+            x = np.ones(256, dtype=np.float32)
+            info = comm.all_reduce(x, op=ReduceOp.SUM)
+            assert info.world_size == 3 and x[0] == 3.0
+            done.append(rank)
+            comm.destroy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    barrier = threading.Barrier(4)
+    ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+          for r in range(3)]
+    for t in ts:
+        t.start()
+
+    # the garbage client joins AFTER formation completes (a hello racing the
+    # formation votes would be admitted into the establish round and wedge
+    # it); post-formation nobody votes topology, so it stays pending — and a
+    # pending client's reports must not poison the accepted group
+    barrier.wait(timeout=60)  # 1: workers formed their world
+    with socket.create_connection(("127.0.0.1", master.port), timeout=10) as s:
+        s.sendall(frame(0x1001, hello(peer_group=7)))
+        time.sleep(0.3)  # welcome lands; we ignore it
+        for mbps in (float("nan"), float("inf"), -float("inf"), 0.0, -1.0,
+                     1e308, 5e-324):
+            payload = bytes(range(16)) + struct.pack(">d", mbps)
+            s.sendall(frame(0x100A, payload))
+        # truncated report (uuid only) for good measure
+        s.sendall(frame(0x100A, bytes(16)))
+        time.sleep(0.2)
+        barrier.wait(timeout=30)  # 2: release the workers to optimize
+        for t in ts:
+            t.join(timeout=120)
+
+    stuck = [t for t in ts if t.is_alive()]
+    master.interrupt()
+    master.destroy()
+    assert not stuck, "worker threads hung"
+    assert not errors, f"peer failures: {errors}"
+    assert sorted(done) == [0, 1, 2]
