@@ -1,1 +1,1 @@
-from . import gpt  # noqa: F401
+from . import gpt, llama  # noqa: F401

@@ -1,0 +1,191 @@
+"""Llama-family decoder — the second model family of pccl_tpu.
+
+The reference exercises its library with one model family (nanoGPT,
+/root/reference/python/examples/nanogptddp/train_pccl.py); this adds the
+other architecture modern open-weight training actually runs — grouped-query
+attention, SwiGLU MLPs, untied unembedding — built on the same TPU-first
+substrate as models/gpt.py:
+
+- stacked per-layer arrays under `lax.scan` (one traced layer body),
+- bfloat16 compute on the MXU with fp32 norms/params,
+- rotary embeddings, causal iota masking, static shapes,
+- tensor-parallel weight layouts keyed the same way as GPT's
+  (column-parallel in-projections, row-parallel out-projections; see
+  mesh.LLAMA_PARAM_SPECS).
+
+GQA is laid out so the per-head K/V tensors shard over tp like Q does: the
+kv heads are repeated to the full head count ON DEVICE just before the
+attention op, which keeps any attn_fn override (flash attention, ring
+attention) oblivious to the grouping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 8
+    n_head: int = 8
+    n_kv_head: int = 4          # grouped-query: kv heads < query heads
+    n_embd: int = 512
+    ffn_dim: int = 1408         # SwiGLU hidden (≈ 8/3 · d, rounded to 64)
+    block_size: int = 1024
+    rope_theta: float = 500000.0
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+    def __post_init__(self):
+        assert self.n_head % self.n_kv_head == 0
+
+
+def _init_linear(key, fan_in: int, shape) -> jax.Array:
+    std = 1.0 / math.sqrt(fan_in)
+    return jax.random.normal(key, shape, dtype=jnp.float32) * std
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, jax.Array]:
+    """Parameter pytree; per-layer tensors carry a leading [n_layer] dim."""
+    d, L, Dh = cfg.n_embd, cfg.n_layer, cfg.head_dim
+    kv = cfg.n_kv_head * Dh
+    ks = jax.random.split(key, 9)
+    scale_res = 1.0 / math.sqrt(2 * L)
+    return {
+        "tok_emb": jax.random.normal(ks[0], (cfg.vocab_size, d), jnp.float32) * 0.02,
+        "ln1_g": jnp.ones((L, d), jnp.float32),
+        "ln2_g": jnp.ones((L, d), jnp.float32),
+        "attn_q": _init_linear(ks[1], d, (L, d, d)),                # column parallel
+        "attn_kv": _init_linear(ks[2], d, (L, d, 2 * kv)),          # column parallel
+        "attn_out": _init_linear(ks[3], d, (L, d, d)) * scale_res,  # row parallel
+        "mlp_gate": _init_linear(ks[4], d, (L, d, cfg.ffn_dim)),    # column parallel
+        "mlp_up": _init_linear(ks[5], d, (L, d, cfg.ffn_dim)),      # column parallel
+        "mlp_down": _init_linear(ks[6], cfg.ffn_dim,
+                                 (L, cfg.ffn_dim, d)) * scale_res,  # row parallel
+        "lnf_g": jnp.ones((d,), jnp.float32),
+        "head": _init_linear(ks[7], d, (d, cfg.vocab_size)),        # untied
+    }
+
+
+def _rmsnorm(x: jax.Array, gain: jax.Array) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (y * gain).astype(x.dtype)
+
+
+def _rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim. x: [B, T, H, Dh]."""
+    _, T, _, Dh = x.shape
+    half = Dh // 2
+    freqs = jnp.exp(-math.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh]."""
+    _, T, _, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    qi = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    ki = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    logits = jnp.where(ki <= qi, logits.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: LlamaConfig,
+           attn_fn=None) -> jax.Array:
+    B, T, d = x.shape
+    H, Hkv, Dh = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    h = _rmsnorm(x, layer["ln1_g"])
+    q = (h @ layer["attn_q"].astype(h.dtype)).reshape(B, T, H, Dh)
+    kvp = h @ layer["attn_kv"].astype(h.dtype)  # [B, T, 2·Hkv·Dh]
+    k, v = jnp.split(kvp, 2, axis=-1)
+    k = k.reshape(B, T, Hkv, Dh)
+    v = v.reshape(B, T, Hkv, Dh)
+    q = _rope(q, cfg.rope_theta)
+    k = _rope(k, cfg.rope_theta)
+    # repeat kv groups to full head count so attn_fn overrides (flash/ring)
+    # see ordinary multi-head inputs; XLA fuses the broadcast into the gemm
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    att = (attn_fn or _attention)(q, k, v).reshape(B, T, d)
+    x = x + att @ layer["attn_out"].astype(att.dtype)
+    h = _rmsnorm(x, layer["ln2_g"])
+    gated = jax.nn.silu(h @ layer["mlp_gate"].astype(h.dtype)) * \
+        (h @ layer["mlp_up"].astype(h.dtype))
+    return x + gated @ layer["mlp_down"].astype(h.dtype)
+
+
+_LAYER_KEYS = ("ln1_g", "ln2_g", "attn_q", "attn_kv", "attn_out",
+               "mlp_gate", "mlp_up", "mlp_down")
+
+
+def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
+            attn_fn=None) -> jax.Array:
+    """tokens: int32 [B, T] → logits float32 [B, T, vocab]."""
+    x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
+    layers = {k: params[k] for k in _LAYER_KEYS}
+
+    def body(h, layer):
+        return _block(h, layer, cfg, attn_fn), None
+
+    x, _ = lax.scan(body, x, layers)
+    x = _rmsnorm(x, params["lnf_g"])
+    return x.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+
+
+def loss_fn(params, tokens, targets, cfg: LlamaConfig, attn_fn=None) -> jax.Array:
+    logits = forward(params, tokens, cfg, attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_jit(params, tokens, cfg: LlamaConfig):
+    return forward(params, tokens, cfg)
+
+
+def tiny_config(**overrides) -> LlamaConfig:
+    base = dict(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2, n_embd=128,
+                ffn_dim=320, block_size=128)
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+# ladder roughly tracking the open-weight llama-class shapes
+PRESETS = {
+    "tiny": dict(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2, n_embd=128,
+                 ffn_dim=320, block_size=128),
+    "1b": dict(vocab_size=32000, n_layer=16, n_head=32, n_kv_head=8,
+               n_embd=2048, ffn_dim=5632, block_size=2048),
+    "7b": dict(vocab_size=32000, n_layer=32, n_head=32, n_kv_head=32,
+               n_embd=4096, ffn_dim=11008, block_size=4096),
+    "8b": dict(vocab_size=128256, n_layer=32, n_head=32, n_kv_head=8,
+               n_embd=4096, ffn_dim=14336, block_size=8192,
+               rope_theta=500000.0),
+}
+
+
+def named_config(name: str, **overrides) -> LlamaConfig:
+    base = dict(PRESETS[name])
+    base.update(overrides)
+    return LlamaConfig(**base)

@@ -74,6 +74,30 @@ def gpt_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
             for k, spec in GPT_PARAM_SPECS.items()}
 
 
+# --- Llama sharding rules (pccl_tpu.models.llama.init_params layout) ---
+
+LLAMA_PARAM_SPECS: Dict[str, P] = {
+    "tok_emb": P("tp", None),
+    "ln1_g": P(None, None),
+    "ln2_g": P(None, None),
+    # column-parallel in-projections (q, grouped kv, both MLP branches)
+    "attn_q": P(None, None, "tp"),
+    "attn_kv": P(None, None, "tp"),
+    "mlp_gate": P(None, None, "tp"),
+    "mlp_up": P(None, None, "tp"),
+    # row-parallel out-projections
+    "attn_out": P(None, "tp", None),
+    "mlp_down": P(None, "tp", None),
+    "lnf_g": P(None),
+    "head": P(None, "tp"),  # untied unembedding: vocab-parallel
+}
+
+
+def llama_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, _drop_missing_axes(spec, mesh))
+            for k, spec in LLAMA_PARAM_SPECS.items()}
+
+
 def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
     """Tokens [B, T]: batch over dp, optionally sequence over `seq_axis`."""
     return NamedSharding(mesh, _drop_missing_axes(P("dp", seq_axis), mesh))

@@ -1,0 +1,103 @@
+"""Llama model family: GQA + SwiGLU decoder on the shared TPU substrate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pccl_tpu.models import llama
+
+
+def test_forward_shapes_and_gqa():
+    cfg = llama.tiny_config()
+    assert cfg.n_kv_head < cfg.n_head  # the grouped path is actually exercised
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    # kv projection is sized for the GROUPED heads, not the full head count
+    kv = cfg.n_kv_head * cfg.head_dim
+    assert params["attn_kv"].shape == (cfg.n_layer, cfg.n_embd, 2 * kv)
+    tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    logits = llama.forward_jit(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_loss_decreases_one_sgd_step():
+    cfg = llama.tiny_config()
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    loss0, grads = jax.value_and_grad(llama.loss_fn)(params, tokens, targets, cfg)
+    params2 = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+    loss1 = llama.loss_fn(params2, tokens, targets, cfg)
+    assert float(loss1) < float(loss0)
+
+
+def test_causality():
+    cfg = llama.tiny_config()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    t1 = jnp.zeros((1, 8), dtype=jnp.int32)
+    t2 = t1.at[0, 7].set(3)
+    l1 = llama.forward(params, t1, cfg)
+    l2 = llama.forward(params, t2, cfg)
+    np.testing.assert_allclose(np.asarray(l1[0, :7]), np.asarray(l2[0, :7]),
+                               atol=1e-5)
+
+
+def test_gqa_equals_mha_with_tiled_kv_weights():
+    """Grouped-query semantics: a GQA model must equal a FULL-head model
+    whose k/v projection weights are the grouped weights tiled across each
+    head group (repeating activations after projection == projecting with
+    repeated weights). A swapped k/v split, wrong head-major reshape, or
+    wrong repeat axis all break this wholesale."""
+    cfg_g = llama.tiny_config()                       # Hkv=2 < H=4
+    cfg_f = llama.tiny_config(n_kv_head=cfg_g.n_head)  # plain MHA
+    params = llama.init_params(jax.random.PRNGKey(3), cfg_g)
+    H, Hkv, Dh = cfg_g.n_head, cfg_g.n_kv_head, cfg_g.head_dim
+    kw, vw = np.split(np.asarray(params["attn_kv"]), 2, axis=-1)
+
+    def tile(w):  # [L, d, Hkv*Dh] -> [L, d, H*Dh], repeating per head group
+        L, d, _ = w.shape
+        return np.repeat(w.reshape(L, d, Hkv, Dh), H // Hkv,
+                         axis=2).reshape(L, d, H * Dh)
+
+    params_f = dict(params)
+    params_f["attn_kv"] = jnp.asarray(np.concatenate([tile(kw), tile(vw)], -1))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 12), 0,
+                                cfg_g.vocab_size)
+    out_g = np.asarray(llama.forward(params, tokens, cfg_g))
+    out_f = np.asarray(llama.forward(params_f, tokens, cfg_f))
+    np.testing.assert_allclose(out_g, out_f, rtol=2e-2, atol=2e-2)
+    assert np.mean(np.abs(out_g - out_f)) < 1e-3  # same math, bf16 noise only
+
+
+def test_tensor_parallel_forward(eight_devices):
+    """tp-sharded params produce the same logits as replicated ones — the
+    LLAMA_PARAM_SPECS layouts must be consistent with the model's contraction
+    dims (a wrong spec changes results or fails to lower)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.parallel import mesh as mesh_lib
+
+    cfg = llama.tiny_config()
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0, cfg.vocab_size)
+    ref = np.asarray(llama.forward_jit(params, tokens, cfg))
+
+    mesh = mesh_lib.make_mesh(eight_devices, axis_names=("dp", "tp"), shape=(4, 2))
+    shardings = mesh_lib.llama_param_sharding(mesh)
+    sharded = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    tok_sh = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    out = np.asarray(llama.forward_jit(sharded, tok_sh, cfg))
+    # bf16 + different contraction order across shardings: compare loosely
+    # elementwise and tightly in aggregate (a wrong PartitionSpec produces
+    # wholesale garbage, not 1e-2-scale noise)
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.1)
+    # measured bf16 noise on this shape: mean |diff| ~0.007 on logits of
+    # ~0.8 mean magnitude; wholesale-garbage specs land orders above this
+    assert np.mean(np.abs(out - ref)) < 0.03
+
+
+def test_named_configs():
+    c = llama.named_config("8b")
+    assert (c.n_layer, c.n_head, c.n_kv_head, c.n_embd) == (32, 32, 8, 4096)
+    c2 = llama.named_config("tiny", block_size=64)
+    assert c2.block_size == 64

@@ -200,25 +200,32 @@ def test_optimize_isolated_from_garbage_reports(monkeypatch):
     # the garbage client joins AFTER formation completes (a hello racing the
     # formation votes would be admitted into the establish round and wedge
     # it); post-formation nobody votes topology, so it stays pending — and a
-    # pending client's reports must not poison the accepted group
-    barrier.wait(timeout=60)  # 1: workers formed their world
-    with socket.create_connection(("127.0.0.1", master.port), timeout=10) as s:
-        s.sendall(frame(0x1001, hello(peer_group=7)))
-        time.sleep(0.3)  # welcome lands; we ignore it
-        for mbps in (float("nan"), float("inf"), -float("inf"), 0.0, -1.0,
-                     1e308, 5e-324):
-            payload = bytes(range(16)) + struct.pack(">d", mbps)
-            s.sendall(frame(0x100A, payload))
-        # truncated report (uuid only) for good measure
-        s.sendall(frame(0x100A, bytes(16)))
-        time.sleep(0.2)
-        barrier.wait(timeout=30)  # 2: release the workers to optimize
-        for t in ts:
-            t.join(timeout=120)
-
-    stuck = [t for t in ts if t.is_alive()]
-    master.interrupt()
-    master.destroy()
-    assert not stuck, "worker threads hung"
+    # pending client's reports must not poison the accepted group.
+    # try/finally: a worker failing before its barrier breaks the barrier —
+    # teardown must still run and the WORKER's error must surface, not the
+    # main thread's BrokenBarrierError.
+    try:
+        barrier.wait(timeout=60)  # 1: workers formed their world
+        with socket.create_connection(("127.0.0.1", master.port),
+                                      timeout=10) as s:
+            s.sendall(frame(0x1001, hello(peer_group=7)))
+            time.sleep(0.3)  # welcome lands; we ignore it
+            for mbps in (float("nan"), float("inf"), -float("inf"), 0.0, -1.0,
+                         1e308, 5e-324):
+                payload = bytes(range(16)) + struct.pack(">d", mbps)
+                s.sendall(frame(0x100A, payload))
+            # truncated report (uuid only) for good measure
+            s.sendall(frame(0x100A, bytes(16)))
+            time.sleep(0.2)
+            barrier.wait(timeout=30)  # 2: release the workers to optimize
+            for t in ts:
+                t.join(timeout=120)
+    except threading.BrokenBarrierError:
+        pass  # a worker died early; its exception is in `errors`
+    finally:
+        stuck = [t for t in ts if t.is_alive()]
+        master.interrupt()
+        master.destroy()
     assert not errors, f"peer failures: {errors}"
+    assert not stuck, "worker threads hung"
     assert sorted(done) == [0, 1, 2]
