@@ -123,7 +123,10 @@ def add_model_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--model", default="nano",
                     help="model preset: nano (CI default) | tiny | gpt2 | "
                          "gpt2-medium | gpt2-large | gpt2-xl "
-                         "(pccl_tpu.models.gpt.PRESETS)")
+                         "(pccl_tpu.models.gpt.PRESETS); with "
+                         "--family llama: nano | tiny | 1b | 7b | 8b")
+    ap.add_argument("--family", choices=["gpt", "llama"], default="gpt",
+                    help="model family (pccl_tpu.models)")
     ap.add_argument("--profile", action="store_true",
                     help="print a per-section time table at the end "
                          "(pccl_tpu.utils.profiler)")
@@ -132,14 +135,15 @@ def add_model_args(ap: argparse.ArgumentParser) -> None:
 
 
 def model_config(args, *, char_level: bool):
-    """GPTConfig from the --model preset, with --block as the sequence
-    length; char-level text data caps the vocab at 256 bytes."""
-    from pccl_tpu.models import gpt
+    """Model config from --family and the --model preset, with --block as
+    the sequence length; char-level text data caps the vocab at 256 bytes."""
+    from pccl_tpu.models import gpt, llama
 
+    family = gpt if getattr(args, "family", "gpt") == "gpt" else llama
     overrides = {"block_size": args.block}
     if char_level:
         overrides["vocab_size"] = 256
-    return gpt.named_config(args.model, **overrides)
+    return family.named_config(args.model, **overrides)
 
 
 def finish_profile(args, prof) -> None:

@@ -61,9 +61,9 @@ def main() -> int:
     import optax
 
     from pccl_tpu.comm import DataType
-    from pccl_tpu.models import gpt
     from pccl_tpu.parallel import mesh as mesh_lib
     from pccl_tpu.parallel.hierarchical import HierarchicalAllReduce
+    from pccl_tpu.parallel.train import family
 
     comm = common.connect(args)
 
@@ -76,17 +76,18 @@ def main() -> int:
     else:
         mesh = mesh_lib.make_mesh(devices, ("dp", "tp"))
     cfg = common.model_config(args, char_level=args.data == "text")
-    param_sharding = mesh_lib.gpt_param_sharding(mesh)
+    model, sharding_fn = family(cfg)  # gpt or llama by config family
+    param_sharding = sharding_fn(mesh)
     data_sharding = mesh_lib.batch_sharding(mesh)
 
-    init = jax.jit(gpt.init_params, static_argnames=("cfg",),
+    init = jax.jit(model.init_params, static_argnames=("cfg",),
                    out_shardings=param_sharding)
     params = init(jax.random.PRNGKey(args.seed), cfg)
     tx = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
     opt_state = tx.init(params)
 
     loss_and_grad = jax.jit(
-        jax.value_and_grad(functools.partial(gpt.loss_fn, cfg=cfg)),
+        jax.value_and_grad(functools.partial(model.loss_fn, cfg=cfg)),
         in_shardings=(param_sharding, data_sharding, data_sharding),
     )
 

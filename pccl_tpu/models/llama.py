@@ -173,6 +173,10 @@ def tiny_config(**overrides) -> LlamaConfig:
 
 # ladder roughly tracking the open-weight llama-class shapes
 PRESETS = {
+    # nano: the examples' CI default — 2-peer loopback convergence fits a
+    # single-core test budget (mirrors gpt.PRESETS["nano"])
+    "nano": dict(vocab_size=256, n_layer=2, n_head=4, n_kv_head=2, n_embd=64,
+                 ffn_dim=192, block_size=64),
     "tiny": dict(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2, n_embd=128,
                  ffn_dim=320, block_size=128),
     "1b": dict(vocab_size=32000, n_layer=16, n_head=32, n_kv_head=8,

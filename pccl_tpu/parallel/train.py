@@ -1,10 +1,11 @@
-"""Sharded training-step construction for the flagship GPT.
+"""Sharded training-step construction for the model families.
 
 Builds a jitted SPMD train step over a Mesh: parameters laid out by the
-tensor-parallel rules in mesh.py, batch sharded over dp, optimizer = AdamW
-(optax). Gradients reduce over dp implicitly through XLA's SPMD partitioner —
-inside a slice this rides ICI; across slices the DiLoCo outer loop
-(pccl_tpu/parallel/diloco.py) moves pseudo-gradients over the CCoIP-style ring.
+tensor-parallel rules in mesh.py (dispatched on the config's family — GPT or
+Llama), batch sharded over dp, optimizer = AdamW (optax). Gradients reduce
+over dp implicitly through XLA's SPMD partitioner — inside a slice this rides
+ICI; across slices the DiLoCo outer loop (pccl_tpu/parallel/diloco.py) moves
+pseudo-gradients over the CCoIP-style ring.
 
 Reference parity: this replaces the torch training loops in
 /root/reference/python/examples/ (train_pccl.py, sync_diloco.py) as the
@@ -20,14 +21,23 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models import gpt
+from ..models import gpt, llama
 from . import mesh as mesh_lib
 
 
-def make_train_state(key, cfg: gpt.GPTConfig, mesh, lr: float = 3e-4):
+def family(cfg):
+    """(model module, param-sharding builder) for a config's family — the
+    public dispatch examples and user loops should use."""
+    if isinstance(cfg, llama.LlamaConfig):
+        return llama, mesh_lib.llama_param_sharding
+    return gpt, mesh_lib.gpt_param_sharding
+
+
+def make_train_state(key, cfg, mesh, lr: float = 3e-4):
     """Init params + AdamW optimizer state, placed with TP/DP shardings."""
-    param_sharding = mesh_lib.gpt_param_sharding(mesh)
-    init = jax.jit(gpt.init_params, static_argnames=("cfg",),
+    model, sharding_fn = family(cfg)
+    param_sharding = sharding_fn(mesh)
+    init = jax.jit(model.init_params, static_argnames=("cfg",),
                    out_shardings=param_sharding)
     params = init(key, cfg)
     tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
@@ -35,17 +45,18 @@ def make_train_state(key, cfg: gpt.GPTConfig, mesh, lr: float = 3e-4):
     return params, tx, opt_state
 
 
-def build_train_step(cfg: gpt.GPTConfig, tx, mesh, attn_fn=None,
+def build_train_step(cfg, tx, mesh, attn_fn=None,
                      seq_axis: str | None = None):
     """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
 
     attn_fn: optional attention override (e.g. ring attention for sequence
     parallelism over `seq_axis`)."""
-    param_sharding = mesh_lib.gpt_param_sharding(mesh)
+    model, sharding_fn = family(cfg)
+    param_sharding = sharding_fn(mesh)
     data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)
 
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(gpt.loss_fn)(
+        loss, grads = jax.value_and_grad(model.loss_fn)(
             params, tokens, targets, cfg, attn_fn)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

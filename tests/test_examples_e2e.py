@@ -130,3 +130,14 @@ def test_sync_diloco_chars_convergence():
         # so the visible drop is smaller than DDP's full-curve drop
         assert last < first - 0.5, f"insufficient learning: {first} -> {last}"
         assert "world 2" in out
+
+
+def test_llama_ddp_two_peers():
+    """The llama family rides the same DDP loop end-to-end (--family
+    dispatches model init/loss and the tensor-parallel sharding rules)."""
+    outs = _run_example(REPO / "examples" / "nanogpt_ddp" / "train_ddp.py", 2,
+                        ["--family", "llama", "--steps", "10", "--batch", "4"])
+    for out in outs:
+        first, last = _final_losses(out)
+        assert last < first
+        assert "world 2" in out
