@@ -106,7 +106,9 @@ def text_corpus(max_bytes: int = 2 << 20) -> np.ndarray:
 
 def text_batch(corpus: np.ndarray, rng: np.random.RandomState, batch: int,
                block: int):
-    """Random contiguous char windows -> (tokens, targets) int32 [B, T]."""
+    """Random contiguous char windows -> (tokens, targets) int32 [B, T].
+    (Direct sampler; make_batch_fn routes the text path through the
+    library's TokenDataset instead.)"""
     idx = rng.randint(0, len(corpus) - block - 1, size=batch)
     x = np.stack([corpus[i:i + block + 1] for i in idx])
     return x[:, :-1].astype(np.int32), x[:, 1:].astype(np.int32)
@@ -157,12 +159,17 @@ def finish_profile(args, prof) -> None:
 
 
 def make_batch_fn(args, vocab: int):
-    """Per-peer batch sampler for the chosen dataset; the shard is seeded
-    off the peer's base port (data_rng) either way."""
-    rng = data_rng(args)
+    """Per-peer batch sampler for the chosen dataset; the shard is keyed
+    off the peer's base port either way. The text path samples through the
+    library's TokenDataset (random-crop next-token pairs, disjoint stream
+    per worker_index)."""
     if getattr(args, "data", "synthetic") == "text":
-        corpus = text_corpus()
-        return lambda: text_batch(corpus, rng, args.batch, args.block)
+        from pccl_tpu.utils.data import TokenDataset
+
+        ds = TokenDataset(text_corpus(), args.block, args.batch,
+                          seed=1000, worker_index=args.base_port % 997)
+        return ds.sample
+    rng = data_rng(args)
     return lambda: synth_batch(rng, args.batch, args.block, vocab)
 
 

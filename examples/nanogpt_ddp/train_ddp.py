@@ -108,12 +108,19 @@ def main() -> int:
 
     prof = Profiler(enabled=args.profile or bool(args.trace_out))
     next_batch = common.make_batch_fn(args, cfg.vocab_size)  # per-peer shard
+    # background device prefetch: the H2D copy of batch k+1 overlaps the
+    # device compute of batch k (pccl_tpu.utils.data)
+    from pccl_tpu.utils.data import prefetch_to_device
+
+    def batches():
+        while True:
+            yield next_batch()
+
+    feed = prefetch_to_device(batches(), size=2, sharding=data_sharding)
     first_loss = last_loss = None
     for step in range(args.steps):
         common.admit_pending(comm)
-        tok, tgt = next_batch()
-        tok = jax.device_put(jnp.asarray(tok), data_sharding)
-        tgt = jax.device_put(jnp.asarray(tgt), data_sharding)
+        tok, tgt = next(feed)
         with prof.section("fwd+bwd"):
             loss, grads = loss_and_grad(params, tok, tgt)
         with prof.section("ring/all_reduce"):

@@ -1,0 +1,102 @@
+"""Token data pipeline: sharded sampling + background device prefetch.
+
+The reference's training loops sample random crops from a memmapped token
+array on every step (nanoGPT get_batch in
+/root/reference/python/examples/nanogptddp/train_pccl.py) and block on the
+host->device copy inside the step. TPU-first, the input pipeline is its own
+overlap axis: `prefetch_to_device` stages the next batches onto the device
+from a background thread so H2D rides under the previous step's compute —
+the standard TPU input recipe — and `TokenDataset` gives each peer a
+disjoint random stream so data-parallel peers don't train on identical
+batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class TokenDataset:
+    """Random-crop next-token batches over a 1-D token array (in-memory or
+    np.memmap — nothing is copied until a crop is sampled).
+
+    Each (seed, worker_index) pair is an independent deterministic stream;
+    peers pass their rank so a data-parallel group samples disjointly, the
+    same contract as the reference's DDP split.
+    """
+
+    def __init__(self, tokens: np.ndarray, block_size: int, batch_size: int,
+                 *, seed: int = 0, worker_index: int = 0):
+        if tokens.ndim != 1:
+            raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
+        if len(tokens) < block_size + 2:
+            raise ValueError(
+                f"need > block_size+1={block_size + 1} tokens, got {len(tokens)}")
+        self.tokens = tokens
+        self.block_size = block_size
+        self.batch_size = batch_size
+        self._rng = np.random.default_rng((seed << 20) ^ (worker_index + 1))
+
+    def sample(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(tokens, targets) int32 [B, T] — one random-crop batch."""
+        B, T = self.batch_size, self.block_size
+        starts = self._rng.integers(0, len(self.tokens) - T - 1, size=B)
+        x = np.stack([self.tokens[s:s + T] for s in starts])
+        y = np.stack([self.tokens[s + 1:s + T + 1] for s in starts])
+        return x.astype(np.int32), y.astype(np.int32)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        while True:
+            yield self.sample()
+
+
+def prefetch_to_device(it: Iterable, size: int = 2,
+                       sharding: Any = None) -> Iterator:
+    """Stage upcoming items on device from a background thread.
+
+    Yields `jax.device_put(item, sharding)` for each item of `it`, keeping
+    up to `size` future items already transferred — the H2D copy of batch
+    k+1 overlaps the device compute of batch k. Pytrees pass through
+    device_put leaf-wise. The feeder thread is a daemon and also stops at
+    generator close; iteration ends when `it` does.
+    """
+    import jax
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    _END = object()
+
+    def put_respecting_stop(x):
+        while not stop.is_set():
+            try:
+                q.put(x, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def feed():
+        try:
+            for item in it:
+                if stop.is_set():
+                    return
+                put_respecting_stop(jax.device_put(item, sharding))
+            put_respecting_stop(_END)
+        except BaseException as e:  # noqa: BLE001 — surfaced on next()
+            put_respecting_stop(e)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    try:
+        while True:
+            got = q.get()
+            if got is _END:
+                return
+            if isinstance(got, BaseException):
+                raise got
+            yield got
+    finally:
+        stop.set()
