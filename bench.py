@@ -37,7 +37,11 @@ def main() -> None:
     try:
         from pccl_tpu.comm import native_bench  # native C++ stack, preferred
 
-        busbw = native_bench.run_allreduce_bench(nbytes=nbytes, iters=iters)
+        stats = native_bench.run_allreduce_bench(nbytes=nbytes, iters=iters,
+                                                 return_stats=True)
+        busbw = stats["med"]
+        extra["headline_gbps_minmax"] = [round(stats["min"], 3),
+                                         round(stats["max"], 3)]
         path = "native"
     except Exception as e:  # noqa: BLE001 — fall back to pure-python path
         print(f"bench: native path unavailable ({type(e).__name__}: {e}); "
@@ -51,7 +55,15 @@ def main() -> None:
         for key, fn in [
             ("bf16_busbw_gbps", native_bench.run_allreduce_bench_bf16),
             ("quant4_busbw_gbps", native_bench.run_quantized_concurrent_bench),
+            # fp32 twin of config 2: records the loopback inversion (fp32
+            # beats u8 on a free wire) in the artifact itself
+            ("concurrent4_fp32_busbw_gbps",
+             lambda: native_bench.run_quantized_concurrent_bench(
+                 quantize=False)),
             ("shared_state4_step_s", native_bench.run_shared_state_bench),
+            # world-8 burst of 12 tagged 8M-element reduces (the reference
+            # concurrent_reduce_test workload at scale)
+            ("soak8_step_s", native_bench.run_soak_bench),
         ]:
             try:
                 extra[key] = round(fn(), 4)
@@ -88,6 +100,24 @@ def main() -> None:
                   file=sys.stderr)
             extra["hier2_step_s"] = None
             extra["hier2_q8_step_s"] = None
+        # BASELINE config 4 under its real wire: same hierarchical shape,
+        # cross-slice hop paced to 100 Mbit/s — where the quantized DCN
+        # hop must win (on unpaced loopback the A/B inverts)
+        try:
+            for k, v in native_bench.run_hierarchical_wan_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: hierarchical wan failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["hier2_wan_quant_speedup"] = None
+        # one paced DiLoCo outer step, fp32 ring vs u8-ZPS ring
+        try:
+            for k, v in native_bench.run_diloco_wan_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: diloco wan failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["diloco_wan_quant_speedup"] = None
         # the constrained-wire A/B: quantization's reason to exist. 4-peer
         # ring over an emulated 100 Mbit/s WAN egress (PCCLT_WIRE_MBPS),
         # fp32 vs u8-ZPS, both reported as fp32-equivalent busbw.
@@ -106,6 +136,39 @@ def main() -> None:
             print(f"bench: wan bf16 failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["wan_bf16_quant_speedup"] = None
+
+    # On-chip model legs: the jitted bf16 train step on the real TPU —
+    # tokens/s + MFU per family (skip-guarded when no TPU is attached;
+    # everything above runs the native CPU stack regardless).
+    if os.environ.get("PCCLT_BENCH_FAST", "0") != "1":
+        try:
+            import jax
+
+            has_tpu = any(d.platform == "tpu" for d in jax.devices())
+        except Exception:  # noqa: BLE001
+            has_tpu = False
+        if has_tpu:
+            from pccl_tpu.benchmarks import model_bench
+
+            for fam in ("gpt", "llama"):
+                try:
+                    r = model_bench.run_tpu_train_bench(fam)
+                    extra[f"tpu_train_tokens_s_{fam}"] = r["tokens_s"]
+                    extra[f"tpu_mfu_{fam}"] = r["mfu"]
+                    extra[f"tpu_config_{fam}"] = r["config"]
+                    extra[f"tpu_step_s_{fam}"] = r["step_s"]
+                    extra[f"tpu_tokens_s_minmax_{fam}"] = [
+                        r["tokens_s_min"], r["tokens_s_max"]]
+                except Exception as e:  # noqa: BLE001
+                    print(f"bench: tpu {fam} failed ({type(e).__name__}: {e})",
+                          file=sys.stderr)
+                    extra[f"tpu_train_tokens_s_{fam}"] = None
+            # headline aliases point at the flagship (gpt) leg
+            extra["tpu_train_tokens_s"] = extra.get("tpu_train_tokens_s_gpt")
+            extra["tpu_mfu"] = extra.get("tpu_mfu_gpt")
+        else:
+            print("bench: no TPU attached; skipping on-chip model legs",
+                  file=sys.stderr)
 
     print(json.dumps({
         "metric": f"allreduce_busbw_fp32_2peer_loopback({path})",

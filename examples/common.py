@@ -126,7 +126,7 @@ def add_model_args(ap: argparse.ArgumentParser) -> None:
                     help="model preset: nano (CI default) | tiny | gpt2 | "
                          "gpt2-medium | gpt2-large | gpt2-xl "
                          "(pccl_tpu.models.gpt.PRESETS); with "
-                         "--family llama: nano | tiny | 1b | 7b | 8b")
+                         "--family llama: nano | tiny | 700m | 1b | 7b | 8b")
     ap.add_argument("--family", choices=["gpt", "llama"], default="gpt",
                     help="model family (pccl_tpu.models)")
     ap.add_argument("--profile", action="store_true",

@@ -1,0 +1,3 @@
+"""On-hardware benchmarks for the model families (tokens/s, MFU)."""
+
+from . import model_bench  # noqa: F401

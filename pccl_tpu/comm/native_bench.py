@@ -21,9 +21,25 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Tuple
 
 import numpy as np
+
+
+@contextmanager
+def _paced_wire(mbps: float):
+    """PCCLT_WIRE_MBPS egress pacing for every peer spawned inside the
+    block (children inherit the env), restored on exit."""
+    old = os.environ.get("PCCLT_WIRE_MBPS")
+    os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("PCCLT_WIRE_MBPS", None)
+        else:
+            os.environ["PCCLT_WIRE_MBPS"] = old
 
 
 def _port(env: str, dflt: int) -> int:
@@ -113,13 +129,22 @@ def _peer_allreduce(rank, master_port, q, nbytes, iters, dtype_name, port_base):
 def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10,
                         dtype_name: str = "float32", port_env: str =
                         "PCCLT_BENCH_MASTER_PORT", master_port: int = 48651,
-                        port_base: int = 48700) -> float:
-    """Returns busbw in GB/s (median over iters)."""
+                        port_base: int = 48700,
+                        return_stats: bool = False):
+    """Returns busbw in GB/s (median over iters), or with
+    ``return_stats=True`` a {min, med, max} dict — the dispersion that
+    makes a headline move attributable (run-to-run spread on this loaded
+    1-core host is real; a median alone can't distinguish noise from
+    regression)."""
     res = _spawn_world(2, _peer_allreduce, _port(port_env, master_port),
                        (nbytes, iters, dtype_name, port_base))
     times = next(r["times"] for r in res if r["rank"] == 0)
-    med = sorted(times)[len(times) // 2]
-    return (nbytes / med) / 1e9
+    gbps = sorted((nbytes / t) / 1e9 for t in times)
+    # (len-1)//2 keeps the same sample the old sorted-times median picked
+    # for even iters, so the headline stays comparable across rounds
+    stats = {"min": gbps[0], "med": gbps[(len(gbps) - 1) // 2],
+             "max": gbps[-1]}
+    return stats if return_stats else stats["med"]
 
 
 def run_allreduce_bench_bf16(nbytes: int = 64 << 20, iters: int = 10) -> float:
@@ -131,20 +156,22 @@ def run_allreduce_bench_bf16(nbytes: int = 64 << 20, iters: int = 10) -> float:
 
 # ---------------------------------------------------------------- config 2
 
-def _peer_quant(rank, master_port, q, world, n_tensors, elems, iters):
+def _peer_quant(rank, master_port, q, world, n_tensors, elems, iters,
+                quantize=True):
     from pccl_tpu.comm.api import DataType, QuantizationAlgorithm, ReduceOp
 
     comm = _connect(rank, master_port, world, 48790)
     rng = np.random.default_rng(1234 + rank)
     tensors = [rng.standard_normal(elems).astype(np.float32)
                for _ in range(n_tensors)]
+    kw = {}
+    if quantize:
+        kw = dict(quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+                  quantized_dtype=DataType.INT8)
     times = []
     for it in range(iters + 1):  # first iter is warmup
         t0 = time.perf_counter()
-        comm.all_reduce_multiple_with_retry(
-            tensors, op=ReduceOp.AVG,
-            quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
-            quantized_dtype=DataType.INT8)
+        comm.all_reduce_multiple_with_retry(tensors, op=ReduceOp.AVG, **kw)
         if it > 0:
             times.append(time.perf_counter() - t0)
     q.put({"rank": rank, "times": times})
@@ -152,11 +179,15 @@ def _peer_quant(rank, master_port, q, world, n_tensors, elems, iters):
 
 
 def run_quantized_concurrent_bench(world: int = 4, n_tensors: int = 4,
-                                   elems: int = 2 << 20, iters: int = 5) -> float:
-    """int8-ZPS quantized concurrent reduces; returns payload busbw GB/s:
-    2*(N-1)/N * fp32_bytes / median step time."""
+                                   elems: int = 2 << 20, iters: int = 5,
+                                   quantize: bool = True) -> float:
+    """int8-ZPS quantized concurrent reduces (or the fp32 twin with
+    ``quantize=False`` — recorded as concurrent4_fp32_busbw_gbps so BENCH
+    is self-describing about the loopback inversion: on a free local wire
+    the u8 codec work dominates and fp32 wins; see docs/08_performance.md).
+    Returns payload busbw GB/s: 2*(N-1)/N * fp32_bytes / median step."""
     res = _spawn_world(world, _peer_quant, _port("PCCLT_BENCH_MASTER_PORT2", 48653),
-                       (world, n_tensors, elems, iters))
+                       (world, n_tensors, elems, iters, quantize))
     times = next(r["times"] for r in res if r["rank"] == 0)
     med = sorted(times)[len(times) // 2]
     payload = n_tensors * elems * 4
@@ -283,9 +314,7 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
     — 2*(N-1)/N * fp32_bytes / t, i.e. "how fast the logical gradient
     moved" — plus the speedup ratio."""
     out: Dict[str, float] = {}
-    old = os.environ.get("PCCLT_WIRE_MBPS")
-    os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
-    try:
+    with _paced_wire(mbps):
         # bases sit in 45xxx: every derived port (p2p, ss=+1000, bench=+2000)
         # stays below the 48500+ bench masters and the 50000+ fixed test
         # ports, so a bench can run concurrently with the pytest suite
@@ -299,11 +328,6 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
             times = next(r["times"] for r in res if r["rank"] == 0)
             med = sorted(times)[len(times) // 2]
             out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
-    finally:
-        if old is None:
-            os.environ.pop("PCCLT_WIRE_MBPS", None)
-        else:
-            os.environ["PCCLT_WIRE_MBPS"] = old
     out["wan_quant_speedup"] = out["wan_u8zps_busbw_gbps"] / out["wan_fp32_busbw_gbps"]
     return out
 
@@ -316,9 +340,7 @@ def run_wan_bf16_bench(world: int = 4, nbytes: int = 16 << 20, iters: int = 3,
     busbw for both plus the speedup — the bytes-adjusted proof that
     quantizing the TPU gradient dtype pays on a constrained wire."""
     out: Dict[str, float] = {}
-    old = os.environ.get("PCCLT_WIRE_MBPS")
-    os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
-    try:
+    with _paced_wire(mbps):
         for name, quant, mport, base in (
                 # same 45xxx reasoning as run_wan_bench
                 ("wan_bf16_busbw_gbps", False, 48675, 45800),
@@ -330,11 +352,6 @@ def run_wan_bf16_bench(world: int = 4, nbytes: int = 16 << 20, iters: int = 3,
             times = next(r["times"] for r in res if r["rank"] == 0)
             med = sorted(times)[len(times) // 2]
             out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
-    finally:
-        if old is None:
-            os.environ.pop("PCCLT_WIRE_MBPS", None)
-        else:
-            os.environ["PCCLT_WIRE_MBPS"] = old
     out["wan_bf16_quant_speedup"] = (out["wan_bf16_u8zps_busbw_gbps"] /
                                      out["wan_bf16_busbw_gbps"])
     return out
@@ -538,6 +555,136 @@ def run_hierarchical_bench(elems: int = 8 << 20, iters: int = 3) -> Dict[str, fl
                            (elems, iters, quant, base), inline_rank0=False)
         times = next(r["times"] for r in res if r["rank"] == 0)
         out[name] = sorted(times)[len(times) // 2]
+    return out
+
+
+def _peer_soak(rank, master_port, q, world, n_tensors, elems, port_base):
+    from pccl_tpu.comm.api import ReduceOp
+
+    comm = _connect(rank, master_port, world, port_base)
+    xs = [np.full(elems, float(rank + 1 + i), np.float32)
+          for i in range(n_tensors)]
+    warm = np.ones(1024, np.float32)
+    comm.all_reduce(warm, op=ReduceOp.SUM)  # pay p2p establishment once
+    t0 = time.perf_counter()
+    comm.all_reduce_multiple_with_retry(xs, op=ReduceOp.SUM)
+    dt = time.perf_counter() - t0
+    base = world * (world + 1) / 2
+    for i, x in enumerate(xs):
+        assert float(x[0]) == base + world * i, f"soak value wrong: {x[0]}"
+    q.put({"rank": rank, "dt": dt})
+    comm.destroy()
+
+
+def run_soak_bench(world: int = 8, n_tensors: int = 12,
+                   elems: int = 8 << 20) -> float:
+    """The reference's concurrent_reduce_test workload at scale
+    (/root/reference/tests/concurrent_reduce_test/main.cpp:48-50 runs 12
+    concurrent 8M-element reduces): one burst of ``n_tensors`` tagged
+    collectives at ``world`` peers. Returns rank 0's burst wall-clock —
+    surfaced as soak8_step_s in BENCH so large-world scaling regressions
+    (RX wakeup herding, master consensus cost) are visible across rounds.
+    The nightly guard twin with a per-byte floor lives at
+    tests/test_comm_native.py:test_large_world_concurrent_soak."""
+    # base 20000: derived bands span 20000-22028 (world 8), clear of every
+    # other band (nothing below the guard test's 25xxx)
+    res = _spawn_world(world, _peer_soak,
+                       _port("PCCLT_BENCH_MASTER_PORT_SOAK", 48703),
+                       (world, n_tensors, elems, 20000),
+                       inline_rank0=False, timeout_s=600)
+    return next(r["dt"] for r in res if r["rank"] == 0)
+
+
+def run_hierarchical_wan_bench(elems: int = 4 << 20, iters: int = 3,
+                               mbps: float = 100.0,
+                               mports=(48693, 48695),
+                               bases=(31000, 31400)) -> Dict[str, float]:
+    """BASELINE config 4 under its actual wire: the same 2-slice global mean
+    as run_hierarchical_bench, but with the cross-slice DCN hop paced to
+    ``mbps`` megabit/s (PCCLT_WIRE_MBPS; the pacer also force-disables the
+    zero-copy same-host transports, so the emulation can't be bypassed).
+    This is where the quantized hop earns its keep — on unpaced loopback the
+    u8 codec work dominates and the quantized leg *loses* (hier2_q8_step_s >
+    hier2_step_s); on a constrained inter-slice wire the 4× byte reduction
+    wins. Reference intent: the piquant WAN path
+    (/root/reference/ccoip/src/cpp/quantize.cpp:22-57). Returns median step
+    seconds for both plus the speedup ratio."""
+    out: Dict[str, float] = {}
+    with _paced_wire(mbps):
+        # bases 31000/31400: derived bands span 31000-33408, clear of the
+        # unpaced hier bench (38xxx-40xxx), the diloco-wan bands (28xxx-
+        # 30xxx), and the wedge-regression test's 35xxx-37xxx + 48685
+        for name, quant, mport, base in (
+                ("hier2_wan_step_s", False, mports[0], bases[0]),
+                ("hier2_wan_q8_step_s", True, mports[1], bases[1])):
+            res = _spawn_world(2, _peer_hier,
+                               _port("PCCLT_BENCH_MASTER_PORT_HIERWAN", mport),
+                               (elems, iters, quant, base), inline_rank0=False)
+            times = next(r["times"] for r in res if r["rank"] == 0)
+            out[name] = sorted(times)[len(times) // 2]
+    out["hier2_wan_quant_speedup"] = (out["hier2_wan_step_s"] /
+                                      out["hier2_wan_q8_step_s"])
+    return out
+
+
+def _peer_diloco_wan(rank, master_port, q, world, params_n, iters, quantize,
+                     port_base):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from pccl_tpu.comm.api import DataType, QuantizationAlgorithm
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+
+    comm = _connect(rank, master_port, world, port_base)
+    params = {"w": jnp.zeros((params_n,), jnp.float32)}
+    cfg = DilocoConfig(shm_staging=False)  # pacer disables zero-copy anyway
+    if quantize:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
+            quantized_dtype=DataType.UINT8)
+    diloco = Diloco(comm, params, cfg)
+    times = []
+    cur = diloco.params()
+    for it in range(iters + 1):  # first step pays the jit compiles
+        inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
+        jax.block_until_ready(inner)
+        t0 = time.perf_counter()
+        cur = diloco.outer_step(inner)
+        jax.block_until_ready(cur)
+        if it >= 1:
+            times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_diloco_wan_bench(world: int = 2, params_n: int = 5_000_000,
+                         iters: int = 2, mbps: float = 100.0) -> Dict[str, float]:
+    """One DiLoCo outer step on a paced wire: fp32 pseudo-gradient ring vs
+    u8-ZPS quantized ring at ``params_n`` parameters over an emulated
+    ``mbps``-megabit egress. The production DiLoCo shape (BASELINE config 5
+    runs over WAN; reference recipe
+    /root/reference/python/examples/nanogpt_diloco/sync_diloco.py) — the
+    quantized ring must win here or the feature is pointless. Returns median
+    outer-step seconds for both plus the speedup."""
+    out: Dict[str, float] = {}
+    with _paced_wire(mbps):
+        # bases 28000/28400: derived bands span 28000-30408, clear of the
+        # hier-wan bands (31xxx-33xxx) and everything above
+        for name, quant, mport, base in (
+                ("diloco_wan_step_s", False, 48689, 28000),
+                ("diloco_wan_q8_step_s", True, 48691, 28400)):
+            res = _spawn_world(world, _peer_diloco_wan,
+                               _port("PCCLT_BENCH_MASTER_PORT_DILWAN", mport),
+                               (world, params_n, iters, quant, base),
+                               inline_rank0=False, timeout_s=600)
+            times = next(r["times"] for r in res if r["rank"] == 0)
+            out[name] = sorted(times)[len(times) // 2]
+    out["diloco_wan_quant_speedup"] = (out["diloco_wan_step_s"] /
+                                       out["diloco_wan_q8_step_s"])
     return out
 
 

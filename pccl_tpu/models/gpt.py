@@ -124,32 +124,49 @@ _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_qkv", "attn_out", "mlp_in", "mlp_out")
 
 
 def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
-            attn_fn=None) -> jax.Array:
+            attn_fn=None, remat: bool = False) -> jax.Array:
     """tokens: int32 [B, T] → logits float32 [B, T, vocab].
 
     attn_fn: optional (q, k, v) -> out override for the attention op —
     e.g. ops.flash_attention (fused single-chip kernel) or
-    ops.ring_attention.make_ring_attn_fn(mesh) (sequence parallelism)."""
+    ops.ring_attention.make_ring_attn_fn(mesh) (sequence parallelism).
+
+    remat: checkpoint each block — the backward recomputes the layer
+    forward instead of stashing per-layer activations, so HBM holds one
+    layer's activations at a time (how big batches fit a 16 GB chip)."""
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
 
     layers = {k: params[k] for k in _LAYER_KEYS}
 
+    blk = lambda h, layer: _block(h, layer, cfg, attn_fn)  # noqa: E731
+    if remat:
+        # prevent_cse=False is safe (and fast) under lax.scan
+        blk = jax.checkpoint(blk, prevent_cse=False)
+
     def body(h, layer):
-        return _block(h, layer, cfg, attn_fn), None
+        return blk(h, layer), None
 
     x, _ = lax.scan(body, x, layers)
     x = _rmsnorm(x, params["lnf_g"])
-    # weight-tied head
-    logits = x.astype(jnp.float32) @ params["tok_emb"].T.astype(jnp.float32)
+    # weight-tied head: bf16 operands on the MXU, fp32 accumulation — the
+    # vocab matmul is a large share of the model's FLOPs and fp32 operands
+    # would run it off the fast systolic path
+    logits = jnp.matmul(x, params["tok_emb"].T.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
     return logits
 
 
-def loss_fn(params, tokens, targets, cfg: GPTConfig, attn_fn=None) -> jax.Array:
-    """Mean next-token cross-entropy. targets: int32 [B, T]."""
-    logits = forward(params, tokens, cfg, attn_fn)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+def loss_fn(params, tokens, targets, cfg: GPTConfig, attn_fn=None,
+            remat: bool = False) -> jax.Array:
+    """Mean next-token cross-entropy. targets: int32 [B, T].
+
+    Written as gather(logits) − logsumexp rather than log_softmax so no
+    second [B, T, vocab] tensor is materialized (the logp stash costs
+    ~1.6 GB at gpt2 vocab and b8x1024 — real HBM on a 16 GB chip)."""
+    logits = forward(params, tokens, cfg, attn_fn, remat=remat)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(lse - tgt)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

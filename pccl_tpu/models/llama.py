@@ -139,24 +139,34 @@ _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_q", "attn_kv", "attn_out",
 
 
 def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
-            attn_fn=None) -> jax.Array:
-    """tokens: int32 [B, T] → logits float32 [B, T, vocab]."""
+            attn_fn=None, remat: bool = False) -> jax.Array:
+    """tokens: int32 [B, T] → logits float32 [B, T, vocab].
+
+    remat: checkpoint each block (see models/gpt.py:forward)."""
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
     layers = {k: params[k] for k in _LAYER_KEYS}
 
+    blk = lambda h, layer: _block(h, layer, cfg, attn_fn)  # noqa: E731
+    if remat:
+        blk = jax.checkpoint(blk, prevent_cse=False)
+
     def body(h, layer):
-        return _block(h, layer, cfg, attn_fn), None
+        return blk(h, layer), None
 
     x, _ = lax.scan(body, x, layers)
     x = _rmsnorm(x, params["lnf_g"])
-    return x.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+    # untied head: bf16 operands on the MXU, fp32 accumulation (see gpt.py)
+    return jnp.matmul(x, params["head"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)
 
 
-def loss_fn(params, tokens, targets, cfg: LlamaConfig, attn_fn=None) -> jax.Array:
-    logits = forward(params, tokens, cfg, attn_fn)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+def loss_fn(params, tokens, targets, cfg: LlamaConfig, attn_fn=None,
+            remat: bool = False) -> jax.Array:
+    # gather − logsumexp: no second [B, T, vocab] stash (see gpt.loss_fn)
+    logits = forward(params, tokens, cfg, attn_fn, remat=remat)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(lse - tgt)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -179,6 +189,11 @@ PRESETS = {
                  ffn_dim=192, block_size=64),
     "tiny": dict(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2, n_embd=128,
                  ffn_dim=320, block_size=128),
+    # 700m: the largest rung whose fp32 AdamW state (params + 2 moments +
+    # transient grads ≈ 11 GB) fits a single 16 GB v5e chip with headroom —
+    # the single-chip benchmark shape. head_dim 128 keeps the MXU tiled.
+    "700m": dict(vocab_size=32000, n_layer=24, n_head=12, n_kv_head=4,
+                 n_embd=1536, ffn_dim=4096, block_size=2048),
     "1b": dict(vocab_size=32000, n_layer=16, n_head=32, n_kv_head=8,
                n_embd=2048, ffn_dim=5632, block_size=2048),
     "7b": dict(vocab_size=32000, n_layer=32, n_head=32, n_kv_head=32,

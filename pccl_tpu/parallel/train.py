@@ -46,18 +46,20 @@ def make_train_state(key, cfg, mesh, lr: float = 3e-4):
 
 
 def build_train_step(cfg, tx, mesh, attn_fn=None,
-                     seq_axis: str | None = None):
+                     seq_axis: str | None = None, remat: bool = False):
     """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
 
     attn_fn: optional attention override (e.g. ring attention for sequence
-    parallelism over `seq_axis`)."""
+    parallelism over `seq_axis`). remat: per-block activation checkpointing
+    (models/gpt.py:forward) — trades ~1/3 more FLOPs for O(1-layer)
+    activation memory, the standard fit-big-batches move on a 16 GB chip."""
     model, sharding_fn = family(cfg)
     param_sharding = sharding_fn(mesh)
     data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(model.loss_fn)(
-            params, tokens, targets, cfg, attn_fn)
+            params, tokens, targets, cfg, attn_fn, remat)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -124,3 +124,31 @@ def test_gpt_forward_with_ring_attention(eight_devices):
         p, t, cfg, attn_fn=make_ring_attn_fn(mesh, batch_axis=None)))(params, tok_sp)
     np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense),
                                rtol=2e-2, atol=2e-2)  # bf16 compute
+
+
+def test_llama_forward_with_ring_attention(eight_devices):
+    """Llama's GQA must compose with the attn_fn override: kv heads are
+    repeated to the full head count on device BEFORE the attention op
+    (models/llama.py:_block), so ring attention sees ordinary multi-head
+    inputs and sequence parallelism works unchanged for the second family."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pccl_tpu.models import llama
+    from pccl_tpu.ops.ring_attention import make_ring_attn_fn
+    from pccl_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(eight_devices[:4], axis_names=("sp",), shape=(4,))
+    cfg = llama.tiny_config(block_size=64)   # n_kv_head=2 < n_head=4: real GQA
+    assert cfg.n_kv_head != cfg.n_head
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size)
+
+    dense = llama.forward(params, tokens, cfg)
+    tok_sp = jax.device_put(tokens, NamedSharding(mesh, P(None, "sp")))
+    ringed = jax.jit(lambda p, t: llama.forward(
+        p, t, cfg, attn_fn=make_ring_attn_fn(mesh, batch_axis=None)))(
+            params, tok_sp)
+    np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)  # bf16 compute

@@ -280,6 +280,29 @@ def test_wan_pacing_quantization_wins(master, monkeypatch):
         f"(fp32 {times[False]:.2f}s vs u8 {times[True]:.2f}s) on the paced wire"
 
 
+def test_wan_pacing_hierarchical_quantization_wins():
+    """The hierarchical twin of test_wan_pacing_quantization_wins: on the
+    BASELINE-config-4 shape (2 emulated slices, ICI mean inside each, the
+    native ring across), the u8-ZPS DCN hop must beat the fp32 hop once the
+    cross-slice wire is actually constrained. On unpaced loopback this A/B
+    *inverts* (codec work dominates — hier2_q8_step_s > hier2_step_s in
+    BENCH); the paced run is the configuration the feature was built for.
+    Reference intent: /root/reference/ccoip/src/cpp/quantize.cpp:22-57."""
+    from pccl_tpu.comm.native_bench import run_hierarchical_wan_bench
+
+    # own master ports + bands (bases 25000/25400 -> derived 25000-27408),
+    # clear of bench.py's 31xxx defaults so this test can run while
+    # bench.py exercises the same helper
+    r = run_hierarchical_wan_bench(elems=1 << 20, iters=2, mbps=200.0,
+                                   mports=(48697, 48699),
+                                   bases=(25000, 25400))
+    speedup = r["hier2_wan_quant_speedup"]
+    assert speedup > 1.8, (
+        f"quantized DCN hop only {speedup:.2f}x faster on the paced wire "
+        f"(fp32 {r['hier2_wan_step_s']:.2f}s vs u8 "
+        f"{r['hier2_wan_q8_step_s']:.2f}s)")
+
+
 def test_wire_dtype_override_validation(master):
     """A wire-dtype override whose element size mismatches the array's must
     raise, not silently reinterpret half the buffer (element COUNT crosses
@@ -343,6 +366,17 @@ def test_large_world_concurrent_soak(master, world, monkeypatch):
             assert float(x[-1]) == base + world * i
 
     _run_peers(master.port, world, worker, _ports(world * 8))
-    # the step moves 2(N-1)/N * 384 MB per peer; healthy runs take 2-20 s
-    # even under full-suite load — 90 s means herding/consensus collapse
-    assert step_times[world] < 90, step_times
+    # per-byte floor instead of a wall-clock ceiling: the step moves
+    # 2(N-1)/N * 384 MB of logical gradient per peer; healthy runs sustain
+    # 0.03+ GB/s effective even with the full suite loading this 1-core
+    # host (unloaded: 0.15-0.3), so the floor catches a real scaling
+    # regression (wakeup herding, consensus stalls) rather than only total
+    # collapse. The same workload is measured on a quiet host as
+    # soak8_step_s in BENCH extra (native_bench.run_soak_bench).
+    # floor 0.02 = the documented worst healthy loaded run (20 s at world 4
+    # ≈ 0.03 GB/s) with ~1.5x margin; unloaded runs sustain 0.15-0.3
+    logical_gb = 2 * (world - 1) / world * n_tensors * elems * 4 / 1e9
+    eff = logical_gb / step_times[world]
+    assert eff > 0.02, (
+        f"world-{world} soak effective busbw {eff:.3f} GB/s "
+        f"({step_times[world]:.1f} s for {logical_gb:.2f} GB)")

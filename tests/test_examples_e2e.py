@@ -132,6 +132,22 @@ def test_sync_diloco_chars_convergence():
         assert "world 2" in out
 
 
+def test_llama_diloco_chars_convergence():
+    """Family parity for the flagship e2e: llama must LEARN through the
+    full DiLoCo loop (inner AdamW + pseudo-gradient ring + outer Nesterov)
+    on real text, with the same substantial-drop bound as the GPT twin —
+    not just `last < first`. Proves the second family rides the whole
+    training substrate, not only the DDP demo."""
+    outs = _run_example(
+        REPO / "examples" / "nanogpt_diloco" / "sync_diloco.py", 2,
+        ["--family", "llama", "--data", "text", "--outer-steps", "5",
+         "--inner-steps", "10", "--batch", "8", "--inner-lr", "3e-3"])
+    for out in outs:
+        first, last = _final_losses(out)
+        assert last < first - 0.5, f"insufficient learning: {first} -> {last}"
+        assert "world 2" in out
+
+
 def test_llama_ddp_two_peers():
     """The llama family rides the same DDP loop end-to-end (--family
     dispatches model init/loss and the tensor-parallel sharding rules)."""
