@@ -140,19 +140,29 @@ def main() -> None:
     # On-chip model legs: the jitted bf16 train step on the real TPU —
     # tokens/s + MFU per family (skip-guarded when no TPU is attached;
     # everything above runs the native CPU stack regardless).
+    #
+    # Every TPU touch happens in a SUBPROCESS: standard libtpu is
+    # process-exclusive, so if this parent initialized the backend (even
+    # just to probe jax.devices()), the spawned rank-0 of the diloco-tpu
+    # leg could never acquire the chip. Probe, model legs, and the diloco
+    # leg therefore each run sequentially in their own process.
     if os.environ.get("PCCLT_BENCH_FAST", "0") != "1":
-        try:
-            import jax
+        import subprocess
 
-            has_tpu = any(d.platform == "tpu" for d in jax.devices())
-        except Exception:  # noqa: BLE001
-            has_tpu = False
-        if has_tpu:
-            from pccl_tpu.benchmarks import model_bench
-
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(any(d.platform == 'tpu' "
+             "for d in jax.devices()))"],
+            capture_output=True, text=True, timeout=300)
+        if probe.stdout.strip().endswith("True"):
             for fam in ("gpt", "llama"):
                 try:
-                    r = model_bench.run_tpu_train_bench(fam)
+                    p = subprocess.run(
+                        [sys.executable, "-m",
+                         "pccl_tpu.benchmarks.model_bench", fam],
+                        capture_output=True, text=True, timeout=900,
+                        check=True)
+                    r = json.loads(p.stdout.strip().splitlines()[-1])
                     extra[f"tpu_train_tokens_s_{fam}"] = r["tokens_s"]
                     extra[f"tpu_mfu_{fam}"] = r["mfu"]
                     extra[f"tpu_config_{fam}"] = r["config"]
@@ -166,6 +176,17 @@ def main() -> None:
             # headline aliases point at the flagship (gpt) leg
             extra["tpu_train_tokens_s"] = extra.get("tpu_train_tokens_s_gpt")
             extra["tpu_mfu"] = extra.get("tpu_mfu_gpt")
+            # on-chip DiLoCo outer step over a paced wire: rank 0 stages
+            # from the real TPU; the pipelined leg hides D2H under the
+            # ring. Spawned peers acquire the chip themselves — this
+            # parent never holds it.
+            try:
+                for k, v in native_bench.run_diloco_tpu_bench().items():
+                    extra[k] = round(v, 4) if isinstance(v, float) else v
+            except Exception as e:  # noqa: BLE001
+                print(f"bench: diloco tpu failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+                extra["diloco_tpu_step_s"] = None
         else:
             print("bench: no TPU attached; skipping on-chip model legs",
                   file=sys.stderr)

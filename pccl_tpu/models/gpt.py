@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._common import gather_ce_loss, maybe_checkpoint
+
 
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
@@ -138,10 +140,8 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
 
     layers = {k: params[k] for k in _LAYER_KEYS}
 
-    blk = lambda h, layer: _block(h, layer, cfg, attn_fn)  # noqa: E731
-    if remat:
-        # prevent_cse=False is safe (and fast) under lax.scan
-        blk = jax.checkpoint(blk, prevent_cse=False)
+    blk = maybe_checkpoint(
+        lambda h, layer: _block(h, layer, cfg, attn_fn), remat)
 
     def body(h, layer):
         return blk(h, layer), None
@@ -158,15 +158,10 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
 
 def loss_fn(params, tokens, targets, cfg: GPTConfig, attn_fn=None,
             remat: bool = False) -> jax.Array:
-    """Mean next-token cross-entropy. targets: int32 [B, T].
-
-    Written as gather(logits) − logsumexp rather than log_softmax so no
-    second [B, T, vocab] tensor is materialized (the logp stash costs
-    ~1.6 GB at gpt2 vocab and b8x1024 — real HBM on a 16 GB chip)."""
+    """Mean next-token cross-entropy (gather − logsumexp form; see
+    models/_common.py). targets: int32 [B, T]."""
     logits = forward(params, tokens, cfg, attn_fn, remat=remat)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    return jnp.mean(lse - tgt)
+    return gather_ce_loss(logits, targets)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

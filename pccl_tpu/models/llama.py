@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._common import gather_ce_loss, maybe_checkpoint
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -146,9 +148,8 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
     layers = {k: params[k] for k in _LAYER_KEYS}
 
-    blk = lambda h, layer: _block(h, layer, cfg, attn_fn)  # noqa: E731
-    if remat:
-        blk = jax.checkpoint(blk, prevent_cse=False)
+    blk = maybe_checkpoint(
+        lambda h, layer: _block(h, layer, cfg, attn_fn), remat)
 
     def body(h, layer):
         return blk(h, layer), None
@@ -162,11 +163,8 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
 
 def loss_fn(params, tokens, targets, cfg: LlamaConfig, attn_fn=None,
             remat: bool = False) -> jax.Array:
-    # gather − logsumexp: no second [B, T, vocab] stash (see gpt.loss_fn)
     logits = forward(params, tokens, cfg, attn_fn, remat=remat)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    return jnp.mean(lse - tgt)
+    return gather_ce_loss(logits, targets)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

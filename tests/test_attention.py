@@ -138,9 +138,14 @@ def test_llama_forward_with_ring_attention(eight_devices):
     from pccl_tpu.ops.ring_attention import make_ring_attn_fn
     from pccl_tpu.parallel import mesh as mesh_lib
 
+    import jax.numpy as jnp
+
     mesh = mesh_lib.make_mesh(eight_devices[:4], axis_names=("sp",), shape=(4,))
-    cfg = llama.tiny_config(block_size=64)   # n_kv_head=2 < n_head=4: real GQA
-    assert cfg.n_kv_head != cfg.n_head
+    # fp32 compute: the test checks GQA/ring COMPOSITION, and SwiGLU's
+    # multiplicative gating amplifies bf16 attention rounding past any
+    # meaningful tolerance (observed 0.05 on logits for an exact ring)
+    cfg = llama.tiny_config(block_size=64, compute_dtype=jnp.float32)
+    assert cfg.n_kv_head != cfg.n_head   # n_kv_head=2 < n_head=4: real GQA
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
                                 cfg.vocab_size)
@@ -151,4 +156,4 @@ def test_llama_forward_with_ring_attention(eight_devices):
         p, t, cfg, attn_fn=make_ring_attn_fn(mesh, batch_axis=None)))(
             params, tok_sp)
     np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense),
-                               rtol=2e-2, atol=2e-2)  # bf16 compute
+                               rtol=1e-4, atol=1e-4)
