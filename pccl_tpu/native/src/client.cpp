@@ -312,7 +312,9 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
         bool ok = true;
         for (size_t i = 0; i < cfg_.pool_size; ++i) {
             net::Socket s;
-            if (!s.connect(net::Addr{ep.ip, ep.p2p_port}, 5000)) {
+            net::Addr pa = ep.ip;
+            pa.port = ep.p2p_port;
+            if (!s.connect(pa, 5000)) {
                 ok = false;
                 break;
             }
@@ -508,7 +510,9 @@ Status Client::optimize_topology() {
             double mbps = -1.0;
             int hard_failures = 0;
             while (mbps < 0) {
-                mbps = bench::run_probe(net::Addr{req.ip, req.bench_port});
+                net::Addr ba = req.ip;
+                ba.port = req.bench_port;
+                mbps = bench::run_probe(ba);
                 if (mbps == -2.0) { // busy; jittered nap, retry until deadline
                     mbps = -1.0;
                     // jitter desynchronizes probers that got rejected at the
@@ -920,7 +924,9 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
             dist_open_ = false;
         }
         net::Socket sock;
-        if (!sock.connect(net::Addr{resp->dist_ip, resp->dist_port}, 10'000)) {
+        net::Addr da = resp->dist_ip;
+        da.port = resp->dist_port;
+        if (!sock.connect(da, 10'000)) {
             st = Status::kConnectionLost;
         } else {
             wire::Writer w;

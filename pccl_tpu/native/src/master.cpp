@@ -28,7 +28,7 @@ bool Master::launch() {
         std::lock_guard lk(conns_mu_);
         uint64_t id = next_conn_id_++;
         auto conn = std::make_shared<Conn>();
-        conn->src_ip = sock.peer_addr().ip;
+        conn->src_ip = sock.peer_addr();  // family-tagged; port is the ephemeral src port, unused
         conn->sock = std::move(sock);
         conn->sock.set_keepalive();
         conns_[id] = conn;
@@ -113,7 +113,7 @@ void Master::dispatcher_loop() {
                 if (conn->reader.joinable()) conn->reader.join();
             }
         } else {
-            uint32_t src_ip = 0;
+            net::Addr src_ip{};
             {
                 std::lock_guard lk(conns_mu_);
                 auto it = conns_.find(ev.conn_id);

@@ -37,7 +37,7 @@ private:
         net::Socket sock;
         std::mutex write_mu;
         std::thread reader;
-        uint32_t src_ip = 0;
+        net::Addr src_ip{};
     };
     struct Event {
         enum Kind { kPacket, kDisconnect } kind;

@@ -86,7 +86,7 @@ std::vector<uint64_t> MasterState::take_pending_closes() {
 
 // ---------- join ----------
 
-std::vector<Outbox> MasterState::on_hello(uint64_t conn, uint32_t src_ip,
+std::vector<Outbox> MasterState::on_hello(uint64_t conn, const net::Addr &src_ip,
                                           const proto::HelloC2M &h) {
     std::vector<Outbox> out;
     if (h.wire_rev != proto::kWireRev) {
@@ -111,7 +111,7 @@ std::vector<Outbox> MasterState::on_hello(uint64_t conn, uint32_t src_ip,
     c.ss_port = h.ss_port;
     c.bench_port = h.bench_port;
     if (!h.adv_ip.empty()) {
-        if (auto a = net::Addr::parse(h.adv_ip, 0)) c.ip = a->ip;
+        if (auto a = net::Addr::parse(h.adv_ip, 0)) c.ip = *a;
     }
     clients_[conn] = c;
     PLOG(kInfo) << "client " << proto::uuid_str(c.uuid) << " joined (pending), group "

@@ -46,7 +46,7 @@ struct ClientInfo {
     Uuid uuid{};
     uint64_t conn_id = 0;
     uint32_t peer_group = 0;
-    uint32_t ip = 0; // host order, observed or advertised
+    net::Addr ip{}; // observed or advertised (family-tagged; port unused)
     uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
     bool accepted = false; // admitted to the world vs pending join
 
@@ -86,7 +86,8 @@ public:
     ~MasterState();
 
     // --- event handlers: apply + return packets to send ---
-    std::vector<Outbox> on_hello(uint64_t conn, uint32_t src_ip, const proto::HelloC2M &h);
+    std::vector<Outbox> on_hello(uint64_t conn, const net::Addr &src_ip,
+                                 const proto::HelloC2M &h);
     std::vector<Outbox> on_topology_update(uint64_t conn);
     std::vector<Outbox> on_peers_pending_query(uint64_t conn);
     std::vector<Outbox> on_p2p_established(uint64_t conn, uint64_t revision, bool ok,

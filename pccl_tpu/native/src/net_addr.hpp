@@ -1,0 +1,32 @@
+// net::Addr — the dual-family endpoint POD, split from sockets.hpp so the
+// wire-format layer (protocol.hpp) can carry addresses without pulling in
+// the whole socket/multiplex machinery.
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <optional>
+#include <string>
+
+namespace pcclt::net {
+
+// Dual-family endpoint. Field order keeps v4 aggregate inits
+// (`Addr{ip, port}`) working; v6 carries its 16 bytes network-order in
+// `ip6` with `family == 6`. Reference parity: ccoip_inet.h:15-29 carries
+// both families in its inet types; here they also ROUTE (connect, listen,
+// peer_addr, and the PCCP/2 family-tagged wire all speak both).
+struct Addr {
+    uint32_t ip = 0; // v4, host byte order
+    uint16_t port = 0;
+    uint8_t family = 4; // 4 or 6
+    std::array<uint8_t, 16> ip6{}; // v6, network byte order
+    std::string str() const; // defined in sockets.cpp
+    // accepts dotted v4, plain v6 ("::1"), or bracketed v6 ("[::1]")
+    static std::optional<Addr> parse(const std::string &ip_str, uint16_t port);
+    bool operator==(const Addr &o) const {
+        return family == o.family && port == o.port &&
+               (family == 6 ? ip6 == o.ip6 : ip == o.ip);
+    }
+};
+
+} // namespace pcclt::net

@@ -69,23 +69,28 @@ std::optional<HelloC2M> HelloC2M::decode(const std::vector<uint8_t> &b) {
 namespace {
 
 // Family-tagged wire addresses (PCCP/2): a u8 family then 4 bytes (v4,
-// host-order u32) or 16 bytes (v6). The reference carries IPv6 in its inet
-// types even though its client plumbing is IPv4-first
-// (ccoip/public_include/ccoip_inet.h:15-29); tagging now means adding v6
-// routing later is NOT a breaking wire change. This build's plumbing is
-// IPv4-first (net::Addr), so a v6 payload fails the packet decode loudly —
-// mapping it to any v4 placeholder would have the client connect() to the
-// wrong endpoint (0.0.0.0 routes to loopback on Linux).
-void put_addr4(wire::Writer &w, uint32_t ip) {
-    w.u8(4);
-    w.u32(ip);
+// host-order u32) or 16 bytes (v6, network order). Both families ROUTE
+// end-to-end since round 4 (net::Addr carries either; connect/listen/
+// peer_addr speak both). Reference parity: ccoip_inet.h:15-29 carries
+// both in its inet types, IPv4-first in its plumbing.
+void put_addr(wire::Writer &w, const net::Addr &a) {
+    if (a.family == 6) {
+        w.u8(6);
+        w.raw(a.ip6.data(), 16);
+    } else {
+        w.u8(4);
+        w.u32(a.ip);
+    }
 }
 
-uint32_t get_addr4(wire::Reader &r) {
+net::Addr get_addr(wire::Reader &r) {
     uint8_t family = r.u8();
-    if (family == 4) return r.u32();
-    if (family == 6)
-        throw std::runtime_error("IPv6 wire address: this build routes IPv4 only");
+    if (family == 4) return net::Addr{r.u32(), 0};
+    if (family == 6) {
+        net::Addr a{0, 0, 6};
+        for (auto &b : a.ip6) b = r.u8();
+        return a;
+    }
     throw std::runtime_error("bad wire address family");
 }
 
@@ -99,7 +104,7 @@ std::vector<uint8_t> P2PConnInfo::encode() const {
     w.u32(static_cast<uint32_t>(peers.size()));
     for (const auto &p : peers) {
         put_uuid(w, p.uuid);
-        put_addr4(w, p.ip);
+        put_addr(w, p.ip);
         w.u16(p.p2p_port);
         w.u16(p.bench_port);
         w.u32(p.peer_group);
@@ -118,7 +123,7 @@ std::optional<P2PConnInfo> P2PConnInfo::decode(const std::vector<uint8_t> &b) {
         for (uint32_t i = 0; i < n; ++i) {
             PeerEndpoint e;
             e.uuid = get_uuid(r);
-            e.ip = get_addr4(r);
+            e.ip = get_addr(r);
             e.p2p_port = r.u16();
             e.bench_port = r.u16();
             e.peer_group = r.u32();
@@ -200,7 +205,7 @@ std::vector<uint8_t> SharedStateSyncResp::encode() const {
     wire::Writer w;
     w.u8(outdated);
     w.u8(failed);
-    put_addr4(w, dist_ip);
+    put_addr(w, dist_ip);
     w.u16(dist_port);
     w.u64(revision);
     w.u32(static_cast<uint32_t>(outdated_keys.size()));
@@ -216,7 +221,7 @@ std::optional<SharedStateSyncResp> SharedStateSyncResp::decode(const std::vector
         SharedStateSyncResp s;
         s.outdated = r.u8();
         s.failed = r.u8();
-        s.dist_ip = get_addr4(r);
+        s.dist_ip = get_addr(r);
         s.dist_port = r.u16();
         s.revision = r.u64();
         uint32_t n = r.u32();
@@ -235,7 +240,7 @@ std::vector<uint8_t> OptimizeResponse::encode() const {
     w.u32(static_cast<uint32_t>(requests.size()));
     for (const auto &q : requests) {
         put_uuid(w, q.to);
-        put_addr4(w, q.ip);
+        put_addr(w, q.ip);
         w.u16(q.bench_port);
     }
     return w.take();
@@ -250,7 +255,7 @@ std::optional<OptimizeResponse> OptimizeResponse::decode(const std::vector<uint8
         for (uint32_t i = 0; i < n; ++i) {
             BenchRequest q;
             q.to = get_uuid(r);
-            q.ip = get_addr4(r);
+            q.ip = get_addr(r);
             q.bench_port = r.u16();
             o.requests.push_back(q);
         }

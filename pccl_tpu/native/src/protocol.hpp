@@ -13,6 +13,7 @@
 #include <string>
 #include <vector>
 
+#include "net_addr.hpp" // PCCP/2 wire addresses carry the family
 #include "wire.hpp"
 
 namespace pcclt::proto {
@@ -109,7 +110,7 @@ struct HelloC2M {
 
 struct PeerEndpoint {
     Uuid uuid{};
-    uint32_t ip = 0; // host order
+    net::Addr ip{}; // family-tagged; port field unused (ports below)
     uint16_t p2p_port = 0;
     uint16_t bench_port = 0;
     uint32_t peer_group = 0;
@@ -153,7 +154,7 @@ struct SharedStateSyncC2M {
 struct SharedStateSyncResp {
     uint8_t outdated = 0;
     uint8_t failed = 0; // round could not elect a distributor at the expected revision
-    uint32_t dist_ip = 0;
+    net::Addr dist_ip{}; // family-tagged; port carried in dist_port
     uint16_t dist_port = 0;
     uint64_t revision = 0;
     std::vector<std::string> outdated_keys;
@@ -164,7 +165,7 @@ struct SharedStateSyncResp {
 
 struct BenchRequest {
     Uuid to{};
-    uint32_t ip = 0;
+    net::Addr ip{}; // family-tagged; port carried in bench_port
     uint16_t bench_port = 0;
 };
 

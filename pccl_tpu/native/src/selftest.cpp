@@ -50,18 +50,28 @@ static void test_wire() {
     CHECK(r.f64() == 3.25);
     CHECK(r.done());
 
-    // family-tagged wire addresses (PCCP/2): v4 roundtrips; a v6 payload
-    // fails the decode loudly (IPv4-first plumbing must not connect to a
-    // placeholder address); an unknown family fails too
+    // family-tagged wire addresses (PCCP/2): both families roundtrip
+    // (v6 routes end-to-end since round 4); an unknown family fails loudly
     proto::SharedStateSyncResp resp;
     resp.outdated = 1;
-    resp.dist_ip = 0x7F000001;
+    resp.dist_ip = net::Addr{0x7F000001, 0};  // 127.0.0.1
     resp.dist_port = 1234;
     resp.revision = 9;
     auto dec = proto::SharedStateSyncResp::decode(resp.encode());
-    CHECK(dec && dec->dist_ip == 0x7F000001 && dec->dist_port == 1234 &&
-          dec->revision == 9);
+    CHECK(dec && dec->dist_ip == (net::Addr{0x7F000001, 0}) &&
+          dec->dist_port == 1234 && dec->revision == 9);
     {
+        // v6 round-trip: the family tag and 16 address bytes survive
+        auto a6 = net::Addr::parse("::1", 0);
+        CHECK(a6 && a6->family == 6);
+        proto::SharedStateSyncResp r6;
+        r6.dist_ip = *a6;
+        auto d6 = proto::SharedStateSyncResp::decode(r6.encode());
+        CHECK(d6 && d6->dist_ip == *a6 && d6->dist_ip.str() == "[::1]:0");
+    }
+    {
+        // hand-encoded family-6 payload: since the round-4 v6 routing this
+        // DECODES (it used to be rejected while the plumbing was v4-only)
         wire::Writer w6;
         w6.u8(1);  // outdated
         w6.u8(0);  // failed
@@ -72,7 +82,8 @@ static void test_wire() {
         w6.u32(0);
         w6.u32(0);
         auto d6 = proto::SharedStateSyncResp::decode(w6.take());
-        CHECK(!d6);
+        CHECK(d6 && d6->dist_ip.family == 6 && d6->dist_ip.ip6[15] == 15 &&
+              d6->dist_port == 4321 && d6->revision == 11);
     }
     {
         // hello carries the wire rev first; roundtrip keeps it

@@ -25,6 +25,11 @@ namespace pcclt::net {
 // ---------- Addr ----------
 
 std::string Addr::str() const {
+    if (family == 6) {
+        char buf[INET6_ADDRSTRLEN];
+        inet_ntop(AF_INET6, ip6.data(), buf, sizeof buf);
+        return "[" + std::string(buf) + "]:" + std::to_string(port);
+    }
     struct in_addr a;
     a.s_addr = htonl(ip);
     char buf[INET_ADDRSTRLEN];
@@ -34,25 +39,48 @@ std::string Addr::str() const {
 
 std::optional<Addr> Addr::parse(const std::string &ip_str, uint16_t port) {
     struct in_addr a;
-    if (inet_pton(AF_INET, ip_str.c_str(), &a) != 1) return std::nullopt;
-    return Addr{ntohl(a.s_addr), port};
+    if (inet_pton(AF_INET, ip_str.c_str(), &a) == 1)
+        return Addr{ntohl(a.s_addr), port};
+    // v6, with or without URL-style brackets
+    std::string s = ip_str;
+    if (s.size() >= 2 && s.front() == '[' && s.back() == ']')
+        s = s.substr(1, s.size() - 2);
+    struct in6_addr a6;
+    if (inet_pton(AF_INET6, s.c_str(), &a6) == 1) {
+        Addr out{0, port, 6};
+        memcpy(out.ip6.data(), &a6, 16);
+        return out;
+    }
+    return std::nullopt;
 }
 
 // ---------- Socket ----------
 
 bool Socket::connect(const Addr &addr, int timeout_ms) {
     close();
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    const bool v6 = addr.family == 6;
+    int fd = ::socket(v6 ? AF_INET6 : AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return false;
-    struct sockaddr_in sa{};
-    sa.sin_family = AF_INET;
-    sa.sin_port = htons(addr.port);
-    sa.sin_addr.s_addr = htonl(addr.ip);
+    struct sockaddr_storage ss{};
+    socklen_t salen;
+    if (v6) {
+        auto *sa6 = reinterpret_cast<sockaddr_in6 *>(&ss);
+        sa6->sin6_family = AF_INET6;
+        sa6->sin6_port = htons(addr.port);
+        memcpy(&sa6->sin6_addr, addr.ip6.data(), 16);
+        salen = sizeof(sockaddr_in6);
+    } else {
+        auto *sa4 = reinterpret_cast<sockaddr_in *>(&ss);
+        sa4->sin_family = AF_INET;
+        sa4->sin_port = htons(addr.port);
+        sa4->sin_addr.s_addr = htonl(addr.ip);
+        salen = sizeof(sockaddr_in);
+    }
 
     // non-blocking connect with timeout, then back to blocking
     int flags = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
-    int rc = ::connect(fd, reinterpret_cast<sockaddr *>(&sa), sizeof sa);
+    int rc = ::connect(fd, reinterpret_cast<sockaddr *>(&ss), salen);
     if (rc != 0 && errno != EINPROGRESS) {
         ::close(fd);
         return false;
@@ -199,17 +227,42 @@ void Socket::set_keepalive(int idle_s) {
 }
 
 Addr Socket::peer_addr() const {
-    struct sockaddr_in sa{};
-    socklen_t len = sizeof sa;
-    if (getpeername(fd_.load(), reinterpret_cast<sockaddr *>(&sa), &len) != 0) return {};
-    return Addr{ntohl(sa.sin_addr.s_addr), ntohs(sa.sin_port)};
+    struct sockaddr_storage ss{};
+    socklen_t len = sizeof ss;
+    if (getpeername(fd_.load(), reinterpret_cast<sockaddr *>(&ss), &len) != 0) return {};
+    if (ss.ss_family == AF_INET6) {
+        auto *sa6 = reinterpret_cast<const sockaddr_in6 *>(&ss);
+        const uint8_t *b = sa6->sin6_addr.s6_addr;
+        // a v4 client hitting the dual-stack listener arrives v4-mapped
+        // (::ffff:a.b.c.d) — report it as the v4 address it is, so master
+        // bookkeeping and endpoint distribution stay family-consistent
+        static const uint8_t mapped[12] = {0, 0, 0, 0, 0, 0, 0, 0,
+                                           0, 0, 0xff, 0xff};
+        if (memcmp(b, mapped, 12) == 0) {
+            uint32_t v4 = (uint32_t(b[12]) << 24) | (uint32_t(b[13]) << 16) |
+                          (uint32_t(b[14]) << 8) | b[15];
+            return Addr{v4, ntohs(sa6->sin6_port)};
+        }
+        Addr out{0, ntohs(sa6->sin6_port), 6};
+        memcpy(out.ip6.data(), b, 16);
+        return out;
+    }
+    auto *sa = reinterpret_cast<const sockaddr_in *>(&ss);
+    return Addr{ntohl(sa->sin_addr.s_addr), ntohs(sa->sin_port)};
 }
 
 bool Socket::peer_is_loopback() const {
-    // 127.0.0.0/8. Two hosts can never reach each other via loopback, and a
-    // loopback connection can never cross a network namespace boundary, so
-    // this is a sound same-host test for the CMA fast path.
-    return (peer_addr().ip >> 24) == 127;
+    // 127.0.0.0/8 or ::1. Two hosts can never reach each other via
+    // loopback, and a loopback connection can never cross a network
+    // namespace boundary, so this is a sound same-host test for the CMA
+    // fast path. (v4-mapped loopback is already folded to v4 above.)
+    Addr a = peer_addr();
+    if (a.family == 6) {
+        static const uint8_t l6[16] = {0, 0, 0, 0, 0, 0, 0, 0,
+                                       0, 0, 0, 0, 0, 0, 0, 1};
+        return memcmp(a.ip6.data(), l6, 16) == 0;
+    }
+    return (a.ip >> 24) == 127;
 }
 
 // ---------- control framing ----------
@@ -278,29 +331,74 @@ std::optional<Frame> recv_frame(Socket &s, int timeout_ms) {
 
 bool Listener::listen(uint16_t port, int tries, bool loopback_only) {
     for (int i = 0; i < tries; ++i) {
-        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-        if (fd < 0) return false;
-        int one = 1;
-        setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
-        struct sockaddr_in sa{};
-        sa.sin_family = AF_INET;
-        sa.sin_port = htons(static_cast<uint16_t>(port + i));
-        sa.sin_addr.s_addr = htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
-        if (bind(fd, reinterpret_cast<sockaddr *>(&sa), sizeof sa) == 0 &&
-            ::listen(fd, 64) == 0) {
-            fd_ = fd;
-            port_ = static_cast<uint16_t>(port + i);
-            if (port_ == 0) {
-                // port 0 = kernel-assigned ephemeral; report the real port so
-                // callers can advertise it
-                struct sockaddr_in bound{};
-                socklen_t slen = sizeof bound;
-                if (getsockname(fd, reinterpret_cast<sockaddr *>(&bound), &slen) == 0)
-                    port_ = ntohs(bound.sin_port);
+        uint16_t p = static_cast<uint16_t>(port + i);
+        int fd = -1;
+        // Production listeners are dual-stack: one AF_INET6 socket with
+        // V6ONLY off accepts both families (v4 clients appear v4-mapped,
+        // folded back to v4 in peer_addr). Falls back to v4-only when the
+        // kernel has no v6. loopback_only (a socktest-only knob) stays
+        // v4 127.0.0.1 — its callers connect there explicitly.
+        if (!loopback_only) {
+            fd = ::socket(AF_INET6, SOCK_STREAM, 0);
+            if (fd >= 0) {
+                int one = 1, zero = 0;
+                setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+                // V6ONLY must verifiably turn OFF: a v6-only listener would
+                // silently refuse every v4 client (net.ipv6.bindv6only=1
+                // hosts), so on failure fall back to the v4 socket instead
+                if (setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero,
+                               sizeof zero) != 0) {
+                    PLOG(kWarn) << "listener: IPV6_V6ONLY=0 refused; "
+                                   "using v4-only listener";
+                    ::close(fd);
+                    fd = -1;
+                } else {
+                    struct sockaddr_in6 sa6{};
+                    sa6.sin6_family = AF_INET6;
+                    sa6.sin6_port = htons(p);
+                    sa6.sin6_addr = in6addr_any;
+                    if (bind(fd, reinterpret_cast<sockaddr *>(&sa6),
+                             sizeof sa6) != 0 || ::listen(fd, 64) != 0) {
+                        if (p != 0)  // port-scan retries are expected noise
+                            PLOG(kWarn) << "listener: dual-stack bind on port "
+                                        << p << " failed (" << strerror(errno)
+                                        << "); trying v4-only";
+                        ::close(fd);
+                        fd = -1;
+                    } else {
+                        goto bound;
+                    }
+                }
             }
-            return true;
         }
-        ::close(fd);
+        fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) return false;
+        {
+            int one = 1;
+            setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+            struct sockaddr_in sa{};
+            sa.sin_family = AF_INET;
+            sa.sin_port = htons(p);
+            sa.sin_addr.s_addr = htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
+            if (bind(fd, reinterpret_cast<sockaddr *>(&sa), sizeof sa) != 0 ||
+                ::listen(fd, 64) != 0) {
+                ::close(fd);
+                continue;
+            }
+        }
+    bound:
+        fd_ = fd;
+        port_ = p;
+        if (port_ == 0) {
+            // port 0 = kernel-assigned ephemeral; report the real port so
+            // callers can advertise it (family-agnostic: port sits at the
+            // same offset in sockaddr_in and sockaddr_in6)
+            struct sockaddr_storage bound{};
+            socklen_t slen = sizeof bound;
+            if (getsockname(fd, reinterpret_cast<sockaddr *>(&bound), &slen) == 0)
+                port_ = ntohs(reinterpret_cast<sockaddr_in *>(&bound)->sin_port);
+        }
+        return true;
     }
     return false;
 }

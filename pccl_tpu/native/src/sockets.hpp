@@ -37,6 +37,7 @@
 //            2=CMA ack, 3=CMA nack
 #pragma once
 
+#include <array>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -52,17 +53,10 @@
 #include <vector>
 
 #include "mpsc.hpp"
+#include "net_addr.hpp"
 #include "park.hpp"
 
 namespace pcclt::net {
-
-struct Addr {
-    uint32_t ip = 0; // host byte order
-    uint16_t port = 0;
-    std::string str() const;
-    static std::optional<Addr> parse(const std::string &ip_str, uint16_t port);
-    bool operator==(const Addr &o) const { return ip == o.ip && port == o.port; }
-};
 
 class Socket {
 public:

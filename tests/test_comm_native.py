@@ -22,7 +22,7 @@ def _ports(n=1):
     return alloc_ports(64 * n)
 
 
-def _run_peers(master_port, world, worker, base):
+def _run_peers(master_port, world, worker, base, host="127.0.0.1"):
     """Spin up `world` client threads; each runs worker(comm, rank).
     Mirrors the reference establishConnections helper (test_all_reduce.cpp:16-42)."""
     from pccl_tpu.comm import Communicator
@@ -30,7 +30,7 @@ def _run_peers(master_port, world, worker, base):
     errors = []
 
     def peer(rank):
-        comm = Communicator("127.0.0.1", master_port,
+        comm = Communicator(host, master_port,
                             p2p_port=base + rank * 8, ss_port=base + 512 + rank * 8,
                             bench_port=base + 1024 + rank * 8)
         try:
@@ -293,7 +293,10 @@ def test_wan_pacing_hierarchical_quantization_wins():
     # own master ports + bands (bases 25000/25400 -> derived 25000-27408),
     # clear of bench.py's 31xxx defaults so this test can run while
     # bench.py exercises the same helper
-    r = run_hierarchical_wan_bench(elems=1 << 20, iters=2, mbps=200.0,
+    # 2M elems at 200 Mbit/s: enough bytes that the wire dominates the u8
+    # codec work on a loaded host (1M elems left the ratio within suite
+    # noise of the 1.8x bar)
+    r = run_hierarchical_wan_bench(elems=2 << 20, iters=2, mbps=200.0,
                                    mports=(48697, 48699),
                                    bases=(25000, 25400))
     speedup = r["hier2_wan_quant_speedup"]
@@ -301,6 +304,33 @@ def test_wan_pacing_hierarchical_quantization_wins():
         f"quantized DCN hop only {speedup:.2f}x faster on the paced wire "
         f"(fp32 {r['hier2_wan_step_s']:.2f}s vs u8 "
         f"{r['hier2_wan_q8_step_s']:.2f}s)")
+
+
+def test_ipv6_loopback_reduce(master):
+    """2-peer SUM all-reduce entirely over ::1: the clients dial the master
+    over v6 (dual-stack listener), the master observes their v6 source
+    address, distributes family-tagged v6 endpoints (PCCP/2 wire), and the
+    peers' p2p data plane connects back over v6. Reference carries IPv6 in
+    its inet types (ccoip_inet.h:15-29); here it routes end-to-end.
+
+    Skips where the kernel has no v6 (ipv6.disable=1 containers): the
+    listeners legitimately fall back to v4-only there by design."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        s.bind(("::1", 0))
+        s.close()
+    except OSError:
+        pytest.skip("IPv6 loopback unavailable on this host")
+
+    def worker(comm, rank):
+        x = np.full(4096, float(rank + 1), dtype=np.float32)
+        comm.all_reduce(x)
+        assert float(x[0]) == 3.0 and float(x[-1]) == 3.0
+        assert comm.world_size == 2
+
+    _run_peers(master.port, 2, worker, _ports(4), host="::1")
 
 
 def test_wire_dtype_override_validation(master):
