@@ -91,6 +91,27 @@ def main() -> None:
             for k in ("diloco_steady_step_s", "diloco_churn_step_s",
                       "worlds_seen", "steps_completed", "rejoiner_joined"):
                 extra[k] = None
+        # THE driver-configured BASELINE metric: DiLoCo outer step at 1B
+        # params (4 GB fp32 per peer). Gated on RAM — each peer wants
+        # ~25 GB; skip quietly on small hosts.
+        try:
+            avail_kb = 0
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable"):
+                        avail_kb = int(line.split()[1])
+                        break
+            if avail_kb > 70 * 1024 * 1024:
+                extra["diloco_1b_step_s"] = round(
+                    native_bench.run_diloco_1b_bench(), 4)
+            else:
+                print("bench: skipping 1B diloco leg "
+                      f"(MemAvailable {avail_kb >> 20} GB < 70)",
+                      file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: diloco 1b failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["diloco_1b_step_s"] = None
         # BASELINE config 4 shape: 2 emulated slices, plain vs quantized DCN
         try:
             for k, v in native_bench.run_hierarchical_bench().items():

@@ -647,16 +647,7 @@ def _peer_diloco_wan(rank, master_port, q, world, params_n, iters, quantize,
             cfg, quantization=QuantizationAlgorithm.ZERO_POINT_SCALE,
             quantized_dtype=DataType.UINT8)
     diloco = Diloco(comm, params, cfg)
-    times = []
-    cur = diloco.params()
-    for it in range(iters + 1):  # first step pays the jit compiles
-        inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
-        jax.block_until_ready(inner)
-        t0 = time.perf_counter()
-        cur = diloco.outer_step(inner)
-        jax.block_until_ready(cur)
-        if it >= 1:
-            times.append(time.perf_counter() - t0)
+    times, _ = _diloco_timed_steps(diloco, rank, iters)
     q.put({"rank": rank, "times": times})
     comm.destroy()
 
@@ -688,6 +679,64 @@ def run_diloco_wan_bench(world: int = 2, params_n: int = 5_000_000,
     return out
 
 
+def _diloco_timed_steps(diloco, rank, iters, donate_inner=False):
+    """Shared warmup+timed outer-step loop for the diloco bench peers:
+    synthetic inner step, first iteration pays the jit compiles, the rest
+    are timed. Returns (times, final params tree)."""
+    import jax
+
+    mk = lambda t: jax.tree.map(lambda p: p - 0.01 * (rank + 1), t)  # noqa: E731
+    if donate_inner:
+        # at multi-GB sizes a fresh output buffer costs ~25x the op
+        # (CPU-backend allocation pathology; see codec.build_codec)
+        mk = jax.jit(mk, donate_argnums=(0,))
+    times = []
+    cur = diloco.params()
+    for it in range(iters + 1):
+        inner = mk(cur)
+        jax.block_until_ready(inner)
+        t0 = time.perf_counter()
+        cur = diloco.outer_step(inner)
+        jax.block_until_ready(cur)
+        if it >= 1:
+            times.append(time.perf_counter() - t0)
+    return times, cur
+
+
+def run_diloco_1b_bench(world: int = 2, params_n: int = 1_000_000_000,
+                        iters: int = 2) -> float:
+    """THE driver-configured BASELINE metric: DiLoCo outer-step wall-clock
+    at 1B parameters (BASELINE.md: "DiLoCo outer-step 1B params, 4 slices";
+    the reference publishes no value for it). Runs ``world`` host peers
+    each holding a 4 GB fp32 outer vector — shm-staged zero-copy ring,
+    fused apply+unflatten — and returns rank 0's median outer-step seconds.
+    Needs ~25 GB RAM per peer; callers gate on available memory."""
+    # reuse the WAN peer body unpaced: same Diloco loop, shm staging on
+    # (zero-copy same-host ring is the right transport at 4 GB)
+    res = _spawn_world(world, _peer_diloco_big,
+                       _port("PCCLT_BENCH_MASTER_PORT_1B", 48709),
+                       (world, params_n, iters, 13000),
+                       inline_rank0=False, timeout_s=1800)
+    times = next(r["times"] for r in res if r["rank"] == 0)
+    return sorted(times)[len(times) // 2]
+
+
+def _peer_diloco_big(rank, master_port, q, world, params_n, iters, port_base):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from pccl_tpu.parallel.diloco import Diloco, DilocoConfig
+
+    comm = _connect(rank, master_port, world, port_base)
+    params = {"w": jnp.zeros((params_n,), jnp.float32)}
+    diloco = Diloco(comm, params, DilocoConfig(shm_staging=True))
+    times, _ = _diloco_timed_steps(diloco, rank, iters, donate_inner=True)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
 def _peer_diloco_tpu(rank, master_port, q, world, params_n, iters, windows,
                      port_base):
     """DiLoCo peer with rank 0 on the REAL TPU (other ranks pin CPU — the
@@ -707,16 +756,7 @@ def _peer_diloco_tpu(rank, master_port, q, world, params_n, iters, windows,
     jax.block_until_ready(params["w"])
     diloco = Diloco(comm, params, DilocoConfig(shm_staging=True,
                                                comm_windows=windows))
-    times = []
-    cur = diloco.params()
-    for it in range(iters + 1):  # first step pays the jit compiles
-        inner = jax.tree.map(lambda p: p - 0.01 * (rank + 1), cur)
-        jax.block_until_ready(inner)
-        t0 = time.perf_counter()
-        cur = diloco.outer_step(inner)
-        jax.block_until_ready(cur)
-        if it >= 1:
-            times.append(time.perf_counter() - t0)
+    times, cur = _diloco_timed_steps(diloco, rank, iters)
     # one more step, rank 0 profiled — EVERY rank must run it (the ring is
     # a collective; a profiled step without a matching peer step stalls
     # into the abort path and the breakdown records the timeout)

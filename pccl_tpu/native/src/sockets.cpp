@@ -359,10 +359,13 @@ bool Listener::listen(uint16_t port, int tries, bool loopback_only) {
                     sa6.sin6_addr = in6addr_any;
                     if (bind(fd, reinterpret_cast<sockaddr *>(&sa6),
                              sizeof sa6) != 0 || ::listen(fd, 64) != 0) {
-                        if (p != 0)  // port-scan retries are expected noise
-                            PLOG(kWarn) << "listener: dual-stack bind on port "
-                                        << p << " failed (" << strerror(errno)
-                                        << "); trying v4-only";
+                        // trace, not warn: callers port-scan (tries up to
+                        // 64), so a busy port here is expected noise — the
+                        // v4 attempt below fails the same way and the scan
+                        // moves to the next port
+                        PLOG(kTrace) << "listener: dual-stack bind on port "
+                                     << p << " failed (" << strerror(errno)
+                                     << ")";
                         ::close(fd);
                         fd = -1;
                     } else {

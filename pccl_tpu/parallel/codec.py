@@ -69,5 +69,14 @@ def build_codec(template: Any) -> PytreeCodec:
     def _flat_delta_vec(outer_vec, inner):
         return outer_vec - _flat(inner)
 
+    # flat_delta_vec donates the INNER tree: it is dead the moment the
+    # pseudo-gradient exists (DiLoCo callers continue from outer_step's
+    # return), and donation lets XLA back the delta with inner's buffers.
+    # This matters at scale: on the CPU backend a fresh multi-GB output
+    # costs ~25x the op itself in allocation/fault pathology (measured:
+    # 0.6 s donated vs 22 s fresh for a 2 GB subtract) — donation is the
+    # difference between a 1B-param outer step working and crawling.
+    # A caller that reuses the tree after outer_step gets jax's loud
+    # "Array has been deleted", not silent corruption.
     return PytreeCodec(jax.jit(_flat_delta), jax.jit(_flat), jax.jit(_unflat),
-                       total, jax.jit(_flat_delta_vec))
+                       total, jax.jit(_flat_delta_vec, donate_argnums=(1,)))

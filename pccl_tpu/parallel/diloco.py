@@ -281,8 +281,11 @@ class Diloco:
         """Average pseudo-gradients across peers, apply outer Nesterov SGD,
         return the new global params (device pytree).
 
-        The returned tree has fresh buffers, safe to hand to a donating
-        train step; the driver keeps only the canonical flat vector.
+        ``inner_params`` is CONSUMED (its buffers are donated to the
+        pseudo-gradient computation — see codec.build_codec); continue
+        training from the returned tree. The returned tree has fresh
+        buffers, safe to hand to a donating train step; the driver keeps
+        only the canonical flat vector.
 
         With ``cfg.profile`` set, ``self.last_profile`` holds a per-phase
         wall-clock breakdown (seconds) of this step — each phase is fenced
@@ -441,6 +444,11 @@ class AsyncDiloco(Diloco):
             self._inflight_host = None
             raise err
         self._inflight_host = None
+        # NOT the fused _apply_tree_fn: the async path reads outer_params at
+        # times decoupled from the join (sync_shared_state may adopt a new
+        # vector in between, and donating callers need fresh buffers per
+        # read), so a cached tree would be a staleness hazard for a minor
+        # win in a phase that already overlaps inner compute.
         new_vec, self._momentum_vec = self._apply_fn(
             self._outer_vec, self._momentum_vec, jnp.asarray(self._async_out))
         self._outer_vec = self._applied = new_vec
@@ -448,7 +456,11 @@ class AsyncDiloco(Diloco):
 
     def outer_step_async(self, inner_params: Any) -> Any:
         """Apply the previous in-flight reduce (if any), launch the reduce of
-        this step's pseudo-gradient, return params to continue from."""
+        this step's pseudo-gradient, return params to continue from.
+
+        Like the sync path, ``inner_params`` is CONSUMED (buffers donated
+        into the pseudo-gradient); read any eval/logging values from it
+        BEFORE this call and continue from the returned tree."""
         # the pseudo-gradient baseline is the outer vector the inner phase
         # STARTED from — before the delayed update from step t-1 lands
         # (reference async semantics, docs/md/07-.../03-AsyncDiloco.md)
