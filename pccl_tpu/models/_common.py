@@ -6,12 +6,26 @@ import jax
 import jax.numpy as jnp
 
 
-def maybe_checkpoint(block_fn, remat: bool):
+def maybe_checkpoint(block_fn, remat):
     """Per-block activation checkpointing: the backward recomputes the
     layer forward instead of stashing per-layer activations, so HBM holds
     one layer's activations at a time (how big batches fit a 16 GB chip).
-    prevent_cse=False is safe (and fast) under lax.scan."""
-    return jax.checkpoint(block_fn, prevent_cse=False) if remat else block_fn
+    prevent_cse=False is safe (and fast) under lax.scan.
+
+    remat: False = stash everything; True = full remat; "dots" = save
+    weight-matmul outputs and recompute only the cheap/batched rest
+    (jax checkpoint_dots_with_no_batch_dims) — a middle point trading
+    HBM back for recompute FLOPs."""
+    if not remat:
+        return block_fn
+    if remat is True:
+        policy = None
+    elif remat == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    else:
+        raise ValueError(f"unknown remat mode {remat!r}; use False, True, "
+                         "or 'dots'")
+    return jax.checkpoint(block_fn, prevent_cse=False, policy=policy)
 
 
 def gather_ce_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:

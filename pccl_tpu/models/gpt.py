@@ -126,7 +126,7 @@ _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_qkv", "attn_out", "mlp_in", "mlp_out")
 
 
 def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
-            attn_fn=None, remat: bool = False) -> jax.Array:
+            attn_fn=None, remat: "bool | str" = False) -> jax.Array:
     """tokens: int32 [B, T] → logits float32 [B, T, vocab].
 
     attn_fn: optional (q, k, v) -> out override for the attention op —
@@ -157,7 +157,7 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
 
 
 def loss_fn(params, tokens, targets, cfg: GPTConfig, attn_fn=None,
-            remat: bool = False) -> jax.Array:
+            remat: "bool | str" = False) -> jax.Array:
     """Mean next-token cross-entropy (gather − logsumexp form; see
     models/_common.py). targets: int32 [B, T]."""
     logits = forward(params, tokens, cfg, attn_fn, remat=remat)

@@ -141,7 +141,7 @@ _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_q", "attn_kv", "attn_out",
 
 
 def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
-            attn_fn=None, remat: bool = False) -> jax.Array:
+            attn_fn=None, remat: "bool | str" = False) -> jax.Array:
     """tokens: int32 [B, T] → logits float32 [B, T, vocab].
 
     remat: checkpoint each block (see models/gpt.py:forward)."""
@@ -162,7 +162,7 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
 
 
 def loss_fn(params, tokens, targets, cfg: LlamaConfig, attn_fn=None,
-            remat: bool = False) -> jax.Array:
+            remat: "bool | str" = False) -> jax.Array:
     logits = forward(params, tokens, cfg, attn_fn, remat=remat)
     return gather_ce_loss(logits, targets)
 

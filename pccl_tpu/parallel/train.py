@@ -46,13 +46,15 @@ def make_train_state(key, cfg, mesh, lr: float = 3e-4):
 
 
 def build_train_step(cfg, tx, mesh, attn_fn=None,
-                     seq_axis: str | None = None, remat: bool = False):
+                     seq_axis: str | None = None, remat: "bool | str" = False):
     """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
 
     attn_fn: optional attention override (e.g. ring attention for sequence
     parallelism over `seq_axis`). remat: per-block activation checkpointing
-    (models/gpt.py:forward) — trades ~1/3 more FLOPs for O(1-layer)
-    activation memory, the standard fit-big-batches move on a 16 GB chip."""
+    (models/_common.py:maybe_checkpoint) — True trades ~1/3 more FLOPs for
+    O(1-layer) activation memory, the standard fit-big-batches move on a
+    16 GB chip; "dots" saves weight-matmul outputs and recomputes only the
+    rest (less recompute, more memory than True)."""
     model, sharding_fn = family(cfg)
     param_sharding = sharding_fn(mesh)
     data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)

@@ -81,3 +81,29 @@ def test_profiler_sections():
     assert len(trace["traceEvents"]) == 3
     prof.reset()
     assert prof.stats() == {}
+
+
+def test_remat_modes_match_no_remat():
+    """Both checkpointing modes (full remat, "dots" policy) are pure
+    memory/recompute trades — loss AND grads must match the stash-everything
+    path (same ops, re-executed; CPU fp is deterministic)."""
+    import jax
+    import numpy as np
+
+    from pccl_tpu.models import gpt
+
+    cfg = gpt.tiny_config()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, cfg.block_size), 0,
+                             cfg.vocab_size)
+
+    def lg(remat):
+        return jax.jit(jax.value_and_grad(
+            lambda p: gpt.loss_fn(p, tok, tok, cfg, None, remat)))(params)
+
+    l0, g0 = lg(False)
+    for mode in (True, "dots"):
+        l1, g1 = lg(mode)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g0, g1)
