@@ -194,6 +194,22 @@ def main() -> None:
                     print(f"bench: tpu {fam} failed ({type(e).__name__}: {e})",
                           file=sys.stderr)
                     extra[f"tpu_train_tokens_s_{fam}"] = None
+            # long-context leg: T=8192 single-chip training through the
+            # fused flash fwd+bwd pallas kernels (a dense backward at this
+            # T wants a 4 GB probs tensor per layer and runs 40x slower)
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-m", "pccl_tpu.benchmarks.model_bench",
+                     "gpt", "batch=1", "seq=8192", "use_flash=1", "remat=1"],
+                    capture_output=True, text=True, timeout=900, check=True)
+                r = json.loads(p.stdout.strip().splitlines()[-1])
+                extra["tpu_longctx_tokens_s"] = r["tokens_s"]
+                extra["tpu_longctx_mfu"] = r["mfu"]
+                extra["tpu_longctx_config"] = r["config"]
+            except Exception as e:  # noqa: BLE001
+                print(f"bench: tpu longctx failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+                extra["tpu_longctx_tokens_s"] = None
             # headline aliases point at the flagship (gpt) leg
             extra["tpu_train_tokens_s"] = extra.get("tpu_train_tokens_s_gpt")
             extra["tpu_mfu"] = extra.get("tpu_mfu_gpt")

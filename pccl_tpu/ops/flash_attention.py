@@ -8,7 +8,11 @@ Layout: grid over (batch·heads, q blocks); for each q block the kernel
 streams K/V blocks from VMEM with online softmax in fp32 scratch, skipping
 k blocks strictly above the causal diagonal (trip count depends only on the
 q-block index, so the loop stays statically boundable). Logits never
-materialize beyond a [block_q, block_k] tile.
+materialize beyond a [block_q, block_k] tile — in EITHER direction: the
+backward is a fused FlashAttention-2-style pair of kernels (dq, then
+dk/dv) that rebuild p = exp(s − lse) from the forward's saved log-sum-exp,
+so long-context training never touches a [T, T] tensor. All gemms run with
+bf16 operands and fp32 accumulation on the MXU.
 
 On non-TPU backends `flash_attention` falls back to the jnp reference
 implementation (CI runs on a virtual CPU mesh); `interpret=True` forces the
@@ -40,32 +44,47 @@ def reference_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq_len: int, causal: bool, scale: float):
+def _causal_mask(s, row0, col0, bq: int, bk: int):
+    """Mask entries of the [bq, bk] score tile whose absolute column index
+    exceeds its row index. Shared by the forward and BOTH backward kernels
+    so masking semantics can never desynchronize between directions."""
+    rows = row0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = col0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(cols <= rows, s, -1e30)
+
+
+def _causal_nk(qi, nk, block_q: int, block_k: int):
+    """Last k block (exclusive) any row of q block `qi` may attend to."""
+    return jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                  block_k: int, seq_len: int, causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale        # [block_q, Dh]
+    # the matmuls stay in the input dtype (bf16) with fp32 ACCUMULATION —
+    # fp32 operands would run the MXU at a fraction of its rate, and at
+    # long T the QK^T/PV gemms are the whole kernel
+    q = q_ref[0]                                     # [block_q, Dh]
 
     nk = seq_len // block_k
     if causal:
-        # last k block any row of this q block may attend to (ceil division)
-        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+        nk = _causal_nk(qi, nk, block_q, block_k)
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T                                  # [block_q, block_k]
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, -1e30)
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new[:, None])              # f32 [block_q, block_k]
         l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + p @ v
+        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_new = acc * corr[:, None] + pv
         return m_new, l_new, acc_new
 
     m0 = jnp.full((block_q,), -1e30, jnp.float32)
@@ -73,11 +92,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
     acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # log-sum-exp per row: everything the backward needs to rebuild p
+    # from scratch (p = exp(s - lse)) without storing any [T, T] tensor.
+    # lse rides as [BH, 1, T] (full-T row block, revisited across the q
+    # grid dim) — TPU lowering wants the last two block dims (8, 128)-
+    # divisible or equal to the array's, which a [1, block_q] tile isn't.
+    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = m + jnp.log(l)
 
 
 def _flash_bhtd(qt, kt, vt, *, block_q: int, block_k: int, causal: bool,
                 interpret: bool):
-    """qt,kt,vt: [BH, T, Dh] → [BH, T, Dh]."""
+    """qt,kt,vt: [BH, T, Dh] → ([BH, T, Dh] out, [BH, T] f32 lse)."""
     BH, T, Dh = qt.shape
     scale = 1.0 / math.sqrt(Dh)
     kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
@@ -85,60 +110,206 @@ def _flash_bhtd(qt, kt, vt, *, block_q: int, block_k: int, causal: bool,
     grid = (BH, T // block_q)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, T, Dh), qt.dtype),
+        out_shape=(jax.ShapeDtypeStruct((BH, T, Dh), qt.dtype),
+                   jax.ShapeDtypeStruct((BH, 1, T), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, Dh), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, T, Dh), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, T, Dh), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, Dh), lambda i, j: (i, j, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, Dh), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, 1, T), lambda i, j: (i, 0, 0))),
         interpret=interpret,
     )(qt, kt, vt)
 
 
+# --- fused backward (FlashAttention-2 shape): two kernels, no [T, T]
+# tensor ever materialized. dq: grid over q blocks, inner loop over the
+# causal k range. dk/dv: grid over k blocks, inner loop over the q range
+# at or below the diagonal. Both rebuild p = exp(s − lse) from the saved
+# log-sum-exp and use delta = rowsum(do · o) for the softmax jacobian:
+#   ds = p ⊙ (do·vᵀ − delta) · scale
+# All gemms run in the input dtype on the MXU with fp32 accumulation.
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q: int, block_k: int, seq_len: int,
+                         causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0]                                     # [bq, Dh]
+    do = do_ref[0]
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]   # [bq] f32
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+
+    nk = seq_len // block_k
+    if causal:
+        nk = _causal_nk(qi, nk, block_q, block_k)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        return dq + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    dq_ref[0] = lax.fori_loop(0, nk, body, dq0).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, block_k: int,
+                          seq_len: int, causal: bool, scale: float):
+    ki = pl.program_id(1)
+    k = k_ref[0]                                     # [bk, Dh]
+    v = v_ref[0]
+
+    nq = seq_len // block_q
+    j0 = (ki * block_k) // block_q if causal else 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do = do_ref[0, pl.ds(j * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, j * block_q, ki * block_k, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])                # [bq, bk] f32
+        pt = p.astype(do.dtype)
+        dv = dv + lax.dot_general(pt, do, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dk = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((block_k, k_ref.shape[-1]), jnp.float32)
+    dk, dv = lax.fori_loop(j0, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhtd(qt, kt, vt, ot, do, lse, *, block_q: int, block_k: int,
+                    causal: bool, interpret: bool):
+    """Fused backward over [BH, T, Dh] tensors → (dq, dk, dv)."""
+    BH, T, Dh = qt.shape
+    scale = 1.0 / math.sqrt(Dh)
+    delta = jnp.sum(do.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)[:, None, :]             # [BH, 1, T]
+    common = dict(block_q=block_q, block_k=block_k, seq_len=T, causal=causal,
+                  scale=scale)
+    row = lambda i, j: (i, j, 0)  # noqa: E731
+    full = lambda i, j: (i, 0, 0)  # noqa: E731
+    vec_blk = pl.BlockSpec((1, 1, T), lambda i, j: (i, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((BH, T, Dh), qt.dtype),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dh), row),       # q
+            pl.BlockSpec((1, T, Dh), full),            # k
+            pl.BlockSpec((1, T, Dh), full),            # v
+            pl.BlockSpec((1, block_q, Dh), row),       # do
+            vec_blk,                                   # lse
+            vec_blk,                                   # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dh), row),
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        out_shape=(jax.ShapeDtypeStruct((BH, T, Dh), kt.dtype),
+                   jax.ShapeDtypeStruct((BH, T, Dh), vt.dtype)),
+        grid=(BH, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, Dh), full),            # q
+            pl.BlockSpec((1, block_k, Dh), row),       # k
+            pl.BlockSpec((1, block_k, Dh), row),       # v
+            pl.BlockSpec((1, T, Dh), full),            # do
+            vec_blk,                                   # lse
+            vec_blk,                                   # delta
+        ],
+        out_specs=(pl.BlockSpec((1, block_k, Dh), row),
+                   pl.BlockSpec((1, block_k, Dh), row)),
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+    return dq, dk, dv
+
+
+def _to_bhtd(x):
+    B, T, H, Dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
+
+
+def _from_bhtd(x, B, H):
+    BH, T, Dh = x.shape
+    return x.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
-    B, T, H, Dh = q.shape
-
-    def to_bhtd(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
-
-    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), block_q=block_q,
-                      block_k=block_k, causal=causal, interpret=interpret)
-    return out.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)
+    B, _, H, _ = q.shape
+    out, _ = _flash_bhtd(_to_bhtd(q), _to_bhtd(k), _to_bhtd(v),
+                         block_q=block_q, block_k=block_k, causal=causal,
+                         interpret=interpret)
+    return _from_bhtd(out, B, H)
 
 
 def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_diff(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    B, _, H, _ = q.shape
+    qt, kt, vt = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
+    out, lse = _flash_bhtd(qt, kt, vt, block_q=block_q, block_k=block_k,
+                           causal=causal, interpret=interpret)
+    return _from_bhtd(out, B, H), (qt, kt, vt, out, lse, B, H)
 
 
 def _flash_diff_bwd(causal, block_q, block_k, interpret, res, g):
-    # Backward recomputes through the dense reference path (O(T²) logits in
-    # the backward only); a fused flash backward kernel can swap in here
-    # without changing the public API.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
-        q, k, v)
-    return vjp(g)
+    # Fused flash backward: rebuilds p from the saved lse per tile — the
+    # O(T²) score matrix never exists in HBM in either direction, which is
+    # what makes long-context training fit (a dense backward at T=8192
+    # wants a 4 GB probs tensor PER LAYER).
+    qt, kt, vt, ot, lse, B, H = res
+    dq, dk, dv = _flash_bwd_bhtd(qt, kt, vt, ot, _to_bhtd(g), lse,
+                                 block_q=block_q, block_k=block_k,
+                                 causal=causal, interpret=interpret)
+    return (_from_bhtd(dq, B, H), _from_bhtd(dk, B, H), _from_bhtd(dv, B, H))
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
+                    block_k: int = 512, interpret: bool = False):
     """Fused causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh].
 
-    Uses the pallas kernel on TPU (or under `interpret`); falls back to the
-    dense jnp path elsewhere or when T doesn't tile. Differentiable: the
-    forward runs the fused kernel, the backward recomputes via the dense
-    reference attention (custom_vjp), so it drops into build_train_step."""
+    Uses the pallas kernels on TPU (or under `interpret`); falls back to
+    the dense jnp path elsewhere or when T doesn't tile. Differentiable:
+    forward AND backward are fused kernels (custom_vjp over the saved
+    log-sum-exp), so it drops into build_train_step and stays O(T) in
+    memory for long-context training."""
     B, T, H, Dh = q.shape
     on_tpu = jax.default_backend() == "tpu"
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
+    # snap blocks DOWN to divisors of T so mid-size T (1280, 2560, ...)
+    # stays on the kernel instead of silently falling back to the dense
+    # O(T^2) path; below 128 the tile no longer fills the MXU, so bail
+    def _snap(b):
+        b = min(b, T)
+        while b >= 128 and T % b:
+            b //= 2
+        return b
+
+    block_q, block_k = _snap(block_q), _snap(block_k)
     if not (on_tpu or interpret) or T % block_q or T % block_k:
         return reference_attention(q, k, v, causal=causal)
     return _flash_diff(q, k, v, causal, block_q, block_k, interpret)

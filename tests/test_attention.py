@@ -157,3 +157,28 @@ def test_llama_forward_with_ring_attention(eight_devices):
             params, tok_sp)
     np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_flash_grad_noncausal_and_asym_blocks():
+    """Regression cover for the fused backward's untested corners: the
+    non-causal branch and block_q != block_k (exercises the dkv kernel's
+    diagonal start-block arithmetic j0 = ki*block_k // block_q)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.ops.flash_attention import _flash_diff, reference_attention
+
+    q, k, v = _qkv(B=1, T=128, H=2, Dh=16)
+
+    for causal, bq, bk in ((False, 32, 32), (True, 16, 64), (True, 64, 16)):
+        def loss_f(q, k, v):
+            return jnp.sum(_flash_diff(q, k, v, causal, bq, bk, True) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4), (causal, bq, bk)
