@@ -31,17 +31,9 @@ from jax.experimental import pallas as pl
 
 
 def reference_attention(q, k, v, causal: bool = True):
-    """Dense jnp causal attention; q,k,v: [B, T, H, Dh]."""
-    Dh = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    logits = logits / math.sqrt(Dh)
-    if causal:
-        T = q.shape[1]
-        qi = lax.broadcasted_iota(jnp.int32, (T, T), 0)
-        ki = lax.broadcasted_iota(jnp.int32, (T, T), 1)
-        logits = jnp.where(ki <= qi, logits, -1e30)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    """Dense jnp causal attention; q,k,v: [B, T, H, Dh]. One source of
+    truth with the ring fallback: softmax == exp(logits − lse)."""
+    return dense_attention_with_lse(q, k, v, causal)[0]
 
 
 def _causal_mask(s, row0, col0, bq: int, bk: int):
@@ -201,12 +193,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_bhtd(qt, kt, vt, ot, do, lse, *, block_q: int, block_k: int,
-                    causal: bool, interpret: bool):
-    """Fused backward over [BH, T, Dh] tensors → (dq, dk, dv)."""
+                    causal: bool, interpret: bool, delta_override=None):
+    """Fused backward over [BH, T, Dh] tensors → (dq, dk, dv).
+
+    delta_override: callers differentiating an (out, lse) PAIR pass
+    delta − dlse here (flash_attention_with_lse's backward)."""
     BH, T, Dh = qt.shape
     scale = 1.0 / math.sqrt(Dh)
-    delta = jnp.sum(do.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)[:, None, :]             # [BH, 1, T]
+    if delta_override is None:
+        delta = jnp.sum(do.astype(jnp.float32) * ot.astype(jnp.float32),
+                        axis=-1)[:, None, :]         # [BH, 1, T]
+    else:
+        delta = delta_override
     common = dict(block_q=block_q, block_k=block_k, seq_len=T, causal=causal,
                   scale=scale)
     row = lambda i, j: (i, j, 0)  # noqa: E731
@@ -289,6 +287,79 @@ def _flash_diff_bwd(causal, block_q, block_k, interpret, res, g):
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+def snap_block(b: int, T: int) -> int:
+    """Snap a block size DOWN to a divisor of T so mid-size T (1280,
+    2560, ...) stays on the kernel instead of silently falling back to the
+    dense O(T^2) path; below 128 the tile no longer fills the MXU, so the
+    caller's divisibility check then routes to the fallback. Shared by
+    flash_attention and the ring-attention per-shard path."""
+    b = min(b, T)
+    while b >= 128 and T % b:
+        b //= 2
+    return b
+
+
+def dense_attention_with_lse(q, k, v, causal: bool = True):
+    """jnp twin of flash_attention_with_lse for non-TPU backends: returns
+    (out [B,T,H,Dh], lse [B,H,T] f32). Plain jnp, so autodiff covers it."""
+    Dh = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(Dh)
+    if causal:
+        T = q.shape[1]
+        qi = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        logits = jnp.where(ki <= qi, logits, -1e30)
+    lse = jax.nn.logsumexp(logits, axis=-1)          # [B, H, T]
+    p = jnp.exp(logits - lse[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
+    """Fused attention returning (out, lse [B, H, T] f32) — the form block-
+    combiners need (ring attention folds per-shard results by lse). Both
+    outputs are differentiable: the backward folds the incoming dlse into
+    delta (d lse/d s = p, so ds = p ⊙ (dp − (delta − dlse))) and reuses the
+    same fused kernels."""
+    B, _, H, _ = q.shape
+    out, lse = _flash_bhtd(_to_bhtd(q), _to_bhtd(k), _to_bhtd(v),
+                           block_q=block_q, block_k=block_k, causal=causal,
+                           interpret=interpret)
+    T = lse.shape[-1]
+    return _from_bhtd(out, B, H), lse.reshape(B, H, T)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    B, _, H, _ = q.shape
+    qt, kt, vt = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
+    out, lse = _flash_bhtd(qt, kt, vt, block_q=block_q, block_k=block_k,
+                           causal=causal, interpret=interpret)
+    T = lse.shape[-1]
+    return ((_from_bhtd(out, B, H), lse.reshape(B, H, T)),
+            (qt, kt, vt, out, lse, B, H))
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
+    do, dlse = g
+    qt, kt, vt, ot, lse, B, H = res
+    dot = _to_bhtd(do)
+    # delta_eff = rowsum(do·o) − dlse: the lse cotangent enters every ds
+    # tile through the same row-broadcast slot delta occupies, so the
+    # kernels need no change — see _flash_bwd_bhtd's delta_override
+    delta = (jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                     axis=-1)
+             - dlse.reshape(ot.shape[0], ot.shape[1]))[:, None, :]
+    dq, dk, dv = _flash_bwd_bhtd(qt, kt, vt, ot, dot, lse,
+                                 block_q=block_q, block_k=block_k,
+                                 causal=causal, interpret=interpret,
+                                 delta_override=delta)
+    return (_from_bhtd(dq, B, H), _from_bhtd(dk, B, H), _from_bhtd(dv, B, H))
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False):
     """Fused causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh].
@@ -300,16 +371,7 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
     memory for long-context training."""
     B, T, H, Dh = q.shape
     on_tpu = jax.default_backend() == "tpu"
-    # snap blocks DOWN to divisors of T so mid-size T (1280, 2560, ...)
-    # stays on the kernel instead of silently falling back to the dense
-    # O(T^2) path; below 128 the tile no longer fills the MXU, so bail
-    def _snap(b):
-        b = min(b, T)
-        while b >= 128 and T % b:
-            b //= 2
-        return b
-
-    block_q, block_k = _snap(block_q), _snap(block_k)
+    block_q, block_k = snap_block(block_q, T), snap_block(block_k, T)
     if not (on_tpu or interpret) or T % block_q or T % block_k:
         return reference_attention(q, k, v, causal=causal)
     return _flash_diff(q, k, v, causal, block_q, block_k, interpret)

@@ -6,14 +6,15 @@ first-class requirement, so it lives here as a core op, not an example.
 
 Design (Liu et al., Ring Attention; implemented the XLA-collective way):
 Q/K/V are sequence-sharded over mesh axis `sp`. Each step, every device
-computes blockwise attention of its resident Q block against the currently
-held K/V block, folds the result into an online-softmax accumulator
-(running max `m`, normalizer `l`, weighted sum `o`), then rotates K/V one
+runs ONE per-shard attention of its resident Q block against the currently
+held K/V block — the fused flash-attention pallas kernels on TPU (forward
+and backward; no [Tl, Tl] tensor ever), the jnp twin elsewhere — and folds
+the (out, log-sum-exp) pair into its accumulator, then rotates K/V one
 hop around the ring with `lax.ppermute` — after sp_size steps every Q block
 has seen every K/V block while K/V traffic only ever crosses neighboring
 devices (rides ICI, never DCN). XLA's latency-hiding scheduler overlaps the
-ppermute with the next block's compute; peak memory per device is O(T²/n²)
-for logits instead of O(T²).
+ppermute with the next step's kernel; peak per-device attention memory is
+one kernel tile on TPU (O(T²/n²) dense logits on the jnp fallback).
 
 Causality uses GLOBAL positions (rank-offset iota), so the result is
 bit-equivalent in exact arithmetic to dense causal attention over the full
@@ -22,7 +23,6 @@ sequence.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Optional
 
@@ -32,62 +32,60 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _block_attn(q, k, v, q_pos, k_pos, m, l, o, causal: bool, scale: float):
-    """One online-softmax accumulation step.
+def _shard_attn_with_lse(q, k, v, blk_causal: bool):
+    """Per-shard attention returning (out, lse [B, H, Tl]) — the fused
+    pallas kernels on TPU (forward AND backward; no [Tl, Tl] tensor),
+    the jnp twin elsewhere. Blocks snapped to divisors of Tl."""
+    from .flash_attention import (dense_attention_with_lse,
+                                  flash_attention_with_lse, snap_block)
 
-    q,k,v: [B, Tl, H, Dh]; m,l: [B, H, Tl]; o: [B, Tl, H, Dh] (fp32).
-    Returns updated (m, l, o)."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
-        logits = jnp.where(mask, logits, -1e30)
-    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))          # [B, H, Tl]
-    corr = jnp.exp(m - m_new)
-    p = jnp.exp(logits - m_new[..., None])                    # [B, H, Tq, Tk]
-    l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
-    return m_new, l_new, o_new
+    Tl = q.shape[1]
+    bq, bk = snap_block(256, Tl), snap_block(512, Tl)
+    if jax.default_backend() == "tpu" and Tl % bq == 0 and Tl % bk == 0:
+        return flash_attention_with_lse(q, k, v, blk_causal, bq, bk, False)
+    return dense_attention_with_lse(q, k, v, blk_causal)
 
 
-def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
-                     manual_axes: tuple):
-    """Per-device body under shard_map. q,k,v: [B, Tl, H, Dh] (local)."""
+def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body under shard_map. q,k,v: [B, Tl, H, Dh] (local).
+
+    The ring is UNROLLED over the (static) axis size: at step s the device
+    holds the K/V block of rank (r − s) mod n, so under causal masking the
+    visibility of the whole block is all-or-nothing — s == 0 is the
+    diagonal (a causal per-shard call), s > 0 is fully visible iff r ≥ s.
+    Each step is therefore ONE per-shard attention (the fused flash kernel
+    on TPU) plus a log-sum-exp fold:
+
+        lse' = logaddexp(lse, lse_s)
+        o'   = o·exp(lse − lse') + o_s·exp(lse_s − lse')
+
+    with an invisible step entering as lse_s = −inf (weight exactly 0).
+    Step 0 runs first and is always visible, so the accumulator lse is
+    finite from the first fold and no −inf − −inf NaN can arise.
+    ppermute rotates K/V between steps; XLA's latency-hiding scheduler
+    overlaps the rotation with the next step's kernel."""
     B, Tl, H, Dh = q.shape
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
-    scale = 1.0 / math.sqrt(Dh)
-    q32, k0, v0 = q, k, v
-
-    q_pos = r * Tl + jnp.arange(Tl)
-
-    # initial accumulators must carry the same varying-manual-axes type as
-    # the loop outputs (shard_map's varying-axis tracking)
-    def _vary(x):
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, manual_axes, to="varying")
-        return lax.pvary(x, manual_axes)  # removed in newer JAX
-
-    m0 = _vary(jnp.full((B, H, Tl), -1e30, jnp.float32))
-    l0 = _vary(jnp.zeros((B, H, Tl), jnp.float32))
-    o0 = _vary(jnp.zeros((B, Tl, H, Dh), jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(s, carry):
-        m, l, o, kb, vb = carry
-        src = (r - s) % n                      # whose block we hold at step s
-        k_pos = src * Tl + jnp.arange(Tl)
-        m, l, o = _block_attn(q32, kb, vb, q_pos, k_pos, m, l, o, causal, scale)
-        # rotate K/V to the next rank (skippable on the last step, but a
-        # static-trip-count scan keeps XLA free to overlap it with compute)
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        return m, l, o, kb, vb
-
-    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k0, v0))
-    # causal rows always see at least the diagonal, so l > 0
-    out = o / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    o = jnp.zeros((B, Tl, H, Dh), jnp.float32)
+    lse = jnp.full((B, H, Tl), -jnp.inf, jnp.float32)
+    kb, vb = k, v
+    for s in range(n):
+        o_s, lse_s = _shard_attn_with_lse(q, kb, vb, causal and s == 0)
+        if causal and s > 0:
+            visible = r >= s                       # whole-block visibility
+            lse_s = jnp.where(visible, lse_s, -jnp.inf)
+        lse_new = jnp.logaddexp(lse, lse_s)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(lse_s - lse_new).transpose(0, 2, 1)[..., None]
+        o = o * w_old + o_s.astype(jnp.float32) * w_new
+        lse = lse_new
+        if s != n - 1:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+    return o.astype(q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
@@ -98,13 +96,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     q,k,v: [B, T, H, Dh] with T sharded over mesh axis `axis` and B
     (optionally) over `batch_axis`. Returns [B, T, H, Dh], same layout.
     Composes inside an outer jit."""
+    import inspect
+
     ba = batch_axis if batch_axis and batch_axis in mesh.shape else None
     spec = P(ba, axis)
-    manual = tuple(mesh.axis_names)
+    # pallas_call outputs carry no varying-mesh-axes annotation, which the
+    # replication checker refuses inside a checked shard_map; the kwarg
+    # was renamed check_rep -> check_vma across jax versions
+    params = inspect.signature(jax.shard_map).parameters
+    kw = ({"check_vma": False} if "check_vma" in params
+          else {"check_rep": False} if "check_rep" in params else {})
     fn = jax.shard_map(
-        partial(_ring_attn_local, axis_name=axis, causal=causal,
-                manual_axes=manual),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        partial(_ring_attn_local, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw,
     )
     return fn(q, k, v)
 

@@ -182,3 +182,39 @@ def test_flash_grad_noncausal_and_asym_blocks():
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4), (causal, bq, bk)
+
+
+def test_flash_with_lse_pair_grads():
+    """flash_attention_with_lse returns a DIFFERENTIABLE (out, lse) pair —
+    the form ring attention folds per shard. The backward folds the lse
+    cotangent into delta (ds = p*(dp - (delta - dlse))), so a loss that
+    touches BOTH outputs must match the jnp twin exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.ops.flash_attention import (dense_attention_with_lse,
+                                              flash_attention_with_lse)
+
+    q, k, v = _qkv(B=2, T=64, H=2, Dh=16)
+
+    for causal in (True, False):
+        of, lf = flash_attention_with_lse(q, k, v, causal, 32, 32, True)
+        od, ld = dense_attention_with_lse(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(of), np.asarray(od),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(ld),
+                                   rtol=1e-5, atol=1e-5)
+
+        def loss_f(q, k, v):
+            o, l = flash_attention_with_lse(q, k, v, causal, 32, 32, True)
+            return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))  # both outputs live
+
+        def loss_d(q, k, v):
+            o, l = dense_attention_with_lse(q, k, v, causal)
+            return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
