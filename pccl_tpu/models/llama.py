@@ -13,10 +13,13 @@ substrate as models/gpt.py:
   (column-parallel in-projections, row-parallel out-projections; see
   mesh.LLAMA_PARAM_SPECS).
 
-GQA is laid out so the per-head K/V tensors shard over tp like Q does: the
-kv heads are repeated to the full head count ON DEVICE just before the
-attention op, which keeps any attn_fn override (flash attention, ring
-attention) oblivious to the grouping.
+GQA is native end to end (round 5): K/V stay Hkv-shaped from the kv
+projection through the attention op — the flash kernels and the ring
+path consume grouped K/V directly (head mapping lives in the kernels'
+BlockSpec index maps, ops/flash_attention.py), so HBM never holds a
+repeated K/V tensor and the architecture's KV-bytes advantage survives
+exactly where it matters, long context. Only the plain-jnp fallback
+`_attention` repeats internally (correctness path, CPU CI).
 """
 
 from __future__ import annotations
@@ -100,8 +103,12 @@ def _rope(x: jax.Array, theta: float) -> jax.Array:
 
 
 def _attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Causal attention. q,k,v: [B, T, H, Dh] → [B, T, H, Dh]."""
-    _, T, _, Dh = q.shape
+    """Causal attention fallback. q: [B, T, H, Dh], k/v: [B, T, Hkv, Dh]
+    (GQA folded here by repeating — the kernel paths never do)."""
+    _, T, H, Dh = q.shape
+    if k.shape[2] != H:
+        k = jnp.repeat(k, H // k.shape[2], axis=2)
+        v = jnp.repeat(v, H // v.shape[2], axis=2)
     scale = 1.0 / math.sqrt(Dh)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     qi = lax.broadcasted_iota(jnp.int32, (T, T), 0)
@@ -123,11 +130,9 @@ def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: LlamaConfig,
     v = v.reshape(B, T, Hkv, Dh)
     q = _rope(q, cfg.rope_theta)
     k = _rope(k, cfg.rope_theta)
-    # repeat kv groups to full head count so attn_fn overrides (flash/ring)
-    # see ordinary multi-head inputs; XLA fuses the broadcast into the gemm
-    if Hkv != H:
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
+    # K/V go to attn_fn Hkv-shaped: the flash/ring kernels are GQA-native
+    # (kv-head mapping in their index maps), so no repeated K/V ever
+    # exists in HBM; jnp fallbacks repeat internally for correctness only
     att = (attn_fn or _attention)(q, k, v).reshape(B, T, d)
     x = x + att @ layer["attn_out"].astype(att.dtype)
     h = _rmsnorm(x, layer["ln2_g"])

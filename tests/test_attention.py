@@ -218,3 +218,88 @@ def test_flash_with_lse_pair_grads():
         for a, b in zip(gf, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+def _gqa_qkv(B=2, T=128, H=8, Hkv=2, Dh=16, seed=3):
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, H, Dh), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, Hkv, Dh), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, Hkv, Dh), jnp.float32)
+    return q, k, v
+
+
+def test_flash_gqa_matches_repeated_dense():
+    """GQA-native kernels (Hkv-shaped K/V, head mapping in the BlockSpec
+    index maps — no jnp.repeat anywhere on the kernel path) must match
+    dense attention over explicitly repeated K/V, forward and backward.
+    VERDICT r4 ask #2: llama's K/V repeat erased the architecture's
+    KV-bytes advantage."""
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.ops.flash_attention import _flash_diff, reference_attention
+
+    q, k, v = _gqa_qkv()
+    G = q.shape[2] // k.shape[2]
+    krep = jnp.repeat(k, G, axis=2)
+    vrep = jnp.repeat(v, G, axis=2)
+
+    for causal in (True, False):
+        out = _flash_diff(q, k, v, causal, 32, 32, True)
+        ref = reference_attention(q, krep, vrep, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def loss_f(q, k, v):
+        return jnp.sum(_flash_diff(q, k, v, True, 32, 32, True) ** 2)
+
+    def loss_r(q, k, v):
+        out = reference_attention(q, jnp.repeat(k, G, axis=2),
+                                  jnp.repeat(v, G, axis=2))
+        return jnp.sum(out ** 2)
+
+    # autodiff through loss_r's jnp.repeat already folds the G copies, so
+    # both sides produce the native Hkv-shaped dk/dv
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gqa_with_lse_pair():
+    """The (out, lse) pair path (ring attention's per-shard form) with
+    GQA-shaped K/V: values and both-output grads match the jnp twin."""
+    import jax
+    import jax.numpy as jnp
+
+    from pccl_tpu.ops.flash_attention import (dense_attention_with_lse,
+                                              flash_attention_with_lse)
+
+    q, k, v = _gqa_qkv(B=1, T=64, H=4, Hkv=2)
+
+    of, lf = flash_attention_with_lse(q, k, v, True, 32, 32, True)
+    od, ld = dense_attention_with_lse(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(od),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(ld),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_f(q, k, v):
+        o, l = flash_attention_with_lse(q, k, v, True, 32, 32, True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+    def loss_d(q, k, v):
+        o, l = dense_attention_with_lse(q, k, v, True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
