@@ -157,6 +157,16 @@ def main() -> None:
             print(f"bench: wan bf16 failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["wan_bf16_quant_speedup"] = None
+        # the fat-pipe A/B: same ring on an emulated 1 Gbit/s x 50 ms RTT
+        # pipe (bandwidth pacing + delivery delay line), single flow vs 4
+        # concurrent windowed collectives — the regime windowing exists for
+        try:
+            for k, v in native_bench.run_wan_rtt_windowed_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: wan rtt failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["wan_rtt_windowed_speedup"] = None
 
     # On-chip model legs: the jitted bf16 train step on the real TPU —
     # tokens/s + MFU per family (skip-guarded when no TPU is attached;
@@ -194,22 +204,30 @@ def main() -> None:
                     print(f"bench: tpu {fam} failed ({type(e).__name__}: {e})",
                           file=sys.stderr)
                     extra[f"tpu_train_tokens_s_{fam}"] = None
-            # long-context leg: T=8192 single-chip training through the
-            # fused flash fwd+bwd pallas kernels (a dense backward at this
-            # T wants a 4 GB probs tensor per layer and runs 40x slower)
-            try:
-                p = subprocess.run(
-                    [sys.executable, "-m", "pccl_tpu.benchmarks.model_bench",
-                     "gpt", "batch=1", "seq=8192", "use_flash=1", "remat=1"],
-                    capture_output=True, text=True, timeout=900, check=True)
-                r = json.loads(p.stdout.strip().splitlines()[-1])
-                extra["tpu_longctx_tokens_s"] = r["tokens_s"]
-                extra["tpu_longctx_mfu"] = r["mfu"]
-                extra["tpu_longctx_config"] = r["config"]
-            except Exception as e:  # noqa: BLE001
-                print(f"bench: tpu longctx failed ({type(e).__name__}: {e})",
-                      file=sys.stderr)
-                extra["tpu_longctx_tokens_s"] = None
+            # long-context legs: single-chip training through the fused
+            # k-blocked flash fwd+bwd pallas kernels (a dense backward at
+            # these T wants a multi-GB probs tensor per layer; the round-4
+            # full-T-resident kernels topped out at T=8192 on the VMEM
+            # ceiling). The llama leg is GQA-native: Hkv-shaped K/V all
+            # the way through the kernels.
+            for key, fam, seq in (("tpu_longctx", "gpt", 8192),
+                                  ("tpu_longctx16k", "gpt", 16384),
+                                  ("tpu_longctx_llama", "llama", 8192)):
+                try:
+                    p = subprocess.run(
+                        [sys.executable, "-m",
+                         "pccl_tpu.benchmarks.model_bench", fam, "batch=1",
+                         f"seq={seq}", "use_flash=1", "remat=1"],
+                        capture_output=True, text=True, timeout=900,
+                        check=True)
+                    r = json.loads(p.stdout.strip().splitlines()[-1])
+                    extra[f"{key}_tokens_s"] = r["tokens_s"]
+                    extra[f"{key}_mfu"] = r["mfu"]
+                    extra[f"{key}_config"] = r["config"]
+                except Exception as e:  # noqa: BLE001
+                    print(f"bench: {key} failed ({type(e).__name__}: {e})",
+                          file=sys.stderr)
+                    extra[f"{key}_tokens_s"] = None
             # headline aliases point at the flagship (gpt) leg
             extra["tpu_train_tokens_s"] = extra.get("tpu_train_tokens_s_gpt")
             extra["tpu_mfu"] = extra.get("tpu_mfu_gpt")

@@ -28,18 +28,29 @@ import numpy as np
 
 
 @contextmanager
-def _paced_wire(mbps: float):
-    """PCCLT_WIRE_MBPS egress pacing for every peer spawned inside the
+def _wire_env(name: str, value: float):
+    """Set a wire-emulation env var for every peer spawned inside the
     block (children inherit the env), restored on exit."""
-    old = os.environ.get("PCCLT_WIRE_MBPS")
-    os.environ["PCCLT_WIRE_MBPS"] = str(mbps)
+    old = os.environ.get(name)
+    os.environ[name] = str(value)
     try:
         yield
     finally:
         if old is None:
-            os.environ.pop("PCCLT_WIRE_MBPS", None)
+            os.environ.pop(name, None)
         else:
-            os.environ["PCCLT_WIRE_MBPS"] = old
+            os.environ[name] = old
+
+
+def _paced_wire(mbps: float):
+    """PCCLT_WIRE_MBPS egress pacing (bandwidth emulation)."""
+    return _wire_env("PCCLT_WIRE_MBPS", mbps)
+
+
+def _rtt_wire(rtt_ms: float):
+    """PCCLT_WIRE_RTT_MS round-trip-time emulation (delivery delay line in
+    sockets.cpp)."""
+    return _wire_env("PCCLT_WIRE_RTT_MS", rtt_ms)
 
 
 def _port(env: str, dflt: int) -> int:
@@ -329,6 +340,64 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
             med = sorted(times)[len(times) // 2]
             out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
     out["wan_quant_speedup"] = out["wan_u8zps_busbw_gbps"] / out["wan_fp32_busbw_gbps"]
+    return out
+
+
+def _peer_wan_rtt(rank, master_port, q, world, nbytes, iters, windows,
+                  port_base):
+    from pccl_tpu.parallel.ring import avg_all_reduce_windowed
+
+    comm = _connect(rank, master_port, world, port_base)
+    rng = np.random.default_rng(11 + rank)
+    x = rng.standard_normal(nbytes // 4).astype(np.float32)
+    avg_all_reduce_windowed(comm, x, windows=windows)    # warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        avg_all_reduce_windowed(comm, x, windows=windows)
+        times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_wan_rtt_windowed_bench(world: int = 4, nbytes: int = 16 << 20,
+                               iters: int = 3, mbps: float = 1000.0,
+                               rtt_ms: float = 50.0,
+                               mports: Tuple[int, int] = (48679, 48681),
+                               bases: Tuple[int, int] = (46600, 47000),
+                               ) -> Dict[str, float]:
+    """The fat-pipe A/B: reduce windowing's reason to exist (reference
+    pitch: concurrent reduces saturating the WAN,
+    /root/reference/docs/md/01_Introduction.md:8). Same ``world``-peer AVG
+    ring over an emulated high-bandwidth-delay pipe — ``mbps`` egress
+    pacing (PCCLT_WIRE_MBPS) x ``rtt_ms`` round-trip latency
+    (PCCLT_WIRE_RTT_MS delivery delay line) — once as a single flow
+    (windows=1), once split into 4 concurrent tagged collectives over the
+    connection pool (avg_all_reduce_windowed; 4 is the most the default
+    16 MB payload admits under the 1M-element window floor). A single
+    flow pays every
+    stage-boundary latency stall serially (each ring hop's chunk chain
+    fills owd late, and the per-op consensus round trips are exposed);
+    concurrent windows overlap one window's stalls with another's drain.
+    Returns busbw for both plus wan_rtt_windowed_speedup (>1 = windowing
+    pays on fat pipes). Measured sweet spot: the win GROWS as the payload
+    shrinks toward the bandwidth-delay product (1.46-1.53x at 16 MB vs
+    1.20x at 32 MB on this host) — exactly the latency-dominated regime
+    real outer-step shards live in."""
+    out: Dict[str, float] = {}
+    with _paced_wire(mbps), _rtt_wire(rtt_ms):
+        for name, windows, mport, base in (
+                ("wan_rtt_single_busbw_gbps", 1, mports[0], bases[0]),
+                ("wan_rtt_windowed_busbw_gbps", 4, mports[1], bases[1])):
+            res = _spawn_world(world, _peer_wan_rtt,
+                               _port("PCCLT_BENCH_MASTER_PORT_RTT", mport),
+                               (world, nbytes, iters, windows, base),
+                               inline_rank0=False)
+            times = next(r["times"] for r in res if r["rank"] == 0)
+            med = sorted(times)[len(times) // 2]
+            out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
+    out["wan_rtt_windowed_speedup"] = (out["wan_rtt_windowed_busbw_gbps"] /
+                                       out["wan_rtt_single_busbw_gbps"])
     return out
 
 

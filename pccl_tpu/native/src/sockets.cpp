@@ -13,6 +13,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <random>
 
 #include "log.hpp"
@@ -856,6 +857,92 @@ private:
     std::mutex mu_;
 };
 
+// --- emulated-WAN one-way delivery latency -------------------------------
+// PCCLT_WIRE_RTT_MS=<ms> models the pipe's round-trip time: every received
+// data frame becomes VISIBLE to its consumer (extent marking / queue
+// delivery + wakeup) RTT/2 after its bytes finished draining the emulated
+// wire. Semantics are a delay LINE, not a per-frame sleep: the RX thread
+// never blocks — it keeps draining the socket at wire rate and enqueues
+// the visibility flip with a deadline, so back-to-back frames each arrive
+// owd later while preserving their bandwidth spacing (one latency per
+// dependency chain, exactly like a real long pipe). This is the missing
+// term the round-4 WAN emulation lacked (bandwidth only): without it the
+// fat-pipe features — reduce windowing, connection pools — can never show
+// the stage-latency stalls they exist to hide.
+class DeliveryDelay {
+public:
+    static DeliveryDelay &inst() {
+        // intentionally leaked: the detached timer thread blocks on mu_/cv_
+        // forever, so a static-destruction teardown would be UB at exit
+        static DeliveryDelay *d = new DeliveryDelay;
+        return *d;
+    }
+    bool enabled() const { return owd_ns_.load(std::memory_order_relaxed) > 0; }
+    void refresh() {
+        uint64_t ns = 0;
+        if (const char *e = std::getenv("PCCLT_WIRE_RTT_MS")) {
+            double ms = atof(e);
+            if (ms > 0) ns = static_cast<uint64_t>(ms * 0.5e6); // one-way
+        }
+        owd_ns_.store(ns, std::memory_order_relaxed);
+    }
+    // Run `fn` once the one-way delay has elapsed from now (= wire drain
+    // time: the sender's pacer completed the write at drain end, loopback
+    // delivery is instant, and this RX thread never sleeps).
+    void deliver(std::function<void()> fn) {
+        uint64_t at = now_ns() + owd_ns_.load(std::memory_order_relaxed);
+        {
+            std::lock_guard lk(mu_);
+            q_.emplace(at, std::move(fn));
+            if (!running_) {
+                running_ = true;
+                std::thread([this] { timer_loop(); }).detach();
+            }
+        }
+        cv_.notify_one();
+    }
+
+private:
+    DeliveryDelay() { refresh(); }
+    static uint64_t now_ns() {
+        struct timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+               static_cast<uint64_t>(ts.tv_nsec);
+    }
+    void timer_loop() {
+        std::unique_lock lk(mu_);
+        while (true) {
+            if (q_.empty()) {
+                cv_.wait_for(lk, std::chrono::seconds(1));
+                continue;
+            }
+            uint64_t at = q_.begin()->first;
+            uint64_t now = now_ns();
+            if (now < at) {
+                cv_.wait_for(lk, std::chrono::nanoseconds(at - now));
+                continue;
+            }
+            auto fn = std::move(q_.begin()->second);
+            q_.erase(q_.begin());
+            lk.unlock();
+            fn();
+            lk.lock();
+        }
+    }
+    std::atomic<uint64_t> owd_ns_{0};
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::multimap<uint64_t, std::function<void()>> q_; // deadline-ordered
+    bool running_ = false;
+};
+
+// Any wire emulation (bandwidth pacing or RTT) must defeat the same-host
+// zero-copy transports — an emulated WAN cannot be bypassed by CMA/shm.
+bool wire_emulated() {
+    return WirePacer::inst().enabled() || DeliveryDelay::inst().enabled();
+}
+
 constexpr size_t kRxSlice = 256 << 10;  // TCP sink write slice (cancel latency)
 constexpr uint32_t kMaxDataFrame = 272u << 20;
 
@@ -877,10 +964,11 @@ MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
     tx_chunk_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
     cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
     WirePacer::inst().refresh();
-    // under pacing, cap the wire chunk: a streamed receiver consumes as
-    // frames land, and at WAN rates an 8 MB frame is ~60 ms of pipeline
-    // stall before the first byte of a ring slice can be reduced
-    if (WirePacer::inst().enabled())
+    DeliveryDelay::inst().refresh();
+    // under wire emulation, cap the wire chunk: a streamed receiver
+    // consumes as frames land, and at WAN rates an 8 MB frame is ~60 ms of
+    // pipeline stall before the first byte of a ring slice can be reduced
+    if (wire_emulated())
         tx_chunk_ = std::min(tx_chunk_, size_t{256} << 10);
 }
 
@@ -899,7 +987,7 @@ MultiplexConn::~MultiplexConn() {
 
 void MultiplexConn::run() {
     alive_ = true;
-    cma_ok_ = cma_enabled_env() && !WirePacer::inst().enabled() &&
+    cma_ok_ = cma_enabled_env() && !wire_emulated() &&
               sock_.peer_is_loopback();
     sock_.set_quickack();
     table_->attach(shared_from_this());
@@ -1595,19 +1683,65 @@ void MultiplexConn::rx_loop() {
                     cancelled = it == table_->sinks_.end() || it->second.cancel;
                 }
             }
+            bool delivered = ok && !cancelled;
+            bool delay = DeliveryDelay::inst().enabled();
             {
                 std::lock_guard lk(table_->mu_);
                 auto it = table_->sinks_.find(tag);
                 if (it != table_->sinks_.end()) {
-                    --it->second.busy;
-                    if (ok && !cancelled) it->second.add_extent(off, off + n);
+                    --it->second.busy;   // buffer write done: release NOW
+                    if (delivered && !delay)
+                        it->second.add_extent(off, off + n);
                 }
             }
-            table_->signal_tag(tag);
+            if (delivered && delay) {
+                // bytes already landed zero-copy in the sink; only their
+                // VISIBILITY (extent + wakeup) rides the delay line
+                DeliveryDelay::inst().deliver([tbl = table_, tag, off, n] {
+                    {
+                        std::lock_guard lk(tbl->mu_);
+                        auto it = tbl->sinks_.find(tag);
+                        if (it != tbl->sinks_.end() && !it->second.cancel &&
+                            off + n <= it->second.cap)
+                            it->second.add_extent(off, off + n);
+                    }
+                    tbl->signal_tag(tag);
+                });
+            } else {
+                table_->signal_tag(tag);
+            }
             if (!ok) break;
         } else {
             scratch.resize(n);
             if (n > 0 && !sock_.recv_all(scratch.data(), n)) break;
+            if (DeliveryDelay::inst().enabled()) {
+                // copy the payload onto the delay line; the closure re-runs
+                // the sink-or-queue logic at visibility time
+                std::vector<uint8_t> bytes(scratch.begin(),
+                                           scratch.begin() + n);
+                DeliveryDelay::inst().deliver(
+                    [tbl = table_, tag, off, bytes = std::move(bytes)] {
+                        {
+                            std::lock_guard lk(tbl->mu_);
+                            auto it = tbl->sinks_.find(tag);
+                            size_t n = bytes.size();
+                            if (it != tbl->sinks_.end() &&
+                                !it->second.cancel &&
+                                off + n <= it->second.cap) {
+                                memcpy(it->second.base + off, bytes.data(), n);
+                                it->second.add_extent(off, off + n);
+                            } else if (!tbl->is_retired(tag)) {
+                                std::vector<uint8_t> qf(8 + n);
+                                memcpy(qf.data(), &off, 8);
+                                if (n > 0)
+                                    memcpy(qf.data() + 8, bytes.data(), n);
+                                tbl->queues_[tag].push_back(std::move(qf));
+                            }
+                        }
+                        tbl->signal_tag(tag);
+                    });
+                continue;
+            }
             {
                 // re-check: a sink may have been registered while we were in
                 // recv_all above — queueing now would strand the bytes where

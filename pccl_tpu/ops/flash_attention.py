@@ -394,6 +394,18 @@ def _flash_diff_bwd(causal, block_q, block_k, interpret, res, g):
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+def default_blocks(T: int, Dh: int) -> tuple:
+    """Measured-on-chip default tile sizes (v5e, bf16, fwd+bwd sweep at
+    T=8192..32768). With K/V streamed per q block, refetch traffic scales
+    1/block_q — arithmetic intensity of the refetch is ~block_q flops/byte
+    vs the v5e ridge of ~240 — so blocks must be LARGE: (1024, 1024) for
+    Dh=64 (17.6 vs 23.0 ms at the round-4 (256, 512)), (2048, 1024) for
+    Dh=128 (10.7 vs 18.5 ms). bk=2048 or bq=4096 trip the VMEM ceiling
+    (fp32 [bq, bk] score tiles)."""
+    bq = 2048 if Dh >= 128 else 1024
+    return snap_block(bq, T), snap_block(1024, T)
+
+
 def snap_block(b: int, T: int) -> int:
     """Snap a block size DOWN to a divisor of T so mid-size T (1280,
     2560, ...) stays on the kernel instead of silently falling back to the
@@ -478,8 +490,8 @@ def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
-                    block_k: int = 512, interpret: bool = False):
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 0,
+                    block_k: int = 0, interpret: bool = False):
     """Fused causal attention. q: [B, T, H, Dh], k/v: [B, T, Hkv, Dh]
     (Hkv == H for MHA, Hkv dividing H for GQA) → [B, T, H, Dh].
 
@@ -493,7 +505,9 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
         raise ValueError(f"GQA requires n_kv_head to divide n_head; got "
                          f"H={H}, Hkv={k.shape[2]}")
     on_tpu = jax.default_backend() == "tpu"
-    block_q, block_k = snap_block(block_q, T), snap_block(block_k, T)
+    dbq, dbk = default_blocks(T, Dh)
+    block_q = snap_block(block_q, T) if block_q else dbq
+    block_k = snap_block(block_k, T) if block_k else dbk
     if not (on_tpu or interpret) or T % block_q or T % block_k:
         return reference_attention(q, k, v, causal=causal)
     return _flash_diff(q, k, v, causal, block_q, block_k, interpret)

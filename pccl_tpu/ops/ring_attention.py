@@ -36,11 +36,11 @@ def _shard_attn_with_lse(q, k, v, blk_causal: bool):
     """Per-shard attention returning (out, lse [B, H, Tl]) — the fused
     pallas kernels on TPU (forward AND backward; no [Tl, Tl] tensor),
     the jnp twin elsewhere. Blocks snapped to divisors of Tl."""
-    from .flash_attention import (dense_attention_with_lse,
-                                  flash_attention_with_lse, snap_block)
+    from .flash_attention import (default_blocks, dense_attention_with_lse,
+                                  flash_attention_with_lse)
 
     Tl = q.shape[1]
-    bq, bk = snap_block(256, Tl), snap_block(512, Tl)
+    bq, bk = default_blocks(Tl, q.shape[-1])
     if jax.default_backend() == "tpu" and Tl % bq == 0 and Tl % bk == 0:
         return flash_attention_with_lse(q, k, v, blk_causal, bq, bk, False)
     return dense_attention_with_lse(q, k, v, blk_causal)
