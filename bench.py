@@ -212,7 +212,8 @@ def main() -> None:
             # the way through the kernels.
             for key, fam, seq in (("tpu_longctx", "gpt", 8192),
                                   ("tpu_longctx16k", "gpt", 16384),
-                                  ("tpu_longctx_llama", "llama", 8192)):
+                                  ("tpu_longctx_llama", "llama", 8192),
+                                  ("tpu_longctx16k_llama", "llama", 16384)):
                 try:
                     p = subprocess.run(
                         [sys.executable, "-m",
@@ -228,6 +229,20 @@ def main() -> None:
                     print(f"bench: {key} failed ({type(e).__name__}: {e})",
                           file=sys.stderr)
                     extra[f"{key}_tokens_s"] = None
+            # clean-sync invariant: the on-device shared-state digest
+            # (hash type 2) stays flat across state sizes while the
+            # staging path scales with the tunnel's D2H rate
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-m", "pccl_tpu.benchmarks.hash_bench"],
+                    capture_output=True, text=True, timeout=600, check=True)
+                for k, v in json.loads(
+                        p.stdout.strip().splitlines()[-1]).items():
+                    extra[f"tpu_{k}"] = v
+            except Exception as e:  # noqa: BLE001
+                print(f"bench: hash bench failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+                extra["tpu_devhash_256mb_s"] = None
             # headline aliases point at the flagship (gpt) leg
             extra["tpu_train_tokens_s"] = extra.get("tpu_train_tokens_s_gpt")
             extra["tpu_mfu"] = extra.get("tpu_mfu_gpt")

@@ -89,18 +89,19 @@ def flops_per_token(cfg, seq: int) -> float:
     return 6.0 * matmul_params + 12.0 * L * seq * d
 
 
-def _named_config(family: str, preset: str, seq: int):
+def _named_config(family: str, preset: str, seq: int, **overrides):
     from ..models import gpt, llama
 
     mod = llama if family == "llama" else gpt
-    return mod.named_config(preset, block_size=seq)
+    return mod.named_config(preset, block_size=seq, **overrides)
 
 
 def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
                         batch: int | None = None, seq: int | None = None,
                         steps_per_window: int = 8, windows: int = 5,
                         use_flash: bool = False,
-                        remat: "bool | str | None" = None) -> Dict[str, Any]:
+                        remat: "bool | str | None" = None,
+                        **cfg_overrides) -> Dict[str, Any]:
     """Measure the jitted train step on the first TPU device.
 
     Returns {config, tokens_s (median), tokens_s_min/max, step_s, mfu,
@@ -125,7 +126,7 @@ def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
         shape["remat"] = remat
     B, T = shape["batch"], shape["seq"]
     do_remat = shape.get("remat", False)
-    cfg = _named_config(family, shape["preset"], T)
+    cfg = _named_config(family, shape["preset"], T, **cfg_overrides)
 
     from jax.sharding import Mesh
     from ..parallel import train as train_lib
@@ -201,8 +202,9 @@ if __name__ == "__main__":
             kw[k] = v
         elif k == "remat":
             kw[k] = v if v == "dots" else bool(int(v))
-        elif k == "use_flash":
+        elif k in ("use_flash", "untie_head"):
             kw[k] = bool(int(v))
         else:
-            kw[k] = int(v)
+            kw[k] = int(v)  # batch/seq/windows + int config overrides
+                            # (n_head, n_embd, ... — ablation legs)
     print(json.dumps(run_tpu_train_bench(fam, **kw)))

@@ -78,6 +78,9 @@ class ReduceInfo(ctypes.Structure):
     ]
 
 
+MaterializeFn = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
 class TensorInfoC(ctypes.Structure):
     _fields_ = [
         ("name", ctypes.c_char_p),
@@ -86,6 +89,13 @@ class TensorInfoC(ctypes.Structure):
         ("dtype", ctypes.c_int),
         ("device", ctypes.c_int),
         ("allow_content_inequality", ctypes.c_int),
+        # accelerator-resident entries (pcclt.h round 5): on-device hash +
+        # lazy host staging + received-content flag
+        ("precomputed_hash", ctypes.c_uint64),
+        ("has_precomputed_hash", ctypes.c_int),
+        ("materialize", MaterializeFn),
+        ("materialize_ctx", ctypes.c_void_p),
+        ("updated", ctypes.c_int),
     ]
 
 

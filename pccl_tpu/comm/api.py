@@ -254,6 +254,11 @@ class TensorInfo:
     device: DeviceType = DeviceType.HOST
     allow_content_inequality: bool = False
     _source: Any = field(default=None, repr=False)  # torch tensor / jax array
+    # device-hash path (from_jax_device): hash computed on the accelerator,
+    # host staging deferred until the native core actually serves the bytes
+    _precomputed_hash: Any = field(default=None, repr=False)
+    _materialize_cb: Any = field(default=None, repr=False)  # keepalive
+    _updated: bool = field(default=False, repr=False)
 
     @staticmethod
     def from_numpy(name: str, arr: np.ndarray,
@@ -289,10 +294,70 @@ class TensorInfo:
         ti._source = arr
         return ti
 
+    @staticmethod
+    def from_jax_device(name: str, arr,
+                        allow_content_inequality: bool = False
+                        ) -> "TensorInfo":
+        """TPU-resident entry whose content hash is computed ON DEVICE
+        (ops.hashing.jax_simplehash_device — 8 bytes cross to the host);
+        the array is staged to the host ONLY if the sync actually needs
+        the bytes (this peer is elected distributor, via the native
+        materialize callback, or the entry arrives outdated). A clean
+        sync of N gigabytes therefore moves 8 bytes instead of N — the
+        invariant the reference preserves by hashing CUDA buffers on-GPU
+        (/root/reference/ccoip/src/cuda/simplehash_cuda.cu).
+
+        Requires PCCLT_SS_HASH=simple-tpu group-wide (the one hash type a
+        TPU can compute over resident bytes); raises otherwise so a
+        mismatched configuration fails loudly instead of looping forever
+        on phantom hash drift. After sync, read the authoritative value
+        with .jax_value() (device content unless the sync updated it)."""
+        import os
+
+        from ..ops.hashing import jax_simplehash_device
+
+        if os.environ.get("PCCLT_SS_HASH") != "simple-tpu":
+            raise RuntimeError(
+                "TensorInfo.from_jax_device needs PCCLT_SS_HASH=simple-tpu "
+                "(every peer of the group must hash with the TPU-computable "
+                "type); set the env var or use from_jax for staged syncs")
+        host = np.empty(arr.shape, arr.dtype)   # unmaterialized until needed
+        ti = TensorInfo(name, host, _np_dtype_of(host), DeviceType.TPU,
+                        allow_content_inequality)
+        ti._source = arr
+        lazy = True
+        if not allow_content_inequality:
+            try:
+                ti._precomputed_hash = jax_simplehash_device(arr)
+            except ValueError:
+                # 8-byte dtypes have no device word stream (TPUs run 32-bit
+                # ints); fall back to eager staging + the host twin of the
+                # SAME hash type, so the group-wide digest still agrees
+                from ..ops.hashing import simplehash_tpu
+
+                np.copyto(host, np.asarray(arr))
+                ti._precomputed_hash = simplehash_tpu(host)
+                lazy = False
+
+        if lazy:
+            def _materialize(_ctx):
+                # called from a native serving thread (ctypes re-acquires
+                # the GIL); one staging D2H, exactly once per sync window
+                np.copyto(host, np.asarray(ti._source))
+
+            ti._materialize_cb = _native.MaterializeFn(_materialize)
+        return ti
+
     def jax_value(self):
-        """Device array with the current (synced) host content."""
+        """Device array with the current authoritative content: the synced
+        host bytes when the sync wrote any (or for staged entries, which
+        always hold current content), else the untouched device array."""
         import jax
 
+        if self._materialize_cb is not None and not self._updated:
+            # lazy entry the sync never wrote to: the host buffer may be
+            # unmaterialized garbage — the device array is authoritative
+            return self._source
         if self._source is not None and hasattr(self._source, "sharding"):
             return jax.device_put(self.data, self._source.sharding)
         return jax.device_put(self.data)
@@ -300,6 +365,9 @@ class TensorInfo:
     def _as_c(self, keepalive: list) -> _native.TensorInfoC:
         name_b = self.name.encode()
         keepalive.append(name_b)
+        has_h = self._precomputed_hash is not None
+        if self._materialize_cb is not None:
+            keepalive.append(self._materialize_cb)
         return _native.TensorInfoC(
             name=name_b,
             data=self.data.ctypes.data_as(ctypes.c_void_p),
@@ -307,6 +375,12 @@ class TensorInfo:
             dtype=int(self.dtype),
             device=int(self.device),
             allow_content_inequality=1 if self.allow_content_inequality else 0,
+            precomputed_hash=self._precomputed_hash if has_h else 0,
+            has_precomputed_hash=1 if has_h else 0,
+            materialize=self._materialize_cb if self._materialize_cb
+            else _native.MaterializeFn(),
+            materialize_ctx=None,
+            updated=0,
         )
 
 
@@ -664,4 +738,9 @@ class Communicator:
         code = self._lib.pccltSynchronizeSharedState(
             self._h, ctypes.byref(st), int(strategy), ctypes.byref(out))
         _check(code, "sync_shared_state")
+        for i, ti in enumerate(state.infos):
+            # per-entry received-content flag (device-hash entries use it
+            # to decide between the untouched device array and the synced
+            # host bytes in jax_value)
+            ti._updated = bool(infos[i].updated)
         return SharedStateSyncInfo(out.tx_bytes, out.rx_bytes, out.revision)

@@ -43,6 +43,11 @@ class GPTConfig:
     dropout: float = 0.0  # dropout is a no-op under jit benchmarking; kept for parity
     rope_theta: float = 10000.0
     compute_dtype: Any = jnp.bfloat16
+    # untie the unembedding from tok_emb (GPT-2 ties them; the untied
+    # variant exists to ABLATE the tied head's backward — tok_emb's grad
+    # is then a pure embedding scatter instead of scatter + dense matmul
+    # grad fused into one accumulation). See docs/08_performance.md.
+    untie_head: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -71,6 +76,8 @@ def init_params(key: jax.Array, cfg: GPTConfig) -> Dict[str, jax.Array]:
         "mlp_out": _init_linear(ks[4], 4 * d, (L, 4 * d, d)) * scale_res,  # row parallel
         "lnf_g": jnp.ones((d,), jnp.float32),
     }
+    if cfg.untie_head:
+        params["head"] = _init_linear(ks[5], d, (d, cfg.vocab_size))
     return params
 
 
@@ -148,11 +155,15 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
 
     x, _ = lax.scan(body, x, layers)
     x = _rmsnorm(x, params["lnf_g"])
-    # weight-tied head: bf16 operands on the MXU, fp32 accumulation — the
-    # vocab matmul is a large share of the model's FLOPs and fp32 operands
-    # would run it off the fast systolic path
-    logits = jnp.matmul(x, params["tok_emb"].T.astype(x.dtype),
-                        preferred_element_type=jnp.float32)
+    # weight-tied head (default): bf16 operands on the MXU, fp32
+    # accumulation — the vocab matmul is a large share of the model's
+    # FLOPs and fp32 operands would run it off the fast systolic path
+    if cfg.untie_head:
+        logits = jnp.matmul(x, params["head"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+    else:
+        logits = jnp.matmul(x, params["tok_emb"].T.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
     return logits
 
 

@@ -114,6 +114,19 @@ typedef struct pccltTensorInfo_t {
     pccltDataType_t dtype;
     pccltDeviceType_t device;
     int allow_content_inequality;
+    /* Accelerator-resident entries (optional; zero-init for host state):
+     * with has_precomputed_hash set, `precomputed_hash` (computed on the
+     * device, type matching PCCLT_SS_HASH — use hash_type 2 on TPUs) is
+     * used at request time and `data` may be unmaterialized; `materialize`
+     * (with `materialize_ctx`) is then called at most once, from a native
+     * thread, before this entry's bytes are first served to an outdated
+     * peer. `updated` is written back nonzero iff the sync overwrote
+     * `data` (push it back to the device). */
+    uint64_t precomputed_hash;
+    int has_precomputed_hash;
+    void (*materialize)(void *ctx);
+    void *materialize_ctx;
+    int updated;
 } pccltTensorInfo_t;
 
 typedef struct pccltSharedState_t {
@@ -191,7 +204,9 @@ PCCLT_EXPORT pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c,
                                                        pccltSharedStateSyncInfo_t *info);
 
 /* Content hash used for shared-state drift detection (reference
- * ccoip_hash_type_t). hash_type: 0 = simplehash (default), 1 = CRC32.
+ * ccoip_hash_type_t). hash_type: 0 = simplehash (default), 1 = CRC32,
+ * 2 = simplehash-tpu (u32-only lane/fold hash an accelerator can compute
+ * over device-resident bytes; see pcclt::hash::simplehash_tpu).
  * Exposed so bindings/tools can verify bit parity with the Python twin. */
 PCCLT_EXPORT uint64_t pccltHashBuffer(int hash_type, const void *data,
                                       uint64_t nbytes);

@@ -305,7 +305,9 @@ pccltResult_t pccltAllReduceMultipleWithRetry(pccltComm_t *c, const void *const 
 }
 
 uint64_t pccltHashBuffer(int hash_type, const void *data, uint64_t nbytes) {
-    auto t = hash_type == 1 ? pcclt::hash::Type::kCrc32 : pcclt::hash::Type::kSimple;
+    auto t = hash_type == 1   ? pcclt::hash::Type::kCrc32
+             : hash_type == 2 ? pcclt::hash::Type::kSimpleTpu
+                              : pcclt::hash::Type::kSimple;
     return pcclt::hash::content_hash(t, data, nbytes);
 }
 
@@ -328,7 +330,7 @@ pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *st
     if (!c || !state || (state->count && !state->infos)) return pccltInvalidArgument;
     std::vector<pcclt::client::SharedStateEntry> entries;
     for (uint64_t i = 0; i < state->count; ++i) {
-        const auto &ti = state->infos[i];
+        auto &ti = state->infos[i];
         if (!ti.name || !ti.data) return pccltInvalidArgument;
         pcclt::client::SharedStateEntry e;
         e.name = ti.name;
@@ -336,6 +338,12 @@ pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *st
         e.count = ti.count;
         e.data = ti.data;
         e.allow_content_inequality = ti.allow_content_inequality != 0;
+        e.precomputed_hash = ti.precomputed_hash;
+        e.has_precomputed_hash = ti.has_precomputed_hash != 0;
+        e.materialize = ti.materialize;
+        e.materialize_ctx = ti.materialize_ctx;
+        ti.updated = 0;
+        e.updated = &ti.updated;
         entries.push_back(std::move(e));
     }
     pcclt::client::SyncInfo si;

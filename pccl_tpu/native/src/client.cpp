@@ -151,6 +151,11 @@ void Client::on_ss_accept(net::Socket sock) {
         if (!net::send_frame(sock, mu, PacketType::kS2CStateHeader, w.data())) return;
         if (!ok) return;
         for (const auto &e : entries) {
+            // lazily-staged accelerator entries materialize exactly once
+            // per window, before their first byte is served; concurrent
+            // fetchers block on the once-flag until the bytes are real
+            if (e.materialize && e.mat_once)
+                std::call_once(*e.mat_once, e.materialize, e.materialize_ctx);
             size_t nbytes = e.count * proto::dtype_size(e.dtype);
             // count BEFORE sending: the requester can complete its fetch and
             // the whole dist-done handshake the instant the last byte lands,
@@ -858,7 +863,11 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
         dist_open_ = true;
         dist_revision_ = revision;
         dist_entries_.clear();
-        for (const auto &e : entries) dist_entries_[e.name] = e;
+        for (const auto &e : entries) {
+            auto &d = dist_entries_[e.name] = e;
+            if (d.materialize)   // fresh once-flag per sync window
+                d.mat_once = std::make_shared<std::once_flag>();
+        }
         dist_tx_bytes_ = 0;
     }
     auto close_window = [this] {
@@ -879,10 +888,16 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
         m.dtype = e.dtype;
         m.count = e.count;
         m.allow_content_inequality = e.allow_content_inequality ? 1 : 0;
-        m.hash = e.allow_content_inequality
-                     ? 0
-                     : hash::content_hash(hash_type, e.data,
-                                          e.count * proto::dtype_size(e.dtype));
+        // precomputed (on-device) hashes take precedence: the caller's
+        // accelerator digested its resident bytes and shipped 8 bytes to
+        // host, so a clean sync never stages the array (the type must
+        // match PCCLT_SS_HASH group-wide — kSimpleTpu is the one a TPU
+        // can compute, ops/hashing.py:jax_simplehash_device)
+        m.hash = e.allow_content_inequality ? 0
+                 : e.has_precomputed_hash   ? e.precomputed_hash
+                                            : hash::content_hash(
+                                       hash_type, e.data,
+                                       e.count * proto::dtype_size(e.dtype));
         req.entries.push_back(std::move(m));
     }
     if (!master_.send(PacketType::kC2MSharedStateSync, req.encode())) {
@@ -965,6 +980,10 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
                                     break;
                                 }
                                 rx_bytes += nbytes;
+                                // the host buffer now holds authoritative
+                                // content; the caller must push it back to
+                                // the device (TPU entries)
+                                if (target->updated) *target->updated = 1;
                                 // verify against the mask's expected hash
                                 for (size_t k = 0; k < resp->outdated_keys.size(); ++k) {
                                     if (resp->outdated_keys[k] != name) continue;

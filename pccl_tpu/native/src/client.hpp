@@ -74,6 +74,23 @@ struct SharedStateEntry {
     uint64_t count = 0;
     void *data = nullptr;
     bool allow_content_inequality = false;
+    // Accelerator-resident state (the reference's on-GPU hashing,
+    // simplehash_cuda.cu, re-designed for the host/device split here):
+    // when has_precomputed_hash is set, the request-time content hash is
+    // taken from precomputed_hash (computed on-device by the caller; its
+    // type must match PCCLT_SS_HASH) and `data` may be UNMATERIALIZED —
+    // `materialize` is then invoked (once per sync window, any serving
+    // thread, before the first byte of this entry is served) to fill
+    // `data` from the device. Receives always land in `data`; *updated is
+    // set when they do, so the caller knows to push the bytes back.
+    uint64_t precomputed_hash = 0;
+    bool has_precomputed_hash = false;
+    void (*materialize)(void *ctx) = nullptr;
+    void *materialize_ctx = nullptr;
+    int *updated = nullptr;
+    // per-sync-window once flag for materialize (shared by every snapshot
+    // of this entry; created when the distribution window opens)
+    std::shared_ptr<std::once_flag> mat_once;
 };
 
 struct SyncInfo {

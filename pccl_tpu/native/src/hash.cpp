@@ -1,6 +1,7 @@
 #include "hash.hpp"
 
 #include <array>
+#include <vector>
 #include <bit>
 #include <cstdlib>
 #include <cstring>
@@ -77,9 +78,76 @@ struct Crc32Tables {
 
 } // namespace
 
+uint64_t simplehash_tpu(const void *data, size_t nbytes) {
+    const auto *bytes = static_cast<const uint8_t *>(data);
+    const size_t nwords = (nbytes + 3) / 4;
+    const size_t full_rows = nwords / kTpuLanes;
+    const size_t tail = nwords - full_rows * kTpuLanes;
+
+    auto le_word = [](uint32_t w) {
+        if constexpr (std::endian::native == std::endian::big)
+            w = __builtin_bswap32(w);
+        return w;
+    };
+    std::vector<uint32_t> la(kTpuLanes, kTpuSA), lb(kTpuLanes, kTpuSB);
+    auto word_at = [&](size_t i) {
+        uint32_t w = 0;
+        size_t b = i * 4;
+        memcpy(&w, bytes + b, b + 4 <= nbytes ? 4 : nbytes - b);
+        return le_word(w);
+    };
+    for (size_t r = 0; r < full_rows; ++r) {
+        const size_t base = r * kTpuLanes;
+        // tail-safe: every word of a full row is 4 in-bounds bytes
+        for (size_t l = 0; l < kTpuLanes; ++l) {
+            uint32_t w;
+            memcpy(&w, bytes + (base + l) * 4, 4);
+            w = le_word(w);
+            la[l] = la[l] * kTpuPA + w;
+            lb[l] = lb[l] * kTpuPB + w;
+        }
+    }
+    if (tail) {
+        // the definition pads the last row to a FULL row of the lane grid
+        // (the jax twin reshapes to [rows, 65536]); lanes >= tail fold a
+        // zero word, i.e. just advance their Horner chains
+        const size_t base = full_rows * kTpuLanes;
+        for (size_t l = 0; l < tail; ++l) {
+            uint32_t w = word_at(base + l);
+            la[l] = la[l] * kTpuPA + w;
+            lb[l] = lb[l] * kTpuPB + w;
+        }
+        for (size_t l = tail; l < kTpuLanes; ++l) {
+            la[l] = la[l] * kTpuPA;
+            lb[l] = lb[l] * kTpuPB;
+        }
+    }
+    // murmur3-step fold: the combiner must be non-linear with rotations —
+    // a linear fold of IDENTICAL halves (uniform content, e.g. zero-init
+    // params) cancels structurally and made every constant array hash the
+    // same (see ops/hashing.py:_mix2 for the derivation)
+    auto rotl = [](uint32_t x, int r) {
+        return (x << r) | (x >> (32 - r));
+    };
+    auto mix2 = [&](uint32_t h, uint32_t k) {
+        k = rotl(k * 0xCC9E2D51u, 15) * 0x1B873593u;
+        return rotl(h ^ k, 13) * 5u + 0xE6546B64u;
+    };
+    for (size_t half = kTpuLanes / 2; half >= 1; half /= 2) {
+        for (size_t l = 0; l < half; ++l) {
+            la[l] = mix2(la[l], la[l + half]);
+            lb[l] = mix2(lb[l], lb[l + half]);
+        }
+        if (half == 1) break;
+    }
+    uint64_t d = (static_cast<uint64_t>(la[0]) << 32) | lb[0];
+    return avalanche64(d ^ (static_cast<uint64_t>(nbytes) * kQ));
+}
+
 uint64_t content_hash(Type t, const void *data, size_t nbytes) {
     switch (t) {
     case Type::kCrc32: return crc32(data, nbytes);
+    case Type::kSimpleTpu: return simplehash_tpu(data, nbytes);
     case Type::kSimple: break;
     }
     return simplehash(data, nbytes);
@@ -89,8 +157,10 @@ Type type_from_env() {
     const char *v = std::getenv("PCCLT_SS_HASH");
     if (!v || std::string_view(v) == "simple") return Type::kSimple;
     if (std::string_view(v) == "crc32") return Type::kCrc32;
+    if (std::string_view(v) == "simple-tpu") return Type::kSimpleTpu;
     PLOG(kWarn) << "unknown PCCLT_SS_HASH value \"" << v
-                << "\" (expected \"simple\" or \"crc32\"); using simplehash";
+                << "\" (expected \"simple\", \"crc32\" or \"simple-tpu\"); "
+                   "using simplehash";
     return Type::kSimple;
 }
 

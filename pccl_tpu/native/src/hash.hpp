@@ -28,15 +28,37 @@ inline constexpr uint64_t kSeed = 0xCBF29CE484222325ull;   // FNV offset basis
 
 uint64_t simplehash(const void *data, size_t nbytes);
 
+// TPU-native hash (Type::kSimpleTpu): the digest a TPU can compute over
+// HBM-RESIDENT bytes with pure u32 arithmetic (no u64 on the VPU), so a
+// clean shared-state sync ships 8 bytes over the wire instead of staging
+// the whole array to host (the reference hashes CUDA buffers on-GPU for
+// exactly this reason: /root/reference/ccoip/src/cuda/simplehash_cuda.cu,
+// dispatched at ccoip_client_handler.cpp:383-416). Definition: LE u32
+// words, word i -> (row i / 65536, lane i % 65536); each of the 65536
+// lanes runs two parallel u32 Horner chains (planes A/B with distinct
+// primes/seeds) over its padded column; lanes combine by 16 levels of
+// pairwise murmur3-step folding (non-linear rotate-multiply — a linear
+// fold cancels on uniform content); the two u32 plane digests
+// concatenate to 64 bits, mix with the byte length, and avalanche. The
+// lane/fold structure is embarrassingly parallel on the VPU (the jax twin
+// is a baked weighted-sum + fold, ops/hashing.py) and this CPU twin is
+// bit-identical.
+inline constexpr size_t kTpuLanes = 65536;
+inline constexpr uint32_t kTpuPA = 0x01000193u;  // FNV-1a 32 prime
+inline constexpr uint32_t kTpuSA = 0x811C9DC5u;  // FNV-1a 32 offset
+inline constexpr uint32_t kTpuPB = 0x85EBCA6Bu;  // murmur3 fmix c1
+inline constexpr uint32_t kTpuSB = 0x9E3779B9u;  // 2^32 / phi
+uint64_t simplehash_tpu(const void *data, size_t nbytes);
+
 // CRC32 (IEEE 802.3, reflected, poly 0xEDB88320) — matches zlib.crc32.
 uint32_t crc32(const void *data, size_t nbytes, uint32_t crc = 0);
 
 // Selectable shared-state hash (reference ccoip_hash_type_t,
 // ccoip_types.hpp:27-30 — the reference also defaults to simplehash).
 // All peers of a group must agree on the type; it is configured via the
-// PCCLT_SS_HASH env var ("simple" | "crc32"), mirroring the reference where
-// the choice is internal rather than per-call.
-enum class Type : uint8_t { kSimple = 0, kCrc32 = 1 };
+// PCCLT_SS_HASH env var ("simple" | "crc32" | "simple-tpu"), mirroring the
+// reference where the choice is internal rather than per-call.
+enum class Type : uint8_t { kSimple = 0, kCrc32 = 1, kSimpleTpu = 2 };
 uint64_t content_hash(Type t, const void *data, size_t nbytes);
 Type type_from_env();
 

@@ -1715,10 +1715,10 @@ void MultiplexConn::rx_loop() {
             scratch.resize(n);
             if (n > 0 && !sock_.recv_all(scratch.data(), n)) break;
             if (DeliveryDelay::inst().enabled()) {
-                // copy the payload onto the delay line; the closure re-runs
-                // the sink-or-queue logic at visibility time
-                std::vector<uint8_t> bytes(scratch.begin(),
-                                           scratch.begin() + n);
+                // move the payload onto the delay line (scratch is resized
+                // fresh next iteration); the closure re-runs the
+                // sink-or-queue logic at visibility time
+                std::vector<uint8_t> bytes(std::move(scratch));
                 DeliveryDelay::inst().deliver(
                     [tbl = table_, tag, off, bytes = std::move(bytes)] {
                         {

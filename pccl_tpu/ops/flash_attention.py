@@ -401,8 +401,10 @@ def default_blocks(T: int, Dh: int) -> tuple:
     vs the v5e ridge of ~240 — so blocks must be LARGE: (1024, 1024) for
     Dh=64 (17.6 vs 23.0 ms at the round-4 (256, 512)), (2048, 1024) for
     Dh=128 (10.7 vs 18.5 ms). bk=2048 or bq=4096 trip the VMEM ceiling
-    (fp32 [bq, bk] score tiles)."""
-    bq = 2048 if Dh >= 128 else 1024
+    (fp32 [bq, bk] score tiles), and so does bq=2048 at Dh=128 once the
+    kernel sits under a remat'd scan (T=16384 train: scoped-vmem over by
+    420K from the remat stack) — hence the T>8192 cap."""
+    bq = 2048 if (Dh >= 128 and T <= 8192) else 1024
     return snap_block(bq, T), snap_block(1024, T)
 
 

@@ -63,15 +63,21 @@ GPT_PARAM_SPECS: Dict[str, P] = {
     "lnf_g": P(None),
 }
 
+# present only when GPTConfig.untie_head (the tied-head ablation)
+_GPT_HEAD_SPEC = P(None, "tp")  # vocab-parallel, like llama's
+
 
 def _drop_missing_axes(spec: P, mesh: Mesh) -> P:
     """Replace axis names absent from `mesh` with None (replicated)."""
     return P(*[a if (a in mesh.shape) else None for a in spec])
 
 
-def gpt_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+def gpt_param_sharding(mesh: Mesh, cfg=None) -> Dict[str, NamedSharding]:
+    specs = dict(GPT_PARAM_SPECS)
+    if cfg is not None and getattr(cfg, "untie_head", False):
+        specs["head"] = _GPT_HEAD_SPEC
     return {k: NamedSharding(mesh, _drop_missing_axes(spec, mesh))
-            for k, spec in GPT_PARAM_SPECS.items()}
+            for k, spec in specs.items()}
 
 
 # --- Llama sharding rules (pccl_tpu.models.llama.init_params layout) ---
@@ -93,7 +99,7 @@ LLAMA_PARAM_SPECS: Dict[str, P] = {
 }
 
 
-def llama_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+def llama_param_sharding(mesh: Mesh, cfg=None) -> Dict[str, NamedSharding]:
     return {k: NamedSharding(mesh, _drop_missing_axes(spec, mesh))
             for k, spec in LLAMA_PARAM_SPECS.items()}
 

@@ -36,7 +36,7 @@ def family(cfg):
 def make_train_state(key, cfg, mesh, lr: float = 3e-4):
     """Init params + AdamW optimizer state, placed with TP/DP shardings."""
     model, sharding_fn = family(cfg)
-    param_sharding = sharding_fn(mesh)
+    param_sharding = sharding_fn(mesh, cfg)
     init = jax.jit(model.init_params, static_argnames=("cfg",),
                    out_shardings=param_sharding)
     params = init(key, cfg)
@@ -56,7 +56,7 @@ def build_train_step(cfg, tx, mesh, attn_fn=None,
     16 GB chip; "dots" saves weight-matmul outputs and recomputes only the
     rest (less recompute, more memory than True)."""
     model, sharding_fn = family(cfg)
-    param_sharding = sharding_fn(mesh)
+    param_sharding = sharding_fn(mesh, cfg)
     data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)
 
     def step(params, opt_state, tokens, targets):
