@@ -29,6 +29,9 @@ def add_comm_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--min-world", type=int, default=1,
                     help="wait until this many peers joined before training")
     ap.add_argument("--peer-group", type=int, default=0)
+    ap.add_argument("--connect-timeout", type=float, default=120.0,
+                    help="seconds to wait for --min-world peers (raise when "
+                         "many peers cold-start jax on a loaded host)")
     ap.add_argument("--solo", action="store_true",
                     help="run without a comm (single slice, no master)")
 
@@ -45,7 +48,7 @@ def connect(args):
                         p2p_port=args.base_port, ss_port=args.base_port + 4,
                         bench_port=args.base_port + 8)
     comm.connect()
-    deadline = time.time() + 120
+    deadline = time.time() + getattr(args, "connect_timeout", 120.0)
     while comm.world_size < args.min_world:
         if time.time() > deadline:
             raise TimeoutError(f"world never reached {args.min_world}")
