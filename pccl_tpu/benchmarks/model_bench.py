@@ -101,6 +101,7 @@ def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
                         steps_per_window: int = 8, windows: int = 5,
                         use_flash: bool = False,
                         remat: "bool | str | None" = None,
+                        repeat_kv: bool = False,
                         **cfg_overrides) -> Dict[str, Any]:
     """Measure the jitted train step on the first TPU device.
 
@@ -134,6 +135,16 @@ def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
 
     mesh = Mesh(np.array(tpus[:1]).reshape(1, 1), ("dp", "tp"))
     attn_fn = flash_attention if use_flash else None
+    if repeat_kv and use_flash:
+        # A/B ablation: the round-4 degraded path — materialize K/V at the
+        # full head count in HBM before the kernel, forfeiting GQA's
+        # KV-bytes shrink. Measures what the GQA-native kernels buy.
+        def attn_fn(q, k, v):  # noqa: F811 — deliberate override
+            H, Hkv = q.shape[2], k.shape[2]
+            if Hkv != H:
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
+            return flash_attention(q, k, v)
     with mesh:
         params, tx, opt_state = train_lib.make_train_state(
             jax.random.PRNGKey(0), cfg, mesh)
@@ -202,7 +213,7 @@ if __name__ == "__main__":
             kw[k] = v
         elif k == "remat":
             kw[k] = v if v == "dots" else bool(int(v))
-        elif k in ("use_flash", "untie_head"):
+        elif k in ("use_flash", "untie_head", "repeat_kv"):
             kw[k] = bool(int(v))
         else:
             kw[k] = int(v)  # batch/seq/windows + int config overrides

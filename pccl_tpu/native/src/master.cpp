@@ -28,7 +28,11 @@ bool Master::launch() {
         std::lock_guard lk(conns_mu_);
         uint64_t id = next_conn_id_++;
         auto conn = std::make_shared<Conn>();
-        conn->src_ip = sock.peer_addr();  // family-tagged; port is the ephemeral src port, unused
+        conn->src_ip = sock.peer_addr();
+        // family-tagged observed address; zero the ephemeral source port so
+        // Addr equality (which compares ports) can't silently mismatch this
+        // against advertised addresses, which store port 0
+        conn->src_ip.port = 0;
         conn->sock = std::move(sock);
         conn->sock.set_keepalive();
         conns_[id] = conn;

@@ -63,7 +63,12 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool):
     Step 0 runs first and is always visible, so the accumulator lse is
     finite from the first fold and no −inf − −inf NaN can arise.
     ppermute rotates K/V between steps; XLA's latency-hiding scheduler
-    overlaps the rotation with the next step's kernel."""
+    overlaps the rotation with the next step's kernel.
+
+    Tradeoff of the unroll: HLO size and compile time grow linearly with
+    the sp axis size (×2 with the backward) — negligible at sp ≤ 8, worth
+    a scan over the uniform s > 0 steps (step 0 peeled) if sp worlds of
+    dozens of devices become a target."""
     B, Tl, H, Dh = q.shape
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
