@@ -222,6 +222,12 @@ def main() -> None:
                     ("tpu_longctx16k", "gpt", 16384, ()),
                     ("tpu_longctx_llama", "llama", 8192, ()),
                     ("tpu_longctx16k_llama", "llama", 16384, ()),
+                    # T=32768: enabled by the chunked CE (loss_chunk) —
+                    # the full [1, 32768, vocab] fp32 logits + cotangent
+                    # alone would blow the 15.75 GB chip
+                    ("tpu_longctx32k", "gpt", 32768, ("loss_chunk=2048",)),
+                    ("tpu_longctx32k_llama", "llama", 32768,
+                     ("loss_chunk=2048",)),
                     # the GQA A/B: same llama leg with K/V repeated to full
                     # head count in HBM before the kernel (the degraded
                     # round-4 path) — the GQA-native win is the ratio

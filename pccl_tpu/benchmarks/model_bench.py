@@ -102,6 +102,7 @@ def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
                         use_flash: bool = False,
                         remat: "bool | str | None" = None,
                         repeat_kv: bool = False,
+                        loss_chunk: int = 0,
                         **cfg_overrides) -> Dict[str, Any]:
     """Measure the jitted train step on the first TPU device.
 
@@ -149,7 +150,8 @@ def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
         params, tx, opt_state = train_lib.make_train_state(
             jax.random.PRNGKey(0), cfg, mesh)
         step = train_lib.build_train_step(cfg, tx, mesh, attn_fn=attn_fn,
-                                          remat=do_remat)
+                                          remat=do_remat,
+                                          loss_chunk=loss_chunk or None)
 
         rng = np.random.default_rng(0)
         tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)),
@@ -189,6 +191,8 @@ def run_tpu_train_bench(family: str = "gpt", preset: str | None = None,
                   f"{'flash' if use_flash else 'dense'}"
                   f"{'+remat' if do_remat is True else ''}"
                   f"{'+remat:' + do_remat if isinstance(do_remat, str) else ''}"
+                  f"{'+ce:' + str(loss_chunk) if loss_chunk else ''}"
+                  f"{'+repeatkv' if repeat_kv else ''}"
                   f" ({dev.device_kind})",
         "tokens_s": round(tok_s, 1),
         "tokens_s_min": round(min(rates), 1),

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def maybe_checkpoint(block_fn, remat):
@@ -35,3 +36,38 @@ def gather_ce_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     real HBM on a 16 GB chip)."""
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(jax.nn.logsumexp(logits, axis=-1) - tgt)
+
+
+def chunked_ce_loss(x: jax.Array, head_mat: jax.Array, targets: jax.Array,
+                    chunk: int) -> jax.Array:
+    """Mean next-token CE that never materializes the full [B, T, vocab]
+    logits: a scan over sequence chunks computes each chunk's logits inside
+    ``jax.checkpoint`` (the backward recomputes them), so peak logits
+    memory is [B, chunk, vocab]. At T=32768 / 32k vocab the full-logits
+    path holds a 4.2 GB fp32 tensor PLUS its cotangent — the single
+    largest resident of a long-context train step and the difference
+    between fitting a 16 GB chip and OOM; the chunked path holds ~260 MB
+    at chunk=2048. Cost: the head matmul runs once more in the backward
+    (+2·T·d·V FLOPs, ~1 % of a long-context step).
+
+    x: [B, T, d] final hidden states; head_mat: [d, vocab] (pass ``W.T``
+    lazily for tied heads — XLA folds the transpose into the matmul);
+    targets: int32 [B, T]. ``chunk`` must divide T."""
+    B, T, d = x.shape
+    n = T // chunk
+    assert n * chunk == T, f"loss chunk {chunk} must divide T={T}"
+    xs = jnp.moveaxis(x.reshape(B, n, chunk, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc):
+        logits = jnp.matmul(xc, head_mat.astype(xc.dtype),
+                            preferred_element_type=jnp.float32)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return jnp.sum(jax.nn.logsumexp(logits, axis=-1) - tgt)
+
+    def body(acc, ct):
+        return acc + chunk_nll(*ct), None
+
+    tot, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
+    return tot / (B * T)

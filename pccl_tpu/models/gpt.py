@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ._common import gather_ce_loss, maybe_checkpoint
+from ._common import chunked_ce_loss, gather_ce_loss, maybe_checkpoint
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,17 +132,10 @@ def _block(x: jax.Array, layer: Dict[str, jax.Array], cfg: GPTConfig,
 _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_qkv", "attn_out", "mlp_in", "mlp_out")
 
 
-def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
-            attn_fn=None, remat: "bool | str" = False) -> jax.Array:
-    """tokens: int32 [B, T] → logits float32 [B, T, vocab].
-
-    attn_fn: optional (q, k, v) -> out override for the attention op —
-    e.g. ops.flash_attention (fused single-chip kernel) or
-    ops.ring_attention.make_ring_attn_fn(mesh) (sequence parallelism).
-
-    remat: checkpoint each block — the backward recomputes the layer
-    forward instead of stashing per-layer activations, so HBM holds one
-    layer's activations at a time (how big batches fit a 16 GB chip)."""
+def hidden(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
+           attn_fn=None, remat: "bool | str" = False) -> jax.Array:
+    """tokens: int32 [B, T] → final-norm hidden states [B, T, d] (the
+    pre-head activations; forward() applies the vocab matmul)."""
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
 
     layers = {k: params[k] for k in _LAYER_KEYS}
@@ -154,23 +147,52 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
         return blk(h, layer), None
 
     x, _ = lax.scan(body, x, layers)
-    x = _rmsnorm(x, params["lnf_g"])
+    return _rmsnorm(x, params["lnf_g"])
+
+
+def _head_mat(params, cfg: GPTConfig) -> jax.Array:
+    """[d, vocab] unembedding. Weight-tied by default: the lazy .T folds
+    into the consuming matmul."""
+    return params["head"] if cfg.untie_head else params["tok_emb"].T
+
+
+def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
+            attn_fn=None, remat: "bool | str" = False) -> jax.Array:
+    """tokens: int32 [B, T] → logits float32 [B, T, vocab].
+
+    attn_fn: optional (q, k, v) -> out override for the attention op —
+    e.g. ops.flash_attention (fused single-chip kernel) or
+    ops.ring_attention.make_ring_attn_fn(mesh) (sequence parallelism).
+
+    remat: checkpoint each block — the backward recomputes the layer
+    forward instead of stashing per-layer activations, so HBM holds one
+    layer's activations at a time (how big batches fit a 16 GB chip)."""
+    x = hidden(params, tokens, cfg, attn_fn, remat)
     # weight-tied head (default): bf16 operands on the MXU, fp32
     # accumulation — the vocab matmul is a large share of the model's
     # FLOPs and fp32 operands would run it off the fast systolic path
-    if cfg.untie_head:
-        logits = jnp.matmul(x, params["head"].astype(x.dtype),
-                            preferred_element_type=jnp.float32)
-    else:
-        logits = jnp.matmul(x, params["tok_emb"].T.astype(x.dtype),
-                            preferred_element_type=jnp.float32)
-    return logits
+    return jnp.matmul(x, _head_mat(params, cfg).astype(x.dtype),
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, tokens, targets, cfg: GPTConfig, attn_fn=None,
-            remat: "bool | str" = False) -> jax.Array:
+            remat: "bool | str" = False,
+            loss_chunk: "int | None" = None) -> jax.Array:
     """Mean next-token cross-entropy (gather − logsumexp form; see
-    models/_common.py). targets: int32 [B, T]."""
+    models/_common.py). targets: int32 [B, T].
+
+    loss_chunk: compute the vocab matmul + CE in recompute-checkpointed
+    sequence chunks of this size (models/_common.py:chunked_ce_loss) —
+    the full [B, T, vocab] logits never exist, which is what fits
+    T ≥ 32768 on a 16 GB chip. Must divide T (a silent fall-back to the
+    full-logits path would resurface as an opaque multi-GB XLA OOM in
+    exactly the configs loss_chunk exists to rescue)."""
+    T = targets.shape[1]
+    if loss_chunk and T % loss_chunk:
+        raise ValueError(f"loss_chunk {loss_chunk} must divide T={T}")
+    if loss_chunk and T > loss_chunk:
+        x = hidden(params, tokens, cfg, attn_fn, remat)
+        return chunked_ce_loss(x, _head_mat(params, cfg), targets, loss_chunk)
     logits = forward(params, tokens, cfg, attn_fn, remat=remat)
     return gather_ce_loss(logits, targets)
 

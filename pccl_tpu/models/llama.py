@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ._common import gather_ce_loss, maybe_checkpoint
+from ._common import chunked_ce_loss, gather_ce_loss, maybe_checkpoint
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,11 +145,10 @@ _LAYER_KEYS = ("ln1_g", "ln2_g", "attn_q", "attn_kv", "attn_out",
                "mlp_gate", "mlp_up", "mlp_down")
 
 
-def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
-            attn_fn=None, remat: "bool | str" = False) -> jax.Array:
-    """tokens: int32 [B, T] → logits float32 [B, T, vocab].
-
-    remat: checkpoint each block (see models/gpt.py:forward)."""
+def hidden(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
+           attn_fn=None, remat: "bool | str" = False) -> jax.Array:
+    """tokens: int32 [B, T] → final-norm hidden states [B, T, d] (the
+    pre-head activations; forward() applies the vocab matmul)."""
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
     layers = {k: params[k] for k in _LAYER_KEYS}
 
@@ -160,14 +159,34 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
         return blk(h, layer), None
 
     x, _ = lax.scan(body, x, layers)
-    x = _rmsnorm(x, params["lnf_g"])
+    return _rmsnorm(x, params["lnf_g"])
+
+
+def forward(params: Dict[str, jax.Array], tokens: jax.Array, cfg: LlamaConfig,
+            attn_fn=None, remat: "bool | str" = False) -> jax.Array:
+    """tokens: int32 [B, T] → logits float32 [B, T, vocab].
+
+    remat: checkpoint each block (see models/gpt.py:forward)."""
+    x = hidden(params, tokens, cfg, attn_fn, remat)
     # untied head: bf16 operands on the MXU, fp32 accumulation (see gpt.py)
     return jnp.matmul(x, params["head"].astype(x.dtype),
                       preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, tokens, targets, cfg: LlamaConfig, attn_fn=None,
-            remat: "bool | str" = False) -> jax.Array:
+            remat: "bool | str" = False,
+            loss_chunk: "int | None" = None) -> jax.Array:
+    """Mean next-token CE; loss_chunk chunks the vocab matmul + CE with
+    recompute checkpointing (models/_common.py:chunked_ce_loss) so the
+    full [B, T, vocab] logits never exist — the T ≥ 32768 enabler. Must
+    divide T (raises rather than silently running the full-logits path
+    into an opaque OOM)."""
+    T = targets.shape[1]
+    if loss_chunk and T % loss_chunk:
+        raise ValueError(f"loss_chunk {loss_chunk} must divide T={T}")
+    if loss_chunk and T > loss_chunk:
+        x = hidden(params, tokens, cfg, attn_fn, remat)
+        return chunked_ce_loss(x, params["head"], targets, loss_chunk)
     logits = forward(params, tokens, cfg, attn_fn, remat=remat)
     return gather_ce_loss(logits, targets)
 

@@ -46,7 +46,8 @@ def make_train_state(key, cfg, mesh, lr: float = 3e-4):
 
 
 def build_train_step(cfg, tx, mesh, attn_fn=None,
-                     seq_axis: str | None = None, remat: "bool | str" = False):
+                     seq_axis: str | None = None, remat: "bool | str" = False,
+                     loss_chunk: "int | None" = None):
     """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
 
     attn_fn: optional attention override (e.g. ring attention for sequence
@@ -54,14 +55,17 @@ def build_train_step(cfg, tx, mesh, attn_fn=None,
     (models/_common.py:maybe_checkpoint) — True trades ~1/3 more FLOPs for
     O(1-layer) activation memory, the standard fit-big-batches move on a
     16 GB chip; "dots" saves weight-matmul outputs and recomputes only the
-    rest (less recompute, more memory than True)."""
+    rest (less recompute, more memory than True). loss_chunk: compute the
+    vocab matmul + CE in recompute-checkpointed sequence chunks so the
+    full [B, T, vocab] logits never exist (the T ≥ 32k memory enabler;
+    models/_common.py:chunked_ce_loss)."""
     model, sharding_fn = family(cfg)
     param_sharding = sharding_fn(mesh, cfg)
     data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(model.loss_fn)(
-            params, tokens, targets, cfg, attn_fn, remat)
+            params, tokens, targets, cfg, attn_fn, remat, loss_chunk)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

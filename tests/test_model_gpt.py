@@ -107,3 +107,34 @@ def test_remat_modes_match_no_remat():
         np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g0, g1)
+
+
+def test_chunked_ce_matches_full():
+    """loss_chunk is a pure memory/recompute trade: the chunked
+    (scan + checkpoint) CE must match the full-logits path in loss AND
+    grads for both the tied and untied head (same matmuls re-executed;
+    CPU fp is deterministic up to reduction order, hence the tolerances)."""
+    import jax
+    import numpy as np
+
+    from pccl_tpu.models import gpt
+
+    for untie in (False, True):
+        cfg = gpt.tiny_config(untie_head=untie)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (2, cfg.block_size),
+                                 0, cfg.vocab_size)
+
+        def lg(chunk):
+            return jax.jit(jax.value_and_grad(
+                lambda p: gpt.loss_fn(p, tok, tok, cfg, None, False,
+                                      chunk)))(params)
+
+        l0, g0 = lg(None)
+        l1, g1 = lg(cfg.block_size // 4)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=2e-5)
+        # non-head leaves come out bit-identical; the head grad differs by
+        # bf16 accumulation order (chunked partial sums vs one big matmul),
+        # measured maxabs ~1e-4 on grads of magnitude ~0.03
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=5e-4), g0, g1)
