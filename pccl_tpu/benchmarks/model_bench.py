@@ -216,7 +216,7 @@ if __name__ == "__main__":
         if k == "preset":
             kw[k] = v
         elif k == "remat":
-            kw[k] = v if v == "dots" else bool(int(v))
+            kw[k] = v if v in ("dots", "sqrt") else bool(int(v))
         elif k in ("use_flash", "untie_head", "repeat_kv"):
             kw[k] = bool(int(v))
         else:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ._common import chunked_ce_loss, gather_ce_loss, maybe_checkpoint
+from ._common import chunked_ce_loss, gather_ce_loss, scan_blocks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,16 +137,9 @@ def hidden(params: Dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig,
     """tokens: int32 [B, T] → final-norm hidden states [B, T, d] (the
     pre-head activations; forward() applies the vocab matmul)."""
     x = params["tok_emb"][tokens].astype(cfg.compute_dtype)
-
     layers = {k: params[k] for k in _LAYER_KEYS}
-
-    blk = maybe_checkpoint(
-        lambda h, layer: _block(h, layer, cfg, attn_fn), remat)
-
-    def body(h, layer):
-        return blk(h, layer), None
-
-    x, _ = lax.scan(body, x, layers)
+    x = scan_blocks(lambda h, layer: _block(h, layer, cfg, attn_fn),
+                    x, layers, remat)
     return _rmsnorm(x, params["lnf_g"])
 
 

@@ -92,7 +92,9 @@ def test_remat_modes_match_no_remat():
 
     from pccl_tpu.models import gpt
 
-    cfg = gpt.tiny_config()
+    # n_layer=4: "sqrt" groups as G=2 — L=2 would degenerate to G=1 and
+    # silently skip the grouped two-level path this test must cover
+    cfg = gpt.tiny_config(n_layer=4)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     tok = jax.random.randint(jax.random.PRNGKey(1), (2, cfg.block_size), 0,
                              cfg.vocab_size)
@@ -102,7 +104,7 @@ def test_remat_modes_match_no_remat():
             lambda p: gpt.loss_fn(p, tok, tok, cfg, None, remat)))(params)
 
     l0, g0 = lg(False)
-    for mode in (True, "dots"):
+    for mode in (True, "dots", "sqrt"):
         l1, g1 = lg(mode)
         np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
