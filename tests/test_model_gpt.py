@@ -140,3 +140,20 @@ def test_chunked_ce_matches_full():
         # measured maxabs ~1e-4 on grads of magnitude ~0.03
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-2, atol=5e-4), g0, g1)
+
+
+def test_loss_chunk_must_divide():
+    """A non-dividing loss_chunk raises immediately — a silent fall-back to
+    the full-logits path would resurface as an opaque multi-GB OOM in
+    exactly the configs the flag exists to rescue."""
+    import jax
+    import pytest
+
+    from pccl_tpu.models import gpt
+
+    cfg = gpt.tiny_config()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (1, cfg.block_size), 0,
+                             cfg.vocab_size)
+    with pytest.raises(ValueError, match="must divide"):
+        gpt.loss_fn(params, tok, tok, cfg, None, False, 100)
