@@ -192,6 +192,12 @@ def main() -> None:
             tpu_attached = probe.stdout.strip().endswith("True")
         except (subprocess.TimeoutExpired, OSError):
             tpu_attached = False
+        # the dev tunnel to the chip goes down for hours at a time; cache
+        # each successful on-chip pass so a bench run that catches the
+        # tunnel dead can still carry the most recent REAL measurements —
+        # clearly labeled as cached, never mixed into the live keys
+        cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  ".tpu_bench_cache.json")
         if tpu_attached:
             for fam in ("gpt", "llama"):
                 try:
@@ -286,9 +292,33 @@ def main() -> None:
                 print(f"bench: async diloco tpu failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr)
                 extra["async_diloco_tpu_step_s"] = None
+            try:
+                tpu_keys = {k: v for k, v in extra.items()
+                            if k.startswith(("tpu_", "diloco_tpu",
+                                             "async_diloco_tpu"))
+                            and v is not None}
+                if tpu_keys:
+                    import time
+
+                    tpu_keys["cached_at"] = time.strftime(
+                        "%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+                    with open(cache_path, "w") as f:
+                        json.dump(tpu_keys, f)
+            except OSError:
+                pass
         else:
             print("bench: no TPU attached; skipping on-chip model legs",
                   file=sys.stderr)
+            try:
+                with open(cache_path) as f:
+                    cached = json.load(f)
+                cached["note"] = ("TPU tunnel unreachable at bench time; "
+                                  "these are this repo's most recent "
+                                  "on-chip measurements, reproducible via "
+                                  "pccl_tpu.benchmarks.model_bench")
+                extra["tpu_cached"] = cached
+            except (OSError, ValueError):
+                pass
 
     print(json.dumps({
         "metric": f"allreduce_busbw_fp32_2peer_loopback({path})",
