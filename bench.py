@@ -300,10 +300,23 @@ def main() -> None:
                 if tpu_keys:
                     import time
 
-                    tpu_keys["cached_at"] = time.strftime(
+                    # MERGE into the existing cache: a partially failed
+                    # pass (tunnel drops mid-run, some legs None) must not
+                    # wipe the surviving legs' last real measurements.
+                    # The file is deliberately git-TRACKED — it is the
+                    # insurance artifact for rounds where the tunnel is
+                    # dead at bench time.
+                    merged = {}
+                    try:
+                        with open(cache_path) as f:
+                            merged = json.load(f)
+                    except (OSError, ValueError):
+                        pass
+                    merged.update(tpu_keys)
+                    merged["cached_at"] = time.strftime(
                         "%Y-%m-%d %H:%M:%S UTC", time.gmtime())
                     with open(cache_path, "w") as f:
-                        json.dump(tpu_keys, f)
+                        json.dump(merged, f)
             except OSError:
                 pass
         else:
