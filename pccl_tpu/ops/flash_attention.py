@@ -403,7 +403,9 @@ def default_blocks(T: int, Dh: int) -> tuple:
     Dh=128 (10.7 vs 18.5 ms). bk=2048 or bq=4096 trip the VMEM ceiling
     (fp32 [bq, bk] score tiles), and so does bq=2048 at Dh=128 once the
     kernel sits under a remat'd scan (T=16384 train: scoped-vmem over by
-    420K from the remat stack) — hence the T>8192 cap."""
+    420K from the remat stack) — hence bq drops back to 1024 for
+    T > 8192 (a tile-size cap only; the k-blocked kernels themselves run
+    to T=32768+)."""
     bq = 2048 if (Dh >= 128 and T <= 8192) else 1024
     return snap_block(bq, T), snap_block(1024, T)
 
