@@ -163,3 +163,41 @@ def test_grid_survives_killed_column(master, grid_file):
     for out in outs:
         first, last = _final_losses(out)
         assert last < first
+
+
+def _grid_file_cls():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "grid_diloco", REPO / "examples" / "grid_fsdp" / "grid_diloco.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.GridFile
+
+
+def test_grid_file_lifecycle(tmp_path):
+    """GridFile guarantees: atomic init with -1 sentinels, publish/wait
+    ordering, attach-compatible, LOUD rejection of incompatible stale
+    files (a silent attach would hand a new run another run's params)."""
+    GridFile = _grid_file_cls()
+    path = str(tmp_path / "g.bin")
+    g = GridFile(path, 2, 100)
+    assert list(g.seq) == [-1, -1]
+    data = np.arange(50, dtype=np.float32)
+    g.publish(0, 3, data)
+    assert g.seq[0] == 3 and g.seq[1] == -1
+    # same-shape attacher sees the published shard
+    h = GridFile(path, 2, 100)
+    np.testing.assert_array_equal(h.read_full()[:50], data)
+    h.publish(1, 3, np.zeros(50, np.float32))
+    g.wait_all(3, timeout=5)
+    # wrong size -> loud error, never a misaligned attach
+    with pytest.raises(RuntimeError, match="grid file"):
+        GridFile(path, 2, 200)
+    # same byte size (8·(3+4)+4·96 == 8·(3+2)+4·100) but different layout
+    # -> the identity header catches what the size check cannot
+    with pytest.raises(RuntimeError, match="identity mismatch"):
+        GridFile(path, 4, 96)
+    g.remove()
+    g.remove()  # idempotent
+    assert not (tmp_path / "g.bin").exists()
