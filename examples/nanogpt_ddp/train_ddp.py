@@ -46,6 +46,10 @@ def main() -> int:
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--tp", type=int, default=0,
                     help="in-slice tensor-parallel degree (0 = auto mesh)")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="gradient accumulation microbatches per step "
+                         "(reference gradient_accumulation_steps); the "
+                         "ring still moves ONE averaged gradient per step")
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--shm-staging", action="store_true",
@@ -86,8 +90,19 @@ def main() -> int:
     tx = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
     opt_state = tx.init(params)
 
+    base_lg = jax.value_and_grad(functools.partial(model.loss_fn, cfg=cfg))
+    if args.grad_accum > 1:
+        # tokens/targets arrive [A, B, T]; the shared library wrapper
+        # (parallel/train.py:accum_value_and_grad) scans the microbatches
+        # so one microbatch's activations are live at a time
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pccl_tpu.parallel.train import accum_value_and_grad
+
+        data_sharding = NamedSharding(mesh, P(None, *data_sharding.spec))
+        base_lg = accum_value_and_grad(base_lg, args.grad_accum)
     loss_and_grad = jax.jit(
-        jax.value_and_grad(functools.partial(model.loss_fn, cfg=cfg)),
+        base_lg,
         in_shardings=(param_sharding, data_sharding, data_sharding),
     )
 
@@ -114,7 +129,12 @@ def main() -> int:
 
     def batches():
         while True:
-            yield next_batch()
+            if args.grad_accum > 1:
+                ms = [next_batch() for _ in range(args.grad_accum)]
+                yield (np.stack([m[0] for m in ms]),
+                       np.stack([m[1] for m in ms]))
+            else:
+                yield next_batch()
 
     feed = prefetch_to_device(batches(), size=2, sharding=data_sharding)
     first_loss = last_loss = None

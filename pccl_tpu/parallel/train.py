@@ -45,9 +45,43 @@ def make_train_state(key, cfg, mesh, lr: float = 3e-4):
     return params, tx, opt_state
 
 
+def accum_value_and_grad(base_lg, accum_steps: int):
+    """Wrap a (params, tokens, targets) -> (loss, grads) function with
+    scan-based microbatch accumulation: the wrapped function takes
+    [A, B, T] tokens/targets, runs one microbatch's activations at a time
+    under `lax.scan`, and accumulates grads in an fp32 tree. Loss and
+    grads are the exact mean over all A·B sequences (CE is a per-sequence
+    mean, so averaging A microbatch means equals the full-batch mean).
+    Shapes are static under jit, so a data pipeline whose leading axis
+    disagrees with `accum_steps` fails LOUDLY at trace time instead of
+    silently mis-scaling gradients."""
+
+    def fn(params, tokens, targets):
+        a = tokens.shape[0]
+        assert a == accum_steps, (
+            f"got {a} microbatches, step was built for accum_steps="
+            f"{accum_steps}")
+
+        def micro(carry, tt):
+            loss_sum, grad_acc = carry
+            loss, grads = base_lg(params, tt[0], tt[1])
+            grad_acc = jax.tree.map(
+                lambda acc, g: acc + g.astype(jnp.float32), grad_acc, grads)
+            return (loss_sum + loss, grad_acc), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grads), _ = jax.lax.scan(
+            micro, (jnp.zeros((), jnp.float32), zeros), (tokens, targets))
+        return loss_sum / a, jax.tree.map(lambda g: g / a, grads)
+
+    return fn
+
+
 def build_train_step(cfg, tx, mesh, attn_fn=None,
                      seq_axis: str | None = None, remat: "bool | str" = False,
-                     loss_chunk: "int | None" = None):
+                     loss_chunk: "int | None" = None,
+                     accum_steps: int = 1):
     """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
 
     attn_fn: optional attention override (e.g. ring attention for sequence
@@ -58,14 +92,33 @@ def build_train_step(cfg, tx, mesh, attn_fn=None,
     rest (less recompute, more memory than True). loss_chunk: compute the
     vocab matmul + CE in recompute-checkpointed sequence chunks so the
     full [B, T, vocab] logits never exist (the T ≥ 32k memory enabler;
-    models/_common.py:chunked_ce_loss)."""
+    models/_common.py:chunked_ce_loss).
+
+    accum_steps: gradient accumulation (reference parity: the torch loops'
+    gradient_accumulation_steps, e.g. sync_diloco_fsdp.py). With A > 1 the
+    step takes tokens/targets shaped [A, B, T] — an EXPLICIT leading
+    microbatch axis, scanned with `lax.scan` so one microbatch's
+    activations are live at a time while per-microbatch grads accumulate
+    in an fp32 tree; batch sharding applies to the B axis. Loss and grads
+    are the exact mean over all A·B sequences (CE is a per-sequence mean,
+    so averaging A microbatch means equals the full-batch mean — grads
+    match a single [A·B, T] step bitwise up to reduction order)."""
     model, sharding_fn = family(cfg)
     param_sharding = sharding_fn(mesh, cfg)
     data_sharding = mesh_lib.batch_sharding(mesh, seq_axis=seq_axis)
+    if accum_steps > 1:
+        # [A, B, T]: microbatch axis unsharded, batch over dp as usual
+        spec = data_sharding.spec
+        data_sharding = NamedSharding(mesh, P(None, *spec))
+
+    base_lg = jax.value_and_grad(
+        lambda p, tok, tgt: model.loss_fn(p, tok, tgt, cfg, attn_fn, remat,
+                                          loss_chunk))
+    lg = accum_value_and_grad(base_lg, accum_steps) if accum_steps > 1 \
+        else base_lg
 
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(model.loss_fn)(
-            params, tokens, targets, cfg, attn_fn, remat, loss_chunk)
+        loss, grads = lg(params, tokens, targets)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -164,3 +164,15 @@ def test_llama_ddp_two_peers():
         first, last = _final_losses(out)
         assert last < first
         assert "world 2" in out
+
+
+def test_nanogpt_ddp_grad_accum():
+    """--grad-accum 2: the loop scans 2 microbatches per step and still
+    moves ONE averaged gradient over the ring (reference
+    gradient_accumulation_steps)."""
+    outs = _run_example(REPO / "examples" / "nanogpt_ddp" / "train_ddp.py", 2,
+                        ["--steps", "8", "--batch", "4", "--grad-accum", "2"])
+    for out in outs:
+        first, last = _final_losses(out)
+        assert last < first
+        assert "world 2" in out

@@ -157,3 +157,42 @@ def test_loss_chunk_must_divide():
                              cfg.vocab_size)
     with pytest.raises(ValueError, match="must divide"):
         gpt.loss_fn(params, tok, tok, cfg, None, False, 100)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=A over [A, B, T] must match one step over [A·B, T]:
+    CE is a per-sequence mean, so the average of A microbatch means (and
+    grads) equals the full-batch mean exactly — same updated params, same
+    loss, up to fp32 reduction order."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pccl_tpu.models import gpt
+    from pccl_tpu.parallel import mesh as mesh_lib, train as train_lib
+
+    import optax
+
+    cfg = gpt.tiny_config()
+    mesh = mesh_lib.make_mesh(jax.devices()[:2], ("dp", "tp"))
+    tok = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(2), (4, cfg.block_size), 0, cfg.vocab_size))
+
+    def run(accum):
+        params, _, _ = train_lib.make_train_state(
+            jax.random.PRNGKey(0), cfg, mesh)
+        # plain SGD(1.0): new_params − old_params == −grads, so the
+        # comparison is of the accumulated GRADIENTS themselves (AdamW's
+        # m/√v would sign-normalize noise-level grads and amplify bf16
+        # reduction-order dust into lr-scale diffs)
+        tx = optax.sgd(1.0)
+        opt = tx.init(params)
+        step = train_lib.build_train_step(cfg, tx, mesh, accum_steps=accum)
+        t = jnp.asarray(tok.reshape(2, 2, -1) if accum > 1 else tok)
+        return step(params, opt, t, t)
+
+    p1, _, l1 = run(1)
+    p2, _, l2 = run(2)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=2e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-2, atol=5e-5), p1, p2)
