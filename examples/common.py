@@ -161,18 +161,26 @@ def finish_profile(args, prof) -> None:
         print(prof.summary(), flush=True)
 
 
-def make_batch_fn(args, vocab: int):
+def make_batch_fn(args, vocab: int, split: str = "train"):
     """Per-peer batch sampler for the chosen dataset; the shard is keyed
     off the peer's base port either way. The text path samples through the
     library's TokenDataset (random-crop next-token pairs, disjoint stream
-    per worker_index)."""
+    per worker_index); split="val" crops a DISJOINT tail 10% of the corpus
+    (the reference's train.bin/val.bin estimate_loss split) — a different
+    rng stream alone would still sample the training text. The synthetic
+    rule is the distribution itself, so there a fresh stream IS held out."""
     if getattr(args, "data", "synthetic") == "text":
         from pccl_tpu.utils.data import TokenDataset
 
-        ds = TokenDataset(text_corpus(), args.block, args.batch,
-                          seed=1000, worker_index=args.base_port % 997)
+        corpus = text_corpus()
+        cut = int(len(corpus) * 0.9)
+        corpus = corpus[cut:] if split == "val" else corpus[:cut]
+        ds = TokenDataset(corpus, args.block, args.batch,
+                          seed=1000 if split == "train" else 7919,
+                          worker_index=args.base_port % 997)
         return ds.sample
-    rng = data_rng(args)
+    rng = data_rng(args) if split == "train" else \
+        np.random.RandomState(7919 + (args.base_port % 997))
     return lambda: synth_batch(rng, args.batch, args.block, vocab)
 
 

@@ -50,6 +50,17 @@ def main() -> int:
                     help="gradient accumulation microbatches per step "
                          "(reference gradient_accumulation_steps); the "
                          "ring still moves ONE averaged gradient per step")
+    ap.add_argument("--lr-schedule", choices=["const", "cosine"],
+                    default="const",
+                    help="cosine = linear warmup then cosine decay to "
+                         "--min-lr over --steps (reference get_lr)")
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--min-lr", type=float, default=0.0)
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="every N steps, report mean loss over "
+                         "--eval-batches held-out batches (reference "
+                         "estimate_loss)")
+    ap.add_argument("--eval-batches", type=int, default=4)
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--shm-staging", action="store_true",
@@ -87,7 +98,13 @@ def main() -> int:
     init = jax.jit(model.init_params, static_argnames=("cfg",),
                    out_shardings=param_sharding)
     params = init(jax.random.PRNGKey(args.seed), cfg)
-    tx = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    lr = args.lr
+    if args.lr_schedule == "cosine":
+        from pccl_tpu.parallel.train import cosine_warmup_schedule
+
+        lr = cosine_warmup_schedule(args.lr, args.steps,
+                                    args.warmup_steps, args.min_lr)
+    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
     opt_state = tx.init(params)
 
     base_lg = jax.value_and_grad(functools.partial(model.loss_fn, cfg=cfg))
@@ -137,6 +154,15 @@ def main() -> int:
                 yield next_batch()
 
     feed = prefetch_to_device(batches(), size=2, sharding=data_sharding)
+
+    # held-out eval (reference estimate_loss): the val split — a disjoint
+    # tail slice of the text corpus (or a fresh synthetic stream, which is
+    # held out by construction) — through a grad-free jitted loss
+    eval_fn = eval_batch = None
+    if args.eval_every > 0:
+        eval_fn = jax.jit(functools.partial(model.loss_fn, cfg=cfg))
+        eval_batch = common.make_batch_fn(args, cfg.vocab_size, split="val")
+
     first_loss = last_loss = None
     for step in range(args.steps):
         common.admit_pending(comm)
@@ -152,6 +178,15 @@ def main() -> int:
         last_loss = loss
         world = comm.world_size if comm is not None else 1
         print(f"step {step} loss {loss:.4f} world {world}", flush=True)
+        if eval_fn is not None and (step + 1) % args.eval_every == 0:
+            import jax.numpy as _jnp
+
+            vals = []
+            for _ in range(args.eval_batches):
+                et, ey = eval_batch()
+                vals.append(float(eval_fn(params, _jnp.asarray(et),
+                                          _jnp.asarray(ey))))
+            print(f"eval step {step} loss {np.mean(vals):.4f}", flush=True)
 
     common.finish_profile(args, prof)
     return common.report_final(first_loss, last_loss, comm)

@@ -33,16 +33,37 @@ def family(cfg):
     return gpt, mesh_lib.gpt_param_sharding
 
 
-def make_train_state(key, cfg, mesh, lr: float = 3e-4):
-    """Init params + AdamW optimizer state, placed with TP/DP shardings."""
+def make_train_state(key, cfg, mesh, lr: float = 3e-4, schedule=None):
+    """Init params + AdamW optimizer state, placed with TP/DP shardings.
+
+    schedule: optional optax schedule (steps -> lr) used INSTEAD of the
+    constant `lr` — e.g. cosine_warmup_schedule below (the reference
+    loops' warmup + cosine decay, sync_diloco_fsdp.py:get_lr)."""
     model, sharding_fn = family(cfg)
     param_sharding = sharding_fn(mesh, cfg)
     init = jax.jit(model.init_params, static_argnames=("cfg",),
                    out_shardings=param_sharding)
     params = init(key, cfg)
-    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    tx = optax.adamw(schedule if schedule is not None else lr,
+                     b1=0.9, b2=0.95, weight_decay=0.1)
     opt_state = jax.jit(tx.init, out_shardings=None)(params)
     return params, tx, opt_state
+
+
+def cosine_warmup_schedule(lr: float, total_steps: int,
+                           warmup_steps: int = 0, min_lr: float = 0.0):
+    """The reference loops' LR policy (linear warmup -> cosine decay to
+    min_lr; /root/reference/python/examples/nanogpt_diloco/
+    sync_diloco_fsdp.py:get_lr), as an optax schedule usable by
+    make_train_state(schedule=...) — the schedule runs INSIDE the jitted
+    step off the optimizer's step count, no host-side LR pokes."""
+    warmup_steps = max(0, warmup_steps)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0 if warmup_steps else lr, peak_value=lr,
+        warmup_steps=warmup_steps,
+        # optax requires decay_steps > warmup_steps (the cosine part must
+        # be non-empty) — warmup >= total collapses to warmup-then-min_lr
+        decay_steps=max(warmup_steps + 1, total_steps), end_value=min_lr)
 
 
 def accum_value_and_grad(base_lg, accum_steps: int):

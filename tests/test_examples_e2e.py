@@ -176,3 +176,17 @@ def test_nanogpt_ddp_grad_accum():
         first, last = _final_losses(out)
         assert last < first
         assert "world 2" in out
+
+
+def test_nanogpt_ddp_schedule_and_eval():
+    """--lr-schedule cosine + periodic held-out eval (reference get_lr +
+    estimate_loss): the run trains and emits eval lines from a disjoint
+    data stream."""
+    outs = _run_example(
+        REPO / "examples" / "nanogpt_ddp" / "train_ddp.py", 2,
+        ["--steps", "10", "--batch", "4", "--lr-schedule", "cosine",
+         "--warmup-steps", "2", "--eval-every", "5"])
+    for out in outs:
+        first, last = _final_losses(out)
+        assert last < first
+        assert "eval step 4 loss" in out and "eval step 9 loss" in out
