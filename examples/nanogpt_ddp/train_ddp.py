@@ -61,6 +61,12 @@ def main() -> int:
                          "--eval-batches held-out batches (reference "
                          "estimate_loss)")
     ap.add_argument("--eval-batches", type=int, default=4)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="save {params, opt_state} here every "
+                         "--checkpoint-every steps and resume from the "
+                         "newest snapshot (reference ckpt.pt save/resume)")
+    ap.add_argument("--checkpoint-every", default=20,
+                    type=lambda v: max(1, int(v)))
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--shm-staging", action="store_true",
@@ -92,20 +98,19 @@ def main() -> int:
         mesh = mesh_lib.make_mesh(devices, ("dp", "tp"))
     cfg = common.model_config(args, char_level=args.data == "text")
     model, sharding_fn = family(cfg)  # gpt or llama by config family
-    param_sharding = sharding_fn(mesh)
+    param_sharding = sharding_fn(mesh, cfg)  # must match make_train_state's
     data_sharding = mesh_lib.batch_sharding(mesh)
 
-    init = jax.jit(model.init_params, static_argnames=("cfg",),
-                   out_shardings=param_sharding)
-    params = init(jax.random.PRNGKey(args.seed), cfg)
-    lr = args.lr
-    if args.lr_schedule == "cosine":
-        from pccl_tpu.parallel.train import cosine_warmup_schedule
+    from pccl_tpu.parallel.train import (cosine_warmup_schedule,
+                                         make_train_state)
 
-        lr = cosine_warmup_schedule(args.lr, args.steps,
-                                    args.warmup_steps, args.min_lr)
-    tx = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
-    opt_state = tx.init(params)
+    schedule = None
+    if args.lr_schedule == "cosine":
+        schedule = cosine_warmup_schedule(args.lr, args.steps,
+                                          args.warmup_steps, args.min_lr)
+    params, tx, opt_state = make_train_state(
+        jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.lr,
+        schedule=schedule)
 
     base_lg = jax.value_and_grad(functools.partial(model.loss_fn, cfg=cfg))
     if args.grad_accum > 1:
@@ -144,6 +149,39 @@ def main() -> int:
     # device compute of batch k (pccl_tpu.utils.data)
     from pccl_tpu.utils.data import prefetch_to_device
 
+    def _replicate_loose(tree):
+        """Optimizer scalars (step counts) come back from checkpoint
+        restore or shared-state adoption COMMITTED to a single device
+        while params are mesh-sharded — one jit cannot mix the two device
+        sets, so re-place any non-mesh-sharded leaf replicated."""
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda x: x if isinstance(getattr(x, "sharding", None),
+                                      NamedSharding)
+            else jax.device_put(x, mesh_lib.replicated(mesh)), tree)
+
+    ckpt = None
+    start = 0
+    if args.checkpoint_dir:
+        from pccl_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            tree = ckpt.restore({"params": params, "opt_state": opt_state},
+                                latest)
+            params, opt_state = tree["params"], tree["opt_state"]
+            opt_state = _replicate_loose(opt_state)
+            start = latest
+            # advance the deterministic data stream past the replayed
+            # prefix — otherwise resumed steps retrain on the exact
+            # batches steps [0, start) already consumed. MUST happen
+            # before the prefetch thread below starts drawing.
+            for _ in range(start * max(1, args.grad_accum)):
+                next_batch()
+            print(f"resumed from step {latest}", flush=True)
+
     def batches():
         while True:
             if args.grad_accum > 1:
@@ -163,9 +201,65 @@ def main() -> int:
         eval_fn = jax.jit(functools.partial(model.loss_fn, cfg=cfg))
         eval_batch = common.make_batch_fn(args, cfg.vocab_size, split="val")
 
+    # --- per-step shared-state sync (reference train_pccl.py keeps its
+    # model+optimizer in the pccl shared state and syncs every step) ---
+    # The DDP invariant is IDENTICAL params on every peer; topology alone
+    # cannot keep it — a late joiner starts from seed params and a
+    # checkpoint-resumed peer from its snapshot. Revision = STEP, so the
+    # bootstrap election deterministically favors the furthest-trained
+    # offer (a resumed peer's progress can never lose a content tie to a
+    # seed model), and syncing once per trained step keeps the master's
+    # strict one-increment rule naturally. Cost note: without
+    # PCCLT_SS_HASH=simple-tpu the hash compare stages every leaf to the
+    # host each step — fine for example scale; TPU deployments set the
+    # env var group-wide so clean syncs ship 8 bytes per entry instead
+    # (pccl_tpu.ops.hashing, TensorInfo.from_jax_device).
+    import os as _os
+
+    from pccl_tpu.comm import PcclError, SharedState, TensorInfo
+
+    _mk = (TensorInfo.from_jax_device
+           if _os.environ.get("PCCLT_SS_HASH") == "simple-tpu"
+           else TensorInfo.from_jax)
+
+    def sync_state(params, opt_state, step):
+        leaves_p, tdef_p = jax.tree.flatten(params)
+        leaves_o, tdef_o = jax.tree.flatten(opt_state)
+        step_arr = np.array([step], dtype=np.uint64)
+        entries = ([_mk(f"ddp.p{i}", l) for i, l in enumerate(leaves_p)]
+                   + [_mk(f"ddp.o{i}", l) for i, l in enumerate(leaves_o)]
+                   + [TensorInfo.from_numpy("ddp.step", step_arr)])
+        st = SharedState(entries, revision=step)
+        try:
+            info = comm.sync_shared_state(st)
+        except PcclError:
+            # churn mid-election: survivors re-elect on the next
+            # iteration (the vote itself can hit churn too — swallow and
+            # retry rather than die, the module's churn contract)
+            try:
+                if comm.are_peers_pending():
+                    comm.update_topology()
+            except PcclError:
+                pass
+            return params, opt_state, step
+        if info.rx_bytes:  # outdated: adopt the cohort's state
+            n = len(leaves_p)
+            params = jax.tree.unflatten(
+                tdef_p, [e.jax_value() for e in entries[:n]])
+            opt_state = _replicate_loose(jax.tree.unflatten(
+                tdef_o, [e.jax_value() for e in entries[n:n + len(leaves_o)]]))
+            step = int(step_arr[0])
+            print(f"adopted shared state at step {step}", flush=True)
+        return params, opt_state, step
+
     first_loss = last_loss = None
-    for step in range(args.steps):
+    step = start
+    while step < args.steps:
         common.admit_pending(comm)
+        if comm is not None:
+            params, opt_state, step = sync_state(params, opt_state, step)
+            if step >= args.steps:
+                break
         tok, tgt = next(feed)
         with prof.section("fwd+bwd"):
             loss, grads = loss_and_grad(params, tok, tgt)
@@ -179,14 +273,15 @@ def main() -> int:
         world = comm.world_size if comm is not None else 1
         print(f"step {step} loss {loss:.4f} world {world}", flush=True)
         if eval_fn is not None and (step + 1) % args.eval_every == 0:
-            import jax.numpy as _jnp
-
             vals = []
             for _ in range(args.eval_batches):
                 et, ey = eval_batch()
-                vals.append(float(eval_fn(params, _jnp.asarray(et),
-                                          _jnp.asarray(ey))))
+                vals.append(float(eval_fn(params, jnp.asarray(et),
+                                          jnp.asarray(ey))))
             print(f"eval step {step} loss {np.mean(vals):.4f}", flush=True)
+        if ckpt is not None and (step + 1) % args.checkpoint_every == 0:
+            ckpt.save(step + 1, {"params": params, "opt_state": opt_state})
+        step += 1
 
     common.finish_profile(args, prof)
     return common.report_final(first_loss, last_loss, comm)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -190,3 +191,80 @@ def test_nanogpt_ddp_schedule_and_eval():
         first, last = _final_losses(out)
         assert last < first
         assert "eval step 4 loss" in out and "eval step 9 loss" in out
+
+
+def test_nanogpt_ddp_checkpoint_resume(tmp_path):
+    """Checkpoint + resume in the DDP loop (reference ckpt.pt save/resume):
+    a second invocation picks up params/opt_state at the newest snapshot
+    and runs only the remaining steps."""
+    script = REPO / "examples" / "nanogpt_ddp" / "train_ddp.py"
+    base = [sys.executable, str(script), "--solo", "--batch", "4",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--checkpoint-every", "3"]
+    r1 = subprocess.run(base + ["--steps", "6"], capture_output=True,
+                        text=True, env=_peer_env(), timeout=300)
+    assert r1.returncode == 0, r1.stdout[-2000:] + r1.stderr[-2000:]
+    r2 = subprocess.run(base + ["--steps", "9"], capture_output=True,
+                        text=True, env=_peer_env(), timeout=300)
+    assert r2.returncode == 0, r2.stdout[-2000:] + r2.stderr[-2000:]
+    assert "resumed from step 6" in r2.stdout
+    assert "step 6 " in r2.stdout and "step 8 " in r2.stdout
+    assert "step 5 " not in r2.stdout  # did NOT redo pre-resume steps
+
+
+def test_nanogpt_ddp_late_join_adopts_state():
+    """A peer joining mid-run must ADOPT the cohort's params/opt/step via
+    the per-step shared-state election (reference train_pccl.py keeps its
+    model in the pccl shared state for exactly this) — not ring-average
+    its seed params against a trained model."""
+    from pccl_tpu.comm import MasterNode
+
+    master = MasterNode("0.0.0.0", _next_port())
+    master.run()
+    script = REPO / "examples" / "nanogpt_ddp" / "train_ddp.py"
+    base = _next_port(span=64)
+
+    def spawn(port, extra):
+        cmd = [sys.executable, str(script), "--master-port", str(master.port),
+               "--base-port", str(port), "--steps", "400", "--batch", "4",
+               "--block", "128", "--connect-timeout", "300"] + extra
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=_peer_env())
+    # deterministic gate: spawn B only once A's own output shows training
+    # under way (a fixed sleep races A finishing all steps on a fast box —
+    # 400 steps at block 128 gives B's cold jax start a wide window)
+    import threading
+
+    a = spawn(base, ["--min-world", "1"])
+    a_lines = []
+    pump = threading.Thread(
+        target=lambda: a_lines.extend(iter(a.stdout.readline, "")),
+        daemon=True)
+    pump.start()
+    deadline = time.time() + 300
+    while not any(ln.startswith("step 5 ") for ln in a_lines):
+        assert time.time() < deadline and a.poll() is None, \
+            "A never reached step 5:\n" + "".join(a_lines)[-3000:]
+        time.sleep(0.2)
+    b = spawn(base + 16, ["--min-world", "2"])
+    try:
+        b_out, _ = b.communicate(timeout=420)
+        assert b.returncode == 0, b_out[-3000:]
+        a.wait(timeout=420)
+        pump.join(timeout=10)
+        a_out = "".join(a_lines)
+        assert a.returncode == 0, a_out[-3000:]
+        outs = [a_out, b_out]
+    finally:
+        for p in (a, b):
+            if p.poll() is None:
+                p.kill()
+        master.interrupt()
+        master.destroy()
+    # B adopted a nonzero step from the election instead of starting at 0
+    import re
+
+    m = re.search(r"adopted shared state at step (\d+)", outs[1])
+    assert m and int(m.group(1)) > 0, outs[1][-3000:]
+    assert "world 2" in outs[0]
