@@ -229,3 +229,29 @@ def force_cpu_if_requested() -> None:
             jax.config.update("jax_platforms", plat)
         except Exception:  # noqa: BLE001 — backend already initialized
             pass
+
+
+def add_lr_schedule_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--lr-schedule", choices=["const", "cosine"],
+                    default="const",
+                    help="cosine = linear warmup then cosine decay to "
+                         "--min-lr over the run (reference get_lr)")
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--min-lr", type=float, default=0.0)
+
+
+def make_schedule(args, peak_lr: float, total_steps: int, offset: int = 0):
+    """The --lr-schedule CLI -> an optax schedule (or None for const).
+    offset shifts the schedule's step count — a resumed run continues the
+    decay from where it left off instead of rerunning warmup (the inner
+    optimizer state, including its step count, is rebuilt fresh on
+    resume)."""
+    if getattr(args, "lr_schedule", "const") != "cosine":
+        return None
+    from pccl_tpu.parallel.train import cosine_warmup_schedule
+
+    base = cosine_warmup_schedule(peak_lr, total_steps, args.warmup_steps,
+                                  args.min_lr)
+    if not offset:
+        return base
+    return lambda count: base(count + offset)

@@ -50,12 +50,7 @@ def main() -> int:
                     help="gradient accumulation microbatches per step "
                          "(reference gradient_accumulation_steps); the "
                          "ring still moves ONE averaged gradient per step")
-    ap.add_argument("--lr-schedule", choices=["const", "cosine"],
-                    default="const",
-                    help="cosine = linear warmup then cosine decay to "
-                         "--min-lr over --steps (reference get_lr)")
-    ap.add_argument("--warmup-steps", type=int, default=0)
-    ap.add_argument("--min-lr", type=float, default=0.0)
+    common.add_lr_schedule_args(ap)
     ap.add_argument("--eval-every", type=int, default=0,
                     help="every N steps, report mean loss over "
                          "--eval-batches held-out batches (reference "
@@ -101,13 +96,9 @@ def main() -> int:
     param_sharding = sharding_fn(mesh, cfg)  # must match make_train_state's
     data_sharding = mesh_lib.batch_sharding(mesh)
 
-    from pccl_tpu.parallel.train import (cosine_warmup_schedule,
-                                         make_train_state)
+    from pccl_tpu.parallel.train import make_train_state
 
-    schedule = None
-    if args.lr_schedule == "cosine":
-        schedule = cosine_warmup_schedule(args.lr, args.steps,
-                                          args.warmup_steps, args.min_lr)
+    schedule = common.make_schedule(args, args.lr, args.steps)
     params, tx, opt_state = make_train_state(
         jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.lr,
         schedule=schedule)

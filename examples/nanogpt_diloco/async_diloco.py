@@ -32,6 +32,7 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--block", type=int, default=64)
     ap.add_argument("--inner-lr", type=float, default=1e-3)
+    common.add_lr_schedule_args(ap)
     common.add_data_args(ap)
     ap.add_argument("--outer-lr", type=float, default=0.7)
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
@@ -51,8 +52,11 @@ def main() -> int:
 
     mesh = mesh_lib.make_mesh(jax.devices(), ("dp", "tp"))
     cfg = common.model_config(args, char_level=args.data == "text")
+    schedule = common.make_schedule(
+        args, args.inner_lr, args.outer_steps * args.inner_steps)
     params, tx, opt_state = train_lib.make_train_state(
-        jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.inner_lr)
+        jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.inner_lr,
+        schedule=schedule)
     step_fn = train_lib.build_train_step(cfg, tx, mesh)
     data_sharding = mesh_lib.batch_sharding(mesh)
 

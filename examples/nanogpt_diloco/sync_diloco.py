@@ -37,6 +37,7 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--block", type=int, default=64)
     ap.add_argument("--inner-lr", type=float, default=1e-3)
+    common.add_lr_schedule_args(ap)
     common.add_data_args(ap)
     ap.add_argument("--outer-lr", type=float, default=0.7)
     ap.add_argument("--quantize", choices=["none", "minmax"], default="none")
@@ -64,8 +65,11 @@ def main() -> int:
 
     mesh = mesh_lib.make_mesh(jax.devices(), ("dp", "tp"))
     cfg = common.model_config(args, char_level=args.data == "text")
+    schedule = common.make_schedule(
+        args, args.inner_lr, args.outer_steps * args.inner_steps)
     params, tx, opt_state = train_lib.make_train_state(
-        jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.inner_lr)
+        jax.random.PRNGKey(args.seed), cfg, mesh, lr=args.inner_lr,
+        schedule=schedule)
     step_fn = train_lib.build_train_step(cfg, tx, mesh)
     data_sharding = mesh_lib.batch_sharding(mesh)
 
@@ -85,6 +89,25 @@ def main() -> int:
         ckpt = DilocoCheckpoint(args.checkpoint_dir)
         start = ckpt.maybe_restore(dl)
         if start:
+            # continue INNER training from the restored outer params —
+            # training from seed-init params would make the first
+            # pseudo-gradient (outer − inner) a restored-vs-seed jump
+            # that the outer SGD then applies toward the seed
+            params = dl.params()
+            if schedule is not None:
+                # the schedule's position lives in the optimizer's step
+                # count, which resumes at 0 — shift it so the decay
+                # continues where the run left off instead of re-running
+                # warmup (inner Adam moments restart fresh by design:
+                # DiLoCo shares only the outer state)
+                shifted = common.make_schedule(
+                    args, args.inner_lr,
+                    args.outer_steps * args.inner_steps,
+                    offset=start * args.inner_steps)
+                _, tx, opt_state = train_lib.make_train_state(
+                    jax.random.PRNGKey(args.seed), cfg, mesh,
+                    lr=args.inner_lr, schedule=shifted)
+                step_fn = train_lib.build_train_step(cfg, tx, mesh)
             print(f"resumed from outer step {start}", flush=True)
 
     prof = Profiler(enabled=args.profile or bool(args.trace_out))

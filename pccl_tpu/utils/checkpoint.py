@@ -111,7 +111,14 @@ class DilocoCheckpoint:
         }
         state = self._ck.restore(template)
         diloco.outer_params = diloco._restore_shardings(state["outer_params"])
-        diloco._momentum_vec = state["momentum"]
+        # the live momentum buffer is UNcommitted (jit places it freely)
+        # but orbax restores arrays committed to one device — re-place it
+        # with the outer vector's sharding or the fused apply sees two
+        # incompatible device sets on a multi-device mesh
+        mom = state["momentum"]
+        if hasattr(diloco._outer_vec, "sharding"):
+            mom = jax.device_put(mom, diloco._outer_vec.sharding)
+        diloco._momentum_vec = mom
         diloco.step = int(state["step"])
         return diloco.step
 
