@@ -168,25 +168,30 @@ class GridFile:
 
 
 def wait_grid_full(comm, num_shards: int, ever_full: bool = False,
+                   grid: "GridFile" = None, step: int = 0,
                    timeout: float = 300.0) -> None:
     """Admit pending peers until the grid is rectangular (footguns doc:
     proceed only when global == num_shards × largest group).
 
     ``ever_full``: once a cell has seen the full grid, a whole shard group
-    VANISHING no longer blocks the gate — groups may finish their final
-    outer step one iteration apart (the wait_all protocol allows a skew of
-    one), so a faster group that completed and left must not strand the
-    lagging group at this gate; the departed group's terminal shard is
-    already published in the grid file. During bootstrap (never yet full)
-    the strict rectangularity condition stands."""
+    VANISHING no longer blocks the gate — but only when the departed
+    group's grid-file seq already covers this cell's current ``step``
+    (groups may finish their final outer step one iteration apart, and a
+    faster group that completed and left must not strand the lagging
+    group; its terminal shard is already published). A group that CRASHED
+    mid-run has stale seq entries, so the gate keeps holding for a
+    replacement column instead of sailing into wait_all's timeout. During
+    bootstrap (never yet full) the strict rectangularity condition
+    stands."""
     deadline = time.time() + timeout
     while True:
         if comm.are_peers_pending():
             comm.update_topology()
         if comm.global_world_size == num_shards * comm.largest_peer_group:
             return
-        if ever_full and comm.num_peer_groups < num_shards:
-            return  # a group drained (end of run) — don't wait for it
+        if ever_full and comm.num_peer_groups < num_shards and (
+                grid is None or bool(np.all(grid.seq >= step))):
+            return  # a group finished its run and left — don't wait for it
         if time.time() > deadline:
             raise TimeoutError("grid never filled (a column is incomplete)")
         time.sleep(0.05)
@@ -303,7 +308,7 @@ def main() -> int:
     step = 0
     ever_full = False
     while step < args.outer_steps:
-        wait_grid_full(comm, args.num_shards, ever_full)
+        wait_grid_full(comm, args.num_shards, ever_full, grid=grid, step=step)
         ever_full = True
 
         # shard-g shared state: joiners adopt the group's shard + revision

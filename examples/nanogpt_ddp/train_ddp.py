@@ -221,18 +221,22 @@ def main() -> int:
                    + [_mk(f"ddp.o{i}", l) for i, l in enumerate(leaves_o)]
                    + [TensorInfo.from_numpy("ddp.step", step_arr)])
         st = SharedState(entries, revision=step)
-        try:
-            info = comm.sync_shared_state(st)
-        except PcclError:
-            # churn mid-election: survivors re-elect on the next
-            # iteration (the vote itself can hit churn too — swallow and
-            # retry rather than die, the module's churn contract)
+        # churn mid-election: retry at the SAME revision until the survivor
+        # group elects (grid_diloco.py's sync_with_retry contract). Training
+        # through a failed sync would increment step and offer
+        # last_revision + 2 next round — the master kicks the whole cohort
+        # for that ("shared-state revision increment violation").
+        while True:
             try:
-                if comm.are_peers_pending():
-                    comm.update_topology()
+                info = comm.sync_shared_state(st)
+                break
             except PcclError:
-                pass
-            return params, opt_state, step
+                time.sleep(0.1)
+                try:
+                    if comm.are_peers_pending():
+                        comm.update_topology()
+                except PcclError:
+                    pass
         if info.rx_bytes:  # outdated: adopt the cohort's state
             n = len(leaves_p)
             params = jax.tree.unflatten(

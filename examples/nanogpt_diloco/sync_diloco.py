@@ -112,6 +112,13 @@ def main() -> int:
 
     prof = Profiler(enabled=args.profile or bool(args.trace_out))
     next_batch = common.make_batch_fn(args, cfg.vocab_size)
+    if start:
+        # fast-forward the deterministic data stream past the batches outer
+        # steps [0, start) already consumed — without this a resumed run
+        # retrains the replayed prefix (train_ddp.py's resume path drains
+        # its stream the same way)
+        for _ in range(start * args.inner_steps):
+            next_batch()
     first_loss = last_loss = None
     for outer in range(start or 0, args.outer_steps):
         common.admit_pending(comm)

@@ -175,3 +175,14 @@ def _declare(lib):
     lib.pccltShmAlloc.argtypes = [c.c_uint64, P(c.c_void_p)]
     lib.pccltShmFree.restype = c.c_int
     lib.pccltShmFree.argtypes = [c.c_void_p]
+
+    # per-edge wire-emulation introspection: resolve what a conn to ip:port
+    # would emulate with under the current PCCLT_WIRE_* env (netem.hpp).
+    # Tolerate its absence so PCCLT_LIB can still point at an older build.
+    try:
+        lib.pccltWireModelQuery.restype = c.c_int
+        lib.pccltWireModelQuery.argtypes = [c.c_char_p, c.c_uint16,
+                                            P(c.c_double), P(c.c_double),
+                                            P(c.c_double), P(c.c_double)]
+    except AttributeError:
+        pass

@@ -53,8 +53,85 @@ def _rtt_wire(rtt_ms: float):
     return _wire_env("PCCLT_WIRE_RTT_MS", rtt_ms)
 
 
+def _edge_value(spec, i: int, j: int):
+    """Resolve edge (i -> j) from a scalar, a world x world matrix, or a
+    {(i, j): value} dict; None entries mean 'unconstrained'."""
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        return spec.get((i, j))
+    if isinstance(spec, (list, tuple)):
+        return spec[i][j]
+    return spec  # scalar: every edge
+
+
+def _endpoint_ports(port_base: int, rank: int):
+    """The ports a peer at `rank` is REACHED on (_rank_ports layout): p2p
+    (data plane + edge key canonicalized by the P2P hello) and bench (the
+    topology optimizer's probe target)."""
+    p2p, _ss, bench = _rank_ports(port_base, rank)
+    return (p2p, bench)
+
+
+@contextmanager
+def wire_topology(world: int, port_base: int, mbps=None, rtt_ms=None,
+                  jitter_ms=None, drop=None, host: str = "127.0.0.1"):
+    """Build per-rank PCCLT_WIRE_*_MAP env dicts describing a heterogeneous
+    emulated mesh over a loopback world (netem.hpp). Yields a list of env
+    dicts, one per rank; each spawned peer applies its own via
+    ``os.environ.update(envs[rank])`` BEFORE constructing its Communicator
+    (the native layer re-reads the env at every connection establishment).
+
+    Edge (i -> j) constraints live in rank i's env, keyed by rank j's
+    endpoints — both the p2p port (data plane; the P2P hello canonicalizes
+    accepted conns to it) and the bench port (so ``optimize_topology``'s
+    bandwidth probes measure the same emulated edge the ring will ride).
+
+    ``mbps`` / ``rtt_ms`` / ``jitter_ms`` / ``drop`` each accept a scalar
+    (uniform), a world x world matrix, or a {(i, j): value} dict; None
+    entries leave that edge/dimension unconstrained. The process-global
+    PCCLT_WIRE_MBPS / PCCLT_WIRE_RTT_MS vars keep acting as defaults for
+    unmapped edges. Nothing in THIS process's environment is touched —
+    the context-manager shape only scopes the description; the maps take
+    effect in whichever peer applies its env dict."""
+    var_specs = (("PCCLT_WIRE_MBPS_MAP", mbps),
+                 ("PCCLT_WIRE_RTT_MS_MAP", rtt_ms),
+                 ("PCCLT_WIRE_JITTER_MS_MAP", jitter_ms),
+                 ("PCCLT_WIRE_DROP_MAP", drop))
+    # the native layer's canonical v6 endpoint form is bracketed
+    # ("[::1]:5000" — Addr::str()); a bare "::1:5000" key would never match
+    key_host = f"[{host}]" if ":" in host and not host.startswith("[") else host
+    envs = []
+    for i in range(world):
+        env: Dict[str, str] = {}
+        for var, spec in var_specs:
+            entries = []
+            for j in range(world):
+                if j == i:
+                    continue
+                v = _edge_value(spec, i, j)
+                if v is None:
+                    continue
+                for port in _endpoint_ports(port_base, j):
+                    entries.append(f"{key_host}:{port}={v}")
+            if entries:
+                env[var] = ",".join(entries)
+        envs.append(env)
+    yield envs
+
+
 def _port(env: str, dflt: int) -> int:
     return int(os.environ.get(env, str(dflt)))
+
+
+def _rank_ports(port_base: int, rank: int) -> Tuple[int, int, int]:
+    """The bench harness's port layout for a peer at `rank`: (p2p, ss,
+    bench). Single source of truth for _connect, the topology peers, and
+    wire_topology's map keys — a stride change that misses one of them
+    would silently mis-key the per-edge emulation."""
+    return (port_base + rank * 4,
+            port_base + 1000 + rank * 4,
+            port_base + 2000 + rank * 4)
 
 
 def _spawn_world(world: int, peer_main: Callable, master_port: int,
@@ -97,10 +174,9 @@ def _connect(rank: int, master_port: int, world: int, port_base: int):
     """Join and wait until the group reaches `world` peers."""
     from pccl_tpu.comm.api import Communicator
 
+    p2p, ss, bench = _rank_ports(port_base, rank)
     comm = Communicator("127.0.0.1", master_port,
-                        p2p_port=port_base + rank * 4,
-                        ss_port=port_base + 1000 + rank * 4,
-                        bench_port=port_base + 2000 + rank * 4)
+                        p2p_port=p2p, ss_port=ss, bench_port=bench)
     comm.connect()
     while comm.world_size < world:
         if comm.are_peers_pending():
@@ -398,6 +474,118 @@ def run_wan_rtt_windowed_bench(world: int = 4, nbytes: int = 16 << 20,
             out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
     out["wan_rtt_windowed_speedup"] = (out["wan_rtt_windowed_busbw_gbps"] /
                                        out["wan_rtt_single_busbw_gbps"])
+    return out
+
+
+def _peer_topo(rank, master_port, q, world, nbytes, iters, port_base, envs,
+               gate_dir):
+    """Peer for the topology-optimizer proof: joins in RANK ORDER (file
+    gate) so the naive ring is deterministically [0, 1, ..., world-1] and
+    the emulated mesh's pessimal edge provably sits on it."""
+    from pccl_tpu.comm.api import Communicator, ReduceOp
+
+    os.environ.update(envs[rank])  # this rank's per-edge wire model
+    # ordered join: the master appends newcomers to the ring in join order,
+    # so gating each connect on the previous rank's admission pins the
+    # naive ring to rank order
+    if rank > 0:
+        deadline = time.time() + 120
+        while not os.path.exists(os.path.join(gate_dir, str(rank - 1))):
+            if time.time() > deadline:
+                raise TimeoutError(f"rank {rank}: rank {rank-1} never joined")
+            time.sleep(0.02)
+    p2p, ss, bench = _rank_ports(port_base, rank)
+    comm = Communicator("127.0.0.1", master_port,
+                        p2p_port=p2p, ss_port=ss, bench_port=bench)
+    comm.connect()
+    with open(os.path.join(gate_dir, str(rank)), "w"):
+        pass
+    while comm.world_size < world:
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+
+    rng = np.random.default_rng(5 + rank)
+    x = rng.standard_normal(nbytes // 4).astype(np.float32)
+    y = np.empty_like(x)
+
+    def timed():
+        comm.all_reduce(x, y, op=ReduceOp.AVG)  # warmup (and ring re-route)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            comm.all_reduce(x, y, op=ReduceOp.AVG)
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    t_naive = timed()
+    # every peer votes; blocks until the master's ATSP round adopts a ring
+    comm.optimize_topology()
+    t_opt = timed()
+    # second round: all edges already measured, so this adopts the finished
+    # moonshot tour when it beats the quick solve — must improve or hold
+    comm.optimize_topology()
+    t_opt2 = timed()
+    q.put({"rank": rank, "naive": t_naive, "opt": t_opt, "opt2": t_opt2})
+    comm.destroy()
+
+
+def run_topology_opt_bench(world: int = 4, nbytes: int = 4 << 20,
+                           iters: int = 3, fast_mbps: float = 200.0,
+                           slow_mbps: float = 25.0,
+                           master_port: int = 48715,
+                           port_base: int = 5000) -> Dict[str, float]:
+    """The end-to-end proof that the ATSP topology optimizer wins — the
+    reference's headline capability (bandwidth-aware ring optimization,
+    PAPER.md), exercised on a deliberately heterogeneous emulated mesh
+    (per-edge netem models, PCCLT_WIRE_*_MAP): every directed edge runs at
+    ``fast_mbps`` except the pessimal pair 0<->1 at ``slow_mbps`` (+ high
+    RTT), and peers join in rank order so the naive ring [0,1,...,n-1]
+    provably crosses it. One slow edge gates the whole lockstep ring
+    (arxiv 2606.01680's premise), so after ``optimize_topology()`` — whose
+    bandwidth probes ride the same emulated edges — the adopted ring
+    routes around the degraded link and the step time must drop. A second
+    optimize adopts the background moonshot tour and must improve or hold.
+
+    Returns naive/optimized/second-optimized median step seconds plus
+    ``topology_opt_speedup`` (naive / optimized)."""
+    import tempfile
+
+    mbps = [[None if i == j else fast_mbps for j in range(world)]
+            for i in range(world)]
+    rtt = [[None if i == j else 8.0 for j in range(world)]
+           for i in range(world)]
+    mbps[0][1] = mbps[1][0] = slow_mbps   # the degraded link
+    rtt[0][1] = rtt[1][0] = 60.0
+    old_env = {k: os.environ.get(k) for k in
+               ("PCCLT_BENCH_SECONDS", "PCCLT_BENCH_CONNECTIONS",
+                "PCCLT_MOONSHOT_MS")}
+    # short probe window + small flood pool: the optimize round serializes
+    # probes per target, and per-edge pacing makes each one deterministic
+    # anyway; moonshot small enough to finish before the second optimize
+    os.environ["PCCLT_BENCH_SECONDS"] = "0.4"
+    os.environ["PCCLT_BENCH_CONNECTIONS"] = "2"
+    os.environ["PCCLT_MOONSHOT_MS"] = "400"
+    try:
+        with wire_topology(world, port_base, mbps=mbps, rtt_ms=rtt) as envs, \
+                tempfile.TemporaryDirectory() as gate_dir:
+            res = _spawn_world(world, _peer_topo,
+                               _port("PCCLT_BENCH_MASTER_PORT_TOPO",
+                                     master_port),
+                               (world, nbytes, iters, port_base, envs,
+                                gate_dir),
+                               inline_rank0=False, timeout_s=600)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    r0 = next(r for r in res if r["rank"] == 0)
+    out = {"topology_naive_step_s": r0["naive"],
+           "topology_opt_step_s": r0["opt"],
+           "topology_opt2_step_s": r0["opt2"],
+           "topology_opt_speedup": r0["naive"] / r0["opt"]}
     return out
 
 

@@ -221,6 +221,19 @@ PCCLT_EXPORT uint64_t pccltHashBuffer(int hash_type, const void *data,
 PCCLT_EXPORT pccltResult_t pccltShmAlloc(uint64_t nbytes, void **out);
 PCCLT_EXPORT pccltResult_t pccltShmFree(void *ptr);
 
+/* Per-edge wire-emulation introspection (pcclt extension). Re-reads the
+ * PCCLT_WIRE_MBPS / PCCLT_WIRE_RTT_MS globals and the per-endpoint
+ * PCCLT_WIRE_MBPS_MAP / PCCLT_WIRE_RTT_MS_MAP / PCCLT_WIRE_JITTER_MS_MAP /
+ * PCCLT_WIRE_DROP_MAP env maps ("ip:port=value,ip=value,..."), then
+ * resolves the parameters a connection to ip:port would emulate with
+ * (exact entry, else bare-ip wildcard, else the globals; 0 = that
+ * dimension off). Output pointers may be NULL. Mirrors exactly what the
+ * data plane resolves at connection establishment, so tests and tools can
+ * verify a topology description without opening sockets. */
+PCCLT_EXPORT pccltResult_t pccltWireModelQuery(const char *ip, uint16_t port,
+                                               double *mbps, double *rtt_ms,
+                                               double *jitter_ms, double *drop);
+
 #ifdef __cplusplus
 }
 #endif

@@ -11,6 +11,7 @@
 #include "hash.hpp"
 #include "log.hpp"
 #include "master.hpp"
+#include "netem.hpp"
 #include "shm.hpp"
 
 using pcclt::client::Client;
@@ -322,6 +323,22 @@ pccltResult_t pccltShmAlloc(uint64_t nbytes, void **out) {
 pccltResult_t pccltShmFree(void *ptr) {
     if (!ptr) return pccltInvalidArgument;
     return pcclt::shm::free_buf(ptr) ? pccltSuccess : pccltInvalidArgument;
+}
+
+pccltResult_t pccltWireModelQuery(const char *ip, uint16_t port, double *mbps,
+                                  double *rtt_ms, double *jitter_ms,
+                                  double *drop) {
+    if (!ip) return pccltInvalidArgument;
+    auto addr = pcclt::net::Addr::parse(ip, port);
+    if (!addr) return pccltInvalidArgument;
+    auto &reg = pcclt::net::netem::Registry::inst();
+    reg.refresh();
+    auto params = reg.resolve(*addr)->params();
+    if (mbps) *mbps = params.mbps;
+    if (rtt_ms) *rtt_ms = params.rtt_ms;
+    if (jitter_ms) *jitter_ms = params.jitter_ms;
+    if (drop) *drop = params.drop;
+    return pccltSuccess;
 }
 
 pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *state,

@@ -1,5 +1,6 @@
 #include "benchmark.hpp"
 
+#include <algorithm>
 #include <chrono>
 #include <cstdlib>
 #include <cstring>
@@ -8,6 +9,7 @@
 #include <vector>
 
 #include "log.hpp"
+#include "netem.hpp"
 #include "protocol.hpp"
 #include "wire.hpp"
 
@@ -34,6 +36,14 @@ int probe_connections() {
 double run_probe(const net::Addr &target) {
     const int ncon = probe_connections();
 
+    // per-edge wire emulation must shape the probe too — the whole point
+    // of the topology optimizer is to measure the edge the collective will
+    // actually ride, and on an emulated mesh that edge is the netem model.
+    // The flood below paces through the target's Edge bucket (shared with
+    // the data plane), so the measured rate ≈ the emulated rate.
+    net::netem::Registry::inst().refresh();
+    auto edge = net::netem::Registry::inst().resolve(target);
+
     // one random token per probe: the server admits connections per-PROBER
     // (all-or-nothing), so two concurrent probers can never split the
     // server's capacity and both walk away busy-rejected
@@ -57,12 +67,21 @@ double run_probe(const net::Addr &target) {
         if (ack->payload[0] == 0) return -2.0; // busy: another prober holds it
     }
 
-    // one shared random 8 MB buffer (reference: DEFAULT_SEND_BUFFER_SIZE)
+    // one shared random 8 MB buffer (reference: DEFAULT_SEND_BUFFER_SIZE).
+    // On a paced edge, flood in chunks the emulated wire drains in ~25 ms
+    // so the deadline stays meaningful (one 8 MB send at 25 Mbit/s would
+    // blow a sub-second probe window by seconds on its own).
     std::vector<uint8_t> buf(8 << 20);
     std::mt19937_64 rng{0x9E3779B97F4A7C15ull};
     for (size_t i = 0; i + 8 <= buf.size(); i += 8) {
         uint64_t v = rng();
         memcpy(buf.data() + i, &v, 8);
+    }
+    size_t chunk = buf.size();
+    if (edge->pace_enabled()) {
+        double mbps_cap = edge->params().mbps;
+        chunk = std::min(chunk, std::max<size_t>(
+            64 << 10, static_cast<size_t>(mbps_cap * 1e6 / 8 * 0.025)));
     }
 
     const double secs = probe_seconds();
@@ -70,13 +89,14 @@ double run_probe(const net::Addr &target) {
     std::vector<std::thread> threads;
     threads.reserve(ncon);
     for (int i = 0; i < ncon; ++i) {
-        threads.emplace_back([&, i] {
+        threads.emplace_back([&, i, chunk] {
             auto deadline = Clock::now() + std::chrono::duration<double>(secs);
             uint64_t sent = 0;
             auto t0 = Clock::now();
             while (Clock::now() < deadline) {
-                if (!socks[i].send_all(buf.data(), buf.size())) break;
-                sent += buf.size();
+                edge->pace(chunk);  // no-op on unemulated edges
+                if (!socks[i].send_all(buf.data(), chunk)) break;
+                sent += chunk;
             }
             double elapsed =
                 std::chrono::duration<double>(Clock::now() - t0).count();

@@ -64,17 +64,26 @@ void Client::spawn_service(
 // ---------------- accept handlers ----------------
 
 void Client::on_p2p_accept(net::Socket sock) {
-    // handshake: peer sends P2PHello{uuid, pool index}; we ack with our uuid
+    // handshake: peer sends P2PHello{uuid, pool index, p2p listen port};
+    // we ack with our uuid
     spawn_service(std::move(sock), [this](net::Socket &sock,
                                           const std::shared_ptr<std::atomic<int>> &fd) {
         auto hello = net::recv_frame(sock, 15'000);
         if (!hello || hello->type != PacketType::kP2PHello) return;
         proto::Uuid peer;
         uint32_t idx = 0;
+        uint16_t peer_p2p_port = 0;
         try {
             wire::Reader r(hello->payload);
             peer = proto::get_uuid(r);
             idx = r.u32();
+            // the peer's advertised p2p listen port: the accepted socket's
+            // source port is ephemeral, so this is the only way to key the
+            // conn's wire-emulation edge by the peer's canonical endpoint.
+            // Optional (absent = 0) so a bare uuid+idx hello still connects.
+            try {
+                peer_p2p_port = r.u16();
+            } catch (...) {}
         } catch (...) { return; }
         wire::Writer w;
         proto::put_uuid(w, uuid_);
@@ -94,6 +103,14 @@ void Client::on_p2p_accept(net::Socket sock) {
         }
         auto conn = std::make_shared<net::MultiplexConn>(std::move(sock), table);
         fd->store(-1); // handed off: the conn owns the fd now
+        if (peer_p2p_port != 0) {
+            // canonical peer endpoint = observed source ip + advertised p2p
+            // port: per-edge wire emulation resolves against it (before
+            // run(), so the zero-copy gate sees the final emulation state)
+            net::Addr pa = conn->socket().peer_addr();
+            pa.port = peer_p2p_port;
+            conn->set_wire_peer(pa);
+        }
         conn->run();
         std::shared_ptr<net::MultiplexConn> replaced;
         {
@@ -328,6 +345,9 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
             wire::Writer w;
             proto::put_uuid(w, uuid_);
             w.u32(static_cast<uint32_t>(i));
+            // our p2p listen port: lets the acceptor key its side of this
+            // conn by our canonical endpoint (per-edge wire emulation)
+            w.u16(p2p_listener_.port());
             std::mutex mu;
             if (!net::send_frame(s, mu, PacketType::kP2PHello, w.data())) {
                 ok = false;
@@ -339,6 +359,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
                 break;
             }
             auto conn = std::make_shared<net::MultiplexConn>(std::move(s), table);
+            conn->set_wire_peer(pa); // canonical endpoint (= the addr dialed)
             conn->run();
             pool.push_back(conn);
         }

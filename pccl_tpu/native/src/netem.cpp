@@ -1,0 +1,290 @@
+#include "netem.hpp"
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <thread>
+
+#include "log.hpp"
+
+namespace pcclt::net::netem {
+
+namespace {
+
+uint64_t mono_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+           static_cast<uint64_t>(ts.tv_nsec);
+}
+
+uint64_t splitmix64(uint64_t &s) {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+}
+
+// strip leading/trailing spaces (map values often come from shell strings)
+std::string trim(const std::string &s) {
+    size_t a = s.find_first_not_of(" \t");
+    if (a == std::string::npos) return "";
+    size_t b = s.find_last_not_of(" \t");
+    return s.substr(a, b - a + 1);
+}
+
+}  // namespace
+
+// ---------- Edge ----------
+
+void Edge::configure(const EdgeParams &p) {
+    ns_per_byte_.store(p.mbps > 0 ? 8000.0 / p.mbps : 0.0,
+                       std::memory_order_relaxed);
+    owd_ns_.store(p.rtt_ms > 0 ? static_cast<uint64_t>(p.rtt_ms * 0.5e6) : 0,
+                  std::memory_order_relaxed);
+    jitter_ns_.store(
+        p.jitter_ms > 0 ? static_cast<uint64_t>(p.jitter_ms * 1e6) : 0,
+        std::memory_order_relaxed);
+    drop_.store(p.drop > 0 ? std::min(p.drop, 1.0) : 0.0,
+                std::memory_order_relaxed);
+}
+
+EdgeParams Edge::params() const {
+    EdgeParams p;
+    double npb = ns_per_byte_.load(std::memory_order_relaxed);
+    p.mbps = npb > 0 ? 8000.0 / npb : 0.0;
+    p.rtt_ms = static_cast<double>(owd_ns_.load(std::memory_order_relaxed)) /
+               0.5e6;
+    p.jitter_ms =
+        static_cast<double>(jitter_ns_.load(std::memory_order_relaxed)) / 1e6;
+    p.drop = drop_.load(std::memory_order_relaxed);
+    return p;
+}
+
+void Edge::pace(size_t bytes) {
+    double npb = ns_per_byte_.load(std::memory_order_relaxed);
+    if (npb <= 0) return;
+    uint64_t end;
+    {
+        std::lock_guard lk(mu_);
+        uint64_t now = mono_ns();
+        // reserve the transmission slot [start, end) and sleep until the
+        // frame has fully drained — a sender cannot complete a send faster
+        // than the wire carries it (no burst credit: next never lags now)
+        uint64_t start = std::max(next_ns_, now);
+        end = start + static_cast<uint64_t>(static_cast<double>(bytes) * npb);
+        next_ns_ = end;
+    }
+    // small frames (ctl, quant metadata) charge the bucket but may run a
+    // bounded window ahead of the wire: a real qdisc interleaves a sub-MTU
+    // packet ~one chunk behind the current queue, not the full depth. The
+    // bound matters — traffic composed ENTIRELY of small frames must still
+    // be throttled, so beyond the window small frames pace like the rest.
+    if (bytes <= 4096) {
+        constexpr uint64_t kAheadNs = 40'000'000;  // ~2 chunk-times @ 100 Mbit
+        if (end <= mono_ns() + kAheadNs) return;
+        end -= kAheadNs;
+    }
+    for (uint64_t now = mono_ns(); now < end; now = mono_ns()) {
+        uint64_t gap = end - now;
+        struct timespec ts{static_cast<time_t>(gap / 1000000000ull),
+                           static_cast<long>(gap % 1000000000ull)};
+        nanosleep(&ts, nullptr);
+    }
+}
+
+uint64_t Edge::delivery_delay_ns() {
+    uint64_t d = owd_ns_.load(std::memory_order_relaxed);
+    uint64_t jit = jitter_ns_.load(std::memory_order_relaxed);
+    double drop = drop_.load(std::memory_order_relaxed);
+    if (jit == 0 && drop <= 0) return d;
+    std::lock_guard lk(mu_);
+    if (jit > 0) d += splitmix64(rng_) % jit;
+    if (drop > 0 &&
+        static_cast<double>(splitmix64(rng_) >> 11) * 0x1.0p-53 < drop) {
+        // TCP never loses a frame; a "dropped" one arrives an RTO late
+        uint64_t rto = std::max<uint64_t>(
+            2 * owd_ns_.load(std::memory_order_relaxed), 200'000'000ull);
+        d += rto;
+    }
+    return d;
+}
+
+// ---------- DelayLine ----------
+
+DelayLine &DelayLine::inst() {
+    // intentionally leaked: the detached timer thread blocks on mu_/cv_
+    // forever, so a static-destruction teardown would be UB at exit
+    static DelayLine *d = new DelayLine;
+    return *d;
+}
+
+void DelayLine::deliver(uint64_t delay_ns, std::function<void()> fn) {
+    uint64_t at = mono_ns() + delay_ns;
+    {
+        std::lock_guard lk(mu_);
+        q_.emplace(at, std::move(fn));
+        if (!running_) {
+            running_ = true;
+            std::thread([this] { timer_loop(); }).detach();
+        }
+    }
+    cv_.notify_one();
+}
+
+void DelayLine::timer_loop() {
+    std::unique_lock lk(mu_);
+    while (true) {
+        if (q_.empty()) {
+            cv_.wait_for(lk, std::chrono::seconds(1));
+            continue;
+        }
+        uint64_t at = q_.begin()->first;
+        uint64_t now = mono_ns();
+        if (now < at) {
+            cv_.wait_for(lk, std::chrono::nanoseconds(at - now));
+            continue;
+        }
+        auto fn = std::move(q_.begin()->second);
+        q_.erase(q_.begin());
+        lk.unlock();
+        fn();
+        lk.lock();
+    }
+}
+
+// ---------- map parsing ----------
+
+std::map<std::string, double> parse_map(const char *spec, const char *name) {
+    std::map<std::string, double> out;
+    if (!spec) return out;
+    std::string s(spec);
+    size_t pos = 0;
+    while (pos <= s.size()) {
+        size_t comma = s.find(',', pos);
+        std::string entry =
+            trim(s.substr(pos, comma == std::string::npos ? std::string::npos
+                                                          : comma - pos));
+        pos = comma == std::string::npos ? s.size() + 1 : comma + 1;
+        if (entry.empty()) continue;
+        // split on the LAST '=': v6 keys like [::1]:7000 contain no '=',
+        // but being defensive costs nothing
+        size_t eq = entry.rfind('=');
+        if (eq == std::string::npos || eq == 0 || eq + 1 >= entry.size()) {
+            PLOG(kWarn) << name << ": skipping malformed entry '" << entry
+                        << "' (want key=value)";
+            continue;
+        }
+        std::string key = trim(entry.substr(0, eq));
+        std::string val = trim(entry.substr(eq + 1));
+        char *endp = nullptr;
+        double v = strtod(val.c_str(), &endp);
+        if (key.empty() || !endp || *endp != '\0' || !(v >= 0) ||
+            !std::isfinite(v)) {
+            PLOG(kWarn) << name << ": skipping malformed entry '" << entry
+                        << "' (bad key or value)";
+            continue;
+        }
+        out[key] = v;
+    }
+    return out;
+}
+
+// ---------- Registry ----------
+
+Registry &Registry::inst() {
+    static Registry *r = new Registry;  // leaked: edges outlive any conn
+    return *r;
+}
+
+namespace {
+double env_f(const char *name) {
+    if (const char *e = std::getenv(name)) {
+        double v = atof(e);
+        if (v > 0) return v;
+    }
+    return 0;
+}
+}  // namespace
+
+void Registry::refresh() {
+    std::lock_guard lk(mu_);
+    mbps_ = parse_map(std::getenv("PCCLT_WIRE_MBPS_MAP"),
+                      "PCCLT_WIRE_MBPS_MAP");
+    rtt_ = parse_map(std::getenv("PCCLT_WIRE_RTT_MS_MAP"),
+                     "PCCLT_WIRE_RTT_MS_MAP");
+    jitter_ = parse_map(std::getenv("PCCLT_WIRE_JITTER_MS_MAP"),
+                        "PCCLT_WIRE_JITTER_MS_MAP");
+    drop_ = parse_map(std::getenv("PCCLT_WIRE_DROP_MAP"),
+                      "PCCLT_WIRE_DROP_MAP");
+    global_.mbps = env_f("PCCLT_WIRE_MBPS");
+    global_.rtt_ms = env_f("PCCLT_WIRE_RTT_MS");
+    global_.jitter_ms = 0;
+    global_.drop = 0;
+    if (!default_) default_ = std::make_shared<Edge>();
+    default_->configure(global_);
+    // retune live edges in place: conns keep their shared_ptr (and their
+    // shared bucket) across refreshes; keys that dropped out of the maps
+    // fall back to the current global defaults field by field
+    for (auto &[key, e] : edges_)
+        e.edge->configure(params_for(e.exact_key, e.ip_key));
+}
+
+EdgeParams Registry::params_for(const std::string &exact_key,
+                                const std::string &ip_key) const {
+    auto field = [&](const std::map<std::string, double> &m,
+                     double global) -> double {
+        auto it = m.find(exact_key);
+        if (it != m.end()) return it->second;
+        it = m.find(ip_key);
+        if (it != m.end()) return it->second;
+        return global;
+    };
+    EdgeParams p;
+    p.mbps = field(mbps_, global_.mbps);
+    p.rtt_ms = field(rtt_, global_.rtt_ms);
+    p.jitter_ms = field(jitter_, global_.jitter_ms);
+    p.drop = field(drop_, global_.drop);
+    return p;
+}
+
+std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
+    std::string exact = peer.str();
+    // bare-ip wildcard key: Addr::str() is "a.b.c.d:port" / "[v6]:port"
+    std::string ip = exact.substr(0, exact.rfind(':'));
+    std::lock_guard lk(mu_);
+    auto has = [&](const std::string &k) {
+        return mbps_.count(k) || rtt_.count(k) || jitter_.count(k) ||
+               drop_.count(k);
+    };
+    std::string match;
+    if (has(exact)) {
+        match = exact;  // per-endpoint bucket
+    } else if (has(ip)) {
+        match = ip;  // per-host bucket, shared by every port on that ip
+    } else {
+        return default_;  // globals: the one process-wide bucket (legacy)
+    }
+    auto it = edges_.find(match);
+    if (it == edges_.end()) {
+        Entry e;
+        // wildcard-matched edges key their refresh lookups by the ip too:
+        // the bucket is shared host-wide, so one endpoint's later exact
+        // entry must not retune it
+        e.exact_key = match == ip ? ip : exact;
+        e.ip_key = ip;
+        e.edge = std::make_shared<Edge>(params_for(e.exact_key, ip));
+        it = edges_.emplace(match, std::move(e)).first;
+    }
+    return it->second.edge;
+}
+
+std::shared_ptr<Edge> Registry::default_edge() {
+    std::lock_guard lk(mu_);
+    return default_;
+}
+
+}  // namespace pcclt::net::netem

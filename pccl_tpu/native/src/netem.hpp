@@ -1,0 +1,163 @@
+// Per-edge network emulation ("netem"): keyed wire models for loopback
+// meshes that pretend to be heterogeneous WANs.
+//
+// The round-4/5 wire emulation was process-global — one PCCLT_WIRE_MBPS
+// leaky bucket and one PCCLT_WIRE_RTT_MS delay line shared by every
+// connection — which can A/B a uniform WAN but cannot express the thing
+// the ATSP topology optimizer exists for: a mesh where ONE edge is slow
+// and routing around it wins (see "Don't Let a Few Network Failures Slow
+// the Entire AllReduce", arxiv 2606.01680). This subsystem replaces the
+// singletons with a registry of per-remote-endpoint Edge models:
+//
+//   PCCLT_WIRE_MBPS_MAP=ip:port=mbps,ip=mbps,...    egress bandwidth
+//   PCCLT_WIRE_RTT_MS_MAP=ip:port=ms,...            round-trip time
+//   PCCLT_WIRE_JITTER_MS_MAP=ip:port=ms,...         uniform extra delay
+//   PCCLT_WIRE_DROP_MAP=ip:port=p,...               frame-loss probability
+//
+// Key resolution is exact "ip:port" first, then bare-"ip" wildcard, then
+// the process-global PCCLT_WIRE_MBPS / PCCLT_WIRE_RTT_MS vars — which thus
+// keep their old meaning as defaults: with no *_MAP set, every connection
+// resolves to the single shared default Edge and behavior is bit-for-bit
+// the old global pacer/delay line. Per-field fallback: an endpoint listed
+// only in the mbps map takes its rtt from the global default, and so on.
+// Malformed map entries are skipped with a warning; the rest apply.
+//
+// An Edge is SHARED by every connection resolved to the same key (the
+// whole point of the old "global, not per-conn" rule, now per edge): Link
+// striping across a conn pool toward one peer cannot manufacture
+// bandwidth, because all pool members drain one bucket. refresh() is
+// called per conn construction and updates parameters of existing Edge
+// objects in place, so a process can re-point the env between connections
+// (bench legs, tests) without restarting — and without splitting buckets.
+//
+// Drop emulation is TCP-honest: PCCP frames ride TCP, which never loses
+// frames, so a "dropped" frame is delivered late by a retransmit penalty
+// (~RTO: max(RTT, 200 ms)) instead of vanishing. Jitter and drop can
+// reorder delivery within a tag; the SinkTable's extent bookkeeping
+// already absorbs out-of-order offsets (real jittery networks reorder
+// too — that is what the emulation is for).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "net_addr.hpp"
+
+namespace pcclt::net::netem {
+
+// one edge's emulated parameters (0 = that dimension off)
+struct EdgeParams {
+    double mbps = 0;       // egress bandwidth, megabits/s
+    double rtt_ms = 0;     // round-trip time; delivery delays by rtt/2
+    double jitter_ms = 0;  // uniform extra delivery delay in [0, jitter)
+    double drop = 0;       // P(frame "lost") -> delivered late by ~RTO
+};
+
+// One emulated edge: this process -> one remote endpoint. Holds the
+// reservation-based leaky bucket (shared by every conn on the edge) and
+// computes per-frame delivery delays. Parameters are atomics so refresh()
+// can retune a live edge without racing the data path.
+class Edge {
+public:
+    explicit Edge(const EdgeParams &p = {}) { configure(p); }
+    void configure(const EdgeParams &p);
+    EdgeParams params() const;
+
+    bool pace_enabled() const {
+        return ns_per_byte_.load(std::memory_order_relaxed) > 0;
+    }
+    bool delay_enabled() const {
+        return owd_ns_.load(std::memory_order_relaxed) > 0 ||
+               jitter_ns_.load(std::memory_order_relaxed) > 0 ||
+               drop_.load(std::memory_order_relaxed) > 0;
+    }
+    // any emulation at all: callers use this to defeat the same-host
+    // zero-copy transports (an emulated WAN cannot be bypassed)
+    bool emulated() const { return pace_enabled() || delay_enabled(); }
+
+    // Reserve [next, next+bytes*ns_per_byte) in the edge's bucket and
+    // sleep until the frame has fully drained. Small frames (<= 4 KiB)
+    // charge the bucket but may run a bounded window ahead of the wire —
+    // the same qdisc-interleaving allowance the old global pacer had.
+    void pace(size_t bytes);
+
+    // Per-frame delivery delay: owd (rtt/2) + U[0, jitter) + the
+    // retransmit penalty when the frame rolls a "loss". 0 = deliver now.
+    uint64_t delivery_delay_ns();
+
+private:
+    std::atomic<double> ns_per_byte_{0};
+    std::atomic<uint64_t> owd_ns_{0};
+    std::atomic<uint64_t> jitter_ns_{0};
+    std::atomic<double> drop_{0};
+
+    std::mutex mu_;          // bucket + rng
+    uint64_t next_ns_ = 0;   // bucket: end of the last reserved slot
+    uint64_t rng_ = 0x9E3779B97F4A7C15ull;  // splitmix64 state (jitter/drop)
+};
+
+// Deadline-ordered delivery timer shared by every delayed edge: one
+// (lazily started, intentionally leaked) thread runs visibility flips at
+// their per-frame deadlines. Replaces the old fixed-owd DeliveryDelay —
+// the delay now arrives per call, so one line serves heterogeneous edges.
+class DelayLine {
+public:
+    static DelayLine &inst();
+    // run fn once delay_ns has elapsed from now
+    void deliver(uint64_t delay_ns, std::function<void()> fn);
+
+private:
+    DelayLine() = default;
+    void timer_loop();
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::multimap<uint64_t, std::function<void()>> q_;  // deadline -> fn
+    bool running_ = false;
+};
+
+// Parse one "k=v,k=v,..." map env value. Malformed entries (no '=',
+// empty key, unparsable value, out-of-range value) are skipped with a
+// warning and do not poison their neighbors. Exposed for tests.
+std::map<std::string, double> parse_map(const char *spec, const char *name);
+
+// Registry of Edge models keyed by canonical remote endpoint.
+class Registry {
+public:
+    static Registry &inst();
+
+    // Re-read the PCCLT_WIRE_* env (globals + maps). Called per conn
+    // construction, mirroring the old per-conn WirePacer refresh.
+    void refresh();
+
+    // Resolve the Edge for a remote endpoint: exact "ip:port" entry in any
+    // map -> per-endpoint Edge; bare-"ip" wildcard -> per-ip Edge (shared
+    // by every port on that host); otherwise the shared default Edge
+    // (global PCCLT_WIRE_MBPS / PCCLT_WIRE_RTT_MS, old semantics).
+    std::shared_ptr<Edge> resolve(const Addr &peer);
+
+    // the globals-backed fallback edge (also what unresolved conns use)
+    std::shared_ptr<Edge> default_edge();
+
+private:
+    Registry() { refresh(); }
+    EdgeParams params_for(const std::string &exact_key,
+                          const std::string &ip_key) const;  // holds mu_
+
+    mutable std::mutex mu_;
+    std::shared_ptr<Edge> default_;                 // never null after ctor
+    struct Entry {
+        std::shared_ptr<Edge> edge;
+        std::string exact_key, ip_key;  // for in-place refresh
+    };
+    std::map<std::string, Entry> edges_;            // by matched key
+    std::map<std::string, double> mbps_, rtt_, jitter_, drop_;
+    EdgeParams global_;
+};
+
+}  // namespace pcclt::net::netem

@@ -18,6 +18,7 @@
 
 #include "log.hpp"
 #include "kernels.hpp"
+#include "netem.hpp"
 #include "shm.hpp"
 #include "wire.hpp"
 
@@ -778,170 +779,20 @@ bool cma_enabled_env() {
     return !(e && e[0] == '0');
 }
 
-// --- emulated-WAN pacing ------------------------------------------------
-// PCCLT_WIRE_MBPS=<megabits/s> models the peer's NIC egress rate: a
-// process-global leaky bucket over every multiplexer TCP write. This exists
-// to validate the library's reason-to-be — on-the-wire compression for
-// bandwidth-constrained WANs (reference: docs/md/01_Introduction.md:8) — on
-// a loopback host where the wire is otherwise free. Semantics:
-//  * global, not per-conn: Link striping across a conn pool cannot
-//    manufacture bandwidth, and in a ring each peer's egress IS its link
-//  * reservation-based: a writer reserves [next, next+cost) under the
-//    bucket lock, then sleeps until its slot OUTSIDE the lock (holding its
-//    conn's wr_mu_, which is correct — that conn's wire is serial)
-//  * no burst credit: idle time does not accumulate (next never lags now)
-//  * same-host zero-copy transports (CMA, registered shm) are force-
-//    disabled while pacing: an emulated WAN cannot bypass the wire
-class WirePacer {
-public:
-    static WirePacer &inst() {
-        static WirePacer p;
-        return p;
-    }
-    bool enabled() const { return ns_per_byte_.load(std::memory_order_relaxed) > 0; }
-    // Re-read PCCLT_WIRE_MBPS; called per conn construction so a process
-    // that flips the env between connections (tests, bench legs) gets the
-    // new rate without a restart.
-    void refresh() {
-        double npb = 0;
-        if (const char *e = std::getenv("PCCLT_WIRE_MBPS")) {
-            double mbps = atof(e);
-            if (mbps > 0) npb = 8000.0 / mbps;
-        }
-        ns_per_byte_.store(npb, std::memory_order_relaxed);
-    }
-    void pace(size_t bytes) {
-        double npb = ns_per_byte_.load(std::memory_order_relaxed);
-        if (npb <= 0) return;
-        uint64_t end;
-        {
-            std::lock_guard lk(mu_);
-            uint64_t now = mono_ns();
-            // reserve the transmission slot [start, end) and sleep until the
-            // frame has fully drained — a sender cannot complete a send
-            // faster than the wire carries it (no first-frame burst credit)
-            uint64_t start = std::max(next_ns_, now);
-            end = start + static_cast<uint64_t>(
-                static_cast<double>(bytes) * npb);
-            next_ns_ = end;
-        }
-        // small frames (ctl, quant metadata) charge the bucket but may run a
-        // bounded window ahead of the wire: a real qdisc interleaves a
-        // sub-MTU packet ~one chunk behind the current queue, not the full
-        // depth. The bound matters — traffic composed ENTIRELY of small
-        // frames (tiny chunk sizes, tiny tensors) must still be throttled,
-        // so beyond the window small frames pace like everything else.
-        if (bytes <= 4096) {
-            constexpr uint64_t kAheadNs = 40'000'000; // ~2 chunk-times @ 100 Mbit
-            if (end <= mono_ns() + kAheadNs) return;
-            end -= kAheadNs;
-        }
-        for (uint64_t now = mono_ns(); now < end; now = mono_ns()) {
-            uint64_t gap = end - now;
-            struct timespec ts{static_cast<time_t>(gap / 1000000000ull),
-                               static_cast<long>(gap % 1000000000ull)};
-            nanosleep(&ts, nullptr);
-        }
-    }
-
-private:
-    WirePacer() { refresh(); }
-    static uint64_t mono_ns() {
-        struct timespec ts;
-        clock_gettime(CLOCK_MONOTONIC, &ts);
-        return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
-               static_cast<uint64_t>(ts.tv_nsec);
-    }
-    std::atomic<double> ns_per_byte_{0};
-    uint64_t next_ns_ = 0;
-    std::mutex mu_;
-};
-
-// --- emulated-WAN one-way delivery latency -------------------------------
-// PCCLT_WIRE_RTT_MS=<ms> models the pipe's round-trip time: every received
-// data frame becomes VISIBLE to its consumer (extent marking / queue
-// delivery + wakeup) RTT/2 after its bytes finished draining the emulated
-// wire. Semantics are a delay LINE, not a per-frame sleep: the RX thread
-// never blocks — it keeps draining the socket at wire rate and enqueues
-// the visibility flip with a deadline, so back-to-back frames each arrive
-// owd later while preserving their bandwidth spacing (one latency per
-// dependency chain, exactly like a real long pipe). This is the missing
-// term the round-4 WAN emulation lacked (bandwidth only): without it the
-// fat-pipe features — reduce windowing, connection pools — can never show
-// the stage-latency stalls they exist to hide.
-class DeliveryDelay {
-public:
-    static DeliveryDelay &inst() {
-        // intentionally leaked: the detached timer thread blocks on mu_/cv_
-        // forever, so a static-destruction teardown would be UB at exit
-        static DeliveryDelay *d = new DeliveryDelay;
-        return *d;
-    }
-    bool enabled() const { return owd_ns_.load(std::memory_order_relaxed) > 0; }
-    void refresh() {
-        uint64_t ns = 0;
-        if (const char *e = std::getenv("PCCLT_WIRE_RTT_MS")) {
-            double ms = atof(e);
-            if (ms > 0) ns = static_cast<uint64_t>(ms * 0.5e6); // one-way
-        }
-        owd_ns_.store(ns, std::memory_order_relaxed);
-    }
-    // Run `fn` once the one-way delay has elapsed from now (= wire drain
-    // time: the sender's pacer completed the write at drain end, loopback
-    // delivery is instant, and this RX thread never sleeps).
-    void deliver(std::function<void()> fn) {
-        uint64_t at = now_ns() + owd_ns_.load(std::memory_order_relaxed);
-        {
-            std::lock_guard lk(mu_);
-            q_.emplace(at, std::move(fn));
-            if (!running_) {
-                running_ = true;
-                std::thread([this] { timer_loop(); }).detach();
-            }
-        }
-        cv_.notify_one();
-    }
-
-private:
-    DeliveryDelay() { refresh(); }
-    static uint64_t now_ns() {
-        struct timespec ts;
-        clock_gettime(CLOCK_MONOTONIC, &ts);
-        return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
-               static_cast<uint64_t>(ts.tv_nsec);
-    }
-    void timer_loop() {
-        std::unique_lock lk(mu_);
-        while (true) {
-            if (q_.empty()) {
-                cv_.wait_for(lk, std::chrono::seconds(1));
-                continue;
-            }
-            uint64_t at = q_.begin()->first;
-            uint64_t now = now_ns();
-            if (now < at) {
-                cv_.wait_for(lk, std::chrono::nanoseconds(at - now));
-                continue;
-            }
-            auto fn = std::move(q_.begin()->second);
-            q_.erase(q_.begin());
-            lk.unlock();
-            fn();
-            lk.lock();
-        }
-    }
-    std::atomic<uint64_t> owd_ns_{0};
-    std::mutex mu_;
-    std::condition_variable cv_;
-    std::multimap<uint64_t, std::function<void()>> q_; // deadline-ordered
-    bool running_ = false;
-};
-
-// Any wire emulation (bandwidth pacing or RTT) must defeat the same-host
-// zero-copy transports — an emulated WAN cannot be bypassed by CMA/shm.
-bool wire_emulated() {
-    return WirePacer::inst().enabled() || DeliveryDelay::inst().enabled();
-}
+// Wire emulation lives in netem.hpp/.cpp: per-remote-endpoint Edge models
+// (egress leaky bucket, RTT/jitter/drop delivery delay) resolved from the
+// PCCLT_WIRE_*_MAP env maps with the process-global PCCLT_WIRE_MBPS /
+// PCCLT_WIRE_RTT_MS vars as defaults. Every conn resolves its edge at
+// construction (re-resolved by set_wire_peer once the peer's canonical
+// endpoint is known from the P2P hello) and:
+//  * paces every frame write through the edge's bucket — shared by the
+//    whole conn pool to that endpoint, so striping cannot manufacture
+//    bandwidth, and in a ring each peer's per-edge egress IS its link
+//  * delays RX visibility (extent marking / queue delivery + wakeup) by
+//    the edge's per-frame delay via the shared netem::DelayLine; the RX
+//    thread never blocks, preserving bandwidth spacing like a real pipe
+//  * force-disables the same-host zero-copy transports (CMA, registered
+//    shm) on emulated edges: an emulated WAN cannot be bypassed
 
 constexpr size_t kRxSlice = 256 << 10;  // TCP sink write slice (cancel latency)
 constexpr uint32_t kMaxDataFrame = 272u << 20;
@@ -961,15 +812,28 @@ size_t cma_slice() {
 MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
     : sock_(std::move(sock)),
       table_(table ? std::move(table) : std::make_shared<SinkTable>()) {
-    tx_chunk_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
+    tx_chunk_base_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
     cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
-    WirePacer::inst().refresh();
-    DeliveryDelay::inst().refresh();
+    // per-conn env re-read (old WirePacer::refresh semantics): a process
+    // that flips the wire env between connections gets the new model
+    netem::Registry::inst().refresh();
+    // initial resolution by observed peer address: exact for outgoing conns
+    // (we dialed the canonical endpoint); accepted conns see an ephemeral
+    // source port and land on the ip-wildcard/default until set_wire_peer
+    // re-resolves with the hello's advertised endpoint
+    set_wire_peer(sock_.peer_addr());
+}
+
+void MultiplexConn::set_wire_peer(const Addr &peer) {
+    wire_ = netem::Registry::inst().resolve(peer);
     // under wire emulation, cap the wire chunk: a streamed receiver
     // consumes as frames land, and at WAN rates an 8 MB frame is ~60 ms of
-    // pipeline stall before the first byte of a ring slice can be reduced
-    if (wire_emulated())
-        tx_chunk_ = std::min(tx_chunk_, size_t{256} << 10);
+    // pipeline stall before the first byte of a ring slice can be reduced.
+    // Recomputed from the base on every resolution, so a rekey from an
+    // emulated wildcard to an exempt canonical endpoint restores the full
+    // chunk instead of keeping the cap for the conn's lifetime.
+    tx_chunk_ = wire_->emulated() ? std::min(tx_chunk_base_, size_t{256} << 10)
+                                  : tx_chunk_base_;
 }
 
 MultiplexConn::~MultiplexConn() {
@@ -987,7 +851,7 @@ MultiplexConn::~MultiplexConn() {
 
 void MultiplexConn::run() {
     alive_ = true;
-    cma_ok_ = cma_enabled_env() && !wire_emulated() &&
+    cma_ok_ = cma_enabled_env() && !wire_->emulated() &&
               sock_.peer_is_loopback();
     sock_.set_quickack();
     table_->attach(shared_from_this());
@@ -1094,7 +958,7 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
     // head-of-line-block other frames on the conn. Reordering is safe —
     // within a tag only one thread streams (offsets carried per frame), and
     // the order-sensitive shm announce path is disabled under pacing.
-    WirePacer::inst().pace(21 + payload.size());
+    wire_->pace(21 + payload.size());
     std::lock_guard lk(wr_mu_);
     return sock_.send_all2(hdr, 21, payload.data(), payload.size());
 }
@@ -1684,29 +1548,34 @@ void MultiplexConn::rx_loop() {
                 }
             }
             bool delivered = ok && !cancelled;
-            bool delay = DeliveryDelay::inst().enabled();
+            // per-edge delivery delay: rtt/2 + jitter + drop penalty for
+            // THIS frame on THIS conn's emulated edge (0 = deliver now)
+            uint64_t delay_ns =
+                wire_->delay_enabled() ? wire_->delivery_delay_ns() : 0;
             {
                 std::lock_guard lk(table_->mu_);
                 auto it = table_->sinks_.find(tag);
                 if (it != table_->sinks_.end()) {
                     --it->second.busy;   // buffer write done: release NOW
-                    if (delivered && !delay)
+                    if (delivered && delay_ns == 0)
                         it->second.add_extent(off, off + n);
                 }
             }
-            if (delivered && delay) {
+            if (delivered && delay_ns > 0) {
                 // bytes already landed zero-copy in the sink; only their
                 // VISIBILITY (extent + wakeup) rides the delay line
-                DeliveryDelay::inst().deliver([tbl = table_, tag, off, n] {
-                    {
-                        std::lock_guard lk(tbl->mu_);
-                        auto it = tbl->sinks_.find(tag);
-                        if (it != tbl->sinks_.end() && !it->second.cancel &&
-                            off + n <= it->second.cap)
-                            it->second.add_extent(off, off + n);
-                    }
-                    tbl->signal_tag(tag);
-                });
+                netem::DelayLine::inst().deliver(
+                    delay_ns, [tbl = table_, tag, off, n] {
+                        {
+                            std::lock_guard lk(tbl->mu_);
+                            auto it = tbl->sinks_.find(tag);
+                            if (it != tbl->sinks_.end() &&
+                                !it->second.cancel &&
+                                off + n <= it->second.cap)
+                                it->second.add_extent(off, off + n);
+                        }
+                        tbl->signal_tag(tag);
+                    });
             } else {
                 table_->signal_tag(tag);
             }
@@ -1714,12 +1583,15 @@ void MultiplexConn::rx_loop() {
         } else {
             scratch.resize(n);
             if (n > 0 && !sock_.recv_all(scratch.data(), n)) break;
-            if (DeliveryDelay::inst().enabled()) {
+            uint64_t delay_ns =
+                wire_->delay_enabled() ? wire_->delivery_delay_ns() : 0;
+            if (delay_ns > 0) {
                 // move the payload onto the delay line (scratch is resized
                 // fresh next iteration); the closure re-runs the
                 // sink-or-queue logic at visibility time
                 std::vector<uint8_t> bytes(std::move(scratch));
-                DeliveryDelay::inst().deliver(
+                netem::DelayLine::inst().deliver(
+                    delay_ns,
                     [tbl = table_, tag, off, bytes = std::move(bytes)] {
                         {
                             std::lock_guard lk(tbl->mu_);

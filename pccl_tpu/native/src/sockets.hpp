@@ -58,6 +58,10 @@
 
 namespace pcclt::net {
 
+namespace netem {
+class Edge;  // per-remote-endpoint wire emulation model (netem.hpp)
+}
+
 class Socket {
 public:
     Socket() = default;
@@ -315,6 +319,13 @@ public:
 
     void run(); // spawn RX + TX threads
 
+    // Re-resolve the conn's wire-emulation edge against the peer's
+    // CANONICAL endpoint (its advertised ip + p2p listen port) once the
+    // handshake reveals it — accepted conns only see an ephemeral source
+    // port, which can never match a per-endpoint map entry. Call before
+    // run() so the CMA/zero-copy gate sees the final emulation state.
+    void set_wire_peer(const Addr &peer);
+
     // Async TX. The payload span must stay valid and unmodified until the
     // returned handle completes. allow_cma lets same-host transfers go
     // through the CMA descriptor path.
@@ -396,6 +407,9 @@ private:
 
     Socket sock_;
     std::shared_ptr<SinkTable> table_;
+    // wire-emulation edge for this conn's remote endpoint; shared by every
+    // conn to the same endpoint (one bucket per edge). Never null.
+    std::shared_ptr<netem::Edge> wire_;
     std::thread rx_thread_, tx_thread_;
     std::atomic<bool> alive_{false};
     std::atomic<bool> closing_{false};
@@ -442,7 +456,8 @@ private:
     std::map<uint64_t, ShmMap> shm_maps_;
     std::vector<ShmMap> shm_zombies_;
 
-    size_t tx_chunk_;
+    size_t tx_chunk_;       // active wire chunk (capped on emulated edges)
+    size_t tx_chunk_base_;  // env-configured chunk, pre-cap
     size_t cma_min_;
 };
 

@@ -572,6 +572,105 @@ void test_wire_pacing() {
     fprintf(stderr, "wire pacing: ok (%.0f ms for 4 MB @ 25 MB/s)\n", s * 1e3);
 }
 
+void test_wire_per_edge() {
+    // Per-edge emulation (netem.hpp): ONE process models a heterogeneous
+    // mesh. The map keys the connector's egress by the listener's endpoint;
+    // the reverse direction has no entry and stays free — asymmetry the old
+    // process-global pacer could not express.
+    auto accepted = std::make_shared<std::atomic<bool>>(false);
+    auto accepted_sock = std::make_shared<net::Socket>();
+    net::Listener listener;
+    CHECK(listener.listen(0, 1, /*loopback_only=*/true));
+    listener.run_async([accepted, accepted_sock](net::Socket s) {
+        *accepted_sock = std::move(s);
+        accepted->store(true);
+    });
+    // 100 Mbit/s toward the listener port + toward a second (canonical)
+    // endpoint used to exercise set_wire_peer re-resolution below. Set
+    // BEFORE the conns construct: the registry re-reads env per conn.
+    // 1009 is a privileged port, outside any sane ip_local_port_range
+    // (this CI container uses 16000-65535, stock Linux 32768-60999), so
+    // the accepted conn's kernel-assigned source port can never collide
+    // with the canonical-endpoint map key.
+    char map[128];
+    snprintf(map, sizeof map, "127.0.0.1:%u=100,127.0.0.1:1009=100",
+             listener.port());
+    setenv("PCCLT_WIRE_MBPS_MAP", map, 1);
+    net::Socket c;
+    CHECK(c.connect(net::Addr{127u << 24 | 1, listener.port()}, 5000));
+    for (int i = 0; i < 500 && !accepted->load(); ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    CHECK(accepted->load());
+    listener.stop();
+    auto ta = std::make_shared<net::SinkTable>();
+    auto tb = std::make_shared<net::SinkTable>();
+    auto a = std::make_shared<net::MultiplexConn>(std::move(c), ta);
+    auto b = std::make_shared<net::MultiplexConn>(std::move(*accepted_sock), tb);
+    ta->attach(a);
+    tb->attach(b);
+    a->run();
+    b->run();
+
+    CHECK(!a->cma_eligible()); // a's edge is emulated: zero-copy defeated
+    CHECK(b->cma_eligible());  // b's edge (ephemeral peer port) is free
+
+    const size_t n = 2 * 1024 * 1024; // 2 MB @ 12.5 MB/s = 160 ms minimum
+    auto data = pattern(n, 31);
+    std::vector<uint8_t> dst(n, 0);
+    tb->register_sink(40, dst.data(), n);
+    auto t0 = std::chrono::steady_clock::now();
+    CHECK(a->send_bytes(40, data, /*allow_cma=*/true));
+    CHECK(tb->wait_filled(40, n, 10'000) == n);
+    double slow_s = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - t0).count();
+    tb->unregister_sink(40);
+    CHECK(dst == data);
+    CHECK(slow_s >= 0.140);
+    CHECK(slow_s < 2.0);
+
+    // reverse direction: unconstrained — must be far under the paced time.
+    // Best of 3: a single 2 MB loopback pass can eat a ~200 ms scheduler
+    // stall on a loaded 2-core host, which is NOT the pacing under test.
+    double fast_s = 1e9;
+    for (int rep = 0; rep < 3; ++rep) {
+        std::vector<uint8_t> dst2(n, 0);
+        uint64_t tag = 41 + 100 * rep;
+        ta->register_sink(tag, dst2.data(), n);
+        t0 = std::chrono::steady_clock::now();
+        CHECK(b->send_bytes(tag, data, /*allow_cma=*/false));
+        CHECK(ta->wait_filled(tag, n, 10'000) == n);
+        fast_s = std::min(fast_s,
+                          std::chrono::duration<double>(
+                              std::chrono::steady_clock::now() - t0).count());
+        ta->unregister_sink(tag);
+        CHECK(dst2 == data);
+    }
+    CHECK(fast_s < slow_s / 2.0);
+
+    // set_wire_peer re-keys b by a "canonical" endpoint with a map entry
+    // (what the P2P hello does for accepted conns): b's egress now paces
+    CHECK(b->socket().peer_addr().port != 1009); // ephemeral != canonical
+    b->set_wire_peer(net::Addr{127u << 24 | 1, 1009});
+    std::vector<uint8_t> dst3(n, 0);
+    ta->register_sink(42, dst3.data(), n);
+    t0 = std::chrono::steady_clock::now();
+    CHECK(b->send_bytes(42, data, /*allow_cma=*/false));
+    CHECK(ta->wait_filled(42, n, 10'000) == n);
+    double rekeyed_s = std::chrono::duration<double>(
+                           std::chrono::steady_clock::now() - t0).count();
+    ta->unregister_sink(42);
+    CHECK(dst3 == data);
+    CHECK(rekeyed_s >= 0.140);
+
+    a->close();
+    b->close();
+    unsetenv("PCCLT_WIRE_MBPS_MAP");
+    fprintf(stderr,
+            "wire per-edge: ok (paced %.0f ms / free %.0f ms / rekeyed "
+            "%.0f ms for 2 MB @ 12.5 MB/s)\n",
+            slow_s * 1e3, fast_s * 1e3, rekeyed_s * 1e3);
+}
+
 } // namespace
 
 int main() {
@@ -587,6 +686,7 @@ int main() {
     test_shm_zero_copy_paths();
     test_link_striping();
     test_wire_pacing();
+    test_wire_per_edge();
     test_bench_probe();
     if (failures) {
         fprintf(stderr, "SOCKTEST FAILED (%d checks)\n", failures);

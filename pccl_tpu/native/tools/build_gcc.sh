@@ -1,0 +1,28 @@
+#!/bin/bash
+# Direct g++ build mirroring CMakeLists.txt, for hosts without cmake/ninja.
+# Usage: bash pccl_tpu/native/tools/build_gcc.sh   (artifacts land in native/build/)
+set -e
+cd "$(dirname "$0")/.."  # pccl_tpu/native
+SRC=src
+OUT=build
+CXX=${CXX:-g++}
+FLAGS="-std=c++20 -O3 -g -fPIC -Wall -Wextra -Wno-unused-parameter -fopenmp-simd -Iinclude -pthread"
+EXTRA_FLAGS="${PCCLT_BUILD_FLAGS:-}"
+mkdir -p $OUT/obj
+objs=""
+for f in log guarded_alloc wire shm sockets netem protocol hash hash_clmul kernels kernels_avx2 quantize bandwidth atsp benchmark master_state master client reduce api; do
+  [ -f $SRC/$f.cpp ] || continue
+  arch=""
+  [ "$f" = kernels_avx2 ] && arch="-mavx2"
+  [ "$f" = hash_clmul ] && arch="-mpclmul -msse4.1"
+  if [ $SRC/$f.cpp -nt $OUT/obj/$f.o ] || [ -n "$FORCE" ]; then
+    echo "CXX $f.cpp"
+    $CXX $FLAGS $EXTRA_FLAGS $arch -c $SRC/$f.cpp -o $OUT/obj/$f.o &
+  fi
+  objs="$objs $OUT/obj/$f.o"
+done
+wait
+$CXX -shared $FLAGS $EXTRA_FLAGS -o $OUT/libpcclt.so $objs
+$CXX $FLAGS $EXTRA_FLAGS -Isrc -o $OUT/pcclt_selftest $SRC/selftest.cpp -L$OUT -lpcclt -Wl,-rpath,'$ORIGIN'
+$CXX $FLAGS $EXTRA_FLAGS -Isrc -o $OUT/pcclt_socktest $SRC/socktest.cpp -L$OUT -lpcclt -Wl,-rpath,'$ORIGIN'
+echo "build ok"

@@ -333,6 +333,93 @@ def test_wan_rtt_windowing_wins():
         f"vs windowed {r['wan_rtt_windowed_busbw_gbps']:.3f} GB/s)")
 
 
+def test_topology_opt_wins():
+    """The reference's headline capability, proven end to end: on a
+    heterogeneous emulated mesh (per-edge netem models via
+    PCCLT_WIRE_*_MAP — every edge 200 Mbit/s except the pessimal 0<->1
+    pair at 25 Mbit/s + 60 ms RTT, with peers joining in rank order so
+    the naive ring provably crosses it), ``optimize_topology()``'s
+    bandwidth probes measure the emulated edges, the ATSP solve adopts a
+    ring that routes around the degraded link, and the all-reduce step
+    time drops. One slow edge gates the whole lockstep ring (the premise
+    of arxiv 2606.01680), so the measured win is large (~4x on this
+    host); the floor is 1.25x to ride out suite load. The second
+    optimize (moonshot adoption) must hold the win."""
+    from pccl_tpu.comm.native_bench import run_topology_opt_bench
+
+    # own master port + band (base 2000 -> derived 2000-4012), below every
+    # other band so this test can run while bench.py exercises the same
+    # helper on its 5000-7012 default band
+    r = run_topology_opt_bench(master_port=48717, port_base=2000)
+    speedup = r["topology_opt_speedup"]
+    assert speedup > 1.25, (
+        f"optimized ring only {speedup:.2f}x the naive ring on the "
+        f"heterogeneous mesh (naive {r['topology_naive_step_s']:.2f}s vs "
+        f"opt {r['topology_opt_step_s']:.2f}s)")
+    speedup2 = r["topology_naive_step_s"] / r["topology_opt2_step_s"]
+    assert speedup2 > 1.25, (
+        f"second optimize (moonshot adoption) lost the win: "
+        f"{speedup2:.2f}x vs first-optimize {speedup:.2f}x "
+        f"(opt {r['topology_opt_step_s']:.2f}s -> "
+        f"opt2 {r['topology_opt2_step_s']:.2f}s)")
+
+
+def test_wire_model_map_parsing(monkeypatch):
+    """Unit tests for the per-edge wire-model resolution
+    (pccltWireModelQuery -> netem::Registry): exact-endpoint entries,
+    bare-ip wildcard with per-field fallback, globals as defaults,
+    malformed entries skipped without poisoning their neighbors, and
+    per-conn refresh (env re-read on every query/connection)."""
+    import ctypes
+
+    from pccl_tpu.comm import _native
+
+    lib = _native.load()
+
+    def query(ip, port):
+        vals = [ctypes.c_double() for _ in range(4)]
+        rc = lib.pccltWireModelQuery(ip.encode(), port, *vals)
+        assert rc == 0
+        return tuple(v.value for v in vals)  # (mbps, rtt_ms, jitter, drop)
+
+    # exact entry wins over wildcard; unlisted fields fall to globals
+    monkeypatch.setenv("PCCLT_WIRE_MBPS_MAP",
+                       "127.0.0.1:7001=25,127.0.0.1=200")
+    monkeypatch.setenv("PCCLT_WIRE_RTT_MS_MAP", "127.0.0.1:7001=80")
+    monkeypatch.setenv("PCCLT_WIRE_MBPS", "100")
+    monkeypatch.setenv("PCCLT_WIRE_RTT_MS", "10")
+    assert query("127.0.0.1", 7001) == (25.0, 80.0, 0.0, 0.0)
+    # wildcard match: mbps from the ip entry, rtt from the global default
+    assert query("127.0.0.1", 7002) == (200.0, 10.0, 0.0, 0.0)
+    # no map match at all: the globals (legacy process-wide behavior)
+    assert query("10.1.2.3", 1234) == (100.0, 10.0, 0.0, 0.0)
+
+    # malformed entries are skipped; the valid neighbors still apply
+    monkeypatch.setenv(
+        "PCCLT_WIRE_MBPS_MAP",
+        "garbage,=5,x=,127.0.0.1:7001=nan,127.0.0.1:7001=50, 127.0.0.1:7003=75 ,a=b=3")
+    assert query("127.0.0.1", 7001)[0] == 50.0
+    assert query("127.0.0.1", 7003)[0] == 75.0   # spaces trimmed
+    # 'a=b=3' splits on the LAST '=': key 'a=b' is valid-but-unmatched,
+    # never a crash
+    assert query("10.9.9.9", 1)[0] == 100.0
+
+    # per-conn refresh: dropping the maps reverts resolution to globals...
+    monkeypatch.delenv("PCCLT_WIRE_MBPS_MAP")
+    monkeypatch.delenv("PCCLT_WIRE_RTT_MS_MAP")
+    assert query("127.0.0.1", 7001) == (100.0, 10.0, 0.0, 0.0)
+    # ...and dropping the globals turns emulation off entirely
+    monkeypatch.delenv("PCCLT_WIRE_MBPS")
+    monkeypatch.delenv("PCCLT_WIRE_RTT_MS")
+    assert query("127.0.0.1", 7001) == (0.0, 0.0, 0.0, 0.0)
+
+    # jitter/drop maps resolve the same way (v6 keys carry brackets)
+    monkeypatch.setenv("PCCLT_WIRE_JITTER_MS_MAP", "[::1]:7001=5")
+    monkeypatch.setenv("PCCLT_WIRE_DROP_MAP", "[::1]=0.01")
+    assert query("::1", 7001)[2:] == (5.0, 0.01)
+    assert query("::1", 7002)[2:] == (0.0, 0.01)
+
+
 def test_ipv6_loopback_reduce(master):
     """2-peer SUM all-reduce entirely over ::1: the clients dial the master
     over v6 (dual-stack listener), the master observes their v6 source
