@@ -42,6 +42,11 @@ def main() -> None:
         busbw = stats["med"]
         extra["headline_gbps_minmax"] = [round(stats["min"], 3),
                                          round(stats["max"], 3)]
+        # flight-recorder phase breakdown for the headline op (mean per
+        # reduce, seconds): where a regression lives — ring phases vs
+        # wire-stall (docs/09_observability.md)
+        if "phases" in stats:
+            extra["allreduce_phases_s"] = stats["phases"]
         path = "native"
     except Exception as e:  # noqa: BLE001 — fall back to pure-python path
         print(f"bench: native path unavailable ({type(e).__name__}: {e}); "

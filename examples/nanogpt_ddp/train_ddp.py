@@ -126,11 +126,15 @@ def main() -> int:
         return optax.apply_updates(params, updates), opt_state
 
     # --- cross-slice gradient averaging ---
-    # params serve as the gradient template: same shapes/dtypes/shardings
-    ring = HierarchicalAllReduce(comm, params,
-                                 quantization=common.quant_from_arg(args.quantize),
-                                 quantized_dtype=DataType.UINT8,
-                                 shm_staging=args.shm_staging)
+    # params serve as the gradient template: same shapes/dtypes/shardings.
+    # Factory, not a one-off: a KickedError recovery below reconnects and
+    # needs a ring bound to the fresh communicator.
+    def make_ring():
+        return HierarchicalAllReduce(
+            comm, params, quantization=common.quant_from_arg(args.quantize),
+            quantized_dtype=DataType.UINT8, shm_staging=args.shm_staging)
+
+    ring = make_ring()
 
     from pccl_tpu.utils.profiler import Profiler
 
@@ -196,22 +200,46 @@ def main() -> int:
     # model+optimizer in the pccl shared state and syncs every step) ---
     # The DDP invariant is IDENTICAL params on every peer; topology alone
     # cannot keep it — a late joiner starts from seed params and a
-    # checkpoint-resumed peer from its snapshot. Revision = STEP, so the
-    # bootstrap election deterministically favors the furthest-trained
-    # offer (a resumed peer's progress can never lose a content tie to a
-    # seed model), and syncing once per trained step keeps the master's
-    # strict one-increment rule naturally. Cost note: without
-    # PCCLT_SS_HASH=simple-tpu the hash compare stages every leaf to the
-    # host each step — fine for example scale; TPU deployments set the
-    # env var group-wide so clean syncs ship 8 bytes per entry instead
-    # (pccl_tpu.ops.hashing, TensorInfo.from_jax_device).
+    # checkpoint-resumed peer from its snapshot. The sync REVISION is the
+    # master's strict one-increment counter, NOT the step: after the first
+    # sync every peer offers info.revision + 1, and the step consensus
+    # rides in the "ddp.step" entry. Revision equals step only on the
+    # common path (a cohort that started together), and the first offer
+    # depends on how this peer came up:
+    #  * fresh start — offer revision 0 (a late joiner's 0 can never trip
+    #    the master's `revision > last+1` kick; if the cohort is ahead the
+    #    mismatch marks us outdated and we adopt params/opt/step below);
+    #  * checkpoint resume into a possibly-initialized cohort — offering
+    #    the snapshot step would be revision last+2-or-more and the master
+    #    KICKS for it ("shared-state revision increment violation"; before
+    #    this fix the retry loop below then spun forever on the dead
+    #    conn). The first sync is instead a probe at revision 0 — in
+    #    receive-only SPIRIT, but declared ENFORCE_POPULAR because the
+    #    master's all-or-nothing mixing rule (reference parity) kicks a
+    #    literal rx-only request alongside enforce-popular incumbents. A
+    #    revision-0 enforce-popular offer is never kickable (0 <= last+1
+    #    always) and never wins an election against revision-matched
+    #    incumbents, so against an initialized cohort it degenerates to
+    #    "adopt their params/opt/step"; in a whole-cohort restart (every
+    #    member probing at 0) the popularity election converges everyone
+    #    onto one checkpoint's content instead of kicking the round;
+    #  * checkpoint resume running solo (world 1) — offer the snapshot
+    #    step; the fresh master bootstraps at any first revision.
+    # Cost note: without PCCLT_SS_HASH=simple-tpu the hash compare stages
+    # every leaf to the host each step — fine for example scale; TPU
+    # deployments set the env var group-wide so clean syncs ship 8 bytes
+    # per entry instead (pccl_tpu.ops.hashing, TensorInfo.from_jax_device).
     import os as _os
 
-    from pccl_tpu.comm import PcclError, SharedState, TensorInfo
+    from pccl_tpu.comm import (KickedError, PcclError, SharedState,
+                               SharedStateSyncStrategy, TensorInfo)
 
     _mk = (TensorInfo.from_jax_device
            if _os.environ.get("PCCLT_SS_HASH") == "simple-tpu"
            else TensorInfo.from_jax)
+
+    sync_ctl = {"next_revision": None,  # None until the first sync lands
+                "probe": start > 0}     # resumed: rx-only@0 first (see above)
 
     def sync_state(params, opt_state, step):
         leaves_p, tdef_p = jax.tree.flatten(params)
@@ -220,23 +248,36 @@ def main() -> int:
         entries = ([_mk(f"ddp.p{i}", l) for i, l in enumerate(leaves_p)]
                    + [_mk(f"ddp.o{i}", l) for i, l in enumerate(leaves_o)]
                    + [TensorInfo.from_numpy("ddp.step", step_arr)])
-        st = SharedState(entries, revision=step)
+        probe = sync_ctl["probe"] and comm.world_size >= 2
+        if probe:
+            revision = 0  # adopt-the-cohort probe (see the comment above)
+        else:
+            revision = (sync_ctl["next_revision"]
+                        if sync_ctl["next_revision"] is not None else step)
+        strategy = SharedStateSyncStrategy.ENFORCE_POPULAR
+        st = SharedState(entries, revision=revision)
         # churn mid-election: retry at the SAME revision until the survivor
         # group elects (grid_diloco.py's sync_with_retry contract). Training
-        # through a failed sync would increment step and offer
-        # last_revision + 2 next round — the master kicks the whole cohort
-        # for that ("shared-state revision increment violation").
+        # through a failed sync would increment the offer and violate the
+        # master's one-increment rule. A kick is terminal for this
+        # communicator — surface it instead of spinning on a dead conn.
         while True:
             try:
-                info = comm.sync_shared_state(st)
+                info = comm.sync_shared_state(st, strategy)
                 break
+            except KickedError:
+                raise
             except PcclError:
                 time.sleep(0.1)
                 try:
                     if comm.are_peers_pending():
                         comm.update_topology()
+                except KickedError:
+                    raise
                 except PcclError:
                     pass
+        sync_ctl["next_revision"] = info.revision + 1
+        sync_ctl["probe"] = False
         if info.rx_bytes:  # outdated: adopt the cohort's state
             n = len(leaves_p)
             params = jax.tree.unflatten(
@@ -252,7 +293,28 @@ def main() -> int:
     while step < args.steps:
         common.admit_pending(comm)
         if comm is not None:
-            params, opt_state, step = sync_state(params, opt_state, step)
+            try:
+                params, opt_state, step = sync_state(params, opt_state, step)
+            except KickedError:
+                # Safety net: a kick is terminal for the communicator (the
+                # old code spun forever retrying on the dead conn). The
+                # probe path above cannot be kicked, but a solo-resumed
+                # peer whose cohort materialized mid-run, or a master-side
+                # policy we did not anticipate, still can. Reconnect and
+                # re-offer revision 0 enforce-popular — never kickable, so
+                # this cannot loop; the election then converges us onto
+                # the cohort's content (incl. its ddp.step).
+                print("kicked during sync; reconnecting with revision-0 "
+                      "enforce-popular offer", flush=True)
+                try:
+                    comm.destroy()
+                except PcclError:
+                    pass
+                comm = common.connect(args)
+                ring = make_ring()
+                sync_ctl["probe"] = False
+                sync_ctl["next_revision"] = 0
+                continue
             if step >= args.steps:
                 break
         tok, tgt = next(feed)

@@ -33,4 +33,8 @@ from .api import (  # noqa: F401
     TooFewPeersError,
     TensorInfo,
     shm_ndarray,
+    trace_clear,
+    trace_dump,
+    trace_enable,
+    trace_events,
 )

@@ -115,6 +115,34 @@ class SharedStateSyncInfo(ctypes.Structure):
     ]
 
 
+class CommStats(ctypes.Structure):
+    _fields_ = [
+        ("collectives_ok", ctypes.c_uint64),
+        ("collectives_aborted", ctypes.c_uint64),
+        ("collectives_connection_lost", ctypes.c_uint64),
+        ("topology_updates", ctypes.c_uint64),
+        ("topology_optimizes", ctypes.c_uint64),
+        ("syncs_ok", ctypes.c_uint64),
+        ("syncs_failed", ctypes.c_uint64),
+        ("sync_hash_mismatches", ctypes.c_uint64),
+        ("kicked", ctypes.c_uint64),
+        ("peers_joined", ctypes.c_uint64),
+        ("peers_left", ctypes.c_uint64),
+    ]
+
+
+class EdgeStats(ctypes.Structure):
+    _fields_ = [
+        ("endpoint", ctypes.c_char * 64),
+        ("tx_bytes", ctypes.c_uint64),
+        ("rx_bytes", ctypes.c_uint64),
+        ("tx_frames", ctypes.c_uint64),
+        ("rx_frames", ctypes.c_uint64),
+        ("connects", ctypes.c_uint64),
+        ("stall_ms", ctypes.c_uint64),
+    ]
+
+
 def _declare(lib):
     c = ctypes
     P = c.POINTER
@@ -184,5 +212,21 @@ def _declare(lib):
         lib.pccltWireModelQuery.argtypes = [c.c_char_p, c.c_uint16,
                                             P(c.c_double), P(c.c_double),
                                             P(c.c_double), P(c.c_double)]
+    except AttributeError:
+        pass
+
+    # flight-recorder telemetry (same older-build tolerance)
+    try:
+        lib.pccltCommGetStats.restype = c.c_int
+        lib.pccltCommGetStats.argtypes = [c.c_void_p, P(CommStats)]
+        lib.pccltCommGetEdgeStats.restype = c.c_int
+        lib.pccltCommGetEdgeStats.argtypes = [c.c_void_p, P(EdgeStats),
+                                              c.c_uint64, P(c.c_uint64)]
+        lib.pccltTraceEnable.restype = c.c_int
+        lib.pccltTraceEnable.argtypes = [c.c_int]
+        lib.pccltTraceClear.restype = c.c_int
+        lib.pccltTraceClear.argtypes = []
+        lib.pccltTraceDump.restype = c.c_int
+        lib.pccltTraceDump.argtypes = [c.c_char_p]
     except AttributeError:
         pass

@@ -194,6 +194,55 @@ def shm_ndarray(shape, dtype=np.float32) -> np.ndarray:
     weakref.finalize(buf, lib.pccltShmFree, ctypes.c_void_p(ptr.value))
     return np.ndarray(shape, dtype=dtype, buffer=buf)
 
+# ---------------------------------------------------- flight-recorder trace
+
+def trace_enable(on: bool = True) -> None:
+    """Toggle the native flight recorder's event capture at runtime
+    (process-global; see docs/09_observability.md). Counters —
+    ``Communicator.stats()`` — are always on; this gates only the event
+    ring feeding ``trace_events()`` / ``trace_dump()``. ``PCCLT_TRACE=path``
+    in the environment enables capture at load and dumps at process exit."""
+    lib = _native.load()
+    _check(lib.pccltTraceEnable(1 if on else 0), "trace enable")
+
+
+def trace_clear() -> None:
+    """Drop every captured event (isolates multi-phase runs sharing one
+    process, e.g. consecutive bench legs)."""
+    lib = _native.load()
+    _check(lib.pccltTraceClear(), "trace clear")
+
+
+def trace_dump(path: str) -> None:
+    """Write the recorder's event ring as Chrome trace-event JSON (load in
+    chrome://tracing or ui.perfetto.dev). Timestamps are CLOCK_MONOTONIC
+    microseconds — merge with Python profiler sections via
+    Profiler.export_chrome_trace(..., native_events=...)."""
+    lib = _native.load()
+    _check(lib.pccltTraceDump(path.encode()), "trace dump")
+
+
+def trace_events() -> list:
+    """The native recorder's current events as a list of Chrome trace-event
+    dicts (the parsed form of trace_dump's output)."""
+    import json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        tmp = f.name
+    try:
+        trace_dump(tmp)
+        with open(tmp) as f:
+            return json.load(f)["traceEvents"]
+    finally:
+        import os
+
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 class MasterNode:
     """Standalone orchestration master (reference: pccl.MasterNode /
     the ccoip_master binary). Control plane only — bulk data never flows
@@ -536,6 +585,48 @@ class Communicator:
 
     def update_topology(self) -> None:
         _check(self._lib.pccltUpdateTopology(self._h), "update topology")
+
+    # -- telemetry --
+
+    def stats(self) -> dict:
+        """Flight-recorder counter snapshot for THIS communicator:
+
+            {"counters": {collectives_ok, collectives_aborted, ...},
+             "edges": {"ip:port": {tx_bytes, rx_bytes, tx_frames,
+                                   rx_frames, connects, stall_ms}, ...}}
+
+        Edge keys are canonical remote endpoints (the peer's advertised
+        p2p listen endpoint — the same key netem's PCCLT_WIRE_*_MAP uses).
+        Counters are monotonic since connect and always on; see
+        docs/09_observability.md for field semantics."""
+        cs = _native.CommStats()
+        _check(self._lib.pccltCommGetStats(self._h, ctypes.byref(cs)), "stats")
+        counters = {name: int(getattr(cs, name)) for name, _ in cs._fields_}
+        n = ctypes.c_uint64()
+        _check(self._lib.pccltCommGetEdgeStats(self._h, None, 0,
+                                               ctypes.byref(n)), "edge stats")
+        edges = {}
+        if n.value:
+            buf = (_native.EdgeStats * n.value)()
+            _check(self._lib.pccltCommGetEdgeStats(self._h, buf, n.value,
+                                                   ctypes.byref(n)),
+                   "edge stats")
+            for i in range(min(n.value, len(buf))):
+                e = buf[i]
+                edges[e.endpoint.decode()] = {
+                    "tx_bytes": int(e.tx_bytes), "rx_bytes": int(e.rx_bytes),
+                    "tx_frames": int(e.tx_frames),
+                    "rx_frames": int(e.rx_frames),
+                    "connects": int(e.connects), "stall_ms": int(e.stall_ms),
+                }
+        return {"counters": counters, "edges": edges}
+
+    def trace_events(self) -> list:
+        """Native flight-recorder events as Chrome trace-event dicts. The
+        recorder is process-global (one ring per process, every comm and
+        the in-process master feed it); exposed here for symmetry with
+        stats(). Enable capture with PCCLT_TRACE=path or trace_enable()."""
+        return trace_events()
 
     def are_peers_pending(self) -> bool:
         out = ctypes.c_int()

@@ -187,8 +187,24 @@ def _connect(rank: int, master_port: int, world: int, port_base: int):
 
 # ---------------------------------------------------------------- config 1
 
+def _phase_breakdown(events, iters: int) -> Dict[str, float]:
+    """Aggregate the flight recorder's per-op events into a mean per-op
+    phase breakdown (seconds): reduce-scatter / all-gather span time plus
+    the wire-stall and quantize accumulators (telemetry.hpp)."""
+    sums: Dict[str, float] = {}
+    for e in events:
+        name, args = e.get("name"), e.get("args", {})
+        if name in ("reduce_scatter", "all_gather", "allreduce", "allgather") \
+                and e.get("ph") == "X":
+            sums[name] = sums.get(name, 0.0) + e.get("dur", 0.0) / 1e6
+        elif name in ("wire_stall", "quantize") and "ns" in args:
+            sums[name] = sums.get(name, 0.0) + args["ns"] / 1e9
+    return {f"{k}_s": round(v / max(1, iters), 6) for k, v in sums.items()}
+
+
 def _peer_allreduce(rank, master_port, q, nbytes, iters, dtype_name, port_base):
-    from pccl_tpu.comm.api import DataType, ReduceOp, shm_ndarray
+    from pccl_tpu.comm.api import (DataType, ReduceOp, shm_ndarray,
+                                   trace_clear, trace_enable, trace_events)
 
     bf16 = dtype_name == "bfloat16"
     dtype = np.uint16 if bf16 else np.dtype(dtype_name)
@@ -202,6 +218,14 @@ def _peer_allreduce(rank, master_port, q, nbytes, iters, dtype_name, port_base):
     y = shm_ndarray(count, dtype)
     wire = DataType.BFLOAT16 if bf16 else None
     comm.all_reduce(x, y, op=ReduceOp.SUM, dtype=wire)  # warmup
+    # rank 0 runs inline in the bench process: enable the flight recorder
+    # for the timed window and pick its events out by timestamp (perf_counter
+    # shares the recorder's CLOCK_MONOTONIC timebase), so a user-requested
+    # PCCLT_TRACE always-on capture is neither cleared nor disabled
+    env_capture = bool(os.environ.get("PCCLT_TRACE"))
+    if rank == 0:
+        t_mark_us = time.perf_counter() * 1e6
+        trace_enable(True)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -209,7 +233,14 @@ def _peer_allreduce(rank, master_port, q, nbytes, iters, dtype_name, port_base):
         times.append(time.perf_counter() - t0)
     expect = 0x4000 if bf16 else 3.0
     assert float(y[0]) == expect, f"allreduce wrong: {y[0]} != {expect}"
-    q.put({"rank": rank, "times": times})
+    res = {"rank": rank, "times": times}
+    if rank == 0:
+        evs = [e for e in trace_events() if e.get("ts", 0) >= t_mark_us]
+        res["phases"] = _phase_breakdown(evs, iters)
+        if not env_capture:
+            trace_enable(False)
+            trace_clear()  # later legs in this process start clean
+    q.put(res)
     comm.destroy()
 
 
@@ -225,12 +256,16 @@ def run_allreduce_bench(nbytes: int = 64 << 20, iters: int = 10,
     regression)."""
     res = _spawn_world(2, _peer_allreduce, _port(port_env, master_port),
                        (nbytes, iters, dtype_name, port_base))
-    times = next(r["times"] for r in res if r["rank"] == 0)
-    gbps = sorted((nbytes / t) / 1e9 for t in times)
+    r0 = next(r for r in res if r["rank"] == 0)
+    gbps = sorted((nbytes / t) / 1e9 for t in r0["times"])
     # (len-1)//2 keeps the same sample the old sorted-times median picked
     # for even iters, so the headline stays comparable across rounds
     stats = {"min": gbps[0], "med": gbps[(len(gbps) - 1) // 2],
              "max": gbps[-1]}
+    # flight-recorder phase breakdown (mean per op): reduce_scatter_s /
+    # all_gather_s span time + wire_stall_s (+ quantize_s when quantized)
+    if "phases" in r0:
+        stats["phases"] = r0["phases"]
     return stats if return_stats else stats["med"]
 
 

@@ -234,6 +234,64 @@ PCCLT_EXPORT pccltResult_t pccltWireModelQuery(const char *ip, uint16_t port,
                                                double *mbps, double *rtt_ms,
                                                double *jitter_ms, double *drop);
 
+/* --- flight-recorder telemetry (pcclt extension) ---
+ *
+ * Monotonic counters are always on (relaxed atomic adds at frame
+ * granularity). The event recorder is off unless PCCLT_TRACE=path is set
+ * in the environment (Chrome-trace JSON dumped to `path` at process exit;
+ * "%p" in the path expands to the pid) or pccltTraceEnable(1) is called. */
+
+typedef struct pccltCommStats_t {
+    /* collectives by final outcome */
+    uint64_t collectives_ok;
+    uint64_t collectives_aborted;
+    uint64_t collectives_connection_lost;
+    /* control-plane rounds */
+    uint64_t topology_updates;
+    uint64_t topology_optimizes;
+    /* shared-state sync outcomes */
+    uint64_t syncs_ok;
+    uint64_t syncs_failed;
+    uint64_t sync_hash_mismatches;
+    /* membership */
+    uint64_t kicked;       /* times THIS peer was kicked */
+    uint64_t peers_joined; /* ring additions observed (self excluded) */
+    uint64_t peers_left;   /* ring departures observed */
+} pccltCommStats_t;
+
+typedef struct pccltEdgeStats_t {
+    char endpoint[64];  /* canonical remote endpoint "ip:port" (netem key) */
+    uint64_t tx_bytes;  /* data payload bytes sent (TCP streamed or CMA) */
+    uint64_t rx_bytes;  /* data payload bytes received */
+    uint64_t tx_frames; /* data sends (frames / same-host descriptors) */
+    uint64_t rx_frames;
+    uint64_t connects;  /* connections established on this edge */
+    uint64_t stall_ms;  /* receiver wire-stall charged to this edge */
+} pccltEdgeStats_t;
+
+/* Snapshot this communicator's counters. */
+PCCLT_EXPORT pccltResult_t pccltCommGetStats(pccltComm_t *c,
+                                             pccltCommStats_t *out);
+
+/* Snapshot per-edge counters. Writes up to `cap` entries into `out` and
+ * always stores the TOTAL edge count into *count (call with cap=0 to size
+ * the buffer). */
+PCCLT_EXPORT pccltResult_t pccltCommGetEdgeStats(pccltComm_t *c,
+                                                 pccltEdgeStats_t *out,
+                                                 uint64_t cap, uint64_t *count);
+
+/* Toggle the process-global event recorder at runtime. */
+PCCLT_EXPORT pccltResult_t pccltTraceEnable(int on);
+
+/* Drop every captured event (isolates multi-phase runs in one process). */
+PCCLT_EXPORT pccltResult_t pccltTraceClear(void);
+
+/* Write the recorder's current event ring as Chrome trace-event JSON
+ * (chrome://tracing, ui.perfetto.dev). path NULL falls back to the
+ * PCCLT_TRACE env value; with neither set, returns InvalidArgument.
+ * Timestamps are CLOCK_MONOTONIC microseconds. */
+PCCLT_EXPORT pccltResult_t pccltTraceDump(const char *path);
+
 #ifdef __cplusplus
 }
 #endif

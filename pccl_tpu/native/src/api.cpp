@@ -2,6 +2,7 @@
 // Reference parity: src/pccl.cpp (validation + enum translation over CCoIP).
 #include "../include/pcclt.h"
 
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <string>
@@ -13,6 +14,7 @@
 #include "master.hpp"
 #include "netem.hpp"
 #include "shm.hpp"
+#include "telemetry.hpp"
 
 using pcclt::client::Client;
 using pcclt::client::ClientConfig;
@@ -339,6 +341,63 @@ pccltResult_t pccltWireModelQuery(const char *ip, uint16_t port, double *mbps,
     if (jitter_ms) *jitter_ms = params.jitter_ms;
     if (drop) *drop = params.drop;
     return pccltSuccess;
+}
+
+pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
+    if (!c || !out) return pccltInvalidArgument;
+    const auto &m = c->client->tele().comm;
+    auto ld = [](const std::atomic<uint64_t> &a) {
+        return a.load(std::memory_order_relaxed);
+    };
+    out->collectives_ok = ld(m.collectives_ok);
+    out->collectives_aborted = ld(m.collectives_aborted);
+    out->collectives_connection_lost = ld(m.collectives_lost);
+    out->topology_updates = ld(m.topology_updates);
+    out->topology_optimizes = ld(m.topology_optimizes);
+    out->syncs_ok = ld(m.syncs_ok);
+    out->syncs_failed = ld(m.syncs_failed);
+    out->sync_hash_mismatches = ld(m.sync_hash_mismatches);
+    out->kicked = ld(m.kicked);
+    out->peers_joined = ld(m.peers_joined);
+    out->peers_left = ld(m.peers_left);
+    return pccltSuccess;
+}
+
+pccltResult_t pccltCommGetEdgeStats(pccltComm_t *c, pccltEdgeStats_t *out,
+                                    uint64_t cap, uint64_t *count) {
+    if (!c || !count || (cap && !out)) return pccltInvalidArgument;
+    auto edges = c->client->tele().snapshot_edges();
+    *count = edges.size();
+    for (uint64_t i = 0; i < cap && i < edges.size(); ++i) {
+        auto &e = edges[i];
+        auto &o = out[i];
+        snprintf(o.endpoint, sizeof o.endpoint, "%s", e.endpoint.c_str());
+        o.tx_bytes = e.tx_bytes;
+        o.rx_bytes = e.rx_bytes;
+        o.tx_frames = e.tx_frames;
+        o.rx_frames = e.rx_frames;
+        o.connects = e.conns;
+        o.stall_ms = e.stall_ns / 1000000;
+    }
+    return pccltSuccess;
+}
+
+pccltResult_t pccltTraceEnable(int on) {
+    pcclt::telemetry::Recorder::inst().enable(on != 0);
+    return pccltSuccess;
+}
+
+pccltResult_t pccltTraceClear(void) {
+    pcclt::telemetry::Recorder::inst().clear();
+    return pccltSuccess;
+}
+
+pccltResult_t pccltTraceDump(const char *path) {
+    std::string p = path ? std::string(path)
+                         : pcclt::telemetry::Recorder::env_trace_path();
+    if (p.empty()) return pccltInvalidArgument;
+    return pcclt::telemetry::Recorder::inst().dump_json(p) ? pccltSuccess
+                                                           : pccltInternalError;
 }
 
 pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *state,

@@ -101,7 +101,8 @@ void Client::on_p2p_accept(net::Socket sock) {
             if (!pc.rx_table) pc.rx_table = std::make_shared<net::SinkTable>();
             table = pc.rx_table;
         }
-        auto conn = std::make_shared<net::MultiplexConn>(std::move(sock), table);
+        auto conn = std::make_shared<net::MultiplexConn>(std::move(sock), table,
+                                                         tele_);
         fd->store(-1); // handed off: the conn owns the fd now
         if (peer_p2p_port != 0) {
             // canonical peer endpoint = observed source ip + advertised p2p
@@ -296,6 +297,11 @@ Status Client::check_kicked() {
             reason = r.str();
         } catch (...) {}
         PLOG(kError) << "kicked by master: " << reason;
+        tele_->comm.kicked.fetch_add(1, std::memory_order_relaxed);
+        if (telemetry::Recorder::inst().on())
+            telemetry::Recorder::inst().instant(
+                "membership", "kicked", nullptr, 0, nullptr, 0,
+                telemetry::intern(reason));
         connected_ = false;
         return Status::kKicked;
     }
@@ -358,7 +364,8 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
                 ok = false;
                 break;
             }
-            auto conn = std::make_shared<net::MultiplexConn>(std::move(s), table);
+            auto conn = std::make_shared<net::MultiplexConn>(std::move(s), table,
+                                                             tele_);
             conn->set_wire_peer(pa); // canonical endpoint (= the addr dialed)
             conn->run();
             pool.push_back(conn);
@@ -396,9 +403,28 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
 }
 
 void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid> &ring) {
-    std::lock_guard lk(state_mu_);
-    ring_ = ring;
-    topo_revision_ = info.revision;
+    size_t joined = 0, left = 0;
+    {
+        std::lock_guard lk(state_mu_);
+        // membership churn counters: ring delta vs the previous adoption
+        // (self excluded — it is not a peer)
+        for (const auto &u : ring)
+            if (u != uuid_ &&
+                std::find(ring_.begin(), ring_.end(), u) == ring_.end())
+                ++joined;
+        for (const auto &u : ring_)
+            if (u != uuid_ &&
+                std::find(ring.begin(), ring.end(), u) == ring.end())
+                ++left;
+        ring_ = ring;
+        topo_revision_ = info.revision;
+    }
+    tele_->comm.peers_joined.fetch_add(joined, std::memory_order_relaxed);
+    tele_->comm.peers_left.fetch_add(left, std::memory_order_relaxed);
+    if (telemetry::Recorder::inst().on())
+        telemetry::Recorder::inst().instant("membership", "topology_adopt",
+                                            "world", ring.size(), "revision",
+                                            info.revision);
 }
 
 Status Client::establish_loop(bool vote_deferrable) {
@@ -474,7 +500,15 @@ Status Client::establish_loop(bool vote_deferrable) {
 Status Client::update_topology() {
     if (!connected_.load()) return Status::kNotConnected;
     if (!master_.send(PacketType::kC2MTopologyUpdate, {})) return Status::kConnectionLost;
-    return establish_loop(/*vote_deferrable=*/true);
+    auto t0 = telemetry::now_ns();
+    Status st = establish_loop(/*vote_deferrable=*/true);
+    if (st == Status::kOk) {
+        tele_->comm.topology_updates.fetch_add(1, std::memory_order_relaxed);
+        telemetry::Recorder::inst().span("membership", "update_topology", t0,
+                                         telemetry::now_ns(), "world",
+                                         group_world());
+    }
+    return st;
 }
 
 Status Client::are_peers_pending(bool &pending) {
@@ -514,6 +548,13 @@ Status Client::optimize_topology() {
                 if (ok) {
                     std::lock_guard lk(state_mu_);
                     ring_ = ring;
+                }
+                if (ok) {
+                    tele_->comm.topology_optimizes.fetch_add(
+                        1, std::memory_order_relaxed);
+                    telemetry::Recorder::inst().instant(
+                        "membership", "optimize_topology", "world",
+                        group_world());
                 }
                 return ok ? Status::kOk : Status::kInternal;
             } catch (...) { return Status::kInternal; }
@@ -755,6 +796,17 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         ctx.quant = desc.quant;
         ctx.q_dtype = desc.quant_dtype;
         ctx.backup = snapshot.empty() ? nullptr : snapshot.data();
+        {
+            // receiver wire-stall is charged to the inbound edge: the ring
+            // predecessor's canonical endpoint (the netem/telemetry key)
+            std::lock_guard lk(state_mu_);
+            auto it = peers_.find(prev);
+            if (it != peers_.end()) {
+                net::Addr pa = it->second.ep.ip;
+                pa.port = it->second.ep.p2p_port;
+                ctx.rx_edge = &tele_->edge(pa.str());
+            }
+        }
         auto scratch = take_scratch();
         ctx.scratch = &scratch;
         ctx.should_abort = [&]() -> bool {
@@ -861,6 +913,13 @@ Status Client::await_reduce(uint64_t tag, ReduceInfo *info) {
     }
     Status st = op->result.get();
     if (info) *info = op->info;
+    // single accounting point: every collective's final status funnels
+    // through here (blocking all_reduce included)
+    auto &c = tele_->comm;
+    if (st == Status::kOk) c.collectives_ok.fetch_add(1, std::memory_order_relaxed);
+    else if (st == Status::kAborted)
+        c.collectives_aborted.fetch_add(1, std::memory_order_relaxed);
+    else c.collectives_lost.fetch_add(1, std::memory_order_relaxed);
     return st;
 }
 
@@ -876,6 +935,21 @@ Status Client::all_reduce(const void *send, void *recv, uint64_t count,
 Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy,
                                  const std::vector<SharedStateEntry> &entries,
                                  SyncInfo *info) {
+    // telemetry wrapper: one accounting + trace point for every exit path
+    auto t0 = telemetry::now_ns();
+    Status st = sync_shared_state_impl(revision, strategy, entries, info);
+    auto &c = tele_->comm;
+    if (st == Status::kOk) c.syncs_ok.fetch_add(1, std::memory_order_relaxed);
+    else c.syncs_failed.fetch_add(1, std::memory_order_relaxed);
+    telemetry::Recorder::inst().span("membership", "shared_state_sync", t0,
+                                     telemetry::now_ns(), "revision", revision,
+                                     "status", static_cast<uint64_t>(st));
+    return st;
+}
+
+Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy strategy,
+                                      const std::vector<SharedStateEntry> &entries,
+                                      SyncInfo *info) {
     if (!connected_.load()) return Status::kNotConnected;
 
     // open the distribution window (we may be elected distributor)
@@ -1010,8 +1084,16 @@ Status Client::sync_shared_state(uint64_t revision, proto::SyncStrategy strategy
                                     if (resp->outdated_keys[k] != name) continue;
                                     uint64_t h = hash::content_hash(
                                         hash_type, target->data, nbytes);
-                                    if (h != resp->expected_hashes[k])
+                                    if (h != resp->expected_hashes[k]) {
                                         st = Status::kContentMismatch;
+                                        tele_->comm.sync_hash_mismatches
+                                            .fetch_add(1,
+                                                       std::memory_order_relaxed);
+                                        telemetry::Recorder::inst().instant(
+                                            "membership", "sync_hash_mismatch",
+                                            "revision", resp->revision, nullptr,
+                                            0, telemetry::intern(name));
+                                    }
                                 }
                             }
                         }

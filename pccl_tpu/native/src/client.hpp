@@ -22,6 +22,7 @@
 #include "pool.hpp"
 #include "protocol.hpp"
 #include "sockets.hpp"
+#include "telemetry.hpp"
 
 namespace pcclt::client {
 
@@ -122,6 +123,11 @@ public:
     Status sync_shared_state(uint64_t revision, proto::SyncStrategy strategy,
                              const std::vector<SharedStateEntry> &entries,
                              SyncInfo *info);
+private:
+    Status sync_shared_state_impl(uint64_t revision, proto::SyncStrategy strategy,
+                                  const std::vector<SharedStateEntry> &entries,
+                                  SyncInfo *info);
+public:
 
     uint32_t global_world() const;
     uint32_t group_world() const;
@@ -129,6 +135,11 @@ public:
     uint32_t largest_group() const;
     const proto::Uuid &uuid() const { return uuid_; }
     bool connected() const { return connected_.load(); }
+
+    // Flight-recorder counter domain for this communicator: comm-level
+    // outcome counters + per-edge byte/stall counters (telemetry.hpp).
+    // Shared with every MultiplexConn this client creates.
+    telemetry::Domain &tele() { return *tele_; }
 
 private:
     struct PeerConns {
@@ -169,6 +180,8 @@ private:
     ClientConfig cfg_;
     proto::Uuid uuid_{};
     std::atomic<bool> connected_{false};
+    std::shared_ptr<telemetry::Domain> tele_ =
+        std::make_shared<telemetry::Domain>();
 
     net::ControlClient master_;
     net::Listener p2p_listener_, ss_listener_, bench_listener_;

@@ -6,6 +6,7 @@
 #include "atsp.hpp"
 #include "log.hpp"
 #include "sockets.hpp"
+#include "telemetry.hpp"
 
 namespace pcclt::master {
 
@@ -70,6 +71,10 @@ std::vector<Uuid> MasterState::build_ring(uint32_t group) {
 
 void MasterState::kick(std::vector<Outbox> &out, ClientInfo &c, const std::string &reason) {
     PLOG(kWarn) << "kicking client " << proto::uuid_str(c.uuid) << ": " << reason;
+    if (telemetry::Recorder::inst().on())
+        telemetry::Recorder::inst().instant("membership", "master_kick",
+                                            "group", c.peer_group, nullptr, 0,
+                                            telemetry::intern(reason));
     wire::Writer w;
     w.str(reason);
     out.push_back({c.conn_id, PacketType::kM2CKicked, w.take()});
@@ -116,6 +121,9 @@ std::vector<Outbox> MasterState::on_hello(uint64_t conn, const net::Addr &src_ip
     clients_[conn] = c;
     PLOG(kInfo) << "client " << proto::uuid_str(c.uuid) << " joined (pending), group "
                 << c.peer_group << ", world=" << world_size();
+    telemetry::Recorder::inst().instant("membership", "master_join_pending",
+                                        "group", c.peer_group, "world",
+                                        world_size());
 
     wire::Writer w;
     w.u8(1);
@@ -278,6 +286,10 @@ void MasterState::check_establish(std::vector<Outbox> &out) {
         }
         PLOG(kInfo) << "topology round " << topology_revision_ << " complete, world="
                     << world_size();
+        telemetry::Recorder::inst().instant("membership",
+                                            "master_topology_complete",
+                                            "revision", topology_revision_,
+                                            "world", world_size());
     } else {
         // kick unreachable peers; everyone else retries
         std::vector<ClientInfo *> to_kick;
@@ -472,7 +484,12 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
 
     // strategy mixing: enforce-popular is all-or-nothing; any peer declaring a
     // different strategy alongside an enforce-popular peer is kicked
-    // (reference: ccoip_master_handler.cpp:703-731)
+    // (reference: ccoip_master_handler.cpp:703-731). NOTE for joiners
+    // resuming from a checkpoint: this rule means an rx-only "adopt the
+    // cohort" first sync is impossible against enforce-popular incumbents —
+    // offer revision 0 WITH enforce-popular instead (never kickable: 0 is
+    // always <= last+1, and a revision-mismatched member simply loses the
+    // election and adopts; see examples/nanogpt_ddp/train_ddp.py).
     bool any_enforce = false, any_other = false;
     for (auto *m : members) {
         if (m->sync_req->strategy == proto::SyncStrategy::kEnforcePopular) any_enforce = true;
@@ -622,6 +639,9 @@ std::vector<Outbox> MasterState::on_dist_done(uint64_t conn) {
     g.sync_in_flight = false;
     PLOG(kDebug) << "shared-state sync complete, group " << c->peer_group << " revision "
                  << g.last_revision;
+    telemetry::Recorder::inst().instant("membership", "master_sync_complete",
+                                        "group", c->peer_group, "revision",
+                                        g.last_revision);
     return out;
 }
 
@@ -761,6 +781,8 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
     }
     optimize_in_flight_ = false;
     PLOG(kInfo) << "topology optimization complete";
+    telemetry::Recorder::inst().instant("membership", "master_optimize_complete",
+                                        "world", world_size());
 }
 
 MasterState::~MasterState() {
@@ -832,6 +854,9 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
     bandwidth_.forget(gone.uuid);
     PLOG(kInfo) << "client " << proto::uuid_str(gone.uuid) << " disconnected, world="
                 << world_size();
+    telemetry::Recorder::inst().instant("membership", "master_peer_left",
+                                        "group", gone.peer_group, "world",
+                                        world_size());
 
     // abort running collectives in its group, prune its votes from ops
     abort_group_collectives(out, gone.peer_group);

@@ -1,6 +1,5 @@
 #include "reduce.hpp"
 
-#include <chrono>
 #include <cstdlib>
 #include <cstring>
 #include <vector>
@@ -8,12 +7,15 @@
 #include "kernels.hpp"
 #include "log.hpp"
 #include "quantize.hpp"
+#include "telemetry.hpp"
 
 namespace pcclt::reduce {
 
 namespace {
 
-// PCCLT_PROF=1 → log per-op phase timings (diagnostics only)
+// PCCLT_PROF=1 → log per-op phase timings. A thin consumer of the
+// telemetry recorder's clock + accumulators (telemetry.hpp) — the same
+// numbers land in the flight-recorder event stream when PCCLT_TRACE is on.
 bool prof_enabled() {
     static const bool on = [] {
         const char *e = std::getenv("PCCLT_PROF");
@@ -22,14 +24,15 @@ bool prof_enabled() {
     return on;
 }
 
+// Per-op phase accumulators (ns). wait_ns is wire-stall: time the op thread
+// spent blocked on bytes that had not arrived yet — the per-edge stall
+// counter and the "wire_stall" trace event both read from it.
 struct Prof {
-    double wait_ms = 0, compute_ms = 0, join_ms = 0, other_ms = 0;
+    uint64_t wait_ns = 0, compute_ns = 0, join_ns = 0, reg_ns = 0,
+             quant_ns = 0;
 };
 
-using Clock = std::chrono::steady_clock;
-double ms_since(Clock::time_point t0) {
-    return std::chrono::duration<double, std::milli>(Clock::now() - t0).count();
-}
+using telemetry::now_ns;
 
 constexpr uint64_t kMetaBit = 0x8000;
 constexpr size_t kSubChunk = 2 << 20; // streaming granularity (bytes)
@@ -63,7 +66,7 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
         if (consumed == 0) {
             // a pending same-host descriptor covers the whole payload: pull
             // it fused with the reduction on this thread
-            auto t0 = Clock::now();
+            auto t0 = now_ns();
             Claim c = ctx.rx.table().consume_cma(
                 tag, target, elem_size,
                 [&](const uint8_t *src, size_t lo, size_t n) {
@@ -72,7 +75,7 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
                     return !(ctx.should_abort && ctx.should_abort());
                 },
                 fill_if_unmapped);
-            if (prof) prof->compute_ms += ms_since(t0);
+            if (prof) prof->compute_ns += now_ns() - t0;
             if (c == Claim::kDone) break;
             if (c == Claim::kCancelled) return false;
             // kNone: no descriptor (yet) -> TCP path below re-polls;
@@ -82,10 +85,10 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
         // bounded wait so master aborts / peer death interrupt the stream;
         // while nothing has streamed in, also wake the moment a claimable
         // same-host descriptor arrives (the loop claims it above)
-        auto t0 = Clock::now();
+        auto t0 = now_ns();
         bool cma_pending = false;
         size_t filled = ctx.rx.table().wait_filled(tag, want, 100, &cma_pending);
-        if (prof) prof->wait_ms += ms_since(t0);
+        if (prof) prof->wait_ns += now_ns() - t0;
         if (cma_pending) {
             if (consumed == 0) continue; // claim fused at the top of the loop
             // fused no longer possible (TCP bytes already consumed): a late
@@ -96,9 +99,9 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
         // consume only whole elements
         size_t usable = (filled / elem_size) * elem_size;
         if (usable > consumed) {
-            t0 = Clock::now();
+            t0 = now_ns();
             on_data(scratch + consumed, consumed, usable);
-            if (prof) prof->compute_ms += ms_since(t0);
+            if (prof) prof->compute_ns += now_ns() - t0;
             consumed = usable;
         }
         if (consumed >= target) break;
@@ -188,19 +191,29 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         hs.insert(hs.end(), ph.begin(), ph.end());
         return hs;
     };
+    // Phase accumulators are always collected: the per-edge stall counter
+    // consumes wait_ns unconditionally, and the clock pairs are vdso reads
+    // around multi-hundred-µs slices. Only EVENT emission is gated, on the
+    // recorder's relaxed atomic flag.
+    auto &rec = telemetry::Recorder::inst();
+    const bool trace = rec.on();
     Prof prof;
-    Prof *profp = prof_enabled() ? &prof : nullptr;
-    auto op_t0 = Clock::now();
+    auto op_t0 = now_ns();
     auto join_tx = [&](const std::vector<net::SendHandle> &hs) -> bool {
-        auto t0 = Clock::now();
+        auto t0 = now_ns();
         bool ok = net::Link::wait_all(hs);
-        if (profp) prof.join_ms += ms_since(t0);
+        prof.join_ns += now_ns() - t0;
         return ok;
     };
     auto reg_sink = [&](uint64_t tag, uint8_t *base, size_t cap, bool consumer_pull) {
-        auto t0 = Clock::now();
+        auto t0 = now_ns();
         ctx.rx.table().register_sink(tag, base, cap, consumer_pull);
-        if (profp) prof.other_ms += ms_since(t0);
+        prof.reg_ns += now_ns() - t0;
+    };
+    auto quant_timed = [&](auto &&fn) {
+        auto t0 = now_ns();
+        fn();
+        prof.quant_ns += now_ns() - t0;
     };
 
     // stage sequence: reduce-scatter stages seq 0..world-2, then all-gather
@@ -243,8 +256,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     reg_stage(0); // before ANY tx: inbound bytes always find a live sink
 
     // ---------------- phase 1: reduce-scatter ----------------
+    auto rs_t0 = now_ns();
     for (uint32_t s = 0; s + 1 < world; ++s) {
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " rs stage " << s;
+        telemetry::Span stage_span("collective", "rs_stage", "stage", s,
+                                   "seq", ctx.op_seq);
         const uint64_t tag = base_tag | s;
         const uint32_t send_c = (rank + world - s) % world;
         const uint32_t recv_c = (rank + world - s - 1) % world;
@@ -257,9 +273,13 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         std::vector<net::SendHandle> tx_job;
         quant::Meta rx_meta;
         if (quantized) {
-            auto meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype, send_ptr,
-                                            send_span.n_elems);
-            quant::quantize(meta, send_ptr, tx_scratch.data(), send_span.n_elems);
+            quant::Meta meta;
+            quant_timed([&] {
+                meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
+                                           send_ptr, send_span.n_elems);
+                quant::quantize(meta, send_ptr, tx_scratch.data(),
+                                send_span.n_elems);
+            });
             tx_job = launch_tx(tag, meta.encode(),
                                {tx_scratch.data(), send_span.n_elems * qsz});
             ctx.tx_bytes += send_span.n_elems * qsz;
@@ -284,7 +304,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       quant::dequantize_accumulate(
                                           rx_meta, ctx.op, src,
                                           recv_ptr + e0 * esz, e1 - e0);
-                                  }, profp);
+                                  }, &prof);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -306,7 +326,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                                            recv_ptr + e0 * esz,
                                                            local_ptr + e0 * esz,
                                                            src, e1 - e0);
-                                  }, profp);
+                                  }, &prof);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -314,15 +334,22 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         }
     }
 
+    if (trace)
+        rec.span("collective", "reduce_scatter", rs_t0, now_ns(), "seq",
+                 ctx.op_seq, "bytes", (count * esz / world) * (world - 1));
+
     // ---------------- phase 2: all-gather ----------------
     // after reduce-scatter, this rank owns fully-reduced chunk (rank+1)%world.
     // Quantized path: own chunk is quantized ONCE; received chunks are
     // forwarded verbatim (no re-quantization), and the owner self-dequantizes
     // for bit parity (reference reduce.cpp:673-738).
+    auto ag_t0 = now_ns();
     std::vector<uint8_t> fwd_q;      // quantized bytes to forward next stage
     std::vector<uint8_t> fwd_meta;   // encoded meta to forward
     for (uint32_t s = 0; s + 1 < world; ++s) {
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " ag stage " << s;
+        telemetry::Span stage_span("collective", "ag_stage", "stage", s,
+                                   "seq", ctx.op_seq);
         const uint64_t tag = base_tag | (0x4000u + s);
         const uint32_t send_c = (rank + 1 + world - s) % world;
         const uint32_t recv_c = (rank + world - s) % world;
@@ -335,12 +362,18 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         std::vector<net::SendHandle> tx_job;
         if (quantized) {
             if (s == 0) {
-                auto meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
-                                                send_ptr, send_span.n_elems);
-                fwd_q.resize(send_span.n_elems * qsz);
-                quant::quantize(meta, send_ptr, fwd_q.data(), send_span.n_elems);
-                // bit parity: owner keeps exactly what the others will decode
-                quant::dequantize_set(meta, fwd_q.data(), send_ptr, send_span.n_elems);
+                quant::Meta meta;
+                quant_timed([&] {
+                    meta = quant::compute_meta(ctx.quant, ctx.q_dtype,
+                                               ctx.dtype, send_ptr,
+                                               send_span.n_elems);
+                    fwd_q.resize(send_span.n_elems * qsz);
+                    quant::quantize(meta, send_ptr, fwd_q.data(),
+                                    send_span.n_elems);
+                    // bit parity: owner keeps exactly what the others decode
+                    quant::dequantize_set(meta, fwd_q.data(), send_ptr,
+                                          send_span.n_elems);
+                });
                 fwd_meta = meta.encode();
             }
             tx_job = launch_tx(tag, fwd_meta, fwd_q);
@@ -368,7 +401,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
                                       quant::dequantize_set(*m, src,
                                                             recv_ptr + e0 * esz, e1 - e0);
-                                  }, profp);
+                                  }, &prof);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -392,7 +425,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       if (src != recv_ptr + lo)
                                           kernels::copy_stream(recv_ptr + lo, src,
                                                                hi - lo);
-                                  }, profp, /*fill_if_unmapped=*/true);
+                                  }, &prof, /*fill_if_unmapped=*/true);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -405,10 +438,27 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
 
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
-    if (profp)
-        PLOG(kInfo) << "reduce prof: total=" << ms_since(op_t0)
-                    << "ms wait=" << prof.wait_ms << " compute=" << prof.compute_ms
-                    << " join=" << prof.join_ms << " reg=" << prof.other_ms;
+    uint64_t op_t1 = now_ns();
+    if (ctx.rx_edge)  // receiver wire-stall charged to the inbound edge
+        ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns, std::memory_order_relaxed);
+    if (trace) {
+        rec.span("collective", "all_gather", ag_t0, op_t1, "seq", ctx.op_seq,
+                 "bytes", (count * esz / world) * (world - 1));
+        rec.span("collective", "allreduce", op_t0, op_t1, "seq", ctx.op_seq,
+                 "bytes", count * esz);
+        rec.instant("collective", "wire_stall", "ns", prof.wait_ns, "seq",
+                    ctx.op_seq);
+        if (quantized)
+            rec.instant("collective", "quantize", "ns", prof.quant_ns, "seq",
+                        ctx.op_seq);
+    }
+    if (prof_enabled())
+        PLOG(kInfo) << "reduce prof: total=" << (op_t1 - op_t0) / 1e6
+                    << "ms wait=" << prof.wait_ns / 1e6
+                    << " compute=" << prof.compute_ns / 1e6
+                    << " quant=" << prof.quant_ns / 1e6
+                    << " join=" << prof.join_ns / 1e6
+                    << " reg=" << prof.reg_ns / 1e6;
     return Result::kOk;
 }
 
@@ -443,8 +493,14 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                      seg, /*consumer_pull=*/true);
     };
     reg_stage(0);
+    auto &rec = telemetry::Recorder::inst();
+    const bool trace = rec.on();
+    Prof prof;
+    auto op_t0 = now_ns();
     for (uint32_t s = 0; s + 1 < world; ++s) {
         const uint64_t tag = base_tag | s;
+        telemetry::Span stage_span("collective", "gather_stage", "stage", s,
+                                   "seq", ctx.op_seq);
         const uint32_t fwd_rank = (rank + world - s) % world; // own at s=0
         const uint8_t *src = s == 0 ? static_cast<const uint8_t *>(send)
                                     : out + slot(fwd_rank) * seg;
@@ -457,7 +513,7 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
                               [&](const uint8_t *p, size_t lo, size_t hi) {
                                   if (p != dst + lo)
                                       kernels::copy_stream(dst + lo, p, hi - lo);
-                              }, nullptr, /*fill_if_unmapped=*/true);
+                              }, &prof, /*fill_if_unmapped=*/true);
         ctx.rx.table().unregister_sink(tag);
         bool tx_ok = net::Link::wait_all(tx_job);
         if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -465,6 +521,14 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
     }
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    if (ctx.rx_edge)
+        ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns, std::memory_order_relaxed);
+    if (trace) {
+        rec.span("collective", "allgather", op_t0, now_ns(), "seq", ctx.op_seq,
+                 "bytes", static_cast<uint64_t>(world) * seg);
+        rec.instant("collective", "wire_stall", "ns", prof.wait_ns, "seq",
+                    ctx.op_seq);
+    }
     return Result::kOk;
 }
 

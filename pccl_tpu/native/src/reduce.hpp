@@ -12,6 +12,10 @@
 #include "protocol.hpp"
 #include "sockets.hpp"
 
+namespace pcclt::telemetry {
+struct EdgeCounters;  // per-edge flight-recorder counters (telemetry.hpp)
+}
+
 namespace pcclt::reduce {
 
 enum class Result : int { kOk = 0, kAborted, kConnectionLost };
@@ -36,6 +40,10 @@ struct RingCtx {
     // client keeps a reuse pool and lends a buffer for the op's lifetime
     std::vector<uint8_t> *scratch = nullptr;
     uint64_t tx_bytes = 0, rx_bytes = 0;
+    // telemetry: the inbound edge's counters (keyed by the ring
+    // predecessor's canonical endpoint) — receiver wire-stall time is
+    // charged here at op end. Optional; null skips attribution.
+    telemetry::EdgeCounters *rx_edge = nullptr;
     // all-gather only: destination slot per ring position (stable ordering
     // by sorted peer uuid — ring positions reshuffle across topology
     // rounds, so they cannot define the user-visible segment order)

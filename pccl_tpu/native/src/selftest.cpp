@@ -17,6 +17,7 @@
 #include "kernels.hpp"
 #include "master.hpp"
 #include "quantize.hpp"
+#include "telemetry.hpp"
 #include "wire.hpp"
 
 using namespace pcclt;
@@ -38,6 +39,68 @@ static bool fast_mode() {
             ++g_failures;                                                               \
         }                                                                               \
     } while (0)
+
+static void test_telemetry() {
+    auto &rec = telemetry::Recorder::inst();
+    const bool was_on = rec.on();
+    rec.clear();
+    rec.enable(true);
+    // spans/instants from several threads land ordered and intact
+    auto t0 = telemetry::now_ns();
+    rec.span("unit", "alpha", t0, t0 + 1000, "seq", 7, "bytes", 42);
+    std::vector<std::thread> ths;
+    for (int t = 0; t < 4; ++t)
+        ths.emplace_back([&] {
+            for (int i = 0; i < 100; ++i)
+                telemetry::Recorder::inst().instant("unit", "tick", "i",
+                                                    static_cast<uint64_t>(i));
+        });
+    for (auto &th : ths) th.join();
+    auto evs = rec.snapshot();
+    CHECK(evs.size() == 401);
+    for (size_t i = 1; i < evs.size(); ++i) CHECK(evs[i - 1].ts_ns <= evs[i].ts_ns);
+    size_t spans = 0;
+    for (const auto &e : evs)
+        if (e.dur_ns) {
+            ++spans;
+            CHECK(std::string(e.name) == "alpha");
+            CHECK(e.v0 == 7 && e.v1 == 42);
+        }
+    CHECK(spans == 1);
+    // disabled path records nothing
+    rec.enable(false);
+    rec.instant("unit", "dropped");
+    CHECK(rec.snapshot().size() == 401);
+    // JSON dump round-trips through a file and is non-trivial
+    const char *path = "/tmp/pcclt_selftest_trace.json";
+    rec.enable(true);
+    CHECK(rec.dump_json(path));
+    FILE *f = fopen(path, "r");
+    CHECK(f != nullptr);
+    if (f) {
+        char buf[64] = {0};
+        CHECK(fread(buf, 1, 15, f) == 15);
+        CHECK(strncmp(buf, "{\"traceEvents\":", 15) == 0);
+        fclose(f);
+        remove(path);
+    }
+    // interning is stable: same string -> same pointer
+    CHECK(telemetry::intern("edge-x") == telemetry::intern("edge-x"));
+    // domain edge counters: registration is idempotent, snapshot faithful,
+    // and edges without a single established conn (pre-rekey ephemeral-port
+    // stubs) are filtered from snapshots
+    telemetry::Domain dom;
+    dom.edge("127.0.0.1:9").conns.fetch_add(1);
+    dom.edge("127.0.0.1:9").tx_bytes.fetch_add(123);
+    dom.edge("127.0.0.1:9").rx_bytes.fetch_add(45);
+    dom.edge("127.0.0.1:99");  // stub: never connected
+    auto edges = dom.snapshot_edges();
+    CHECK(edges.size() == 1);
+    CHECK(edges[0].endpoint == "127.0.0.1:9");
+    CHECK(edges[0].tx_bytes == 123 && edges[0].rx_bytes == 45);
+    rec.clear();
+    rec.enable(was_on);
+}
 
 static void test_wire() {
     wire::Writer w;
@@ -632,6 +695,7 @@ static void test_e2e_abort_mid_ring() {
 }
 
 int main() {
+    test_telemetry();
     test_wire();
     test_hash();
     test_kernels();

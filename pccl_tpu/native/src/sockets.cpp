@@ -20,6 +20,7 @@
 #include "kernels.hpp"
 #include "netem.hpp"
 #include "shm.hpp"
+#include "telemetry.hpp"
 #include "wire.hpp"
 
 namespace pcclt::net {
@@ -753,7 +754,8 @@ void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
     // ack dropped descriptors so the sender's pending handle completes —
     // the data is unwanted (op aborted), not undeliverable
     for (auto &d : dropped)
-        if (auto c = d.ack_conn.lock()) c->send_ctl(MultiplexConn::kCmaAck, d.tag, d.off);
+        if (auto c = d.ack_conn.lock())
+            c->send_ctl(MultiplexConn::kCmaAckDrop, d.tag, d.off);
 }
 
 bool SinkTable::is_retired(uint64_t tag) const {
@@ -809,9 +811,11 @@ size_t cma_slice() {
 
 } // namespace
 
-MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
+MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table,
+                             std::shared_ptr<telemetry::Domain> dom)
     : sock_(std::move(sock)),
-      table_(table ? std::move(table) : std::make_shared<SinkTable>()) {
+      table_(table ? std::move(table) : std::make_shared<SinkTable>()),
+      dom_(dom ? std::move(dom) : telemetry::default_domain()) {
     tx_chunk_base_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
     cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
     // per-conn env re-read (old WirePacer::refresh semantics): a process
@@ -826,6 +830,16 @@ MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table)
 
 void MultiplexConn::set_wire_peer(const Addr &peer) {
     wire_ = netem::Registry::inst().resolve(peer);
+    // per-edge telemetry keys by the same canonical endpoint as the wire
+    // model; an accepted conn lands on the ephemeral source port until the
+    // P2P hello rekeys it (bytes moved before that are handshake-free —
+    // run() has not started). Interned label + release stores: a live
+    // rekey must not race the RX/TX threads' counter reads, and a freshly
+    // constructed EdgeCounters must be fully visible before its pointer is
+    // (edge() pairs with an acquire load).
+    const std::string key = peer.str();
+    edge_.store(&dom_->edge(key), std::memory_order_release);
+    edge_label_.store(telemetry::intern(key), std::memory_order_release);
     // under wire emulation, cap the wire chunk: a streamed receiver
     // consumes as frames land, and at WAN rates an 8 MB frame is ~60 ms of
     // pipeline stall before the first byte of a ring slice can be reduced.
@@ -851,6 +865,11 @@ MultiplexConn::~MultiplexConn() {
 
 void MultiplexConn::run() {
     alive_ = true;
+    edge().conns.fetch_add(1, std::memory_order_relaxed);
+    if (telemetry::Recorder::inst().on())
+        telemetry::Recorder::inst().instant(
+            "edge", "conn_up", nullptr, 0, nullptr, 0,
+            edge_label_.load(std::memory_order_relaxed));
     cma_ok_ = cma_enabled_env() && !wire_->emulated() &&
               sock_.peer_is_loopback();
     sock_.set_quickack();
@@ -959,6 +978,13 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
     // within a tag only one thread streams (offsets carried per frame), and
     // the order-sensitive shm announce path is disabled under pacing.
     wire_->pace(21 + payload.size());
+    if (kind == kData) {
+        // per-edge data-plane accounting: payload bytes only (headers and
+        // control frames excluded), so a ring op's per-edge tx total equals
+        // its logical 2*(n-1)/n payload movement exactly
+        edge().tx_frames.fetch_add(1, std::memory_order_relaxed);
+        edge().tx_bytes.fetch_add(payload.size(), std::memory_order_relaxed);
+    }
     std::lock_guard lk(wr_mu_);
     return sock_.send_all2(hdr, 21, payload.data(), payload.size());
 }
@@ -1028,6 +1054,7 @@ void MultiplexConn::tx_loop() {
             }
             break;
         case kCmaAck:
+        case kCmaAckDrop:
         case kCmaNack:
             sock_ok = write_frame(req->kind, req->tag, req->off, {});
             break;
@@ -1127,7 +1154,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         }
     }
     if (!dst) {
-        send_ctl(drop ? kCmaAck : kCmaNack, tag, d.off);
+        send_ctl(drop ? kCmaAckDrop : kCmaNack, tag, d.off);
         return;
     }
     if (const uint8_t *mapped = shm_resolve(d.addr, d.len)) {
@@ -1154,7 +1181,11 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
             if (it != table_->sinks_.end()) --it->second.busy;
         }
         table_->signal_tag(tag);
-        send_ctl(kCmaAck, tag, d.off);
+        if (!cancelled) {
+            edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
+            edge().rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
+        }
+        send_ctl(cancelled ? kCmaAckDrop : kCmaAck, tag, d.off);
         return;
     }
     if (!cma_verify_peer(d)) {
@@ -1204,7 +1235,14 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         if (it != table_->sinks_.end()) --it->second.busy;
     }
     table_->signal_tag(tag);
-    send_ctl(ok || cancelled ? kCmaAck : kCmaNack, tag, d.off);
+    if (ok && !cancelled) {
+        edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
+        edge().rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
+    }
+    send_ctl(ok && !cancelled ? kCmaAck
+             : cancelled      ? kCmaAckDrop
+                              : kCmaNack,
+             tag, d.off);
     if (!ok && !cancelled)
         PLOG(kWarn) << "CMA read from pid " << d.pid << " failed (errno " << errno
                     << "); peer will fall back to streaming";
@@ -1249,11 +1287,13 @@ SinkTable::CmaClaim MultiplexConn::consumer_cma_pull(
         while (off < d.len) {
             size_t want = std::min(slice, d.len - off);
             if (!consume(mapped + off, d.off + off, want)) {
-                send_ctl(kCmaAck, tag, d.off); // ack-drop: op aborted locally
+                send_ctl(kCmaAckDrop, tag, d.off); // op aborted locally
                 return SinkTable::CmaClaim::kCancelled;
             }
             off += want;
         }
+        edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
+        edge().rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
         send_ctl(kCmaAck, tag, d.off);
         return SinkTable::CmaClaim::kDone;
     }
@@ -1292,11 +1332,13 @@ SinkTable::CmaClaim MultiplexConn::consumer_cma_pull(
         }
         if (!consume(bounce.data(), d.off + off, want)) {
             // consumer aborted: ack-drop so the sender's handle completes
-            send_ctl(kCmaAck, tag, d.off);
+            send_ctl(kCmaAckDrop, tag, d.off);
             return SinkTable::CmaClaim::kCancelled;
         }
         off += want;
     }
+    edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
+    edge().rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
     send_ctl(kCmaAck, tag, d.off);
     return SinkTable::CmaClaim::kDone;
 }
@@ -1363,7 +1405,7 @@ void MultiplexConn::rx_loop() {
         }
         size_t n = len - 17;
 
-        if (kind == kCmaAck || kind == kCmaNack) {
+        if (kind == kCmaAck || kind == kCmaAckDrop || kind == kCmaNack) {
             SendHandle st;
             {
                 std::lock_guard lk(cma_mu_);
@@ -1374,7 +1416,18 @@ void MultiplexConn::rx_loop() {
                 }
             }
             if (st) {
-                if (kind == kCmaAck) {
+                if (kind == kCmaAck || kind == kCmaAckDrop) {
+                    if (kind == kCmaAck) {
+                        // same-host delivery confirmed: account the payload
+                        // as sent on this edge (one descriptor = one logical
+                        // send). Ack-DROPPED payloads (op aborted/purged on
+                        // the receiver) complete the handle but were never
+                        // delivered — counting them would break the per-edge
+                        // tx==rx conservation invariant.
+                        edge().tx_frames.fetch_add(1, std::memory_order_relaxed);
+                        edge().tx_bytes.fetch_add(st->span.size(),
+                                                  std::memory_order_relaxed);
+                    }
                     st->complete(true);
                 } else {
                     // receiver could not pull: fall back to TCP streaming of
@@ -1504,7 +1557,7 @@ void MultiplexConn::rx_loop() {
             if (retired) {
                 // straggler for a purged op: ack-drop NOW so the sender's
                 // handle completes — nobody is left to claim it later
-                send_ctl(kCmaAck, tag, d.off);
+                send_ctl(kCmaAckDrop, tag, d.off);
             } else if (fill_now) {
                 do_cma_fill(tag, d);
             } else {
@@ -1519,6 +1572,8 @@ void MultiplexConn::rx_loop() {
         // read in bounded slices so a cancel request (op abort) is honoured
         // promptly without killing the connection.
         PLOG(kTrace) << "rx data tag=" << tag << " off=" << off << " len=" << n;
+        edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
+        edge().rx_bytes.fetch_add(n, std::memory_order_relaxed);
         uint8_t *dst = nullptr;
         {
             std::lock_guard lk(table_->mu_);
@@ -1637,6 +1692,10 @@ void MultiplexConn::rx_loop() {
         }
     }
     alive_ = false;
+    if (telemetry::Recorder::inst().on())
+        telemetry::Recorder::inst().instant(
+            "edge", "conn_down", nullptr, 0, nullptr, 0,
+            edge_label_.load(std::memory_order_relaxed));
     tx_ev_.signal(); // wake the TX thread so it notices and drains
     fail_all_pending();
     table_->on_conn_dead();

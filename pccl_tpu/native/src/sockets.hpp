@@ -56,6 +56,11 @@
 #include "net_addr.hpp"
 #include "park.hpp"
 
+namespace pcclt::telemetry {
+class Domain;         // per-comm counter registry (telemetry.hpp)
+struct EdgeCounters;  // per-edge byte/frame/stall counters
+}
+
 namespace pcclt::net {
 
 namespace netem {
@@ -314,7 +319,10 @@ private:
 class MultiplexConn : public std::enable_shared_from_this<MultiplexConn> {
 public:
     // A fresh SinkTable is created when `table` is null (standalone conn).
-    explicit MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table = nullptr);
+    // `dom` is the telemetry domain whose per-edge counters this conn
+    // feeds (the owning comm's); null falls back to the process default.
+    explicit MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table = nullptr,
+                           std::shared_ptr<telemetry::Domain> dom = nullptr);
     ~MultiplexConn();
 
     void run(); // spawn RX + TX threads
@@ -361,6 +369,10 @@ private:
         // resolve to direct local pointers. Retire {base} unmaps peer-side.
         kShmAnnounce = 5,
         kShmRetire = 6,
+        // ack-DROP: completes the sender's handle like kCmaAck, but the
+        // payload was discarded (op aborted/purged receiver-side), so the
+        // sender must not account it as delivered on the edge counters
+        kCmaAckDrop = 7,
     };
 
     struct SendReq : mpsc::Node {
@@ -410,6 +422,20 @@ private:
     // wire-emulation edge for this conn's remote endpoint; shared by every
     // conn to the same endpoint (one bucket per edge). Never null.
     std::shared_ptr<netem::Edge> wire_;
+    // telemetry: owning domain + this conn's edge counters (keyed by the
+    // same canonical endpoint as wire_). Atomics because set_wire_peer may
+    // rekey a LIVE conn (socktest's netem rekey) while the RX/TX threads
+    // bump counters; the pointee lives in dom_'s never-erased map and the
+    // label is interned (both immortal), so a stale read is merely a
+    // frame attributed to the pre-rekey edge. Never null after ctor.
+    std::shared_ptr<telemetry::Domain> dom_;
+    std::atomic<telemetry::EdgeCounters *> edge_{nullptr};
+    std::atomic<const char *> edge_label_{""};
+    // acquire pairs with set_wire_peer's release store, so a rekeyed-in
+    // EdgeCounters is fully constructed before any counter add through it
+    telemetry::EdgeCounters &edge() const {
+        return *edge_.load(std::memory_order_acquire);
+    }
     std::thread rx_thread_, tx_thread_;
     std::atomic<bool> alive_{false};
     std::atomic<bool> closing_{false};

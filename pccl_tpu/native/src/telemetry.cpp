@@ -1,0 +1,253 @@
+#include "telemetry.hpp"
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <algorithm>
+#include <set>
+
+#include "log.hpp"
+
+namespace pcclt::telemetry {
+
+uint64_t now_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+           static_cast<uint64_t>(ts.tv_nsec);
+}
+
+const char *intern(const std::string &s) {
+    static std::mutex mu;
+    static std::set<std::string> *table = new std::set<std::string>;  // leaked
+    std::lock_guard lk(mu);
+    return table->insert(s).first->c_str();
+}
+
+namespace {
+
+uint32_t tid_now() {
+    static thread_local uint32_t tid =
+        static_cast<uint32_t>(::syscall(SYS_gettid));
+    return tid;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------- Domain
+
+EdgeCounters &Domain::edge(const std::string &endpoint) {
+    std::lock_guard lk(mu_);
+    auto &p = edges_[endpoint];
+    if (!p) p = std::make_unique<EdgeCounters>();
+    return *p;
+}
+
+std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
+    std::lock_guard lk(mu_);
+    std::vector<EdgeSnapshot> out;
+    out.reserve(edges_.size());
+    for (const auto &[key, e] : edges_) {
+        EdgeSnapshot s;
+        s.endpoint = key;
+        s.conns = e->conns.load(std::memory_order_relaxed);
+        if (s.conns == 0) continue;  // pre-rekey ephemeral-port stub: no
+                                     // conn ever ran keyed here — noise
+        s.tx_bytes = e->tx_bytes.load(std::memory_order_relaxed);
+        s.rx_bytes = e->rx_bytes.load(std::memory_order_relaxed);
+        s.tx_frames = e->tx_frames.load(std::memory_order_relaxed);
+        s.rx_frames = e->rx_frames.load(std::memory_order_relaxed);
+        s.stall_ns = e->stall_ns.load(std::memory_order_relaxed);
+        out.push_back(std::move(s));
+    }
+    return out;
+}
+
+const std::shared_ptr<Domain> &default_domain() {
+    static const std::shared_ptr<Domain> *d =
+        new std::shared_ptr<Domain>(std::make_shared<Domain>());  // leaked
+    return *d;
+}
+
+// ---------------------------------------------------------------- Recorder
+
+Recorder &Recorder::inst() {
+    // leaked: conns/op threads may record during static destruction
+    static Recorder *r = new Recorder;
+    return *r;
+}
+
+std::string Recorder::env_trace_path() {
+    const char *e = std::getenv("PCCLT_TRACE");
+    if (!e || !e[0]) return {};
+    std::string path(e);
+    auto pos = path.find("%p");
+    if (pos != std::string::npos)
+        path.replace(pos, 2, std::to_string(getpid()));
+    return path;
+}
+
+Recorder::Recorder() : ring_(new Slot[kCap]) {
+    if (!env_trace_path().empty()) {
+        on_.store(true, std::memory_order_relaxed);
+        // always-on capture: dump whatever the ring holds at process exit
+        std::atexit([] {
+            auto path = env_trace_path();
+            if (!path.empty()) Recorder::inst().dump_json(path);
+        });
+    }
+}
+
+void Recorder::push(const Event &ev) {
+    uint64_t buf[kEvWords] = {0};
+    memcpy(buf, &ev, sizeof(Event));
+    uint64_t idx = head_.fetch_add(1, std::memory_order_relaxed);
+    Slot &s = ring_[idx % kCap];
+    uint64_t gen = (idx / kCap + 1) * 2;  // even, strictly increasing per slot
+    s.seq.store(gen - 1, std::memory_order_relaxed);  // odd: write in progress
+    std::atomic_thread_fence(std::memory_order_release);  // odd BEFORE words
+    for (size_t i = 0; i < kEvWords; ++i)
+        s.w[i].store(buf[i], std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_release);  // words BEFORE even
+    s.seq.store(gen, std::memory_order_relaxed);
+}
+
+void Recorder::span(const char *cat, const char *name, uint64_t t0_ns,
+                    uint64_t t1_ns, const char *arg0, uint64_t v0,
+                    const char *arg1, uint64_t v1, const char *detail) {
+    if (!on()) return;
+    Event ev;
+    ev.ts_ns = t0_ns;
+    ev.dur_ns = t1_ns > t0_ns ? t1_ns - t0_ns : 0;
+    ev.cat = cat;
+    ev.name = name;
+    ev.arg0 = arg0;
+    ev.arg1 = arg1;
+    ev.v0 = v0;
+    ev.v1 = v1;
+    ev.detail = detail;
+    ev.tid = tid_now();
+    push(ev);
+}
+
+void Recorder::instant(const char *cat, const char *name, const char *arg0,
+                       uint64_t v0, const char *arg1, uint64_t v1,
+                       const char *detail) {
+    if (!on()) return;
+    Event ev;
+    ev.ts_ns = now_ns();
+    ev.cat = cat;
+    ev.name = name;
+    ev.arg0 = arg0;
+    ev.arg1 = arg1;
+    ev.v0 = v0;
+    ev.v1 = v1;
+    ev.detail = detail;
+    ev.tid = tid_now();
+    push(ev);
+}
+
+std::vector<Event> Recorder::snapshot() const {
+    std::vector<Event> out;
+    out.reserve(kCap);
+    for (size_t i = 0; i < kCap; ++i) {
+        const Slot &s = ring_[i];
+        // seqlock read: retry a torn slot a few times, then skip it — a
+        // frozen snapshot matters less than never blocking a writer
+        for (int attempt = 0; attempt < 4; ++attempt) {
+            uint64_t a = s.seq.load(std::memory_order_acquire);
+            if (a == 0) break;           // never written
+            if (a & 1) continue;         // mid-write; retry
+            uint64_t buf[kEvWords];
+            for (size_t k = 0; k < kEvWords; ++k)
+                buf[k] = s.w[k].load(std::memory_order_relaxed);
+            std::atomic_thread_fence(std::memory_order_acquire);
+            if (s.seq.load(std::memory_order_relaxed) == a) {
+                Event ev;
+                memcpy(&ev, buf, sizeof(Event));
+                out.push_back(ev);
+                break;
+            }
+        }
+    }
+    std::sort(out.begin(), out.end(),
+              [](const Event &a, const Event &b) { return a.ts_ns < b.ts_ns; });
+    return out;
+}
+
+void Recorder::clear() {
+    for (size_t i = 0; i < kCap; ++i)
+        ring_[i].seq.store(0, std::memory_order_relaxed);
+    // head_ keeps counting: generations stay strictly increasing
+}
+
+namespace {
+
+void json_escaped(FILE *f, const char *s) {
+    for (; *s; ++s) {
+        unsigned char c = *s;
+        if (c == '"' || c == '\\') fprintf(f, "\\%c", c);
+        else if (c < 0x20) fprintf(f, "\\u%04x", c);
+        else fputc(c, f);
+    }
+}
+
+}  // namespace
+
+bool Recorder::dump_json(const std::string &path) const {
+    auto events = snapshot();
+    FILE *f = fopen(path.c_str(), "w");
+    if (!f) {
+        PLOG(kWarn) << "telemetry: cannot write trace to " << path;
+        return false;
+    }
+    const int pid = getpid();
+    fputs("{\"traceEvents\":[", f);
+    fprintf(f,
+            "{\"ph\":\"M\",\"name\":\"process_name\",\"pid\":%d,"
+            "\"args\":{\"name\":\"pcclt native (pid %d)\"}}",
+            pid, pid);
+    for (const auto &ev : events) {
+        fputs(",\n", f);
+        fprintf(f, "{\"name\":\"");
+        json_escaped(f, ev.name);
+        fprintf(f, "\",\"cat\":\"");
+        json_escaped(f, ev.cat);
+        // ts/dur in µs on the raw monotonic timebase (doubles carry the
+        // magnitude exactly enough: boot-relative µs stay < 2^53)
+        fprintf(f, "\",\"ph\":\"%s\",\"pid\":%d,\"tid\":%u,\"ts\":%.3f",
+                ev.dur_ns ? "X" : "i", pid, ev.tid, ev.ts_ns / 1e3);
+        if (ev.dur_ns) fprintf(f, ",\"dur\":%.3f", ev.dur_ns / 1e3);
+        else fputs(",\"s\":\"t\"", f);  // instant scope: thread
+        fputs(",\"args\":{", f);
+        bool first = true;
+        auto arg_u64 = [&](const char *k, uint64_t v) {
+            if (!k) return;
+            fprintf(f, "%s\"", first ? "" : ",");
+            json_escaped(f, k);
+            fprintf(f, "\":%" PRIu64, v);
+            first = false;
+        };
+        arg_u64(ev.arg0, ev.v0);
+        arg_u64(ev.arg1, ev.v1);
+        if (ev.detail) {
+            fprintf(f, "%s\"detail\":\"", first ? "" : ",");
+            json_escaped(f, ev.detail);
+            fputs("\"", f);
+        }
+        fputs("}}", f);
+    }
+    fputs("]}\n", f);
+    bool ok = fclose(f) == 0;
+    if (ok)
+        PLOG(kDebug) << "telemetry: wrote " << events.size() << " events to "
+                     << path;
+    return ok;
+}
+
+}  // namespace pcclt::telemetry

@@ -1,0 +1,188 @@
+// Flight-recorder telemetry: event ring buffer + monotonic counters.
+//
+// Two pieces, deliberately split by scope:
+//
+//  * Recorder — a PROCESS-global fixed-size event ring (spans + instants)
+//    behind one relaxed atomic flag. Writers claim a slot with a relaxed
+//    fetch_add and publish it with a per-slot seqlock, so the hot path is
+//    mutex-free; readers (snapshot/dump — rare) retry torn slots. Enabled
+//    by `PCCLT_TRACE=path` (dumped as Chrome trace-event JSON at process
+//    exit; `%p` in the path expands to the pid) or via pccltTraceEnable.
+//    Disabled cost: one relaxed load + branch per would-be event.
+//
+//  * Domain — a counter registry attached to ONE comm (or master): comm-
+//    level monotonic counters (collectives by outcome, topology rounds,
+//    sync outcomes incl. hash mismatches, kicks, membership churn) plus
+//    per-edge counters keyed by the same canonical remote endpoint string
+//    as netem ("ip:port", Addr::str()) — bytes/frames tx+rx, connections,
+//    receiver wire-stall time. Counters are always on: they are relaxed
+//    atomic adds at per-frame granularity (frames are 256 KiB..8 MiB), so
+//    there is nothing worth gating. Multiple communicators in one process
+//    (loopback tests) each get their own Domain, so per-comm attribution
+//    survives in-process worlds; standalone conns (socktest) fall back to
+//    a shared default Domain.
+//
+// The PCCLT_PROF=1 per-op phase log (reduce.cpp) is a thin consumer of the
+// same clock + accumulators instead of its own chrono calls.
+//
+// Motivated by the WAN-training diagnosis gap ("was outer step 7 slow
+// because of the wire, a straggler peer, or quantization?") — per-edge,
+// per-phase visibility as called for by arxiv 2606.01680.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+namespace pcclt::telemetry {
+
+// CLOCK_MONOTONIC ns — the one clock every producer (and the Python
+// profiler via time.perf_counter, which is CLOCK_MONOTONIC on Linux)
+// shares, so native and Python events merge onto one timeline.
+uint64_t now_ns();
+
+// Intern a dynamic string (kick reasons, endpoint labels) into a leaked
+// process-wide table so events can carry `const char *` only. Bounded use:
+// callers intern from small closed sets, never per-frame.
+const char *intern(const std::string &s);
+
+// ---------------------------------------------------------------- counters
+
+struct EdgeCounters {
+    std::atomic<uint64_t> tx_bytes{0};   // data payload bytes sent (TCP or CMA)
+    std::atomic<uint64_t> rx_bytes{0};   // data payload bytes received
+    std::atomic<uint64_t> tx_frames{0};  // data sends (frames / CMA descriptors)
+    std::atomic<uint64_t> rx_frames{0};
+    std::atomic<uint64_t> conns{0};      // connections established on this edge
+    std::atomic<uint64_t> stall_ns{0};   // receiver wire-stall charged to this edge
+};
+
+struct CommCounters {
+    std::atomic<uint64_t> collectives_ok{0};
+    std::atomic<uint64_t> collectives_aborted{0};
+    std::atomic<uint64_t> collectives_lost{0};
+    std::atomic<uint64_t> topology_updates{0};
+    std::atomic<uint64_t> topology_optimizes{0};
+    std::atomic<uint64_t> syncs_ok{0};
+    std::atomic<uint64_t> syncs_failed{0};
+    std::atomic<uint64_t> sync_hash_mismatches{0};
+    std::atomic<uint64_t> kicked{0};
+    std::atomic<uint64_t> peers_joined{0};
+    std::atomic<uint64_t> peers_left{0};
+};
+
+struct EdgeSnapshot {
+    std::string endpoint;
+    uint64_t tx_bytes = 0, rx_bytes = 0, tx_frames = 0, rx_frames = 0,
+             conns = 0, stall_ns = 0;
+};
+
+class Domain {
+public:
+    CommCounters comm;
+
+    // Counters for the edge toward `endpoint` (canonical "ip:port", the
+    // netem key). The returned reference is never invalidated.
+    EdgeCounters &edge(const std::string &endpoint);
+
+    std::vector<EdgeSnapshot> snapshot_edges() const;
+
+private:
+    mutable std::mutex mu_;
+    std::map<std::string, std::unique_ptr<EdgeCounters>> edges_;
+};
+
+// Shared fallback for conns constructed without a comm (socktest, tools).
+const std::shared_ptr<Domain> &default_domain();
+
+// ---------------------------------------------------------------- events
+
+struct Event {
+    uint64_t ts_ns = 0;          // CLOCK_MONOTONIC
+    uint64_t dur_ns = 0;         // 0 = instant
+    const char *cat = "";        // static string
+    const char *name = "";       // static string
+    const char *arg0 = nullptr;  // optional arg names (static/interned)
+    const char *arg1 = nullptr;
+    uint64_t v0 = 0, v1 = 0;
+    const char *detail = nullptr;  // optional interned string arg
+    uint32_t tid = 0;
+};
+
+class Recorder {
+public:
+    static Recorder &inst();
+
+    bool on() const { return on_.load(std::memory_order_relaxed); }
+    void enable(bool on) { on_.store(on, std::memory_order_relaxed); }
+
+    // [t0, t1) span. All const char* must be static or interned.
+    void span(const char *cat, const char *name, uint64_t t0_ns, uint64_t t1_ns,
+              const char *arg0 = nullptr, uint64_t v0 = 0,
+              const char *arg1 = nullptr, uint64_t v1 = 0,
+              const char *detail = nullptr);
+    void instant(const char *cat, const char *name,
+                 const char *arg0 = nullptr, uint64_t v0 = 0,
+                 const char *arg1 = nullptr, uint64_t v1 = 0,
+                 const char *detail = nullptr);
+
+    // time-ordered copy of the ring (newest kCap events survive)
+    std::vector<Event> snapshot() const;
+    void clear();
+
+    // Chrome trace-event JSON (chrome://tracing, ui.perfetto.dev). ts/dur
+    // in microseconds on the raw CLOCK_MONOTONIC timebase, so a consumer
+    // holding a perf_counter anchor can align Python sections exactly.
+    bool dump_json(const std::string &path) const;
+
+    // The PCCLT_TRACE path with %p expanded, or empty when unset.
+    static std::string env_trace_path();
+
+private:
+    Recorder();
+    void push(const Event &ev);
+
+    static constexpr size_t kCap = 1 << 16;  // newest 64k events survive
+    // Seqlock slot. The event bytes live in relaxed atomic WORDS (not a
+    // plain Event) so a concurrent reader's torn copy is detected by the
+    // generation double-check without a data race (Boehm, "Can seqlocks
+    // get along with programming language memory models?"); the fences in
+    // push()/snapshot() provide the store-store / load-load ordering the
+    // relaxed accesses need.
+    static_assert(std::is_trivially_copyable_v<Event>);
+    static constexpr size_t kEvWords = (sizeof(Event) + 7) / 8;
+    struct Slot {
+        std::atomic<uint64_t> seq{0};  // 0 free; odd = writing; even = gen done
+        std::atomic<uint64_t> w[kEvWords] = {};
+    };
+    std::atomic<bool> on_{false};
+    std::atomic<uint64_t> head_{0};
+    std::unique_ptr<Slot[]> ring_;
+};
+
+// RAII span: records [ctor, dtor) when the recorder is enabled at ctor time.
+class Span {
+public:
+    Span(const char *cat, const char *name, const char *arg0 = nullptr,
+         uint64_t v0 = 0, const char *arg1 = nullptr, uint64_t v1 = 0)
+        : cat_(cat), name_(name), arg0_(arg0), arg1_(arg1), v0_(v0), v1_(v1),
+          t0_(Recorder::inst().on() ? now_ns() : 0) {}
+    ~Span() {
+        if (t0_)
+            Recorder::inst().span(cat_, name_, t0_, now_ns(), arg0_, v0_,
+                                  arg1_, v1_);
+    }
+    Span(const Span &) = delete;
+    Span &operator=(const Span &) = delete;
+
+private:
+    const char *cat_, *name_, *arg0_, *arg1_;
+    uint64_t v0_, v1_, t0_;
+};
+
+}  // namespace pcclt::telemetry

@@ -9,13 +9,17 @@ CXX=${CXX:-g++}
 FLAGS="-std=c++20 -O3 -g -fPIC -Wall -Wextra -Wno-unused-parameter -fopenmp-simd -Iinclude -pthread"
 EXTRA_FLAGS="${PCCLT_BUILD_FLAGS:-}"
 mkdir -p $OUT/obj
+# coarse header dependency tracking: a changed header (e.g. a class layout
+# edit in sockets.hpp) must rebuild EVERY object, or stale objects keep the
+# old ABI and the linked library silently misbehaves
+NEWEST_HDR=$(ls -t $SRC/*.hpp include/*.h 2>/dev/null | head -1)
 objs=""
-for f in log guarded_alloc wire shm sockets netem protocol hash hash_clmul kernels kernels_avx2 quantize bandwidth atsp benchmark master_state master client reduce api; do
+for f in log telemetry guarded_alloc wire shm sockets netem protocol hash hash_clmul kernels kernels_avx2 quantize bandwidth atsp benchmark master_state master client reduce api; do
   [ -f $SRC/$f.cpp ] || continue
   arch=""
   [ "$f" = kernels_avx2 ] && arch="-mavx2"
   [ "$f" = hash_clmul ] && arch="-mpclmul -msse4.1"
-  if [ $SRC/$f.cpp -nt $OUT/obj/$f.o ] || [ -n "$FORCE" ]; then
+  if [ $SRC/$f.cpp -nt $OUT/obj/$f.o ] || [ -n "$NEWEST_HDR" -a "$NEWEST_HDR" -nt $OUT/obj/$f.o ] || [ -n "$FORCE" ]; then
     echo "CXX $f.cpp"
     $CXX $FLAGS $EXTRA_FLAGS $arch -c $SRC/$f.cpp -o $OUT/obj/$f.o &
   fi

@@ -90,6 +90,12 @@ class Profiler:
             rows = [("section", "count", "total_s", "mean_ms", "min_ms", "max_ms")]
             for name in sorted(self._stats, key=lambda n: -self._stats[n].total_s):
                 s = self._stats[name]
+                if s.count == 0:
+                    # a never-entered section (pre-registered stat, or a
+                    # reset mid-flight) must not render "min=inf" / divide
+                    # by zero
+                    rows.append((name, "0", "0.000", "-", "-", "-"))
+                    continue
                 rows.append((name, str(s.count), f"{s.total_s:.3f}",
                              f"{1e3 * s.total_s / s.count:.2f}",
                              f"{1e3 * s.min_s:.2f}", f"{1e3 * s.max_s:.2f}"))
@@ -97,13 +103,37 @@ class Profiler:
             return "\n".join(
                 "  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows)
 
-    def export_chrome_trace(self, path: str) -> None:
+    def export_chrome_trace(self, path: str, *, native_events=None,
+                            overwrite: bool = True) -> None:
         """Write accumulated spans as a Chrome trace-event JSON file
-        (load in chrome://tracing or ui.perfetto.dev)."""
+        (load in chrome://tracing or ui.perfetto.dev).
+
+        ``native_events`` merges the native flight recorder's events
+        (``pccl_tpu.comm.trace_events()``) onto the same timeline:
+        Python sections stay on pid 0 ("python"), native events keep
+        their own pid (the recorder labels it "pcclt native (pid N)"),
+        so perfetto renders them as separate process tracks. Alignment
+        is exact on Linux: native timestamps are CLOCK_MONOTONIC µs and
+        ``time.perf_counter`` is CLOCK_MONOTONIC too, so the profiler's
+        t0 anchors both clocks; events that predate this profiler's
+        construction are clamped to ts=0.
+
+        ``overwrite=False`` refuses to clobber an existing file
+        (FileExistsError) — by default the export silently overwrites,
+        matching the save-per-run workflow of the examples."""
         with self._lock:
             events = list(self._events)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events}, f)
+            t0_us = self._t0 * 1e6
+        out = [{"ph": "M", "name": "process_name", "pid": 0,
+                "args": {"name": "python"}}] + events
+        for ev in native_events or []:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = max(0.0, ev["ts"] - t0_us)
+            out.append(ev)
+        mode = "w" if overwrite else "x"
+        with open(path, mode) as f:
+            json.dump({"traceEvents": out}, f)
 
     def reset(self) -> None:
         with self._lock:

@@ -1,0 +1,82 @@
+"""Telemetry test peer (subprocess worker).
+
+One peer of a wire_topology-emulated loopback world: applies its per-rank
+PCCLT_WIRE_*_MAP env BEFORE touching the native layer, runs one fp32 ring
+all-reduce with the flight recorder enabled, and prints a single JSON line
+with its Communicator.stats() snapshot. Rank 0 additionally exports a
+MERGED Chrome trace (Python profiler sections + native recorder events) to
+--trace-out. The orchestrating test (test_telemetry.py) asserts per-edge
+byte conservation across the collected stats and that the merged trace
+parses as a valid perfetto-loadable trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-port", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--port-base", type=int, required=True)
+    ap.add_argument("--count", type=int, default=1 << 18)
+    ap.add_argument("--env", default="{}",
+                    help="JSON env dict applied before the native load "
+                         "(per-rank wire_topology maps)")
+    ap.add_argument("--trace-out", default=None,
+                    help="rank 0: write the merged Python+native Chrome "
+                         "trace here")
+    args = ap.parse_args()
+
+    os.environ.update(json.loads(args.env))
+
+    import numpy as np
+
+    from pccl_tpu.comm import Communicator, ReduceOp, trace_enable, trace_events
+    from pccl_tpu.comm.native_bench import _rank_ports
+    from pccl_tpu.utils.profiler import Profiler
+
+    trace_enable(True)
+    p2p, ss, bench = _rank_ports(args.port_base, args.rank)
+    comm = Communicator("127.0.0.1", args.master_port,
+                        p2p_port=p2p, ss_port=ss, bench_port=bench)
+    comm.connect()
+    deadline = time.time() + 60
+    while comm.world_size < args.world:
+        if time.time() > deadline:
+            print(json.dumps({"rank": args.rank, "error": "world timeout"}),
+                  flush=True)
+            return 2
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+
+    prof = Profiler()
+    x = np.full(args.count, float(args.rank + 1), dtype=np.float32)
+    with prof.section("py/all_reduce"):
+        comm.all_reduce(x, op=ReduceOp.SUM, tag=0)
+    expect = args.world * (args.world + 1) / 2
+    if float(x[0]) != expect or float(x[-1]) != expect:
+        print(json.dumps({"rank": args.rank,
+                          "error": f"bad result {x[0]} != {expect}"}),
+              flush=True)
+        return 3
+    stats = comm.stats()
+    if args.trace_out:
+        prof.export_chrome_trace(args.trace_out, native_events=trace_events())
+    print(json.dumps({"rank": args.rank, "stats": stats}), flush=True)
+    comm.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,315 @@
+"""Flight-recorder telemetry: per-edge byte conservation, merged Chrome
+trace round-trip, stats across a peer kick, and the profiler guards.
+
+Reference parity: the reference has no counterpart — its only native
+visibility is stderr timing lines. This subsystem exists because the WAN
+training loop (Prime's report, arxiv 2505.14065) needs to answer "was the
+step slow because of the wire, a straggler peer, or quantization?" with
+data; arxiv 2606.01680 makes per-edge visibility the prerequisite for
+every AllReduce robustness claim.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+LIB = REPO / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+from conftest import alloc_ports
+
+
+def test_edge_conservation_and_merged_trace(tmp_path):
+    """The acceptance scenario: a wire_topology-emulated 4-peer all-reduce.
+
+    Per-edge counters must conserve bytes exactly:
+      * each peer's total data tx across edges == 2*(n-1)/n * payload
+        (the ring's logical movement; count divisible by n, unquantized,
+        so equality is exact);
+      * peer i's tx toward its successor == the successor's rx keyed by
+        i's canonical endpoint (both sides count the same frames).
+    And rank 0's MERGED Chrome trace (Python profiler sections + native
+    recorder events) must parse with both tracks present."""
+    from pccl_tpu.comm import MasterNode
+    from pccl_tpu.comm.native_bench import _rank_ports, wire_topology
+
+    world, count = 4, 1 << 18  # 1 MiB payload, divisible by 4
+    port_base = alloc_ports(span=2300)
+    master = MasterNode("0.0.0.0", alloc_ports())
+    master.run()
+    trace_path = tmp_path / "merged_trace.json"
+    procs = []
+    try:
+        # uniform emulated mesh: forces every byte onto the streamed TCP
+        # path (emulation disables the same-host zero-copy transports), so
+        # the counters meter real wire frames
+        with wire_topology(world, port_base, mbps=4000.0) as envs:
+            for r in range(world):
+                cmd = [sys.executable, str(REPO / "tests" / "telemetry_peer.py"),
+                       "--master-port", str(master.port), "--rank", str(r),
+                       "--world", str(world), "--port-base", str(port_base),
+                       "--count", str(count), "--env", json.dumps(envs[r])]
+                if r == 0:
+                    cmd += ["--trace-out", str(trace_path)]
+                procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                              stderr=subprocess.STDOUT,
+                                              text=True))
+            outs = [p.communicate(timeout=180)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        master.interrupt()
+        master.destroy()
+    stats = {}
+    for out in outs:
+        line = out.strip().splitlines()[-1]
+        r = json.loads(line)
+        assert "error" not in r, out[-2000:]
+        stats[r["rank"]] = r["stats"]
+    assert set(stats) == set(range(world))
+
+    nbytes = count * 4
+    expected = 2 * (world - 1) * nbytes // world
+    endpoint_of = {r: f"127.0.0.1:{_rank_ports(port_base, r)[0]}"
+                   for r in range(world)}
+    rank_of = {ep: r for r, ep in endpoint_of.items()}
+    for r in range(world):
+        edges = stats[r]["edges"]
+        tx_total = sum(e["tx_bytes"] for e in edges.values())
+        rx_total = sum(e["rx_bytes"] for e in edges.values())
+        assert tx_total == expected, \
+            f"rank {r}: tx {tx_total} != {expected} ({edges})"
+        assert rx_total == expected, f"rank {r}: rx {rx_total} != {expected}"
+        # exactly one successor edge carries the tx
+        tx_edges = {ep: e for ep, e in edges.items() if e["tx_bytes"]}
+        assert len(tx_edges) == 1, f"rank {r}: tx spread over {tx_edges}"
+        (succ_ep, e), = tx_edges.items()
+        succ = rank_of[succ_ep]
+        # the successor's rx from OUR endpoint matches our tx bitwise
+        succ_rx = stats[succ]["edges"][endpoint_of[r]]
+        assert succ_rx["rx_bytes"] == e["tx_bytes"], \
+            f"edge {r}->{succ}: tx {e['tx_bytes']} != rx {succ_rx['rx_bytes']}"
+        assert succ_rx["rx_frames"] == e["tx_frames"]
+        assert e["connects"] >= 1
+
+    # merged trace: valid JSON, python + native tracks, spans well-formed
+    trace = json.loads(trace_path.read_text())
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    names = {e.get("name") for e in events}
+    assert "py/all_reduce" in names          # python profiler track
+    assert "allreduce" in names              # native collective span
+    assert "reduce_scatter" in names and "all_gather" in names
+    assert "wire_stall" in names
+    pids = {e.get("pid") for e in events}
+    assert 0 in pids and len(pids) >= 2      # separate process tracks
+    for e in events:
+        assert "name" in e and "ph" in e
+        if e["ph"] in ("X", "i"):
+            assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # the python section must overlap the native allreduce span in time
+    py = next(e for e in events if e["name"] == "py/all_reduce")
+    nat = next(e for e in events if e["name"] == "allreduce")
+    assert py["ts"] <= nat["ts"] <= py["ts"] + py["dur"] + 1e3
+
+
+def _run_peers(master_port, world, worker, base):
+    """In-process peer threads (per-comm telemetry domains keep their
+    stats attributable even in one process)."""
+    from pccl_tpu.comm import Communicator
+
+    errors = []
+
+    def peer(rank):
+        comm = Communicator("127.0.0.1", master_port,
+                            p2p_port=base + rank * 8,
+                            ss_port=base + 512 + rank * 8,
+                            bench_port=base + 1024 + rank * 8)
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < world:
+                if time.time() > deadline:
+                    raise TimeoutError(f"rank {rank}: world never {world}")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not [t for t in threads if t.is_alive()], "peers wedged"
+    assert not errors, f"peer failures: {errors}"
+
+
+def test_stats_across_peer_kick():
+    """A peer violating the shared-state one-increment rule is kicked; its
+    stats record the kick, the survivors' stats record the departure, and
+    the in-process master's flight recorder carries the kick event with
+    its reason."""
+    from pccl_tpu.comm import (KickedError, MasterNode, SharedState,
+                               TensorInfo, trace_enable, trace_events)
+
+    master = MasterNode("0.0.0.0", alloc_ports())
+    master.run()
+    trace_enable(True)
+    stats = {}
+    kicked_ranks = []
+    barrier = threading.Barrier(3, timeout=60)
+
+    def worker(comm, rank):
+        w = np.full(64, 1.0, dtype=np.float32)
+        # round 1: everyone at revision 1 — initializes the group's
+        # revision tracking (one-increment rule armed from here on)
+        comm.sync_shared_state(
+            SharedState([TensorInfo.from_numpy("w", w)], revision=1))
+        barrier.wait()
+        # round 2: rank 2 offers revision 5 (> last+1) -> master kicks it;
+        # ranks 0/1 offer the legal revision 2 and complete once the
+        # violator is gone
+        offer = 5 if rank == 2 else 2
+        try:
+            comm.sync_shared_state(
+                SharedState([TensorInfo.from_numpy("w", w)], revision=offer))
+        except KickedError:
+            kicked_ranks.append(rank)
+            stats[rank] = comm.stats()
+            return
+        # survivors: observe the departure via a topology round
+        deadline = time.time() + 30
+        while comm.world_size > 2 and time.time() < deadline:
+            try:
+                comm.update_topology()
+            except Exception:  # noqa: BLE001 — racing the disconnect
+                time.sleep(0.05)
+        stats[rank] = comm.stats()
+
+    try:
+        _run_peers(master.port, 3, worker, alloc_ports(span=2048))
+    finally:
+        master.interrupt()
+        master.destroy()
+
+    assert kicked_ranks == [2]
+    assert stats[2]["counters"]["kicked"] == 1
+    assert stats[2]["counters"]["syncs_failed"] >= 1
+    for r in (0, 1):
+        c = stats[r]["counters"]
+        assert c["syncs_ok"] == 2, (r, c)
+        assert c["peers_left"] >= 1, (r, c)
+        assert c["kicked"] == 0
+    # the in-process master fed the same recorder: the kick is an event,
+    # and its reason names the revision rule
+    evs = trace_events()
+    kicks = [e for e in evs if e["name"] == "master_kick"]
+    assert kicks, "master kick event missing from trace"
+    assert any("revision" in k.get("args", {}).get("detail", "")
+               for k in kicks)
+
+
+def test_stats_counters_shape():
+    """stats() exposes the full counter set with zero defaults and no
+    edges before any p2p traffic."""
+    from pccl_tpu.comm import Communicator, MasterNode
+
+    master = MasterNode("0.0.0.0", alloc_ports())
+    master.run()
+    try:
+        comm = Communicator("127.0.0.1", master.port,
+                            p2p_port=alloc_ports(span=64))
+        comm.connect()
+        s = comm.stats()
+        for key in ("collectives_ok", "collectives_aborted",
+                    "collectives_connection_lost", "topology_updates",
+                    "topology_optimizes", "syncs_ok", "syncs_failed",
+                    "sync_hash_mismatches", "kicked", "peers_joined",
+                    "peers_left"):
+            assert s["counters"][key] == 0, (key, s)
+        assert s["edges"] == {}
+        comm.destroy()
+    finally:
+        master.interrupt()
+        master.destroy()
+
+
+# ---------------------------------------------------------------- profiler
+
+
+def test_profiler_summary_handles_empty_sections():
+    """A pre-registered/never-entered section must not render min=inf or
+    divide by zero (satellite fix)."""
+    from pccl_tpu.utils.profiler import Profiler, _Stat
+
+    prof = Profiler()
+    with prof.section("ran"):
+        pass
+    prof._stats["never"] = _Stat()
+    s = prof.summary()
+    assert "inf" not in s
+    assert "never" in s and "ran" in s
+
+
+def test_profiler_export_overwrite_guard(tmp_path):
+    """export_chrome_trace(overwrite=False) refuses to clobber; the default
+    keeps the historical overwrite behavior (satellite fix)."""
+    from pccl_tpu.utils.profiler import Profiler
+
+    prof = Profiler()
+    with prof.section("s"):
+        pass
+    path = tmp_path / "t.json"
+    prof.export_chrome_trace(str(path))
+    prof.export_chrome_trace(str(path))  # default: silent overwrite
+    with pytest.raises(FileExistsError):
+        prof.export_chrome_trace(str(path), overwrite=False)
+
+
+def test_profiler_merges_native_events(tmp_path):
+    """Native events (absolute CLOCK_MONOTONIC µs) are re-anchored to the
+    profiler's t0 so both tracks share one timeline; pre-profiler events
+    clamp to 0; metadata events pass through untouched."""
+    from pccl_tpu.utils.profiler import Profiler
+
+    prof = Profiler()
+    with prof.section("py"):
+        time.sleep(0.002)
+    now_us = time.perf_counter() * 1e6
+    native = [
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "native"}},
+        {"name": "allreduce", "cat": "collective", "ph": "X", "pid": 9,
+         "tid": 1, "ts": now_us - 1000.0, "dur": 500.0, "args": {}},
+        {"name": "ancient", "cat": "collective", "ph": "i", "pid": 9,
+         "tid": 1, "ts": 1.0, "s": "t", "args": {}},
+    ]
+    path = tmp_path / "m.json"
+    prof.export_chrome_trace(str(path), native_events=native)
+    events = json.loads(path.read_text())["traceEvents"]
+    by_name = {e["name"]: e for e in events if "name" in e}
+    assert by_name["py"]["pid"] == 0
+    # the allreduce happened ~1ms before `now`, well after prof's t0
+    assert 0 < by_name["allreduce"]["ts"] < now_us
+    assert by_name["ancient"]["ts"] == 0.0          # clamped, not negative
+    assert "ts" not in by_name["process_name"]      # metadata untouched
+    # input list was not mutated (export copies)
+    assert native[1]["ts"] == now_us - 1000.0
