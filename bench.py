@@ -173,6 +173,18 @@ def main() -> None:
             print(f"bench: wan rtt failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["wan_rtt_windowed_speedup"] = None
+        # master HA recovery: SIGKILL the journaled master mid-run, restart
+        # on the same port; master_recovery_s = SIGKILL -> first
+        # post-restart collective completing over resumed sessions
+        # (docs/10_high_availability.md). Includes the ~0.5 s scripted
+        # outage window, so the floor is downtime + one resume backoff.
+        try:
+            for k, v in native_bench.run_master_recovery_bench().items():
+                extra[k] = round(v, 4) if isinstance(v, float) else v
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: master recovery failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["master_recovery_s"] = None
         # the topology-optimizer proof: 4 peers on a heterogeneous emulated
         # mesh (per-edge netem, one pessimal 25 Mbit edge on the naive
         # ring); after optimize_topology() the ATSP ring routes around the

@@ -10,14 +10,20 @@ runtime wedged and the soak FAILS.
 Master churn: with --master-kill-interval > 0 the MASTER process is also
 SIGKILLed on a schedule and restarted on the same port (reference recipe:
 docs/md/05-ImplementationNotes/03_MasterOrchestration.md — restart the
-master, peers reconnect, training resumes). Peers rejoin with fresh
-communicators (tests/ft_peer.py rejoin path) and the stall detector proves
-the group recovers.
+master, peers reconnect, training resumes). Without a journal, peers rejoin
+with fresh communicators (tests/ft_peer.py rejoin path); with --journal PATH
+the restarted master rehydrates its state from the write-ahead journal and
+peers SESSION-RESUME under their old UUIDs instead — a master restart is a
+blip, not a world reset (docs/10_high_availability.md). The run summary
+prints measured master downtime plus resumes-vs-full-rejoins counts, so a
+journaled run can be eyeballed for "all resumes, zero rejoins".
 
 Usage:
     python examples/stress/stress_orchestrator.py --duration 120 --peers 3
     python examples/stress/stress_orchestrator.py --duration 120 --peers 3 \
         --master-kill-interval 30
+    python examples/stress/stress_orchestrator.py --duration 120 --peers 3 \
+        --master-kill-interval 30 --journal /tmp/master.journal
 """
 
 from __future__ import annotations
@@ -40,16 +46,18 @@ class MasterProc:
 
     _instance = 0
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, journal: str | None = None):
         self.port = port
         import os
         MasterProc._instance += 1
         log = os.environ.get("MASTER_LOG")
         out = (open(f"{log}.{MasterProc._instance}", "wb")
                if log else subprocess.DEVNULL)
+        cmd = [sys.executable, "-m", "pccl_tpu.comm.master", "--port", str(port)]
+        if journal:
+            cmd += ["--journal", journal]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "pccl_tpu.comm.master", "--port", str(port)],
-            cwd=str(REPO), stdout=out, stderr=subprocess.STDOUT)
+            cmd, cwd=str(REPO), stdout=out, stderr=subprocess.STDOUT)
         deadline = time.time() + 15
         while time.time() < deadline:
             try:
@@ -83,6 +91,12 @@ class Peer:
         self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                      stderr=subprocess.STDOUT, text=True)
         self.steps = 0
+        self.resumes = 0  # total session resumes across this peer's comm lives
+        self.rejoins = 0  # full re-registrations (fresh communicator)
+        # RESUMED total=N is per-COMMUNICATOR and resets to 0 on a rejoin, so
+        # fold each comm life's max into a base when a REJOIN line arrives
+        self._resume_base = 0
+        self._life_max = 0
         self._t = threading.Thread(target=self._pump, daemon=True)
         self._t.start()
 
@@ -91,6 +105,18 @@ class Peer:
         for line in self.proc.stdout:
             if line.startswith("STEP "):
                 self.steps += 1
+            elif line.startswith("RESUMED total="):
+                try:
+                    n = int(line.split("total=")[1].split()[0])
+                except (ValueError, IndexError):
+                    continue
+                self._life_max = max(self._life_max, n)
+                self.resumes = self._resume_base + self._life_max
+            elif line.startswith("REJOIN"):
+                self.rejoins += 1
+                self._resume_base += self._life_max
+                self._life_max = 0
+                self.resumes = self._resume_base
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -113,17 +139,23 @@ def main() -> int:
                          "seconds (0 = master never dies)")
     ap.add_argument("--master-down-time", type=float, default=1.5,
                     help="how long the master stays dead before restart")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="master HA journal: restarts rehydrate state and "
+                         "peers session-resume instead of rejoining")
     ap.add_argument("--stall-seconds", type=float, default=120.0,
                     help="fail if NO peer makes progress for this long "
                          "(reference uses 5 minutes)")
     args = ap.parse_args()
 
-    master = MasterProc(args.master_port)
+    master = MasterProc(args.master_port, args.journal)
     peers: list[Peer] = []
     seed = 1
     total_relaunches = 0
     master_restarts = 0
+    master_downtime_s: list[float] = []  # SIGKILL -> listening again
     retired_steps = 0  # steps of peers that died; keeps the total monotone
+    retired_resumes = 0
+    retired_rejoins = 0
     next_master_kill = (time.time() + args.master_kill_interval
                         if args.master_kill_interval > 0 else None)
     try:
@@ -153,10 +185,13 @@ def main() -> int:
                 master_restarts += 1
                 print(f"killing master (#{master_restarts}); down for "
                       f"{args.master_down_time:.1f}s", flush=True)
+                t_kill = time.time()
                 master.kill()
                 time.sleep(args.master_down_time)
-                master = MasterProc(args.master_port)
-                print("master restarted", flush=True)
+                master = MasterProc(args.master_port, args.journal)
+                down = time.time() - t_kill
+                master_downtime_s.append(down)
+                print(f"master restarted (downtime {down:.2f}s)", flush=True)
                 next_master_kill = time.time() + args.master_kill_interval
             elif not master.alive():
                 # master died on its own: that's a soak failure
@@ -168,6 +203,8 @@ def main() -> int:
                 if not p.alive():
                     total_relaunches += 1
                     retired_steps += p.steps
+                    retired_resumes += p.resumes
+                    retired_rejoins += p.rejoins
                     print(f"peer {p.idx} died (steps={p.steps}); relaunching "
                           f"(#{total_relaunches})", flush=True)
                     peers[i] = Peer(args.master_port, p.idx, p.base_port,
@@ -182,6 +219,16 @@ def main() -> int:
             print("SOAK FAILED: master churn requested but never exercised",
                   flush=True)
             return 1
+        resumes = retired_resumes + sum(p.resumes for p in peers)
+        rejoins = retired_rejoins + sum(p.rejoins for p in peers)
+        if master_downtime_s:
+            print(f"master downtime: "
+                  f"{sum(master_downtime_s) / len(master_downtime_s):.2f}s "
+                  f"mean / {max(master_downtime_s):.2f}s max over "
+                  f"{len(master_downtime_s)} restarts", flush=True)
+        print(f"recovery mix: {resumes} session resumes, {rejoins} full "
+              f"rejoins (journal={'on' if args.journal else 'off'})",
+              flush=True)
         print(f"SOAK PASSED: {total} heartbeat steps, "
               f"{total_relaunches} relaunches, "
               f"{master_restarts} master restarts in {args.duration:.0f}s",

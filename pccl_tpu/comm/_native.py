@@ -58,6 +58,11 @@ class CommCreateParams(ctypes.Structure):
         ("ss_port", ctypes.c_uint16),
         ("bench_port", ctypes.c_uint16),
         ("p2p_connection_pool_size", ctypes.c_uint32),
+        # master HA reconnect: -1 = env default (PCCLT_RECONNECT_ATTEMPTS,
+        # 8), 0 = disabled; backoff fields in ms, 0 = env defaults
+        ("reconnect_attempts", ctypes.c_int32),
+        ("reconnect_backoff_ms", ctypes.c_uint32),
+        ("reconnect_backoff_cap_ms", ctypes.c_uint32),
     ]
 
 
@@ -128,6 +133,8 @@ class CommStats(ctypes.Structure):
         ("kicked", ctypes.c_uint64),
         ("peers_joined", ctypes.c_uint64),
         ("peers_left", ctypes.c_uint64),
+        ("master_reconnects", ctypes.c_uint64),
+        ("p2p_conns_reused", ctypes.c_uint64),
     ]
 
 
@@ -159,6 +166,15 @@ def _declare(lib):
         f.argtypes = [c.c_void_p]
     lib.pccltMasterPort.restype = c.c_uint16
     lib.pccltMasterPort.argtypes = [c.c_void_p]
+    # master HA (journal + epoch); tolerate older builds via PCCLT_LIB
+    try:
+        lib.pccltCreateMasterEx.restype = c.c_int
+        lib.pccltCreateMasterEx.argtypes = [c.c_char_p, c.c_uint16, c.c_char_p,
+                                            P(c.c_void_p)]
+        lib.pccltMasterEpoch.restype = c.c_uint64
+        lib.pccltMasterEpoch.argtypes = [c.c_void_p]
+    except AttributeError:
+        pass
 
     lib.pccltCreateCommunicator.restype = c.c_int
     lib.pccltCreateCommunicator.argtypes = [P(CommCreateParams), P(c.c_void_p)]

@@ -87,6 +87,10 @@ class Attribute(enum.IntEnum):
     PEER_GROUP_WORLD_SIZE = 1
     NUM_DISTINCT_PEER_GROUPS = 2
     LARGEST_PEER_GROUP_WORLD_SIZE = 3
+    # master HA (docs/10_high_availability.md)
+    MASTER_EPOCH = 4
+    RECONNECT_COUNT = 5
+    SHARED_STATE_REVISION = 6
 
 
 _NP_TO_DTYPE = {
@@ -246,13 +250,34 @@ def trace_events() -> list:
 class MasterNode:
     """Standalone orchestration master (reference: pccl.MasterNode /
     the ccoip_master binary). Control plane only — bulk data never flows
-    through it."""
+    through it.
 
-    def __init__(self, listen_address: str = "0.0.0.0", port: int = 48501):
+    ``journal_path`` enables master HA: authoritative state (registrations,
+    membership, ring order, shared-state revision, bandwidth matrix) is
+    write-ahead-logged there, and a later ``MasterNode`` pointed at the same
+    journal resumes the same world view under a bumped :attr:`epoch` —
+    clients re-attach via session resume instead of re-registering
+    (docs/10_high_availability.md). ``None`` falls back to the
+    ``PCCLT_MASTER_JOURNAL`` env var; pass ``""`` to force-disable."""
+
+    def __init__(self, listen_address: str = "0.0.0.0", port: int = 48501,
+                 journal_path: Optional[str] = None):
         self._lib = _native.load()
         handle = ctypes.c_void_p()
-        _check(self._lib.pccltCreateMaster(listen_address.encode(), port,
-                                           ctypes.byref(handle)), "create master")
+        if journal_path is not None and not hasattr(self._lib,
+                                                    "pccltCreateMasterEx"):
+            raise PcclError(Result.INVALID_USAGE,
+                            "this libpcclt.so predates master HA "
+                            "(pccltCreateMasterEx); rebuild the native core")
+        if hasattr(self._lib, "pccltCreateMasterEx"):
+            _check(self._lib.pccltCreateMasterEx(
+                listen_address.encode(), port,
+                journal_path.encode() if journal_path is not None else None,
+                ctypes.byref(handle)), "create master")
+        else:
+            _check(self._lib.pccltCreateMaster(listen_address.encode(), port,
+                                               ctypes.byref(handle)),
+                   "create master")
         self._h = handle
         self._ran = False
 
@@ -263,6 +288,14 @@ class MasterNode:
     @property
     def port(self) -> int:
         return int(self._lib.pccltMasterPort(self._h))
+
+    @property
+    def epoch(self) -> int:
+        """This incarnation's epoch: 1 fresh (or journal-less), +1 on every
+        journaled restart. Valid after run()."""
+        if not hasattr(self._lib, "pccltMasterEpoch"):
+            return 0
+        return int(self._lib.pccltMasterEpoch(self._h))
 
     def interrupt(self) -> None:
         _check(self._lib.pccltInterruptMaster(self._h))
@@ -516,7 +549,18 @@ class Communicator:
     def __init__(self, master_ip: str, master_port: int = 48501, *,
                  peer_group: int = 0, advertised_ip: Optional[str] = None,
                  p2p_port: int = 0, ss_port: int = 0, bench_port: int = 0,
-                 p2p_connection_pool_size: int = 1):
+                 p2p_connection_pool_size: int = 1,
+                 reconnect_attempts: Optional[int] = None,
+                 reconnect_backoff_ms: int = 0,
+                 reconnect_backoff_cap_ms: int = 0):
+        """``reconnect_*`` tune master-HA session resume: on a lost master
+        link the client retries with bounded exponential backoff + jitter
+        (keeping p2p connections alive) and re-attaches under its old UUID
+        against a journaled master. ``reconnect_attempts`` ``None`` = env
+        ``PCCLT_RECONNECT_ATTEMPTS`` (default 8), ``0`` disables; backoff
+        ms fields default to env ``PCCLT_RECONNECT_BACKOFF_MS`` (100) /
+        ``PCCLT_RECONNECT_MAX_BACKOFF_MS`` (2000). See
+        docs/10_high_availability.md."""
         self._lib = _native.load()
         params = _native.CommCreateParams(
             master_ip=master_ip.encode(),
@@ -527,6 +571,10 @@ class Communicator:
             ss_port=ss_port,
             bench_port=bench_port,
             p2p_connection_pool_size=p2p_connection_pool_size,
+            reconnect_attempts=(-1 if reconnect_attempts is None
+                                else reconnect_attempts),
+            reconnect_backoff_ms=reconnect_backoff_ms,
+            reconnect_backoff_cap_ms=reconnect_backoff_cap_ms,
         )
         handle = ctypes.c_void_p()
         _check(self._lib.pccltCreateCommunicator(ctypes.byref(params),
@@ -582,6 +630,28 @@ class Communicator:
         """Largest group's world size — with num_peer_groups, the grid
         fullness check: global == num_groups * largest (docs 07)."""
         return self.get_attribute(Attribute.LARGEST_PEER_GROUP_WORLD_SIZE)
+
+    @property
+    def master_epoch(self) -> int:
+        """The master epoch observed at welcome / last session resume. A
+        journaled master bumps its epoch on every restart, so a change here
+        = 'the master restarted under us and we resumed'."""
+        return self.get_attribute(Attribute.MASTER_EPOCH)
+
+    @property
+    def reconnect_count(self) -> int:
+        """How many times this communicator resumed its master session
+        (HA blips absorbed without re-registering)."""
+        return self.get_attribute(Attribute.RECONNECT_COUNT)
+
+    @property
+    def shared_state_revision(self) -> int:
+        """Last shared-state revision known COMPLETE group-wide (from a
+        sync Done, or the resume ack after a master restart). If a sync
+        raised and this already covers its revision, the round finished
+        just before the crash — skip the retry instead of wedging the
+        group on a revision disagreement."""
+        return self.get_attribute(Attribute.SHARED_STATE_REVISION)
 
     def update_topology(self) -> None:
         _check(self._lib.pccltUpdateTopology(self._h), "update topology")

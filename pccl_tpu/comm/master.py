@@ -5,6 +5,11 @@ Reference parity: the reference ships both a ccoip_master binary
 (/root/reference/python/framework/pccl/master.py). The native equivalent
 binary here is pccl_tpu/native/build/pcclt_master; this module is the
 python-side runner for environments that only have the shared library.
+
+``--journal PATH`` enables master HA: state is write-ahead-logged to PATH
+and a restarted master pointed at the same journal resumes the same world
+view under a bumped epoch — clients session-resume instead of
+re-registering (docs/10_high_availability.md).
 """
 
 from __future__ import annotations
@@ -20,11 +25,16 @@ def main() -> int:
     ap = argparse.ArgumentParser(description="pccl_tpu master node")
     ap.add_argument("--listen", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=48500)
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="HA journal path (restart on the same journal = "
+                         "resume the world, not reset it); default: the "
+                         "PCCLT_MASTER_JOURNAL env var, else disabled")
     args = ap.parse_args()
 
-    m = MasterNode(args.listen, args.port)
+    m = MasterNode(args.listen, args.port, journal_path=args.journal)
     m.run()
-    print(f"master listening on {args.listen}:{m.port}", flush=True)
+    print(f"master listening on {args.listen}:{m.port} (epoch {m.epoch})",
+          flush=True)
 
     # sigwait instead of a signal handler: a handler would never run while
     # the main thread is blocked inside the foreign await_termination call

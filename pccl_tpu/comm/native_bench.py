@@ -795,6 +795,133 @@ def run_diloco_churn_bench(world: int = 4, params_n: int = 12_500_000,
     }
 
 
+def _peer_master_recovery(rank, master_port, q, world, n_steps, port_base):
+    """Peer for the master-recovery bench: small lockstep reduces, streaming
+    per-step wall-clock end times + the comm's resume counter so the parent
+    can time SIGKILL -> first post-restart collective."""
+    from pccl_tpu.comm.api import (ConnectionLostError, Communicator,
+                                   OperationAbortedError)
+
+    p2p, ss, bench = _rank_ports(port_base, rank)
+    comm = Communicator("127.0.0.1", master_port, p2p_port=p2p, ss_port=ss,
+                        bench_port=bench, reconnect_attempts=20,
+                        reconnect_backoff_ms=50, reconnect_backoff_cap_ms=250)
+    comm.connect()
+    while comm.world_size < world:
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+    x = np.ones(1 << 14, np.float32)
+    y = np.empty_like(x)
+    steps = []
+    step = 0
+    while step < n_steps:
+        try:
+            comm.all_reduce(x, y)
+        except (ConnectionLostError, OperationAbortedError):
+            try:
+                comm.update_topology()
+            except Exception:  # noqa: BLE001 — resumed next loop
+                time.sleep(0.02)
+            continue
+        steps.append((time.time(), comm.reconnect_count))
+        if rank == 0:
+            q.put({"progress": step + 1, "t": time.time(),
+                   "resumes": comm.reconnect_count})
+        step += 1
+        time.sleep(0.05)
+    q.put({"rank": rank, "steps": steps})
+    comm.destroy()
+
+
+def run_master_recovery_bench(world: int = 3, n_steps: int = 60,
+                              master_port: int = 48694,
+                              base: int = 43500) -> Dict[str, Any]:
+    """Master HA recovery number (docs/10): SIGKILL the journaled master
+    mid-run, restart it on the same port, and measure SIGKILL -> first
+    post-restart collective completing (``master_recovery_s``). Peers ride
+    the native session resume — the run must finish with zero rejoins."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    import queue as queue_mod
+
+    port = _port("PCCLT_BENCH_MASTER_PORT_HA", master_port)
+    journal = os.path.join(tempfile.mkdtemp(prefix="pcclt_ha_"),
+                           "master.journal")
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    def spawn_master():
+        p = subprocess.Popen([sys.executable, "-m", "pccl_tpu.comm.master",
+                              "--port", str(port), "--journal", journal],
+                             cwd=repo_root, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.STDOUT)
+        import socket as socket_mod
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                with socket_mod.create_connection(("127.0.0.1", port),
+                                                  timeout=1):
+                    return p
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError("bench master never started")
+
+    master = spawn_master()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_peer_master_recovery,
+                         args=(r, port, q, world, n_steps, base))
+             for r in range(world)]
+    t_kill = None
+    t_first_resumed = None
+    try:
+        for p in procs:
+            p.start()
+        results = []
+        deadline = time.time() + 300
+        while len(results) < world and time.time() < deadline:
+            try:
+                msg = q.get(timeout=10)
+            except queue_mod.Empty:
+                continue
+            if "progress" in msg:
+                if t_kill is None and msg["progress"] >= 5:
+                    master.send_signal(signal.SIGKILL)
+                    master.wait(timeout=10)
+                    t_kill = time.time()
+                    time.sleep(0.5)  # outage window
+                    master = spawn_master()
+                elif (t_kill is not None and t_first_resumed is None
+                      and msg["resumes"] >= 1):
+                    t_first_resumed = msg["t"]
+            else:
+                results.append(msg)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+        if master.poll() is None:
+            master.kill()
+        master.wait(timeout=10)
+    if t_kill is None or t_first_resumed is None:
+        raise RuntimeError("master recovery bench: outage never exercised "
+                           f"(kill={t_kill}, resumed={t_first_resumed})")
+    resumed_ranks = sum(1 for r in results
+                        if any(res >= 1 for _, res in r.get("steps", [])))
+    return {
+        "master_recovery_s": t_first_resumed - t_kill,
+        "master_recovery_resumed_peers": resumed_ranks,
+    }
+
+
 def _peer_hier(rank, master_port, q, elems, iters, quantize, port_base):
     """One emulated TPU slice (4 virtual CPU devices) of the hierarchical
     all-reduce: ICI staging on the slice mesh, the native ring across

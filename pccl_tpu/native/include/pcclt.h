@@ -78,6 +78,15 @@ typedef enum pccltAttribute_t {
     PCCLT_ATTR_PEER_GROUP_WORLD_SIZE = 1,
     PCCLT_ATTR_NUM_DISTINCT_PEER_GROUPS = 2,
     PCCLT_ATTR_LARGEST_PEER_GROUP_WORLD_SIZE = 3,
+    /* master HA (docs/10_high_availability.md): the master epoch observed at
+     * welcome / session resume (bumped on every journaled master restart),
+     * and how many times THIS communicator resumed its session */
+    PCCLT_ATTR_MASTER_EPOCH = 4,
+    PCCLT_ATTR_RECONNECT_COUNT = 5,
+    /* last shared-state revision known complete (sync Done or resume ack):
+     * after a resumed master restart, an app whose sync failed mid-crash
+     * checks this to skip re-syncing a revision that completed group-wide */
+    PCCLT_ATTR_SHARED_STATE_REVISION = 6,
 } pccltAttribute_t;
 
 typedef struct pccltComm pccltComm_t;
@@ -92,6 +101,16 @@ typedef struct pccltCommCreateParams_t {
     uint16_t ss_port;
     uint16_t bench_port;
     uint32_t p2p_connection_pool_size; /* 0 = 1 */
+    /* Master HA reconnect (session resume after a master restart). On
+     * kMasterUnreachable mid-session the client retries with bounded
+     * exponential backoff + jitter while keeping its p2p connections
+     * alive; a journaled master re-binds the session under the old UUID.
+     * reconnect_attempts: -1 = env PCCLT_RECONNECT_ATTEMPTS (default 8),
+     * 0 = disabled, >0 = attempt budget. The backoff fields are ms; 0 =
+     * env PCCLT_RECONNECT_BACKOFF_MS (100) / _MAX_BACKOFF_MS (2000). */
+    int32_t reconnect_attempts;
+    uint32_t reconnect_backoff_ms;
+    uint32_t reconnect_backoff_cap_ms;
 } pccltCommCreateParams_t;
 
 typedef struct pccltReduceDescriptor_t {
@@ -146,8 +165,21 @@ typedef struct pccltSharedStateSyncInfo_t {
 PCCLT_EXPORT pccltResult_t pccltInit(void);
 PCCLT_EXPORT const char *pccltGetBuildInfo(void);
 
+/* Creates a master. When the PCCLT_MASTER_JOURNAL env var is set, master
+ * HA is enabled: authoritative state is write-ahead-logged to that path
+ * and rehydrated on the next pccltRunMaster at the same path, so a
+ * restarted master resumes the same world view under a bumped epoch
+ * (docs/10_high_availability.md). */
 PCCLT_EXPORT pccltResult_t pccltCreateMaster(const char *listen_ip, uint16_t port,
                                              pccltMaster_t **out);
+/* Same, with an explicit journal path: NULL = fall back to the env var,
+ * empty string = force-disable journaling. */
+PCCLT_EXPORT pccltResult_t pccltCreateMasterEx(const char *listen_ip, uint16_t port,
+                                               const char *journal_path,
+                                               pccltMaster_t **out);
+/* This master incarnation's epoch (1 fresh / journal-less; +1 per journaled
+ * restart). Valid after pccltRunMaster. */
+PCCLT_EXPORT uint64_t pccltMasterEpoch(pccltMaster_t *m);
 PCCLT_EXPORT pccltResult_t pccltRunMaster(pccltMaster_t *m);
 PCCLT_EXPORT pccltResult_t pccltInterruptMaster(pccltMaster_t *m);
 PCCLT_EXPORT pccltResult_t pccltMasterAwaitTermination(pccltMaster_t *m);
@@ -257,6 +289,9 @@ typedef struct pccltCommStats_t {
     uint64_t kicked;       /* times THIS peer was kicked */
     uint64_t peers_joined; /* ring additions observed (self excluded) */
     uint64_t peers_left;   /* ring departures observed */
+    /* master HA */
+    uint64_t master_reconnects; /* control sessions resumed after a restart */
+    uint64_t p2p_conns_reused;  /* p2p conns kept alive across topology rounds */
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {

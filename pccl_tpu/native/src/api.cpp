@@ -3,6 +3,7 @@
 #include "../include/pcclt.h"
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <string>
@@ -81,14 +82,24 @@ const char *pccltGetBuildInfo(void) {
 
 // ---------------- master ----------------
 
-pccltResult_t pccltCreateMaster(const char *listen_ip, uint16_t port,
-                                pccltMaster_t **out) {
+pccltResult_t pccltCreateMasterEx(const char *listen_ip, uint16_t port,
+                                  const char *journal_path, pccltMaster_t **out) {
     (void)listen_ip; // listens on all interfaces
     if (!out) return pccltInvalidArgument;
-    auto *m = new pccltMaster{new Master(port ? port : 48501)};
+    std::string journal;
+    if (journal_path) journal = journal_path; // "" = force-disable
+    else if (const char *e = std::getenv("PCCLT_MASTER_JOURNAL")) journal = e;
+    auto *m = new pccltMaster{new Master(port ? port : 48501, journal)};
     *out = m;
     return pccltSuccess;
 }
+
+pccltResult_t pccltCreateMaster(const char *listen_ip, uint16_t port,
+                                pccltMaster_t **out) {
+    return pccltCreateMasterEx(listen_ip, port, nullptr, out);
+}
+
+uint64_t pccltMasterEpoch(pccltMaster_t *m) { return m ? m->master->epoch() : 0; }
 
 pccltResult_t pccltRunMaster(pccltMaster_t *m) {
     if (!m || m->launched) return pccltInvalidUsage;
@@ -136,6 +147,10 @@ pccltResult_t pccltCreateCommunicator(const pccltCommCreateParams_t *params,
     if (params->ss_port) cfg.ss_port = params->ss_port;
     if (params->bench_port) cfg.bench_port = params->bench_port;
     cfg.pool_size = params->p2p_connection_pool_size ? params->p2p_connection_pool_size : 1;
+    cfg.reconnect_attempts = params->reconnect_attempts;
+    cfg.reconnect_backoff_ms = static_cast<int>(params->reconnect_backoff_ms);
+    cfg.reconnect_backoff_cap_ms =
+        static_cast<int>(params->reconnect_backoff_cap_ms);
     *out = new pccltComm{new Client(cfg)};
     return pccltSuccess;
 }
@@ -159,6 +174,15 @@ pccltResult_t pccltGetAttribute(pccltComm_t *c, pccltAttribute_t attr, int64_t *
     case PCCLT_ATTR_PEER_GROUP_WORLD_SIZE: *out = c->client->group_world(); break;
     case PCCLT_ATTR_NUM_DISTINCT_PEER_GROUPS: *out = c->client->num_groups(); break;
     case PCCLT_ATTR_LARGEST_PEER_GROUP_WORLD_SIZE: *out = c->client->largest_group(); break;
+    case PCCLT_ATTR_MASTER_EPOCH:
+        *out = static_cast<int64_t>(c->client->master_epoch());
+        break;
+    case PCCLT_ATTR_RECONNECT_COUNT:
+        *out = static_cast<int64_t>(c->client->reconnect_count());
+        break;
+    case PCCLT_ATTR_SHARED_STATE_REVISION:
+        *out = static_cast<int64_t>(c->client->shared_state_revision());
+        break;
     default: return pccltInvalidArgument;
     }
     return pccltSuccess;
@@ -360,6 +384,8 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     out->kicked = ld(m.kicked);
     out->peers_joined = ld(m.peers_joined);
     out->peers_left = ld(m.peers_left);
+    out->master_reconnects = ld(m.master_reconnects);
+    out->p2p_conns_reused = ld(m.p2p_conns_reused);
     return pccltSuccess;
 }
 

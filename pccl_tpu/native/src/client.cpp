@@ -27,6 +27,11 @@ size_t max_concurrent_ops() {
     return 16;
 }
 
+int env_int(const char *name, int dflt) {
+    if (const char *e = std::getenv(name)) return atoi(e);
+    return dflt;
+}
+
 } // namespace
 
 Client::~Client() { disconnect(); }
@@ -232,6 +237,12 @@ Status Client::connect() {
             return Status::kMasterUnreachable;
         }
         uuid_ = proto::get_uuid(r);
+        // master epoch (HA) trails the welcome string; tolerate its absence
+        // so an older master still welcomes us
+        try {
+            r.str();
+            master_epoch_.store(r.u64(), std::memory_order_relaxed);
+        } catch (...) {}
     } catch (...) { return Status::kInternal; }
     connected_ = true;
 
@@ -247,7 +258,7 @@ Status Client::connect() {
 }
 
 void Client::disconnect() {
-    connected_ = false;
+    connected_ = false; // unparks an in-flight resume loop promptly
     std::unique_ptr<util::WorkerPool> pool;
     {
         std::lock_guard lk(ops_mu_);
@@ -259,7 +270,11 @@ void Client::disconnect() {
         pool = std::move(op_pool_); // taken under the admission lock
     }
     pool.reset(); // joins the pooled worker threads (they never take ops_mu_)
-    master_.close();
+    {
+        // serialize against resume_master_session's reconnect of master_
+        std::lock_guard lk(resume_mu_);
+        master_.close();
+    }
     p2p_listener_.stop();
     ss_listener_.stop();
     bench_listener_.stop();
@@ -305,11 +320,118 @@ Status Client::check_kicked() {
         connected_ = false;
         return Status::kKicked;
     }
-    if (!master_.connected()) {
-        connected_ = false;
-        return Status::kConnectionLost;
-    }
+    // link down is no longer session death: the session may still resume
+    // (classify_master_loss) — connected_ only drops when resume gives up
+    if (!master_.connected()) return Status::kConnectionLost;
     return Status::kOk;
+}
+
+// ---------------- master HA: session resume ----------------
+
+Status Client::resume_master_session() {
+    std::lock_guard lk(resume_mu_);
+    if (master_.connected()) return Status::kOk; // another caller already resumed
+    if (!connected_.load()) return Status::kNotConnected;
+    const int attempts = cfg_.reconnect_attempts >= 0
+                             ? cfg_.reconnect_attempts
+                             : env_int("PCCLT_RECONNECT_ATTEMPTS", 8);
+    if (attempts <= 0) return Status::kMasterUnreachable;
+    const int backoff_ms = cfg_.reconnect_backoff_ms > 0
+                               ? cfg_.reconnect_backoff_ms
+                               : env_int("PCCLT_RECONNECT_BACKOFF_MS", 100);
+    const int cap_ms = cfg_.reconnect_backoff_cap_ms > 0
+                           ? cfg_.reconnect_backoff_cap_ms
+                           : env_int("PCCLT_RECONNECT_MAX_BACKOFF_MS", 2000);
+    auto t0 = telemetry::now_ns();
+    telemetry::Recorder::inst().instant("membership", "master_limbo", "epoch",
+                                        master_epoch_.load());
+    std::mt19937_64 rng{std::random_device{}() ^
+                        static_cast<uint64_t>(reinterpret_cast<uintptr_t>(this))};
+    for (int a = 0; a < attempts; ++a) {
+        if (a > 0) {
+            // exponential backoff with jitter: desynchronizes a whole world
+            // of clients hammering the restarting master in lockstep.
+            // Slept in slices so a concurrent disconnect() (which waits on
+            // resume_mu_) is released within ~100 ms, not a full backoff.
+            double d = std::min<double>(cap_ms, backoff_ms * double(1ull << (a - 1)));
+            d *= 0.5 + std::uniform_real_distribution<>{}(rng);
+            for (double slept = 0; slept < d && connected_.load(); slept += 100)
+                std::this_thread::sleep_for(std::chrono::duration<double, std::milli>(
+                    std::min(100.0, d - slept)));
+        }
+        if (!connected_.load()) return Status::kNotConnected; // disconnect() raced
+        if (!master_.reconnect(cfg_.master)) continue; // master still down
+        master_.run();
+        proto::SessionResumeC2M req;
+        req.uuid = uuid_;
+        req.last_revision = last_sync_revision_.load();
+        req.p2p_port = p2p_listener_.port();
+        req.ss_port = ss_listener_.port();
+        req.bench_port = bench_listener_.port();
+        req.adv_ip = cfg_.adv_ip;
+        if (!master_.send(PacketType::kC2MSessionResume, req.encode())) continue;
+        auto fr = master_.recv_match(PacketType::kM2CSessionResumeAck, nullptr,
+                                     10'000);
+        if (!fr) continue; // died again mid-handshake: next backoff slot
+        auto ack = proto::SessionResumeAck::decode(fr->payload);
+        if (!ack) continue;
+        if (!ack->ok) {
+            // the master is up but holds no journaled state for us (no
+            // journal, limbo expired, or uuid re-bound): resuming is
+            // impossible — the caller must re-register from scratch
+            PLOG(kWarn) << "session resume rejected: " << ack->reason;
+            telemetry::Recorder::inst().instant(
+                "membership", "master_resume_rejected", "epoch", ack->epoch,
+                nullptr, 0, telemetry::intern(ack->reason));
+            master_.close();
+            return Status::kMasterUnreachable;
+        }
+        master_epoch_.store(ack->epoch, std::memory_order_relaxed);
+        // the master's journaled group revision may be AHEAD of what we saw
+        // complete (its Done to us was lost in the crash); adopt the max so
+        // the app can skip re-syncing an already-completed revision
+        uint64_t lr = last_sync_revision_.load(std::memory_order_relaxed);
+        while (ack->last_revision > lr &&
+               !last_sync_revision_.compare_exchange_weak(lr, ack->last_revision)) {}
+        reconnects_.fetch_add(1, std::memory_order_relaxed);
+        session_gen_.fetch_add(1, std::memory_order_release);
+        tele_->comm.master_reconnects.fetch_add(1, std::memory_order_relaxed);
+        PLOG(kInfo) << "master session resumed as " << proto::uuid_str(uuid_)
+                    << " (epoch " << ack->epoch << ", attempt " << a + 1 << ")";
+        telemetry::Recorder::inst().span("membership", "master_resume", t0,
+                                         telemetry::now_ns(), "epoch",
+                                         ack->epoch, "attempts",
+                                         static_cast<uint64_t>(a + 1));
+        return Status::kOk;
+    }
+    PLOG(kError) << "master unreachable after " << attempts << " reconnect attempts";
+    return Status::kMasterUnreachable;
+}
+
+Status Client::classify_master_loss() {
+    // a queued kick is authoritative — we were thrown out, the master lives
+    auto kicked = master_.recv_match(PacketType::kM2CKicked, nullptr, 0, true);
+    if (kicked) {
+        std::string reason;
+        try {
+            wire::Reader r(kicked->payload);
+            reason = r.str();
+        } catch (...) {}
+        PLOG(kError) << "kicked by master: " << reason;
+        tele_->comm.kicked.fetch_add(1, std::memory_order_relaxed);
+        if (telemetry::Recorder::inst().on())
+            telemetry::Recorder::inst().instant("membership", "kicked", nullptr,
+                                                0, nullptr, 0,
+                                                telemetry::intern(reason));
+        connected_ = false;
+        return Status::kKicked;
+    }
+    if (master_.connected()) return Status::kConnectionLost; // not a link loss
+    Status st = resume_master_session();
+    if (st == Status::kOk)
+        return Status::kConnectionLost; // session re-bound; caller retries the op
+    connected_ = false;
+    return st;
 }
 
 // ---------------- topology / establishment ----------------
@@ -325,6 +447,23 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
         {
             std::lock_guard lk(state_mu_);
             auto &pc = peers_[ep.uuid];
+            // Blip-not-rebuild: when the peer's endpoint is unchanged and
+            // every pooled conn is still alive, keep the pool — a topology
+            // round after a master restart (or a plain re-vote) then moves
+            // ZERO data-plane bytes. A peer that died and rejoined always
+            // reconnects: it comes back under a fresh UUID (or, post-resume,
+            // with its old conns dead).
+            bool reusable = pc.ep.ip == ep.ip && pc.ep.p2p_port == ep.p2p_port &&
+                            pc.tx.size() == cfg_.pool_size && !pc.tx.empty();
+            if (reusable)
+                for (const auto &c : pc.tx)
+                    if (!c || !c->alive()) reusable = false;
+            if (reusable) {
+                pc.ep = ep;
+                tele_->comm.p2p_conns_reused.fetch_add(
+                    pc.tx.size(), std::memory_order_relaxed);
+                continue;
+            }
             pc.ep = ep;
             old_pool = std::move(pc.tx);
             pc.tx.clear();
@@ -429,7 +568,11 @@ void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid
 
 Status Client::establish_loop(bool vote_deferrable) {
     while (true) {
-        if (auto st = check_kicked(); st != Status::kOk) return st;
+        if (auto st = check_kicked(); st != Status::kOk) {
+            // master link down mid-round: classify (and maybe resume); any
+            // vote we held died with the old session — the caller re-votes
+            return st == Status::kConnectionLost ? classify_master_loss() : st;
+        }
         std::optional<net::Frame> fr;
         if (vote_deferrable) {
             // the master declines the vote (kM2CTopologyDeferred) when our
@@ -447,8 +590,12 @@ Status Client::establish_loop(bool vote_deferrable) {
             fr = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 120'000);
         }
         if (!fr) {
-            auto st = check_kicked();
-            return st == Status::kOk ? Status::kMasterUnreachable : st;
+            if (master_.connected()) {
+                // round stalled with the link up: old surface (kick-aware)
+                auto st = check_kicked();
+                return st == Status::kOk ? Status::kMasterUnreachable : st;
+            }
+            return classify_master_loss();
         }
         // stale rounds may have queued older conn infos; use the newest
         while (auto newer = master_.recv_match(PacketType::kM2CP2PConnInfo, nullptr, 0, true))
@@ -465,7 +612,7 @@ Status Client::establish_loop(bool vote_deferrable) {
         w.u32(static_cast<uint32_t>(failed.size()));
         for (const auto &f : failed) proto::put_uuid(w, f);
         if (!master_.send(PacketType::kC2MP2PEstablished, w.data()))
-            return Status::kConnectionLost;
+            return classify_master_loss();
 
         // match only this round's response (stale-round responses are dropped
         // by revision, mirroring the reference's connection-revision guard)
@@ -478,8 +625,11 @@ Status Client::establish_loop(bool vote_deferrable) {
         auto resp =
             master_.recv_match(PacketType::kM2CP2PEstablishedResp, rev_pred, 120'000);
         if (!resp) {
-            auto st = check_kicked();
-            return st == Status::kOk ? Status::kMasterUnreachable : st;
+            if (master_.connected()) {
+                auto st = check_kicked();
+                return st == Status::kOk ? Status::kMasterUnreachable : st;
+            }
+            return classify_master_loss();
         }
         try {
             wire::Reader r(resp->payload);
@@ -499,9 +649,28 @@ Status Client::establish_loop(bool vote_deferrable) {
 
 Status Client::update_topology() {
     if (!connected_.load()) return Status::kNotConnected;
-    if (!master_.send(PacketType::kC2MTopologyUpdate, {})) return Status::kConnectionLost;
     auto t0 = telemetry::now_ns();
-    Status st = establish_loop(/*vote_deferrable=*/true);
+    Status st = Status::kConnectionLost;
+    // a master blip mid-round is absorbed here: resume the session and
+    // re-vote (the old master's vote died with it) instead of surfacing a
+    // loss the app would treat as a world reset. Bounded so a flapping
+    // master still fails out.
+    for (int round = 0; round < 4; ++round) {
+        if (!connected_.load()) return Status::kNotConnected;
+        if (!master_.connected()) {
+            Status rst = resume_master_session();
+            if (rst != Status::kOk) {
+                connected_ = false;
+                return rst;
+            }
+        }
+        if (!master_.send(PacketType::kC2MTopologyUpdate, {})) {
+            st = Status::kConnectionLost;
+            continue; // next round resumes the session first
+        }
+        st = establish_loop(/*vote_deferrable=*/true);
+        if (st != Status::kConnectionLost) break; // done, or a non-link failure
+    }
     if (st == Status::kOk) {
         tele_->comm.topology_updates.fetch_add(1, std::memory_order_relaxed);
         telemetry::Recorder::inst().span("membership", "update_topology", t0,
@@ -513,16 +682,27 @@ Status Client::update_topology() {
 
 Status Client::are_peers_pending(bool &pending) {
     if (!connected_.load()) return Status::kNotConnected;
-    if (!master_.send(PacketType::kC2MPeersPendingQuery, {})) return Status::kConnectionLost;
-    auto fr = master_.recv_match(PacketType::kM2CPeersPendingReply, nullptr, 30'000);
-    if (!fr) return Status::kConnectionLost;
-    pending = !fr->payload.empty() && fr->payload[0] != 0;
-    return Status::kOk;
+    for (int attempt = 0; attempt < 2; ++attempt) {
+        if (!master_.send(PacketType::kC2MPeersPendingQuery, {})) {
+            auto st = classify_master_loss();
+            if (st != Status::kConnectionLost) return st;
+            continue; // session resumed underneath: retry the query
+        }
+        auto fr = master_.recv_match(PacketType::kM2CPeersPendingReply, nullptr, 30'000);
+        if (fr) {
+            pending = !fr->payload.empty() && fr->payload[0] != 0;
+            return Status::kOk;
+        }
+        auto st = classify_master_loss();
+        if (st != Status::kConnectionLost) return st;
+    }
+    return Status::kConnectionLost;
 }
 
 Status Client::optimize_topology() {
     if (!connected_.load()) return Status::kNotConnected;
-    if (!master_.send(PacketType::kC2MOptimizeTopology, {})) return Status::kConnectionLost;
+    if (!master_.send(PacketType::kC2MOptimizeTopology, {}))
+        return classify_master_loss();
     // the whole-group optimize round serializes probes per target, so a fast
     // peer may wait roughly (world * window * retry-budget) for the slowest
     // prober; the wait must scale accordingly or healthy large clusters time out
@@ -535,8 +715,13 @@ Status Client::optimize_topology() {
             {PacketType::kM2COptimizeResponse, PacketType::kM2COptimizeComplete}, nullptr,
             optimize_wait_ms);
         if (!fr) {
-            auto st = check_kicked();
-            return st == Status::kOk ? Status::kMasterUnreachable : st;
+            if (master_.connected()) {
+                auto st = check_kicked();
+                return st == Status::kOk ? Status::kMasterUnreachable : st;
+            }
+            // the optimize round died with the master; resume (if possible)
+            // and let the caller re-enter a fresh round
+            return classify_master_loss();
         }
         if (fr->type == PacketType::kM2COptimizeComplete) {
             try {
@@ -602,10 +787,10 @@ Status Client::optimize_topology() {
             proto::put_uuid(w, req.to);
             w.f64(mbps);
             if (!master_.send(PacketType::kC2MBandwidthReport, w.data()))
-                return Status::kConnectionLost;
+                return classify_master_loss();
         }
         if (!master_.send(PacketType::kC2MOptimizeWorkDone, {}))
-            return Status::kConnectionLost;
+            return classify_master_loss();
     }
 }
 
@@ -684,6 +869,14 @@ Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
 
 Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
                                  proto::DType dtype, ReduceDesc desc, AsyncOp *op) {
+    // session generation at op start: if a concurrent thread resumes the
+    // master session mid-op, replies to THIS op's packets can never arrive
+    // on the new session — bail with a retryable status instead of waiting
+    // out the full commence/verdict timeouts
+    const uint64_t gen0 = session_gen_.load(std::memory_order_acquire);
+    auto session_flipped = [&] {
+        return session_gen_.load(std::memory_order_acquire) != gen0;
+    };
     // 1. initiate with master, await commence (predicate-matched by tag)
     proto::CollectiveInit ci;
     ci.tag = desc.tag;
@@ -693,7 +886,7 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     ci.quant = desc.quant;
     ci.quant_dtype = desc.quant_dtype;
     if (!master_.send(PacketType::kC2MCollectiveInit, ci.encode()))
-        return Status::kConnectionLost;
+        return classify_master_loss();
 
     auto tag_pred = [tag = desc.tag](const std::vector<uint8_t> &p) {
         try {
@@ -701,9 +894,11 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
             return r.u64() == tag;
         } catch (...) { return false; }
     };
+    if (session_flipped()) return Status::kConnectionLost;
     auto commence =
         master_.recv_match(PacketType::kM2CCollectiveCommence, tag_pred, 600'000);
-    if (!commence) return Status::kConnectionLost;
+    if (!commence) return classify_master_loss();
+    if (session_flipped()) return Status::kConnectionLost;
     uint64_t seq;
     try {
         wire::Reader r(commence->payload);
@@ -853,20 +1048,23 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         fprintf(stderr, "[op %llu] ring done st=%d seq=%llu\n",
                 (unsigned long long)desc.tag, int(st), (unsigned long long)seq);
     bool local_failure = st != Status::kOk;
+    if (session_flipped()) return Status::kConnectionLost;
     wire::Writer w;
     w.u64(desc.tag);
     w.u8(local_failure ? 1 : 0);
     if (!master_.send(PacketType::kC2MCollectiveComplete, w.data()))
-        return Status::kConnectionLost;
+        return classify_master_loss();
     if (!consumed_abort) {
-        if (!consume_abort(false)) return Status::kConnectionLost;
+        if (session_flipped()) return Status::kConnectionLost;
+        if (!consume_abort(false)) return classify_master_loss();
     }
     if (dbg_phases)
         fprintf(stderr, "[op %llu] verdict=%d seq=%llu\n",
                 (unsigned long long)desc.tag, int(verdict_aborted),
                 (unsigned long long)seq);
+    if (session_flipped()) return Status::kConnectionLost;
     auto done = master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
-    if (!done) return Status::kConnectionLost;
+    if (!done) return classify_master_loss();
     if (dbg_phases)
         fprintf(stderr, "[op %llu] done seq=%llu\n", (unsigned long long)desc.tag,
                 (unsigned long long)seq);
@@ -951,6 +1149,13 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
                                       const std::vector<SharedStateEntry> &entries,
                                       SyncInfo *info) {
     if (!connected_.load()) return Status::kNotConnected;
+    // session generation at sync start: a concurrent thread resuming the
+    // master session mid-sync orphans this round (sync rounds are not
+    // journaled) — bail retryable instead of waiting out the 300 s recvs
+    const uint64_t gen0 = session_gen_.load(std::memory_order_acquire);
+    auto session_flipped = [&] {
+        return session_gen_.load(std::memory_order_acquire) != gen0;
+    };
 
     // open the distribution window (we may be elected distributor)
     {
@@ -995,15 +1200,24 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
                                        e.count * proto::dtype_size(e.dtype));
         req.entries.push_back(std::move(m));
     }
-    if (!master_.send(PacketType::kC2MSharedStateSync, req.encode())) {
+    if (!master_.send(PacketType::kC2MSharedStateSync, req.encode()) ||
+        session_flipped()) {
         close_window();
-        return Status::kConnectionLost;
+        return master_.connected() && session_flipped()
+                   ? Status::kConnectionLost // resumed mid-sync: round is gone
+                   : classify_master_loss();
     }
     auto fr = master_.recv_match(PacketType::kM2CSharedStateSyncResp, nullptr, 300'000);
     if (!fr) {
         close_window();
-        auto kst = check_kicked();
-        return kst == Status::kOk ? Status::kConnectionLost : kst;
+        return classify_master_loss();
+    }
+    if (session_flipped()) {
+        // a concurrent resume replaced the session while the response was in
+        // flight: the round (and any distributor assignment) died with the
+        // old master — retry the whole sync on the live session
+        close_window();
+        return Status::kConnectionLost;
     }
     auto resp = proto::SharedStateSyncResp::decode(fr->payload);
     if (!resp) {
@@ -1103,24 +1317,34 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
         }
     }
 
-    if (!master_.send(PacketType::kC2MSharedStateDistDone, {})) {
+    if (session_flipped()) {
         close_window();
         return Status::kConnectionLost;
     }
+    if (!master_.send(PacketType::kC2MSharedStateDistDone, {})) {
+        close_window();
+        return classify_master_loss();
+    }
     auto done = master_.recv_match(PacketType::kM2CSharedStateDone, nullptr, 300'000);
     close_window();
-    if (!done) {
-        auto kst = check_kicked();
-        return kst == Status::kOk ? Status::kConnectionLost : kst;
-    }
+    if (!done) return classify_master_loss();
 
+    uint64_t done_rev = 0;
+    try {
+        wire::Reader r(done->payload);
+        done_rev = r.u64();
+    } catch (...) {}
+    // remember the last revision we saw COMPLETE: re-presented on session
+    // resume so a restarted master whose journal missed the final append
+    // still restores the one-increment invariant (monotonic max — a
+    // malformed Done payload must not wipe the counter back to 0)
+    uint64_t prev = last_sync_revision_.load(std::memory_order_relaxed);
+    while (done_rev > prev &&
+           !last_sync_revision_.compare_exchange_weak(prev, done_rev)) {}
     if (info) {
         info->rx_bytes = rx_bytes;
         info->tx_bytes = dist_tx_bytes_.load();
-        try {
-            wire::Reader r(done->payload);
-            info->revision = r.u64();
-        } catch (...) {}
+        info->revision = done_rev;
     }
     return st;
 }

@@ -49,6 +49,15 @@ struct ClientConfig {
     uint16_t ss_port = 48532;
     uint16_t bench_port = 48562;
     size_t pool_size = 1;          // p2p connection pool per peer
+    // Master HA reconnect (session resume after a master restart).
+    // -1/0 = resolve from env at connect: PCCLT_RECONNECT_ATTEMPTS
+    // (default 8; 0 disables), PCCLT_RECONNECT_BACKOFF_MS (default 100),
+    // PCCLT_RECONNECT_MAX_BACKOFF_MS (default 2000). The retry loop is
+    // bounded exponential backoff with jitter; p2p connections stay alive
+    // throughout, so a resumed session needs no mesh rebuild.
+    int reconnect_attempts = -1;
+    int reconnect_backoff_ms = 0;
+    int reconnect_backoff_cap_ms = 0;
 };
 
 struct ReduceDesc {
@@ -133,6 +142,13 @@ public:
     uint32_t group_world() const;
     uint32_t num_groups() const;
     uint32_t largest_group() const;
+    // master HA: last welcome/resume-ack epoch, and sessions resumed
+    uint64_t master_epoch() const { return master_epoch_.load(); }
+    uint64_t reconnect_count() const { return reconnects_.load(); }
+    // last shared-state revision known complete (from a sync Done or the
+    // resume ack) — apps use it to skip re-syncing a revision that
+    // completed group-wide just before a master crash
+    uint64_t shared_state_revision() const { return last_sync_revision_.load(); }
     const proto::Uuid &uuid() const { return uuid_; }
     bool connected() const { return connected_.load(); }
 
@@ -167,6 +183,17 @@ private:
                                std::vector<proto::Uuid> &failed);
     void adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid> &ring);
     Status check_kicked(); // poll for a queued kick packet
+    // Master HA: bounded exponential-backoff-with-jitter reconnect +
+    // kC2MSessionResume under the existing UUID. Returns kOk when the
+    // session is re-bound (epoch adopted, p2p mesh untouched),
+    // kMasterUnreachable when the budget is exhausted or the master
+    // rejected the resume (caller must re-register from scratch).
+    Status resume_master_session();
+    // Classify a failed master exchange: queued kick -> kKicked; master
+    // link down -> try resume (kOk resume -> kConnectionLost so the caller
+    // retries the op on the live session); resume failed ->
+    // kMasterUnreachable with connected_ cleared.
+    Status classify_master_loss();
     Status run_reduce_worker(const void *send, void *recv, uint64_t count,
                              proto::DType dtype, ReduceDesc desc, AsyncOp *op);
     void on_p2p_accept(net::Socket sock);
@@ -180,6 +207,16 @@ private:
     ClientConfig cfg_;
     proto::Uuid uuid_{};
     std::atomic<bool> connected_{false};
+    // master HA state: serialized resume loop, observed epoch, resume count,
+    // last shared-state revision seen complete (re-presented on resume)
+    std::mutex resume_mu_;
+    std::atomic<uint64_t> master_epoch_{0};
+    std::atomic<uint64_t> reconnects_{0};
+    std::atomic<uint64_t> last_sync_revision_{0};
+    // bumped on every successful resume: an exchange that started against
+    // the OLD master session must not wait out its full timeout on replies
+    // the new session will never produce (concurrent ops + resume race)
+    std::atomic<uint64_t> session_gen_{0};
     std::shared_ptr<telemetry::Domain> tele_ =
         std::make_shared<telemetry::Domain>();
 

@@ -1,0 +1,274 @@
+#include "journal.hpp"
+
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+
+#include "log.hpp"
+#include "wire.hpp"
+
+namespace pcclt::journal {
+
+namespace {
+constexpr char kMagic[] = "PCCLJ1\n";
+constexpr size_t kMagicLen = sizeof(kMagic) - 1;
+constexpr uint32_t kMaxRecord = 16u << 20; // sanity guard on corrupt lengths
+} // namespace
+
+Journal::~Journal() {
+    std::lock_guard lk(mu_);
+    if (f_) fclose(f_);
+    f_ = nullptr;
+}
+
+bool Journal::open(const std::string &path) {
+    std::lock_guard lk(mu_);
+    if (f_) return false; // already open
+    path_ = path;
+    fsync_ = [] {
+        const char *e = std::getenv("PCCLT_JOURNAL_FSYNC");
+        return e && e[0] == '1';
+    }();
+    replay(path); // missing/empty file is a fresh journal, not an error
+    epoch_ = restored_.epoch + 1;
+    if (!write_snapshot()) {
+        PLOG(kError) << "journal: cannot write " << path;
+        return false;
+    }
+    PLOG(kInfo) << "journal " << path << " open: epoch " << epoch_ << ", "
+                << restored_.clients.size() << " clients, "
+                << restored_.groups.size() << " groups restored";
+    return true;
+}
+
+bool Journal::replay(const std::string &path) {
+    FILE *f = fopen(path.c_str(), "rb");
+    if (!f) return false;
+    char magic[8] = {0};
+    if (fread(magic, 1, kMagicLen, f) != kMagicLen ||
+        memcmp(magic, kMagic, kMagicLen) != 0) {
+        fclose(f);
+        PLOG(kWarn) << "journal: bad magic in " << path << "; starting fresh";
+        return false;
+    }
+    std::vector<uint8_t> buf;
+    while (true) {
+        uint8_t hdr[5];
+        if (fread(hdr, 1, 5, f) != 5) break; // torn tail / EOF: stop replay
+        uint32_t len;
+        memcpy(&len, hdr, 4);
+        len = wire::from_be(len);
+        uint8_t type = hdr[4];
+        if (len > kMaxRecord) break;
+        buf.resize(len);
+        if (len && fread(buf.data(), 1, len, f) != len) break; // torn record
+        try {
+            wire::Reader r(buf);
+            switch (type) {
+            case kEpoch:
+                restored_.epoch = r.u64();
+                break;
+            case kClient: {
+                ClientRec c;
+                c.uuid = proto::get_uuid(r);
+                c.peer_group = r.u32();
+                c.ip = r.str();
+                c.p2p_port = r.u16();
+                c.ss_port = r.u16();
+                c.bench_port = r.u16();
+                c.accepted = r.u8() != 0;
+                restored_.clients[c.uuid] = std::move(c);
+                break;
+            }
+            case kClientRemove:
+                restored_.clients.erase(proto::get_uuid(r));
+                break;
+            case kGroup: {
+                uint32_t g = r.u32();
+                auto &gr = restored_.groups[g];
+                gr.last_revision = r.u64();
+                gr.revision_initialized = r.u8() != 0;
+                break;
+            }
+            case kRing: {
+                uint32_t g = r.u32();
+                uint32_t n = r.u32();
+                auto &gr = restored_.groups[g];
+                gr.ring.clear();
+                for (uint32_t i = 0; i < n; ++i)
+                    gr.ring.push_back(proto::get_uuid(r));
+                break;
+            }
+            case kTopoRev:
+                restored_.topology_revision = r.u64();
+                break;
+            case kSeqBound:
+                restored_.next_seq = std::max(restored_.next_seq, r.u64());
+                break;
+            case kBandwidth: {
+                BandwidthRec b;
+                b.from = proto::get_uuid(r);
+                b.to = proto::get_uuid(r);
+                b.mbps = r.f64();
+                restored_.bandwidth.push_back(b);
+                break;
+            }
+            default:
+                break; // unknown record: skip (forward compatibility)
+            }
+            restored_.any = true;
+        } catch (...) {
+            break; // short payload: torn record, stop replay
+        }
+    }
+    fclose(f);
+    // drop bandwidth rows whose peers are gone (forget() deltas are not
+    // journaled; pruning at replay keeps the matrix consistent)
+    std::vector<BandwidthRec> kept;
+    for (auto &b : restored_.bandwidth)
+        if (restored_.clients.count(b.from) && restored_.clients.count(b.to))
+            kept.push_back(b);
+    restored_.bandwidth = std::move(kept);
+    return true;
+}
+
+bool Journal::write_snapshot() {
+    // compact to a temp file then rename over: a crash mid-snapshot leaves
+    // the previous journal intact
+    std::string tmp = path_ + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    if (fwrite(kMagic, 1, kMagicLen, f) != kMagicLen) {
+        fclose(f);
+        return false;
+    }
+    auto put = [&](uint8_t type, const std::vector<uint8_t> &payload) {
+        uint32_t len = wire::to_be(static_cast<uint32_t>(payload.size()));
+        fwrite(&len, 4, 1, f);
+        fwrite(&type, 1, 1, f);
+        if (!payload.empty()) fwrite(payload.data(), 1, payload.size(), f);
+    };
+    {
+        wire::Writer w;
+        w.u64(epoch_);
+        put(kEpoch, w.take());
+    }
+    {
+        wire::Writer w;
+        w.u64(restored_.topology_revision);
+        put(kTopoRev, w.take());
+    }
+    {
+        wire::Writer w;
+        w.u64(restored_.next_seq);
+        put(kSeqBound, w.take());
+    }
+    for (auto &[_, c] : restored_.clients) {
+        wire::Writer w;
+        proto::put_uuid(w, c.uuid);
+        w.u32(c.peer_group);
+        w.str(c.ip);
+        w.u16(c.p2p_port);
+        w.u16(c.ss_port);
+        w.u16(c.bench_port);
+        w.u8(c.accepted ? 1 : 0);
+        put(kClient, w.take());
+    }
+    for (auto &[g, gr] : restored_.groups) {
+        {
+            wire::Writer w;
+            w.u32(g);
+            w.u64(gr.last_revision);
+            w.u8(gr.revision_initialized ? 1 : 0);
+            put(kGroup, w.take());
+        }
+        wire::Writer w;
+        w.u32(g);
+        w.u32(static_cast<uint32_t>(gr.ring.size()));
+        for (const auto &u : gr.ring) proto::put_uuid(w, u);
+        put(kRing, w.take());
+    }
+    for (auto &b : restored_.bandwidth) {
+        wire::Writer w;
+        proto::put_uuid(w, b.from);
+        proto::put_uuid(w, b.to);
+        w.f64(b.mbps);
+        put(kBandwidth, w.take());
+    }
+    if (fflush(f) != 0 || fdatasync(fileno(f)) != 0) {
+        fclose(f);
+        return false;
+    }
+    fclose(f);
+    if (rename(tmp.c_str(), path_.c_str()) != 0) return false;
+    f_ = fopen(path_.c_str(), "ab");
+    return f_ != nullptr;
+}
+
+void Journal::append(uint8_t type, const std::vector<uint8_t> &payload) {
+    std::lock_guard lk(mu_);
+    if (!f_) return;
+    uint32_t len = wire::to_be(static_cast<uint32_t>(payload.size()));
+    fwrite(&len, 4, 1, f_);
+    fwrite(&type, 1, 1, f_);
+    if (!payload.empty()) fwrite(payload.data(), 1, payload.size(), f_);
+    fflush(f_); // kernel-buffered: survives SIGKILL of this process
+    if (fsync_) fdatasync(fileno(f_));
+}
+
+void Journal::record_client(const ClientRec &c) {
+    wire::Writer w;
+    proto::put_uuid(w, c.uuid);
+    w.u32(c.peer_group);
+    w.str(c.ip);
+    w.u16(c.p2p_port);
+    w.u16(c.ss_port);
+    w.u16(c.bench_port);
+    w.u8(c.accepted ? 1 : 0);
+    append(kClient, w.take());
+}
+
+void Journal::record_client_remove(const Uuid &u) {
+    wire::Writer w;
+    proto::put_uuid(w, u);
+    append(kClientRemove, w.take());
+}
+
+void Journal::record_group(uint32_t group, uint64_t last_revision, bool initialized) {
+    wire::Writer w;
+    w.u32(group);
+    w.u64(last_revision);
+    w.u8(initialized ? 1 : 0);
+    append(kGroup, w.take());
+}
+
+void Journal::record_ring(uint32_t group, const std::vector<Uuid> &ring) {
+    wire::Writer w;
+    w.u32(group);
+    w.u32(static_cast<uint32_t>(ring.size()));
+    for (const auto &u : ring) proto::put_uuid(w, u);
+    append(kRing, w.take());
+}
+
+void Journal::record_topology_revision(uint64_t rev) {
+    wire::Writer w;
+    w.u64(rev);
+    append(kTopoRev, w.take());
+}
+
+void Journal::record_seq_bound(uint64_t bound) {
+    wire::Writer w;
+    w.u64(bound);
+    append(kSeqBound, w.take());
+}
+
+void Journal::record_bandwidth(const Uuid &from, const Uuid &to, double mbps) {
+    wire::Writer w;
+    proto::put_uuid(w, from);
+    proto::put_uuid(w, to);
+    w.f64(mbps);
+    append(kBandwidth, w.take());
+}
+
+} // namespace pcclt::journal

@@ -1,0 +1,115 @@
+// Master-state journal: write-ahead log of the DURABLE subset of
+// MasterState, so a restarted master resumes with the same world view
+// (same client UUIDs, peer-group membership, ring order, shared-state
+// revision, bandwidth matrix) under a bumped epoch instead of resetting
+// the world.
+//
+// Design: the journal records STATE transitions, not protocol events —
+// replay is a pure reconstruction of the durable fields, never a re-run
+// of the consensus machine (in-flight votes/ops are deliberately NOT
+// durable; they die with the master and clients simply retry). The file
+// is a framed append-only log: a snapshot prefix (rewritten compacted on
+// every open) followed by delta records. A torn tail from a crash
+// mid-append is tolerated: replay stops at the first short frame.
+//
+// Framing: magic "PCCLJ1\n" then records of [u32 len][u8 type][payload],
+// payloads in the big-endian wire format (wire.hpp). Appends are
+// fflush()ed per record — the threat model is process death (SIGKILL),
+// where kernel-buffered writes survive; set PCCLT_JOURNAL_FSYNC=1 to
+// fdatasync each record against power loss at a latency cost.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "net_addr.hpp"
+#include "protocol.hpp"
+
+namespace pcclt::journal {
+
+using proto::Uuid;
+
+struct ClientRec {
+    Uuid uuid{};
+    uint32_t peer_group = 0;
+    std::string ip; // Addr::str() form (family-tagged by syntax)
+    uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
+    bool accepted = false;
+};
+
+struct GroupRec {
+    uint64_t last_revision = 0;
+    bool revision_initialized = false;
+    std::vector<Uuid> ring;
+};
+
+struct BandwidthRec {
+    Uuid from{}, to{};
+    double mbps = 0;
+};
+
+// Rehydrated view of the durable master state after replay.
+struct Restored {
+    uint64_t epoch = 0;             // epoch of the PREVIOUS incarnation
+    uint64_t topology_revision = 0;
+    uint64_t next_seq = 1;          // safe restart point for collective seqs
+    std::map<Uuid, ClientRec> clients;
+    std::map<uint32_t, GroupRec> groups;
+    std::vector<BandwidthRec> bandwidth;
+    bool any = false;               // true when the file held prior state
+};
+
+class Journal {
+public:
+    ~Journal();
+
+    // Replays an existing journal at `path` (if any) into restored(),
+    // bumps the epoch, then rewrites the file as a compacted snapshot of
+    // the restored state and leaves it open for appends. Returns false
+    // when the file cannot be opened/created for writing.
+    bool open(const std::string &path);
+
+    const Restored &restored() const { return restored_; }
+    // epoch of THIS incarnation (restored().epoch + 1, or 1 when fresh)
+    uint64_t epoch() const { return epoch_; }
+
+    // --- delta appends (thread-safe; no-ops until open() succeeded) ---
+    void record_client(const ClientRec &c);
+    void record_client_remove(const Uuid &u);
+    void record_group(uint32_t group, uint64_t last_revision, bool initialized);
+    void record_ring(uint32_t group, const std::vector<Uuid> &ring);
+    void record_topology_revision(uint64_t rev);
+    void record_seq_bound(uint64_t bound);
+    void record_bandwidth(const Uuid &from, const Uuid &to, double mbps);
+
+    bool is_open() const { return f_ != nullptr; }
+
+private:
+    enum RecType : uint8_t {
+        kEpoch = 1,
+        kClient = 2,
+        kClientRemove = 3,
+        kGroup = 4,
+        kRing = 5,
+        kTopoRev = 6,
+        kBandwidth = 7,
+        kSeqBound = 8,
+    };
+
+    void append(uint8_t type, const std::vector<uint8_t> &payload);
+    bool replay(const std::string &path); // fills restored_; torn-tail tolerant
+    bool write_snapshot();                // compacted restored_ + new epoch
+
+    std::mutex mu_;
+    FILE *f_ = nullptr;
+    std::string path_;
+    Restored restored_;
+    uint64_t epoch_ = 1;
+    bool fsync_ = false;
+};
+
+} // namespace pcclt::journal

@@ -7,9 +7,23 @@ namespace pcclt::master {
 using proto::PacketType;
 
 bool Master::launch() {
+    // bind FIRST: a second master accidentally started on a live master's
+    // port+journal must fail here, BEFORE Journal::open rename-clobbers the
+    // running master's journal file out from under it
     if (!listener_.listen(port_)) {
         PLOG(kError) << "master: cannot bind port " << port_;
         return false;
+    }
+    if (!journal_path_.empty()) {
+        // open (and rehydrate from) the journal before accept()ing clients
+        // (connections queue in the TCP backlog until run_async below): the
+        // first hello must already see the restored world + bumped epoch
+        if (!journal_.open(journal_path_)) {
+            PLOG(kError) << "master: cannot open journal " << journal_path_;
+            listener_.stop();
+            return false;
+        }
+        state_.attach_journal(&journal_);
     }
     port_ = listener_.port();
     running_ = true;
@@ -85,16 +99,28 @@ void Master::dispatcher_loop() {
     // the state machine is single-threaded by design; enforce it at runtime
     // (reference THREAD_GUARD discipline)
     PCCLT_THREAD_GUARD(state_guard_);
+    // limbo expiry (HA) must run on a steady deadline, not only when the
+    // queue drains: a busy group's event stream would otherwise starve the
+    // tick and freeze rounds on a never-resuming session forever
+    auto next_tick = std::chrono::steady_clock::now() + std::chrono::milliseconds(100);
     while (running_.load()) {
         Event ev;
+        bool have_ev = false;
         {
             std::unique_lock lk(ev_mu_);
             ev_cv_.wait_for(lk, std::chrono::milliseconds(100),
                             [this] { return !events_.empty() || !running_.load(); });
-            if (events_.empty()) continue;
-            ev = std::move(events_.front());
-            events_.pop_front();
+            if (!events_.empty()) {
+                ev = std::move(events_.front());
+                events_.pop_front();
+                have_ev = true;
+            }
         }
+        if (auto now = std::chrono::steady_clock::now(); now >= next_tick) {
+            apply_outbox(state_.on_tick());
+            next_tick = now + std::chrono::milliseconds(100);
+        }
+        if (!have_ev) continue;
 
         std::vector<Outbox> out;
         if (ev.kind == Event::kDisconnect) {
@@ -129,6 +155,11 @@ void Master::dispatcher_loop() {
                 case PacketType::kC2MHello: {
                     auto h = proto::HelloC2M::decode(p);
                     if (h) out = state_.on_hello(ev.conn_id, src_ip, *h);
+                    break;
+                }
+                case PacketType::kC2MSessionResume: {
+                    auto s = proto::SessionResumeC2M::decode(p);
+                    if (s) out = state_.on_session_resume(ev.conn_id, src_ip, *s);
                     break;
                 }
                 case PacketType::kC2MTopologyUpdate:

@@ -24,13 +24,18 @@ namespace pcclt::master {
 
 class Master {
 public:
-    explicit Master(uint16_t port) : port_(port) {}
+    // journal_path non-empty enables master HA: authoritative state is
+    // write-ahead-logged there and rehydrated on the next launch (same
+    // world view, bumped epoch; see journal.hpp).
+    explicit Master(uint16_t port, std::string journal_path = {})
+        : port_(port), journal_path_(std::move(journal_path)) {}
     ~Master() { interrupt(); join(); }
 
     bool launch();
     void interrupt();
     void join();
     uint16_t port() const { return port_; }
+    uint64_t epoch() const { return state_.epoch(); }
 
 private:
     struct Conn {
@@ -50,6 +55,8 @@ private:
     void apply_outbox(const std::vector<Outbox> &out);
 
     uint16_t port_;
+    std::string journal_path_;
+    journal::Journal journal_;
     net::Listener listener_;
     MasterState state_;
     ThreadGuard state_guard_;

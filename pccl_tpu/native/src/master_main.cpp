@@ -15,13 +15,18 @@ static void on_signal(int) {
 
 int main(int argc, char **argv) {
     uint16_t port = 48501;
+    const char *journal = nullptr; // nullptr = PCCLT_MASTER_JOURNAL env
     if (argc > 1) port = static_cast<uint16_t>(atoi(argv[1]));
-    if (pccltCreateMaster("0.0.0.0", port, &g_master) != pccltSuccess) return 1;
+    if (argc > 2) journal = argv[2]; // HA: journal path (see journal.hpp)
+    if (pccltCreateMasterEx("0.0.0.0", port, journal, &g_master) != pccltSuccess)
+        return 1;
     if (pccltRunMaster(g_master) != pccltSuccess) {
         fprintf(stderr, "failed to launch master on port %u\n", port);
         return 1;
     }
-    printf("pcclt master listening on port %u\n", pccltMasterPort(g_master));
+    printf("pcclt master listening on port %u (epoch %llu)\n",
+           pccltMasterPort(g_master),
+           (unsigned long long)pccltMasterEpoch(g_master));
     fflush(stdout);
     signal(SIGINT, on_signal);
     signal(SIGTERM, on_signal);

@@ -89,6 +89,144 @@ std::vector<uint64_t> MasterState::take_pending_closes() {
     return v;
 }
 
+// ---------- HA: journal rehydration + session resume ----------
+
+void MasterState::journal_client(const ClientInfo &c) {
+    if (!journal_) return;
+    journal::ClientRec rec;
+    rec.uuid = c.uuid;
+    rec.peer_group = c.peer_group;
+    rec.ip = c.ip.str();
+    rec.p2p_port = c.p2p_port;
+    rec.ss_port = c.ss_port;
+    rec.bench_port = c.bench_port;
+    rec.accepted = c.accepted;
+    journal_->record_client(rec);
+}
+
+bool MasterState::group_frozen(uint32_t group) const {
+    for (const auto &[_, l] : limbo_)
+        if (l.info.peer_group == group) return true;
+    return false;
+}
+
+void MasterState::attach_journal(journal::Journal *j) {
+    journal_ = j;
+    if (!j) return;
+    epoch_ = j->epoch();
+    const auto &r = j->restored();
+    topology_revision_ = r.topology_revision;
+    next_seq_ = std::max<uint64_t>(1, r.next_seq);
+    seq_bound_ = next_seq_;
+    int limbo_ms = 15'000;
+    if (const char *e = std::getenv("PCCLT_MASTER_LIMBO_MS")) {
+        int v = std::atoi(e);
+        if (v > 0) limbo_ms = v;
+    }
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(limbo_ms);
+    for (const auto &[u, rc] : r.clients) {
+        ClientInfo c;
+        c.uuid = rc.uuid;
+        c.conn_id = 0;
+        c.peer_group = rc.peer_group;
+        if (auto a = net::Addr::parse(rc.ip, 0)) c.ip = *a;
+        c.p2p_port = rc.p2p_port;
+        c.ss_port = rc.ss_port;
+        c.bench_port = rc.bench_port;
+        c.accepted = rc.accepted;
+        limbo_[u] = LimboClient{c, deadline};
+    }
+    for (const auto &[gid, gr] : r.groups) {
+        auto &g = groups_[gid];
+        g.last_revision = gr.last_revision;
+        g.revision_initialized = gr.revision_initialized;
+        g.ring = gr.ring;
+    }
+    for (const auto &b : r.bandwidth) bandwidth_.store(b.from, b.to, b.mbps);
+    if (!limbo_.empty())
+        PLOG(kInfo) << "journal restore: epoch " << epoch_ << ", "
+                    << limbo_.size() << " sessions in limbo awaiting resume ("
+                    << limbo_ms << " ms window)";
+    telemetry::Recorder::inst().instant("membership", "master_restore", "epoch",
+                                        epoch_, "limbo", limbo_.size());
+}
+
+std::vector<Outbox> MasterState::on_session_resume(uint64_t conn,
+                                                   const net::Addr &src_ip,
+                                                   const proto::SessionResumeC2M &s) {
+    std::vector<Outbox> out;
+    proto::SessionResumeAck ack;
+    ack.epoch = epoch_;
+    auto it = limbo_.find(s.uuid);
+    if (it == limbo_.end()) {
+        // not a rehydrated session: either this master has no journal, the
+        // limbo window expired, or the uuid is already (re)bound — the
+        // client must fall back to a fresh registration
+        ack.ok = 0;
+        ack.reason = by_uuid(s.uuid) ? "session already bound"
+                                     : "unknown session (no journaled state)";
+        out.push_back({conn, PacketType::kM2CSessionResumeAck, ack.encode()});
+        return out;
+    }
+    ClientInfo c = it->second.info;
+    limbo_.erase(it);
+    c.conn_id = conn;
+    // refresh the observed address + re-advertised ports: the client
+    // process survived, but its NAT mapping may not have
+    c.ip = src_ip;
+    if (s.p2p_port) c.p2p_port = s.p2p_port;
+    if (s.ss_port) c.ss_port = s.ss_port;
+    if (s.bench_port) c.bench_port = s.bench_port;
+    if (!s.adv_ip.empty())
+        if (auto a = net::Addr::parse(s.adv_ip, 0)) c.ip = *a;
+    auto &g = groups_[c.peer_group];
+    if (s.last_revision > g.last_revision) {
+        // the client witnessed a sync Done the journal missed (crash between
+        // emitting Done and the append reaching disk): the client can only
+        // have seen a Done this master emitted, so trust it — this restores
+        // the one-increment invariant for the whole group
+        g.last_revision = s.last_revision;
+        g.revision_initialized = true;
+        if (journal_)
+            journal_->record_group(c.peer_group, g.last_revision, true);
+    }
+    ack.ok = 1;
+    ack.last_revision = g.last_revision;
+    clients_[conn] = c;
+    journal_client(c);
+    PLOG(kInfo) << "session resumed: " << proto::uuid_str(c.uuid) << " group "
+                << c.peer_group << " (" << limbo_.size() << " still in limbo)";
+    telemetry::Recorder::inst().instant("membership", "master_session_resume",
+                                        "group", c.peer_group, "limbo",
+                                        limbo_.size());
+    out.push_back({c.conn_id, PacketType::kM2CSessionResumeAck, ack.encode()});
+    // last limbo session back: unfreeze every consensus round
+    if (limbo_.empty()) recheck_all(out);
+    return out;
+}
+
+std::vector<Outbox> MasterState::on_tick() {
+    std::vector<Outbox> out;
+    if (limbo_.empty()) return out;
+    auto now = std::chrono::steady_clock::now();
+    std::vector<Uuid> expired;
+    for (const auto &[u, l] : limbo_)
+        if (now >= l.deadline) expired.push_back(u);
+    for (const auto &u : expired) {
+        ClientInfo gone = limbo_[u].info;
+        limbo_.erase(u);
+        if (journal_) journal_->record_client_remove(u);
+        PLOG(kWarn) << "limbo session " << proto::uuid_str(u)
+                    << " expired without resume; treating as departed";
+        telemetry::Recorder::inst().instant("membership", "master_limbo_expired",
+                                            "group", gone.peer_group, "world",
+                                            world_size());
+        remove_client(out, gone);
+    }
+    return out;
+}
+
 // ---------- join ----------
 
 std::vector<Outbox> MasterState::on_hello(uint64_t conn, const net::Addr &src_ip,
@@ -129,6 +267,7 @@ std::vector<Outbox> MasterState::on_hello(uint64_t conn, const net::Addr &src_ip
     w.u8(1);
     proto::put_uuid(w, c.uuid);
     w.str("welcome");
+    w.u64(epoch_); // master epoch (HA); older clients simply don't read it
     out.push_back({conn, PacketType::kM2CWelcome, w.take()});
     check_topology(out);
     return out;
@@ -199,6 +338,11 @@ std::vector<Outbox> MasterState::on_peers_pending_query(uint64_t conn) {
 
 void MasterState::check_topology(std::vector<Outbox> &out) {
     if (establish_in_flight_ || optimize_in_flight_) return;
+    // HA freeze: sessions rehydrated from the journal have not re-attached
+    // yet; a round run without them would drop their endpoints from every
+    // peer list and tear the surviving mesh down (limbo resolves by resume
+    // or expiry, both of which re-check)
+    if (!limbo_.empty()) return;
     auto acc = accepted_clients();
     bool any_pending = clients_.size() > acc.size();
     if (acc.empty() && !any_pending) return;
@@ -209,10 +353,12 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
     for (auto &[_, c] : clients_)
         if (!c.accepted) {
             c.accepted = true;
+            journal_client(c);
             PLOG(kInfo) << "admitted " << proto::uuid_str(c.uuid) << " to group "
                         << c.peer_group;
         }
     ++topology_revision_;
+    if (journal_) journal_->record_topology_revision(topology_revision_);
     establish_in_flight_ = true;
     round_members_.clear();
     std::set<uint32_t> groups;
@@ -223,7 +369,10 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
         c.establish_failed.clear();
         groups.insert(c.peer_group);
     }
-    for (uint32_t g : groups) build_ring(g);
+    for (uint32_t g : groups) {
+        build_ring(g);
+        if (journal_) journal_->record_ring(g, groups_[g].ring);
+    }
 
     for (auto &[_, c] : clients_) {
         proto::P2PConnInfo info;
@@ -348,10 +497,19 @@ void MasterState::check_collective(std::vector<Outbox> &out, uint32_t group, uin
     auto members = group_members(group);
 
     if (!op.commenced) {
+        // HA freeze: a group member is in limbo (master restarted, session
+        // not yet resumed) — commencing without it would run the ring over a
+        // membership the clients' rings disagree with
+        if (group_frozen(group)) return;
         for (auto *m : members)
             if (!op.initiated.count(m->uuid)) return;
         op.commenced = true;
         op.seq = next_seq_++;
+        if (journal_ && next_seq_ > seq_bound_) {
+            // batched: journal a stride-ahead bound, not every seq
+            seq_bound_ = next_seq_ + 1024;
+            journal_->record_seq_bound(seq_bound_);
+        }
         for (auto *m : members) op.members.insert(m->uuid);
         for (auto *m : members) {
             wire::Writer w;
@@ -461,6 +619,7 @@ std::vector<Outbox> MasterState::on_shared_state_sync(uint64_t conn,
 
 void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
     if (groups_[group].sync_in_flight) return; // round already answered
+    if (group_frozen(group)) return; // HA freeze (see check_collective)
     auto members = group_members(group);
     if (members.empty()) return;
     for (auto *m : members)
@@ -637,6 +796,7 @@ std::vector<Outbox> MasterState::on_dist_done(uint64_t conn) {
     g.last_revision = g.sync_revision;
     g.revision_initialized = true;
     g.sync_in_flight = false;
+    if (journal_) journal_->record_group(c->peer_group, g.last_revision, true);
     PLOG(kDebug) << "shared-state sync complete, group " << c->peer_group << " revision "
                  << g.last_revision;
     telemetry::Recorder::inst().instant("membership", "master_sync_complete",
@@ -657,6 +817,7 @@ std::vector<Outbox> MasterState::on_optimize(uint64_t conn) {
 }
 
 void MasterState::check_optimize(std::vector<Outbox> &out) {
+    if (!limbo_.empty()) return; // HA freeze (optimize rounds are global)
     auto acc = accepted_clients();
     if (acc.empty()) return;
     if (!optimize_in_flight_) {
@@ -766,6 +927,7 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
             std::vector<Uuid> ring;
             for (int idx : tour) ring.push_back(m_uuids[idx]);
             groups_[gid].ring = ring;
+            if (journal_) journal_->record_ring(gid, ring);
             spawn_moonshot(gid, m_uuids, cost, tour);
         }
     }
@@ -831,6 +993,7 @@ std::vector<Outbox> MasterState::on_bandwidth_report(uint64_t conn, const Uuid &
     auto *c = by_conn(conn);
     if (!c) return out;
     bandwidth_.store(c->uuid, to, mbps);
+    if (journal_) journal_->record_bandwidth(c->uuid, to, mbps);
     return out;
 }
 
@@ -851,12 +1014,20 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
     if (it == clients_.end()) return out;
     ClientInfo gone = it->second;
     clients_.erase(it);
-    bandwidth_.forget(gone.uuid);
+    if (journal_) journal_->record_client_remove(gone.uuid);
     PLOG(kInfo) << "client " << proto::uuid_str(gone.uuid) << " disconnected, world="
                 << world_size();
     telemetry::Recorder::inst().instant("membership", "master_peer_left",
                                         "group", gone.peer_group, "world",
                                         world_size());
+    remove_client(out, gone);
+    return out;
+}
+
+// shared tail of on_disconnect and limbo expiry: the client is already out
+// of clients_/limbo_ — prune its traces and re-check every consensus
+void MasterState::remove_client(std::vector<Outbox> &out, const ClientInfo &gone) {
+    bandwidth_.forget(gone.uuid);
 
     // abort running collectives in its group, prune its votes from ops
     abort_group_collectives(out, gone.peer_group);
@@ -869,15 +1040,20 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
         // last member gone: reset the group's shared-state revision tracking.
         // A fresh cohort is a logical resume (any first revision legal, like
         // a restarted master) — without this, workers restarted from an older
-        // checkpoint against a long-lived master could never sync again
-        if (group_members(gone.peer_group).empty()) {
+        // checkpoint against a long-lived master could never sync again.
+        // Limbo members count as present: their sessions may still resume.
+        if (group_members(gone.peer_group).empty() &&
+            !group_frozen(gone.peer_group)) {
             git->second = GroupState{};
+            if (journal_) {
+                journal_->record_group(gone.peer_group, 0, false);
+                journal_->record_ring(gone.peer_group, {});
+            }
             PLOG(kInfo) << "peer group " << gone.peer_group
                         << " emptied; shared-state revision tracking reset";
         }
     }
     recheck_all(out);
-    return out;
 }
 
 void MasterState::recheck_all(std::vector<Outbox> &out) {

@@ -19,6 +19,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <map>
 #include <memory>
@@ -30,6 +31,7 @@
 #include <vector>
 
 #include "bandwidth.hpp"
+#include "journal.hpp"
 #include "protocol.hpp"
 
 namespace pcclt::master {
@@ -85,9 +87,24 @@ class MasterState {
 public:
     ~MasterState();
 
+    // --- HA: journal attachment + rehydration (call before any event) ---
+    // Rehydrated clients enter LIMBO: known by UUID with their endpoint
+    // info, awaiting kC2MSessionResume. While a group has limbo members,
+    // its consensus rounds are frozen (a round completed without them
+    // would treat a merely-disconnected peer as departed); limbo entries
+    // expire after PCCLT_MASTER_LIMBO_MS (default 15000) and are then
+    // treated exactly like a disconnect.
+    void attach_journal(journal::Journal *j);
+    uint64_t epoch() const { return epoch_; }
+    size_t limbo_count() const { return limbo_.size(); }
+
     // --- event handlers: apply + return packets to send ---
     std::vector<Outbox> on_hello(uint64_t conn, const net::Addr &src_ip,
                                  const proto::HelloC2M &h);
+    std::vector<Outbox> on_session_resume(uint64_t conn, const net::Addr &src_ip,
+                                          const proto::SessionResumeC2M &s);
+    // periodic housekeeping from the dispatcher (limbo expiry)
+    std::vector<Outbox> on_tick();
     std::vector<Outbox> on_topology_update(uint64_t conn);
     std::vector<Outbox> on_peers_pending_query(uint64_t conn);
     std::vector<Outbox> on_p2p_established(uint64_t conn, uint64_t revision, bool ok,
@@ -116,6 +133,12 @@ private:
     std::vector<Uuid> build_ring(uint32_t group);
 
     void kick(std::vector<Outbox> &out, ClientInfo &c, const std::string &reason);
+    // shared tail of on_disconnect and limbo expiry: prune the departed
+    // client's votes/ops, reset emptied groups, re-check every consensus
+    void remove_client(std::vector<Outbox> &out, const ClientInfo &gone);
+    // HA freeze gates: no round may complete while its members sit in limbo
+    bool group_frozen(uint32_t group) const;
+    void journal_client(const ClientInfo &c);
 
     // consensus checks — called after votes change AND after disconnects
     void check_topology(std::vector<Outbox> &out);
@@ -132,11 +155,26 @@ private:
     std::map<uint64_t, ClientInfo> clients_; // by conn_id
     std::map<uint32_t, GroupState> groups_;
 
+    // HA: journal (owned by Master; null = disabled), this incarnation's
+    // epoch, and rehydrated sessions awaiting resume
+    journal::Journal *journal_ = nullptr;
+    uint64_t epoch_ = 1;
+    struct LimboClient {
+        ClientInfo info; // conn_id 0 (no connection yet)
+        std::chrono::steady_clock::time_point deadline;
+    };
+    std::map<Uuid, LimboClient> limbo_;
+
     // topology / establishment round
     bool establish_in_flight_ = false;
     std::set<Uuid> round_members_;
     uint64_t topology_revision_ = 0;
     uint64_t next_seq_ = 1;
+    // journaled upper bound on issued collective seqs (stride-batched so the
+    // journal is not written per collective); a restarted master resumes
+    // ABOVE every seq the previous incarnation could have issued — seq-scoped
+    // tag ranges in client sink tables are never reused across an epoch
+    uint64_t seq_bound_ = 0;
 
     // optimization round
     bool optimize_in_flight_ = false;

@@ -66,6 +66,54 @@ std::optional<HelloC2M> HelloC2M::decode(const std::vector<uint8_t> &b) {
     } catch (...) { return std::nullopt; }
 }
 
+// --- SessionResumeC2M / SessionResumeAck (master HA) ---
+
+std::vector<uint8_t> SessionResumeC2M::encode() const {
+    wire::Writer w;
+    put_uuid(w, uuid);
+    w.u64(last_revision);
+    w.u16(p2p_port);
+    w.u16(ss_port);
+    w.u16(bench_port);
+    w.str(adv_ip);
+    return w.take();
+}
+
+std::optional<SessionResumeC2M> SessionResumeC2M::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        SessionResumeC2M s;
+        s.uuid = get_uuid(r);
+        s.last_revision = r.u64();
+        s.p2p_port = r.u16();
+        s.ss_port = r.u16();
+        s.bench_port = r.u16();
+        s.adv_ip = r.str();
+        return s;
+    } catch (...) { return std::nullopt; }
+}
+
+std::vector<uint8_t> SessionResumeAck::encode() const {
+    wire::Writer w;
+    w.u8(ok);
+    w.u64(epoch);
+    w.u64(last_revision);
+    w.str(reason);
+    return w.take();
+}
+
+std::optional<SessionResumeAck> SessionResumeAck::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        SessionResumeAck a;
+        a.ok = r.u8();
+        a.epoch = r.u64();
+        a.last_revision = r.u64();
+        a.reason = r.str();
+        return a;
+    } catch (...) { return std::nullopt; }
+}
+
 namespace {
 
 // Family-tagged wire addresses (PCCP/2): a u8 family then 4 bytes (v4,

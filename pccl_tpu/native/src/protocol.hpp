@@ -43,6 +43,10 @@ enum PacketType : uint16_t {
     kC2MOptimizeTopology = 0x1009,
     kC2MBandwidthReport = 0x100A,
     kC2MOptimizeWorkDone = 0x100B,
+    // re-attach under an existing UUID after a master restart (HA resume;
+    // only honored when the restarted master rehydrated this session from
+    // its journal — see journal.hpp and docs/10_high_availability.md)
+    kC2MSessionResume = 0x100C,
 
     // master -> client
     kM2CWelcome = 0x2001,
@@ -64,6 +68,7 @@ enum PacketType : uint16_t {
     // loop retries after its next collective (deadlock tie-break; see
     // MasterState::defer_topology_voters)
     kM2CTopologyDeferred = 0x200D,
+    kM2CSessionResumeAck = 0x200E,
 
     // p2p handshake
     kP2PHello = 0x3001,
@@ -106,6 +111,28 @@ struct HelloC2M {
     std::string adv_ip; // empty = use source address of the connection
     std::vector<uint8_t> encode() const;
     static std::optional<HelloC2M> decode(const std::vector<uint8_t> &);
+};
+
+// Session resume after a master restart (HA). The client re-presents its
+// UUID plus the last shared-state revision it saw complete; a journaled
+// master that rehydrated this session re-binds it (same UUID, same
+// membership, ring preserved) instead of forcing a fresh registration.
+struct SessionResumeC2M {
+    Uuid uuid{};
+    uint64_t last_revision = 0;
+    uint16_t p2p_port = 0, ss_port = 0, bench_port = 0; // re-advertised
+    std::string adv_ip;
+    std::vector<uint8_t> encode() const;
+    static std::optional<SessionResumeC2M> decode(const std::vector<uint8_t> &);
+};
+
+struct SessionResumeAck {
+    uint8_t ok = 0;           // 0 = unknown session (client must re-register)
+    uint64_t epoch = 0;       // master epoch (bumped on every restart)
+    uint64_t last_revision = 0; // master's view of the group revision
+    std::string reason;       // diagnostic on rejection
+    std::vector<uint8_t> encode() const;
+    static std::optional<SessionResumeAck> decode(const std::vector<uint8_t> &);
 };
 
 struct PeerEndpoint {

@@ -13,6 +13,7 @@
 #include "atsp.hpp"
 #include "client.hpp"
 #include "guarded_alloc.hpp"
+#include "journal.hpp"
 #include "hash.hpp"
 #include "kernels.hpp"
 #include "master.hpp"
@@ -345,6 +346,178 @@ static void test_quant_16bit_parity() {
             }
         }
     }
+}
+
+static void test_journal() {
+    const char *path = "/tmp/pcclt_selftest_journal.bin";
+    remove(path);
+    proto::Uuid u1 = proto::uuid_random(), u2 = proto::uuid_random();
+    {
+        journal::Journal j;
+        CHECK(j.open(path));
+        CHECK(j.epoch() == 1);
+        CHECK(!j.restored().any);
+        j.record_client({u1, 0, "127.0.0.1", 1001, 1002, 1003, true});
+        j.record_client({u2, 1, "10.0.0.2", 2001, 2002, 2003, false});
+        j.record_group(0, 7, true);
+        j.record_ring(0, {u1, u2});
+        j.record_topology_revision(5);
+        j.record_seq_bound(4096);
+        j.record_bandwidth(u1, u2, 123.5);
+        // a removed client must not resurrect on replay
+        proto::Uuid u3 = proto::uuid_random();
+        j.record_client({u3, 0, "127.0.0.3", 1, 2, 3, true});
+        j.record_client_remove(u3);
+    }
+    {
+        // snapshot + deltas -> rehydrate -> identical state, bumped epoch
+        journal::Journal j;
+        CHECK(j.open(path));
+        CHECK(j.epoch() == 2);
+        const auto &r = j.restored();
+        CHECK(r.any);
+        CHECK(r.clients.size() == 2);
+        CHECK(r.clients.count(u1) && r.clients.count(u2));
+        const auto &c1 = r.clients.at(u1);
+        CHECK(c1.peer_group == 0 && c1.ip == "127.0.0.1" && c1.p2p_port == 1001 &&
+              c1.ss_port == 1002 && c1.bench_port == 1003 && c1.accepted);
+        CHECK(!r.clients.at(u2).accepted && r.clients.at(u2).peer_group == 1);
+        CHECK(r.topology_revision == 5);
+        CHECK(r.next_seq == 4096);
+        CHECK(r.groups.at(0).last_revision == 7 &&
+              r.groups.at(0).revision_initialized);
+        CHECK(r.groups.at(0).ring == (std::vector<proto::Uuid>{u1, u2}));
+        CHECK(r.bandwidth.size() == 1 && r.bandwidth[0].from == u1 &&
+              r.bandwidth[0].to == u2 && r.bandwidth[0].mbps == 123.5);
+    }
+    {
+        // torn tail (crash mid-append): replay stops clean at the valid prefix
+        FILE *f = fopen(path, "ab");
+        CHECK(f != nullptr);
+        uint8_t torn[7] = {0, 0, 0, 50, 2, 1, 2}; // claims 50 bytes, has 2
+        fwrite(torn, 1, sizeof torn, f);
+        fclose(f);
+        journal::Journal j;
+        CHECK(j.open(path));
+        CHECK(j.epoch() == 3);
+        CHECK(j.restored().clients.size() == 2);
+    }
+    remove(path);
+}
+
+// Master HA at the state-machine level: run a 2-client world against a
+// journaled MasterState, drop it (simulated SIGKILL), rehydrate a fresh
+// MasterState from the same journal, and resume both sessions — same
+// UUIDs, preserved ring + revision, frozen rounds while a session is
+// still in limbo, bumped epoch.
+static void test_master_ha_state() {
+    const char *path = "/tmp/pcclt_selftest_ha_journal.bin";
+    remove(path);
+    using master::Outbox;
+    auto find = [](const std::vector<Outbox> &out, uint64_t conn,
+                   uint16_t type) -> const Outbox * {
+        for (const auto &o : out)
+            if (o.conn_id == conn && o.type == type) return &o;
+        return nullptr;
+    };
+    auto uuid_of_welcome = [](const Outbox &o) {
+        wire::Reader r(o.payload);
+        CHECK(r.u8() == 1);
+        return proto::get_uuid(r);
+    };
+    net::Addr ip = *net::Addr::parse("127.0.0.1", 0);
+    proto::Uuid ua{}, ub{};
+    {
+        journal::Journal j;
+        CHECK(j.open(path));
+        master::MasterState st;
+        st.attach_journal(&j);
+        CHECK(st.epoch() == 1);
+        proto::HelloC2M h;
+        h.p2p_port = 100;
+        h.ss_port = 101;
+        h.bench_port = 102;
+        auto out = st.on_hello(1, ip, h); // empty world: admitted immediately
+        auto *w = find(out, 1, proto::kM2CWelcome);
+        CHECK(w != nullptr);
+        ua = uuid_of_welcome(*w);
+        {
+            // welcome carries the epoch after the uuid + banner string
+            wire::Reader r(w->payload);
+            r.u8();
+            proto::get_uuid(r);
+            r.str();
+            CHECK(r.u64() == 1);
+        }
+        out = st.on_p2p_established(1, 1, true, {});
+        CHECK(find(out, 1, proto::kM2CP2PEstablishedResp) != nullptr);
+        h.p2p_port = 200;
+        out = st.on_hello(2, ip, h);
+        ub = uuid_of_welcome(*find(out, 2, proto::kM2CWelcome));
+        out = st.on_topology_update(1); // incumbent vote admits the joiner
+        CHECK(find(out, 1, proto::kM2CP2PConnInfo) != nullptr);
+        CHECK(find(out, 2, proto::kM2CP2PConnInfo) != nullptr);
+        out = st.on_p2p_established(1, 2, true, {});
+        auto out2 = st.on_p2p_established(2, 2, true, {});
+        CHECK(find(out2, 1, proto::kM2CP2PEstablishedResp) != nullptr);
+        // one shared-state round at revision 3 (fresh master: any bootstraps)
+        proto::SharedStateSyncC2M sync;
+        sync.revision = 3;
+        st.on_shared_state_sync(1, sync);
+        out = st.on_shared_state_sync(2, sync);
+        CHECK(find(out, 1, proto::kM2CSharedStateSyncResp) != nullptr);
+        st.on_dist_done(1);
+        out = st.on_dist_done(2);
+        CHECK(find(out, 2, proto::kM2CSharedStateDone) != nullptr);
+        // MasterState dropped here without disconnects = simulated crash
+    }
+    {
+        journal::Journal j;
+        CHECK(j.open(path));
+        master::MasterState st;
+        st.attach_journal(&j);
+        CHECK(st.epoch() == 2);
+        CHECK(st.limbo_count() == 2);
+        // session resume under the OLD uuids on fresh conns
+        proto::SessionResumeC2M ra;
+        ra.uuid = ua;
+        ra.last_revision = 3;
+        auto out = st.on_session_resume(11, ip, ra);
+        auto *ack = find(out, 11, proto::kM2CSessionResumeAck);
+        CHECK(ack != nullptr);
+        auto dec = proto::SessionResumeAck::decode(ack->payload);
+        CHECK(dec && dec->ok == 1 && dec->epoch == 2 && dec->last_revision == 3);
+        CHECK(st.limbo_count() == 1);
+        // rounds stay FROZEN while b is still in limbo: a's collective
+        // init must not commence a 1-member op
+        proto::CollectiveInit ci;
+        ci.tag = 9;
+        ci.count = 16;
+        out = st.on_collective_init(11, ci);
+        CHECK(find(out, 11, proto::kM2CCollectiveCommence) == nullptr);
+        proto::SessionResumeC2M rb;
+        rb.uuid = ub;
+        rb.last_revision = 3;
+        out = st.on_session_resume(12, ip, rb);
+        CHECK(st.limbo_count() == 0);
+        CHECK(st.world_size() == 2); // zero re-registrations
+        out = st.on_collective_init(12, ci);
+        CHECK(find(out, 11, proto::kM2CCollectiveCommence) != nullptr);
+        CHECK(find(out, 12, proto::kM2CCollectiveCommence) != nullptr);
+        // an unknown uuid is rejected (no journaled session)
+        proto::SessionResumeC2M rx;
+        rx.uuid = proto::uuid_random();
+        out = st.on_session_resume(13, ip, rx);
+        auto rej = proto::SessionResumeAck::decode(
+            find(out, 13, proto::kM2CSessionResumeAck)->payload);
+        CHECK(rej && rej->ok == 0);
+        // revision continuity: the next sync must expect revision 4
+        proto::SharedStateSyncC2M stale;
+        stale.revision = 9; // > last+1: increment violation -> kick
+        out = st.on_shared_state_sync(11, stale);
+        CHECK(find(out, 11, proto::kM2CKicked) != nullptr);
+    }
+    remove(path);
 }
 
 static void test_atsp() {
@@ -701,6 +874,8 @@ int main() {
     test_kernels();
     test_quant();
     test_quant_16bit_parity();
+    test_journal();
+    test_master_ha_state();
     test_atsp();
     {
         // guarded allocator: bytes usable end-to-end, balanced live count

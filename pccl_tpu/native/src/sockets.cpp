@@ -446,6 +446,24 @@ bool ControlClient::connect(const Addr &addr) {
     return true;
 }
 
+bool ControlClient::reconnect(const Addr &addr) {
+    close(); // joins the old reader; wakes matched-receive waiters
+    {
+        // drop frames of the dead session: a stale queued packet must never
+        // satisfy a post-resume recv_match
+        std::lock_guard lk(mu_);
+        queue_.clear();
+    }
+    // exclude in-flight writers before swapping the socket: a sender that
+    // entered send_frame before connected_ flipped could otherwise write the
+    // TAIL of its stale frame into the fresh connection, corrupting the
+    // resumed session's framing (close() already failed its socket, so the
+    // writer exits promptly and we take the lock)
+    std::lock_guard wl(write_mu_);
+    sock_ = Socket();
+    return connect(addr);
+}
+
 void ControlClient::run(std::function<void()> on_disconnect) {
     on_disconnect_ = std::move(on_disconnect);
     reader_ = std::thread([this] {

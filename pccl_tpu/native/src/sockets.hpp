@@ -144,6 +144,12 @@ class ControlClient {
 public:
     ~ControlClient() { close(); }
     bool connect(const Addr &addr);
+    // Tear down the current socket/reader/queue and dial `addr` afresh on
+    // the SAME object (master HA session resume). Pending recv_match
+    // waiters wake with nullopt when the old socket dies; the caller must
+    // re-issue any request that was in flight. Call run() again after a
+    // successful reconnect.
+    bool reconnect(const Addr &addr);
     // spawn reader thread; on_disconnect fires once when the socket dies
     void run(std::function<void()> on_disconnect = nullptr);
     bool send(uint16_t type, std::span<const uint8_t> payload);

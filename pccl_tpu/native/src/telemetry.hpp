@@ -74,6 +74,10 @@ struct CommCounters {
     std::atomic<uint64_t> kicked{0};
     std::atomic<uint64_t> peers_joined{0};
     std::atomic<uint64_t> peers_left{0};
+    // master HA: control sessions resumed after a master restart, and p2p
+    // connections kept alive across a topology round (blip, not rebuild)
+    std::atomic<uint64_t> master_reconnects{0};
+    std::atomic<uint64_t> p2p_conns_reused{0};
 };
 
 struct EdgeSnapshot {

@@ -56,11 +56,14 @@ def main() -> int:
         TooFewPeersError,
     )
 
-    # losing the master link (master crash/restart, or we got kicked) is
-    # recovered by REJOINING with a fresh communicator — the reference
-    # recipe for master orchestration restarts (docs/md/05-ImplementationNotes/
-    # 03_MasterOrchestration.md): restart master, peers reconnect, the
-    # revision-0 master accepts whatever revision the cohort offers
+    # Losing the master link is now a two-tier recovery (docs/10):
+    #  1. the native client transparently session-resumes against a
+    #     journaled restarted master (same uuid, p2p mesh kept) — ops
+    #     surface at worst a retryable ConnectionLost/Aborted;
+    #  2. only when resume is impossible (no journal, budget exhausted,
+    #     kicked) does the error land here and we REJOIN with a fresh
+    #     communicator — the reference recipe for master restarts
+    #     (docs/md/05-ImplementationNotes/03_MasterOrchestration.md).
     master_loss = (ConnectionLostError, MasterUnreachableError, KickedError)
 
     def build_comm(budget_s: float = 90.0):
@@ -80,6 +83,7 @@ def main() -> int:
                 time.sleep(0.5)
 
     def rejoin(old):
+        print("REJOIN", flush=True)
         try:
             old.destroy()
         except Exception:  # noqa: BLE001 — link already dead
@@ -103,6 +107,7 @@ def main() -> int:
     x = np.ones(args.count, dtype=np.float32)
     y = np.empty_like(x)
     step = 0
+    last_resumes = 0
     while step < args.steps:
         if args.die_prob > 0 and rng.rand() < args.die_prob:
             print(f"DYING at step {step}", flush=True)
@@ -158,6 +163,15 @@ def main() -> int:
         if info is not None and abs(float(y[0]) - world) > tol:
             print(f"WRONG RESULT step={step} y={y[0]} world={world}", flush=True)
             return 3
+        # surface HA session resumes (absorbed master restarts) so the
+        # stress orchestrator can count resumes vs full rejoins
+        try:
+            rc = comm.reconnect_count
+        except Exception:  # noqa: BLE001 — older lib without the attribute
+            rc = 0
+        if rc > last_resumes:
+            print(f"RESUMED total={rc} epoch={comm.master_epoch}", flush=True)
+        last_resumes = rc  # a rejoin resets the comm's counter to 0
         print(f"STEP {step} world={world} rank={args.rank}", flush=True)
         step += 1
         if args.step_interval > 0:

@@ -1,0 +1,176 @@
+"""Master-HA test peer (subprocess worker for tests/test_master_ha.py).
+
+Unlike tests/ft_peer.py — which recovers from master loss by REJOINING with
+a fresh communicator — this peer relies entirely on the native session
+resume: the master may be SIGKILLed and restarted (with a journal) under
+it, and every step must complete under the ORIGINAL uuid. Any
+MasterUnreachableError/KickedError is fatal (exit 4): with the journal +
+resume enabled a master restart must be a blip, never an identity reset.
+
+Each step runs one shared-state sync (deterministic content, lockstep
+revision) and one all-reduce, then prints a machine-parsable line:
+
+    STEP <n> rev=<revision> world=<w> resumes=<k> epoch=<e> \
+        ss_rx=<bytes> ss_tx=<bytes> conns=<p2p edge connects>
+
+The test asserts from these lines: revision monotonicity across the
+outage, zero sync bytes moved post-resume (no full shared-state
+retransmit), stable p2p connect counts (mesh kept alive), and a bumped
+epoch with resumes >= 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-port", type=int, required=True)
+    ap.add_argument("--base-port", type=int, required=True)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--min-world", type=int, default=2)
+    ap.add_argument("--step-interval", type=float, default=0.1)
+    ap.add_argument("--count", type=int, default=16384)
+    ap.add_argument("--reconnect-attempts", type=int, default=12)
+    ap.add_argument("--reconnect-backoff-ms", type=int, default=100)
+    ap.add_argument("--reconnect-cap-ms", type=int, default=1000)
+    args = ap.parse_args()
+
+    from pccl_tpu.comm import (
+        Communicator,
+        ConnectionLostError,
+        KickedError,
+        MasterUnreachableError,
+        OperationAbortedError,
+        PcclError,
+        ReduceOp,
+        SharedState,
+        TensorInfo,
+        TooFewPeersError,
+    )
+
+    comm = None
+    deadline = time.time() + 60
+    while True:
+        comm = Communicator("127.0.0.1", args.master_port,
+                            p2p_port=args.base_port,
+                            ss_port=args.base_port + 4,
+                            bench_port=args.base_port + 8,
+                            reconnect_attempts=args.reconnect_attempts,
+                            reconnect_backoff_ms=args.reconnect_backoff_ms,
+                            reconnect_backoff_cap_ms=args.reconnect_cap_ms)
+        try:
+            comm.connect()
+            break
+        except PcclError:
+            comm.destroy()
+            if time.time() > deadline:
+                print("FATAL connect timeout", flush=True)
+                return 2
+            time.sleep(0.3)
+
+    while comm.world_size < args.min_world:
+        if time.time() > deadline:
+            print("TIMEOUT waiting for world", flush=True)
+            return 2
+        try:
+            if comm.are_peers_pending():
+                comm.update_topology()
+        except (MasterUnreachableError, KickedError) as e:
+            print(f"FATAL {type(e).__name__} during formation", flush=True)
+            return 4
+        except PcclError:
+            pass
+        time.sleep(0.02)
+
+    # shared state: deterministic lockstep content so a healthy world syncs
+    # with ZERO bytes moved (all hashes equal); the step count drives the
+    # revision, so every peer offers the same revision each step
+    state_arr = np.zeros(args.count, dtype=np.float32)
+    x = np.ones(args.count, dtype=np.float32)
+    y = np.empty_like(x)
+
+    step = 0
+    rev = 0
+    while step < args.steps:
+        # admit pending joiners (none expected in this harness, but keeps
+        # the loop shaped like real training)
+        try:
+            if comm.are_peers_pending():
+                comm.update_topology()
+        except (MasterUnreachableError, KickedError) as e:
+            print(f"FATAL {type(e).__name__}", flush=True)
+            return 4
+        except PcclError:
+            time.sleep(0.05)
+            continue
+
+        target_rev = rev + 1
+        state_arr[:] = float(target_rev)  # same bytes on every peer
+        ss_rx = ss_tx = 0
+        try:
+            info = comm.sync_shared_state(SharedState(
+                [TensorInfo.from_numpy("w", state_arr)], revision=target_rev))
+            rev = info.revision
+            ss_rx, ss_tx = info.rx_bytes, info.tx_bytes
+        except (MasterUnreachableError, KickedError) as e:
+            print(f"FATAL {type(e).__name__}", flush=True)
+            return 4
+        except (ConnectionLostError, OperationAbortedError):
+            # the round died with the old master. If the resume ack says the
+            # revision completed group-wide just before the crash, adopt it;
+            # otherwise retry the same revision on the resumed session.
+            if comm.shared_state_revision >= target_rev:
+                rev = comm.shared_state_revision
+            else:
+                time.sleep(0.05)
+                continue
+
+        try:
+            info = comm.all_reduce(x, y, op=ReduceOp.SUM)
+            world = info.world_size
+        except (MasterUnreachableError, KickedError) as e:
+            print(f"FATAL {type(e).__name__}", flush=True)
+            return 4
+        except (ConnectionLostError, OperationAbortedError):
+            try:
+                comm.update_topology()
+            except (MasterUnreachableError, KickedError) as e:
+                print(f"FATAL {type(e).__name__}", flush=True)
+                return 4
+            except PcclError:
+                time.sleep(0.05)
+            continue
+        except TooFewPeersError:
+            print("FATAL TooFewPeersError (world must never shrink here)",
+                  flush=True)
+            return 4
+        if abs(float(y[0]) - world) > 1e-5:
+            print(f"WRONG RESULT step={step} y={y[0]} world={world}",
+                  flush=True)
+            return 3
+
+        conns = sum(e["connects"] for e in comm.stats()["edges"].values())
+        print(f"STEP {step} rev={rev} world={world} "
+              f"resumes={comm.reconnect_count} epoch={comm.master_epoch} "
+              f"ss_rx={ss_rx} ss_tx={ss_tx} conns={conns}", flush=True)
+        step += 1
+        if args.step_interval > 0:
+            time.sleep(args.step_interval)
+
+    comm.destroy()
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

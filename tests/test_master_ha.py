@@ -1,0 +1,227 @@
+"""Master high availability: journaled master state + client session resume.
+
+The tentpole contract (docs/10_high_availability.md): with a journal and
+resume enabled, SIGKILLing the master mid-training and restarting it on the
+same port is a BLIP — every peer re-attaches under its old UUID (zero
+re-registrations, asserted via the epoch/resume attributes), the
+shared-state revision stays monotonic across the outage, and no shared-state
+bytes are retransmitted on resume (asserted via the sync byte counters and
+the per-edge connect counters: the p2p mesh is never rebuilt). Without a
+journal, the failure path stays clean: reconnect budget exhausted ->
+MasterUnreachableError within the configured deadline, no hang.
+
+Multi-peer behavior is tested with real processes, never mocks (the repo's
+stress-test discipline; see tests/test_fault_tolerance.py).
+"""
+
+from __future__ import annotations
+
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PEER = REPO / "tests" / "ha_peer.py"
+LIB = REPO / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+from conftest import alloc_ports as _next_port  # noqa: E402
+
+
+class MasterProc:
+    """python -m pccl_tpu.comm.master as a SIGKILL-able subprocess."""
+
+    def __init__(self, port: int, journal: str | None = None):
+        self.port = port
+        cmd = [sys.executable, "-m", "pccl_tpu.comm.master",
+               "--port", str(port)]
+        if journal:
+            cmd += ["--journal", journal]
+        self.proc = subprocess.Popen(cmd, cwd=str(REPO),
+                                     stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    return
+            except OSError:
+                if self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"master died on startup: {self.proc.stdout.read()}")
+                time.sleep(0.05)
+        raise RuntimeError("master never started listening")
+
+    def sigkill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+
+class HaPeer:
+    def __init__(self, master_port: int, rank: int, base_port: int, **kw):
+        cmd = [sys.executable, str(PEER), "--master-port", str(master_port),
+               "--rank", str(rank), "--base-port", str(base_port)]
+        for k, v in kw.items():
+            cmd += [f"--{k.replace('_', '-')}", str(v)]
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True)
+        self.lines: list[str] = []
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            self.lines.append(line.rstrip())
+
+    def steps(self) -> list[dict]:
+        out = []
+        for ln in self.lines:
+            if not ln.startswith("STEP "):
+                continue
+            d = {"step": int(ln.split()[1])}
+            for tok in ln.split()[2:]:
+                k, v = tok.split("=")
+                d[k] = int(v)
+            out.append(d)
+        return out
+
+    def wait_for_step(self, step: int, timeout: float = 90) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if any(s["step"] >= step for s in self.steps()):
+                return True
+            if self.proc.poll() is not None:
+                return any(s["step"] >= step for s in self.steps())
+            time.sleep(0.05)
+        return False
+
+    def join(self, timeout: float = 120) -> int:
+        try:
+            return self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            raise
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait(timeout=10)
+
+
+def test_master_sigkill_restart_is_a_blip(tmp_path):
+    """SIGKILL + restart the master mid-training on a 3-peer loopback world:
+    collectives resume, zero re-registrations (same uuids: resumes>=1 and
+    epoch 2 on every peer), shared-state revision monotonic across the
+    outage, and no shared-state retransmit nor p2p reconnect on resume."""
+    journal = str(tmp_path / "master.journal")
+    port = _next_port()
+    base = _next_port(64)
+    master = MasterProc(port, journal)
+    peers = [HaPeer(port, r, base + r * 16, steps=28, min_world=3,
+                    step_interval=0.15) for r in range(3)]
+    try:
+        for p in peers:
+            assert p.wait_for_step(5), f"peer stalled: {p.lines[-8:]}"
+        master.sigkill()
+        time.sleep(1.0)  # a real outage window, mid-training
+        master = MasterProc(port, journal)
+
+        for i, p in enumerate(peers):
+            assert p.join() == 0, f"peer {i} failed: {p.lines[-12:]}"
+            steps = p.steps()
+            assert steps[-1]["step"] == 27, f"peer {i} incomplete: {steps[-1]}"
+            # zero re-registrations: the outage was absorbed by session
+            # resume (epoch bumped to 2) under the original uuid
+            assert steps[-1]["resumes"] >= 1, f"peer {i} never resumed"
+            assert steps[-1]["epoch"] == 2, f"peer {i} epoch: {steps[-1]}"
+            assert not any("FATAL" in ln or "REJOIN" in ln for ln in p.lines)
+            # world never shrank: membership survived the restart intact
+            assert all(s["world"] == 3 for s in steps), \
+                f"peer {i} world dipped: {sorted({s['world'] for s in steps})}"
+            # shared-state revision monotonic ACROSS the outage, and it kept
+            # advancing afterwards
+            revs = [s["rev"] for s in steps]
+            assert revs == sorted(revs), f"peer {i} revision regressed: {revs}"
+            pre = [s for s in steps if s["resumes"] == 0]
+            post = [s for s in steps if s["resumes"] >= 1]
+            assert post, f"peer {i} made no post-resume steps"
+            assert post[-1]["rev"] > pre[-1]["rev"], \
+                f"peer {i} revision stalled across the outage"
+            # no full shared-state retransmit on resume: every post-resume
+            # sync moved ZERO bytes (hashes agree; only control traffic)
+            assert all(s["ss_rx"] == 0 and s["ss_tx"] == 0 for s in post), \
+                f"peer {i} resynced bytes post-resume: {post}"
+            # the p2p mesh was kept alive: no new data-plane connections
+            # after the resume (per-edge connect counters are monotonic)
+            assert post[-1]["conns"] == pre[-1]["conns"], \
+                f"peer {i} rebuilt p2p conns: {pre[-1]} -> {post[-1]}"
+    finally:
+        for p in peers:
+            p.kill()
+        master.sigkill()
+
+
+def test_no_journal_fails_fast(tmp_path):
+    """Journal-disabled failure path: with no journal and the reconnect
+    budget exhausted, peers surface MasterUnreachableError within the
+    configured deadline — no hang, no leaked subprocess."""
+    port = _next_port()
+    base = _next_port(64)
+    master = MasterProc(port, journal=None)
+    # small, deterministic budget: 3 attempts x (<=200 ms backoff)
+    peers = [HaPeer(port, r, base + r * 16, steps=1000, min_world=2,
+                    step_interval=0.1, reconnect_attempts=3,
+                    reconnect_backoff_ms=50, reconnect_cap_ms=200)
+             for r in range(2)]
+    try:
+        for p in peers:
+            assert p.wait_for_step(3), f"peer stalled: {p.lines[-8:]}"
+        t_kill = time.time()
+        master.sigkill()  # and never restart
+        for i, p in enumerate(peers):
+            # budget: ~0.3 s of backoff + connect failures; 30 s is a hard
+            # ceiling that still catches a 300/600 s protocol-timeout hang
+            rc = p.join(timeout=30)
+            assert rc == 4, f"peer {i} exit {rc}: {p.lines[-12:]}"
+            assert any("FATAL MasterUnreachableError" in ln for ln in p.lines), \
+                f"peer {i}: {p.lines[-12:]}"
+        assert time.time() - t_kill < 30
+    finally:
+        for p in peers:
+            p.kill()
+        master.sigkill()
+
+
+def test_resume_rejected_without_journal(tmp_path):
+    """A master restarted WITHOUT a journal cannot resume sessions: the
+    resume is rejected and the client surfaces MasterUnreachableError (the
+    identity-reset signal the rejoin path keys on) instead of hanging."""
+    port = _next_port()
+    base = _next_port(64)
+    master = MasterProc(port, journal=None)
+    peers = [HaPeer(port, r, base + r * 16, steps=1000, min_world=2,
+                    step_interval=0.1, reconnect_attempts=10,
+                    reconnect_backoff_ms=50, reconnect_cap_ms=300)
+             for r in range(2)]
+    try:
+        for p in peers:
+            assert p.wait_for_step(3), f"peer stalled: {p.lines[-8:]}"
+        master.sigkill()
+        time.sleep(0.5)
+        master = MasterProc(port, journal=None)  # fresh state, no limbo
+        for i, p in enumerate(peers):
+            rc = p.join(timeout=60)
+            assert rc == 4, f"peer {i} exit {rc}: {p.lines[-12:]}"
+            assert any("FATAL MasterUnreachableError" in ln for ln in p.lines)
+    finally:
+        for p in peers:
+            p.kill()
+        master.sigkill()
