@@ -1,0 +1,138 @@
+// Clang thread-safety annotations + annotated lock primitives.
+//
+// Compile-time lock discipline for the native core: every shared field
+// declares the mutex that guards it (PCCLT_GUARDED_BY) and every function
+// declares its lock contract (PCCLT_REQUIRES / PCCLT_ACQUIRE / ...), so a
+// forgotten lock is a BUILD ERROR under `clang++ -Werror=thread-safety`
+// (cmake -DPCCLT_ANALYZE=ON, or `python -m tools.pcclt_check --checker tsa`
+// which drives the same analysis through libclang) instead of a data race
+// TSan catches only when a test happens to hit it. The macro set mirrors
+// the abseil/LLVM discipline (clang.llvm.org/docs/ThreadSafetyAnalysis);
+// under GCC (the default toolchain) every macro expands to nothing and
+// pcclt::Mutex is a zero-overhead veneer over std::mutex — verified by
+// pcclt_selftest's test_lock_annotations in the asan/tsan lanes.
+//
+// Usage rules (enforced tree-wide, see docs/11_static_analysis.md):
+//  * shared state uses pcclt::Mutex, never bare std::mutex — the analysis
+//    only understands annotated capabilities;
+//  * scoped locking uses pcclt::MutexLock (a SCOPED_CAPABILITY);
+//  * condition waits use pcclt::CondVar, whose wait(mu) REQUIRES(mu) —
+//    std::condition_variable's unique_lock protocol is invisible to the
+//    analysis and would leak unannotated unlock/relock pairs;
+//  * single-threaded-by-design classes keep using the runtime
+//    PCCLT_THREAD_GUARD (thread_guard.hpp) — that invariant ("only one
+//    thread ever enters") is not expressible as a capability.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+
+#if defined(__clang__) && defined(__has_attribute)
+#define PCCLT_TSA(x) __attribute__((x))
+#else
+#define PCCLT_TSA(x) // no-op: GCC/MSVC have no thread-safety analysis
+#endif
+
+// --- capability declarations ---
+#define PCCLT_CAPABILITY(x) PCCLT_TSA(capability(x))
+#define PCCLT_SCOPED_CAPABILITY PCCLT_TSA(scoped_lockable)
+
+// --- data annotations ---
+#define PCCLT_GUARDED_BY(x) PCCLT_TSA(guarded_by(x))
+#define PCCLT_PT_GUARDED_BY(x) PCCLT_TSA(pt_guarded_by(x))
+
+// --- function contracts ---
+#define PCCLT_REQUIRES(...) PCCLT_TSA(requires_capability(__VA_ARGS__))
+#define PCCLT_REQUIRES_SHARED(...) \
+    PCCLT_TSA(requires_shared_capability(__VA_ARGS__))
+#define PCCLT_ACQUIRE(...) PCCLT_TSA(acquire_capability(__VA_ARGS__))
+#define PCCLT_RELEASE(...) PCCLT_TSA(release_capability(__VA_ARGS__))
+#define PCCLT_TRY_ACQUIRE(...) PCCLT_TSA(try_acquire_capability(__VA_ARGS__))
+#define PCCLT_EXCLUDES(...) PCCLT_TSA(locks_excluded(__VA_ARGS__))
+#define PCCLT_RETURN_CAPABILITY(x) PCCLT_TSA(lock_returned(x))
+
+// --- ordering + escape hatch ---
+#define PCCLT_ACQUIRED_BEFORE(...) PCCLT_TSA(acquired_before(__VA_ARGS__))
+#define PCCLT_ACQUIRED_AFTER(...) PCCLT_TSA(acquired_after(__VA_ARGS__))
+// For the handful of protocols the analysis cannot express (lock handoff
+// across threads, init-before-publish). Every use must carry a comment
+// saying WHY the invariant holds.
+#define PCCLT_NO_TSA PCCLT_TSA(no_thread_safety_analysis)
+
+namespace pcclt {
+
+// std::mutex with a declared capability. Same layout, same codegen (every
+// member is a forwarding inline), but lockable state the analysis can track.
+class PCCLT_CAPABILITY("mutex") Mutex {
+public:
+    Mutex() = default;
+    Mutex(const Mutex &) = delete;
+    Mutex &operator=(const Mutex &) = delete;
+
+    void lock() PCCLT_ACQUIRE() { mu_.lock(); }
+    void unlock() PCCLT_RELEASE() { mu_.unlock(); }
+    bool try_lock() PCCLT_TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+private:
+    friend class CondVar;
+    std::mutex mu_;
+};
+
+// RAII scoped lock over Mutex (abseil's MutexLock + ReleasableMutexLock in
+// one: unlock()/lock() allow the wait_not_busy-style drop-and-reacquire
+// windows the socket layer needs, tracked by the analysis).
+class PCCLT_SCOPED_CAPABILITY MutexLock {
+public:
+    explicit MutexLock(Mutex &mu) PCCLT_ACQUIRE(mu) : mu_(mu), held_(true) {
+        mu_.lock();
+    }
+    ~MutexLock() PCCLT_RELEASE() {
+        if (held_) mu_.unlock();
+    }
+    MutexLock(const MutexLock &) = delete;
+    MutexLock &operator=(const MutexLock &) = delete;
+
+    void unlock() PCCLT_RELEASE() {
+        held_ = false;
+        mu_.unlock();
+    }
+    void lock() PCCLT_ACQUIRE() {
+        mu_.lock();
+        held_ = true;
+    }
+
+private:
+    Mutex &mu_;
+    bool held_;
+};
+
+// Condition variable whose waits take the annotated Mutex DIRECTLY (it
+// satisfies BasicLockable), so the unlock-while-waiting/relock-on-wake
+// protocol stays inside one REQUIRES(mu) call the analysis understands.
+class CondVar {
+public:
+    void notify_one() noexcept { cv_.notify_one(); }
+    void notify_all() noexcept { cv_.notify_all(); }
+
+    void wait(Mutex &mu) PCCLT_REQUIRES(mu) { cv_.wait(mu); }
+
+    template <typename Rep, typename Period>
+    std::cv_status wait_for(Mutex &mu,
+                            const std::chrono::duration<Rep, Period> &d)
+        PCCLT_REQUIRES(mu) {
+        return cv_.wait_for(mu, d);
+    }
+
+    template <typename Clock, typename Duration>
+    std::cv_status wait_until(Mutex &mu,
+                              const std::chrono::time_point<Clock, Duration> &tp)
+        PCCLT_REQUIRES(mu) {
+        return cv_.wait_until(mu, tp);
+    }
+
+private:
+    std::condition_variable_any cv_;
+};
+
+} // namespace pcclt

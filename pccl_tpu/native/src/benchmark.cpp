@@ -59,7 +59,7 @@ double run_probe(const net::Addr &target) {
     std::vector<net::Socket> socks(ncon);
     for (int i = 0; i < ncon; ++i) {
         if (!socks[i].connect(target)) return -1.0;
-        std::mutex mu;
+        Mutex mu;
         if (!net::send_frame(socks[i], mu, proto::kBenchHello, token)) return -1.0;
         auto ack = net::recv_frame(socks[i]);
         if (!ack || ack->type != proto::kBenchAck || ack->payload.empty())
@@ -123,7 +123,7 @@ void serve_connection(net::Socket sock, ServeState &state) {
 
     bool accept = false;
     {
-        std::lock_guard lk(state.mu);
+        MutexLock lk(state.mu);
         if (state.refcount == 0) {
             memcpy(state.token.data(), hello->payload.data(), 16);
             state.refcount = 1;
@@ -135,7 +135,7 @@ void serve_connection(net::Socket sock, ServeState &state) {
             accept = true;
         }
     }
-    std::mutex mu;
+    Mutex mu;
     uint8_t flag = accept ? 1 : 0;
     net::send_frame(sock, mu, proto::kBenchAck, {&flag, 1});
     if (!accept) return;
@@ -146,7 +146,7 @@ void serve_connection(net::Socket sock, ServeState &state) {
         if (r == 0 || r == -1) break; // closed or error; -2 timeout keeps waiting
     }
     {
-        std::lock_guard lk(state.mu);
+        MutexLock lk(state.mu);
         state.refcount--; // reaching 0 releases the token for the next prober
     }
 }

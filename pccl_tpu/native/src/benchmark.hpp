@@ -13,8 +13,8 @@
 
 #include <array>
 #include <cstdint>
-#include <mutex>
 
+#include "annotations.hpp"
 #include "sockets.hpp"
 
 namespace pcclt::bench {
@@ -30,9 +30,9 @@ double run_probe(const net::Addr &target);
 
 // Per-server-endpoint admission state: one prober token holds the floor.
 struct ServeState {
-    std::mutex mu;
-    std::array<uint8_t, 16> token{};
-    int refcount = 0;
+    Mutex mu;
+    std::array<uint8_t, 16> token PCCLT_GUARDED_BY(mu){};
+    int refcount PCCLT_GUARDED_BY(mu) = 0;
 };
 
 // Serve one accepted benchmark connection (counts+discards until close).

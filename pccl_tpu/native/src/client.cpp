@@ -43,7 +43,7 @@ void Client::spawn_service(
     std::function<void(net::Socket &, const std::shared_ptr<std::atomic<int>> &)> body) {
     auto fd = std::make_shared<std::atomic<int>>(sock.fd());
     auto done = std::make_shared<std::atomic<bool>>(false);
-    std::lock_guard lk(svc_mu_);
+    MutexLock lk(svc_mu_);
     if (!svc_accepting_) return; // disconnecting: drop the connection
     // reap finished threads so the vector stays bounded under churn
     for (auto it = svc_threads_.begin(); it != svc_threads_.end();) {
@@ -92,7 +92,7 @@ void Client::on_p2p_accept(net::Socket sock) {
         } catch (...) { return; }
         wire::Writer w;
         proto::put_uuid(w, uuid_);
-        std::mutex mu;
+        Mutex mu;
         if (!net::send_frame(sock, mu, PacketType::kP2PHelloAck, w.data())) return;
         sock.set_keepalive();
         sock.set_bufsizes(8 << 20);
@@ -101,7 +101,7 @@ void Client::on_p2p_accept(net::Socket sock) {
         // transfers land in one place
         std::shared_ptr<net::SinkTable> table;
         {
-            std::lock_guard lk(state_mu_);
+            MutexLock lk(state_mu_);
             auto &pc = peers_[peer];
             if (!pc.rx_table) pc.rx_table = std::make_shared<net::SinkTable>();
             table = pc.rx_table;
@@ -120,7 +120,7 @@ void Client::on_p2p_accept(net::Socket sock) {
         conn->run();
         std::shared_ptr<net::MultiplexConn> replaced;
         {
-            std::lock_guard lk(state_mu_);
+            MutexLock lk(state_mu_);
             auto &pc = peers_[peer];
             if (pc.rx.size() <= idx) pc.rx.resize(idx + 1);
             replaced = std::move(pc.rx[idx]);
@@ -150,7 +150,7 @@ void Client::on_ss_accept(net::Socket sock) {
         std::vector<SharedStateEntry> entries;
         bool ok;
         {
-            std::lock_guard lk(dist_mu_);
+            MutexLock lk(dist_mu_);
             ok = dist_open_ && revision == dist_revision_;
             if (ok)
                 for (const auto &k : keys) {
@@ -170,7 +170,7 @@ void Client::on_ss_accept(net::Socket sock) {
             w.u8(static_cast<uint8_t>(e.dtype));
             w.u64(e.count);
         }
-        std::mutex mu;
+        Mutex mu;
         if (!net::send_frame(sock, mu, PacketType::kS2CStateHeader, w.data())) return;
         if (!ok) return;
         for (const auto &e : entries) {
@@ -203,7 +203,7 @@ void Client::on_bench_accept(net::Socket sock) {
 Status Client::connect() {
     if (connected_.load()) return Status::kInvalid;
     {
-        std::lock_guard lk(svc_mu_);
+        MutexLock lk(svc_mu_);
         svc_accepting_ = true;
     }
     if (!p2p_listener_.listen(cfg_.p2p_port, 64)) return Status::kInternal;
@@ -261,7 +261,7 @@ void Client::disconnect() {
     connected_ = false; // unparks an in-flight resume loop promptly
     std::unique_ptr<util::WorkerPool> pool;
     {
-        std::lock_guard lk(ops_mu_);
+        MutexLock lk(ops_mu_);
         for (auto &[_, op] : ops_) {
             op->abort = true;
             op->result.wait();
@@ -272,7 +272,7 @@ void Client::disconnect() {
     pool.reset(); // joins the pooled worker threads (they never take ops_mu_)
     {
         // serialize against resume_master_session's reconnect of master_
-        std::lock_guard lk(resume_mu_);
+        MutexLock lk(resume_mu_);
         master_.close();
     }
     p2p_listener_.stop();
@@ -281,7 +281,7 @@ void Client::disconnect() {
     // interrupt + join all service threads before tearing down state they touch
     std::vector<SvcThread> svcs;
     {
-        std::lock_guard lk(svc_mu_);
+        MutexLock lk(svc_mu_);
         svc_accepting_ = false;
         for (auto &s : svc_threads_) {
             int fd = s.fd->load();
@@ -292,7 +292,7 @@ void Client::disconnect() {
     }
     for (auto &s : svcs)
         if (s.th.joinable()) s.th.join();
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     for (auto &[_, pc] : peers_) {
         for (auto &c : pc.tx)
             if (c) c->close();
@@ -329,7 +329,7 @@ Status Client::check_kicked() {
 // ---------------- master HA: session resume ----------------
 
 Status Client::resume_master_session() {
-    std::lock_guard lk(resume_mu_);
+    MutexLock lk(resume_mu_);
     if (master_.connected()) return Status::kOk; // another caller already resumed
     if (!connected_.load()) return Status::kNotConnected;
     const int attempts = cfg_.reconnect_attempts >= 0
@@ -445,7 +445,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
         std::vector<std::shared_ptr<net::MultiplexConn>> old_pool;
         std::shared_ptr<net::SinkTable> table;
         {
-            std::lock_guard lk(state_mu_);
+            MutexLock lk(state_mu_);
             auto &pc = peers_[ep.uuid];
             // Blip-not-rebuild: when the peer's endpoint is unchanged and
             // every pooled conn is still alive, keep the pool — a topology
@@ -493,7 +493,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
             // our p2p listen port: lets the acceptor key its side of this
             // conn by our canonical endpoint (per-edge wire emulation)
             w.u16(p2p_listener_.port());
-            std::mutex mu;
+            Mutex mu;
             if (!net::send_frame(s, mu, PacketType::kP2PHello, w.data())) {
                 ok = false;
                 break;
@@ -514,7 +514,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
             for (auto &c : pool)
                 if (c) c->close();
         } else {
-            std::lock_guard lk(state_mu_);
+            MutexLock lk(state_mu_);
             peers_[ep.uuid].tx = std::move(pool);
         }
     }
@@ -522,7 +522,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
     // the conns' RX/TX threads)
     std::vector<std::shared_ptr<net::MultiplexConn>> to_close;
     {
-        std::lock_guard lk(state_mu_);
+        MutexLock lk(state_mu_);
         std::set<proto::Uuid> alive;
         for (const auto &ep : info.peers) alive.insert(ep.uuid);
         for (auto it = peers_.begin(); it != peers_.end();) {
@@ -544,7 +544,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
 void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid> &ring) {
     size_t joined = 0, left = 0;
     {
-        std::lock_guard lk(state_mu_);
+        MutexLock lk(state_mu_);
         // membership churn counters: ring delta vs the previous adoption
         // (self excluded — it is not a peer)
         for (const auto &u : ring)
@@ -731,7 +731,7 @@ Status Client::optimize_topology() {
                 std::vector<proto::Uuid> ring;
                 for (uint32_t i = 0; i < n; ++i) ring.push_back(proto::get_uuid(r));
                 if (ok) {
-                    std::lock_guard lk(state_mu_);
+                    MutexLock lk(state_mu_);
                     ring_ = ring;
                 }
                 if (ok) {
@@ -798,7 +798,7 @@ Status Client::optimize_topology() {
 
 Status Client::gather_slot(uint64_t *slot) {
     if (!connected_.load()) return Status::kNotConnected;
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     if (ring_.empty()) return Status::kInvalid;
     std::vector<proto::Uuid> sorted = ring_;
     std::sort(sorted.begin(), sorted.end());
@@ -809,7 +809,7 @@ Status Client::gather_slot(uint64_t *slot) {
 }
 
 net::Link Client::tx_link(const proto::Uuid &peer) {
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     auto it = peers_.find(peer);
     if (it == peers_.end() || it->second.tx.empty()) return {};
     return net::Link(it->second.tx, it->second.tx_table);
@@ -817,7 +817,7 @@ net::Link Client::tx_link(const proto::Uuid &peer) {
 
 net::Link Client::rx_link(const proto::Uuid &peer, int timeout_ms) {
     auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    std::unique_lock lk(state_mu_);
+    MutexLock lk(state_mu_);
     while (true) {
         auto it = peers_.find(peer);
         if (it != peers_.end()) {
@@ -826,7 +826,7 @@ net::Link Client::rx_link(const proto::Uuid &peer, int timeout_ms) {
                     return net::Link(it->second.rx, it->second.rx_table);
             }
         }
-        if (state_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        if (state_cv_.wait_until(state_mu_, deadline) == std::cv_status::timeout)
             return {};
     }
 }
@@ -842,7 +842,7 @@ Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
         return Status::kInvalid;
     if (group_world() < 2) return Status::kTooFewPeers;
     {
-        std::lock_guard lk(ops_mu_);
+        MutexLock lk(ops_mu_);
         // re-check under the lock: a concurrent disconnect() clears ops_ and
         // tears the pool down under this same mutex, so an op admitted here
         // can never race the pool's destruction
@@ -909,7 +909,7 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     // 2. snapshot ring + neighbor connections
     std::vector<proto::Uuid> ring;
     {
-        std::lock_guard lk(state_mu_);
+        MutexLock lk(state_mu_);
         ring = ring_;
     }
     uint32_t world = static_cast<uint32_t>(ring.size());
@@ -994,7 +994,7 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         {
             // receiver wire-stall is charged to the inbound edge: the ring
             // predecessor's canonical endpoint (the netem/telemetry key)
-            std::lock_guard lk(state_mu_);
+            MutexLock lk(state_mu_);
             auto it = peers_.find(prev);
             if (it != peers_.end()) {
                 net::Addr pa = it->second.ep.ip;
@@ -1081,7 +1081,7 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
 }
 
 std::vector<uint8_t> Client::take_scratch() {
-    std::lock_guard lk(scratch_mu_);
+    MutexLock lk(scratch_mu_);
     if (scratch_pool_.empty()) return {};
     auto v = std::move(scratch_pool_.back());
     scratch_pool_.pop_back();
@@ -1096,14 +1096,14 @@ void Client::give_scratch(std::vector<uint8_t> v) {
     // scratch, so the shrink realloc copies nothing worth keeping)
     if (v.capacity() > 2 * v.size() + (1u << 20))
         v.shrink_to_fit();
-    std::lock_guard lk(scratch_mu_);
+    MutexLock lk(scratch_mu_);
     if (scratch_pool_.size() < 8) scratch_pool_.push_back(std::move(v));
 }
 
 Status Client::await_reduce(uint64_t tag, ReduceInfo *info) {
     std::unique_ptr<AsyncOp> op;
     {
-        std::lock_guard lk(ops_mu_);
+        MutexLock lk(ops_mu_);
         auto it = ops_.find(tag);
         if (it == ops_.end()) return Status::kInvalid;
         op = std::move(it->second);
@@ -1159,7 +1159,7 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
 
     // open the distribution window (we may be elected distributor)
     {
-        std::lock_guard lk(dist_mu_);
+        MutexLock lk(dist_mu_);
         dist_open_ = true;
         dist_revision_ = revision;
         dist_entries_.clear();
@@ -1171,7 +1171,7 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
         dist_tx_bytes_ = 0;
     }
     auto close_window = [this] {
-        std::lock_guard lk(dist_mu_);
+        MutexLock lk(dist_mu_);
         dist_open_ = false;
         dist_entries_.clear();
     };
@@ -1244,7 +1244,7 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
     if (resp->outdated) {
         // update the distribution window so we don't serve stale content
         {
-            std::lock_guard lk(dist_mu_);
+            MutexLock lk(dist_mu_);
             dist_open_ = false;
         }
         net::Socket sock;
@@ -1257,7 +1257,7 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
             w.u64(resp->revision);
             w.u32(static_cast<uint32_t>(resp->outdated_keys.size()));
             for (const auto &k : resp->outdated_keys) w.str(k);
-            std::mutex mu;
+            Mutex mu;
             if (!net::send_frame(sock, mu, PacketType::kC2SStateRequest, w.data())) {
                 st = Status::kConnectionLost;
             } else {
@@ -1352,24 +1352,24 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
 // ---------------- attributes ----------------
 
 uint32_t Client::global_world() const {
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     return static_cast<uint32_t>(peers_.size() + 1);
 }
 
 uint32_t Client::group_world() const {
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     return static_cast<uint32_t>(ring_.size());
 }
 
 uint32_t Client::num_groups() const {
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     std::set<uint32_t> g{cfg_.peer_group};
     for (const auto &[_, pc] : peers_) g.insert(pc.ep.peer_group);
     return static_cast<uint32_t>(g.size());
 }
 
 uint32_t Client::largest_group() const {
-    std::lock_guard lk(state_mu_);
+    MutexLock lk(state_mu_);
     std::map<uint32_t, uint32_t> counts;
     ++counts[cfg_.peer_group];
     for (const auto &[_, pc] : peers_) ++counts[pc.ep.peer_group];

@@ -13,12 +13,13 @@
 #include <future>
 #include <map>
 #include <memory>
-#include <mutex>
+#include <mutex>  // std::once_flag (SharedStateEntry::mat_once)
 #include <optional>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "annotations.hpp"
 #include "pool.hpp"
 #include "protocol.hpp"
 #include "sockets.hpp"
@@ -207,9 +208,11 @@ private:
     ClientConfig cfg_;
     proto::Uuid uuid_{};
     std::atomic<bool> connected_{false};
-    // master HA state: serialized resume loop, observed epoch, resume count,
-    // last shared-state revision seen complete (re-presented on resume)
-    std::mutex resume_mu_;
+    // master HA state: serialized resume loop (resume_mu_ guards no data —
+    // it serializes reconnect() of master_ against concurrent resumers and
+    // disconnect()), observed epoch, resume count, last shared-state
+    // revision seen complete (re-presented on resume)
+    Mutex resume_mu_;
     std::atomic<uint64_t> master_epoch_{0};
     std::atomic<uint64_t> reconnects_{0};
     std::atomic<uint64_t> last_sync_revision_{0};
@@ -223,28 +226,30 @@ private:
     net::ControlClient master_;
     net::Listener p2p_listener_, ss_listener_, bench_listener_;
 
-    mutable std::mutex state_mu_;
-    std::condition_variable state_cv_; // signalled when inbound p2p conns land
-    std::map<proto::Uuid, PeerConns> peers_;
-    std::vector<proto::Uuid> ring_;
-    uint64_t topo_revision_ = 0;
+    mutable Mutex state_mu_;
+    CondVar state_cv_; // signalled when inbound p2p conns land
+    std::map<proto::Uuid, PeerConns> peers_ PCCLT_GUARDED_BY(state_mu_);
+    std::vector<proto::Uuid> ring_ PCCLT_GUARDED_BY(state_mu_);
+    uint64_t topo_revision_ PCCLT_GUARDED_BY(state_mu_) = 0;
 
-    std::mutex ops_mu_;
-    std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_;
-    std::unique_ptr<util::WorkerPool> op_pool_; // lazily sized to the op cap
+    Mutex ops_mu_;
+    std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_ PCCLT_GUARDED_BY(ops_mu_);
+    // lazily sized to the op cap
+    std::unique_ptr<util::WorkerPool> op_pool_ PCCLT_GUARDED_BY(ops_mu_);
 
     // reuse pool for ring receive scratch: per-op vectors would be
     // page-zeroed by the kernel on every reduce (milliseconds at 10s of MiB)
-    std::mutex scratch_mu_;
-    std::vector<std::vector<uint8_t>> scratch_pool_;
+    Mutex scratch_mu_;
+    std::vector<std::vector<uint8_t>> scratch_pool_ PCCLT_GUARDED_BY(scratch_mu_);
     std::vector<uint8_t> take_scratch();
     void give_scratch(std::vector<uint8_t> v);
 
     // shared-state distribution window (serve only while a sync is active)
-    std::mutex dist_mu_;
-    bool dist_open_ = false;
-    uint64_t dist_revision_ = 0;
-    std::map<std::string, SharedStateEntry> dist_entries_;
+    Mutex dist_mu_;
+    bool dist_open_ PCCLT_GUARDED_BY(dist_mu_) = false;
+    uint64_t dist_revision_ PCCLT_GUARDED_BY(dist_mu_) = 0;
+    std::map<std::string, SharedStateEntry> dist_entries_
+        PCCLT_GUARDED_BY(dist_mu_);
     std::atomic<uint64_t> dist_tx_bytes_{0};
 
     // Per-connection service threads (p2p handshakes, shared-state serving,
@@ -259,9 +264,9 @@ private:
     void spawn_service(net::Socket sock,
                        std::function<void(net::Socket &,
                                           const std::shared_ptr<std::atomic<int>> &)> body);
-    std::mutex svc_mu_;
-    std::vector<SvcThread> svc_threads_;
-    bool svc_accepting_ = false;
+    Mutex svc_mu_;
+    std::vector<SvcThread> svc_threads_ PCCLT_GUARDED_BY(svc_mu_);
+    bool svc_accepting_ PCCLT_GUARDED_BY(svc_mu_) = false;
 };
 
 } // namespace pcclt::client

@@ -17,13 +17,13 @@ constexpr uint32_t kMaxRecord = 16u << 20; // sanity guard on corrupt lengths
 } // namespace
 
 Journal::~Journal() {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     if (f_) fclose(f_);
     f_ = nullptr;
 }
 
 bool Journal::open(const std::string &path) {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     if (f_) return false; // already open
     path_ = path;
     fsync_ = [] {
@@ -207,7 +207,7 @@ bool Journal::write_snapshot() {
 }
 
 void Journal::append(uint8_t type, const std::vector<uint8_t> &payload) {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     if (!f_) return;
     uint32_t len = wire::to_be(static_cast<uint32_t>(payload.size()));
     fwrite(&len, 4, 1, f_);

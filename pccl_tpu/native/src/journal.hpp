@@ -22,10 +22,10 @@
 #include <cstdint>
 #include <cstdio>
 #include <map>
-#include <mutex>
 #include <string>
 #include <vector>
 
+#include "annotations.hpp"
 #include "net_addr.hpp"
 #include "protocol.hpp"
 
@@ -86,7 +86,10 @@ public:
     void record_seq_bound(uint64_t bound);
     void record_bandwidth(const Uuid &from, const Uuid &to, double mbps);
 
-    bool is_open() const { return f_ != nullptr; }
+    bool is_open() const {
+        MutexLock lk(mu_);
+        return f_ != nullptr;
+    }
 
 private:
     enum RecType : uint8_t {
@@ -100,16 +103,21 @@ private:
         kSeqBound = 8,
     };
 
-    void append(uint8_t type, const std::vector<uint8_t> &payload);
-    bool replay(const std::string &path); // fills restored_; torn-tail tolerant
-    bool write_snapshot();                // compacted restored_ + new epoch
+    void append(uint8_t type, const std::vector<uint8_t> &payload)
+        PCCLT_EXCLUDES(mu_);
+    bool replay(const std::string &path) // fills restored_; torn-tail tolerant
+        PCCLT_REQUIRES(mu_);
+    bool write_snapshot() PCCLT_REQUIRES(mu_); // compacted restored_ + new epoch
 
-    std::mutex mu_;
-    FILE *f_ = nullptr;
-    std::string path_;
+    mutable Mutex mu_;
+    FILE *f_ PCCLT_GUARDED_BY(mu_) = nullptr;
+    std::string path_ PCCLT_GUARDED_BY(mu_);
+    // restored_/epoch_ are written once inside open() (under mu_) before the
+    // journal is published to any other thread; the const accessors read
+    // them lock-free afterwards, so they carry no guard annotation.
     Restored restored_;
     uint64_t epoch_ = 1;
-    bool fsync_ = false;
+    bool fsync_ PCCLT_GUARDED_BY(mu_) = false;
 };
 
 } // namespace pcclt::journal

@@ -1,3 +1,4 @@
+#include "annotations.hpp"
 #include "log.hpp"
 
 #include <cstdio>
@@ -24,7 +25,7 @@ Level parse_env() {
 }
 
 Level g_threshold = parse_env();
-std::mutex g_mu;
+Mutex g_mu;
 
 const char *name(Level lv) {
     switch (lv) {
@@ -51,7 +52,7 @@ void write(Level lv, const std::string &msg) {
     char ts[16];
     strftime(ts, sizeof ts, "%H:%M:%S", &tmv);
     auto tid = std::hash<std::thread::id>{}(std::this_thread::get_id()) % 100000;
-    std::lock_guard lk(g_mu);
+    MutexLock lk(g_mu);
     fprintf(stderr, "[%s][%5s][cc:%zu] %s\n", ts, name(lv), tid, msg.c_str());
     if (lv == Level::kFatal) {
         fflush(stderr);

@@ -39,7 +39,7 @@ bool Master::launch() {
         // (std::terminate). Assign under conns_mu_ and make the reader's
         // first action acquire the same mutex: its events now happen-after
         // the assignment for anyone who locked conns_mu_ in between.
-        std::lock_guard lk(conns_mu_);
+        MutexLock lk(conns_mu_);
         uint64_t id = next_conn_id_++;
         auto conn = std::make_shared<Conn>();
         conn->src_ip = sock.peer_addr();
@@ -51,7 +51,7 @@ bool Master::launch() {
         conn->sock.set_keepalive();
         conns_[id] = conn;
         conn->reader = std::thread([this, id, conn] {
-            { std::lock_guard gate(conns_mu_); } // wait out the assignment
+            { MutexLock gate(conns_mu_); } // wait out the assignment
             while (running_.load()) {
                 auto f = net::recv_frame(conn->sock);
                 if (!f) break;
@@ -66,7 +66,7 @@ bool Master::launch() {
 
 void Master::push_event(Event ev) {
     {
-        std::lock_guard lk(ev_mu_);
+        MutexLock lk(ev_mu_);
         events_.push_back(std::move(ev));
     }
     ev_cv_.notify_one();
@@ -76,7 +76,7 @@ void Master::apply_outbox(const std::vector<Outbox> &out) {
     for (const auto &o : out) {
         std::shared_ptr<Conn> conn;
         {
-            std::lock_guard lk(conns_mu_);
+            MutexLock lk(conns_mu_);
             auto it = conns_.find(o.conn_id);
             if (it == conns_.end()) continue;
             conn = it->second;
@@ -86,7 +86,7 @@ void Master::apply_outbox(const std::vector<Outbox> &out) {
     for (uint64_t id : state_.take_pending_closes()) {
         std::shared_ptr<Conn> conn;
         {
-            std::lock_guard lk(conns_mu_);
+            MutexLock lk(conns_mu_);
             auto it = conns_.find(id);
             if (it == conns_.end()) continue;
             conn = it->second;
@@ -96,8 +96,9 @@ void Master::apply_outbox(const std::vector<Outbox> &out) {
 }
 
 void Master::dispatcher_loop() {
-    // the state machine is single-threaded by design; enforce it at runtime
-    // (reference THREAD_GUARD discipline)
+    // the state machine's single-thread invariant (see the class marker in
+    // master.hpp) is enforced here at runtime: reference THREAD_GUARD
+    // discipline
     PCCLT_THREAD_GUARD(state_guard_);
     // limbo expiry (HA) must run on a steady deadline, not only when the
     // queue drains: a busy group's event stream would otherwise starve the
@@ -107,9 +108,12 @@ void Master::dispatcher_loop() {
         Event ev;
         bool have_ev = false;
         {
-            std::unique_lock lk(ev_mu_);
-            ev_cv_.wait_for(lk, std::chrono::milliseconds(100),
-                            [this] { return !events_.empty() || !running_.load(); });
+            // manual wait (no predicate lambda: a lambda body does not
+            // inherit the caller's lock set under -Wthread-safety); a
+            // spurious wake just re-runs the tick check and loops
+            MutexLock lk(ev_mu_);
+            if (events_.empty() && running_.load())
+                ev_cv_.wait_for(ev_mu_, std::chrono::milliseconds(100));
             if (!events_.empty()) {
                 ev = std::move(events_.front());
                 events_.pop_front();
@@ -127,7 +131,7 @@ void Master::dispatcher_loop() {
             out = state_.on_disconnect(ev.conn_id);
             std::shared_ptr<Conn> conn;
             {
-                std::lock_guard lk(conns_mu_);
+                MutexLock lk(conns_mu_);
                 auto it = conns_.find(ev.conn_id);
                 if (it != conns_.end()) {
                     conn = it->second;
@@ -145,7 +149,7 @@ void Master::dispatcher_loop() {
         } else {
             net::Addr src_ip{};
             {
-                std::lock_guard lk(conns_mu_);
+                MutexLock lk(conns_mu_);
                 auto it = conns_.find(ev.conn_id);
                 if (it != conns_.end()) src_ip = it->second->src_ip;
             }
@@ -228,7 +232,7 @@ void Master::interrupt() {
     if (!running_.exchange(false)) return;
     listener_.stop();
     {
-        std::lock_guard lk(conns_mu_);
+        MutexLock lk(conns_mu_);
         for (auto &[_, c] : conns_) c->sock.shutdown();
     }
     ev_cv_.notify_all();
@@ -238,7 +242,7 @@ void Master::join() {
     if (dispatcher_.joinable()) dispatcher_.join();
     std::map<uint64_t, std::shared_ptr<Conn>> conns;
     {
-        std::lock_guard lk(conns_mu_);
+        MutexLock lk(conns_mu_);
         conns.swap(conns_);
     }
     for (auto &[_, c] : conns) {

@@ -9,19 +9,22 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <deque>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <thread>
 
+#include "annotations.hpp"
 #include "master_state.hpp"
 #include "sockets.hpp"
 #include "thread_guard.hpp"
 
 namespace pcclt::master {
 
+// single-threaded by design: the MasterState machine is mutated only by
+// dispatcher_loop(); state_guard_ aborts loudly on a second entrant
+// (enforced by pcclt-check's `guards` checker — keep this marker on the
+// class that owns the ThreadGuard)
 class Master {
 public:
     // journal_path non-empty enables master HA: authoritative state is
@@ -40,7 +43,7 @@ public:
 private:
     struct Conn {
         net::Socket sock;
-        std::mutex write_mu;
+        Mutex write_mu;
         std::thread reader;
         net::Addr src_ip{};
     };
@@ -60,13 +63,13 @@ private:
     net::Listener listener_;
     MasterState state_;
     ThreadGuard state_guard_;
-    std::map<uint64_t, std::shared_ptr<Conn>> conns_;
-    std::mutex conns_mu_;
-    uint64_t next_conn_id_ = 1;
+    Mutex conns_mu_;
+    std::map<uint64_t, std::shared_ptr<Conn>> conns_ PCCLT_GUARDED_BY(conns_mu_);
+    uint64_t next_conn_id_ PCCLT_GUARDED_BY(conns_mu_) = 1;
 
-    std::mutex ev_mu_;
-    std::condition_variable ev_cv_;
-    std::deque<Event> events_;
+    Mutex ev_mu_;
+    CondVar ev_cv_;
+    std::deque<Event> events_ PCCLT_GUARDED_BY(ev_mu_);
     std::thread dispatcher_;
     std::atomic<bool> running_{false};
 };

@@ -874,7 +874,7 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
             // adopt a finished moonshot result if it beats the quick solve
             // and the membership hasn't changed since it was computed
             {
-                std::lock_guard lk(moon_mu_);
+                MutexLock lk(moon_mu_);
                 auto it = moon_.find(gid);
                 if (it != moon_.end()) {
                     std::set<Uuid> now(m_uuids.begin(), m_uuids.end());
@@ -980,7 +980,7 @@ void MasterState::spawn_moonshot(uint32_t gid, std::vector<Uuid> uuids,
         for (int idx : tour) m.ring.push_back(uuids[idx]);
         m.cost = c;
         {
-            std::lock_guard lk(moon_mu_);
+            MutexLock lk(moon_mu_);
             moon_[gid] = std::move(m);
         }
         running->store(false);

@@ -23,13 +23,13 @@
 #include <cstdint>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <optional>
 #include <set>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "annotations.hpp"
 #include "bandwidth.hpp"
 #include "journal.hpp"
 #include "protocol.hpp"
@@ -193,8 +193,11 @@ private:
     };
     void spawn_moonshot(uint32_t gid, std::vector<Uuid> uuids,
                         std::vector<double> cost, std::vector<int> tour);
-    std::mutex moon_mu_;
-    std::map<uint32_t, Moonshot> moon_;
+    // the ONLY cross-thread state in this otherwise single-dispatcher
+    // machine: the moonshot worker writes its result here, the dispatcher
+    // adopts it on the next optimize round
+    Mutex moon_mu_;
+    std::map<uint32_t, Moonshot> moon_ PCCLT_GUARDED_BY(moon_mu_);
     // one worker per group at a time; finished handles are joined before a
     // replacement is spawned, and moon_stop_ cancels workers on destruction
     std::map<uint32_t, std::thread> moon_threads_;

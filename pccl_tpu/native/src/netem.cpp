@@ -69,7 +69,7 @@ void Edge::pace(size_t bytes) {
     if (npb <= 0) return;
     uint64_t end;
     {
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         uint64_t now = mono_ns();
         // reserve the transmission slot [start, end) and sleep until the
         // frame has fully drained — a sender cannot complete a send faster
@@ -101,7 +101,7 @@ uint64_t Edge::delivery_delay_ns() {
     uint64_t jit = jitter_ns_.load(std::memory_order_relaxed);
     double drop = drop_.load(std::memory_order_relaxed);
     if (jit == 0 && drop <= 0) return d;
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     if (jit > 0) d += splitmix64(rng_) % jit;
     if (drop > 0 &&
         static_cast<double>(splitmix64(rng_) >> 11) * 0x1.0p-53 < drop) {
@@ -125,7 +125,7 @@ DelayLine &DelayLine::inst() {
 void DelayLine::deliver(uint64_t delay_ns, std::function<void()> fn) {
     uint64_t at = mono_ns() + delay_ns;
     {
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         q_.emplace(at, std::move(fn));
         if (!running_) {
             running_ = true;
@@ -136,23 +136,24 @@ void DelayLine::deliver(uint64_t delay_ns, std::function<void()> fn) {
 }
 
 void DelayLine::timer_loop() {
-    std::unique_lock lk(mu_);
     while (true) {
-        if (q_.empty()) {
-            cv_.wait_for(lk, std::chrono::seconds(1));
-            continue;
+        std::function<void()> fn;
+        {
+            MutexLock lk(mu_);
+            if (q_.empty()) {
+                cv_.wait_for(mu_, std::chrono::seconds(1));
+                continue;
+            }
+            uint64_t at = q_.begin()->first;
+            uint64_t now = mono_ns();
+            if (now < at) {
+                cv_.wait_for(mu_, std::chrono::nanoseconds(at - now));
+                continue;
+            }
+            fn = std::move(q_.begin()->second);
+            q_.erase(q_.begin());
         }
-        uint64_t at = q_.begin()->first;
-        uint64_t now = mono_ns();
-        if (now < at) {
-            cv_.wait_for(lk, std::chrono::nanoseconds(at - now));
-            continue;
-        }
-        auto fn = std::move(q_.begin()->second);
-        q_.erase(q_.begin());
-        lk.unlock();
         fn();
-        lk.lock();
     }
 }
 
@@ -211,7 +212,7 @@ double env_f(const char *name) {
 }  // namespace
 
 void Registry::refresh() {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     mbps_ = parse_map(std::getenv("PCCLT_WIRE_MBPS_MAP"),
                       "PCCLT_WIRE_MBPS_MAP");
     rtt_ = parse_map(std::getenv("PCCLT_WIRE_RTT_MS_MAP"),
@@ -255,15 +256,15 @@ std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
     std::string exact = peer.str();
     // bare-ip wildcard key: Addr::str() is "a.b.c.d:port" / "[v6]:port"
     std::string ip = exact.substr(0, exact.rfind(':'));
-    std::lock_guard lk(mu_);
-    auto has = [&](const std::string &k) {
-        return mbps_.count(k) || rtt_.count(k) || jitter_.count(k) ||
-               drop_.count(k);
-    };
+    MutexLock lk(mu_);
+    // written out per key (not a helper lambda): a lambda body does not
+    // inherit the caller's lock set under -Wthread-safety
     std::string match;
-    if (has(exact)) {
+    if (mbps_.count(exact) || rtt_.count(exact) || jitter_.count(exact) ||
+        drop_.count(exact)) {
         match = exact;  // per-endpoint bucket
-    } else if (has(ip)) {
+    } else if (mbps_.count(ip) || rtt_.count(ip) || jitter_.count(ip) ||
+               drop_.count(ip)) {
         match = ip;  // per-host bucket, shared by every port on that ip
     } else {
         return default_;  // globals: the one process-wide bucket (legacy)
@@ -283,7 +284,7 @@ std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
 }
 
 std::shared_ptr<Edge> Registry::default_edge() {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     return default_;
 }
 

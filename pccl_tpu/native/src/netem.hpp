@@ -39,14 +39,13 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 
+#include "annotations.hpp"
 #include "net_addr.hpp"
 
 namespace pcclt::net::netem {
@@ -97,9 +96,11 @@ private:
     std::atomic<uint64_t> jitter_ns_{0};
     std::atomic<double> drop_{0};
 
-    std::mutex mu_;          // bucket + rng
-    uint64_t next_ns_ = 0;   // bucket: end of the last reserved slot
-    uint64_t rng_ = 0x9E3779B97F4A7C15ull;  // splitmix64 state (jitter/drop)
+    Mutex mu_;  // bucket + rng
+    // bucket: end of the last reserved slot
+    uint64_t next_ns_ PCCLT_GUARDED_BY(mu_) = 0;
+    // splitmix64 state (jitter/drop)
+    uint64_t rng_ PCCLT_GUARDED_BY(mu_) = 0x9E3779B97F4A7C15ull;
 };
 
 // Deadline-ordered delivery timer shared by every delayed edge: one
@@ -115,10 +116,11 @@ public:
 private:
     DelayLine() = default;
     void timer_loop();
-    std::mutex mu_;
-    std::condition_variable cv_;
-    std::multimap<uint64_t, std::function<void()>> q_;  // deadline -> fn
-    bool running_ = false;
+    Mutex mu_;
+    CondVar cv_;
+    // deadline -> fn
+    std::multimap<uint64_t, std::function<void()>> q_ PCCLT_GUARDED_BY(mu_);
+    bool running_ PCCLT_GUARDED_BY(mu_) = false;
 };
 
 // Parse one "k=v,k=v,..." map env value. Malformed entries (no '=',
@@ -147,17 +149,21 @@ public:
 private:
     Registry() { refresh(); }
     EdgeParams params_for(const std::string &exact_key,
-                          const std::string &ip_key) const;  // holds mu_
+                          const std::string &ip_key) const PCCLT_REQUIRES(mu_);
 
-    mutable std::mutex mu_;
-    std::shared_ptr<Edge> default_;                 // never null after ctor
+    mutable Mutex mu_;
+    // never null after ctor
+    std::shared_ptr<Edge> default_ PCCLT_GUARDED_BY(mu_);
     struct Entry {
         std::shared_ptr<Edge> edge;
         std::string exact_key, ip_key;  // for in-place refresh
     };
-    std::map<std::string, Entry> edges_;            // by matched key
-    std::map<std::string, double> mbps_, rtt_, jitter_, drop_;
-    EdgeParams global_;
+    // by matched key
+    std::map<std::string, Entry> edges_ PCCLT_GUARDED_BY(mu_);
+    std::map<std::string, double> mbps_ PCCLT_GUARDED_BY(mu_),
+        rtt_ PCCLT_GUARDED_BY(mu_), jitter_ PCCLT_GUARDED_BY(mu_),
+        drop_ PCCLT_GUARDED_BY(mu_);
+    EdgeParams global_ PCCLT_GUARDED_BY(mu_);
 };
 
 }  // namespace pcclt::net::netem

@@ -7,12 +7,12 @@
 // burst of concurrent reduces costs queue pushes, not thread spawns.
 #pragma once
 
-#include <condition_variable>
 #include <deque>
 #include <functional>
-#include <mutex>
 #include <thread>
 #include <vector>
+
+#include "annotations.hpp"
 
 namespace pcclt::util {
 
@@ -26,7 +26,7 @@ public:
 
     ~WorkerPool() {
         {
-            std::lock_guard lk(mu_);
+            MutexLock lk(mu_);
             stop_ = true;
         }
         cv_.notify_all();
@@ -38,7 +38,7 @@ public:
 
     void submit(std::function<void()> fn) {
         {
-            std::lock_guard lk(mu_);
+            MutexLock lk(mu_);
             q_.push_back(std::move(fn));
         }
         cv_.notify_one();
@@ -49,8 +49,8 @@ private:
         for (;;) {
             std::function<void()> fn;
             {
-                std::unique_lock lk(mu_);
-                cv_.wait(lk, [this] { return stop_ || !q_.empty(); });
+                MutexLock lk(mu_);
+                while (!stop_ && q_.empty()) cv_.wait(mu_);
                 if (stop_ && q_.empty()) return;
                 fn = std::move(q_.front());
                 q_.pop_front();
@@ -59,11 +59,11 @@ private:
         }
     }
 
-    std::mutex mu_;
-    std::condition_variable cv_;
-    std::deque<std::function<void()>> q_;
+    Mutex mu_;
+    CondVar cv_;
+    std::deque<std::function<void()>> q_ PCCLT_GUARDED_BY(mu_);
     std::vector<std::thread> threads_;
-    bool stop_ = false;
+    bool stop_ PCCLT_GUARDED_BY(mu_) = false;
 };
 
 } // namespace pcclt::util

@@ -7,8 +7,8 @@
 #include <cstdio>
 #include <cstring>
 #include <map>
-#include <mutex>
 
+#include "annotations.hpp"
 #include "log.hpp"
 
 namespace pcclt::shm {
@@ -16,12 +16,15 @@ namespace pcclt::shm {
 namespace {
 
 struct Registry {
-    std::mutex mu;
-    std::map<uintptr_t, Region> live;           // by base address
-    uint64_t next_id = 1;
-    uint64_t retire_seq = 0;
-    uint64_t trimmed_seq = 0;                   // retires <= this were dropped
-    std::vector<std::pair<uint64_t, uint64_t>> retires; // (seq, base)
+    Mutex mu;
+    // by base address
+    std::map<uintptr_t, Region> live PCCLT_GUARDED_BY(mu);
+    uint64_t next_id PCCLT_GUARDED_BY(mu) = 1;
+    uint64_t retire_seq PCCLT_GUARDED_BY(mu) = 0;
+    // retires <= this were dropped
+    uint64_t trimmed_seq PCCLT_GUARDED_BY(mu) = 0;
+    // (seq, base)
+    std::vector<std::pair<uint64_t, uint64_t>> retires PCCLT_GUARDED_BY(mu);
 };
 
 Registry &reg() {
@@ -58,7 +61,7 @@ void *alloc(size_t len) {
     }
     madvise(p, len, MADV_HUGEPAGE); // advisory; fewer TLB misses on big pulls
     auto &r = reg();
-    std::lock_guard lk(r.mu);
+    MutexLock lk(r.mu);
     Region region;
     region.id = r.next_id++;
     region.fd = fd;
@@ -72,7 +75,7 @@ bool free_buf(void *p) {
     auto &r = reg();
     Region region;
     {
-        std::lock_guard lk(r.mu);
+        MutexLock lk(r.mu);
         auto it = r.live.find(reinterpret_cast<uintptr_t>(p));
         if (it == r.live.end()) return false;
         region = it->second;
@@ -96,7 +99,7 @@ bool free_buf(void *p) {
 
 std::optional<Region> find(const void *p, size_t len) {
     auto &r = reg();
-    std::lock_guard lk(r.mu);
+    MutexLock lk(r.mu);
     auto addr = reinterpret_cast<uintptr_t>(p);
     auto it = r.live.upper_bound(addr);
     if (it == r.live.begin()) return std::nullopt;
@@ -108,7 +111,7 @@ std::optional<Region> find(const void *p, size_t len) {
 
 RetireFeed drain_retires(uint64_t *cursor) {
     auto &r = reg();
-    std::lock_guard lk(r.mu);
+    MutexLock lk(r.mu);
     RetireFeed out;
     out.reset = *cursor < r.trimmed_seq;
     if (!out.reset)
@@ -120,7 +123,7 @@ RetireFeed drain_retires(uint64_t *cursor) {
 
 size_t live_regions() {
     auto &r = reg();
-    std::lock_guard lk(r.mu);
+    MutexLock lk(r.mu);
     return r.live.size();
 }
 

@@ -270,7 +270,7 @@ bool Socket::peer_is_loopback() const {
 
 // ---------- control framing ----------
 
-bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
+bool send_frame(Socket &s, Mutex &write_mu, uint16_t type,
                 std::span<const uint8_t> payload) {
     uint32_t len = static_cast<uint32_t>(2 + payload.size());
     uint8_t hdr[6];
@@ -278,7 +278,7 @@ bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
     uint16_t be_type = wire::to_be(type);
     memcpy(hdr, &be_len, 4);
     memcpy(hdr + 4, &be_type, 2);
-    std::lock_guard lk(write_mu);
+    MutexLock lk(write_mu);
     // gathered write: header + payload in one segment, so control packets
     // don't interact badly with Nagle/delayed-ACK, without a staging copy
     return s.send_all2(hdr, 6, payload.data(), payload.size());
@@ -451,7 +451,7 @@ bool ControlClient::reconnect(const Addr &addr) {
     {
         // drop frames of the dead session: a stale queued packet must never
         // satisfy a post-resume recv_match
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         queue_.clear();
     }
     // exclude in-flight writers before swapping the socket: a sender that
@@ -459,7 +459,7 @@ bool ControlClient::reconnect(const Addr &addr) {
     // TAIL of its stale frame into the fresh connection, corrupting the
     // resumed session's framing (close() already failed its socket, so the
     // writer exits promptly and we take the lock)
-    std::lock_guard wl(write_mu_);
+    MutexLock wl(write_mu_);
     sock_ = Socket();
     return connect(addr);
 }
@@ -471,7 +471,7 @@ void ControlClient::run(std::function<void()> on_disconnect) {
             auto f = recv_frame(sock_);
             if (!f) break;
             {
-                std::lock_guard lk(mu_);
+                MutexLock lk(mu_);
                 queue_.push_back(std::move(*f));
             }
             cv_.notify_all();
@@ -489,66 +489,46 @@ bool ControlClient::send(uint16_t type, std::span<const uint8_t> payload) {
 
 std::optional<Frame> ControlClient::recv_match(uint16_t type, const Pred &pred,
                                                int timeout_ms, bool no_wait) {
-    std::unique_lock lk(mu_);
-    auto scan = [&]() -> std::optional<Frame> {
-        for (auto it = queue_.begin(); it != queue_.end(); ++it) {
-            if (it->type == type && (!pred || pred(it->payload))) {
-                Frame f = std::move(*it);
-                queue_.erase(it);
-                return f;
-            }
+    // thin adapter over the any-of variant: one wait loop to maintain
+    FramePred fp;
+    if (pred) fp = [&pred](const Frame &f) { return pred(f.payload); };
+    return recv_match_any({type}, fp, timeout_ms, no_wait);
+}
+
+std::optional<Frame> ControlClient::scan_queue_any(
+    const std::vector<uint16_t> &types, const FramePred &pred) {
+    for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+        bool type_ok = false;
+        for (auto t : types)
+            if (it->type == t) type_ok = true;
+        if (type_ok && (!pred || pred(*it))) {
+            Frame f = std::move(*it);
+            queue_.erase(it);
+            return f;
         }
-        return std::nullopt;
-    };
-    if (auto f = scan()) return f;
-    if (no_wait) return std::nullopt;
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
-    while (connected_.load()) {
-        if (timeout_ms < 0) {
-            cv_.wait_for(lk, std::chrono::seconds(1)); // forever, re-armed
-        } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
-            return scan(); // last chance
-        }
-        if (auto f = scan()) return f;
-        if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
-            return std::nullopt;
     }
-    return scan();
+    return std::nullopt;
 }
 
 std::optional<Frame> ControlClient::recv_match_any(const std::vector<uint16_t> &types,
                                                    const FramePred &pred, int timeout_ms,
                                                    bool no_wait) {
-    std::unique_lock lk(mu_);
-    auto scan = [&]() -> std::optional<Frame> {
-        for (auto it = queue_.begin(); it != queue_.end(); ++it) {
-            bool type_ok = false;
-            for (auto t : types)
-                if (it->type == t) type_ok = true;
-            if (type_ok && (!pred || pred(*it))) {
-                Frame f = std::move(*it);
-                queue_.erase(it);
-                return f;
-            }
-        }
-        return std::nullopt;
-    };
-    if (auto f = scan()) return f;
+    MutexLock lk(mu_);
+    if (auto f = scan_queue_any(types, pred)) return f;
     if (no_wait) return std::nullopt;
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
     while (connected_.load()) {
         if (timeout_ms < 0) {
-            cv_.wait_for(lk, std::chrono::seconds(1));
-        } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
-            return scan();
+            cv_.wait_for(mu_, std::chrono::seconds(1));
+        } else if (cv_.wait_until(mu_, deadline) == std::cv_status::timeout) {
+            return scan_queue_any(types, pred);
         }
-        if (auto f = scan()) return f;
+        if (auto f = scan_queue_any(types, pred)) return f;
         if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline)
             return std::nullopt;
     }
-    return scan();
+    return scan_queue_any(types, pred);
 }
 
 void ControlClient::close() {
@@ -585,7 +565,7 @@ void SinkTable::Sink::add_extent(size_t off, size_t end) {
 }
 
 void SinkTable::attach(const std::shared_ptr<MultiplexConn> &conn) {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     // drop expired members while we're here (conn churn under retries)
     members_.erase(std::remove_if(members_.begin(), members_.end(),
                                   [](const auto &w) { return w.expired(); }),
@@ -599,7 +579,7 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
                               bool consumer_pull) {
     std::vector<PendingDesc> descs;
     {
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         Sink s;
         s.base = base;
         s.cap = cap;
@@ -641,7 +621,7 @@ size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms,
                               bool *cma_pending) {
     size_t cur = 0;
     park::wait_event(shard_ev(tag), timeout_ms, [&] {
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         if (cma_pending && pending_descs_.count(tag)) {
             *cma_pending = true; // a claimable same-host descriptor arrived
             auto it = sinks_.find(tag);
@@ -671,39 +651,42 @@ size_t SinkTable::wait_filled(uint64_t tag, size_t min_bytes, int timeout_ms,
     return cur;
 }
 
-template <typename PredFn>
-void SinkTable::wait_not_busy(std::unique_lock<std::mutex> &lk, PredFn pred) {
+void SinkTable::wait_not_busy_range(uint64_t lo, uint64_t hi) {
     auto start = std::chrono::steady_clock::now();
     bool killed = false;
     while (true) {
         uint32_t e = ev_.epoch();
-        if (!pred()) return;
+        bool busy = false;
+        for (auto it = sinks_.lower_bound(lo);
+             it != sinks_.end() && it->first < hi; ++it)
+            if (it->second.busy > 0) {
+                busy = true;
+                break;
+            }
+        if (!busy) return;
         if (!killed &&
             std::chrono::steady_clock::now() - start > std::chrono::seconds(5)) {
             // the writer made no progress at all (genuinely stalled peer):
             // kill the attached sockets so the blocked recv fails promptly
             auto members = members_;
-            lk.unlock();
+            mu_.unlock();
             for (auto &w : members)
                 if (auto c = w.lock()) c->kill_socket();
-            lk.lock();
+            mu_.lock();
             killed = true;
         }
-        lk.unlock();
+        mu_.unlock();
         ev_.wait(e, 100);
-        lk.lock();
+        mu_.lock();
     }
 }
 
 void SinkTable::unregister_sink(uint64_t tag) {
-    std::unique_lock lk(mu_);
+    MutexLock lk(mu_);
     auto it = sinks_.find(tag);
     if (it == sinks_.end()) return;
     it->second.cancel = true;
-    wait_not_busy(lk, [&] {
-        auto i = sinks_.find(tag);
-        return i != sinks_.end() && i->second.busy > 0;
-    });
+    wait_not_busy_range(tag, tag + 1);
     sinks_.erase(tag);
 }
 
@@ -713,7 +696,7 @@ std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
     park::wait_event(shard_ev(tag), timeout_ms, [&] {
         bool dead;
         {
-            std::lock_guard lk(mu_);
+            MutexLock lk(mu_);
             auto it = queues_.find(tag);
             if (it != queues_.end() && !it->second.empty()) {
                 auto v = std::move(it->second.front());
@@ -741,14 +724,10 @@ std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
 void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
     std::vector<PendingDesc> dropped;
     {
-        std::unique_lock lk(mu_);
+        MutexLock lk(mu_);
         for (auto &[tag, s] : sinks_)
             if (tag >= lo && tag < hi) s.cancel = true;
-        wait_not_busy(lk, [&] {
-            for (auto &[tag, s] : sinks_)
-                if (tag >= lo && tag < hi && s.busy > 0) return true;
-            return false;
-        });
+        wait_not_busy_range(lo, hi);
         for (auto it = sinks_.begin(); it != sinks_.end();)
             it = (it->first >= lo && it->first < hi) ? sinks_.erase(it) : std::next(it);
         for (auto it = queues_.begin(); it != queues_.end();)
@@ -872,7 +851,7 @@ MultiplexConn::~MultiplexConn() {
     close();
     // safe now: no thread can hold a shared_ptr to us (we are being
     // destroyed), so no shm_resolve pointer can still be in use
-    std::lock_guard lk(shm_mu_);
+    MutexLock lk(shm_mu_);
     for (auto &[base, m] : shm_maps_)
         if (m.local) munmap(m.local, m.len);
     shm_maps_.clear();
@@ -915,7 +894,7 @@ void MultiplexConn::run() {
 
 void MultiplexConn::enqueue(SendReq *req) {
     {
-        std::lock_guard lk(cma_mu_); // doubles as the enqueue/close gate
+        MutexLock lk(cma_mu_); // doubles as the enqueue/close gate
         if (!closing_.load() && alive_.load()) {
             txq_.push(req);
             tx_ev_.signal();
@@ -1003,7 +982,7 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
         edge().tx_frames.fetch_add(1, std::memory_order_relaxed);
         edge().tx_bytes.fetch_add(payload.size(), std::memory_order_relaxed);
     }
-    std::lock_guard lk(wr_mu_);
+    MutexLock lk(wr_mu_);
     return sock_.send_all2(hdr, 21, payload.data(), payload.size());
 }
 
@@ -1014,7 +993,7 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
 bool MultiplexConn::cma_post_desc(uint64_t tag, uint64_t off,
                                   std::span<const uint8_t> span, const SendHandle &st) {
     {
-        std::lock_guard lk(cma_mu_);
+        MutexLock lk(cma_mu_);
         pending_cma_[{tag, off}] = st;
     }
     wire::Writer w;
@@ -1027,7 +1006,7 @@ bool MultiplexConn::cma_post_desc(uint64_t tag, uint64_t off,
     if (!ok) {
         bool mine;
         {
-            std::lock_guard lk(cma_mu_);
+            MutexLock lk(cma_mu_);
             mine = pending_cma_.erase({tag, off}) > 0;
         }
         if (mine) st->complete(false); // else rx/close already failed it
@@ -1092,7 +1071,7 @@ void MultiplexConn::tx_loop() {
     // pushed before we took the gate — its node is visible to pop() — or it
     // sees alive_ false and fails its request itself).
     {
-        std::lock_guard lk(cma_mu_);
+        MutexLock lk(cma_mu_);
         alive_ = false;
     }
     mpsc::Node *n;
@@ -1110,7 +1089,7 @@ bool MultiplexConn::shm_sync_tx(std::span<const uint8_t> span) {
     // held across the frame writes so a racing writer cannot see "announced"
     // and ship a descriptor before the announce actually hit the wire
     // (lock order: shm_tx_mu_ -> wr_mu_, nowhere reversed)
-    std::lock_guard lk(shm_tx_mu_);
+    MutexLock lk(shm_tx_mu_);
     // retires first: they must reach the peer before the address range can
     // be re-announced (alloc never reuses a retired range, but the peer's
     // resolution map must not keep stale entries alive indefinitely)
@@ -1144,7 +1123,7 @@ bool MultiplexConn::shm_sync_tx(std::span<const uint8_t> span) {
 }
 
 const uint8_t *MultiplexConn::shm_resolve(uint64_t addr, uint64_t len) {
-    std::lock_guard lk(shm_mu_);
+    MutexLock lk(shm_mu_);
     auto it = shm_maps_.upper_bound(addr);
     if (it == shm_maps_.begin()) return nullptr;
     --it;
@@ -1157,7 +1136,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
     uint8_t *dst = nullptr;
     bool drop = false;
     {
-        std::lock_guard lk(table_->mu_);
+        MutexLock lk(table_->mu_);
         auto it = table_->sinks_.find(tag);
         if (it == table_->sinks_.end()) {
             // a purge may have landed between the caller's check and here:
@@ -1183,7 +1162,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         while (off < d.len && !cancelled) {
             size_t want = std::min<size_t>(2u << 20, d.len - off);
             kernels::copy_stream(dst + off, mapped + off, want);
-            std::lock_guard lk(table_->mu_);
+            MutexLock lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it == table_->sinks_.end() || it->second.cancel) {
                 cancelled = true;
@@ -1194,7 +1173,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
             table_->signal_tag(tag);
         }
         {
-            std::lock_guard lk(table_->mu_);
+            MutexLock lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it != table_->sinks_.end()) --it->second.busy;
         }
@@ -1208,7 +1187,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
     }
     if (!cma_verify_peer(d)) {
         {
-            std::lock_guard lk(table_->mu_);
+            MutexLock lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it != table_->sinks_.end()) --it->second.busy;
         }
@@ -1236,7 +1215,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         if (ok) {
             // publish every slice (not just the whole payload) so a streaming
             // consumer overlaps its reduction with the remainder of the pull
-            std::lock_guard lk(table_->mu_);
+            MutexLock lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it == table_->sinks_.end() || it->second.cancel) {
                 cancelled = true;
@@ -1248,7 +1227,7 @@ void MultiplexConn::do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d) {
         if (ok && !cancelled) table_->signal_tag(tag);
     }
     {
-        std::lock_guard lk(table_->mu_);
+        MutexLock lk(table_->mu_);
         auto it = table_->sinks_.find(tag);
         if (it != table_->sinks_.end()) --it->second.busy;
     }
@@ -1275,7 +1254,7 @@ bool MultiplexConn::cma_verify_peer(const SinkTable::PendingDesc &d) {
     uint64_t taddr = 0;
     std::array<uint8_t, 16> expect{};
     {
-        std::lock_guard lk(cma_mu_);
+        MutexLock lk(cma_mu_);
         if (cma_peer_valid_) {
             pid = cma_peer_pid_;
             taddr = cma_peer_token_addr_;
@@ -1364,7 +1343,7 @@ SinkTable::CmaClaim MultiplexConn::consumer_cma_pull(
 void SinkTable::fill_pending(uint64_t tag) {
     std::vector<PendingDesc> descs;
     {
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         auto range = pending_descs_.equal_range(tag);
         for (auto it = range.first; it != range.second; ++it)
             descs.push_back(it->second);
@@ -1382,7 +1361,7 @@ SinkTable::CmaClaim SinkTable::consume_cma(
     std::shared_ptr<MultiplexConn> conn;
     bool mismatch = false;
     {
-        std::lock_guard lk(mu_);
+        MutexLock lk(mu_);
         auto it = pending_descs_.find(tag);
         if (it == pending_descs_.end()) return CmaClaim::kNone;
         d = it->second;
@@ -1426,7 +1405,7 @@ void MultiplexConn::rx_loop() {
         if (kind == kCmaAck || kind == kCmaAckDrop || kind == kCmaNack) {
             SendHandle st;
             {
-                std::lock_guard lk(cma_mu_);
+                MutexLock lk(cma_mu_);
                 auto it = pending_cma_.find({tag, off});
                 if (it != pending_cma_.end()) {
                     st = it->second;
@@ -1475,7 +1454,7 @@ void MultiplexConn::rx_loop() {
             uint64_t be_addr;
             memcpy(&be_pid, buf, 4);
             memcpy(&be_addr, buf + 4, 8);
-            std::lock_guard lk(cma_mu_);
+            MutexLock lk(cma_mu_);
             cma_peer_pid_ = wire::from_be(be_pid);
             cma_peer_token_addr_ = wire::from_be(be_addr);
             memcpy(cma_peer_token_.data(), buf + 12, 16);
@@ -1503,7 +1482,7 @@ void MultiplexConn::rx_loop() {
             // (same trust model as every process_vm_readv pull)
             bool pid_ok;
             {
-                std::lock_guard lk(cma_mu_);
+                MutexLock lk(cma_mu_);
                 pid_ok = cma_peer_valid_ && cma_peer_pid_ == pid;
             }
             if (pid_ok && rlen > 0 && rlen <= (64ull << 30)) {
@@ -1515,7 +1494,7 @@ void MultiplexConn::rx_loop() {
                     void *m = mmap(nullptr, rlen, PROT_READ, MAP_SHARED, fd, 0);
                     ::close(fd);
                     if (m != MAP_FAILED) {
-                        std::lock_guard lk(shm_mu_);
+                        MutexLock lk(shm_mu_);
                         auto [it, fresh] = shm_maps_.try_emplace(base);
                         if (!fresh && it->second.local)
                             shm_zombies_.push_back(it->second); // reader-safe
@@ -1529,7 +1508,7 @@ void MultiplexConn::rx_loop() {
         }
 
         if (kind == kShmRetire) {
-            std::lock_guard lk(shm_mu_);
+            MutexLock lk(shm_mu_);
             auto it = shm_maps_.find(off); // retire carries base in `off`
             if (it != shm_maps_.end()) {
                 // no munmap here: an op thread may hold a shm_resolve
@@ -1562,7 +1541,7 @@ void MultiplexConn::rx_loop() {
             bool fill_now;
             bool retired;
             {
-                std::lock_guard lk(table_->mu_);
+                MutexLock lk(table_->mu_);
                 retired = table_->is_retired(tag);
                 auto it = table_->sinks_.find(tag);
                 // consumer_pull sinks (and absent sinks) keep the descriptor
@@ -1594,7 +1573,7 @@ void MultiplexConn::rx_loop() {
         edge().rx_bytes.fetch_add(n, std::memory_order_relaxed);
         uint8_t *dst = nullptr;
         {
-            std::lock_guard lk(table_->mu_);
+            MutexLock lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it != table_->sinks_.end() && !it->second.cancel &&
                 off + n <= it->second.cap) {
@@ -1615,7 +1594,7 @@ void MultiplexConn::rx_loop() {
                 }
                 done += want;
                 if (ok && !cancelled && done < n) {
-                    std::lock_guard lk(table_->mu_);
+                    MutexLock lk(table_->mu_);
                     auto it = table_->sinks_.find(tag);
                     cancelled = it == table_->sinks_.end() || it->second.cancel;
                 }
@@ -1626,7 +1605,7 @@ void MultiplexConn::rx_loop() {
             uint64_t delay_ns =
                 wire_->delay_enabled() ? wire_->delivery_delay_ns() : 0;
             {
-                std::lock_guard lk(table_->mu_);
+                MutexLock lk(table_->mu_);
                 auto it = table_->sinks_.find(tag);
                 if (it != table_->sinks_.end()) {
                     --it->second.busy;   // buffer write done: release NOW
@@ -1640,7 +1619,7 @@ void MultiplexConn::rx_loop() {
                 netem::DelayLine::inst().deliver(
                     delay_ns, [tbl = table_, tag, off, n] {
                         {
-                            std::lock_guard lk(tbl->mu_);
+                            MutexLock lk(tbl->mu_);
                             auto it = tbl->sinks_.find(tag);
                             if (it != tbl->sinks_.end() &&
                                 !it->second.cancel &&
@@ -1667,7 +1646,7 @@ void MultiplexConn::rx_loop() {
                     delay_ns,
                     [tbl = table_, tag, off, bytes = std::move(bytes)] {
                         {
-                            std::lock_guard lk(tbl->mu_);
+                            MutexLock lk(tbl->mu_);
                             auto it = tbl->sinks_.find(tag);
                             size_t n = bytes.size();
                             if (it != tbl->sinks_.end() &&
@@ -1691,7 +1670,7 @@ void MultiplexConn::rx_loop() {
                 // re-check: a sink may have been registered while we were in
                 // recv_all above — queueing now would strand the bytes where
                 // wait_filled never looks (this was a real deadlock)
-                std::lock_guard lk(table_->mu_);
+                MutexLock lk(table_->mu_);
                 auto it = table_->sinks_.find(tag);
                 if (it != table_->sinks_.end() && !it->second.cancel &&
                     off + n <= it->second.cap) {
@@ -1722,7 +1701,7 @@ void MultiplexConn::rx_loop() {
 void MultiplexConn::fail_all_pending() {
     std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending;
     {
-        std::lock_guard lk(cma_mu_);
+        MutexLock lk(cma_mu_);
         pending.swap(pending_cma_);
     }
     for (auto &[_, st] : pending) st->complete(false);
@@ -1732,10 +1711,10 @@ void MultiplexConn::close() {
     // serialize concurrent closers: the loser blocks until the winner has
     // fully torn down, then returns (concurrent join on one std::thread is
     // UB, so exactly one thread may run the sequence below)
-    std::lock_guard close_lk(close_mu_);
+    MutexLock close_lk(close_mu_);
     if (closed_) return;
     {
-        std::lock_guard lk(cma_mu_); // enqueue gate: no pushes after this
+        MutexLock lk(cma_mu_); // enqueue gate: no pushes after this
         closing_ = true;
         alive_ = false;
     }

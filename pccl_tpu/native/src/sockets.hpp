@@ -39,19 +39,18 @@
 
 #include <array>
 #include <atomic>
-#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <functional>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <optional>
 #include <span>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "annotations.hpp"
 #include "mpsc.hpp"
 #include "net_addr.hpp"
 #include "park.hpp"
@@ -113,8 +112,8 @@ struct Frame {
     std::vector<uint8_t> payload;
 };
 
-bool send_frame(Socket &s, std::mutex &write_mu, uint16_t type,
-                std::span<const uint8_t> payload);
+bool send_frame(Socket &s, Mutex &write_mu, uint16_t type,
+                std::span<const uint8_t> payload) PCCLT_EXCLUDES(write_mu);
 // blocking; returns nullopt on disconnect/error
 std::optional<Frame> recv_frame(Socket &s);
 // bounded: returns nullopt on disconnect/error/deadline (for handshake
@@ -168,13 +167,22 @@ public:
     void close();
 
 private:
+    // match-scan over the receive queue; factored out of recv_match_any so
+    // the lock contract is explicit (a scan lambda would not inherit the
+    // caller's lock set under -Wthread-safety). recv_match is an adapter.
+    std::optional<Frame> scan_queue_any(const std::vector<uint16_t> &types,
+                                        const FramePred &pred)
+        PCCLT_REQUIRES(mu_);
+
     Socket sock_;
-    std::mutex write_mu_;
+    Mutex write_mu_;
     std::thread reader_;
     std::atomic<bool> connected_{false};
-    std::mutex mu_;
-    std::condition_variable cv_;
-    std::deque<Frame> queue_;
+    Mutex mu_;
+    CondVar cv_;
+    std::deque<Frame> queue_ PCCLT_GUARDED_BY(mu_);
+    // assigned in run() before the reader thread exists; read only by the
+    // reader at exit — reconnect() joins the old reader before re-running
     std::function<void()> on_disconnect_;
 };
 
@@ -278,14 +286,14 @@ private:
         uint64_t addr = 0, len = 0, off = 0, tag = 0;
     };
 
-    // waits for !busy on sinks matching `pred`; on a 5 s stall kills the
-    // attached conns (peer made no progress at all: last resort)
-    template <typename PredFn> void wait_not_busy(std::unique_lock<std::mutex> &lk,
-                                                  PredFn pred);
+    // waits for !busy on every sink with lo <= tag < hi; on a 5 s stall
+    // kills the attached conns (peer made no progress at all: last resort).
+    // Drops and reacquires mu_ while parked.
+    void wait_not_busy_range(uint64_t lo, uint64_t hi) PCCLT_REQUIRES(mu_);
 
-    bool is_retired(uint64_t tag) const; // caller holds mu_
+    bool is_retired(uint64_t tag) const PCCLT_REQUIRES(mu_);
 
-    std::mutex mu_;
+    Mutex mu_;
     // Sharded wakeups: per-tag waiters (wait_filled, recv_queued, the
     // consume_cma poll) park on their tag's shard so a fill for one tag
     // does not thundering-herd every concurrent op's consumer (the
@@ -309,16 +317,17 @@ private:
         for (auto &e : shard_evs_) e.signal();
         ev_.signal();
     }
-    std::map<uint64_t, Sink> sinks_;
-    std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_;
-    std::multimap<uint64_t, PendingDesc> pending_descs_;
-    std::vector<std::weak_ptr<MultiplexConn>> members_;
+    std::map<uint64_t, Sink> sinks_ PCCLT_GUARDED_BY(mu_);
+    std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_
+        PCCLT_GUARDED_BY(mu_);
+    std::multimap<uint64_t, PendingDesc> pending_descs_ PCCLT_GUARDED_BY(mu_);
+    std::vector<std::weak_ptr<MultiplexConn>> members_ PCCLT_GUARDED_BY(mu_);
     // recently purged tag ranges: data/descriptors that straggle in AFTER an
     // op's end-of-life purge are dropped (and CMA descs ack-dropped) instead
     // of queueing forever — otherwise the sender's handle never completes.
     // Tag ranges are op-seq scoped and never reused, so a bounded memory of
     // past purges is safe.
-    std::deque<std::pair<uint64_t, uint64_t>> retired_;
+    std::deque<std::pair<uint64_t, uint64_t>> retired_ PCCLT_GUARDED_BY(mu_);
 };
 
 // --- MultiplexConn: tag-demuxed bulk data plane over one socket ---
@@ -445,34 +454,37 @@ private:
     std::thread rx_thread_, tx_thread_;
     std::atomic<bool> alive_{false};
     std::atomic<bool> closing_{false};
-    std::mutex close_mu_; // serializes close(); guards closed_
-    bool closed_ = false;
+    Mutex close_mu_; // serializes close(); guards closed_
+    bool closed_ PCCLT_GUARDED_BY(close_mu_) = false;
 
     mpsc::Queue txq_;
     park::Event tx_ev_;
-    std::mutex wr_mu_; // serializes write_frame across tx thread + inline writers
+    Mutex wr_mu_; // serializes write_frame across tx thread + inline writers
 
     std::atomic<bool> cma_ok_{false}; // same-host CMA negotiated & not failed
-    std::mutex cma_mu_;
-    std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending_cma_; // (tag,off)
+    Mutex cma_mu_;
+    // (tag,off)
+    std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending_cma_
+        PCCLT_GUARDED_BY(cma_mu_);
     // Sender side: a random token at a stable address; the receiver
     // probe-reads it via process_vm_readv before every pull and compares
     // with the copy received over TCP — proving the pid resolves to THIS
     // process in the receiver's pid namespace (guards against pid reuse and
     // cross-pidns pid collisions; raw pids are not namespace-safe).
     std::unique_ptr<std::array<uint8_t, 16>> cma_token_;
-    // Receiver side: the peer's announced identity (guarded by cma_mu_)
-    bool cma_peer_valid_ = false;
-    uint32_t cma_peer_pid_ = 0;
-    uint64_t cma_peer_token_addr_ = 0;
-    std::array<uint8_t, 16> cma_peer_token_{};
+    // Receiver side: the peer's announced identity
+    bool cma_peer_valid_ PCCLT_GUARDED_BY(cma_mu_) = false;
+    uint32_t cma_peer_pid_ PCCLT_GUARDED_BY(cma_mu_) = 0;
+    uint64_t cma_peer_token_addr_ PCCLT_GUARDED_BY(cma_mu_) = 0;
+    std::array<uint8_t, 16> cma_peer_token_ PCCLT_GUARDED_BY(cma_mu_){};
 
     // registered-shm transport state (shm.hpp).
     // TX side (guarded by shm_tx_mu_): regions already announced on this
     // conn and the retire-feed cursor.
-    std::mutex shm_tx_mu_;
-    std::map<uint64_t, uint64_t> shm_announced_; // base -> len
-    uint64_t shm_retire_cursor_ = 0;
+    Mutex shm_tx_mu_;
+    // base -> len
+    std::map<uint64_t, uint64_t> shm_announced_ PCCLT_GUARDED_BY(shm_tx_mu_);
+    uint64_t shm_retire_cursor_ PCCLT_GUARDED_BY(shm_tx_mu_) = 0;
     // RX side (guarded by shm_mu_): peer base addr -> {len, local mapping}.
     // Mappings are NEVER munmapped while the conn is alive — shm_resolve
     // hands out raw pointers that op threads read lock-free, so a retire or
@@ -484,9 +496,9 @@ private:
         uint64_t len = 0;
         uint8_t *local = nullptr;
     };
-    std::mutex shm_mu_;
-    std::map<uint64_t, ShmMap> shm_maps_;
-    std::vector<ShmMap> shm_zombies_;
+    Mutex shm_mu_;
+    std::map<uint64_t, ShmMap> shm_maps_ PCCLT_GUARDED_BY(shm_mu_);
+    std::vector<ShmMap> shm_zombies_ PCCLT_GUARDED_BY(shm_mu_);
 
     size_t tx_chunk_;       // active wire chunk (capped on emulated edges)
     size_t tx_chunk_base_;  // env-configured chunk, pre-cap

@@ -99,7 +99,7 @@ void test_frame_roundtrip() {
         std::this_thread::sleep_for(std::chrono::milliseconds(1));
     CHECK(got.load());
 
-    std::mutex mu;
+    Mutex mu;
     // empty payload
     CHECK(net::send_frame(cli, mu, 7, {}));
     auto f = net::recv_frame(srv, 2000);
@@ -158,7 +158,7 @@ void test_control_client_matching() {
         std::this_thread::sleep_for(std::chrono::milliseconds(1));
     CHECK(got.load());
 
-    std::mutex mu;
+    Mutex mu;
     std::vector<uint8_t> p1{1}, p2{2}, p3{3};
     CHECK(net::send_frame(srv, mu, 100, p1));
     CHECK(net::send_frame(srv, mu, 100, p2));
@@ -483,9 +483,9 @@ void test_bench_probe() {
     net::Listener lis;
     CHECK(lis.listen(0, 1, true));
     std::vector<std::thread> servers;
-    std::mutex servers_mu;
+    Mutex servers_mu;
     lis.run_async([&](net::Socket s) {
-        std::lock_guard lk(servers_mu);
+        MutexLock lk(servers_mu);
         servers.emplace_back(
             [&state, sock = std::move(s)]() mutable {
                 bench::serve_connection(std::move(sock), state);
@@ -521,7 +521,7 @@ void test_bench_probe() {
     for (int i = 0; i < 100 && !held; ++i) {
         holder = net::Socket{};
         CHECK(holder.connect(target, 5000));
-        std::mutex mu;
+        Mutex mu;
         CHECK(net::send_frame(holder, mu, proto::kBenchHello, token));
         auto ack = net::recv_frame(holder, 5000);
         CHECK(ack && !ack->payload.empty());
@@ -538,7 +538,7 @@ void test_bench_probe() {
 
     lis.stop();
     {
-        std::lock_guard lk(servers_mu);
+        MutexLock lk(servers_mu);
         for (auto &t : servers) t.join();
     }
     unsetenv("PCCLT_BENCH_SECONDS");

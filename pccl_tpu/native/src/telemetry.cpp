@@ -23,9 +23,9 @@ uint64_t now_ns() {
 }
 
 const char *intern(const std::string &s) {
-    static std::mutex mu;
+    static Mutex mu;
     static std::set<std::string> *table = new std::set<std::string>;  // leaked
-    std::lock_guard lk(mu);
+    MutexLock lk(mu);
     return table->insert(s).first->c_str();
 }
 
@@ -42,14 +42,14 @@ uint32_t tid_now() {
 // ---------------------------------------------------------------- Domain
 
 EdgeCounters &Domain::edge(const std::string &endpoint) {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     auto &p = edges_[endpoint];
     if (!p) p = std::make_unique<EdgeCounters>();
     return *p;
 }
 
 std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
-    std::lock_guard lk(mu_);
+    MutexLock lk(mu_);
     std::vector<EdgeSnapshot> out;
     out.reserve(edges_.size());
     for (const auto &[key, e] : edges_) {

@@ -34,10 +34,11 @@
 #include <cstdint>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <type_traits>
 #include <vector>
+
+#include "annotations.hpp"
 
 namespace pcclt::telemetry {
 
@@ -97,8 +98,11 @@ public:
     std::vector<EdgeSnapshot> snapshot_edges() const;
 
 private:
-    mutable std::mutex mu_;
-    std::map<std::string, std::unique_ptr<EdgeCounters>> edges_;
+    mutable Mutex mu_;
+    // values are never erased and pointees never move: edge() hands out
+    // references that outlive the lock (counter adds are lock-free atomics)
+    std::map<std::string, std::unique_ptr<EdgeCounters>> edges_
+        PCCLT_GUARDED_BY(mu_);
 };
 
 // Shared fallback for conns constructed without a comm (socktest, tools).

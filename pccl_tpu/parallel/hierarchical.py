@@ -29,7 +29,6 @@ contract as the flat ring (reference README.md:90-130).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Optional
 
 import jax

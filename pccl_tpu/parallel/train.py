@@ -14,7 +14,6 @@ in-slice compute engine.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

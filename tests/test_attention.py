@@ -1,7 +1,6 @@
 """Ring attention and flash attention parity vs the dense reference."""
 
 import numpy as np
-import pytest
 
 
 def _qkv(B=2, T=64, H=4, Dh=16, seed=0):

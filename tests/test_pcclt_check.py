@@ -1,0 +1,344 @@
+"""Drift-injection tests for the pcclt-check linters (tools/pcclt_check).
+
+Each checker must (a) pass on the real tree — the lint lane lands green —
+and (b) fail with an actionable message when one specific kind of drift is
+injected into a copy/synthetic tree: a renamed ctypes field, a narrowed
+width, an orphaned protocol id, an undocumented env var, a stale doc row,
+an unchecked thread guard, a dropped lock.  (b) is what keeps the checkers
+honest: a linter that cannot fail is documentation, not enforcement.
+"""
+
+from __future__ import annotations
+
+import shutil
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.pcclt_check import abi, env_registry, guards, protocol_ids
+
+ROOT = Path(__file__).resolve().parents[1]
+SRC = "pccl_tpu/native/src"
+
+
+def _msgs(findings):
+    return "\n".join(str(f) for f in findings)
+
+
+# --------------------------------------------------------------- real tree
+
+
+def test_abi_real_tree_clean():
+    assert abi.check(ROOT) == [], _msgs(abi.check(ROOT))
+
+
+def test_protocol_real_tree_clean():
+    assert protocol_ids.check(ROOT) == [], _msgs(protocol_ids.check(ROOT))
+
+
+def test_env_real_tree_clean():
+    assert env_registry.check(ROOT) == [], _msgs(env_registry.check(ROOT))
+
+
+def test_guards_real_tree_clean():
+    assert guards.check(ROOT) == [], _msgs(guards.check(ROOT))
+
+
+@pytest.mark.slow
+def test_tsa_real_tree_clean():
+    clang = pytest.importorskip("clang.cindex")
+    del clang
+    from tools.pcclt_check import thread_safety
+
+    out = thread_safety.check(ROOT)
+    assert not isinstance(out, list) or out == [], _msgs(out)
+
+
+# ----------------------------------------------------------- abi injection
+
+
+@pytest.fixture
+def abi_tree(tmp_path):
+    for rel in (abi.HEADER, abi.NATIVE):
+        (tmp_path / rel).parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(ROOT / rel, tmp_path / rel)
+    return tmp_path
+
+
+def _edit(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"fixture drift: {old!r} not in {rel}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def test_abi_copy_of_real_tree_passes(abi_tree):
+    assert abi.check(abi_tree) == []
+
+
+def test_abi_catches_renamed_field(abi_tree):
+    _edit(abi_tree, abi.NATIVE, '("world_size", ctypes.c_uint32)',
+          '("wrld_size", ctypes.c_uint32)')
+    out = abi.check(abi_tree)
+    assert any("wrld_size" in f.message and "name/order" in f.message
+               for f in out), _msgs(out)
+
+
+def test_abi_catches_width_drift(abi_tree):
+    _edit(abi_tree, abi.NATIVE, '("master_port", ctypes.c_uint16)',
+          '("master_port", ctypes.c_uint32)')
+    out = abi.check(abi_tree)
+    assert any("master_port" in f.message and "width drift" in f.message
+               for f in out), _msgs(out)
+
+
+def test_abi_catches_missing_function_mirror(abi_tree):
+    _edit(abi_tree, abi.NATIVE,
+          "lib.pccltGatherSlot.restype", "lib.pccltGatherSlotX.restype")
+    _edit(abi_tree, abi.NATIVE,
+          "lib.pccltGatherSlot.argtypes", "lib.pccltGatherSlotX.argtypes")
+    out = abi.check(abi_tree)
+    # both directions: the bogus declaration and the now-undeclared export
+    assert any("pccltGatherSlotX" in f.message for f in out), _msgs(out)
+    assert any("pccltGatherSlot " in f.message or
+               "pccltGatherSlot but" in f.message for f in out), _msgs(out)
+
+
+def test_abi_catches_field_count_mismatch(abi_tree):
+    _edit(abi_tree, abi.NATIVE, '        ("stall_ms", ctypes.c_uint64),\n', "")
+    out = abi.check(abi_tree)
+    assert any("EdgeStats" in f.message and "field" in f.message
+               for f in out), _msgs(out)
+
+
+# ------------------------------------------------------ protocol injection
+
+
+@pytest.fixture
+def proto_tree(tmp_path):
+    for rel in (f"{SRC}/protocol.hpp", f"{SRC}/protocol.cpp",
+                f"{SRC}/client.cpp", f"{SRC}/master.cpp",
+                f"{SRC}/master_state.cpp", f"{SRC}/sockets.cpp",
+                f"{SRC}/benchmark.cpp"):
+        (tmp_path / rel).parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(ROOT / rel, tmp_path / rel)
+    return tmp_path
+
+
+def test_protocol_copy_of_real_tree_passes(proto_tree):
+    assert protocol_ids.check(proto_tree) == []
+
+
+def test_protocol_catches_orphaned_id(proto_tree):
+    _edit(proto_tree, f"{SRC}/protocol.hpp",
+          "kC2MSessionResume = 0x100C,",
+          "kC2MSessionResume = 0x100C,\n    kC2MBogusNewThing = 0x10FF,")
+    out = protocol_ids.check(proto_tree)
+    assert any("kC2MBogusNewThing" in f.message and "never sent" in f.message
+               for f in out), _msgs(out)
+    assert any("kC2MBogusNewThing" in f.message and "dispatch arm" in f.message
+               for f in out), _msgs(out)
+
+
+def test_protocol_catches_duplicate_id_value(proto_tree):
+    _edit(proto_tree, f"{SRC}/protocol.hpp",
+          "kM2CSessionResumeAck = 0x200E,", "kM2CSessionResumeAck = 0x200C,")
+    out = protocol_ids.check(proto_tree)
+    assert any("reuses packet id 0x200C" in f.message for f in out), _msgs(out)
+
+
+def test_protocol_catches_missing_dispatch_arm(proto_tree):
+    _edit(proto_tree, f"{SRC}/master.cpp",
+          "case PacketType::kC2MOptimizeTopology:",
+          "/* dispatch arm dropped by fixture */ default:")
+    out = protocol_ids.check(proto_tree)
+    assert any("kC2MOptimizeTopology" in f.message and "dispatch arm" in f.message
+               for f in out), _msgs(out)
+
+
+def test_protocol_catches_missing_decoder(proto_tree):
+    _edit(proto_tree, f"{SRC}/protocol.cpp",
+          "std::optional<CollectiveInit> CollectiveInit::decode",
+          "std::optional<CollectiveInit> CollectiveInit::decode_renamed")
+    out = protocol_ids.check(proto_tree)
+    assert any("CollectiveInit::decode" in f.message for f in out), _msgs(out)
+
+
+# ----------------------------------------------------------- env injection
+
+
+@pytest.fixture
+def env_tree(tmp_path):
+    src = tmp_path / SRC
+    src.mkdir(parents=True)
+    inc = tmp_path / "pccl_tpu/native/include"
+    inc.mkdir(parents=True)
+    # concatenation keeps these fixture strings invisible to the checker's
+    # own scan of tests/*.py (it would otherwise read them as real env reads)
+    (src / "thing.cpp").write_text(
+        'const char *a = get' + 'env("PCCLT_DOCUMENTED");\n'
+        '#define PCCLT_SOME_MACRO 1\n')
+    (tmp_path / "docs").mkdir()
+    (tmp_path / env_registry.DOC_TABLE).write_text(textwrap.dedent("""\
+        | Var | Default | Meaning |
+        |---|---|---|
+        | `PCCLT_DOCUMENTED` | `1` | a documented knob |
+        """))
+    (tmp_path / "README.md").write_text("mentions `PCCLT_SOME_MACRO` only\n")
+    return tmp_path
+
+
+def test_env_synthetic_tree_passes(env_tree):
+    assert env_registry.check(env_tree) == []
+
+
+def test_env_catches_undocumented_var(env_tree):
+    p = env_tree / SRC / "thing.cpp"
+    p.write_text(p.read_text() +
+                 'const char *b = get' + 'env("PCCLT_SECRET_KNOB");\n')
+    out = env_registry.check(env_tree)
+    assert any("PCCLT_SECRET_KNOB" in f.message and "document it" in f.message
+               for f in out), _msgs(out)
+
+
+def test_env_catches_stale_doc_row(env_tree):
+    p = env_tree / env_registry.DOC_TABLE
+    p.write_text(p.read_text() +
+                 "| `PCCLT_REMOVED_KNOB` | `0` | gone from the code |\n")
+    out = env_registry.check(env_tree)
+    assert any("PCCLT_REMOVED_KNOB" in f.message and "stale" in f.message
+               for f in out), _msgs(out)
+
+
+def test_env_sees_helper_routed_reads(env_tree):
+    # a PCCLT_* name flowing through an env-reader helper (native_bench's
+    # _port pattern) must count as a read — undocumented => finding
+    (env_tree / SRC.replace("native/src", "") ).mkdir(exist_ok=True)
+    helper = env_tree / "pccl_tpu" / "helper_mod.py"
+    helper.write_text(
+        "import os\n"
+        "def _port(env, dflt):\n"
+        "    return int(os.environ.get(env, str(dflt)))\n"
+        "def leg(port_env='PCCLT_HELPER_KNOB', port=1):\n"
+        "    return _port(port_env, port)\n"
+        "leg(port_env='PCCLT_HELPER_KNOB_WAN')\n")
+    out = env_registry.check(env_tree)
+    assert any("PCCLT_HELPER_KNOB" in f.message and "document it" in f.message
+               for f in out), _msgs(out)
+    # one family row covers the base name AND the suffixed variant
+    table = env_tree / env_registry.DOC_TABLE
+    table.write_text(table.read_text() +
+                     "| `PCCLT_HELPER_KNOB` | `1` | helper-routed knob family |\n")
+    assert env_registry.check(env_tree) == [], _msgs(env_registry.check(env_tree))
+
+
+def test_env_catches_misspelled_doc_mention(env_tree):
+    p = env_tree / "README.md"
+    p.write_text(p.read_text() + "set `PCCLT_DOCUMENTD` to tune it\n")
+    out = env_registry.check(env_tree)
+    assert any("PCCLT_DOCUMENTD" in f.message for f in out), _msgs(out)
+
+
+# -------------------------------------------------------- guards injection
+
+
+@pytest.fixture
+def guard_tree(tmp_path):
+    src = tmp_path / SRC
+    src.mkdir(parents=True)
+    (src / "machine.hpp").write_text(textwrap.dedent("""\
+        #pragma once
+        // single-threaded by design: one loop thread drives the machine
+        class Machine {
+            ThreadGuard guard_;
+        };
+        """))
+    (src / "machine.cpp").write_text(
+        "void Machine::loop() { PCCLT_THREAD_GUARD(guard_); }\n")
+    return tmp_path
+
+
+def test_guards_synthetic_tree_passes(guard_tree):
+    assert guards.check(guard_tree) == []
+
+
+def test_guards_catches_marker_without_guard(guard_tree):
+    (guard_tree / SRC / "machine.hpp").write_text(textwrap.dedent("""\
+        #pragma once
+        // single-threaded by design: one loop thread drives the machine
+        class Machine {
+            int x_;
+        };
+        """))
+    (guard_tree / SRC / "machine.cpp").write_text("void f() {}\n")
+    out = guards.check(guard_tree)
+    assert any("declares no pcclt::ThreadGuard" in f.message
+               for f in out), _msgs(out)
+
+
+def test_guards_catches_unchecked_guard(guard_tree):
+    (guard_tree / SRC / "machine.cpp").write_text("void Machine::loop() {}\n")
+    out = guards.check(guard_tree)
+    assert any("nobody checks" in f.message and "guard_" in f.message
+               for f in out), _msgs(out)
+
+
+def test_guards_catches_ambiguous_guard_name(guard_tree):
+    # two classes sharing a guard member name: one class's call must not
+    # satisfy the other's missing check — the checker demands unique names
+    (guard_tree / SRC / "other.hpp").write_text(
+        "#pragma once\nclass Other {\n    ThreadGuard guard_;\n};\n")
+    out = guards.check(guard_tree)
+    assert any("multiple" in f.message and "guard_" in f.message
+               for f in out), _msgs(out)
+
+
+def test_guards_catches_stale_call(guard_tree):
+    (guard_tree / SRC / "machine.cpp").write_text(
+        "void Machine::loop() { PCCLT_THREAD_GUARD(guard_); "
+        "PCCLT_THREAD_GUARD(old_guard_); }\n")
+    out = guards.check(guard_tree)
+    assert any("old_guard_" in f.message and "no declared" in f.message
+               for f in out), _msgs(out)
+
+
+# ----------------------------------------------------------- tsa injection
+
+
+@pytest.fixture
+def tsa_tree(tmp_path):
+    pytest.importorskip("clang.cindex")
+    src = tmp_path / SRC
+    src.mkdir(parents=True)
+    (tmp_path / "pccl_tpu/native/include").mkdir(parents=True)
+    shutil.copy(ROOT / SRC / "annotations.hpp", src / "annotations.hpp")
+    (src / "tiny.cpp").write_text(textwrap.dedent("""\
+        #include "annotations.hpp"
+        struct Counter {
+            pcclt::Mutex mu;
+            int n PCCLT_GUARDED_BY(mu) = 0;
+            void bump() {
+                pcclt::MutexLock lk(mu);
+                ++n;
+            }
+        };
+        int main() { Counter c; c.bump(); return 0; }
+        """))
+    return tmp_path
+
+
+def test_tsa_clean_tu_passes(tsa_tree):
+    from tools.pcclt_check import thread_safety
+
+    out = thread_safety.check(tsa_tree)
+    assert out == [], _msgs(out)
+
+
+def test_tsa_catches_unlocked_write(tsa_tree):
+    from tools.pcclt_check import thread_safety
+
+    _edit(tsa_tree, f"{SRC}/tiny.cpp", "        pcclt::MutexLock lk(mu);\n", "")
+    out = thread_safety.check(tsa_tree)
+    assert any("requires holding mutex 'mu'" in f.message
+               for f in out), _msgs(out)

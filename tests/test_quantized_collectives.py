@@ -7,7 +7,6 @@ inputs, and shape/dtype round-trips including padding.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P

@@ -9,7 +9,6 @@ optimized ring; a second call can adopt the asynchronously-improved
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from pathlib import Path

@@ -1,0 +1,89 @@
+"""pcclt-check: cross-layer drift linters + static lock-discipline analysis.
+
+The native core and its Python binding carry several hand-maintained
+mirrors that TSan and the test suite cannot see drifting:
+
+  * ``include/pcclt.h`` structs/enums/prototypes  <->  the ctypes mirrors
+    in ``pccl_tpu/comm/_native.py``            (checker: ``abi``)
+  * protocol ids in ``protocol.hpp``           <->  their encode/decode
+    sites and dispatch arms                     (checker: ``protocol``)
+  * ``getenv("PCCLT_*")`` reads                 <->  the env-var table in
+    ``docs/03_api_overview.md``                 (checker: ``env``)
+  * "single-threaded by design" markers         <->  runtime
+    ``PCCLT_THREAD_GUARD`` enforcement          (checker: ``guards``)
+  * ``PCCLT_GUARDED_BY``/``PCCLT_REQUIRES`` lock contracts
+    (annotations.hpp)                           (checker: ``tsa``,
+    clang -Wthread-safety via libclang; the CMake ``-DPCCLT_ANALYZE=ON``
+    config runs the same analysis with a real clang++ driver)
+
+Run everything: ``python -m tools.pcclt_check``.  See
+``docs/11_static_analysis.md`` for the discipline and how to extend it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Callable, Iterable
+
+
+@dataclasses.dataclass
+class Finding:
+    """One actionable drift report: where it is and how to fix it."""
+
+    checker: str
+    path: str  # repo-relative
+    line: int  # 0 = whole-file
+    message: str
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"[{self.checker}] {loc}: {self.message}"
+
+
+@dataclasses.dataclass
+class Skip:
+    """A checker that could not run here (missing optional dependency)."""
+
+    checker: str
+    reason: str
+
+    def __str__(self) -> str:
+        return f"[{self.checker}] SKIPPED: {self.reason}"
+
+
+CheckFn = Callable[[Path], "list[Finding] | Skip"]
+
+
+def _registry() -> "dict[str, CheckFn]":
+    # imported lazily so `--checker abi` does not pay for libclang etc.
+    from . import abi, env_registry, guards, protocol_ids, thread_safety
+
+    return {
+        "abi": abi.check,
+        "protocol": protocol_ids.check,
+        "env": env_registry.check,
+        "guards": guards.check,
+        "tsa": thread_safety.check,
+    }
+
+
+def checker_names() -> "list[str]":
+    return list(_registry())
+
+
+def run(root: Path, names: "Iterable[str] | None" = None
+        ) -> "tuple[list[Finding], list[Skip]]":
+    """Run the named checkers (default: all) against the tree at `root`."""
+    registry = _registry()
+    findings: "list[Finding]" = []
+    skips: "list[Skip]" = []
+    for name in names if names is not None else registry:
+        if name not in registry:
+            raise KeyError(f"unknown checker {name!r}; have {sorted(registry)}")
+        out = registry[name](Path(root))
+        if isinstance(out, Skip):
+            skips.append(out)
+        else:
+            findings.extend(out)
+    return findings, skips

@@ -1,0 +1,393 @@
+"""Checker ``abi``: pcclt.h <-> _native.py ctypes mirror parity.
+
+Parses ``pccl_tpu/native/include/pcclt.h`` (structs, enums, prototypes)
+with a small parser for the header's controlled C99 style, and
+``pccl_tpu/comm/_native.py`` with :mod:`ast` (never importing it — the
+checker must run without a built ``libpcclt.so``).  Diffs, field by field
+and argument by argument:
+
+  * every header struct has a ``ctypes.Structure`` mirror whose fields
+    match in NAME, ORDER and WIDTH (e.g. ``uint32_t`` must be mirrored as
+    ``c_uint32``, ``char x[64]`` as ``c_char * 64``);
+  * every function declared in ``_declare()`` exists in the header with
+    the same arity and compatible argument/return ctypes, and every
+    exported header function is declared (a C-side signature change that
+    the binding misses corrupts arguments silently at call time).
+
+Mirror names match after normalization (strip the ``pcclt`` prefix and
+``_t`` suffix, a trailing ``C`` disambiguator, underscores, case), so
+``pccltTensorInfo_t`` <-> ``TensorInfoC``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding
+
+HEADER = "pccl_tpu/native/include/pcclt.h"
+NATIVE = "pccl_tpu/comm/_native.py"
+
+# C scalar type -> the one acceptable ctypes token (width parity)
+_SCALAR = {
+    "uint8_t": "c_uint8",
+    "int8_t": "c_int8",
+    "uint16_t": "c_uint16",
+    "int16_t": "c_int16",
+    "uint32_t": "c_uint32",
+    "int32_t": "c_int32",
+    "uint64_t": "c_uint64",
+    "int64_t": "c_int64",
+    "int": "c_int",
+    "unsigned": "c_uint",
+    "char": "c_char",
+    "float": "c_float",
+    "double": "c_double",
+    "size_t": "c_size_t",
+}
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", lambda m: "\n" * m.group(0).count("\n"), text,
+                  flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+class HeaderModel:
+    def __init__(self) -> None:
+        self.enums: dict[str, dict[str, int]] = {}
+        self.structs: dict[str, list[tuple[str, str, int]]] = {}  # name -> [(field, ctype, line)]
+        self.struct_lines: dict[str, int] = {}
+        self.funcs: dict[str, tuple[str, list[str], int]] = {}  # name -> (ret, args, line)
+
+
+def _canon_c_type(decl: str, enums: set[str], structs: set[str]) -> str:
+    """Map one C declarator type to the expected ctypes token."""
+    t = decl.strip()
+    t = re.sub(r"\bconst\b", "", t).strip()
+    t = re.sub(r"\s+", " ", t)
+    stars = t.count("*")
+    base = t.replace("*", "").strip()
+    if stars == 0:
+        if base in _SCALAR:
+            return _SCALAR[base]
+        if base in enums:
+            return "c_int"  # ctypes convention for C enums (int-sized)
+        return f"?{base}"
+    if base == "char" and stars == 1:
+        return "c_char_p"
+    if base == "void" and stars >= 1:
+        # void* / void** / void *const *: one indirection is the handle
+        return "c_void_p" if stars == 1 else "POINTER(c_void_p)"
+    if stars == 1:
+        if base in _SCALAR:
+            return f"POINTER({_SCALAR[base]})"
+        if base in enums:
+            return "POINTER(c_int)"
+        if base in structs:
+            return f"POINTER({base})"
+        # opaque handle (pccltComm_t / pccltMaster_t)
+        return "c_void_p"
+    if stars == 2:
+        # out-params for handles/structs: POINTER(<single-star form>)
+        inner = _canon_c_type(base + " *", enums, structs)
+        return f"POINTER({inner})"
+    return f"?{t}"
+
+
+def parse_header(text: str) -> HeaderModel:
+    m = HeaderModel()
+    clean = _strip_comments(text)
+
+    for em in re.finditer(r"typedef enum (\w+)\s*\{(.*?)\}\s*\1\s*;", clean, re.S):
+        name, body = em.group(1), em.group(2)
+        vals: dict[str, int] = {}
+        nxt = 0
+        for ent in body.split(","):
+            ent = ent.strip()
+            if not ent:
+                continue
+            if "=" in ent:
+                k, v = ent.split("=")
+                nxt = int(v.strip(), 0)
+                vals[k.strip()] = nxt
+            else:
+                vals[ent] = nxt
+            nxt += 1
+        m.enums[name] = vals
+
+    enum_names = set(m.enums)
+    # two passes so a struct field may reference a struct declared later
+    struct_bodies = list(
+        re.finditer(r"typedef struct (\w+)\s*\{(.*?)\}\s*\1\s*;", clean, re.S))
+    struct_names = {sm.group(1) for sm in struct_bodies}
+    for sm in struct_bodies:
+        name, body = sm.group(1), sm.group(2)
+        m.struct_lines[name] = _line_of(clean, sm.start())
+        fields: list[tuple[str, str, int]] = []
+        for decl in body.split(";"):
+            line = _line_of(clean, sm.start(2) + body.find(decl))
+            decl = decl.strip()
+            if not decl:
+                continue
+            fp = re.match(r"[\w\s]+\**\s*\(\s*\*\s*(\w+)\s*\)\s*\(.*\)$", decl, re.S)
+            if fp:
+                fields.append((fp.group(1), "CFUNCTYPE", line))
+                continue
+            arr = re.match(r"(.+?)\s+(\w+)\s*\[\s*(\d+)\s*\]$", decl)
+            if arr:
+                base = _canon_c_type(arr.group(1), enum_names, struct_names)
+                fields.append((arr.group(2), f"{base}*{arr.group(3)}", line))
+                continue
+            pm = re.match(r"(.+?)\s*(\w+)$", decl, re.S)
+            if pm:
+                typ, fname = pm.group(1), pm.group(2)
+                # '*' may lean on the name: "const char *master_ip"
+                fields.append(
+                    (fname, _canon_c_type(typ, enum_names, struct_names), line))
+        m.structs[name] = fields
+
+    for fm in re.finditer(
+            r"PCCLT_EXPORT\s+([\w\s]+?\**)\s*(pcclt\w+)\s*\((.*?)\)\s*;",
+            clean, re.S):
+        ret, name, argstr = fm.group(1), fm.group(2), fm.group(3)
+        args: list[str] = []
+        argstr = re.sub(r"\s+", " ", argstr).strip()
+        if argstr not in ("", "void"):
+            for a in argstr.split(","):
+                a = a.strip()
+                # drop the parameter name (last identifier not part of type)
+                am = re.match(r"(.+?)\s*(\w+)$", a)
+                typ = am.group(1) if am else a
+                # "const uint64_t *counts" keeps stars with the type above;
+                # "void *const *recvbufs" needs the trailing qualifier fold
+                if am and am.group(2) not in _SCALAR and not am.group(2).startswith("pcclt"):
+                    typ = a[: a.rfind(am.group(2))]
+                args.append(_canon_c_type(typ, enum_names, struct_names))
+        m.funcs[name] = (_canon_c_type(ret, enum_names, struct_names), args,
+                         _line_of(clean, fm.start()))
+    return m
+
+
+# ---------------------------------------------------------------- python side
+
+
+def _canon_py(expr: ast.expr) -> str:
+    """Canonicalize a ctypes expression from _native.py to a token."""
+    if isinstance(expr, ast.Attribute):  # ctypes.c_uint64 / c.c_uint64
+        return expr.attr
+    if isinstance(expr, ast.Name):  # P / CommStats / MaterializeFn
+        return expr.id
+    if isinstance(expr, ast.Call):  # ctypes.POINTER(X) / P(X) / CFUNCTYPE(...)
+        fn = _canon_py(expr.func)
+        if fn in ("POINTER", "P"):
+            return f"POINTER({_canon_py(expr.args[0])})"
+        if fn == "CFUNCTYPE":
+            return "CFUNCTYPE"
+        return fn
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):  # c_char * 64
+        right = expr.right
+        if isinstance(right, ast.Constant):
+            return f"{_canon_py(expr.left)}*{right.value}"
+    return f"?{ast.dump(expr)}"
+
+
+class PyModel:
+    def __init__(self) -> None:
+        self.structs: dict[str, list[tuple[str, str, int]]] = {}
+        self.struct_lines: dict[str, int] = {}
+        self.funcs: dict[str, dict] = {}  # name -> {restype, argtypes, line}
+        self.cfunc_aliases: set[str] = set()
+
+
+def parse_native(text: str) -> PyModel:
+    tree = ast.parse(text)
+    m = PyModel()
+
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                and _canon_py(node.value.func) == "CFUNCTYPE"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    m.cfunc_aliases.add(t.id)
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = [_canon_py(b) for b in node.bases]
+        if "Structure" not in bases:
+            continue
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "_fields_"
+                            for t in stmt.targets)
+                    and isinstance(stmt.value, ast.List)):
+                fields = []
+                for elt in stmt.value.elts:
+                    if isinstance(elt, ast.Tuple) and len(elt.elts) == 2:
+                        fname = elt.elts[0].value  # type: ignore[attr-defined]
+                        ftype = _canon_py(elt.elts[1])
+                        if ftype in m.cfunc_aliases:
+                            ftype = "CFUNCTYPE"
+                        fields.append((fname, ftype, elt.lineno))
+                m.structs[node.name] = fields
+                m.struct_lines[node.name] = node.lineno
+
+    decl = next((n for n in tree.body
+                 if isinstance(n, ast.FunctionDef) and n.name == "_declare"), None)
+    if decl is None:
+        return m
+
+    def record(fname: str, attr: str, value: ast.expr, line: int) -> None:
+        e = m.funcs.setdefault(fname, {"line": line})
+        if attr == "restype":
+            e["restype"] = _canon_py(value)
+        elif attr == "argtypes":
+            if isinstance(value, ast.List):
+                e["argtypes"] = [_canon_py(x) for x in value.elts]
+
+    def walk(stmts: list[ast.stmt], loop_names: list[str] | None = None) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Try):
+                walk(st.body, loop_names)
+                continue
+            if isinstance(st, ast.For):
+                # for fn in ("A", "B", ...): f = getattr(lib, fn); f.X = ...
+                names: list[str] = []
+                if isinstance(st.iter, (ast.Tuple, ast.List)):
+                    names = [e.value for e in st.iter.elts
+                             if isinstance(e, ast.Constant)]
+                walk(st.body, names)
+                continue
+            if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+                continue
+            t = st.targets[0]
+            if not isinstance(t, ast.Attribute):
+                continue
+            attr = t.attr  # restype / argtypes
+            holder = t.value
+            # lib.NAME.restype = ...
+            if (isinstance(holder, ast.Attribute)
+                    and isinstance(holder.value, ast.Name)
+                    and holder.value.id == "lib"):
+                record(holder.attr, attr, st.value, st.lineno)
+            # f.restype = ... inside a for-getattr loop
+            elif isinstance(holder, ast.Name) and loop_names:
+                for n in loop_names:
+                    record(n, attr, st.value, st.lineno)
+
+    walk(decl.body)
+    return m
+
+
+# ------------------------------------------------------------------ compare
+
+
+def _norm(name: str) -> str:
+    n = name
+    if n.startswith("pcclt"):
+        n = n[len("pcclt"):]
+    if n.endswith("_t"):
+        n = n[:-2]
+    if n.endswith("C") and len(n) > 1:
+        n = n[:-1]
+    return n.replace("_", "").lower()
+
+
+def _compatible(expected: str, actual: str, py_structs: set[str]) -> bool:
+    if expected == actual:
+        return True
+    # POINTER(pccltX_t) vs POINTER(PyMirror): struct names match normalized
+    em = re.match(r"POINTER\((\w+)\)", expected)
+    am = re.match(r"POINTER\((\w+)\)", actual)
+    if em and am:
+        return _norm(em.group(1)) == _norm(am.group(1))
+    # a struct pointer may legitimately be declared opaque on the py side
+    if em and actual == "c_void_p":
+        return True
+    return False
+
+
+def check(root: Path) -> "list[Finding]":
+    out: list[Finding] = []
+    hpath, npath = root / HEADER, root / NATIVE
+    for p in (hpath, npath):
+        if not p.is_file():
+            return [Finding("abi", str(p.relative_to(root)) if p.is_relative_to(root)
+                            else str(p), 0, "file missing — cannot diff the ABI")]
+    hm = parse_header(hpath.read_text())
+    pm = parse_native(npath.read_text())
+    py_structs = set(pm.structs)
+    py_by_norm = {_norm(k): k for k in pm.structs}
+
+    # --- structs, field by field ---
+    for cname, cfields in hm.structs.items():
+        pyname = py_by_norm.get(_norm(cname))
+        if pyname is None:
+            out.append(Finding("abi", NATIVE, 0,
+                               f"header struct {cname} has no ctypes.Structure "
+                               f"mirror (add one with {len(cfields)} fields)"))
+            continue
+        pfields = pm.structs[pyname]
+        for i, (cf, pf) in enumerate(zip(cfields, pfields)):
+            if cf[0] != pf[0]:
+                out.append(Finding(
+                    "abi", NATIVE, pf[2],
+                    f"{pyname}._fields_[{i}] is {pf[0]!r} but {cname} field "
+                    f"#{i} in pcclt.h is {cf[0]!r} (name/order drift)"))
+                break  # order is shifted; further pairs are noise
+            if not _compatible(cf[1], pf[1], py_structs):
+                out.append(Finding(
+                    "abi", NATIVE, pf[2],
+                    f"{pyname}.{pf[0]} is {pf[1]} but pcclt.h declares "
+                    f"{cname}.{cf[0]} as {cf[1]} (width drift)"))
+        if len(cfields) != len(pfields):
+            out.append(Finding(
+                "abi", NATIVE, pm.struct_lines[pyname],
+                f"{pyname} has {len(pfields)} fields but {cname} in pcclt.h "
+                f"has {len(cfields)}"))
+
+    # --- functions, argument by argument ---
+    for fname, entry in pm.funcs.items():
+        if fname not in hm.funcs:
+            out.append(Finding("abi", NATIVE, entry["line"],
+                               f"_declare() declares lib.{fname} but pcclt.h "
+                               "exports no such function"))
+            continue
+        ret, cargs, _hline = hm.funcs[fname]
+        if "restype" in entry and not _compatible(ret, entry["restype"], py_structs):
+            out.append(Finding(
+                "abi", NATIVE, entry["line"],
+                f"lib.{fname}.restype is {entry['restype']} but pcclt.h "
+                f"returns {ret}"))
+        if "argtypes" in entry:
+            pargs = entry["argtypes"]
+            if len(pargs) != len(cargs):
+                out.append(Finding(
+                    "abi", NATIVE, entry["line"],
+                    f"lib.{fname}.argtypes has {len(pargs)} entries but "
+                    f"pcclt.h declares {len(cargs)} parameters"))
+            else:
+                for i, (ca, pa) in enumerate(zip(cargs, pargs)):
+                    if not _compatible(ca, pa, py_structs):
+                        out.append(Finding(
+                            "abi", NATIVE, entry["line"],
+                            f"lib.{fname}.argtypes[{i}] is {pa} but pcclt.h "
+                            f"parameter #{i} is {ca}"))
+        elif cargs:
+            out.append(Finding(
+                "abi", NATIVE, entry["line"],
+                f"lib.{fname} sets no argtypes but pcclt.h declares "
+                f"{len(cargs)} parameters (ctypes would guess widths)"))
+
+    for fname, (_ret, _args, hline) in hm.funcs.items():
+        if fname not in pm.funcs:
+            out.append(Finding(
+                "abi", HEADER, hline,
+                f"pcclt.h exports {fname} but _declare() never declares it "
+                "(Python callers would get unchecked int-width defaults)"))
+    return out

@@ -1,0 +1,242 @@
+"""Checker ``env``: every ``PCCLT_*`` env var read by the code is documented,
+and every env var the docs promise actually exists in the code.
+
+Code side (reads only — tests/orchestrators SETTING vars is not an API):
+
+  * native: string literals passed to ``getenv(...)`` or the ``env_*``
+    helpers in ``pccl_tpu/native/{src,include}``;
+  * Python: ``os.environ.get("PCCLT_X")`` / ``os.getenv("PCCLT_X")`` /
+    ``os.environ["PCCLT_X"]`` reads (subscript writes excluded) under
+    ``pccl_tpu/``, ``examples/``, ``tests/`` and ``bench.py``;
+  * Python, helper-routed: an AST pass finds *env-reader helpers* —
+    functions that forward a parameter into ``environ.get``/``getenv``
+    (e.g. native_bench's ``_port(env, dflt)``), transitively — then
+    harvests every ``PCCLT_*`` literal passed to (or defaulted into)
+    that parameter, so knobs routed through wrappers stay visible.
+
+A documented row also covers its suffixed per-leg variants: a read of
+``PCCLT_BENCH_MASTER_PORT_WAN`` is satisfied by the
+``PCCLT_BENCH_MASTER_PORT`` row when the suffix starts with a digit or
+``_`` (the row documents the family; 18 near-identical rows would drown
+the table).
+
+Docs side: the env-var table in ``docs/03_api_overview.md`` (rows of the
+form ``| `PCCLT_X` | default | meaning |``) is the registry of record.
+Additionally, every ``PCCLT_*`` token mentioned anywhere in ``docs/`` or
+``README.md`` must be either a known env var, a ``#define``d macro, a
+CMake option (both harvested from the sources, so new macros never need a
+checker edit), or a ``PCCLT_ATTR_*`` enum constant — anything else is a
+stale or misspelled reference.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding
+
+DOC_TABLE = "docs/03_api_overview.md"
+
+_NATIVE_READ = re.compile(
+    r"(?:getenv|env_f|env_int|env_size|env_bool)\s*\(\s*\"(PCCLT_[A-Z0-9_]+)\"")
+_PY_READ = re.compile(
+    r"(?:environ\.get|getenv)\s*\(\s*\"(PCCLT_[A-Z0-9_]+)\"")
+_PY_SUBSCRIPT = re.compile(r"environ\[\s*\"(PCCLT_[A-Z0-9_]+)\"\s*\]\s*([=\w]?)")
+_TOKEN = re.compile(r"\bPCCLT_[A-Z0-9_]+\b")
+
+
+def _native_files(root: Path):
+    native = root / "pccl_tpu" / "native"
+    yield from sorted((native / "src").glob("*.[ch]pp"))
+    yield from sorted((native / "include").glob("*.h"))
+
+
+def _python_files(root: Path):
+    for base in ("pccl_tpu", "examples", "tests"):
+        d = root / base
+        if d.is_dir():
+            yield from sorted(p for p in d.rglob("*.py") if "native" not in p.parts)
+    if (root / "bench.py").is_file():
+        yield root / "bench.py"
+
+
+def _is_env_read_call(node: ast.Call) -> bool:
+    """environ.get(...) / os.getenv(...) / getenv(...)"""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "get" and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "environ":
+            return True
+        if f.attr == "getenv":
+            return True
+    return isinstance(f, ast.Name) and f.id == "getenv"
+
+
+def _helper_reads(tree: ast.Module) -> "list[tuple[str, int]]":
+    """PCCLT_* names routed through env-reader helper functions.
+
+    Fixpoint over this module: a function is an env reader at param `p`
+    when its body passes `p` as the env-name argument of environ.get /
+    getenv / another known reader.  Then every call site's literal for
+    that argument, and the param's own default, count as reads.
+    """
+    funcs = {n.name: n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    readers: dict[str, str] = {}  # func name -> env-name param
+
+    def reader_arg(call: ast.Call) -> "ast.expr | None":
+        """The expression a call passes as the env-var name, if known."""
+        if _is_env_read_call(call):
+            return call.args[0] if call.args else None
+        name = call.func.attr if isinstance(call.func, ast.Attribute) else (
+            call.func.id if isinstance(call.func, ast.Name) else None)
+        if name not in readers:
+            return None
+        param = readers[name]
+        params = [a.arg for a in funcs[name].args.args] if name in funcs else []
+        if param in params and len(call.args) > params.index(param):
+            return call.args[params.index(param)]
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        return None
+
+    changed = True
+    while changed:
+        changed = False
+        for fname, fn in funcs.items():
+            if fname in readers:
+                continue
+            params = {a.arg for a in fn.args.args}
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                arg = reader_arg(call)
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    readers[fname] = arg.id
+                    changed = True
+                    break
+
+    out: list[tuple[str, int]] = []
+
+    def note_literal(expr: "ast.expr | None") -> None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+                and re.fullmatch(r"PCCLT_[A-Z0-9_]+", expr.value):
+            out.append((expr.value, expr.lineno))
+
+    for call in ast.walk(tree):
+        if isinstance(call, ast.Call):
+            note_literal(reader_arg(call))
+    for fname, param in readers.items():
+        fn = funcs[fname]
+        args, defaults = fn.args.args, fn.args.defaults
+        for a, d in zip(args[len(args) - len(defaults):], defaults):
+            if a.arg == param:
+                note_literal(d)
+    return out
+
+
+def code_env_reads(root: Path) -> "dict[str, tuple[str, int]]":
+    """env var -> first (repo-relative file, line) that reads it."""
+    reads: dict[str, tuple[str, int]] = {}
+
+    def note(var: str, path: Path, line: int) -> None:
+        reads.setdefault(var, (str(path.relative_to(root)), line))
+
+    for p in _native_files(root):
+        for i, ln in enumerate(p.read_text().splitlines(), 1):
+            for m in _NATIVE_READ.finditer(ln):
+                note(m.group(1), p, i)
+    for p in _python_files(root):
+        text = p.read_text()
+        for i, ln in enumerate(text.splitlines(), 1):
+            for m in _PY_READ.finditer(ln):
+                note(m.group(1), p, i)
+            for m in _PY_SUBSCRIPT.finditer(ln):
+                if m.group(2) != "=":  # subscript assignment is a write
+                    note(m.group(1), p, i)
+        try:
+            for var, line in _helper_reads(ast.parse(text)):
+                note(var, p, line)
+        except SyntaxError:
+            pass  # unparsable file: the regex pass above still applies
+    return reads
+
+
+def documented_vars(root: Path) -> "dict[str, int]":
+    """Vars with a row in the docs/03 env table -> line number."""
+    path = root / DOC_TABLE
+    if not path.is_file():
+        return {}
+    out: dict[str, int] = {}
+    for i, ln in enumerate(path.read_text().splitlines(), 1):
+        m = re.match(r"\|\s*`(PCCLT_[A-Z0-9_]+)`\s*\|", ln)
+        if m:
+            out[m.group(1)] = i
+    return out
+
+
+def _non_env_tokens(root: Path) -> "set[str]":
+    """PCCLT_* identifiers that are legitimately not env vars."""
+    ok: set[str] = set()
+    for p in _native_files(root):
+        ok.update(re.findall(r"#define\s+(PCCLT_[A-Z0-9_]+)", p.read_text()))
+    cml = root / "pccl_tpu" / "native" / "CMakeLists.txt"
+    if cml.is_file():
+        ok.update(re.findall(r"option\(\s*(PCCLT_[A-Z0-9_]+)", cml.read_text()))
+    return ok
+
+
+def check(root: Path) -> "list[Finding]":
+    out: list[Finding] = []
+    reads = code_env_reads(root)
+    table = documented_vars(root)
+    if not table:
+        return [Finding("env", DOC_TABLE, 0,
+                        "env-var table not found (rows like '| `PCCLT_X` | ...')")]
+
+    def covered(var: str) -> bool:
+        if var in table:
+            return True
+        # family rule: a row covers its suffixed per-leg variants
+        # (PCCLT_BENCH_MASTER_PORT row covers ..._WAN, ...2, ...)
+        return any(var.startswith(row) and var[len(row)] in "0123456789_"
+                   for row in table if len(var) > len(row))
+
+    for var, (path, line) in sorted(reads.items()):
+        if not covered(var):
+            out.append(Finding(
+                "env", path, line,
+                f"{var} is read here but has no row in the {DOC_TABLE} "
+                "env-var table — document it (name | default | meaning)"))
+
+    for var, line in sorted(table.items()):
+        if var not in reads:
+            out.append(Finding(
+                "env", DOC_TABLE, line,
+                f"{var} is documented but nothing in the tree reads it — "
+                "stale row (or the reader was renamed/removed)"))
+
+    # any other doc mention must be a known identifier class
+    known = set(reads) | set(table) | _non_env_tokens(root)
+    doc_files = sorted((root / "docs").glob("*.md")) if (root / "docs").is_dir() else []
+    if (root / "README.md").is_file():
+        doc_files.append(root / "README.md")
+    for p in doc_files:
+        rel = str(p.relative_to(root))
+        for i, ln in enumerate(p.read_text().splitlines(), 1):
+            for tok in _TOKEN.findall(ln):
+                if tok in known or tok.startswith("PCCLT_ATTR_"):
+                    continue
+                # prefix mentions like "the PCCLT_WIRE_ maps" read as prose
+                if tok.endswith("_"):
+                    continue
+                known.add(tok)  # report each unknown token once
+                out.append(Finding(
+                    "env", rel, i,
+                    f"{tok} is mentioned here but is neither a code-read env "
+                    "var, a #define, a CMake option, nor a PCCLT_ATTR_ "
+                    "constant — stale or misspelled reference"))
+    return out

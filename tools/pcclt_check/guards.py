@@ -1,0 +1,125 @@
+"""Checker ``guards``: single-threaded-by-design classes really enforce it.
+
+Lock annotations (annotations.hpp) cover state that IS shared; the other
+concurrency contract in the tree is the opposite claim — "only one thread
+ever enters this state machine" — which is not expressible as a capability
+and is enforced at runtime by ``pcclt::ThreadGuard`` (thread_guard.hpp).
+This checker keeps the three pieces of that contract from drifting apart:
+
+  * a class whose comment carries the canonical marker
+    ``single-threaded by design`` must declare a ``ThreadGuard`` member
+    (the claim without the tripwire is wishful thinking);
+  * every declared ``ThreadGuard`` member must be checked — at least one
+    ``PCCLT_THREAD_GUARD(<member>)`` call site in the sources (a guard
+    nobody calls catches nothing);
+  * every ``PCCLT_THREAD_GUARD(x)`` call must name a declared guard
+    (catches a renamed member leaving a stale call).
+
+The marker comment must sit within 8 lines above (or inside) the class it
+describes.  See docs/11_static_analysis.md for the convention.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from . import Finding
+
+SRC = "pccl_tpu/native/src"
+MARKER = re.compile(r"single-threaded by design", re.I)
+GUARD_DECL = re.compile(r"\bThreadGuard\s+(\w+)\s*;")
+GUARD_CALL = re.compile(r"PCCLT_THREAD_GUARD\(\s*(\w+)\s*\)")
+CLASS_DECL = re.compile(r"^\s*(?:class|struct)\s+(\w+)")
+
+
+def _enclosing_class(lines: "list[str]", idx: int) -> str:
+    for j in range(idx, -1, -1):
+        m = CLASS_DECL.match(lines[j])
+        if m:
+            return m.group(1)
+    return "?"
+
+
+def check(root: Path) -> "list[Finding]":
+    out: list[Finding] = []
+    src = root / SRC
+    files = sorted(src.glob("*.[ch]pp"))
+    if not files:
+        return [Finding("guards", SRC, 0, "no native sources found")]
+
+    all_text = {p: p.read_text() for p in files}
+    # member -> every (file, line, class) declaring it: calls are matched by
+    # bare member name (the macro call site carries no class), so a name
+    # declared by TWO classes would let one class's call mask the other's
+    # missing check — flagged below as ambiguity rather than guessed at
+    decls: dict[str, list[tuple[str, int, str]]] = {}
+    calls: dict[str, tuple[str, int]] = {}
+
+    for p, text in all_text.items():
+        if p.name == "thread_guard.hpp":
+            continue  # the definition itself
+        rel = str(p.relative_to(root))
+        lines = text.splitlines()
+        for i, ln in enumerate(lines):
+            dm = GUARD_DECL.search(ln)
+            if dm:
+                decls.setdefault(dm.group(1), []).append(
+                    (rel, i + 1, _enclosing_class(lines, i)))
+            if "#define" not in ln:
+                for cm in GUARD_CALL.finditer(ln):
+                    calls.setdefault(cm.group(1), (rel, i + 1))
+
+        # marker comment -> a class with a guard member must follow
+        for i, ln in enumerate(lines):
+            if "//" not in ln or not MARKER.search(ln):
+                continue
+            for j in range(i, min(i + 9, len(lines))):
+                m = CLASS_DECL.match(lines[j])
+                if m:
+                    # the class body must declare a ThreadGuard member
+                    depth, body = 0, []
+                    for k in range(j, len(lines)):
+                        body.append(lines[k])
+                        depth += lines[k].count("{") - lines[k].count("}")
+                        if depth == 0 and "{" in "".join(body):
+                            break
+                    if not GUARD_DECL.search("\n".join(body)):
+                        out.append(Finding(
+                            "guards", rel, j + 1,
+                            f"class {m.group(1)} is marked 'single-threaded "
+                            "by design' but declares no pcclt::ThreadGuard "
+                            "member — the invariant is unenforced"))
+                    break
+            else:
+                out.append(Finding(
+                    "guards", rel, i + 1,
+                    "'single-threaded by design' marker is attached to no "
+                    "class declaration within 8 lines — move it onto the "
+                    "class that owns the ThreadGuard"))
+
+    for member, sites in sorted(decls.items()):
+        if len(sites) > 1:
+            where = ", ".join(f"{c} ({r}:{ln})" for r, ln, c in sites)
+            out.append(Finding(
+                "guards", sites[0][0], sites[0][1],
+                f"ThreadGuard member {member!r} is declared by multiple "
+                f"classes — {where}; calls are matched by bare name, so one "
+                "class's check would mask the others' missing one. Give each "
+                "guard a unique name."))
+            continue
+        rel, line, cls = sites[0]
+        if member not in calls:
+            out.append(Finding(
+                "guards", rel, line,
+                f"{cls}::{member} is a ThreadGuard nobody checks — add "
+                f"PCCLT_THREAD_GUARD({member}) at the guarded entry point(s) "
+                "or remove the member"))
+
+    for member, (rel, line) in sorted(calls.items()):
+        if member not in decls:
+            out.append(Finding(
+                "guards", rel, line,
+                f"PCCLT_THREAD_GUARD({member}) names no declared ThreadGuard "
+                "member — stale call after a rename?"))
+    return out

@@ -1,0 +1,67 @@
+/* Parse-only x86 intrinsics shim for the pcclt-check thread-safety driver.
+ *
+ * The libclang wheel ships libclang.so but NOT clang's resource headers, so
+ * the tsa checker (tools/pcclt_check/thread_safety.py) parses against GCC's
+ * builtin include dir — whose <xmmintrin.h> family calls GCC-only
+ * __builtin_ia32_* builtins clang does not implement. This shim shadows
+ * those headers with just the declarations the pcclt tree uses, typed with
+ * portable vector extensions, so the SIMD TUs (kernels.cpp,
+ * kernels_avx2.cpp, hash_clmul.cpp) stay inside the analysis sweep.
+ *
+ * NEVER used for code generation: real builds (gcc via build_gcc.sh, clang
+ * via -DPCCLT_ANALYZE=ON) use their toolchain's own intrinsic headers. The
+ * semantics below are deliberately wrong (identity bodies) — only the
+ * signatures matter to the parse. Extend it when a new intrinsic appears;
+ * the tsa checker's parse error will point here.
+ */
+#ifndef PCCLT_CHECK_INTRIN_SHIM_H
+#define PCCLT_CHECK_INTRIN_SHIM_H
+
+typedef float __m128 __attribute__((__vector_size__(16), __aligned__(16)));
+typedef long long __m128i __attribute__((__vector_size__(16), __aligned__(16)));
+typedef double __m128d __attribute__((__vector_size__(16), __aligned__(16)));
+typedef float __m256 __attribute__((__vector_size__(32), __aligned__(32)));
+typedef long long __m256i __attribute__((__vector_size__(32), __aligned__(32)));
+
+static inline __m128 _mm_loadu_ps(const float *p) { return *(const __m128 *)p; }
+static inline void _mm_stream_ps(float *p, __m128 a) { *(__m128 *)p = a; }
+static inline __m128 _mm_add_ps(__m128 a, __m128 b) { return a + b; }
+/* clang predeclares _mm_sfence as a (non-static) library builtin, so a
+ * static inline shim would clash; a macro sidesteps the declaration. */
+#define _mm_sfence() ((void)0)
+#define _MM_SHUFFLE(a, b, c, d) ((((a) << 6) | ((b) << 4) | ((c) << 2) | (d)))
+
+static inline __m128i _mm_loadu_si128(const __m128i *p) { return *p; }
+static inline void _mm_storeu_si128(__m128i *p, __m128i a) { *p = a; }
+static inline void _mm_stream_si128(__m128i *p, __m128i a) { *p = a; }
+static inline __m128i _mm_and_si128(__m128i a, __m128i b) { return a & b; }
+static inline __m128i _mm_xor_si128(__m128i a, __m128i b) { return a ^ b; }
+static inline __m128i _mm_set_epi32(int a, int b, int c, int d) {
+    return (__m128i){(long long)a, (long long)d};
+}
+static inline __m128i _mm_cvtsi32_si128(int a) { return (__m128i){a, 0}; }
+static inline int _mm_extract_epi32(__m128i a, int i) { return (int)a[0] + i; }
+static inline __m128i _mm_srli_si128(__m128i a, int i) { return a; }
+static inline __m128i _mm_clmulepi64_si128(__m128i a, __m128i b, int i) {
+    return a ^ b;
+}
+
+static inline __m256 _mm256_add_ps(__m256 a, __m256 b) { return a + b; }
+static inline __m256i _mm256_add_epi32(__m256i a, __m256i b) { return a + b; }
+static inline __m256i _mm256_and_si256(__m256i a, __m256i b) { return a & b; }
+static inline __m256i _mm256_castps_si256(__m256 a) { return (__m256i){0, 0, 0, 0}; }
+static inline __m256 _mm256_castsi256_ps(__m256i a) { return (__m256){0, 0, 0, 0, 0, 0, 0, 0}; }
+static inline __m128i _mm256_castsi256_si128(__m256i a) { return (__m128i){a[0], a[1]}; }
+static inline __m256i _mm256_cvtepu16_epi32(__m128i a) { return (__m256i){a[0], a[1], 0, 0}; }
+static inline __m256i _mm256_packus_epi32(__m256i a, __m256i b) { return a; }
+static inline __m256i _mm256_permute4x64_epi64(__m256i a, int i) { return a; }
+static inline __m256i _mm256_set1_epi32(int a) { return (__m256i){a, a, a, a}; }
+static inline __m256i _mm256_setzero_si256(void) { return (__m256i){0, 0, 0, 0}; }
+static inline __m256i _mm256_slli_epi32(__m256i a, int i) { return a; }
+static inline __m256i _mm256_srli_epi32(__m256i a, int i) { return a; }
+static inline __m256i _mm256_loadu_si256(const __m256i *p) { return *p; }
+static inline void _mm256_storeu_si256(__m256i *p, __m256i a) { *p = a; }
+static inline __m256 _mm256_loadu_ps(const float *p) { return *(const __m256 *)p; }
+static inline void _mm256_storeu_ps(float *p, __m256 a) { *(__m256 *)p = a; }
+
+#endif /* PCCLT_CHECK_INTRIN_SHIM_H */

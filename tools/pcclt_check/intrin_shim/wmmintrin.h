@@ -1,0 +1,2 @@
+/* parse-only shim: see pcclt_shim_common.h */
+#include "pcclt_shim_common.h"

@@ -1,0 +1,125 @@
+"""Checker ``protocol``: PCCP packet-id exhaustiveness across layers.
+
+Parses the ``PacketType`` enum in ``protocol.hpp`` and verifies, for every
+id, the invariants a new packet type must satisfy before it can work
+end-to-end (each one has been violated by real drift at least once in
+comparable codebases — an orphaned id compiles fine and fails at runtime):
+
+  * id values are unique (a collision silently routes packets to the
+    wrong handler);
+  * every ``kC2M*`` id is sent somewhere in ``client.cpp`` AND has a
+    ``case PacketType::kC2M...`` dispatch arm in ``master.cpp`` (the
+    dispatcher that feeds MasterState);
+  * every ``kM2C*`` id is emitted by ``master_state.cpp`` AND matched
+    somewhere in ``client.cpp``;
+  * every other id (``kP2P*``, ``kC2S*``/``kS2C*``, ``kBench*``) is
+    referenced by at least one data-plane implementation file;
+  * every payload struct declared with ``encode()`` in ``protocol.hpp``
+    defines BOTH ``X::encode`` and ``X::decode`` in ``protocol.cpp``
+    (serialize/deserialize parity).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from . import Finding
+
+SRC = "pccl_tpu/native/src"
+
+
+def parse_packet_enum(text: str) -> "dict[str, tuple[int, int]]":
+    """PacketType enumerators -> (value, line)."""
+    m = re.search(r"enum PacketType[^{]*\{(.*?)\};", text, re.S)
+    if not m:
+        return {}
+    body, start = m.group(1), m.start(1)
+    out: dict[str, tuple[int, int]] = {}
+    for em in re.finditer(r"(k\w+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)", body):
+        line = text.count("\n", 0, start + em.start()) + 1
+        out[em.group(1)] = (int(em.group(2), 0), line)
+    return out
+
+
+def check(root: Path) -> "list[Finding]":
+    out: list[Finding] = []
+    src = root / SRC
+    hpp = src / "protocol.hpp"
+    if not hpp.is_file():
+        return [Finding("protocol", f"{SRC}/protocol.hpp", 0, "file missing")]
+    htext = hpp.read_text()
+    ids = parse_packet_enum(htext)
+    if not ids:
+        return [Finding("protocol", f"{SRC}/protocol.hpp", 0,
+                        "could not parse the PacketType enum")]
+
+    # --- unique values ---
+    by_val: dict[int, str] = {}
+    for name, (val, line) in ids.items():
+        if val in by_val:
+            out.append(Finding(
+                "protocol", f"{SRC}/protocol.hpp", line,
+                f"{name} reuses packet id 0x{val:04X} already taken by "
+                f"{by_val[val]} — collisions dispatch to the wrong handler"))
+        else:
+            by_val[val] = name
+
+    def text_of(name: str) -> str:
+        p = src / name
+        return p.read_text() if p.is_file() else ""
+
+    client = text_of("client.cpp")
+    master = text_of("master.cpp")
+    master_state = text_of("master_state.cpp")
+    dataplane = "\n".join(
+        text_of(n) for n in ("client.cpp", "sockets.cpp", "benchmark.cpp"))
+
+    def used(text: str, ident: str) -> bool:
+        return re.search(rf"\b{ident}\b", text) is not None
+
+    for name, (_val, line) in ids.items():
+        if name.startswith("kC2M"):
+            if not used(client, name):
+                out.append(Finding(
+                    "protocol", f"{SRC}/protocol.hpp", line,
+                    f"{name} is never sent by client.cpp — orphaned "
+                    "client->master id (remove it or wire the sender)"))
+            if not re.search(rf"case\s+PacketType::{name}\b", master):
+                out.append(Finding(
+                    "protocol", f"{SRC}/protocol.hpp", line,
+                    f"{name} has no dispatch arm in master.cpp's packet "
+                    "switch — the master would drop it as unknown"))
+        elif name.startswith("kM2C"):
+            if not used(master_state, name):
+                out.append(Finding(
+                    "protocol", f"{SRC}/protocol.hpp", line,
+                    f"{name} is never emitted by master_state.cpp — "
+                    "orphaned master->client id"))
+            if not used(client, name):
+                out.append(Finding(
+                    "protocol", f"{SRC}/protocol.hpp", line,
+                    f"{name} is never matched in client.cpp — the client "
+                    "would never consume it"))
+        else:
+            if not used(dataplane, name):
+                out.append(Finding(
+                    "protocol", f"{SRC}/protocol.hpp", line,
+                    f"{name} is referenced by no data-plane file "
+                    "(client/sockets/benchmark) — orphaned id"))
+
+    # --- encode/decode parity for typed payloads ---
+    proto_cpp = text_of("protocol.cpp")
+    declared = set(re.findall(
+        r"struct (\w+)\s*\{[^{}]*?encode\(\) const;", htext, re.S))
+    for struct in sorted(declared):
+        has_enc = re.search(rf"\b{struct}::encode\b", proto_cpp)
+        has_dec = re.search(rf"\b{struct}::decode\b", proto_cpp)
+        if not has_enc or not has_dec:
+            missing = "encode" if not has_enc else "decode"
+            out.append(Finding(
+                "protocol", f"{SRC}/protocol.cpp", 0,
+                f"{struct} declares encode()/decode() in protocol.hpp but "
+                f"protocol.cpp defines no {struct}::{missing} — "
+                "serialize/deserialize drift"))
+    return out

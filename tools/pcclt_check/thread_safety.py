@@ -1,0 +1,84 @@
+"""Checker ``tsa``: clang -Wthread-safety over every native TU, via libclang.
+
+This is the same analysis the CMake ``-DPCCLT_ANALYZE=ON`` config runs with
+a real ``clang++`` driver (CI's lint lane), made available on hosts that
+only have the ``libclang`` Python wheel: each translation unit in
+``pccl_tpu/native/src`` is parsed with ``-Wthread-safety`` and ANY
+diagnostic of warning severity or above fails the check — the tree is
+kept warning-clean under the analysis, so a single new warning is always
+a regression in the change that introduced it.
+
+Two host quirks are absorbed here:
+
+  * the libclang wheel ships no resource headers, so clang's builtin
+    includes (stddef.h & friends) come from the host GCC's builtin dir;
+  * GCC's SIMD intrinsic headers call GCC-only builtins clang cannot
+    parse, so ``intrin_shim/`` shadows them with parse-only signatures
+    (see pcclt_shim_common.h — never used for code generation).
+
+No libclang on the host -> the checker reports a Skip (the CI lint lane
+still enforces the analysis with real clang++).
+"""
+
+from __future__ import annotations
+
+import glob
+from pathlib import Path
+
+from . import Finding, Skip
+
+SRC = "pccl_tpu/native/src"
+INCLUDE = "pccl_tpu/native/include"
+# severity 2 = warning, 3 = error, 4 = fatal (clang.cindex.Diagnostic)
+_FAIL_AT = 2
+
+
+def _gcc_builtin_include() -> "str | None":
+    hits = sorted(glob.glob("/usr/lib/gcc/*/*/include"))
+    return hits[-1] if hits else None
+
+
+def parse_args(root: Path) -> "list[str]":
+    args = [
+        "-std=c++20", "-x", "c++", "-pthread",
+        f"-I{root / INCLUDE}", f"-I{root / SRC}",
+        f"-I{Path(__file__).resolve().parent / 'intrin_shim'}",
+        "-Wthread-safety", "-Wthread-safety-beta",
+    ]
+    gcc_inc = _gcc_builtin_include()
+    if gcc_inc:
+        args.append(f"-I{gcc_inc}")
+    return args
+
+
+def check(root: Path) -> "list[Finding] | Skip":
+    try:
+        from clang import cindex
+        index = cindex.Index.create()
+    except Exception as e:  # no wheel, or libclang.so failed to load
+        return Skip("tsa", f"libclang unavailable ({e}); run the analysis via "
+                    "CXX=clang++ cmake -DPCCLT_ANALYZE=ON instead")
+
+    src = root / SRC
+    tus = sorted(src.glob("*.cpp"))
+    if not tus:
+        return [Finding("tsa", SRC, 0, "no native TUs found")]
+
+    args = parse_args(root)
+    out: "list[Finding]" = []
+    for tu_path in tus:
+        tu = index.parse(str(tu_path), args=args)
+        for d in tu.diagnostics:
+            if d.severity < _FAIL_AT:
+                continue
+            loc = d.location
+            fpath = str(loc.file) if loc.file else str(tu_path)
+            try:
+                rel = str(Path(fpath).resolve().relative_to(root.resolve()))
+            except ValueError:
+                rel = fpath  # a system header: report as-is
+            out.append(Finding(
+                "tsa", rel, loc.line,
+                f"{d.spelling} [clang -Wthread-safety sweep of "
+                f"{tu_path.name}]"))
+    return out
