@@ -30,7 +30,7 @@ double run_probe(const net::Addr &target);
 
 // Per-server-endpoint admission state: one prober token holds the floor.
 struct ServeState {
-    Mutex mu;
+    Mutex mu; // lock-rank: 72
     std::array<uint8_t, 16> token PCCLT_GUARDED_BY(mu){};
     int refcount PCCLT_GUARDED_BY(mu) = 0;
 };

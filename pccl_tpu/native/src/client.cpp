@@ -43,27 +43,35 @@ void Client::spawn_service(
     std::function<void(net::Socket &, const std::shared_ptr<std::atomic<int>> &)> body) {
     auto fd = std::make_shared<std::atomic<int>>(sock.fd());
     auto done = std::make_shared<std::atomic<bool>>(false);
-    MutexLock lk(svc_mu_);
-    if (!svc_accepting_) return; // disconnecting: drop the connection
-    // reap finished threads so the vector stays bounded under churn
-    for (auto it = svc_threads_.begin(); it != svc_threads_.end();) {
-        if (it->done->load()) {
-            it->th.join();
-            it = svc_threads_.erase(it);
-        } else {
-            ++it;
+    // reap finished threads so the vector stays bounded under churn; the
+    // joins happen OUTSIDE svc_mu_ — a done-flagged thread exits promptly,
+    // but "promptly" on a loaded host is still a stall every accept would
+    // serialize behind (blocking-under-lock lint, tools/pcclt_verify)
+    std::vector<std::thread> reap;
+    {
+        MutexLock lk(svc_mu_);
+        if (!svc_accepting_) return; // disconnecting: drop the connection
+        for (auto it = svc_threads_.begin(); it != svc_threads_.end();) {
+            if (it->done->load()) {
+                reap.push_back(std::move(it->th));
+                it = svc_threads_.erase(it);
+            } else {
+                ++it;
+            }
         }
+        SvcThread st;
+        st.fd = fd;
+        st.done = done;
+        st.th = std::thread(
+            [sock = std::move(sock), body = std::move(body), fd, done]() mutable {
+                body(sock, fd);
+                fd->store(-1);
+                done->store(true);
+            });
+        svc_threads_.push_back(std::move(st));
     }
-    SvcThread st;
-    st.fd = fd;
-    st.done = done;
-    st.th = std::thread(
-        [sock = std::move(sock), body = std::move(body), fd, done]() mutable {
-            body(sock, fd);
-            fd->store(-1);
-            done->store(true);
-        });
-    svc_threads_.push_back(std::move(st));
+    for (auto &t : reap)
+        if (t.joinable()) t.join();
 }
 
 // ---------------- accept handlers ----------------
@@ -292,15 +300,24 @@ void Client::disconnect() {
     }
     for (auto &s : svcs)
         if (s.th.joinable()) s.th.join();
-    MutexLock lk(state_mu_);
-    for (auto &[_, pc] : peers_) {
+    // detach the peer map under state_mu_, close OUTSIDE it: close() joins
+    // each conn's rx/tx threads, and holding the client's state lock across
+    // those joins stalls every concurrent state reader for the whole
+    // teardown (blocking-under-lock lint, tools/pcclt_verify). Nothing can
+    // repopulate peers_ here — the listeners and service threads are
+    // already down.
+    std::map<proto::Uuid, PeerConns> peers;
+    {
+        MutexLock lk(state_mu_);
+        peers.swap(peers_);
+        ring_.clear();
+    }
+    for (auto &[_, pc] : peers) {
         for (auto &c : pc.tx)
             if (c) c->close();
         for (auto &c : pc.rx)
             if (c) c->close();
     }
-    peers_.clear();
-    ring_.clear();
 }
 
 Status Client::check_kicked() {
@@ -869,6 +886,35 @@ Status Client::all_reduce_async(const void *send, void *recv, uint64_t count,
 
 Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
                                  proto::DType dtype, ReduceDesc desc, AsyncOp *op) {
+    bool is_retry;
+    uint64_t retry_seq = 0;
+    {
+        MutexLock lk(retry_mu_);
+        auto it = retry_tags_.find(desc.tag);
+        is_retry = it != retry_tags_.end();
+        if (is_retry) retry_seq = it->second;
+    }
+    uint64_t observed_seq = 0;
+    Status st = run_reduce_worker_impl(send, recv, count, dtype, desc, op,
+                                       is_retry, retry_seq, &observed_seq);
+    // a session-loss outcome marks the NEXT init of this tag as a retry of
+    // the attempt that observed `observed_seq` at commence; any concluded
+    // outcome (ok/aborted/fatal) clears the mark. A RETRY that itself died
+    // pre-commence keeps the ORIGINAL incarnation seq — overwriting with 0
+    // would unkey the journaled verdict forever (code-review catch)
+    MutexLock lk(retry_mu_);
+    if (st == Status::kConnectionLost)
+        retry_tags_[desc.tag] =
+            (is_retry && observed_seq == 0) ? retry_seq : observed_seq;
+    else
+        retry_tags_.erase(desc.tag);
+    return st;
+}
+
+Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t count,
+                                      proto::DType dtype, const ReduceDesc &desc,
+                                      AsyncOp *op, bool is_retry,
+                                      uint64_t retry_seq, uint64_t *observed_seq) {
     // session generation at op start: if a concurrent thread resumes the
     // master session mid-op, replies to THIS op's packets can never arrive
     // on the new session — bail with a retryable status instead of waiting
@@ -885,6 +931,8 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     ci.op = desc.op;
     ci.quant = desc.quant;
     ci.quant_dtype = desc.quant_dtype;
+    ci.retry = is_retry ? 1 : 0;
+    ci.retry_seq = retry_seq;
     if (!master_.send(PacketType::kC2MCollectiveInit, ci.encode()))
         return classify_master_loss();
 
@@ -895,9 +943,42 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         } catch (...) { return false; }
     };
     if (session_flipped()) return Status::kConnectionLost;
-    auto commence =
-        master_.recv_match(PacketType::kM2CCollectiveCommence, tag_pred, 600'000);
+    // Wait for commence OR an abort verdict. An abort BEFORE any commence
+    // is a restarted master replaying the outcome of an op that completed
+    // under its previous incarnation (our Done was lost in the crash, the
+    // peers moved on, and no commence will ever come — journal OpDoneRec,
+    // found by the pcclt-verify model checker). In the normal flow the
+    // commence always precedes any abort on this ordered connection.
+    auto frame_tag_pred = [tag = desc.tag](const net::Frame &f) {
+        try {
+            wire::Reader r(f.payload);
+            return r.u64() == tag;
+        } catch (...) { return false; }
+    };
+    auto commence = master_.recv_match_any(
+        {static_cast<uint16_t>(PacketType::kM2CCollectiveCommence),
+         static_cast<uint16_t>(PacketType::kM2CCollectiveAbort)},
+        frame_tag_pred, 600'000);
     if (!commence) return classify_master_loss();
+    if (commence->type == static_cast<uint16_t>(PacketType::kM2CCollectiveAbort)) {
+        bool replay_aborted = true;
+        uint32_t replay_world = 0;
+        try {
+            wire::Reader r(commence->payload);
+            r.u64();
+            replay_aborted = r.u8() != 0;
+            replay_world = r.u32(); // replayed verdicts carry the op world
+        } catch (...) {}
+        auto done =
+            master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
+        if (!done) return classify_master_loss();
+        // kOk: our ring ran to completion back then — the retry's recv
+        // buffer (same args per the retry contract, and uniquely for this
+        // path the SAME buffer) already holds the result. kAborted: the
+        // group aborted it; retry from the inputs.
+        op->info.world = replay_world;
+        return replay_aborted ? Status::kAborted : Status::kOk;
+    }
     if (session_flipped()) return Status::kConnectionLost;
     uint64_t seq;
     try {
@@ -905,6 +986,7 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
         r.u64();
         seq = r.u64();
     } catch (...) { return Status::kInternal; }
+    *observed_seq = seq; // the incarnation a session-loss retry refers to
 
     // 2. snapshot ring + neighbor connections
     std::vector<proto::Uuid> ring;
@@ -914,7 +996,27 @@ Status Client::run_reduce_worker(const void *send, void *recv, uint64_t count,
     }
     uint32_t world = static_cast<uint32_t>(ring.size());
     auto self_it = std::find(ring.begin(), ring.end(), uuid_);
-    if (self_it == ring.end() || world < 2) return Status::kInternal;
+    if (self_it == ring.end() || world < 2) {
+        // The op COMMENCED group-wide but this member cannot run a ring (a
+        // singleton group, or our ring snapshot raced churn). Returning a
+        // bare error here used to leave the master's CollectiveOp waiting
+        // for a completion that never comes — wedging this tag for every
+        // future group member until we happened to disconnect (found by
+        // the pcclt-verify model checker). Fail the op through the NORMAL
+        // completion handshake instead: complete(aborted=1), consume the
+        // exactly-one abort verdict, await done.
+        wire::Writer w;
+        w.u64(desc.tag);
+        w.u8(1);
+        if (!master_.send(PacketType::kC2MCollectiveComplete, w.data()))
+            return classify_master_loss();
+        auto verdict =
+            master_.recv_match(PacketType::kM2CCollectiveAbort, tag_pred, 600'000);
+        auto done =
+            master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
+        if (!verdict || !done) return classify_master_loss();
+        return Status::kInternal;
+    }
     uint32_t rank = static_cast<uint32_t>(self_it - ring.begin());
     const proto::Uuid &next = ring[(rank + 1) % world];
     const proto::Uuid &prev = ring[(rank + world - 1) % world];

@@ -15,6 +15,7 @@
 #include <memory>
 #include <mutex>  // std::once_flag (SharedStateEntry::mat_once)
 #include <optional>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -197,6 +198,10 @@ private:
     Status classify_master_loss();
     Status run_reduce_worker(const void *send, void *recv, uint64_t count,
                              proto::DType dtype, ReduceDesc desc, AsyncOp *op);
+    Status run_reduce_worker_impl(const void *send, void *recv, uint64_t count,
+                                  proto::DType dtype, const ReduceDesc &desc,
+                                  AsyncOp *op, bool is_retry,
+                                  uint64_t retry_seq, uint64_t *observed_seq);
     void on_p2p_accept(net::Socket sock);
     void on_ss_accept(net::Socket sock);
     void on_bench_accept(net::Socket sock);
@@ -211,8 +216,11 @@ private:
     // master HA state: serialized resume loop (resume_mu_ guards no data —
     // it serializes reconnect() of master_ against concurrent resumers and
     // disconnect()), observed epoch, resume count, last shared-state
-    // revision seen complete (re-presented on resume)
-    Mutex resume_mu_;
+    // revision seen complete (re-presented on resume). blocking-ok: the
+    // whole point of this lock is holding rivals out for the duration of
+    // the dial/backoff/handshake loop; waiters are resumers/disconnect
+    // only, never the data plane.
+    Mutex resume_mu_; // lock-rank: 10 blocking-ok
     std::atomic<uint64_t> master_epoch_{0};
     std::atomic<uint64_t> reconnects_{0};
     std::atomic<uint64_t> last_sync_revision_{0};
@@ -226,26 +234,37 @@ private:
     net::ControlClient master_;
     net::Listener p2p_listener_, ss_listener_, bench_listener_;
 
-    mutable Mutex state_mu_;
+    mutable Mutex state_mu_; // lock-rank: 20
     CondVar state_cv_; // signalled when inbound p2p conns land
     std::map<proto::Uuid, PeerConns> peers_ PCCLT_GUARDED_BY(state_mu_);
     std::vector<proto::Uuid> ring_ PCCLT_GUARDED_BY(state_mu_);
     uint64_t topo_revision_ PCCLT_GUARDED_BY(state_mu_) = 0;
 
-    Mutex ops_mu_;
+    Mutex ops_mu_; // lock-rank: 22
     std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_ PCCLT_GUARDED_BY(ops_mu_);
     // lazily sized to the op cap
     std::unique_ptr<util::WorkerPool> op_pool_ PCCLT_GUARDED_BY(ops_mu_);
 
+    // Tags whose last attempt died with the master session (worker saw
+    // ConnectionLost): the NEXT init of such a tag is a RETRY and is
+    // flagged on the wire (CollectiveInit::retry) so a restarted master
+    // may replay the journaled verdict — and ONLY then: tags are
+    // app-reused across steps, so an unflagged same-tag init must form a
+    // fresh op. Own leaf mutex: workers record outcomes here while
+    // disconnect() holds ops_mu_ awaiting those same workers.
+    Mutex retry_mu_; // lock-rank: 29
+    // tag -> commence seq the dead attempt observed (0 = died pre-commence)
+    std::map<uint64_t, uint64_t> retry_tags_ PCCLT_GUARDED_BY(retry_mu_);
+
     // reuse pool for ring receive scratch: per-op vectors would be
     // page-zeroed by the kernel on every reduce (milliseconds at 10s of MiB)
-    Mutex scratch_mu_;
+    Mutex scratch_mu_; // lock-rank: 28
     std::vector<std::vector<uint8_t>> scratch_pool_ PCCLT_GUARDED_BY(scratch_mu_);
     std::vector<uint8_t> take_scratch();
     void give_scratch(std::vector<uint8_t> v);
 
     // shared-state distribution window (serve only while a sync is active)
-    Mutex dist_mu_;
+    Mutex dist_mu_; // lock-rank: 24
     bool dist_open_ PCCLT_GUARDED_BY(dist_mu_) = false;
     uint64_t dist_revision_ PCCLT_GUARDED_BY(dist_mu_) = 0;
     std::map<std::string, SharedStateEntry> dist_entries_
@@ -264,7 +283,7 @@ private:
     void spawn_service(net::Socket sock,
                        std::function<void(net::Socket &,
                                           const std::shared_ptr<std::atomic<int>> &)> body);
-    Mutex svc_mu_;
+    Mutex svc_mu_; // lock-rank: 26
     std::vector<SvcThread> svc_threads_ PCCLT_GUARDED_BY(svc_mu_);
     bool svc_accepting_ PCCLT_GUARDED_BY(svc_mu_) = false;
 };

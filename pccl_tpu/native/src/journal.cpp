@@ -2,8 +2,10 @@
 
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdlib>
 #include <cstring>
+#include <iterator>
 
 #include "log.hpp"
 #include "wire.hpp"
@@ -114,6 +116,31 @@ bool Journal::replay(const std::string &path) {
                 restored_.bandwidth.push_back(b);
                 break;
             }
+            case kOpDone: {
+                OpDoneRec rec;
+                rec.group = r.u32();
+                rec.tag = r.u64();
+                rec.seq = r.u64();
+                rec.any_aborted = r.u8() != 0;
+                rec.world = r.u32();
+                uint32_t n = r.u32();
+                for (uint32_t i = 0; i < n; ++i)
+                    rec.members.insert(proto::get_uuid(r));
+                restored_.op_done[{rec.group, rec.tag}] = std::move(rec);
+                break;
+            }
+            case kOpDoneConsumed: {
+                uint32_t g = r.u32();
+                uint64_t tag = r.u64();
+                Uuid u = proto::get_uuid(r);
+                auto it = restored_.op_done.find({g, tag});
+                if (it != restored_.op_done.end()) {
+                    it->second.members.erase(u);
+                    if (it->second.members.empty())
+                        restored_.op_done.erase(it);
+                }
+                break;
+            }
             default:
                 break; // unknown record: skip (forward compatibility)
             }
@@ -130,6 +157,39 @@ bool Journal::replay(const std::string &path) {
         if (restored_.clients.count(b.from) && restored_.clients.count(b.to))
             kept.push_back(b);
     restored_.bandwidth = std::move(kept);
+    // prune op-done replay entries owed to departed clients: only a
+    // journaled (rehydratable) session can ever resume and retry the op
+    for (auto it = restored_.op_done.begin(); it != restored_.op_done.end();) {
+        auto &members = it->second.members;
+        for (auto mit = members.begin(); mit != members.end();)
+            mit = restored_.clients.count(*mit) ? std::next(mit)
+                                                : members.erase(mit);
+        it = members.empty() ? restored_.op_done.erase(it) : std::next(it);
+    }
+    // Bound what carries across epochs: each control connection delivers
+    // Dones IN ORDER, so a member can only be owed a SUFFIX of its Done
+    // stream — records older than the most recent kOpDoneKeep completions
+    // per group were delivered long ago and are history, not liabilities.
+    // Without this cap, a long-lived journal would accrete one record per
+    // distinct tag ever completed.
+    constexpr size_t kOpDoneKeep = 64;
+    std::map<uint32_t, std::vector<uint64_t>> seqs_by_group;
+    for (auto &[key, rec] : restored_.op_done)
+        seqs_by_group[key.first].push_back(rec.seq);
+    std::map<uint32_t, uint64_t> min_keep;
+    for (auto &[g, seqs] : seqs_by_group) {
+        if (seqs.size() <= kOpDoneKeep) continue;
+        std::sort(seqs.begin(), seqs.end());
+        min_keep[g] = seqs[seqs.size() - kOpDoneKeep];
+    }
+    if (!min_keep.empty())
+        for (auto it = restored_.op_done.begin();
+             it != restored_.op_done.end();) {
+            auto mk = min_keep.find(it->first.first);
+            it = (mk != min_keep.end() && it->second.seq < mk->second)
+                     ? restored_.op_done.erase(it)
+                     : std::next(it);
+        }
     return true;
 }
 
@@ -195,6 +255,17 @@ bool Journal::write_snapshot() {
         proto::put_uuid(w, b.to);
         w.f64(b.mbps);
         put(kBandwidth, w.take());
+    }
+    for (auto &[_, rec] : restored_.op_done) {
+        wire::Writer w;
+        w.u32(rec.group);
+        w.u64(rec.tag);
+        w.u64(rec.seq);
+        w.u8(rec.any_aborted ? 1 : 0);
+        w.u32(rec.world);
+        w.u32(static_cast<uint32_t>(rec.members.size()));
+        for (const auto &u : rec.members) proto::put_uuid(w, u);
+        put(kOpDone, w.take());
     }
     if (fflush(f) != 0 || fdatasync(fileno(f)) != 0) {
         fclose(f);
@@ -269,6 +340,27 @@ void Journal::record_bandwidth(const Uuid &from, const Uuid &to, double mbps) {
     proto::put_uuid(w, to);
     w.f64(mbps);
     append(kBandwidth, w.take());
+}
+
+void Journal::record_op_done(const OpDoneRec &rec) {
+    wire::Writer w;
+    w.u32(rec.group);
+    w.u64(rec.tag);
+    w.u64(rec.seq);
+    w.u8(rec.any_aborted ? 1 : 0);
+    w.u32(rec.world);
+    w.u32(static_cast<uint32_t>(rec.members.size()));
+    for (const auto &u : rec.members) proto::put_uuid(w, u);
+    append(kOpDone, w.take());
+}
+
+void Journal::record_op_done_consumed(uint32_t group, uint64_t tag,
+                                      const Uuid &u) {
+    wire::Writer w;
+    w.u32(group);
+    w.u64(tag);
+    proto::put_uuid(w, u);
+    append(kOpDoneConsumed, w.take());
 }
 
 } // namespace pcclt::journal

@@ -22,6 +22,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <map>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -52,6 +53,23 @@ struct BandwidthRec {
     double mbps = 0;
 };
 
+// A collective that COMPLETED (verdict decided, Done emitted) — written
+// write-ahead, BEFORE the Done packets leave the process. A restarted
+// master uses these to REPLAY the verdict to a member whose Done was lost
+// in the crash: without the record, that member re-initiates the op while
+// its peers (who saw Done) have moved on — a cross-wait that stalls the
+// whole group until timeouts tear it down (found by the pcclt-verify
+// model checker, scenario restart_resume). `members` tracks who may still
+// need the replay; entries are consumed as members resume and retry.
+struct OpDoneRec {
+    uint32_t group = 0;
+    uint64_t tag = 0;
+    uint64_t seq = 0;
+    bool any_aborted = false;
+    uint32_t world = 0;      // op world at commence (replayed to the client)
+    std::set<Uuid> members;  // who may still need the replay (shrinks)
+};
+
 // Rehydrated view of the durable master state after replay.
 struct Restored {
     uint64_t epoch = 0;             // epoch of the PREVIOUS incarnation
@@ -60,6 +78,9 @@ struct Restored {
     std::map<Uuid, ClientRec> clients;
     std::map<uint32_t, GroupRec> groups;
     std::vector<BandwidthRec> bandwidth;
+    // completed-collective verdicts still owed to members (replay on
+    // re-init after a restart; see OpDoneRec)
+    std::map<std::pair<uint32_t, uint64_t>, OpDoneRec> op_done;
     bool any = false;               // true when the file held prior state
 };
 
@@ -85,6 +106,10 @@ public:
     void record_topology_revision(uint64_t rev);
     void record_seq_bound(uint64_t bound);
     void record_bandwidth(const Uuid &from, const Uuid &to, double mbps);
+    // write-ahead completed-collective verdict (call BEFORE emitting the
+    // Done packets) + per-member consumption as replays are delivered
+    void record_op_done(const OpDoneRec &rec);
+    void record_op_done_consumed(uint32_t group, uint64_t tag, const Uuid &u);
 
     bool is_open() const {
         MutexLock lk(mu_);
@@ -101,6 +126,8 @@ private:
         kTopoRev = 6,
         kBandwidth = 7,
         kSeqBound = 8,
+        kOpDone = 9,
+        kOpDoneConsumed = 10,
     };
 
     void append(uint8_t type, const std::vector<uint8_t> &payload)
@@ -109,7 +136,7 @@ private:
         PCCLT_REQUIRES(mu_);
     bool write_snapshot() PCCLT_REQUIRES(mu_); // compacted restored_ + new epoch
 
-    mutable Mutex mu_;
+    mutable Mutex mu_; // lock-rank: io (serializes this FILE*)
     FILE *f_ PCCLT_GUARDED_BY(mu_) = nullptr;
     std::string path_ PCCLT_GUARDED_BY(mu_);
     // restored_/epoch_ are written once inside open() (under mu_) before the

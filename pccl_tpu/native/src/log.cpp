@@ -25,7 +25,7 @@ Level parse_env() {
 }
 
 Level g_threshold = parse_env();
-Mutex g_mu;
+Mutex g_mu; // lock-rank: io (serializes stderr)
 
 const char *name(Level lv) {
     switch (lv) {

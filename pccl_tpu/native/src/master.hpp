@@ -43,7 +43,7 @@ public:
 private:
     struct Conn {
         net::Socket sock;
-        Mutex write_mu;
+        Mutex write_mu; // lock-rank: io (serializes this conn's fd)
         std::thread reader;
         net::Addr src_ip{};
     };
@@ -63,11 +63,11 @@ private:
     net::Listener listener_;
     MasterState state_;
     ThreadGuard state_guard_;
-    Mutex conns_mu_;
+    Mutex conns_mu_; // lock-rank: 30
     std::map<uint64_t, std::shared_ptr<Conn>> conns_ PCCLT_GUARDED_BY(conns_mu_);
     uint64_t next_conn_id_ PCCLT_GUARDED_BY(conns_mu_) = 1;
 
-    Mutex ev_mu_;
+    Mutex ev_mu_; // lock-rank: 32
     CondVar ev_cv_;
     std::deque<Event> events_ PCCLT_GUARDED_BY(ev_mu_);
     std::thread dispatcher_;

@@ -144,6 +144,7 @@ void MasterState::attach_journal(journal::Journal *j) {
         g.ring = gr.ring;
     }
     for (const auto &b : r.bandwidth) bandwidth_.store(b.from, b.to, b.mbps);
+    replay_ops_ = r.op_done;
     if (!limbo_.empty())
         PLOG(kInfo) << "journal restore: epoch " << epoch_ << ", "
                     << limbo_.size() << " sessions in limbo awaiting resume ("
@@ -353,6 +354,21 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
     for (auto &[_, c] : clients_)
         if (!c.accepted) {
             c.accepted = true;
+            // An admitted joiner is by definition parked in its establish
+            // loop awaiting this round's completion: give it a STANDING
+            // vote so a round that fails (member crash mid-round,
+            // unreachable-peer kick) immediately re-opens for it. Without
+            // this, a failed admission round whose only voters departed
+            // strands the joiner accepted-but-unconfirmed until its 120 s
+            // conn-info timeout fails the whole connect() — found by the
+            // pcclt-verify model checker (scenario collective_crash).
+            // Safe: votes are only consulted between rounds, and no
+            // collective/sync can be mid-commence while a round is in
+            // flight (the all-accepted-must-vote gate plus the
+            // group_mid_round deferral exclude it), so this vote can
+            // never be deferred away while the joiner is parked.
+            c.vote_topology = true;
+            c.admission_vote = true;
             journal_client(c);
             PLOG(kInfo) << "admitted " << proto::uuid_str(c.uuid) << " to group "
                         << c.peer_group;
@@ -424,6 +440,7 @@ void MasterState::check_establish(std::vector<Outbox> &out) {
         for (auto &[_, c] : clients_) {
             if (!c.accepted) continue; // pending clients are not in this round
             c.vote_topology = false;
+            c.admission_vote = false; // the round the joiner needed completed
             c.reported_establish = false;
             wire::Writer w;
             w.u64(topology_revision_);
@@ -468,6 +485,55 @@ std::vector<Outbox> MasterState::on_collective_init(uint64_t conn,
     std::vector<Outbox> out;
     auto *c = by_conn(conn);
     if (!c || !c->accepted) return out;
+    // Verdict replay (HA): this op COMPLETED under the previous master
+    // incarnation, but this member's Done was lost in the crash, so it is
+    // retrying. Its peers saw the Done and moved on — forming a fresh op
+    // here would cross-wait the group forever (model-checker finding,
+    // scenario restart_resume). Replay the journaled verdict instead: the
+    // member's data plane already ran to completion back then. Gated on
+    // ci.retry: tags are app-reused across steps, and replaying a stale
+    // verdict into a member's NEXT op on the same tag would silently skip
+    // that op with stale data (a member whose Done landed pre-crash is in
+    // the owed set too — nothing acks Dones).
+    auto rit = replay_ops_.find({c->peer_group, ci.tag});
+    if (rit != replay_ops_.end() && rit->second.members.count(c->uuid) &&
+        !(ci.retry && ci.retry_seq == rit->second.seq)) {
+        // Any OTHER init of this (group, tag) from an owed member proves
+        // it is past the recorded op: ops on one tag are serialized per
+        // member, so a fresh init — or a retry of a DIFFERENT incarnation
+        // (mismatched seq, including seq 0 = died pre-commence, where the
+        // recorded completion cannot be its op) — means its Done landed or
+        // its attempt post-dates the record. Consume the owed entry so the
+        // stale-verdict window closes at the member's next op instead of
+        // lingering across epochs (code-review catch).
+        if (journal_)
+            journal_->record_op_done_consumed(c->peer_group, ci.tag, c->uuid);
+        rit->second.members.erase(c->uuid);
+        if (rit->second.members.empty()) replay_ops_.erase(rit);
+    }
+    rit = replay_ops_.find({c->peer_group, ci.tag});
+    if (ci.retry && rit != replay_ops_.end() &&
+        rit->second.members.count(c->uuid) &&
+        ci.retry_seq == rit->second.seq) {
+        wire::Writer w;
+        w.u64(ci.tag);
+        w.u8(rit->second.any_aborted ? 1 : 0);
+        // trailing world (op size at commence): only replayed verdicts
+        // carry it; normal abort readers never look this far
+        w.u32(rit->second.world);
+        out.push_back({conn, PacketType::kM2CCollectiveAbort, w.take()});
+        wire::Writer w2;
+        w2.u64(ci.tag);
+        out.push_back({conn, PacketType::kM2CCollectiveDone, w2.take()});
+        // deliberately NOT consumed here: journaling consumption before the
+        // packets actually reach the member would strand it if we die in
+        // between, and replaying twice is harmless (idempotent verdict).
+        // The owed entry is consumed by the member's next NON-matching init
+        // above — which is the proof the replay landed (code-review catch).
+        PLOG(kInfo) << "replayed pre-epoch collective verdict (tag " << ci.tag
+                    << ") to " << proto::uuid_str(c->uuid);
+        return out;
+    }
     auto &g = groups_[c->peer_group];
     auto it = g.ops.find(ci.tag);
     if (it == g.ops.end()) {
@@ -526,6 +592,23 @@ void MasterState::check_collective(std::vector<Outbox> &out, uint32_t group, uin
     for (const auto &u : op.members) {
         auto *m = by_uuid(u);
         if (m && !op.completed.count(u)) return;
+    }
+    // WRITE-AHEAD completion record, before any verdict/Done packet is
+    // handed to the dispatcher: if we die after a Done reaches some member
+    // but not all, the next incarnation replays the verdict to the
+    // stragglers instead of letting their retry cross-wait the group
+    // (journal::OpDoneRec). One small fflush'd append per collective —
+    // negligible next to the collective itself (the seq STRIDE batching
+    // above stays; it covers the per-commence path).
+    if (journal_) {
+        journal::OpDoneRec rec;
+        rec.group = group;
+        rec.tag = tag;
+        rec.seq = op.seq;
+        rec.any_aborted = op.any_aborted;
+        rec.world = static_cast<uint32_t>(op.members.size());
+        rec.members = op.members;
+        journal_->record_op_done(rec);
     }
     // exactly-one-abort accounting: if not broadcast early, deliver verdict now
     for (const auto &u : op.members) {
@@ -819,7 +902,20 @@ std::vector<Outbox> MasterState::on_optimize(uint64_t conn) {
 void MasterState::check_optimize(std::vector<Outbox> &out) {
     if (!limbo_.empty()) return; // HA freeze (optimize rounds are global)
     auto acc = accepted_clients();
-    if (acc.empty()) return;
+    if (acc.empty()) {
+        // The world emptied mid-round: clear the in-flight latch. Leaving
+        // it set wedges the master PERMANENTLY — check_topology() returns
+        // early while optimize_in_flight_ holds, so no future client can
+        // ever be admitted and only a master restart recovers. Found by
+        // the pcclt-verify model checker (scenario optimize_crash: the
+        // sole voter crashes after its optimize vote opened the round).
+        optimize_in_flight_ = false;
+        // clients that said hello while the latch held were turned away by
+        // check_topology (which recheck_all runs BEFORE this): re-open the
+        // admission round for them now that the latch is down
+        check_topology(out);
+        return;
+    }
     if (!optimize_in_flight_) {
         for (auto *a : acc)
             if (!a->vote_optimize) return;
@@ -1037,6 +1133,16 @@ void MasterState::remove_client(std::vector<Outbox> &out, const ClientInfo &gone
             op.initiated.erase(gone.uuid);
             op.completed.erase(gone.uuid);
         }
+        // an op whose every initiator departed before commence has no
+        // observable state (no commence went out): drop the record instead
+        // of leaking it in the op table until the group empties (found by
+        // the pcclt-verify model checker's quiescence backstop)
+        for (auto it = git->second.ops.begin(); it != git->second.ops.end();) {
+            if (!it->second.commenced && it->second.initiated.empty())
+                it = git->second.ops.erase(it);
+            else
+                ++it;
+        }
         // last member gone: reset the group's shared-state revision tracking.
         // A fresh cohort is a logical resume (any first revision legal, like
         // a restarted master) — without this, workers restarted from an older
@@ -1054,6 +1160,33 @@ void MasterState::remove_client(std::vector<Outbox> &out, const ClientInfo &gone
         }
     }
     recheck_all(out);
+    // Moot-vote decline. If the departed client leaves NO pending joiner
+    // and recheck_all did not open a round, every standing topology vote
+    // is now waiting for a round that can never form: the app contract is
+    // "vote while peers are pending", so the remaining non-voters never
+    // will, and each parked voter would sit out its full 120 s conn-info
+    // timeout and surface a spurious failure. Decline the votes exactly
+    // like the mid-round tie-break does (kM2CTopologyDeferred = no-op
+    // success; the voter re-votes when peers are pending again). Found by
+    // the pcclt-verify model checker (scenario collective_crash: the
+    // pending joiner crashes out from under its voter).
+    if (!establish_in_flight_) {
+        bool any_pending = false;
+        for (auto &[_, c] : clients_)
+            if (!c.accepted) any_pending = true;
+        if (!any_pending)
+            for (auto &[_, c] : clients_)
+                // admission votes are never moot: their holder is PARKED in
+                // a non-deferrable establish wait, and the vote is what lets
+                // the next round form for it (code-review hardening)
+                if (c.accepted && c.vote_topology && !c.admission_vote) {
+                    c.vote_topology = false;
+                    out.push_back(
+                        {c.conn_id, PacketType::kM2CTopologyDeferred, {}});
+                    PLOG(kDebug) << "topology vote of " << proto::uuid_str(c.uuid)
+                                 << " declined: no pending peers left to admit";
+                }
+    }
 }
 
 void MasterState::recheck_all(std::vector<Outbox> &out) {

@@ -54,6 +54,10 @@ struct ClientInfo {
 
     // votes (valid within their phase)
     bool vote_topology = false;
+    // vote granted AT ADMISSION (the joiner is parked in its establish
+    // loop and cannot re-vote): never declined as moot, only consumed by
+    // a completed round — see check_topology / remove_client
+    bool admission_vote = false;
     bool reported_establish = false;
     bool establish_ok = false;
     std::vector<Uuid> establish_failed;
@@ -159,6 +163,12 @@ private:
     // epoch, and rehydrated sessions awaiting resume
     journal::Journal *journal_ = nullptr;
     uint64_t epoch_ = 1;
+    // completed-collective verdicts from the PREVIOUS incarnation, still
+    // owed to members whose Done was lost in the crash: a re-init of the
+    // (group, tag) from such a member replays Abort(verdict)+Done instead
+    // of forming a ghost op its moved-on peers would never join (see
+    // journal::OpDoneRec)
+    std::map<std::pair<uint32_t, uint64_t>, journal::OpDoneRec> replay_ops_;
     struct LimboClient {
         ClientInfo info; // conn_id 0 (no connection yet)
         std::chrono::steady_clock::time_point deadline;
@@ -196,7 +206,7 @@ private:
     // the ONLY cross-thread state in this otherwise single-dispatcher
     // machine: the moonshot worker writes its result here, the dispatcher
     // adopts it on the next optimize round
-    Mutex moon_mu_;
+    Mutex moon_mu_; // lock-rank: 34
     std::map<uint32_t, Moonshot> moon_ PCCLT_GUARDED_BY(moon_mu_);
     // one worker per group at a time; finished handles are joined before a
     // replacement is spawned, and moon_stop_ cancels workers on destruction

@@ -96,7 +96,7 @@ private:
     std::atomic<uint64_t> jitter_ns_{0};
     std::atomic<double> drop_{0};
 
-    Mutex mu_;  // bucket + rng
+    Mutex mu_;  // bucket + rng; lock-rank: 62
     // bucket: end of the last reserved slot
     uint64_t next_ns_ PCCLT_GUARDED_BY(mu_) = 0;
     // splitmix64 state (jitter/drop)
@@ -116,7 +116,7 @@ public:
 private:
     DelayLine() = default;
     void timer_loop();
-    Mutex mu_;
+    Mutex mu_; // lock-rank: 64
     CondVar cv_;
     // deadline -> fn
     std::multimap<uint64_t, std::function<void()>> q_ PCCLT_GUARDED_BY(mu_);
@@ -151,7 +151,7 @@ private:
     EdgeParams params_for(const std::string &exact_key,
                           const std::string &ip_key) const PCCLT_REQUIRES(mu_);
 
-    mutable Mutex mu_;
+    mutable Mutex mu_; // lock-rank: 60
     // never null after ctor
     std::shared_ptr<Edge> default_ PCCLT_GUARDED_BY(mu_);
     struct Entry {

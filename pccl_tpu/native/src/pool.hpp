@@ -59,7 +59,7 @@ private:
         }
     }
 
-    Mutex mu_;
+    Mutex mu_; // lock-rank: 70
     CondVar cv_;
     std::deque<std::function<void()>> q_ PCCLT_GUARDED_BY(mu_);
     std::vector<std::thread> threads_;

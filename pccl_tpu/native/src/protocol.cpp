@@ -193,6 +193,8 @@ std::vector<uint8_t> CollectiveInit::encode() const {
     w.u8(static_cast<uint8_t>(op));
     w.u8(static_cast<uint8_t>(quant));
     w.u8(static_cast<uint8_t>(quant_dtype));
+    w.u8(retry);
+    w.u64(retry_seq);
     return w.take();
 }
 
@@ -206,6 +208,10 @@ std::optional<CollectiveInit> CollectiveInit::decode(const std::vector<uint8_t> 
         c.op = static_cast<RedOp>(r.u8());
         c.quant = static_cast<QuantAlgo>(r.u8());
         c.quant_dtype = static_cast<DType>(r.u8());
+        try {
+            c.retry = r.u8(); // trailing; absent from older clients
+            c.retry_seq = r.u64();
+        } catch (...) {}
         return c;
     } catch (...) { return std::nullopt; }
 }

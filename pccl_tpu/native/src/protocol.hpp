@@ -158,6 +158,17 @@ struct CollectiveInit {
     RedOp op = RedOp::kSum;
     QuantAlgo quant = QuantAlgo::kNone;
     DType quant_dtype = DType::kU8;
+    // RETRY of an op whose previous attempt died with the master session
+    // (set by the client library, not the app), plus the seq that attempt
+    // observed at commence (0 = it never saw a commence). Only a
+    // retry-flagged init whose retry_seq MATCHES the journaled completed
+    // op may be answered by a verdict REPLAY after a master restart: tags
+    // are app-reused across steps, so neither the tag nor the bare retry
+    // flag identifies the op incarnation — a genuine lost-Done retrier
+    // always knows the seq (completion implies its commence was
+    // delivered). Trailing on the wire; absent (older client) decodes 0.
+    uint8_t retry = 0;
+    uint64_t retry_seq = 0;
     std::vector<uint8_t> encode() const;
     static std::optional<CollectiveInit> decode(const std::vector<uint8_t> &);
 };

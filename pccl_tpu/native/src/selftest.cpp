@@ -612,6 +612,116 @@ static void test_master_ha_state() {
     remove(path);
 }
 
+// Regression for the pcclt-verify model-checker finding (scenario
+// restart_resume): a collective completes, the master dies AFTER one
+// member's Done was delivered but BEFORE the other's — the straggler's
+// retry must get the journaled verdict REPLAYED (no ghost op that its
+// moved-on peer would never join).
+static void test_op_done_replay() {
+    const char *path = "/tmp/pcclt_selftest_opdone_journal.bin";
+    remove(path);
+    using master::Outbox;
+    auto find = [](const std::vector<Outbox> &out, uint64_t conn,
+                   uint16_t type) -> const Outbox * {
+        for (const auto &o : out)
+            if (o.conn_id == conn && o.type == type) return &o;
+        return nullptr;
+    };
+    net::Addr ip = *net::Addr::parse("127.0.0.1", 0);
+    proto::Uuid ua{}, ub{};
+    proto::CollectiveInit ci;
+    ci.tag = 5;
+    ci.count = 8;
+    {
+        journal::Journal j;
+        CHECK(j.open(path));
+        master::MasterState st;
+        st.attach_journal(&j);
+        proto::HelloC2M h;
+        h.p2p_port = 100;
+        auto out = st.on_hello(1, ip, h);
+        {
+            wire::Reader r(find(out, 1, proto::kM2CWelcome)->payload);
+            CHECK(r.u8() == 1);
+            ua = proto::get_uuid(r);
+        }
+        st.on_p2p_established(1, 1, true, {});
+        h.p2p_port = 200;
+        out = st.on_hello(2, ip, h);
+        {
+            wire::Reader r(find(out, 2, proto::kM2CWelcome)->payload);
+            CHECK(r.u8() == 1);
+            ub = proto::get_uuid(r);
+        }
+        out = st.on_topology_update(1);
+        st.on_p2p_established(1, 2, true, {});
+        st.on_p2p_established(2, 2, true, {});
+        // run tag 5 to full completion: both Dones emitted (and the
+        // completion journaled write-ahead), then "crash"
+        st.on_collective_init(1, ci);
+        out = st.on_collective_init(2, ci);
+        CHECK(find(out, 1, proto::kM2CCollectiveCommence) != nullptr);
+        st.on_collective_complete(1, 5, false);
+        out = st.on_collective_complete(2, 5, false);
+        CHECK(find(out, 1, proto::kM2CCollectiveDone) != nullptr);
+        CHECK(find(out, 2, proto::kM2CCollectiveDone) != nullptr);
+    }
+    {
+        journal::Journal j;
+        CHECK(j.open(path));
+        CHECK(j.restored().op_done.size() == 1);
+        master::MasterState st;
+        st.attach_journal(&j);
+        // client a resumes and RETRIES tag 5 (its Done was "lost"; the
+        // client library flags the re-init as a retry): the verdict is
+        // replayed — abort(0) + done, and crucially NO commence
+        proto::SessionResumeC2M ra;
+        ra.uuid = ua;
+        auto out = st.on_session_resume(11, ip, ra);
+        proto::CollectiveInit retry = ci;
+        retry.retry = 1;
+        retry.retry_seq = 1; // the seq the dead attempt saw at commence
+        out = st.on_collective_init(11, retry);
+        auto *ab = find(out, 11, proto::kM2CCollectiveAbort);
+        CHECK(ab != nullptr);
+        {
+            wire::Reader r(ab->payload);
+            CHECK(r.u64() == 5);
+            CHECK(r.u8() == 0);  // verdict: completed clean
+            CHECK(r.u32() == 2); // trailing op world (replayed verdicts only)
+        }
+        CHECK(find(out, 11, proto::kM2CCollectiveDone) != nullptr);
+        CHECK(find(out, 11, proto::kM2CCollectiveCommence) == nullptr);
+        // a FRESH (unflagged) init of the same tag is a genuinely new op —
+        // the replay gate must NOT answer it with the stale verdict (tags
+        // are app-reused per step); no commence while b is still in limbo
+        out = st.on_collective_init(11, ci);
+        CHECK(find(out, 11, proto::kM2CCollectiveAbort) == nullptr);
+        CHECK(find(out, 11, proto::kM2CCollectiveCommence) == nullptr);
+        // b resumes; a retry of a DIFFERENT incarnation (mismatched seq —
+        // here 0, the attempt died pre-commence, so the recorded
+        // completion cannot be its op) must NOT get the stale verdict:
+        // b's owed entry is consumed and the init joins a's fresh op
+        // normally — commence for both, with a seq ABOVE everything the
+        // previous incarnation issued
+        proto::SessionResumeC2M rb;
+        rb.uuid = ub;
+        st.on_session_resume(12, ip, rb);
+        proto::CollectiveInit wrong = ci;
+        wrong.retry = 1;
+        wrong.retry_seq = 0;
+        out = st.on_collective_init(12, wrong);
+        CHECK(find(out, 12, proto::kM2CCollectiveAbort) == nullptr);
+        auto *cm = find(out, 11, proto::kM2CCollectiveCommence);
+        CHECK(cm != nullptr);
+        CHECK(find(out, 12, proto::kM2CCollectiveCommence) != nullptr);
+        wire::Reader r(cm->payload);
+        CHECK(r.u64() == 5);
+        CHECK(r.u64() >= 2); // seq resumed above the journaled bound
+    }
+    remove(path);
+}
+
 static void test_atsp() {
     // 4-node asymmetric instance with a known-best ring 0->1->2->3->0
     const double INF = 100;
@@ -969,6 +1079,7 @@ int main() {
     test_quant_16bit_parity();
     test_journal();
     test_master_ha_state();
+    test_op_done_replay();
     test_atsp();
     {
         // guarded allocator: bytes usable end-to-end, balanced live count

@@ -16,7 +16,7 @@ namespace pcclt::shm {
 namespace {
 
 struct Registry {
-    Mutex mu;
+    Mutex mu; // lock-rank: 54
     // by base address
     std::map<uintptr_t, Region> live PCCLT_GUARDED_BY(mu);
     uint64_t next_id PCCLT_GUARDED_BY(mu) = 1;

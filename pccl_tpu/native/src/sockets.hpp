@@ -175,10 +175,10 @@ private:
         PCCLT_REQUIRES(mu_);
 
     Socket sock_;
-    Mutex write_mu_;
+    Mutex write_mu_; // lock-rank: io (serializes this socket's writes)
     std::thread reader_;
     std::atomic<bool> connected_{false};
-    Mutex mu_;
+    Mutex mu_; // lock-rank: 56
     CondVar cv_;
     std::deque<Frame> queue_ PCCLT_GUARDED_BY(mu_);
     // assigned in run() before the reader thread exists; read only by the
@@ -293,7 +293,7 @@ private:
 
     bool is_retired(uint64_t tag) const PCCLT_REQUIRES(mu_);
 
-    Mutex mu_;
+    Mutex mu_; // lock-rank: 44
     // Sharded wakeups: per-tag waiters (wait_filled, recv_queued, the
     // consume_cma poll) park on their tag's shard so a fill for one tag
     // does not thundering-herd every concurrent op's consumer (the
@@ -454,15 +454,20 @@ private:
     std::thread rx_thread_, tx_thread_;
     std::atomic<bool> alive_{false};
     std::atomic<bool> closing_{false};
+    // lock-rank: 40 blocking-ok — close() joins the rx/tx threads under
+    // this lock BY DESIGN: concurrent join on one std::thread is UB, so
+    // the losing closer must block until the winner finished tearing
+    // down. Only closers/destructors ever take it.
     Mutex close_mu_; // serializes close(); guards closed_
     bool closed_ PCCLT_GUARDED_BY(close_mu_) = false;
 
     mpsc::Queue txq_;
     park::Event tx_ev_;
+    // lock-rank: io (serializes this socket's frame writes)
     Mutex wr_mu_; // serializes write_frame across tx thread + inline writers
 
     std::atomic<bool> cma_ok_{false}; // same-host CMA negotiated & not failed
-    Mutex cma_mu_;
+    Mutex cma_mu_; // lock-rank: 50
     // (tag,off)
     std::map<std::pair<uint64_t, uint64_t>, SendHandle> pending_cma_
         PCCLT_GUARDED_BY(cma_mu_);
@@ -481,6 +486,10 @@ private:
     // registered-shm transport state (shm.hpp).
     // TX side (guarded by shm_tx_mu_): regions already announced on this
     // conn and the retire-feed cursor.
+    // lock-rank: 46 blocking-ok — held across the announce/retire frame
+    // writes BY DESIGN: a racing writer must not observe "announced" and
+    // ship a descriptor before the announce actually hit the wire (see
+    // shm_sync_tx). Writers block on each other here at most one frame.
     Mutex shm_tx_mu_;
     // base -> len
     std::map<uint64_t, uint64_t> shm_announced_ PCCLT_GUARDED_BY(shm_tx_mu_);
@@ -496,7 +505,7 @@ private:
         uint64_t len = 0;
         uint8_t *local = nullptr;
     };
-    Mutex shm_mu_;
+    Mutex shm_mu_; // lock-rank: 52
     std::map<uint64_t, ShmMap> shm_maps_ PCCLT_GUARDED_BY(shm_mu_);
     std::vector<ShmMap> shm_zombies_ PCCLT_GUARDED_BY(shm_mu_);
 

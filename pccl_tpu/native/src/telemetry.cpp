@@ -23,7 +23,7 @@ uint64_t now_ns() {
 }
 
 const char *intern(const std::string &s) {
-    static Mutex mu;
+    static Mutex mu; // lock-rank: 68
     static std::set<std::string> *table = new std::set<std::string>;  // leaked
     MutexLock lk(mu);
     return table->insert(s).first->c_str();

@@ -98,7 +98,7 @@ public:
     std::vector<EdgeSnapshot> snapshot_edges() const;
 
 private:
-    mutable Mutex mu_;
+    mutable Mutex mu_; // lock-rank: 66
     // values are never erased and pointees never move: edge() hands out
     // references that outlive the lock (counter adds are lock-free atomics)
     std::map<std::string, std::unique_ptr<EdgeCounters>> edges_

@@ -29,7 +29,9 @@ def test_checkpointer_roundtrip_and_retention(tmp_path):
     # retention: keep=2 -> step 1 is gone, step 2 restorable
     out2 = ck.restore(tree, step=2)
     assert float(out2["b"]) == 7.0
-    with pytest.raises(Exception):
+    # noqa'd broad raises: the purged-step error type varies across orbax
+    # versions (FileNotFoundError vs orbax's own CheckpointError)
+    with pytest.raises(Exception):  # noqa: B017
         ck.restore(tree, step=1)
     ck.close()
 

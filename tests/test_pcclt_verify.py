@@ -1,0 +1,457 @@
+"""Drift-injection tests for the pcclt-verify analyses (tools/pcclt_verify).
+
+Same contract as tests/test_pcclt_check.py: every checker must (a) pass on
+a clean (synthetic or real) tree and (b) fail ACTIONABLY when one specific
+defect is injected — a synthetic lock cycle, a blocking send under a state
+lock, a CondVar wait holding a second mutex, a spec transition removed, a
+dispatch arm orphaned from the spec. The model checker itself is kept
+honest with mutation tests: break one consensus rule in a MasterModel
+subclass and the invariant suite must report the violation the rule
+exists to prevent (deadlock tie-break, exactly-one-abort, journaled seq
+bound, resume-ack trust rule, moot-vote decline).
+"""
+
+from __future__ import annotations
+
+import shutil
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.pcclt_verify import blocking, conformance, lock_graph
+from tools.pcclt_verify import harvest as harvest_mod
+from tools.pcclt_verify.fsm_spec import MasterModel, MGroup
+from tools.pcclt_verify.model_check import (Scenario, Violation,
+                                            default_scenarios, explore)
+
+ROOT = Path(__file__).resolve().parents[1]
+SRC = "pccl_tpu/native/src"
+
+
+def _msgs(findings):
+    return "\n".join(str(f) for f in findings)
+
+
+def _fresh(checker, tree):
+    """Run a libclang checker against `tree` with the harvest memo cleared
+    (the memo is keyed by root, but tests reuse tmp paths via fixtures)."""
+    harvest_mod._memo.pop(str(Path(tree).resolve()), None)
+    return checker.check(tree)
+
+
+# ------------------------------------------------------ lock-tree fixture
+
+
+CLEAN_LOCKS = textwrap.dedent("""\
+    #include "annotations.hpp"
+    extern "C" long send(int, const void *, unsigned long, int);
+    extern "C" int nanosleep(const void *, void *);
+    struct A {
+        pcclt::Mutex mu_a; // lock-rank: 10
+        pcclt::Mutex mu_b; // lock-rank: 20
+        int x PCCLT_GUARDED_BY(mu_a) = 0;
+        void good() {
+            pcclt::MutexLock la(mu_a);
+            x = 1;
+            pcclt::MutexLock lb(mu_b);
+        }
+    };
+    struct W {
+        pcclt::Mutex mu; // lock-rank: 60
+        void park() PCCLT_REQUIRES(mu);
+        void outer() {
+            pcclt::MutexLock lk(mu);
+            park();
+        }
+    };
+    void W::park() {
+        // drop-and-reacquire window: blocks with mu RELEASED
+        mu.unlock();
+        nanosleep(nullptr, nullptr);
+        mu.lock();
+    }
+    int main() { A a; a.good(); W w; w.outer(); return 0; }
+    """)
+
+
+@pytest.fixture
+def lock_tree(tmp_path):
+    pytest.importorskip("clang.cindex")
+    src = tmp_path / SRC
+    src.mkdir(parents=True)
+    (tmp_path / "pccl_tpu/native/include").mkdir(parents=True)
+    shutil.copy(ROOT / SRC / "annotations.hpp", src / "annotations.hpp")
+    (src / "locks.cpp").write_text(CLEAN_LOCKS)
+    return tmp_path
+
+
+def _append(tree: Path, code: str) -> None:
+    p = tree / SRC / "locks.cpp"
+    p.write_text(p.read_text().replace("int main()",
+                                       textwrap.dedent(code) + "\nint main()"))
+
+
+# --------------------------------------------------- lockorder injection
+
+
+def test_lockorder_synthetic_tree_clean(lock_tree):
+    out = _fresh(lock_graph, lock_tree)
+    assert out == [], _msgs(out)
+
+
+def test_lockorder_catches_missing_rank(lock_tree):
+    p = lock_tree / SRC / "locks.cpp"
+    p.write_text(p.read_text().replace(
+        "pcclt::Mutex mu_b; // lock-rank: 20", "pcclt::Mutex mu_b;"))
+    out = _fresh(lock_graph, lock_tree)
+    assert any("mu_b" in f.message and "lock-rank" in f.message
+               for f in out), _msgs(out)
+
+
+def test_lockorder_catches_cycle_and_inversion(lock_tree):
+    _append(lock_tree, """
+        struct Rev {
+            void bad(A &a) {
+                pcclt::MutexLock lb(a.mu_b);
+                pcclt::MutexLock la(a.mu_a); // opposite order to A::good
+            }
+        };
+        """)
+    out = _fresh(lock_graph, lock_tree)
+    assert any("lock-order inversion" in f.message and "mu_a" in f.message
+               for f in out), _msgs(out)
+    assert any("cycle" in f.message and "deadlock" in f.message
+               for f in out), _msgs(out)
+
+
+def test_lockorder_catches_io_lock_with_children(lock_tree):
+    _append(lock_tree, """
+        struct Io {
+            pcclt::Mutex wmu; // lock-rank: io
+            void bad(A &a) {
+                pcclt::MutexLock w(wmu);
+                pcclt::MutexLock la(a.mu_a);
+            }
+        };
+        """)
+    out = _fresh(lock_graph, lock_tree)
+    assert any("io" in f.message and "leaves" in f.message
+               for f in out), _msgs(out)
+
+
+# ---------------------------------------------------- blocking injection
+
+
+def test_blocking_synthetic_tree_clean(lock_tree):
+    # includes the REQUIRES'd drop-and-reacquire park in W: the caller
+    # holds mu across the call, but park() releases it before blocking
+    out = _fresh(blocking, lock_tree)
+    assert out == [], _msgs(out)
+
+
+def test_blocking_catches_send_under_state_lock(lock_tree):
+    _append(lock_tree, """
+        struct Tx {
+            pcclt::Mutex smu; // lock-rank: 30
+            void tx() {
+                pcclt::MutexLock lk(smu);
+                send(0, nullptr, 0, 0);
+            }
+        };
+        """)
+    out = _fresh(blocking, lock_tree)
+    assert any("send" in f.message and "smu" in f.message
+               for f in out), _msgs(out)
+
+
+def test_blocking_io_tag_sanctions_the_send(lock_tree):
+    _append(lock_tree, """
+        struct Tx {
+            pcclt::Mutex smu; // lock-rank: io
+            void tx() {
+                pcclt::MutexLock lk(smu);
+                send(0, nullptr, 0, 0);
+            }
+        };
+        """)
+    out = _fresh(blocking, lock_tree)
+    assert out == [], _msgs(out)
+
+
+def test_blocking_allow_annotation_sanctions_the_site(lock_tree):
+    _append(lock_tree, """
+        struct Tx {
+            pcclt::Mutex smu; // lock-rank: 30
+            void tx() {
+                pcclt::MutexLock lk(smu);
+                // pcclt-verify: allow-blocking(test fixture)
+                send(0, nullptr, 0, 0);
+            }
+        };
+        """)
+    out = _fresh(blocking, lock_tree)
+    assert out == [], _msgs(out)
+
+
+def test_blocking_catches_condvar_foreign_wait(lock_tree):
+    _append(lock_tree, """
+        struct Cv {
+            pcclt::Mutex m1; // lock-rank: 40
+            pcclt::Mutex m2; // lock-rank: 50
+            pcclt::CondVar cv;
+            void waitboth() {
+                pcclt::MutexLock l1(m1);
+                pcclt::MutexLock l2(m2);
+                cv.wait(m2); // m1 stays held for the whole park
+            }
+        };
+        """)
+    out = _fresh(blocking, lock_tree)
+    assert any("CondVar" in f.message and "m1" in f.message
+               for f in out), _msgs(out)
+
+
+def test_blocking_catches_lost_drop_window(lock_tree):
+    # remove W::park's unlock: the REQUIRES'd lock is now HELD at the park
+    p = lock_tree / SRC / "locks.cpp"
+    p.write_text(p.read_text().replace("mu.unlock();", "").replace(
+        "mu.lock();", ""))
+    out = _fresh(blocking, lock_tree)
+    assert any("nanosleep" in f.message and "mu" in f.message
+               for f in out), _msgs(out)
+
+
+# ------------------------------------------------- real-tree green gates
+
+
+@pytest.mark.slow
+def test_lockorder_real_tree_clean():
+    out = _fresh(lock_graph, ROOT)
+    assert not isinstance(out, list) or out == [], _msgs(out)
+
+
+@pytest.mark.slow
+def test_blocking_real_tree_clean():
+    out = _fresh(blocking, ROOT)
+    assert not isinstance(out, list) or out == [], _msgs(out)
+
+
+# ------------------------------------------------- conformance injection
+
+
+@pytest.fixture
+def conf_tree(tmp_path):
+    for rel in (f"{SRC}/master.cpp", f"{SRC}/master_state.cpp",
+                f"{SRC}/client.cpp"):
+        (tmp_path / rel).parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(ROOT / rel, tmp_path / rel)
+    return tmp_path
+
+
+def _edit(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"fixture drift: {old!r} not in {rel}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def test_conformance_real_tree_clean():
+    out = conformance.check(ROOT)
+    assert out == [], _msgs(out)
+
+
+def test_conformance_copy_of_real_tree_passes(conf_tree):
+    assert conformance.check(conf_tree) == []
+
+
+def test_conformance_catches_arm_orphaned_from_spec(conf_tree):
+    # a NEW dispatch arm the spec has never heard of
+    _edit(conf_tree, f"{SRC}/master.cpp",
+          "case PacketType::kC2MOptimizeTopology:",
+          "case PacketType::kC2MBrandNewThing:\n"
+          "                    out = state_.on_brand_new(ev.conn_id);\n"
+          "                    break;\n"
+          "                case PacketType::kC2MOptimizeTopology:")
+    out = conformance.check(conf_tree)
+    assert any("kC2MBrandNewThing" in f.message
+               and "no transition" in f.message for f in out), _msgs(out)
+
+
+def test_conformance_catches_spec_transition_removed(conf_tree):
+    # dropping a real arm orphans the spec's modeled transition
+    _edit(conf_tree, f"{SRC}/master.cpp",
+          "case PacketType::kC2MSessionResume:",
+          "case PacketType::kC2MTopologyUpdate: /* arm dropped */")
+    out = conformance.check(conf_tree)
+    assert any("kC2MSessionResume" in f.message
+               and "no dispatch arm" in f.message for f in out), _msgs(out)
+
+
+def test_conformance_catches_handler_mismatch(conf_tree):
+    _edit(conf_tree, f"{SRC}/master.cpp",
+          "out = state_.on_optimize(ev.conn_id);",
+          "out = state_.on_optimize_work_done(ev.conn_id);")
+    out = conformance.check(conf_tree)
+    assert any("kC2MOptimizeTopology" in f.message
+               and "on_optimize" in f.message for f in out), _msgs(out)
+
+
+def test_conformance_catches_unmodeled_emission(conf_tree):
+    _edit(conf_tree, f"{SRC}/master_state.cpp",
+          "PacketType::kM2CKicked",
+          "PacketType::kM2CBogusEmission")
+    out = conformance.check(conf_tree)
+    assert any("kM2CBogusEmission" in f.message for f in out), _msgs(out)
+    # and the now-unemitted kM2CKicked is flagged as stale in the spec
+    assert any("kM2CKicked" in f.message and "never does" in f.message
+               for f in out), _msgs(out)
+
+
+# ------------------------------------------------- model-checker passes
+
+
+def _by_name(name: str) -> Scenario:
+    for sc in default_scenarios():
+        if sc.name == name:
+            return sc
+    raise AssertionError(f"no scenario {name}")
+
+
+def test_model_join_during_collective_passes():
+    explore(_by_name("join_during_collective"))
+
+
+def test_model_local_abort_passes():
+    explore(_by_name("collective_local_abort"))
+
+
+def test_model_restart_lag_passes():
+    explore(_by_name("restart_lag"))
+
+
+@pytest.mark.slow
+def test_model_default_suite_passes():
+    for sc in default_scenarios():
+        explore(sc)
+
+
+# --------------------------------------------- model-checker mutations
+# Break one consensus rule; the checker must report the violation that
+# rule exists to prevent. A model checker that cannot fail is a progress
+# bar, not a proof.
+
+
+class NoTieBreak(MasterModel):
+    """The vote-vs-commence deadlock tie-break removed: votes park even
+    when the voter's group is mid-round, and nobody is ever deferred."""
+
+    def group_mid_round(self, c):
+        return False
+
+    def defer_topology_voters(self, out, gid):
+        pass
+
+
+def test_mutation_no_tie_break_deadlocks():
+    with pytest.raises(Violation, match="stuck world|livelock"):
+        explore(_by_name("join_during_collective"), NoTieBreak)
+
+
+class DoubleAbort(MasterModel):
+    """The exactly-one-abort latch removed: every aborted completion
+    re-broadcasts, so members can see two verdicts."""
+
+    def on_collective_complete(self, uuid, tag, aborted):
+        out = []
+        c = self.clients.get(uuid)
+        if c is None:
+            return out
+        g = self.groups.setdefault(c.group, MGroup())
+        op = g.ops.get(tag)
+        if op is None:
+            return out
+        op.completed = op.completed | {uuid}
+        if aborted:
+            op.any_aborted = True
+            if op.commenced:  # BUG: abort_broadcast never latched
+                for u in op.members:
+                    if u in self.clients:
+                        out.append((u, "kM2CCollectiveAbort",
+                                    {"tag": tag, "aborted": 1}))
+        self.check_collective(out, c.group, tag)
+        return out
+
+
+def test_mutation_double_abort_detected():
+    with pytest.raises(Violation, match="abort"):
+        explore(_by_name("collective_local_abort"), DoubleAbort)
+
+
+class ForgetSeqBound(MasterModel):
+    """A restarted master restarts seqs at 1 instead of resuming above the
+    journaled bound: tag ranges from the previous epoch get reused."""
+
+    @classmethod
+    def restart(cls, journal, lag=False):
+        m = super().restart(journal, lag)
+        m.next_seq = 1  # BUG: journaled seq bound ignored
+        m.seq_bound = 0
+        return m
+
+
+def test_mutation_forgotten_seq_bound_detected():
+    with pytest.raises(Violation, match="seq"):
+        explore(_by_name("restart_resume"), ForgetSeqBound)
+
+
+class DistrustResume(MasterModel):
+    """The resume ack's trust-the-client revision rule removed: a Done
+    that raced the crash is forgotten, and the master later kicks a
+    correct client for offering the revision it legitimately reached."""
+
+    def on_session_resume(self, uuid, last_revision):
+        return super().on_session_resume(uuid, 0)  # BUG: ignore the client
+
+
+def test_mutation_distrust_resume_kicks_correct_client():
+    sc = Scenario("restart_lag3",
+                  (("a", 0, ("sync", "sync", "sync")),
+                   ("b", 0, ("sync", "sync", "sync"))),
+                  journal=True, max_restarts=1, lag=True, staged=True)
+    explore(sc)  # the real rules absorb the lost append
+    with pytest.raises(Violation, match="kick"):
+        explore(sc, DistrustResume)
+
+
+class NoMootDecline(MasterModel):
+    """The moot-vote decline removed: when the pending joiner a vote was
+    cast for departs, the standing vote parks its owner forever."""
+
+    def remove_client(self, out, uuid, gid):
+        self.abort_group_collectives(out, gid)
+        g = self.groups.get(gid)
+        if g is not None:
+            for op in g.ops.values():
+                op.initiated = op.initiated - {uuid}
+                op.completed = op.completed - {uuid}
+            for tag in [t for t, op in g.ops.items()
+                        if not op.commenced and not op.initiated]:
+                del g.ops[tag]
+            if not self.group_members(gid) and not self.group_frozen(gid):
+                self.groups[gid] = MGroup()
+                if self.journal is not None:
+                    self.journal.record_group(gid, 0, False)
+        self.recheck_all(out)  # BUG: standing votes never declined
+
+
+def test_mutation_no_moot_decline_strands_voter():
+    # needs TWO accepted members: with one, the lone vote trivially runs
+    # the round; with two, `a`'s vote parks until `b` votes — and when the
+    # pending joiner dies, `b` never will (are_peers_pending == false)
+    sc = Scenario("moot_vote",
+                  (("a", 0, ()), ("b", 0, ()), ("j", 0, ())),
+                  disconnects=("j",))
+    explore(sc)  # the decline keeps this live
+    with pytest.raises(Violation, match="stuck world|livelock"):
+        explore(sc, NoMootDecline)

@@ -1,0 +1,71 @@
+"""pcclt-verify: whole-program concurrency verification for the native core.
+
+PR 4's toolchain (tools/pcclt_check) proves per-TU lock *discipline*: every
+guarded field is accessed under its declared mutex. This layer proves the
+two properties discipline alone cannot:
+
+  * the whole-program lock acquisition graph is DEADLOCK-FREE — every
+    ``pcclt::Mutex`` carries a declared rank (``// lock-rank: N``), every
+    observed acquisition order respects the ranks, and the harvested graph
+    has no cycle                           (checkers: ``lockorder``)
+  * no critical section blocks — no socket send/recv/connect/poll, no
+    journal fsync, no sleep while holding a non-IO lock, and no CondVar
+    wait while a *different* mutex is held (checker:  ``blocking``)
+  * the master's membership/consensus machine and the client session FSM
+    have no stuck-world interleavings — an explicit-state model checker
+    DFS-explores join/leave/kick/disconnect-mid-vote/master-restart/
+    resume/limbo-expiry at world <= 4      (checker:  ``fsm``)
+  * the model cannot drift from the code — the spec's packet-triggered
+    transitions are diffed against the real kC2M*/kM2C* dispatch arms in
+    master.cpp / client.cpp               (checker:  ``conformance``)
+
+Run everything: ``python -m tools.pcclt_verify``.  See
+``docs/11_static_analysis.md`` for the lock-rank discipline and the FSM
+spec format.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable, Iterable
+
+# One Finding/Skip vocabulary across the whole static-analysis toolchain:
+# pcclt_verify findings print and exit exactly like pcclt_check's.
+from tools.pcclt_check import Finding, Skip
+
+__all__ = ["Finding", "Skip", "checker_names", "run"]
+
+CheckFn = Callable[[Path], "list[Finding] | Skip"]
+
+
+def _registry() -> "dict[str, CheckFn]":
+    # imported lazily so `--checker fsm` does not pay for libclang
+    from . import blocking, conformance, lock_graph, model_check
+
+    return {
+        "lockorder": lock_graph.check,
+        "blocking": blocking.check,
+        "fsm": model_check.check,
+        "conformance": conformance.check,
+    }
+
+
+def checker_names() -> "list[str]":
+    return list(_registry())
+
+
+def run(root: Path, names: "Iterable[str] | None" = None
+        ) -> "tuple[list[Finding], list[Skip]]":
+    """Run the named checkers (default: all) against the tree at `root`."""
+    registry = _registry()
+    findings: "list[Finding]" = []
+    skips: "list[Skip]" = []
+    for name in names if names is not None else registry:
+        if name not in registry:
+            raise KeyError(f"unknown checker {name!r}; have {sorted(registry)}")
+        out = registry[name](Path(root))
+        if isinstance(out, Skip):
+            skips.append(out)
+        else:
+            findings.extend(out)
+    return findings, skips

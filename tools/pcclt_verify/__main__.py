@@ -1,0 +1,60 @@
+"""CLI: ``python -m tools.pcclt_verify [--root DIR] [--checker NAME ...]``.
+
+Exit codes: 0 = clean, 1 = violation found, 2 = usage error. (Same
+contract as ``tools.pcclt_check``; the lint lane runs both.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from . import checker_names, run
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pcclt_verify",
+        description="lock-order/blocking analysis + control-plane model "
+                    "checking for the native core",
+    )
+    ap.add_argument("--root", type=Path,
+                    default=Path(__file__).resolve().parents[2],
+                    help="repo root (default: inferred from this file)")
+    ap.add_argument("--checker", action="append", choices=checker_names(),
+                    help="run only this checker (repeatable; default: all)")
+    ap.add_argument("--list", action="store_true", help="list checkers and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for n in checker_names():
+            print(n)
+        return 0
+    root = args.root.resolve()
+    if not (root / "pccl_tpu").is_dir():
+        print(f"pcclt_verify: {root} does not look like a pcclt repo "
+              "(no pccl_tpu/)", file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    try:
+        findings, skips = run(root, args.checker)
+    except KeyError as e:
+        print(f"pcclt_verify: {e}", file=sys.stderr)
+        return 2
+    for s in skips:
+        print(s, file=sys.stderr)
+    for f in findings:
+        print(f)
+    names = args.checker or checker_names()
+    status = "FAILED" if findings else "ok"
+    print(f"pcclt_verify: {len(findings)} finding(s) from "
+          f"{len(names) - len(skips)} checker(s) "
+          f"({time.monotonic() - t0:.1f}s) -- {status}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

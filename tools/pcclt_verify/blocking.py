@@ -1,0 +1,198 @@
+"""Checker ``blocking``: no blocking calls inside critical sections.
+
+Three lint classes over the harvested facts (one libclang parse shared
+with ``lockorder``):
+
+  * **blocking-under-lock** — a call that can park the thread on the
+    network, the disk, another process, or the clock (``send``/``recv``/
+    ``connect``/``poll``, ``fsync``/``fwrite``, ``process_vm_readv``,
+    sleeps, futex parks, ``std::call_once``) while holding a lock that is
+    not ``io``-tagged. An io lock exists to serialize exactly one fd, so
+    blocking under it is its job; blocking under a *state* lock turns one
+    slow peer into a process-wide stall (the "few network failures slow
+    the entire AllReduce" failure mode, at the lock granularity).
+    Transitive: calling a may-block function under a lock counts.
+  * **condvar-foreign-wait** — ``CondVar::wait(mu)`` releases only ``mu``;
+    any *other* lock stays held for the whole park. That is a stall at
+    best and half a deadlock at worst.
+  * **fsync-under-hot-lock** — the journal's fsync/fwrite appends are
+    singled out with a dedicated message when reached under any master
+    hot-path lock, because that is the exact regression the HA subsystem
+    must never grow (a world-freezing disk stall).
+
+A deliberate, reviewed exception is annotated at the call site with
+``// pcclt-verify: allow-blocking(reason)`` and must carry the reason.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from . import Finding, Skip
+from .harvest import Program, harvest
+
+CHECKER = "blocking"
+
+ALLOW_MARK = "pcclt-verify: allow-blocking("
+
+# journal append path: flagged with a dedicated message under these
+HOT_LOCKS = {"master::Master::ev_mu_", "master::Master::conns_mu_",
+             "master::MasterState::moon_mu_"}
+JOURNAL_PRIMS = {"fsync", "fdatasync", "fwrite", "fflush"}
+
+
+def _io_ok(prog: Program, ident: str) -> bool:
+    """True when blocking under `ident` is sanctioned: io-tagged or
+    blocking-ok-tagged by its declaration, or a function-local mutex (it
+    serializes at most the enclosing frame's own IO — the throwaway
+    send_frame mutex pattern)."""
+    d = prog.locks.get(ident)
+    if d is None:
+        return ident.startswith(("local:", "param:"))
+    return d.io or d.blocking_ok or d.local
+
+
+def may_block(prog: Program) -> "dict[str, tuple[str, str, int] | None]":
+    """USR -> witness (primitive, file, line) when the function may block,
+    directly or transitively; None otherwise."""
+    blk: "dict[str, tuple[str, str, int] | None]" = {}
+    for usr, f in prog.funcs.items():
+        blk[usr] = ((f.blocking[0].what, f.blocking[0].file,
+                     f.blocking[0].line) if f.blocking else None)
+    changed = True
+    while changed:
+        changed = False
+        for usr, f in prog.funcs.items():
+            if blk[usr] is not None:
+                continue
+            for cs in f.calls:
+                w = blk.get(cs.callee)
+                if w is not None:
+                    blk[usr] = (f"{cs.callee_name} -> {w[0]}", cs.file,
+                                cs.line)
+                    changed = True
+                    break
+    return blk
+
+
+def parks_holding(prog: Program,
+                  blk: "dict[str, tuple[str, str, int] | None]"
+                  ) -> "dict[str, frozenset[str]]":
+    """USR -> locks actually HELD at some park reachable from the
+    function. A callee that REQUIRES a lock but drops it before every
+    park (the SinkTable::wait_not_busy_range window) does not hold it at
+    the park, so a caller whose only held lock is that REQUIRES'd one is
+    not stalled-under-lock: the callee releases it while parked."""
+    ph: "dict[str, set[str]]" = {
+        usr: set().union(*(set(b.held) for b in f.blocking))
+        if f.blocking else set()
+        for usr, f in prog.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for usr, f in prog.funcs.items():
+            cur = ph[usr]
+            for cs in f.calls:
+                if blk.get(cs.callee) is None:
+                    continue  # callee never blocks
+                callee = prog.funcs.get(cs.callee)
+                sub = ph.get(cs.callee, set())
+                # locks the callee REQUIRES and never holds at a park are
+                # dropped by the callee before parking
+                dropped = (set(callee.requires) - sub) if callee else set()
+                add = (set(cs.held) - dropped) | sub
+                if not add <= cur:
+                    cur |= add
+                    changed = True
+    return {u: frozenset(s) for u, s in ph.items()}
+
+
+def _allowed(root: Path, file: str, line: int,
+             cache: "dict[str, list[str]]") -> bool:
+    if file not in cache:
+        try:
+            cache[file] = (root / file).read_text(
+                errors="replace").splitlines()
+        except OSError:
+            cache[file] = []
+    lines = cache[file]
+    for ln in (line, line - 1):
+        if 0 < ln <= len(lines) and ALLOW_MARK in lines[ln - 1]:
+            return True
+    return False
+
+
+def check(root: Path) -> "list[Finding] | Skip":
+    prog = harvest(root)
+    if isinstance(prog, str):
+        return Skip(CHECKER, f"{prog}; install the libclang wheel to run "
+                    "the blocking-under-lock analysis")
+    rootp = Path(root).resolve()
+    out: "list[Finding]" = []
+    src_cache: "dict[str, list[str]]" = {}
+    blk = may_block(prog)
+    ph = parks_holding(prog, blk)
+
+    def offenders(held: "tuple[str, ...]") -> "list[str]":
+        return [h for h in held if not _io_ok(prog, h)]
+
+    for f in prog.funcs.values():
+        # direct primitives under a lock
+        for b in f.blocking:
+            bad = offenders(b.held)
+            if not bad or _allowed(rootp, b.file, b.line, src_cache):
+                continue
+            prim = b.what.rsplit("::", 1)[-1].split(" ")[0]
+            if prim in JOURNAL_PRIMS and any(h in HOT_LOCKS for h in bad):
+                out.append(Finding(
+                    CHECKER, b.file, b.line,
+                    f"journal-class disk write ({b.what}) while holding "
+                    f"hot-path lock(s) {', '.join(bad)} — a disk stall "
+                    "here freezes the whole world; append outside the "
+                    "lock or hand off to the journal thread"))
+            else:
+                out.append(Finding(
+                    CHECKER, b.file, b.line,
+                    f"{f.name} calls blocking {b.what} while holding "
+                    f"{', '.join(bad)} — move the call outside the "
+                    "critical section (copy what you need under the lock, "
+                    "then block), tag the lock `io` if its whole purpose "
+                    "is serializing this fd, or annotate "
+                    "`// pcclt-verify: allow-blocking(reason)`"))
+        # transitive: call to a may-block function under a lock
+        for cs in f.calls:
+            bad = offenders(cs.held)
+            if not bad:
+                continue
+            w = blk.get(cs.callee)
+            if w is None:
+                continue
+            callee = prog.funcs.get(cs.callee)
+            if callee is not None:
+                # drop-window excuse: the callee REQUIRES the lock and
+                # releases it before every park it can reach
+                dropped = set(callee.requires) - ph.get(cs.callee,
+                                                        frozenset())
+                bad = [h for h in bad if h not in dropped]
+            if not bad:
+                continue
+            if _allowed(rootp, cs.file, cs.line, src_cache):
+                continue
+            out.append(Finding(
+                CHECKER, cs.file, cs.line,
+                f"{f.name} calls {cs.callee_name} while holding "
+                f"{', '.join(bad)}, and {cs.callee_name} may block "
+                f"({w[0]} at {w[1]}:{w[2]}) — release the lock before the "
+                "call or annotate `// pcclt-verify: allow-blocking(reason)`"))
+        # CondVar waits holding a second lock
+        for cv in f.cv_waits:
+            others = [h for h in cv.held if h != cv.mutex]
+            if not others or _allowed(rootp, cv.file, cv.line, src_cache):
+                continue
+            out.append(Finding(
+                CHECKER, cv.file, cv.line,
+                f"{f.name} waits on a CondVar with {cv.mutex} while ALSO "
+                f"holding {', '.join(others)} — the wait releases only its "
+                "own mutex; every other lock stays held for the whole "
+                "park (stall at best, half a deadlock at worst)"))
+    return out

@@ -1,0 +1,140 @@
+"""Checker ``conformance``: the FSM spec cannot drift from the code.
+
+A hand-written model is only worth trusting while it matches the thing it
+models. This checker diffs the spec's packet-transition tables
+(``fsm_spec.MASTER_DISPATCH`` / ``MASTER_EMITS`` / ``CLIENT_SENDS`` /
+``CLIENT_CONSUMES``) against the REAL protocol surface, extending the
+PR-4 ``protocol`` checker's parsing:
+
+  * every ``case PacketType::kC2M...`` dispatch arm in ``master.cpp`` must
+    appear in the spec, and must route to the same ``on_*`` handler the
+    spec transition names (and the handler must exist on the spec class);
+  * every spec transition must still have its dispatch arm (a removed or
+    renamed arm orphans the model);
+  * every ``kM2C*`` id master_state.cpp emits must be one the spec can
+    emit, and vice versa;
+  * every ``kC2M*``/``kM2C*`` id the client sends/consumes in client.cpp
+    must match the client-FSM tables.
+
+So: adding a packet without teaching the model fails CI, and simplifying
+the model below the code's real surface fails CI too.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from . import Finding, Skip
+
+CHECKER = "conformance"
+SRC = "pccl_tpu/native/src"
+
+
+def parse_dispatch_arms(master_text: str) -> "dict[str, str]":
+    """kC2M id -> the state_.on_*() handler its case arm calls."""
+    out: "dict[str, str]" = {}
+    # split the switch body at case labels; each chunk belongs to the id
+    # that opened it (fallthrough-free switch, enforced by the handler
+    # check below failing on an empty chunk)
+    parts = re.split(r"case\s+PacketType::(k\w+):", master_text)
+    for i in range(1, len(parts) - 1, 2):
+        pid, body = parts[i], parts[i + 1]
+        if not pid.startswith("kC2M"):
+            continue
+        m = re.search(r"state_\.(on_\w+)\s*\(", body)
+        out[pid] = m.group(1) if m else ""
+    return out
+
+
+def check(root: Path) -> "list[Finding] | Skip":
+    from . import fsm_spec
+    from .fsm_spec import MasterModel
+
+    rootp = Path(root)
+    src = rootp / SRC
+    out: "list[Finding]" = []
+
+    def text_of(name: str) -> str:
+        p = src / name
+        return p.read_text() if p.is_file() else ""
+
+    master = text_of("master.cpp")
+    master_state = text_of("master_state.cpp")
+    client = text_of("client.cpp")
+    if not master or not master_state or not client:
+        return [Finding(CHECKER, SRC, 0,
+                        "master.cpp/master_state.cpp/client.cpp missing — "
+                        "cannot diff the spec against the dispatch surface")]
+    spec_rel = "tools/pcclt_verify/fsm_spec.py"
+
+    # --- master dispatch arms <-> spec transitions --------------------
+    arms = parse_dispatch_arms(master)
+    for pid, handler in sorted(arms.items()):
+        spec_handler = fsm_spec.MASTER_DISPATCH.get(pid)
+        if spec_handler is None:
+            out.append(Finding(
+                CHECKER, f"{SRC}/master.cpp", 0,
+                f"dispatch arm {pid} -> {handler or '?'} has no transition "
+                f"in the FSM spec — teach {spec_rel} the packet (or the "
+                "model no longer covers the control plane)"))
+        elif handler != spec_handler:
+            out.append(Finding(
+                CHECKER, f"{SRC}/master.cpp", 0,
+                f"dispatch arm {pid} calls state_.{handler or '<nothing>'} "
+                f"but the spec transition names {spec_handler} — realign "
+                f"the arm or {spec_rel}"))
+    for pid, spec_handler in sorted(fsm_spec.MASTER_DISPATCH.items()):
+        if pid not in arms:
+            out.append(Finding(
+                CHECKER, spec_rel, 0,
+                f"spec transition {pid} -> {spec_handler} has no dispatch "
+                "arm in master.cpp's packet switch — the modeled packet "
+                "no longer exists (remove it from the spec or restore the "
+                "arm)"))
+        if not hasattr(MasterModel, spec_handler):
+            out.append(Finding(
+                CHECKER, spec_rel, 0,
+                f"spec names handler {spec_handler} for {pid} but "
+                "MasterModel defines no such method — the model would "
+                "drop the packet"))
+
+    # --- master emissions <-> spec emissions --------------------------
+    emitted = set(re.findall(r"PacketType::(kM2C\w+)", master_state))
+    # kM2CWelcome's wire-rev-mismatch rejection also writes from on_hello;
+    # both sites are in master_state.cpp, so the harvest is complete.
+    for pid in sorted(emitted - fsm_spec.MASTER_EMITS):
+        out.append(Finding(
+            CHECKER, f"{SRC}/master_state.cpp", 0,
+            f"master_state.cpp emits {pid} but the spec's MASTER_EMITS "
+            f"does not include it — teach {spec_rel} the emission"))
+    for pid in sorted(fsm_spec.MASTER_EMITS - emitted):
+        out.append(Finding(
+            CHECKER, spec_rel, 0,
+            f"spec claims the master emits {pid} but master_state.cpp "
+            "never does — stale spec emission"))
+
+    # --- client surface <-> client-FSM tables -------------------------
+    sends = set(re.findall(r"PacketType::(kC2M\w+)", client))
+    consumes = set(re.findall(r"PacketType::(kM2C\w+)", client))
+    for pid in sorted(sends - fsm_spec.CLIENT_SENDS):
+        out.append(Finding(
+            CHECKER, f"{SRC}/client.cpp", 0,
+            f"client.cpp sends {pid} but the spec's CLIENT_SENDS does not "
+            f"include it — teach {spec_rel} the client transition"))
+    for pid in sorted(fsm_spec.CLIENT_SENDS - sends):
+        out.append(Finding(
+            CHECKER, spec_rel, 0,
+            f"spec claims the client sends {pid} but client.cpp never "
+            "does — stale client transition"))
+    for pid in sorted(consumes - fsm_spec.CLIENT_CONSUMES):
+        out.append(Finding(
+            CHECKER, f"{SRC}/client.cpp", 0,
+            f"client.cpp consumes {pid} but the spec's CLIENT_CONSUMES "
+            f"does not include it — teach {spec_rel} the reaction"))
+    for pid in sorted(fsm_spec.CLIENT_CONSUMES - consumes):
+        out.append(Finding(
+            CHECKER, spec_rel, 0,
+            f"spec claims the client consumes {pid} but client.cpp never "
+            "matches it — stale spec consumption"))
+    return out

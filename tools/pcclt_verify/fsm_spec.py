@@ -1,0 +1,856 @@
+"""Executable spec of the CCoIP control plane: master consensus machine +
+client session FSM.
+
+This is a hand-written Python mirror of ``master_state.cpp`` (one method
+per ``MasterState::on_*`` handler, same names) and of the client protocol
+loop in ``client.cpp`` (connect/establish, topology vote with the
+deferred tie-break, collective init->commence->complete->exactly-one-abort
+->done, shared-state sync, optimize, master-restart resume with the
+session-generation retry rule). The model checker (``model_check.py``)
+DFS-explores every interleaving of these machines; the ``conformance``
+checker diffs the packet tables below against the real dispatch arms so
+the spec cannot silently drift from the code.
+
+Abstractions (deliberate, documented):
+  * payload *contents* are reduced to what the control flow branches on
+    (revisions, tags, ok flags); tensor data, hashes and endpoint info are
+    out of scope;
+  * shared-state entries always agree in key-set and content, and all
+    clients use enforce-popular — the mask-election/kick ladder for
+    mismatched offers is data-plane validation, not interleaving logic;
+  * p2p establishment succeeds unless the scenario injects a failure;
+  * bandwidth matrices collapse to one "measured" bit per client;
+  * client<->master delivery is instant into per-client FIFO inboxes
+    (TCP per-connection ordering + the single-dispatcher master make the
+    *order of client sends* the only real nondeterminism), and clients
+    consume replies by type-matched scan, mirroring ControlClient's
+    matched receive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+# --------------------------------------------------------------------------
+# Packet-transition tables (diffed against master.cpp/client.cpp by the
+# `conformance` checker — extend BOTH the code and these when adding ids).
+# --------------------------------------------------------------------------
+
+# kC2M packet -> MasterState handler its dispatch arm must call
+MASTER_DISPATCH = {
+    "kC2MHello": "on_hello",
+    "kC2MSessionResume": "on_session_resume",
+    "kC2MTopologyUpdate": "on_topology_update",
+    "kC2MPeersPendingQuery": "on_peers_pending_query",
+    "kC2MP2PEstablished": "on_p2p_established",
+    "kC2MCollectiveInit": "on_collective_init",
+    "kC2MCollectiveComplete": "on_collective_complete",
+    "kC2MSharedStateSync": "on_shared_state_sync",
+    "kC2MSharedStateDistDone": "on_dist_done",
+    "kC2MOptimizeTopology": "on_optimize",
+    "kC2MBandwidthReport": "on_bandwidth_report",
+    "kC2MOptimizeWorkDone": "on_optimize_work_done",
+}
+
+# kM2C ids the master machine can emit (master_state.cpp)
+MASTER_EMITS = {
+    "kM2CWelcome", "kM2CSessionResumeAck", "kM2CPeersPendingReply",
+    "kM2CP2PConnInfo", "kM2CP2PEstablishedResp", "kM2CTopologyDeferred",
+    "kM2CCollectiveCommence", "kM2CCollectiveAbort", "kM2CCollectiveDone",
+    "kM2CSharedStateSyncResp", "kM2CSharedStateDone",
+    "kM2COptimizeResponse", "kM2COptimizeComplete", "kM2CKicked",
+}
+
+# kM2C ids the client session FSM consumes (client.cpp recv_match sites)
+CLIENT_CONSUMES = set(MASTER_EMITS)
+
+# kC2M ids the client session FSM sends
+CLIENT_SENDS = set(MASTER_DISPATCH)
+
+
+# --------------------------------------------------------------------------
+# Master model (mirrors master_state.cpp; uuid == client name — the model
+# never reuses a name across different logical peers)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MClient:
+    uuid: str
+    group: int = 0
+    accepted: bool = False
+    vote_topology: bool = False
+    admission_vote: bool = False  # granted at admission; never declined moot
+    reported_establish: bool = False
+    establish_ok: bool = False
+    establish_failed: "tuple[str, ...]" = ()
+    vote_optimize: bool = False
+    optimize_work_done: bool = False
+    bw_measured: bool = False          # stands in for the bandwidth matrix
+    sync_req: "int | None" = None      # offered revision
+    dist_done: bool = False
+
+    def copy(self) -> "MClient":
+        return dataclasses.replace(self)
+
+
+@dataclasses.dataclass
+class MOp:
+    commenced: bool = False
+    seq: int = 0
+    abort_broadcast: bool = False
+    any_aborted: bool = False
+    members: "frozenset[str]" = frozenset()
+    initiated: "frozenset[str]" = frozenset()
+    completed: "frozenset[str]" = frozenset()
+
+    def copy(self) -> "MOp":
+        return dataclasses.replace(self)
+
+
+@dataclasses.dataclass
+class MGroup:
+    revision_initialized: bool = False
+    last_revision: int = 0
+    sync_in_flight: bool = False
+    sync_revision: int = 0
+    # highest tag that ever commenced: the model's stand-in for the
+    # app-level step coordination (training loops derive the op tag from
+    # the shared-state step a joiner adopts at sync) — a freshly admitted
+    # member starts at the group's progress, not at tag 1
+    tag_hwm: int = 0
+    ops: "dict[int, MOp]" = dataclasses.field(default_factory=dict)
+
+    def copy(self) -> "MGroup":
+        g = dataclasses.replace(self)
+        g.ops = {t: op.copy() for t, op in self.ops.items()}
+        return g
+
+
+@dataclasses.dataclass
+class Journal:
+    """Durable subset, appended at the same points as journal.cpp. Records
+    per key are kept as histories so the `lag` restart variant can replay
+    all but the final group append (the crash-between-Done-and-append
+    window the resume ack's trust-the-client rule exists for)."""
+    clients: "dict[str, tuple[int, bool]]" = dataclasses.field(
+        default_factory=dict)  # uuid -> (group, accepted)
+    group_hist: "dict[int, list[tuple[int, bool]]]" = dataclasses.field(
+        default_factory=dict)  # gid -> [(last_revision, initialized)...]
+    # app-level step progress (rides the shared-state revision in reality;
+    # colocated with the seq-bound journaling in the model)
+    tag_hwm: "dict[int, int]" = dataclasses.field(default_factory=dict)
+    # write-ahead completed-collective verdicts (journal.cpp kOpDone):
+    # (gid, tag) -> (seq, any_aborted, members still owed the replay)
+    op_done: "dict[tuple[int, int], tuple[int, bool, frozenset[str]]]" = \
+        dataclasses.field(default_factory=dict)
+    topology_revision: int = 0
+    seq_bound: int = 0
+    epoch: int = 1
+
+    def copy(self) -> "Journal":
+        j = dataclasses.replace(self)
+        j.clients = dict(self.clients)
+        j.group_hist = {g: list(h) for g, h in self.group_hist.items()}
+        j.tag_hwm = dict(self.tag_hwm)
+        j.op_done = dict(self.op_done)
+        return j
+
+    def record_group(self, gid: int, rev: int, init: bool) -> None:
+        self.group_hist.setdefault(gid, []).append((rev, init))
+
+    def restored_group(self, gid: int, lag: bool) -> "tuple[int, bool]":
+        h = self.group_hist.get(gid, [])
+        if lag and h:
+            h = h[:-1]
+        return h[-1] if h else (0, False)
+
+
+Packet = tuple[str, str, dict]  # (dst client, ptype, payload)
+
+
+class MasterModel:
+    """The consensus machine. Mutates self; returns packets to deliver.
+    Tests subclass this and break one rule to prove the checker can fail
+    (drift-injection, PR-4 style)."""
+
+    def __init__(self, journal: "Journal | None"):
+        self.epoch = 1
+        self.topology_revision = 0
+        self.next_seq = 1
+        self.seq_bound = 0
+        self.clients: "dict[str, MClient]" = {}
+        self.groups: "dict[int, MGroup]" = {}
+        self.limbo: "dict[str, tuple[int, bool]]" = {}  # uuid->(group,accepted)
+        self.establish_in_flight = False
+        self.optimize_in_flight = False
+        self.round_members: "frozenset[str]" = frozenset()
+        self.journal = journal
+        self.pending_closes: "set[str]" = set()
+        # verdicts owed from the previous incarnation (journal op_done)
+        self.replay_ops: "dict[tuple[int, int], tuple[int, bool, frozenset[str]]]" = {}
+
+    def copy(self) -> "MasterModel":
+        m = self.__class__.__new__(self.__class__)
+        m.epoch = self.epoch
+        m.topology_revision = self.topology_revision
+        m.next_seq = self.next_seq
+        m.seq_bound = self.seq_bound
+        m.clients = {k: v.copy() for k, v in self.clients.items()}
+        m.groups = {k: v.copy() for k, v in self.groups.items()}
+        m.limbo = dict(self.limbo)
+        m.establish_in_flight = self.establish_in_flight
+        m.optimize_in_flight = self.optimize_in_flight
+        m.round_members = self.round_members
+        m.journal = self.journal.copy() if self.journal else None
+        m.pending_closes = set(self.pending_closes)
+        m.replay_ops = dict(self.replay_ops)
+        return m
+
+    def freeze(self) -> "tuple[Any, ...]":
+        return (
+            self.epoch, self.topology_revision, self.next_seq,
+            self.seq_bound,
+            tuple(sorted((k, dataclasses.astuple(v))
+                         for k, v in self.clients.items())),
+            tuple(sorted(
+                (g, (v.revision_initialized, v.last_revision,
+                     v.sync_in_flight, v.sync_revision, v.tag_hwm,
+                     tuple(sorted((t, dataclasses.astuple(op))
+                                  for t, op in v.ops.items()))))
+                for g, v in self.groups.items())),
+            tuple(sorted(self.limbo.items())),
+            self.establish_in_flight, self.optimize_in_flight,
+            self.round_members,
+            tuple(sorted(self.pending_closes)),
+            tuple(sorted(self.replay_ops.items())),
+            (tuple(sorted(self.journal.clients.items())),
+             tuple(sorted((g, tuple(h))
+                          for g, h in self.journal.group_hist.items())),
+             tuple(sorted(self.journal.tag_hwm.items())),
+             tuple(sorted(self.journal.op_done.items())),
+             self.journal.topology_revision, self.journal.seq_bound,
+             self.journal.epoch) if self.journal else None,
+        )
+
+    # ---- helpers mirrored from master_state.cpp ----
+
+    def group_members(self, gid: int) -> "list[MClient]":
+        return [c for c in self.clients.values()
+                if c.accepted and c.group == gid]
+
+    def accepted_clients(self) -> "list[MClient]":
+        return [c for c in self.clients.values() if c.accepted]
+
+    def group_frozen(self, gid: int) -> bool:
+        return any(g == gid for (g, _a) in self.limbo.values())
+
+    def journal_client(self, c: MClient) -> None:
+        if self.journal is not None:
+            self.journal.clients[c.uuid] = (c.group, c.accepted)
+
+    def kick(self, out: "list[Packet]", c: MClient, reason: str) -> None:
+        out.append((c.uuid, "kM2CKicked", {"reason": reason}))
+        self.pending_closes.add(c.uuid)
+
+    # ---- event handlers (names == MasterState methods) ----
+
+    def on_hello(self, uuid: str, group: int) -> "list[Packet]":
+        out: "list[Packet]" = []
+        self.clients[uuid] = MClient(uuid=uuid, group=group)
+        out.append((uuid, "kM2CWelcome",
+                    {"ok": 1, "uuid": uuid, "epoch": self.epoch}))
+        self.check_topology(out)
+        return out
+
+    def on_session_resume(self, uuid: str, last_revision: int
+                          ) -> "list[Packet]":
+        out: "list[Packet]" = []
+        if uuid not in self.limbo:
+            out.append((uuid, "kM2CSessionResumeAck",
+                        {"ok": 0, "epoch": self.epoch}))
+            return out
+        group, accepted = self.limbo.pop(uuid)
+        c = MClient(uuid=uuid, group=group, accepted=accepted)
+        g = self.groups.setdefault(group, MGroup())
+        if last_revision > g.last_revision:
+            # the client witnessed a Done the journal missed: trust it
+            g.last_revision = last_revision
+            g.revision_initialized = True
+            if self.journal is not None:
+                self.journal.record_group(group, g.last_revision, True)
+        self.clients[uuid] = c
+        self.journal_client(c)
+        out.append((uuid, "kM2CSessionResumeAck",
+                    {"ok": 1, "epoch": self.epoch,
+                     "last_revision": g.last_revision}))
+        if not self.limbo:
+            self.recheck_all(out)
+        return out
+
+    def on_limbo_expiry(self, uuid: str) -> "list[Packet]":
+        out: "list[Packet]" = []
+        group, _accepted = self.limbo.pop(uuid)
+        if self.journal is not None:
+            self.journal.clients.pop(uuid, None)
+        self.remove_client(out, uuid, group)
+        return out
+
+    def on_topology_update(self, uuid: str) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None:
+            return out
+        if c.accepted and self.group_mid_round(c):
+            out.append((uuid, "kM2CTopologyDeferred", {}))
+            return out
+        c.vote_topology = True
+        self.check_topology(out)
+        return out
+
+    def group_mid_round(self, c: MClient) -> bool:
+        g = self.groups.get(c.group)
+        if g is None:
+            return False
+        for op in g.ops.values():
+            if not op.commenced and op.initiated and c.uuid not in op.initiated:
+                return True
+        if not g.sync_in_flight and c.sync_req is None:
+            for m in self.group_members(c.group):
+                if m.uuid != c.uuid and m.sync_req is not None:
+                    return True
+        return False
+
+    def defer_topology_voters(self, out: "list[Packet]", gid: int) -> None:
+        for m in self.group_members(gid):
+            if m.vote_topology:
+                m.vote_topology = False
+                out.append((m.uuid, "kM2CTopologyDeferred", {}))
+
+    def on_peers_pending_query(self, uuid: str) -> "list[Packet]":
+        pending = any(not c.accepted for c in self.clients.values())
+        return [(uuid, "kM2CPeersPendingReply", {"pending": int(pending)})]
+
+    def check_topology(self, out: "list[Packet]") -> None:
+        if self.establish_in_flight or self.optimize_in_flight:
+            return
+        if self.limbo:
+            return  # HA freeze
+        acc = self.accepted_clients()
+        any_pending = len(self.clients) > len(acc)
+        if not acc and not any_pending:
+            return
+        if any(not a.vote_topology for a in acc):
+            return
+        for c in self.clients.values():
+            if not c.accepted:
+                c.accepted = True
+                # an admitted joiner is by definition parked in its
+                # establish loop: grant it a STANDING vote so a round that
+                # fails (member crash, unreachable kick) re-opens for it
+                # instead of stranding it admitted-but-unconfirmed with no
+                # voter left (model-checker finding, scenario
+                # collective_crash; fixed in master_state.cpp in the same
+                # PR that added this spec)
+                c.vote_topology = True
+                c.admission_vote = True
+                self.journal_client(c)
+        self.topology_revision += 1
+        if self.journal is not None:
+            self.journal.topology_revision = self.topology_revision
+        self.establish_in_flight = True
+        self.round_members = frozenset(self.clients)
+        for c in self.clients.values():
+            c.reported_establish = False
+            c.establish_ok = False
+            c.establish_failed = ()
+        for c in self.clients.values():
+            out.append((c.uuid, "kM2CP2PConnInfo",
+                        {"revision": self.topology_revision}))
+
+    def on_p2p_established(self, uuid: str, revision: int, ok: bool,
+                           failed: "tuple[str, ...]" = ()) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None:
+            return out
+        if revision != self.topology_revision:
+            return out  # stale-round report
+        c.reported_establish = True
+        c.establish_ok = ok
+        c.establish_failed = failed
+        self.check_establish(out)
+        return out
+
+    def check_establish(self, out: "list[Packet]") -> None:
+        if not self.establish_in_flight:
+            return
+        if any(c.accepted and not c.reported_establish
+               for c in self.clients.values()):
+            return
+        present = sum(1 for c in self.clients.values()
+                      if c.uuid in self.round_members)
+        membership_stable = present == len(self.round_members)
+        unreachable: "set[str]" = set()
+        all_ok = True
+        for c in self.clients.values():
+            if not c.accepted:
+                continue
+            if not c.establish_ok:
+                all_ok = False
+            unreachable.update(c.establish_failed)
+        self.establish_in_flight = False
+        if all_ok and membership_stable and not unreachable:
+            for c in self.clients.values():
+                if not c.accepted:
+                    continue
+                c.vote_topology = False
+                c.admission_vote = False
+                c.reported_establish = False
+                out.append((c.uuid, "kM2CP2PEstablishedResp",
+                            {"revision": self.topology_revision, "ok": 1}))
+        else:
+            to_kick = [c for c in self.clients.values()
+                       if c.uuid in unreachable]
+            for c in to_kick:
+                self.kick(out, c, "unreachable by peers")
+            for c in self.clients.values():
+                if not c.accepted or c.uuid in unreachable:
+                    continue
+                c.reported_establish = False
+                out.append((c.uuid, "kM2CP2PEstablishedResp",
+                            {"revision": self.topology_revision, "ok": 0}))
+            self.check_topology(out)  # votes still standing
+
+    def on_collective_init(self, uuid: str, tag: int,
+                           retry: bool = False, retry_seq: int = 0
+                           ) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None or not c.accepted:
+            return out
+        # Verdict replay: the op completed under the previous incarnation
+        # and this member's Done was lost in the crash (see the journaled
+        # OpDoneRec in journal.cpp / master_state.cpp). Gated on the
+        # client's retry flag AND the seq its dead attempt observed at
+        # commence: tags are app-reused across steps, so neither the tag
+        # nor the bare flag identifies the op incarnation. Any OTHER init
+        # from an owed member proves it is past the recorded op — consume
+        # its owed entry so the stale-verdict window closes.
+        rec = self.replay_ops.get((c.group, tag))
+        if rec is not None and uuid in rec[2] and \
+                not (retry and retry_seq == rec[0]):
+            members = rec[2] - {uuid}
+            if members:
+                self.replay_ops[(c.group, tag)] = (rec[0], rec[1], members)
+            else:
+                del self.replay_ops[(c.group, tag)]
+            if self.journal is not None:
+                jrec = self.journal.op_done.get((c.group, tag))
+                if jrec is not None:
+                    jm = jrec[2] - {uuid}
+                    if jm:
+                        self.journal.op_done[(c.group, tag)] = \
+                            (jrec[0], jrec[1], jm)
+                    else:
+                        del self.journal.op_done[(c.group, tag)]
+            rec = None
+        if retry and rec is not None and uuid in rec[2] and retry_seq == rec[0]:
+            # deliberately NOT consumed here (mirrors master_state.cpp):
+            # consuming before the packets land would strand the member on
+            # a crash in between; replaying twice is harmless, and the
+            # member's next NON-matching init consumes the entry above
+            out.append((uuid, "kM2CCollectiveAbort",
+                        {"tag": tag, "aborted": int(rec[1]),
+                         "world": len(rec[2])}))
+            out.append((uuid, "kM2CCollectiveDone", {"tag": tag}))
+            return out
+        g = self.groups.setdefault(c.group, MGroup())
+        op = g.ops.setdefault(tag, MOp())
+        op.initiated = op.initiated | {uuid}
+        self.check_collective(out, c.group, tag)
+        op = g.ops.get(tag)
+        if op is not None and not op.commenced:
+            self.defer_topology_voters(out, c.group)
+        return out
+
+    def check_collective(self, out: "list[Packet]", gid: int, tag: int
+                         ) -> None:
+        g = self.groups.get(gid)
+        if g is None or tag not in g.ops:
+            return
+        op = g.ops[tag]
+        members = self.group_members(gid)
+        if not op.commenced:
+            if self.group_frozen(gid):
+                return  # HA freeze
+            if any(m.uuid not in op.initiated for m in members):
+                return
+            op.commenced = True
+            g.tag_hwm = max(g.tag_hwm, tag)
+            if self.journal is not None:
+                self.journal.tag_hwm[gid] = g.tag_hwm
+            op.seq = self.next_seq
+            self.next_seq += 1
+            if self.journal is not None and self.next_seq > self.seq_bound:
+                self.seq_bound = self.next_seq + 1024
+                self.journal.seq_bound = self.seq_bound
+            op.members = frozenset(m.uuid for m in members)
+            for m in members:
+                # `world` is not on the wire — the client derives it from
+                # its adopted ring; the model ships it here for convenience
+                out.append((m.uuid, "kM2CCollectiveCommence",
+                            {"tag": tag, "seq": op.seq,
+                             "world": len(op.members)}))
+            return
+        for u in op.members:
+            if u in self.clients and u not in op.completed:
+                return
+        # write-ahead completion record BEFORE the verdict/Done packets
+        # (journal.cpp kOpDone): a straggler's lost Done is replayable
+        if self.journal is not None:
+            self.journal.op_done[(gid, tag)] = (op.seq, op.any_aborted,
+                                                op.members)
+        for u in op.members:
+            if u not in self.clients:
+                continue
+            if not op.abort_broadcast:
+                out.append((u, "kM2CCollectiveAbort",
+                            {"tag": tag, "aborted": int(op.any_aborted)}))
+            out.append((u, "kM2CCollectiveDone", {"tag": tag}))
+        del g.ops[tag]
+
+    def on_collective_complete(self, uuid: str, tag: int, aborted: bool
+                               ) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None:
+            return out
+        g = self.groups.setdefault(c.group, MGroup())
+        op = g.ops.get(tag)
+        if op is None:
+            return out
+        op.completed = op.completed | {uuid}
+        if aborted:
+            op.any_aborted = True
+            if op.commenced and not op.abort_broadcast:
+                op.abort_broadcast = True
+                for u in op.members:
+                    if u in self.clients:
+                        out.append((u, "kM2CCollectiveAbort",
+                                    {"tag": tag, "aborted": 1}))
+        self.check_collective(out, c.group, tag)
+        return out
+
+    def abort_group_collectives(self, out: "list[Packet]", gid: int) -> None:
+        g = self.groups.get(gid)
+        if g is None:
+            return
+        for tag, op in g.ops.items():
+            if not op.commenced or op.abort_broadcast:
+                continue
+            op.abort_broadcast = True
+            op.any_aborted = True
+            for u in op.members:
+                if u in self.clients:
+                    out.append((u, "kM2CCollectiveAbort",
+                                {"tag": tag, "aborted": 1}))
+
+    def on_shared_state_sync(self, uuid: str, revision: int
+                             ) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None or not c.accepted:
+            return out
+        g = self.groups.setdefault(c.group, MGroup())
+        if g.revision_initialized and revision > g.last_revision + 1:
+            self.kick(out, c, "shared-state revision increment violation")
+            return out
+        c.sync_req = revision
+        c.dist_done = False
+        self.check_shared_state(out, c.group)
+        if not self.groups[c.group].sync_in_flight:
+            self.defer_topology_voters(out, c.group)
+        return out
+
+    def check_shared_state(self, out: "list[Packet]", gid: int) -> None:
+        g = self.groups.setdefault(gid, MGroup())
+        if g.sync_in_flight:
+            return
+        if self.group_frozen(gid):
+            return  # HA freeze
+        members = self.group_members(gid)
+        if not members:
+            return
+        if any(m.sync_req is None for m in members):
+            return
+        # all modeled clients are tx-capable enforce-popular with identical
+        # content: election reduces to the expected-revision rule
+        expected = (g.last_revision + 1 if g.revision_initialized
+                    else max(m.sync_req for m in members
+                             if m.sync_req is not None))
+        matched = [m for m in members if m.sync_req == expected]
+        if not matched:
+            for m in members:
+                out.append((m.uuid, "kM2CSharedStateSyncResp",
+                            {"failed": 1, "revision": expected}))
+                m.sync_req = None
+                m.dist_done = False
+            return
+        for m in members:
+            out.append((m.uuid, "kM2CSharedStateSyncResp",
+                        {"failed": 0, "revision": expected}))
+        g.sync_in_flight = True
+        g.sync_revision = expected
+
+    def on_dist_done(self, uuid: str) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None:
+            return out
+        c.dist_done = True
+        members = self.group_members(c.group)
+        if any(m.sync_req is not None and not m.dist_done for m in members):
+            return out
+        g = self.groups.setdefault(c.group, MGroup())
+        for m in members:
+            out.append((m.uuid, "kM2CSharedStateDone",
+                        {"revision": g.sync_revision}))
+            m.sync_req = None
+            m.dist_done = False
+        g.last_revision = g.sync_revision
+        g.revision_initialized = True
+        g.sync_in_flight = False
+        if self.journal is not None:
+            self.journal.record_group(c.group, g.last_revision, True)
+        return out
+
+    def on_optimize(self, uuid: str) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None or not c.accepted:
+            return out
+        c.vote_optimize = True
+        self.check_optimize(out)
+        return out
+
+    def check_optimize(self, out: "list[Packet]") -> None:
+        if self.limbo:
+            return  # HA freeze (optimize rounds are global)
+        acc = self.accepted_clients()
+        if not acc:
+            # world emptied mid-round: clear the latch, or check_topology
+            # stays blocked forever and no client can ever join again —
+            # and re-open the admission round for joiners turned away
+            # while the latch held (model-checker finding, scenario
+            # optimize_crash; fixed in master_state.cpp in the same PR)
+            self.optimize_in_flight = False
+            self.check_topology(out)
+            return
+        if not self.optimize_in_flight:
+            if any(not a.vote_optimize for a in acc):
+                return
+            self.optimize_in_flight = True
+        else:
+            if any(not a.optimize_work_done for a in acc):
+                return
+        if any(not a.bw_measured for a in acc):
+            for a in acc:
+                a.optimize_work_done = False
+                out.append((a.uuid, "kM2COptimizeResponse", {"complete": 0}))
+            return
+        for a in acc:
+            a.vote_optimize = False
+            a.optimize_work_done = False
+            out.append((a.uuid, "kM2COptimizeComplete", {"ok": 1}))
+        self.optimize_in_flight = False
+
+    def on_bandwidth_report(self, uuid: str) -> "list[Packet]":
+        c = self.clients.get(uuid)
+        if c is not None:
+            c.bw_measured = True
+        return []
+
+    def on_optimize_work_done(self, uuid: str) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.get(uuid)
+        if c is None:
+            return out
+        c.optimize_work_done = True
+        self.check_optimize(out)
+        return out
+
+    def on_disconnect(self, uuid: str) -> "list[Packet]":
+        out: "list[Packet]" = []
+        c = self.clients.pop(uuid, None)
+        self.pending_closes.discard(uuid)
+        if c is None:
+            return out
+        if self.journal is not None:
+            self.journal.clients.pop(uuid, None)
+        self.remove_client(out, uuid, c.group)
+        return out
+
+    def remove_client(self, out: "list[Packet]", uuid: str, gid: int
+                      ) -> None:
+        self.abort_group_collectives(out, gid)
+        g = self.groups.get(gid)
+        if g is not None:
+            for op in g.ops.values():
+                op.initiated = op.initiated - {uuid}
+                op.completed = op.completed - {uuid}
+            # an op whose every initiator departed before commence has no
+            # observable state (no packets went out): drop the record
+            # instead of leaking it in the op table until the group empties
+            for tag in [t for t, op in g.ops.items()
+                        if not op.commenced and not op.initiated]:
+                del g.ops[tag]
+            if not self.group_members(gid) and not self.group_frozen(gid):
+                self.groups[gid] = MGroup()
+                if self.journal is not None:
+                    self.journal.record_group(gid, 0, False)
+        self.recheck_all(out)
+        # Moot-vote decline: if the departed client leaves NO pending
+        # joiner and no round started, every standing topology vote now
+        # waits for a round that can never form (the app only votes while
+        # peers are pending, so the non-voters never will). Decline the
+        # votes like the mid-round tie-break does — the parked voters
+        # return no-op and re-vote when peers are pending again.
+        # (Model-checker finding, scenario collective_crash: the pending
+        # joiner crashes and the lone voter parks forever.)
+        if not self.establish_in_flight and \
+                all(c.accepted for c in self.clients.values()):
+            for c in self.clients.values():
+                if c.accepted and c.vote_topology and not c.admission_vote:
+                    c.vote_topology = False
+                    out.append((c.uuid, "kM2CTopologyDeferred", {}))
+
+    def recheck_all(self, out: "list[Packet]") -> None:
+        self.check_establish(out)
+        self.check_topology(out)
+        for gid, g in list(self.groups.items()):
+            for tag in list(g.ops):
+                self.check_collective(out, gid, tag)
+        for gid in list(self.groups):
+            self.check_shared_state(out, gid)
+            members = self.group_members(gid)
+            if members and self.groups[gid].sync_in_flight:
+                if all(m.sync_req is None or m.dist_done for m in members):
+                    out.extend(self.on_dist_done(members[0].uuid))
+        self.check_optimize(out)
+
+    # ---- restart (SIGKILL + rehydrate; the env action) ----
+
+    @classmethod
+    def restart(cls, journal: Journal, lag: bool = False) -> "MasterModel":
+        """A new incarnation rehydrated from the journal. `lag` drops the
+        final group append (crash between emitting Done and the append
+        reaching disk)."""
+        j = journal.copy()
+        j.epoch += 1
+        m = cls(j)
+        m.epoch = j.epoch
+        m.topology_revision = j.topology_revision
+        m.next_seq = max(1, j.seq_bound)
+        m.seq_bound = m.next_seq
+        for uuid, (group, accepted) in j.clients.items():
+            m.limbo[uuid] = (group, accepted)
+        for gid in j.group_hist:
+            rev, init = j.restored_group(gid, lag)
+            g = m.groups.setdefault(gid, MGroup())
+            g.last_revision = rev
+            g.revision_initialized = init
+        for gid, hwm in j.tag_hwm.items():
+            m.groups.setdefault(gid, MGroup()).tag_hwm = hwm
+        # verdicts owed to journaled members (journal replay prunes
+        # departed members; the real journal also caps records per group,
+        # sound because per-connection Dones are delivered in order)
+        m.replay_ops = {
+            key: (seq, aborted,
+                  frozenset(u for u in members if u in j.clients))
+            for key, (seq, aborted, members) in j.op_done.items()
+            if any(u in j.clients for u in members)}
+        return m
+
+
+# --------------------------------------------------------------------------
+# Client session FSM (mirrors client.cpp's protocol loop)
+# --------------------------------------------------------------------------
+
+# phases a terminal (quiescent) state may legitimately contain
+QUIESCENT_PHASES = {"active", "done", "left", "kicked", "dead"}
+
+
+@dataclasses.dataclass
+class ClientModel:
+    name: str
+    group: int = 0
+    # steps: collective | sync | optimize | leave. Admission votes are NOT
+    # script steps: the app contract (train_ddp's admit-pending loop) is
+    # "any active client votes whenever peers are pending", modeled as an
+    # always-enabled action so a joiner can never be starved by a script.
+    script: "tuple[str, ...]" = ()
+    phase: str = "init"                # see step() for the FSM
+    inbox: "tuple[tuple[str, tuple], ...]" = ()
+    # op state
+    cur_tag: int = 0
+    cur_world: int = 0                 # world at commence (from the ring)
+    abort_seen: int = 0                # abort packets since (re-)init
+    last_seq: int = 0                  # monotonicity witness
+    last_sync_revision: int = 0
+    sync_offered: int = 0              # revision of the in-flight sync round
+    epoch: int = 0
+    estab_revision: int = 0            # round currently being established
+    # mirrors establish_loop's vote_deferrable: only the FIRST wait after
+    # a vote may consume kM2CTopologyDeferred; a Deferred landing on any
+    # other wait sits unmatched (and the model would report the stall)
+    deferrable: bool = False
+    local_abort: bool = False          # scenario: this client fails its op
+    estab_fail_used: bool = False      # scenario: one-shot establish failure
+    # resume bookkeeping: the request to re-issue after a session resume
+    resume_phase: str = ""
+
+    def copy(self) -> "ClientModel":
+        return dataclasses.replace(self)
+
+    def freeze(self) -> "tuple[Any, ...]":
+        return dataclasses.astuple(self)
+
+    # -- inbox helpers (ControlClient matched-receive semantics) --
+
+    def take(self, ptype: str, **match: Any) -> "dict | None":
+        """Consume the first queued frame of `ptype` whose payload matches
+        the given keys (recv_match with a predicate)."""
+        for i, (t, payload) in enumerate(self.inbox):
+            p = dict(payload)
+            if t == ptype and all(p.get(k) == v for k, v in match.items()):
+                self.inbox = self.inbox[:i] + self.inbox[i + 1:]
+                return p
+        return None
+
+    def first_of(self, ptypes: "tuple[str, ...]", **match: Any
+                 ) -> "str | None":
+        """Type of the FIRST queued frame among `ptypes` matching the
+        payload keys — recv_match_any's FIFO semantics, which is what
+        makes an abort-before-commence distinguishable from an abort
+        racing in after the commence."""
+        for t, payload in self.inbox:
+            if t not in ptypes:
+                continue
+            p = dict(payload)
+            if all(p.get(k) == v for k, v in match.items()):
+                return t
+        return None
+
+    def peek(self, ptype: str, **match: Any) -> bool:
+        for t, payload in self.inbox:
+            if t != ptype:
+                continue
+            p = dict(payload)
+            if all(p.get(k) == v for k, v in match.items()):
+                return True
+        return False
+
+    def deliver(self, ptype: str, payload: dict) -> None:
+        self.inbox = self.inbox + ((ptype, tuple(sorted(payload.items()))),)

@@ -1,0 +1,632 @@
+"""Whole-program lock-fact harvest over the native TUs, via libclang.
+
+One parse of every TU in ``pccl_tpu/native/src`` produces the facts both
+lock checkers consume:
+
+  * every ``pcclt::Mutex`` declaration (class member, global, or
+    function-local) with its ``// lock-rank: N [io]`` annotation;
+  * per function: the lock-acquisition events (``MutexLock`` RAII,
+    explicit ``lock()``/``unlock()``, drop-and-reacquire windows) with the
+    set of locks already held at each event;
+  * per function: every call site with the held-set at the call, plus the
+    resolution of ``Mutex &`` arguments (so ``send_frame(sock, write_mu,
+    ...)`` attributes its internal acquisition to the caller's mutex);
+  * per function: direct calls to blocking primitives (socket syscalls,
+    fsync, sleeps, futex parks) and CondVar waits.
+
+Identity model: one node per *declaration* — ``net::SinkTable::mu_`` is a
+single node even though many SinkTable instances exist at runtime. This is
+the classic lock-RANK abstraction: it cannot distinguish two instances of
+the same class, so acquiring one SinkTable's mu_ under another's shows up
+as a self-edge, which ``lockorder`` reports as its own finding class.
+
+Lambda bodies are analyzed as separate anonymous functions with an EMPTY
+initial held-set (a lambda usually runs on another thread; a lambda
+invoked inline under a lock is already banned by the PR-4 discipline, see
+docs/11_static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+SRC = "pccl_tpu/native/src"
+
+# TUs whose locks live inside test fixtures; their acquisitions still feed
+# cycle detection, but their (function-local) locks need no declared rank.
+TEST_TUS = {"selftest.cpp", "socktest.cpp"}
+
+# Direct blocking primitives: anything that can park the calling thread on
+# the network, the disk, another process, or the clock. Plain stderr
+# logging (fprintf/fputs) is deliberately NOT here — it cannot stall on a
+# remote peer and listing it would bury the real findings in log noise.
+BLOCKING_FUNCTIONS = {
+    # sockets
+    "send", "recv", "sendto", "recvfrom", "sendmsg", "recvmsg",
+    "connect", "accept", "accept4", "poll", "ppoll", "select",
+    "epoll_wait", "writev", "readv", "getaddrinfo",
+    # file IO (journal appends, trace dumps)
+    "fsync", "fdatasync", "fwrite", "fflush", "fopen", "fread",
+    # cross-process memory (CMA pulls)
+    "process_vm_readv",
+    # the clock
+    "nanosleep", "usleep", "sleep",
+}
+# method-style blocking primitives, matched as Class::method
+BLOCKING_METHODS = {
+    ("Event", "wait"),          # park::Event futex park
+    ("Event", "wait_for"),
+    ("thread", "join"),         # joining a thread that may itself block
+}
+# namespace-qualified free functions
+BLOCKING_QUALIFIED = {"sleep_for", "sleep_until", "call_once"}
+
+RANK_RE = re.compile(r"lock-rank:\s*(?:(\d+)\s*)?(io\b)?\s*(blocking-ok\b)?")
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    identity: str
+    file: str            # repo-relative
+    line: int
+    rank: "int | None"   # None = no annotation found
+    io: bool             # serializes one fd/file: blocking ok, must be leaf
+    blocking_ok: bool    # long-span serialization lock: blocking sanctioned,
+                         # but ordering rules still apply (not a leaf)
+    local: bool          # function-local (or test-fixture) declaration
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquire:
+    lock: str                  # identity, or "param:<index>"
+    held: "tuple[str, ...]"
+    file: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    callee: str                # USR of the referenced declaration
+    callee_name: str           # display name for messages
+    held: "tuple[str, ...]"
+    file: str
+    line: int
+    # Mutex& arguments: callee param index -> resolved identity
+    mutex_args: "tuple[tuple[int, str], ...]" = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingCall:
+    what: str                  # primitive name
+    held: "tuple[str, ...]"
+    file: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CvWait:
+    mutex: str                 # the mutex the wait releases
+    held: "tuple[str, ...]"    # full held-set at the wait (includes mutex)
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class FuncFacts:
+    usr: str
+    name: str                  # qualified display name
+    file: str
+    line: int
+    requires: "tuple[str, ...]" = ()
+    acquires: "list[Acquire]" = dataclasses.field(default_factory=list)
+    calls: "list[CallSite]" = dataclasses.field(default_factory=list)
+    blocking: "list[BlockingCall]" = dataclasses.field(default_factory=list)
+    cv_waits: "list[CvWait]" = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Program:
+    locks: "dict[str, LockDecl]"
+    funcs: "dict[str, FuncFacts]"      # by USR
+    errors: "list[str]"                # parse-level problems
+
+
+_memo: "dict[str, Program]" = {}
+
+
+def harvest(root: Path) -> "Program | str":
+    """Parse every native TU once; returns Program or an error string when
+    libclang is unavailable."""
+    key = str(Path(root).resolve())
+    if key in _memo:
+        return _memo[key]
+    try:
+        from clang import cindex
+        index = cindex.Index.create()
+    except Exception as e:  # no wheel, or libclang.so failed to load
+        return f"libclang unavailable ({e})"
+    from tools.pcclt_check import thread_safety
+
+    rootp = Path(root).resolve()
+    src = rootp / SRC
+    args = thread_safety.parse_args(rootp)
+    prog = Program(locks={}, funcs={}, errors=[])
+    h = _Harvester(cindex, rootp, prog)
+    for tu_path in sorted(src.glob("*.cpp")):
+        tu = index.parse(str(tu_path), args=args)
+        fatal = [d for d in tu.diagnostics if d.severity >= 4]
+        if fatal:
+            prog.errors.append(f"{tu_path.name}: {fatal[0].spelling}")
+            continue
+        h.visit_tu(tu, tu_path)
+    _memo[key] = prog
+    return prog
+
+
+def display_rel(root: Path, f: "str | None") -> str:
+    if not f:
+        return SRC
+    try:
+        return str(Path(f).resolve().relative_to(Path(root).resolve()))
+    except ValueError:
+        return str(f)
+
+
+class _Harvester:
+    def __init__(self, cindex, root: Path, prog: Program):
+        self.ci = cindex
+        self.K = cindex.CursorKind
+        self.root = root
+        self.prog = prog
+        self._file_cache: "dict[str, list[str]]" = {}
+
+    # ---------------- source access ----------------
+
+    def _lines(self, path: str) -> "list[str]":
+        if path not in self._file_cache:
+            try:
+                self._file_cache[path] = Path(path).read_text(
+                    errors="replace").splitlines()
+            except OSError:
+                self._file_cache[path] = []
+        return self._file_cache[path]
+
+    def _line_text(self, path: str, line: int) -> str:
+        lines = self._lines(path)
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+
+    # ---------------- identity ----------------
+
+    def qualified(self, cursor) -> str:
+        """Display-qualified name: namespace/class chain, 'pcclt::' elided."""
+        parts: "list[str]" = []
+        c = cursor
+        while c is not None and c.kind != self.K.TRANSLATION_UNIT:
+            if c.spelling:
+                parts.append(c.spelling)
+            c = c.semantic_parent
+        parts.reverse()
+        if parts and parts[0] == "pcclt":
+            parts = parts[1:]
+        return "::".join(parts) or cursor.spelling
+
+    def _is_mutex_type(self, t) -> bool:
+        s = t.get_canonical().spelling
+        # strip reference/cv qualifiers: params arrive as `pcclt::Mutex &`
+        s = s.removesuffix("&").strip().removeprefix("const").strip()
+        return s.endswith("pcclt::Mutex") or s == "pcclt::Mutex"
+
+    def _is_mutex_ref(self, t) -> bool:
+        return "&" in t.get_canonical().spelling
+
+    def _is_mutexlock_type(self, t) -> bool:
+        s = t.get_canonical().spelling
+        return s.endswith("pcclt::MutexLock") or s == "pcclt::MutexLock"
+
+    def _in_function(self, cursor) -> bool:
+        c = cursor.semantic_parent
+        while c is not None and c.kind != self.K.TRANSLATION_UNIT:
+            if c.kind in (self.K.CXX_METHOD, self.K.FUNCTION_DECL,
+                          self.K.CONSTRUCTOR, self.K.DESTRUCTOR,
+                          self.K.LAMBDA_EXPR, self.K.FUNCTION_TEMPLATE):
+                return True
+            c = c.semantic_parent
+        return False
+
+    def lock_identity(self, decl) -> str:
+        """Identity for a Mutex declaration cursor (field/var/param)."""
+        if decl.kind == self.K.PARM_DECL:
+            return f"param:{decl.spelling}"
+        if (decl.kind == self.K.VAR_DECL and self._in_function(decl)
+                and decl.storage_class == self.ci.StorageClass.STATIC):
+            # function-local static: global lifetime, shared across
+            # threads — a real graph node, not a per-frame throwaway
+            return self.qualified(decl)
+        if decl.kind == self.K.VAR_DECL and self._in_function(decl):
+            loc = decl.location
+            rel = display_rel(self.root, str(loc.file) if loc.file else "")
+            return f"local:{rel}:{loc.line}:{decl.spelling}"
+        if decl.kind == self.K.FIELD_DECL and self._in_function(decl):
+            # member of a function-local struct (test fixtures)
+            loc = decl.location
+            rel = display_rel(self.root, str(loc.file) if loc.file else "")
+            return f"local:{rel}:{loc.line}:{decl.spelling}"
+        return self.qualified(decl)
+
+    def note_lock_decl(self, decl) -> str:
+        ident = self.lock_identity(decl)
+        if ident in self.prog.locks or ident.startswith("param:"):
+            return ident
+        loc = decl.location
+        path = str(loc.file) if loc.file else ""
+        rel = display_rel(self.root, path)
+        local = ident.startswith("local:") or Path(rel).name in TEST_TUS
+        rank, io, bok = self._rank_annotation(path, loc.line)
+        self.prog.locks[ident] = LockDecl(ident, rel, loc.line, rank, io,
+                                          bok, local)
+        return ident
+
+    def _rank_annotation(self, path: str, line: int
+                         ) -> "tuple[int | None, bool, bool]":
+        """``// lock-rank: N [io|blocking-ok]`` on the declaration line or
+        anywhere in the contiguous comment block directly above it (rank
+        tags often lead a prose paragraph explaining the lock)."""
+        candidates = [line]
+        ln = line - 1
+        while ln > 0 and len(candidates) < 12:
+            stripped = self._line_text(path, ln).strip()
+            if not stripped.startswith("//"):
+                break
+            candidates.append(ln)
+            ln -= 1
+        for ln in candidates:
+            text = self._line_text(path, ln)
+            if "lock-rank:" not in text:
+                continue
+            m = RANK_RE.search(text)
+            if m:
+                rank = int(m.group(1)) if m.group(1) else None
+                return rank, bool(m.group(2)), bool(m.group(3))
+        return None, False, False
+
+    # ---------------- expression resolution ----------------
+
+    def resolve_mutex_expr(self, expr) -> "str | None":
+        """Resolve an expression naming a pcclt::Mutex to its identity."""
+        if expr is None:
+            return None
+        K = self.K
+        if expr.kind in (K.MEMBER_REF_EXPR, K.DECL_REF_EXPR):
+            ref = expr.referenced
+            if ref is not None and self._is_mutex_type(ref.type):
+                return self.note_lock_decl(ref)
+            return None
+        # unwrap casts/parens/unexposed wrappers
+        for ch in expr.get_children():
+            got = self.resolve_mutex_expr(ch)
+            if got is not None:
+                return got
+        return None
+
+    # ---------------- TU walk ----------------
+
+    def visit_tu(self, tu, tu_path: Path) -> None:
+        src_dir = str((self.root / SRC).resolve())
+        inc_dir = str((self.root / "pccl_tpu/native/include").resolve())
+
+        def in_repo(c) -> bool:
+            f = c.location.file
+            if f is None:
+                return False
+            s = str(f)
+            if s.endswith("annotations.hpp"):
+                # the annotated primitives themselves are the TRUSTED layer
+                # (their internals intentionally touch raw std::mutex and a
+                # Mutex& alias member); analyzing them only manufactures
+                # phantom lock nodes
+                return False
+            return s.startswith(src_dir) or s.startswith(inc_dir)
+
+        def walk(c):
+            if not in_repo(c):
+                return
+            K = self.K
+            if c.kind in (K.CXX_METHOD, K.FUNCTION_DECL, K.CONSTRUCTOR,
+                          K.DESTRUCTOR) and c.is_definition():
+                self.visit_function(c)
+                return  # visit_function walks the body (incl. lambdas)
+            if (c.kind == K.FIELD_DECL and self._is_mutex_type(c.type)
+                    and not self._is_mutex_ref(c.type)):
+                # reference members (MutexLock::mu_) alias a lock declared
+                # elsewhere; they are not graph nodes themselves
+                self.note_lock_decl(c)
+            if (c.kind == K.VAR_DECL and self._is_mutex_type(c.type)
+                    and not self._in_function(c)):
+                self.note_lock_decl(c)  # global / file-static mutex
+            for ch in c.get_children():
+                walk(ch)
+
+        for c in tu.cursor.get_children():
+            walk(c)
+
+    # ---------------- function analysis ----------------
+
+    def _attr_locks(self, func, macro_names: "tuple[str, ...]"
+                    ) -> "list[str]":
+        """Harvest PCCLT_<macro>(args) annotations textually: libclang
+        exposes the attribute kind but macro expansion swallows the
+        argument, so the source line at the attribute's extent is read
+        back and parsed."""
+        out: "list[str]" = []
+        for ch in func.get_children():
+            if not ch.kind.is_attribute():
+                continue
+            loc = ch.extent.start
+            path = str(loc.file) if loc.file else ""
+            text = (self._line_text(path, loc.line) + " " +
+                    self._line_text(path, loc.line + 1))
+            for m in re.finditer(r"PCCLT_(\w+)\s*\(([^()]*)\)", text):
+                if m.group(1) not in macro_names:
+                    continue
+                for arg in m.group(2).split(","):
+                    arg = arg.strip()
+                    if arg:
+                        out.append(arg)
+        return out
+
+    def _resolve_annotation_arg(self, func, arg: str) -> "str | None":
+        """Map an annotation argument name (e.g. ``mu_``) to an identity,
+        by looking it up among the owning class's fields, then params."""
+        arg = arg.strip().removesuffix(")").strip()
+        K = self.K
+        for i, p in enumerate(self._params(func)):
+            if p.spelling == arg:
+                return f"param:{i}"
+        cls = func.semantic_parent
+        if cls is not None and cls.kind in (K.CLASS_DECL, K.STRUCT_DECL,
+                                            K.CLASS_TEMPLATE):
+            for ch in cls.get_children():
+                if ch.kind == K.FIELD_DECL and ch.spelling == arg:
+                    if self._is_mutex_type(ch.type):
+                        return self.note_lock_decl(ch)
+        return None
+
+    def _params(self, func) -> list:
+        return [ch for ch in func.get_children()
+                if ch.kind == self.K.PARM_DECL]
+
+    def visit_function(self, func) -> None:
+        usr = func.get_usr()
+        if usr in self.prog.funcs:
+            return
+        loc = func.location
+        facts = FuncFacts(
+            usr=usr, name=self.qualified(func),
+            file=display_rel(self.root, str(loc.file) if loc.file else ""),
+            line=loc.line)
+        req: "list[str]" = []
+        for arg in self._attr_locks(func, ("REQUIRES", "REQUIRES_SHARED")):
+            ident = self._resolve_annotation_arg(func, arg)
+            if ident is not None and not ident.startswith("param:"):
+                req.append(ident)
+            elif ident is not None:
+                # REQUIRES(param): held identity is the param placeholder
+                req.append(ident)
+        facts.requires = tuple(req)
+        self.prog.funcs[usr] = facts
+
+        body = None
+        for ch in func.get_children():
+            if ch.kind == self.K.COMPOUND_STMT:
+                body = ch
+        if body is None:
+            return
+        mutex_params = {p.get_usr(): f"param:{i}"
+                        for i, p in enumerate(self._params(func))
+                        if self._is_mutex_type(p.type)}
+        held: "dict[str, int]" = {r: 1 for r in facts.requires}
+        self._walk_stmt(body, facts, held, {}, mutex_params)
+
+    # -- statement walk with a held-set --------------------------------
+
+    def _held_tuple(self, held: "dict[str, int]") -> "tuple[str, ...]":
+        return tuple(sorted(k for k, v in held.items() if v > 0))
+
+    def _acquire(self, facts, held, lock: str, cursor) -> None:
+        loc = cursor.location
+        facts.acquires.append(Acquire(
+            lock, self._held_tuple(held),
+            display_rel(self.root, str(loc.file) if loc.file else ""),
+            loc.line))
+        held[lock] = held.get(lock, 0) + 1
+
+    def _release(self, held, lock: str) -> None:
+        if held.get(lock, 0) > 0:
+            held[lock] -= 1
+
+    def _walk_stmt(self, c, facts, held, lockvars, mutex_params,
+                   scope_locks: "list[str] | None" = None) -> None:
+        """Recursive walk. `held` maps identity -> count; `lockvars` maps
+        MutexLock var USR -> identity; compound statements release their
+        RAII acquisitions on exit."""
+        K = self.K
+
+        if c.kind == K.COMPOUND_STMT:
+            my_scope: "list[str]" = []
+            for ch in c.get_children():
+                self._walk_stmt(ch, facts, held, lockvars, mutex_params,
+                                my_scope)
+            for lock in my_scope:
+                self._release(held, lock)
+            return
+
+        if c.kind == K.LAMBDA_EXPR:
+            # separate "function": empty held-set (runs on another thread)
+            sub = FuncFacts(
+                usr=f"{facts.usr}:lambda:{c.location.line}",
+                name=f"{facts.name}::<lambda@{c.location.line}>",
+                file=facts.file, line=c.location.line)
+            self.prog.funcs[sub.usr] = sub
+            body = None
+            for ch in c.get_children():
+                if ch.kind == K.COMPOUND_STMT:
+                    body = ch
+            if body is not None:
+                self._walk_stmt(body, sub, {}, {}, {})
+            # No call edge from the enclosing function: nearly every lambda
+            # here is a deferred thread body (or an atexit hook) that does
+            # NOT run under the definition point's locks, and an edge would
+            # manufacture false self-edges (the reader-thread gate in
+            # Master::launch) and false may-block taints (the Recorder's
+            # atexit dump). The lambda body is still analyzed standalone —
+            # its own critical sections are checked. The cost is missing
+            # immediately-invoked lambdas under a lock — a pattern the
+            # PR-4 discipline already bans (docs/11_static_analysis.md).
+            return
+
+        if c.kind == K.VAR_DECL and self._is_mutexlock_type(c.type):
+            mu = None
+            for ch in c.get_children():
+                mu = self.resolve_mutex_expr(ch) or mu
+            if mu is None:
+                mu = f"<unresolved@{facts.file}:{c.location.line}>"
+            if mu.startswith("param:"):
+                # normalize the name form to the index form (functions
+                # taking one Mutex& param, i.e. send_frame's write_mu)
+                for ident in mutex_params.values():
+                    mu = ident
+            self._acquire(facts, held, mu, c)
+            lockvars[c.get_usr()] = mu
+            if scope_locks is not None:
+                scope_locks.append(mu)
+            return
+
+        if c.kind == K.VAR_DECL and self._is_mutex_type(c.type):
+            self.note_lock_decl(c)  # function-local mutex
+
+        if c.kind == K.CALL_EXPR:
+            self._visit_call(c, facts, held, lockvars, mutex_params)
+            # still walk children: nested calls appear as children
+            for ch in c.get_children():
+                self._walk_stmt(ch, facts, held, lockvars, mutex_params,
+                                scope_locks)
+            return
+
+        for ch in c.get_children():
+            self._walk_stmt(ch, facts, held, lockvars, mutex_params,
+                            scope_locks)
+
+    # -- call handling --------------------------------------------------
+
+    def _call_object_lock(self, call, lockvars, mutex_params
+                          ) -> "str | None":
+        """For obj.method() calls, resolve `obj` when it is a MutexLock
+        variable or a Mutex; returns its identity."""
+        children = list(call.get_children())
+        if not children:
+            return None
+        base = children[0]
+        # member call: first child is MEMBER_REF_EXPR whose first child is
+        # the object expression
+        if base.kind == self.K.MEMBER_REF_EXPR:
+            sub = list(base.get_children())
+            obj = sub[0] if sub else None
+        else:
+            obj = base
+        if obj is None:
+            return None
+        K = self.K
+        e = obj
+        while e is not None and e.kind not in (K.DECL_REF_EXPR,
+                                               K.MEMBER_REF_EXPR):
+            nxt = list(e.get_children())
+            e = nxt[0] if nxt else None
+        if e is None:
+            return None
+        ref = e.referenced
+        if ref is None:
+            return None
+        if ref.get_usr() in lockvars:
+            return lockvars[ref.get_usr()]
+        if self._is_mutex_type(ref.type):
+            if ref.kind == self.K.PARM_DECL:
+                # map to param index
+                return mutex_params.get(ref.get_usr(),
+                                        f"param:{ref.spelling}")
+            return self.note_lock_decl(ref)
+        return None
+
+    def _visit_call(self, call, facts, held, lockvars, mutex_params) -> None:
+        ref = call.referenced
+        name = ref.spelling if ref is not None else call.spelling
+        parent = (self.qualified(ref.semantic_parent)
+                  if ref is not None and ref.semantic_parent is not None
+                  else "")
+        loc = call.location
+        rel = display_rel(self.root, str(loc.file) if loc.file else "")
+
+        # Mutex/MutexLock state transitions
+        if name in ("lock", "unlock", "try_lock"):
+            tgt = self._call_object_lock(call, lockvars, mutex_params)
+            if tgt is not None:
+                if name in ("lock", "try_lock"):
+                    self._acquire(facts, held, tgt, call)
+                else:
+                    self._release(held, tgt)
+                return
+
+        # CondVar waits: first arg is the mutex released during the wait
+        if (ref is not None and parent.endswith("CondVar")
+                and name in ("wait", "wait_for", "wait_until")):
+            args = [ch for ch in call.get_children()][1:]
+            mu = None
+            for a in args:
+                mu = self.resolve_mutex_expr(a)
+                if mu is not None:
+                    break
+            if mu is not None and mu.startswith("param:"):
+                pass
+            facts.cv_waits.append(CvWait(
+                mu or "<unknown>", self._held_tuple(held), rel, loc.line))
+            return
+
+        # blocking primitives
+        if ref is not None:
+            is_method = ref.kind == self.K.CXX_METHOD
+            ns = parent.rsplit("::", 1)[-1] if parent else ""
+            if ((not is_method and name in BLOCKING_FUNCTIONS
+                 and "std::" not in parent)
+                    or (is_method and (ns, name) in BLOCKING_METHODS)
+                    or name in BLOCKING_QUALIFIED):
+                facts.blocking.append(BlockingCall(
+                    (f"{ns}::{name}" if is_method else name),
+                    self._held_tuple(held), rel, loc.line))
+                return
+
+        if ref is None:
+            return
+        # ordinary call: record with resolved Mutex& arguments
+        callee_usr = ref.get_usr()
+        if not callee_usr:
+            return
+        margs: "list[tuple[int, str]]" = []
+        params = self._params(ref)
+        if any(self._is_mutex_type(p.type) for p in params):
+            args = list(call.get_children())
+            # member calls: children[0] is the callee expr; free functions:
+            # children[0] is an unexposed ref — align from the tail
+            argexprs = args[-len(params):] if params else []
+            for i, (p, a) in enumerate(zip(params, argexprs)):
+                if not self._is_mutex_type(p.type):
+                    continue
+                ident = self.resolve_mutex_expr(a)
+                if ident is not None and ident.startswith("param:"):
+                    # caller's own param forwarded: map name->index form
+                    ident = next(iter(mutex_params.values()), ident)
+                if ident is not None:
+                    margs.append((i, ident))
+        facts.calls.append(CallSite(
+            callee_usr, (f"{parent}::{name}" if parent else name),
+            self._held_tuple(held), rel, loc.line, tuple(margs)))

@@ -1,0 +1,238 @@
+"""Checker ``lockorder``: whole-program lock-acquisition graph analysis.
+
+Builds the directed graph "lock A is held while lock B is acquired" from
+the harvested facts (direct acquisitions + one level of ``Mutex &``
+parameter substitution + transitive acquisitions through the call graph)
+and enforces three properties:
+
+  * **no cycles** — a cycle is a potential deadlock: two threads entering
+    the cycle from different locks can each hold what the other wants;
+  * **declared ranks are respected** — every non-local ``pcclt::Mutex``
+    carries a ``// lock-rank: N [io]`` comment on (or directly above) its
+    declaration, and every edge goes from a LOWER rank to a HIGHER one, so
+    the global order is documented where the lock lives instead of only in
+    the heads of people who read the whole call graph;
+  * **io locks are leaves** — an ``io``-tagged lock exists to serialize a
+    single fd/file; acquiring anything else while holding one turns an IO
+    stall into a lock-graph stall.
+
+Same-identity self-edges (two *instances* of one class's mutex held at
+once) are reported as their own finding class: ranks cannot order them.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from . import Finding, Skip
+from .harvest import SRC, Program, harvest
+
+CHECKER = "lockorder"
+
+
+def _is_local(prog: Program, ident: str) -> bool:
+    d = prog.locks.get(ident)
+    return d.local if d is not None else ident.startswith(
+        ("local:", "param:", "<unresolved"))
+
+
+def transitive_acquires(prog: Program) -> "dict[str, set[str]]":
+    """USR -> set of lock identities the function may acquire, directly or
+    through calls, with Mutex& parameters substituted per call edge."""
+    # A lock the function REQUIRES is excluded from its own acquisition
+    # summary: re-acquiring it inside (a drop-and-reacquire window, e.g.
+    # SinkTable::wait_not_busy_range) is the caller's already-held lock,
+    # not a new acquisition the caller nests under its held-set.
+    tacq: "dict[str, set[str]]" = {
+        usr: {a.lock for a in f.acquires if a.lock not in f.requires}
+        for usr, f in prog.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for usr, f in prog.funcs.items():
+            cur = tacq[usr]
+            for cs in f.calls:
+                sub = dict(cs.mutex_args)
+                for lock in tacq.get(cs.callee, ()):
+                    if lock.startswith("param:"):
+                        try:
+                            idx = int(lock.split(":", 1)[1])
+                        except ValueError:
+                            idx = -1
+                        lock = sub.get(idx, lock)
+                    if lock not in cur:
+                        cur.add(lock)
+                        changed = True
+    return tacq
+
+
+class Edge:
+    __slots__ = ("src", "dst", "file", "line", "via")
+
+    def __init__(self, src: str, dst: str, file: str, line: int, via: str):
+        self.src, self.dst = src, dst
+        self.file, self.line, self.via = file, line, via
+
+
+def build_edges(prog: Program) -> "list[Edge]":
+    tacq = transitive_acquires(prog)
+    edges: "list[Edge]" = []
+    seen: "set[tuple[str, str]]" = set()
+
+    def add(src: str, dst: str, file: str, line: int, via: str) -> None:
+        if (src, dst) in seen:
+            return
+        seen.add((src, dst))
+        edges.append(Edge(src, dst, file, line, via))
+
+    for f in prog.funcs.values():
+        for a in f.acquires:
+            for h in a.held:
+                add(h, a.lock, a.file, a.line, "direct acquisition")
+        for cs in f.calls:
+            if not cs.held:
+                continue
+            sub = dict(cs.mutex_args)
+            for lock in tacq.get(cs.callee, ()):
+                if lock.startswith("param:"):
+                    try:
+                        idx = int(lock.split(":", 1)[1])
+                    except ValueError:
+                        idx = -1
+                    lock = sub.get(idx, lock)
+                if lock.startswith("param:"):
+                    continue  # unresolved caller-of-caller param
+                for h in cs.held:
+                    add(h, lock, cs.file, cs.line,
+                        f"call to {cs.callee_name}")
+    return edges
+
+
+def find_cycles(edges: "list[Edge]") -> "list[list[Edge]]":
+    """Minimal cycle witnesses, one per strongly-connected component."""
+    adj: "dict[str, list[Edge]]" = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+
+    cycles: "list[list[Edge]]" = []
+    claimed: "set[str]" = set()
+    for start in sorted(adj):
+        if start in claimed:
+            continue
+        # BFS back to start
+        prev: "dict[str, Edge]" = {}
+        frontier = [start]
+        found = None
+        while frontier and found is None:
+            nxt = []
+            for node in frontier:
+                for e in adj.get(node, ()):
+                    if e.dst == start:
+                        prev[start] = e
+                        found = e
+                        break
+                    if e.dst not in prev:
+                        prev[e.dst] = e
+                        nxt.append(e.dst)
+                if found:
+                    break
+            frontier = nxt
+        if found is None:
+            continue
+        # reconstruct start -> ... -> start
+        path = [prev[start]]
+        node = prev[start].src
+        while node != start:
+            path.append(prev[node])
+            node = prev[node].src
+        path.reverse()
+        cycles.append(path)
+        for e in path:
+            claimed.add(e.src)
+    return cycles
+
+
+def check(root: Path) -> "list[Finding] | Skip":
+    prog = harvest(root)
+    if isinstance(prog, str):
+        return Skip(CHECKER, f"{prog}; install the libclang wheel to run "
+                    "the lock-order analysis")
+    out: "list[Finding]" = []
+    for err in prog.errors:
+        out.append(Finding(CHECKER, SRC, 0, f"TU failed to parse: {err}"))
+
+    # --- every non-local lock declares a rank -------------------------
+    for ident, d in sorted(prog.locks.items()):
+        if d.local:
+            continue
+        if d.rank is None and not d.io:
+            out.append(Finding(
+                CHECKER, d.file, d.line,
+                f"{ident} has no `// lock-rank: N [io]` annotation — every "
+                "pcclt::Mutex declares its place in the global acquisition "
+                "order (docs/11_static_analysis.md)"))
+
+    edges = build_edges(prog)
+
+    # --- self-edges: instance-order hazards ---------------------------
+    for e in edges:
+        if e.src == e.dst:
+            out.append(Finding(
+                CHECKER, e.file, e.line,
+                f"{e.src} acquired while an instance of the same lock is "
+                f"already held ({e.via}) — ranks cannot order two instances "
+                "of one lock; impose an instance order (address order) or "
+                "restructure"))
+
+    # --- rank monotonicity + io leaves --------------------------------
+    def rank_of(ident: str) -> "int | None":
+        d = prog.locks.get(ident)
+        if d is None:
+            return None
+        if d.local:
+            return None  # locals are unordered leaves
+        return d.rank
+
+    for e in edges:
+        if e.src == e.dst:
+            continue
+        src_d = prog.locks.get(e.src)
+        if src_d is not None and src_d.io:
+            out.append(Finding(
+                CHECKER, e.file, e.line,
+                f"{e.dst} acquired while holding io-tagged {e.src} "
+                f"({e.via}) — io locks serialize one fd and must be leaves "
+                "of the lock graph"))
+            continue
+        if _is_local(prog, e.src) and not _is_local(prog, e.dst):
+            # a function-local lock is private to one call frame; ordering
+            # a shared lock under it cannot deadlock against another thread
+            # (no other thread can hold the local), so locals stay leaves
+            # unless they wrap a shared acquisition — which we do flag:
+            out.append(Finding(
+                CHECKER, e.file, e.line,
+                f"{e.dst} acquired while holding function-local {e.src} "
+                f"({e.via}) — widen the shared lock's scope instead of "
+                "nesting it inside a throwaway mutex"))
+            continue
+        rs, rd = rank_of(e.src), rank_of(e.dst)
+        if rs is None or rd is None:
+            continue  # missing-rank finding already emitted above
+        if rs >= rd:
+            out.append(Finding(
+                CHECKER, e.file, e.line,
+                f"lock-order inversion: {e.dst} (rank {rd}) acquired while "
+                f"holding {e.src} (rank {rs}) via {e.via} — edges must go "
+                "strictly rank-upward; re-rank or restructure the critical "
+                "section"))
+
+    # --- cycles (independent of ranks: catches unranked cycles too) ---
+    for cyc in find_cycles([e for e in edges if e.src != e.dst]):
+        desc = " -> ".join(f"{e.src} ({e.file}:{e.line})" for e in cyc)
+        first = cyc[0]
+        out.append(Finding(
+            CHECKER, first.file, first.line,
+            f"lock-acquisition cycle (potential deadlock): {desc} -> "
+            f"{cyc[-1].dst} — break the cycle by restructuring one of the "
+            "critical sections"))
+    return out

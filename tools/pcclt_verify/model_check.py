@@ -1,0 +1,765 @@
+"""Checker ``fsm``: explicit-state model checking of the control plane.
+
+Explores EVERY interleaving of the spec machines in ``fsm_spec.py`` —
+client protocol steps, disconnects, kicks, master SIGKILL+restart,
+session resume, limbo expiry — at world <= 4, against these invariants:
+
+  * **no stuck world**: from every reachable state there is a path to a
+    quiescent state (all clients active/done/left/kicked/dead, no round
+    in flight). This is strictly stronger than "no deadlocked terminal
+    state": it also catches livelocks with no escape path.
+  * **exactly-one-abort**: every member of a commenced collective receives
+    exactly ONE abort-verdict packet per op incarnation (early broadcast
+    or completion verdict — never zero, never two).
+  * **seq monotone**: collective seqs observed by a client strictly
+    increase, across master restarts included (the journaled seq bound).
+  * **revision monotone**: a client's observed shared-state revision never
+    decreases, across epochs included (the resume-ack max() rule).
+  * **epoch monotone**: the epoch a client observes never decreases.
+  * scenario-scoped: no client is kicked in scenarios where every client
+    follows the protocol (a kick there means the master punished a
+    correct peer — the restart_lag scenario exists exactly for this).
+
+Run as a checker (CI: ``python -m tools.pcclt_verify --checker fsm``) or
+directly (``python -m tools.pcclt_verify.model_check [--deep]``) for the
+larger worlds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+from . import Finding, Skip
+from .fsm_spec import (QUIESCENT_PHASES, ClientModel, Journal, MasterModel,
+                       Packet)
+
+CHECKER = "fsm"
+
+Action = tuple[Any, ...]
+
+
+class Violation(Exception):
+    def __init__(self, message: str, trace: "list[Action] | None" = None):
+        super().__init__(message)
+        self.message = message
+        self.trace = trace or []
+
+    def __str__(self) -> str:
+        tail = self.trace[-14:]
+        steps = " ; ".join("/".join(str(p) for p in a) for a in tail)
+        more = "" if len(self.trace) <= 14 else f" (last 14 of {len(self.trace)} steps) "
+        return f"{self.message}{more and ' '}[trace{more}: {steps}]"
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    clients: "tuple[tuple[str, int, tuple[str, ...]], ...]"
+    journal: bool = False
+    max_restarts: int = 0
+    lag: bool = False                       # drop the final journal append
+    disconnects: "tuple[str, ...]" = ()     # clients that may crash (once)
+    local_abort: "tuple[str, ...]" = ()     # clients whose op fails locally
+    establish_fail: "tuple[tuple[str, str], ...]" = ()  # (reporter, victim)
+    expect_no_kicks: bool = True
+    # staged: run a canonical join+establish prologue before exploring, so
+    # faults hit a FORMED world. Use for mixed-op scripts: a member that
+    # solo-runs part of its script before a peer joins ends up at a
+    # different program position, and two parked members cross-waiting on
+    # different op TYPES is an app-divergence artifact, not a protocol
+    # state (join interleavings stay fully explored in the join scenarios)
+    staged: bool = False
+    max_states: int = 400_000
+
+
+@dataclasses.dataclass
+class World:
+    master: MasterModel
+    clients: "dict[str, ClientModel]"
+    pending_disconnects: "frozenset[str]"
+    restarts_left: int
+    scenario: Scenario
+
+    def copy(self) -> "World":
+        return World(self.master.copy(),
+                     {k: v.copy() for k, v in self.clients.items()},
+                     self.pending_disconnects, self.restarts_left,
+                     self.scenario)
+
+    def freeze(self) -> "tuple[Any, ...]":
+        return (self.master.freeze(),
+                tuple(c.freeze() for _, c in sorted(self.clients.items())),
+                self.pending_disconnects, self.restarts_left)
+
+    def deliver(self, packets: "list[Packet]") -> None:
+        for dst, ptype, payload in packets:
+            c = self.clients.get(dst)
+            if c is not None and c.phase not in ("left", "dead"):
+                if ptype == "kM2CKicked" and self.scenario.expect_no_kicks:
+                    raise Violation(
+                        f"{dst} kicked ({payload.get('reason')}) in scenario "
+                        f"'{self.scenario.name}' where every client follows "
+                        "the protocol — the master punished a correct peer")
+                c.deliver(ptype, payload)
+
+
+def initial_world(sc: Scenario, master_cls: type = MasterModel) -> World:
+    master = master_cls(Journal() if sc.journal else None)
+    clients = {}
+    for name, group, script in sc.clients:
+        c = ClientModel(name=name, group=group, script=tuple(script))
+        c.local_abort = name in sc.local_abort
+        clients[name] = c
+    w = World(master, clients, frozenset(), sc.max_restarts, sc)
+    if sc.staged:
+        for name in sorted(w.clients):
+            w = apply_action(w, ("client", name, "join"))
+        for _ in range(10_000):
+            acts = [a for a in enabled_actions(w)
+                    if a[0] == "client" and a[2] in
+                    ("consume_conninfo", "consume_estab_resp", "vote",
+                     "consume_deferred")]
+            if not acts:
+                break
+            w = apply_action(w, acts[0])
+        for name, c in w.clients.items():
+            if c.phase != "active":
+                raise Violation(
+                    f"scenario '{sc.name}': staged prologue left {name} in "
+                    f"{c.phase} — the canonical join drain did not converge")
+    return w
+
+
+# --------------------------------------------------------------------------
+# enabled actions
+# --------------------------------------------------------------------------
+
+
+def enabled_actions(w: World) -> "list[Action]":
+    acts: "list[Action]" = []
+    sc = w.scenario
+    m = w.master
+    pending_exists = any(not c.accepted for c in m.clients.values())
+    for name, c in sorted(w.clients.items()):
+        ph = c.phase
+        if ph in ("left", "dead", "kicked"):
+            continue
+        if c.peek("kM2CKicked"):
+            acts.append(("client", name, "consume_kicked"))
+            continue  # a queued kick is authoritative (classify_master_loss)
+        if ph == "init":
+            acts.append(("client", name, "join"))
+        elif ph == "wait_conninfo":
+            if c.peek("kM2CP2PConnInfo"):
+                acts.append(("client", name, "consume_conninfo"))
+            if c.deferrable and c.peek("kM2CTopologyDeferred"):
+                acts.append(("client", name, "consume_deferred"))
+        elif ph == "wait_estab_resp":
+            if c.peek("kM2CP2PEstablishedResp", revision=c.estab_revision):
+                acts.append(("client", name, "consume_estab_resp"))
+        elif ph == "active":
+            # the app contract: any active member votes while peers are
+            # pending (train_ddp's admit-pending loop) — implicit action,
+            # not a script step, so a joiner can never be script-starved
+            if pending_exists:
+                acts.append(("client", name, "vote"))
+            if c.script:
+                step = c.script[0]
+                if step == "collective":
+                    acts.append(("client", name, "start_collective"))
+                elif step == "sync":
+                    acts.append(("client", name, "start_sync"))
+                elif step == "optimize":
+                    acts.append(("client", name, "start_optimize"))
+                elif step == "leave":
+                    acts.append(("client", name, "leave"))
+            # app contract: members of a group all run the same step
+            # sequence, so a member whose script is ahead/exhausted still
+            # answers a group op/sync round its peers have opened
+            mc = m.clients.get(name)
+            g = m.groups.get(c.group)
+            if mc is not None and mc.accepted and g is not None:
+                if not c.script or c.script[0] != "collective":
+                    # guarded to not-yet-commenced ops: a late joiner only
+                    # participates in future ops (step adoption via sync)
+                    for tag, op in sorted(g.ops.items()):
+                        if (not op.commenced and op.initiated
+                                and name not in op.initiated):
+                            acts.append(("client", name, "follow", tag))
+                if ((not c.script or c.script[0] != "sync")
+                        and not g.sync_in_flight and mc.sync_req is None
+                        and any(o.sync_req is not None
+                                for o in m.group_members(c.group))):
+                    acts.append(("client", name, "follow_sync"))
+            if (mc is not None and mc.accepted and not mc.vote_optimize
+                    and (not c.script or c.script[0] != "optimize")
+                    and not m.optimize_in_flight
+                    and any(o.vote_optimize
+                            for o in m.accepted_clients())):
+                # optimize votes are GLOBAL: every accepted client must
+                # join the round, whatever group it is in
+                acts.append(("client", name, "follow_optimize"))
+        elif ph == "wait_commence":
+            first = c.first_of(("kM2CCollectiveCommence",
+                                "kM2CCollectiveAbort"), tag=c.cur_tag)
+            if first == "kM2CCollectiveCommence":
+                acts.append(("client", name, "consume_commence"))
+            elif first == "kM2CCollectiveAbort":
+                # abort BEFORE any commence: a restarted master replaying
+                # the completed op's verdict (client.cpp's any-match wait)
+                acts.append(("client", name, "consume_replay"))
+        elif ph == "in_ring":
+            acts.append(("client", name, "finish_ring"))
+        elif ph == "wait_coll_done":
+            if c.peek("kM2CCollectiveDone"):
+                acts.append(("client", name, "consume_coll_done"))
+        elif ph == "wait_sync_resp":
+            if c.peek("kM2CSharedStateSyncResp"):
+                acts.append(("client", name, "consume_sync_resp"))
+        elif ph == "wait_sync_done":
+            if c.peek("kM2CSharedStateDone"):
+                acts.append(("client", name, "consume_sync_done"))
+        elif ph == "wait_opt":
+            if c.peek("kM2COptimizeResponse"):
+                acts.append(("client", name, "consume_opt_resp"))
+            if c.peek("kM2COptimizeComplete"):
+                acts.append(("client", name, "consume_opt_complete"))
+        elif ph == "resuming":
+            acts.append(("client", name, "resume"))
+        # scenario fault: crash at any point while connected
+        if name in sc.disconnects and ph not in ("init",):
+            acts.append(("env", "crash", name))
+    for name in sorted(w.pending_disconnects | w.master.pending_closes):
+        acts.append(("env", "deliver_disconnect", name))
+    if w.restarts_left > 0 and w.master.journal is not None:
+        acts.append(("env", "restart"))
+    for uuid in sorted(w.master.limbo):
+        acts.append(("env", "limbo_expiry", uuid))
+    return acts
+
+
+# --------------------------------------------------------------------------
+# action application (returns the successor world)
+# --------------------------------------------------------------------------
+
+
+def apply_action(w0: World, act: Action) -> World:
+    w = w0.copy()
+    sc = w.scenario
+    kind = act[0]
+    if kind == "env":
+        if act[1] == "crash":
+            name = act[2]
+            c = w.clients[name]
+            c.phase = "left"
+            c.inbox = ()
+            # a crashed client never comes back; drop its fault budget
+            w.scenario = sc  # budgets are encoded by phase, nothing to do
+            w.pending_disconnects = w.pending_disconnects | {name}
+        elif act[1] == "deliver_disconnect":
+            name = act[2]
+            w.pending_disconnects = w.pending_disconnects - {name}
+            w.deliver(w.master.on_disconnect(name))
+        elif act[1] == "restart":
+            w.restarts_left -= 1
+            assert w.master.journal is not None
+            old_epoch = w.master.epoch
+            w.master = type(w.master).restart(w.master.journal, lag=sc.lag)
+            if w.master.epoch <= old_epoch:
+                raise Violation("epoch did not advance across restart")
+            w.pending_disconnects = frozenset()
+            for c in w.clients.values():
+                if c.phase in ("init", "left", "dead", "kicked"):
+                    continue
+                c.inbox = ()  # in-flight packets died with the master
+                c.resume_phase = c.phase if c.phase != "resuming" else c.resume_phase
+                c.phase = "resuming"
+        elif act[1] == "limbo_expiry":
+            w.deliver(w.master.on_limbo_expiry(act[2]))
+        return w
+
+    name, step = act[1], act[2]
+    c = w.clients[name]
+    m = w.master
+
+    def est_report(revision: int) -> None:
+        failed: "tuple[str, ...]" = ()
+        for reporter, victim in sc.establish_fail:
+            if reporter == name and victim in m.clients \
+                    and not c.estab_fail_used:
+                failed = (victim,)
+                c.estab_fail_used = True
+        c.estab_revision = revision
+        c.phase = "wait_estab_resp"
+        w.deliver(m.on_p2p_established(name, revision, not failed, failed))
+
+    if step == "join":
+        w.deliver(m.on_hello(name, c.group))
+        welcome = c.take("kM2CWelcome")
+        if welcome is None or not welcome.get("ok"):
+            c.phase = "dead"
+            return w
+        if welcome["epoch"] < c.epoch:
+            raise Violation(f"{name} observed epoch moving backwards")
+        c.epoch = welcome["epoch"]
+        c.phase = "wait_conninfo"
+    elif step == "consume_kicked":
+        c.take("kM2CKicked")
+        c.phase = "kicked"
+        c.inbox = ()
+    elif step == "consume_conninfo":
+        info = c.take("kM2CP2PConnInfo")
+        assert info is not None
+        while True:  # stale rounds queue older conn infos; use the newest
+            newer = c.take("kM2CP2PConnInfo")
+            if newer is None:
+                break
+            info = newer
+        c.deferrable = False  # only the first wait honors a Deferred
+        est_report(info["revision"])
+    elif step == "consume_deferred":
+        c.take("kM2CTopologyDeferred")
+        c.deferrable = False
+        c.phase = "active"  # vote declined: no-op success, app re-votes later
+    elif step == "consume_estab_resp":
+        resp = c.take("kM2CP2PEstablishedResp", revision=c.estab_revision)
+        assert resp is not None
+        if resp["ok"]:
+            c.phase = "active"
+            # step adoption: a member entering the group starts at the
+            # group's op progress, not at tag 1 (in reality the joiner's
+            # first shared-state sync adopts the cohort's step, and the
+            # training loop derives op tags from it)
+            g = m.groups.get(c.group)
+            if g is not None:
+                c.cur_tag = max(c.cur_tag, g.tag_hwm)
+        else:
+            c.phase = "wait_conninfo"  # failed round: wait for the retry
+    elif step == "vote":
+        w.deliver(m.on_topology_update(name))
+        if c.take("kM2CTopologyDeferred") is not None:
+            pass  # declined mid-round: no-op, app re-votes later
+        else:
+            c.phase = "wait_conninfo"
+            c.deferrable = True
+    elif step == "start_collective":
+        c.cur_tag += 1
+        c.script = c.script[1:]
+        c.abort_seen = 0
+        c.phase = "wait_commence"
+        w.deliver(m.on_collective_init(name, c.cur_tag))
+    elif step == "follow":
+        c.cur_tag = act[3]
+        c.abort_seen = 0
+        c.phase = "wait_commence"
+        w.deliver(m.on_collective_init(name, c.cur_tag))
+    elif step == "consume_replay":
+        ab = c.take("kM2CCollectiveAbort", tag=c.cur_tag)
+        assert ab is not None
+        done = c.take("kM2CCollectiveDone", tag=c.cur_tag)
+        if done is None:
+            raise Violation(
+                f"{name} got a pre-commence abort for tag {c.cur_tag} with "
+                "no Done following it — replay must deliver verdict+done "
+                "atomically")
+        c.phase = "active"  # kOk or kAborted: either way the app moves on
+    elif step == "consume_commence":
+        fr = c.take("kM2CCollectiveCommence", tag=c.cur_tag)
+        assert fr is not None
+        if fr["seq"] <= c.last_seq:
+            raise Violation(
+                f"{name} observed collective seq {fr['seq']} after "
+                f"{c.last_seq} — seqs must be strictly monotone (journaled "
+                "seq bound across restarts)")
+        c.last_seq = fr["seq"]
+        c.cur_world = fr["world"]
+        c.phase = "in_ring"
+    elif step == "finish_ring":
+        aborted = False
+        ab = c.take("kM2CCollectiveAbort", tag=c.cur_tag)
+        if ab is not None:
+            _count_abort(c, name)
+            aborted = True  # the worker unwound on the abort poll
+        elif c.cur_world < 2:
+            # a ring needs two nodes: the worker fails the op through the
+            # NORMAL completion handshake (local_failure=true), so the
+            # master's op table is closed out instead of leaking the op
+            # until this client disconnects (found by this checker; see
+            # run_reduce_worker's world<2 bail in client.cpp)
+            aborted = True
+        elif c.local_abort:
+            aborted = True
+            c.local_abort = False
+        c.phase = "wait_coll_done"
+        w.deliver(m.on_collective_complete(name, c.cur_tag, aborted))
+    elif step == "consume_coll_done":
+        while True:  # consume the verdict(s) queued before Done
+            ab = c.take("kM2CCollectiveAbort", tag=c.cur_tag)
+            if ab is None:
+                break
+            _count_abort(c, name)
+        if c.abort_seen != 1:
+            raise Violation(
+                f"{name} reached CollectiveDone for tag {c.cur_tag} with "
+                f"{c.abort_seen} abort-verdict packets — the contract is "
+                "exactly one (early broadcast or completion verdict)")
+        c.take("kM2CCollectiveDone", tag=c.cur_tag)
+        c.phase = "active"
+    elif step == "start_sync":
+        c.script = c.script[1:]
+        c.phase = "wait_sync_resp"
+        c.sync_offered = c.last_sync_revision + 1
+        w.deliver(m.on_shared_state_sync(name, c.sync_offered))
+    elif step == "follow_sync":
+        c.phase = "wait_sync_resp"
+        c.sync_offered = c.last_sync_revision + 1
+        w.deliver(m.on_shared_state_sync(name, c.sync_offered))
+    elif step == "consume_sync_resp":
+        resp = c.take("kM2CSharedStateSyncResp")
+        assert resp is not None
+        if resp["failed"]:
+            c.phase = "active"  # round failed loudly; app decides what next
+        else:
+            c.phase = "wait_sync_done"
+            w.deliver(m.on_dist_done(name))
+    elif step == "consume_sync_done":
+        fr = c.take("kM2CSharedStateDone")
+        assert fr is not None
+        if fr["revision"] < c.last_sync_revision:
+            raise Violation(
+                f"{name} observed shared-state revision {fr['revision']} "
+                f"after {c.last_sync_revision} — revisions must be monotone "
+                "across epochs (resume-ack max() rule)")
+        c.last_sync_revision = fr["revision"]
+        c.phase = "active"
+    elif step == "start_optimize":
+        c.script = c.script[1:]
+        c.phase = "wait_opt"
+        w.deliver(m.on_optimize(name))
+    elif step == "follow_optimize":
+        c.phase = "wait_opt"
+        w.deliver(m.on_optimize(name))
+    elif step == "consume_opt_resp":
+        c.take("kM2COptimizeResponse")
+        w.deliver(m.on_bandwidth_report(name))
+        w.deliver(m.on_optimize_work_done(name))
+    elif step == "consume_opt_complete":
+        c.take("kM2COptimizeComplete")
+        c.phase = "active"
+    elif step == "leave":
+        c.script = c.script[1:]
+        c.phase = "left"
+        c.inbox = ()
+        w.pending_disconnects = w.pending_disconnects | {name}
+    elif step == "resume":
+        w.deliver(m.on_session_resume(name, c.last_sync_revision))
+        ack = c.take("kM2CSessionResumeAck")
+        assert ack is not None
+        if not ack["ok"]:
+            c.phase = "dead"  # kMasterUnreachable: app re-registers from scratch
+            return w
+        if ack["epoch"] < c.epoch:
+            raise Violation(f"{name} observed epoch moving backwards on resume")
+        c.epoch = ack["epoch"]
+        c.last_sync_revision = max(c.last_sync_revision,
+                                   ack.get("last_revision", 0))
+        rp, c.resume_phase = c.resume_phase, ""
+        # session-generation rule: the in-flight op died with the old
+        # session; re-issue it on the resumed one (client.cpp retry paths)
+        if rp in ("wait_commence", "in_ring", "wait_coll_done"):
+            c.abort_seen = 0
+            # the previous attempt died with the session: a RETRY carrying
+            # the seq it observed at commence (0 = it never saw one)
+            seen = c.last_seq if rp in ("in_ring", "wait_coll_done") else 0
+            c.phase = "wait_commence"
+            w.deliver(m.on_collective_init(name, c.cur_tag, retry=True,
+                                           retry_seq=seen))
+        elif rp in ("wait_sync_resp", "wait_sync_done"):
+            if c.last_sync_revision >= c.sync_offered:
+                # the resume ack's revision adoption PROVED the in-flight
+                # round completed group-wide just before the crash: skip
+                # the retry instead of wedging the group on a revision
+                # disagreement (docs/10, the tests/ha_peer.py pattern)
+                c.phase = "active"
+            else:
+                c.phase = "wait_sync_resp"
+                c.sync_offered = c.last_sync_revision + 1
+                w.deliver(m.on_shared_state_sync(name, c.sync_offered))
+        elif rp in ("wait_conninfo", "wait_estab_resp"):
+            # the vote died with the old session; the implicit vote action
+            # re-votes if anyone is still pending
+            c.phase = "active"
+        elif rp == "wait_opt":
+            c.phase = "wait_opt"
+            w.deliver(m.on_optimize(name))
+        else:
+            c.phase = "active"
+    else:  # pragma: no cover - enumerator/apply drift
+        raise AssertionError(f"unknown action {act}")
+    return w
+
+
+def _count_abort(c: ClientModel, name: str) -> None:
+    c.abort_seen += 1
+    if c.abort_seen > 1:
+        raise Violation(
+            f"{name} received {c.abort_seen} abort packets for tag "
+            f"{c.cur_tag} — exactly-one-abort violated (double broadcast)")
+
+
+# --------------------------------------------------------------------------
+# exploration
+# --------------------------------------------------------------------------
+
+
+def _quiescent(w: World) -> bool:
+    if w.master.limbo or w.pending_disconnects or w.master.pending_closes:
+        return False
+    for c in w.clients.values():
+        if c.phase not in QUIESCENT_PHASES:
+            return False
+        if c.phase == "active" and c.script:
+            return False
+    # master-side leftovers are latent wedges: a dangling op wedges its tag
+    # for every future joiner, an in-flight round means someone never
+    # answered (their phase would be non-quiescent — this is a backstop)
+    if w.master.establish_in_flight:
+        return False
+    for g in w.master.groups.values():
+        if g.ops or g.sync_in_flight:
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class Result:
+    scenario: str
+    states: int
+    quiescent: int
+
+
+def explore(sc: Scenario, master_cls: type = MasterModel) -> Result:
+    """DFS every interleaving; raises Violation on the first broken
+    invariant (with the action trace that reaches it)."""
+    w0 = initial_world(sc, master_cls)
+    f0 = w0.freeze()
+    worlds: "dict[Any, World]" = {f0: w0}
+    parent: "dict[Any, tuple[Any, Action] | None]" = {f0: None}
+    succs: "dict[Any, list[Any]]" = {}
+    stack = [f0]
+    quiescent: "set[Any]" = set()
+
+    def trace_to(f: Any) -> "list[Action]":
+        acts: "list[Action]" = []
+        while True:
+            pa = parent[f]
+            if pa is None:
+                break
+            f, a = pa
+            acts.append(a)
+        acts.reverse()
+        return acts
+
+    while stack:
+        f = stack.pop()
+        if f in succs:
+            continue
+        w = worlds[f]
+        acts = enabled_actions(w)
+        nxt: "list[Any]" = []
+        if not acts:
+            if not _quiescent(w):
+                waiting = {n: c.phase for n, c in w.clients.items()
+                           if c.phase not in QUIESCENT_PHASES}
+                raise Violation(
+                    f"stuck world in scenario '{sc.name}': no action enabled "
+                    f"but clients are still waiting: {waiting}",
+                    trace_to(f))
+            quiescent.add(f)
+        for a in acts:
+            try:
+                w2 = apply_action(w, a)
+            except Violation as v:
+                raise Violation(f"scenario '{sc.name}': {v.message}",
+                                trace_to(f) + [a]) from None
+            f2 = w2.freeze()
+            nxt.append(f2)
+            if f2 not in worlds:
+                worlds[f2] = w2
+                parent[f2] = (f, a)
+                stack.append(f2)
+                if len(worlds) > sc.max_states:
+                    raise Violation(
+                        f"scenario '{sc.name}' exceeded {sc.max_states} "
+                        "states — shrink the scenario (this cap is a guard "
+                        "against model regressions, not an invariant)")
+        succs[f] = nxt
+        if _quiescent(w):
+            quiescent.add(f)
+
+    # liveness: every reachable state must have a PATH to quiescence
+    rev: "dict[Any, list[Any]]" = {}
+    for f, ns in succs.items():
+        for n in ns:
+            rev.setdefault(n, []).append(f)
+    ok = set(quiescent)
+    frontier = list(quiescent)
+    while frontier:
+        f = frontier.pop()
+        for p in rev.get(f, ()):
+            if p not in ok:
+                ok.add(p)
+                frontier.append(p)
+    bad = [f for f in succs if f not in ok]
+    if bad:
+        f = bad[0]
+        w = worlds[f]
+        waiting = {n: c.phase for n, c in w.clients.items()
+                   if c.phase not in QUIESCENT_PHASES}
+        raise Violation(
+            f"livelock in scenario '{sc.name}': {len(bad)} reachable "
+            f"state(s) have NO path to quiescence; e.g. clients stuck in "
+            f"{waiting}", trace_to(f))
+    return Result(sc.name, len(worlds), len(quiescent))
+
+
+# --------------------------------------------------------------------------
+# scenario suite
+# --------------------------------------------------------------------------
+
+
+def default_scenarios() -> "list[Scenario]":
+    """The per-PR suite: every fault class, worlds sized to finish on a
+    1-core CI box. --deep widens the worlds."""
+    return [
+        # all interleavings of a 4-way join + establish (world <= 4 gate)
+        Scenario("join4_establish",
+                 (("a", 0, ()), ("b", 0, ()), ("c", 0, ()), ("d", 0, ()))),
+        # the hand-reasoned vote-vs-commence deadlock tie-break: two active
+        # peers run a collective while a third joins mid-round (admission
+        # votes are implicit actions, enabled whenever `j` is pending).
+        # `j` joins another peer group: collectives are group-scoped, so a
+        # same-group joiner would additionally have to participate in the
+        # op — the admission/vote interleaving is identical either way.
+        Scenario("join_during_collective",
+                 (("a", 0, ("collective",)), ("b", 0, ("collective",)),
+                  ("j", 1, ()))),
+        # one collective, one member aborts locally -> exactly-one-abort
+        Scenario("collective_local_abort",
+                 (("a", 0, ("collective",)), ("b", 0, ("collective",)),
+                  ("c", 0, ("collective",))),
+                 local_abort=("b",)),
+        # disconnect at every possible point around a collective (scripts
+        # are coordination-closed: every group member participates in
+        # every group op unless it crashed — the app contract)
+        Scenario("collective_crash",
+                 (("a", 0, ("collective", "collective")),
+                  ("b", 0, ("collective", "collective")),
+                  ("c", 0, ("collective", "collective"))),
+                 disconnects=("c",), expect_no_kicks=True),
+        # shared-state sync with a mid-round crash
+        Scenario("sync_crash",
+                 (("a", 0, ("sync", "sync")), ("b", 0, ("sync", "sync")),
+                  ("c", 0, ("sync", "sync"))),
+                 disconnects=("c",)),
+        # establish failure -> the unreachable peer is kicked
+        Scenario("establish_kick",
+                 (("a", 0, ()), ("b", 0, ()), ("v", 0, ())),
+                 establish_fail=(("a", "v"),), expect_no_kicks=False),
+        # optimize vote round with a crash
+        Scenario("optimize_crash",
+                 (("a", 0, ("optimize",)), ("b", 0, ("optimize",)),
+                  ("c", 0, ("optimize",))),
+                 disconnects=("c",)),
+        # master SIGKILL+restart at every point of a collective+sync run;
+        # resume or limbo-expiry at every point after
+        Scenario("restart_resume",
+                 (("a", 0, ("collective", "sync")),
+                  ("b", 0, ("collective", "sync"))),
+                 journal=True, max_restarts=1, staged=True),
+        # crash window between Done and the journal append: the resume
+        # ack's trust-the-client rule must absorb it without kicks
+        Scenario("restart_lag",
+                 (("a", 0, ("sync", "sync")), ("b", 0, ("sync", "sync"))),
+                 journal=True, max_restarts=1, lag=True),
+        # a client joins while another leaves, with a restart in the mix
+        Scenario("churn_restart",
+                 (("a", 0, ("collective",)),
+                  ("b", 0, ("collective", "leave")),
+                  ("j", 1, ())),
+                 journal=True, max_restarts=1),
+    ]
+
+
+def deep_scenarios() -> "list[Scenario]":
+    return [
+        Scenario("join4_sync",
+                 (("a", 0, ("sync",)), ("b", 0, ("sync",)),
+                  ("c", 0, ("sync",)), ("d", 0, ("sync",))),
+                 max_states=2_000_000),
+        Scenario("collective4_abort",
+                 (("a", 0, ("collective",)), ("b", 0, ("collective",)),
+                  ("c", 0, ("collective",)), ("d", 0, ("collective",))),
+                 local_abort=("d",), max_states=2_000_000),
+        Scenario("restart_resume_w3",
+                 (("a", 0, ("collective", "sync")),
+                  ("b", 0, ("collective", "sync")),
+                  ("c", 0, ("collective", "sync"))),
+                 journal=True, max_restarts=1, staged=True,
+                 max_states=4_000_000),
+        Scenario("double_restart",
+                 (("a", 0, ("sync", "collective")),
+                  ("b", 0, ("sync", "collective"))),
+                 journal=True, max_restarts=2, staged=True,
+                 max_states=4_000_000),
+    ]
+
+
+def run_suite(scenarios: "list[Scenario]",
+              master_cls: type = MasterModel,
+              verbose: bool = False) -> "list[Result]":
+    out = []
+    for sc in scenarios:
+        r = explore(sc, master_cls)
+        out.append(r)
+        if verbose:
+            print(f"  {r.scenario}: {r.states} states, "
+                  f"{r.quiescent} quiescent — ok")
+    return out
+
+
+def check(root: Path) -> "list[Finding] | Skip":
+    del root  # the model is self-contained
+    try:
+        run_suite(default_scenarios())
+    except Violation as v:
+        return [Finding(CHECKER, "tools/pcclt_verify/fsm_spec.py", 0, str(v))]
+    return []
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="pcclt_verify.model_check",
+        description="explicit-state model checker for the CCoIP control plane")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the larger worlds (minutes, not seconds)")
+    args = ap.parse_args(argv)
+    try:
+        print("default suite:")
+        run_suite(default_scenarios(), verbose=True)
+        if args.deep:
+            print("deep suite:")
+            run_suite(deep_scenarios(), verbose=True)
+    except Violation as v:
+        print(f"VIOLATION: {v}")
+        return 1
+    print("model check: all invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
