@@ -147,6 +147,8 @@ class EdgeStats(ctypes.Structure):
         ("rx_frames", ctypes.c_uint64),
         ("connects", ctypes.c_uint64),
         ("stall_ms", ctypes.c_uint64),
+        ("tx_zc_frames", ctypes.c_uint64),
+        ("tx_zc_reaps", ctypes.c_uint64),
     ]
 
 

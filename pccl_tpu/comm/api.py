@@ -663,7 +663,8 @@ class Communicator:
 
             {"counters": {collectives_ok, collectives_aborted, ...},
              "edges": {"ip:port": {tx_bytes, rx_bytes, tx_frames,
-                                   rx_frames, connects, stall_ms}, ...}}
+                                   rx_frames, connects, stall_ms,
+                                   tx_zc_frames, tx_zc_reaps}, ...}}
 
         Edge keys are canonical remote endpoints (the peer's advertised
         p2p listen endpoint — the same key netem's PCCLT_WIRE_*_MAP uses).
@@ -688,6 +689,8 @@ class Communicator:
                     "tx_frames": int(e.tx_frames),
                     "rx_frames": int(e.rx_frames),
                     "connects": int(e.connects), "stall_ms": int(e.stall_ms),
+                    "tx_zc_frames": int(e.tx_zc_frames),
+                    "tx_zc_reaps": int(e.tx_zc_reaps),
                 }
         return {"counters": counters, "edges": edges}
 

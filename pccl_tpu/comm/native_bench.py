@@ -455,9 +455,11 @@ def run_wan_bench(world: int = 4, nbytes: int = 32 << 20, iters: int = 3,
 
 
 def _peer_wan_rtt(rank, master_port, q, world, nbytes, iters, windows,
-                  port_base):
+                  port_base, env=None):
     from pccl_tpu.parallel.ring import avg_all_reduce_windowed
 
+    if env:
+        os.environ.update(env)  # data-plane knobs, applied pre-native-load
     comm = _connect(rank, master_port, world, port_base)
     rng = np.random.default_rng(11 + rank)
     x = rng.standard_normal(nbytes // 4).astype(np.float32)
@@ -494,21 +496,69 @@ def run_wan_rtt_windowed_bench(world: int = 4, nbytes: int = 16 << 20,
     pays on fat pipes). Measured sweet spot: the win GROWS as the payload
     shrinks toward the bandwidth-delay product (1.46-1.53x at 16 MB vs
     1.20x at 32 MB on this host) — exactly the latency-dominated regime
-    real outer-step shards live in."""
+    real outer-step shards live in.
+
+    Both legs run with the windowed data-plane pipeline + io_uring backend
+    (docs/08) forced OFF: these keys are the classic store-and-forward
+    BASELINE, comparable across rounds with the r05 numbers, and the
+    windowing A/B only means something on the plane windowing was invented
+    for. The new plane's number is run_wan_pipelined_bench — a single
+    pipelined flow now matches/beats the 4-window figure, which is exactly
+    why the baseline must stay pinned."""
     out: Dict[str, float] = {}
+    env = {"PCCLT_PIPELINE": "0", "PCCLT_URING": "0"}
     with _paced_wire(mbps), _rtt_wire(rtt_ms):
         for name, windows, mport, base in (
                 ("wan_rtt_single_busbw_gbps", 1, mports[0], bases[0]),
                 ("wan_rtt_windowed_busbw_gbps", 4, mports[1], bases[1])):
             res = _spawn_world(world, _peer_wan_rtt,
                                _port("PCCLT_BENCH_MASTER_PORT_RTT", mport),
-                               (world, nbytes, iters, windows, base),
+                               (world, nbytes, iters, windows, base, env),
                                inline_rank0=False)
             times = next(r["times"] for r in res if r["rank"] == 0)
             med = sorted(times)[len(times) // 2]
             out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
     out["wan_rtt_windowed_speedup"] = (out["wan_rtt_windowed_busbw_gbps"] /
                                        out["wan_rtt_single_busbw_gbps"])
+    return out
+
+
+def run_wan_pipelined_bench(world: int = 4, nbytes: int = 16 << 20,
+                            iters: int = 3, mbps: float = 1000.0,
+                            rtt_ms: float = 50.0, baselines=None,
+                            master_port: int = 48705, base: int = 46600,
+                            ) -> Dict[str, float]:
+    """The zero-copy pipelined data plane on the exact fat-long-pipe map of
+    run_wan_rtt_windowed_bench (same mbps × rtt × payload): ONE flow with
+    the windowed quantize→send→recv→dequant pipeline + io_uring batched
+    submission forced on (docs/08 "data-plane pipeline"). A single
+    pipelined collective pays the per-stage one-way delay once per window
+    chain instead of once per stage, recovering MORE than 4-way op
+    windowing did (r05: single 0.0603 / windowed 0.0873; the pipelined
+    flow must beat both) without splitting the collective or paying 4
+    consensus rounds.
+
+    ``baselines`` (optional): a dict holding this run's
+    wan_rtt_single_busbw_gbps / wan_rtt_windowed_busbw_gbps, used for the
+    speedup keys; bench.py passes the values it just measured so the
+    comparison is same-host, same-load."""
+    out: Dict[str, float] = {}
+    env = {"PCCLT_PIPELINE": "1"}  # io_uring rides its default auto-gate
+    with _paced_wire(mbps), _rtt_wire(rtt_ms):
+        res = _spawn_world(world, _peer_wan_rtt,
+                           _port("PCCLT_BENCH_MASTER_PORT_PIPE", master_port),
+                           (world, nbytes, iters, 1, base, env),
+                           inline_rank0=False)
+        times = next(r["times"] for r in res if r["rank"] == 0)
+        med = sorted(times)[len(times) // 2]
+        out["wan_pipelined_busbw_gbps"] = \
+            (2 * (world - 1) / world) * nbytes / med / 1e9
+    for key, name in (("wan_rtt_single_busbw_gbps", "wan_pipelined_speedup"),
+                      ("wan_rtt_windowed_busbw_gbps",
+                       "wan_pipelined_vs_windowed")):
+        ref = (baselines or {}).get(key)
+        if ref:
+            out[name] = out["wan_pipelined_busbw_gbps"] / ref
     return out
 
 

@@ -302,6 +302,8 @@ typedef struct pccltEdgeStats_t {
     uint64_t rx_frames;
     uint64_t connects;  /* connections established on this edge */
     uint64_t stall_ms;  /* receiver wire-stall charged to this edge */
+    uint64_t tx_zc_frames; /* frames sent via io_uring MSG_ZEROCOPY */
+    uint64_t tx_zc_reaps;  /* zerocopy completion notifications reaped */
 } pccltEdgeStats_t;
 
 /* Snapshot this communicator's counters. */

@@ -404,6 +404,8 @@ pccltResult_t pccltCommGetEdgeStats(pccltComm_t *c, pccltEdgeStats_t *out,
         o.rx_frames = e.rx_frames;
         o.connects = e.conns;
         o.stall_ms = e.stall_ns / 1000000;
+        o.tx_zc_frames = e.tx_zc_frames;
+        o.tx_zc_reaps = e.tx_zc_reaps;
     }
     return pccltSuccess;
 }

@@ -1,5 +1,7 @@
 #include "benchmark.hpp"
 
+#include <sys/socket.h>
+
 #include <algorithm>
 #include <chrono>
 #include <cstdlib>
@@ -11,6 +13,7 @@
 #include "log.hpp"
 #include "netem.hpp"
 #include "protocol.hpp"
+#include "uring.hpp"
 #include "wire.hpp"
 
 namespace pcclt::bench {
@@ -93,7 +96,55 @@ double run_probe(const net::Addr &target) {
             auto deadline = Clock::now() + std::chrono::duration<double>(secs);
             uint64_t sent = 0;
             auto t0 = Clock::now();
+            // the probe floods through the same data-plane backend the
+            // collective will ride: batched io_uring sends when available
+            // (4 chunks per submission, each still paced through the
+            // target's netem edge bucket), the plain send loop otherwise
+            net::uring::Ring ring;
+            bool use_uring = net::uring::enabled() && ring.init(8);
             while (Clock::now() < deadline) {
+                if (use_uring) {
+                    constexpr unsigned kProbeBatch = 4;
+                    unsigned nb = 0;
+                    for (; nb < kProbeBatch; ++nb) {
+                        if (Clock::now() >= deadline && nb) break;
+                        edge->pace(chunk);  // no-op on unemulated edges
+                        auto *sqe = ring.get_sqe();
+                        if (!sqe) break;
+                        sqe->opcode = net::uring::kOpSend;
+                        sqe->fd = socks[i].fd();
+                        sqe->addr = reinterpret_cast<uint64_t>(buf.data());
+                        sqe->len = static_cast<uint32_t>(chunk);
+                        sqe->msg_flags = MSG_NOSIGNAL | MSG_WAITALL;
+                        sqe->user_data = nb;
+                    }
+                    // link all but the last, preserving stream order within
+                    // one submission (flags are settable until submit())
+                    for (unsigned k = 0; k + 1 < nb; ++k)
+                        ring.sqe_at_tail(nb - k)->flags |=
+                            net::uring::kSqeIoLink;
+                    int rc = nb == 0 ? -1 : ring.submit();
+                    if (rc < 0) {
+                        use_uring = false;
+                        continue;
+                    }
+                    // reap exactly what was consumed — a short submission
+                    // (async-context allocation failure) must not leave the
+                    // loop waiting for CQEs that will never arrive
+                    const unsigned submitted = static_cast<unsigned>(rc);
+                    bool dead = false;
+                    for (unsigned r = 0; r < submitted; ++r) {
+                        net::uring::Ring::Cqe c;
+                        if (!ring.next_cqe(c) || c.res < 0 ||
+                            static_cast<size_t>(c.res) < chunk)
+                            dead = true;
+                        else
+                            sent += chunk;
+                    }
+                    if (dead) break;
+                    if (submitted < nb) use_uring = false; // ring is sick
+                    continue;
+                }
                 edge->pace(chunk);  // no-op on unemulated edges
                 if (!socks[i].send_all(buf.data(), chunk)) break;
                 sent += chunk;

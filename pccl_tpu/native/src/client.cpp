@@ -955,10 +955,15 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
             return r.u64() == tag;
         } catch (...) { return false; }
     };
+    uint64_t commence_t0 = telemetry::now_ns();
     auto commence = master_.recv_match_any(
         {static_cast<uint16_t>(PacketType::kM2CCollectiveCommence),
          static_cast<uint16_t>(PacketType::kM2CCollectiveAbort)},
         frame_tag_pred, 600'000);
+    if (telemetry::Recorder::inst().on())
+        telemetry::Recorder::inst().span("collective", "commence_wait",
+                                         commence_t0, telemetry::now_ns(),
+                                         "tag", desc.tag);
     if (!commence) return classify_master_loss();
     if (commence->type == static_cast<uint16_t>(PacketType::kM2CCollectiveAbort)) {
         bool replay_aborted = true;
@@ -1045,8 +1050,15 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
     // post-hoc abort verdict can also restore it — all ranks must retry a
     // failed collective from identical inputs
     const size_t nbytes = count * proto::dtype_size(dtype);
+    uint64_t links_t0 = telemetry::now_ns();
     std::vector<uint8_t> snapshot;
     if (send == recv) {
+        // pooled like the RX scratch: a FRESH params-sized vector here costs
+        // a zero-fill plus a page-fault storm per op (~tens of ms at WAN
+        // sizes on a loaded host) before the first byte can leave the wire —
+        // the pipelined data plane made this the largest fixed op cost
+        snapshot = take_scratch();
+        if (snapshot.capacity() < nbytes) snapshot = std::vector<uint8_t>();
         snapshot.resize(nbytes);
         memcpy(snapshot.data(), recv, nbytes);
     }
@@ -1061,6 +1073,9 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         if (rx.valid() || std::chrono::steady_clock::now() >= rx_deadline) break;
         if (op->abort.load() || consume_abort(true)) break;
     }
+    if (telemetry::Recorder::inst().on())
+        telemetry::Recorder::inst().span("collective", "op_setup", links_t0,
+                                         telemetry::now_ns(), "seq", seq);
     if (dbg_phases)
         fprintf(stderr, "[op %llu] links tx=%d rx=%d abort=%d seq=%llu\n",
                 (unsigned long long)desc.tag, tx.valid(), rx.valid(),
@@ -1179,6 +1194,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
             memcpy(recv, snapshot.empty() ? send : snapshot.data(), nbytes);
         st = Status::kAborted;
     }
+    give_scratch(std::move(snapshot)); // retain the warm pages for the next op
     return st;
 }
 

@@ -37,6 +37,54 @@ using telemetry::now_ns;
 constexpr uint64_t kMetaBit = 0x8000;
 constexpr size_t kSubChunk = 2 << 20; // streaming granularity (bytes)
 
+// ---- pipelined data plane (docs/08 "windowed pipeline") ----
+// Each ring stage's payload is split into up to PCCLT_PIPELINE_WINDOW
+// in-flight windows per edge: quantize of window k+1 overlaps the send of
+// window k, and (unquantized) the NEXT stage's send of window k launches
+// the moment window k of this stage's chunk finishes accumulating — so a
+// fat-long-pipe link pays the per-stage one-way delay once per pipeline
+// fill instead of once per stage. Env is re-read per op (tests flip it at
+// runtime); windows never shrink below PCCLT_PIPELINE_MIN_BYTES, so small
+// payloads degrade to the exact single-window behavior of old.
+size_t env_size(const char *name, long long dflt) {
+    if (const char *e = std::getenv(name)) {
+        long long v = atoll(e);
+        if (v >= 0) return static_cast<size_t>(v);
+    }
+    return static_cast<size_t>(dflt);
+}
+
+bool pipeline_enabled() {
+    const char *e = std::getenv("PCCLT_PIPELINE");
+    return !(e && e[0] == '0');
+}
+
+size_t pipeline_windows(size_t bytes) {
+    size_t w = env_size("PCCLT_PIPELINE_WINDOW", 4);
+    size_t min_b = env_size("PCCLT_PIPELINE_MIN_BYTES", 256 << 10);
+    if (min_b == 0) min_b = 1;
+    w = std::min(w, bytes / min_b);
+    return std::max<size_t>(1, w);
+}
+
+// Launch completed windows [*ahead_off, prefix) of the NEXT stage's send
+// chunk (`src`, `total` bytes, granule `wb`) — called from inside a
+// stream_recv accumulation callback, so the next stage's first bytes are
+// on the wire while this stage's later windows are still arriving. A
+// sub-window tail is absorbed into the last window. The one place this
+// arithmetic lives; both ring_allreduce and ring_allgather ride it.
+void send_ahead_windows(net::Link &tx, uint64_t tag, const uint8_t *src,
+                        size_t total, size_t wb, size_t prefix, size_t rot,
+                        size_t *ahead_off, std::vector<net::SendHandle> *hs) {
+    while (*ahead_off < total) {
+        size_t seg = std::min(wb, total - *ahead_off);
+        if (total - (*ahead_off + seg) < wb) seg = total - *ahead_off;
+        if (prefix < *ahead_off + seg) break;
+        hs->push_back(tx.send_at(tag, *ahead_off, {src + *ahead_off, seg}, rot));
+        *ahead_off += seg;
+    }
+}
+
 struct ChunkSpan {
     size_t start_elem, n_elems;
 };
@@ -59,7 +107,12 @@ ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
 bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
                  const uint8_t *scratch,
                  const std::function<void(const uint8_t *src, size_t lo, size_t hi)> &on_data,
-                 Prof *prof = nullptr, bool fill_if_unmapped = false) {
+                 Prof *prof = nullptr, bool fill_if_unmapped = false,
+                 size_t step = 0) {
+    // step: wait/consume granularity — the windowed pipeline passes its
+    // window granule so cross-stage send-ahead fires per window instead of
+    // per kSubChunk (0 = the classic sub-chunk streaming)
+    if (step == 0 || step > kSubChunk) step = kSubChunk;
     using Claim = net::SinkTable::CmaClaim;
     size_t consumed = 0;
     while (consumed < target) {
@@ -81,7 +134,7 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
             // kNone: no descriptor (yet) -> TCP path below re-polls;
             // kFailed: sender falls back to TCP streaming into the sink
         }
-        size_t want = std::min(target, consumed + kSubChunk);
+        size_t want = std::min(target, consumed + step);
         // bounded wait so master aborts / peer death interrupt the stream;
         // while nothing has streamed in, also wake the moment a claimable
         // same-host descriptor arrives (the loop claims it above)
@@ -152,6 +205,17 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // busy RX write first — so every fail() exit leaves no sink pointing into
     // the pooled scratch buffer. On the TX side it acks dropped CMA
     // descriptors so the peer's pending sends complete.
+    // WAN pipelining gate: windowed TX + cross-stage send-ahead. Off on
+    // same-host CMA links — there the fused whole-chunk descriptor claim is
+    // already zero-copy and windowed frames would only fragment it — so the
+    // loopback fast path is bit-for-bit the old one.
+    const bool pipelined = pipeline_enabled() && !ctx.tx.cma_eligible();
+    // Cross-stage send-ahead state (unquantized): handles + contiguous byte
+    // progress of the NEXT stage's chunk, launched from inside the current
+    // stage's accumulation callback as windows complete.
+    std::vector<net::SendHandle> ahead_hs;
+    size_t ahead_off = 0;
+
     auto restore = [&] {
         // purge FIRST: stage-ahead all-gather sinks point into `recv`, and an
         // RX thread may still be writing through one — the restore memcpy
@@ -161,6 +225,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         memcpy(recv, restore_src, count * esz);
     };
     auto fail = [&](bool conn_lost) {
+        // in-flight send-ahead windows borrow spans of `recv`: they must
+        // complete (or fail with their conn) before restore can overwrite it
+        net::Link::wait_all(ahead_hs);
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " failing (conn_lost="
                      << conn_lost << "), purging";
         restore();
@@ -214,6 +281,23 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         auto t0 = now_ns();
         fn();
         prof.quant_ns += now_ns() - t0;
+    };
+    // send_ahead_windows bound to this op's state. The receiver's sink for
+    // the next stage is already registered (reg_stage runs one stage
+    // ahead); a frame that still races registration lands on the
+    // queued-copy path, never lost.
+    auto send_ahead = [&](uint64_t next_tag, const uint8_t *src,
+                          size_t chunk_bytes, size_t wb, size_t prefix) {
+        send_ahead_windows(ctx.tx, next_tag, src, chunk_bytes, wb, prefix,
+                           ctx.op_seq, &ahead_off, &ahead_hs);
+    };
+    // window granule for a chunk, 0 = no windowing (pipeline off or chunk
+    // below the window floor)
+    auto win_bytes = [&](size_t chunk_bytes) -> size_t {
+        if (!pipelined) return 0;
+        size_t w = pipeline_windows(chunk_bytes);
+        if (w <= 1) return 0;
+        return std::max(esz, chunk_bytes / w / esz * esz);
     };
 
     // stage sequence: reduce-scatter stages seq 0..world-2, then all-gather
@@ -277,11 +361,37 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             quant_timed([&] {
                 meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
                                            send_ptr, send_span.n_elems);
-                quant::quantize(meta, send_ptr, tx_scratch.data(),
-                                send_span.n_elems);
             });
-            tx_job = launch_tx(tag, meta.encode(),
-                               {tx_scratch.data(), send_span.n_elems * qsz});
+            const size_t qw =
+                pipelined ? pipeline_windows(send_span.n_elems * qsz) : 1;
+            if (qw <= 1) {
+                quant_timed([&] {
+                    quant::quantize(meta, send_ptr, tx_scratch.data(),
+                                    send_span.n_elems);
+                });
+                tx_job = launch_tx(tag, meta.encode(),
+                                   {tx_scratch.data(), send_span.n_elems * qsz});
+            } else {
+                // per-window quantize→send overlap: window k+1 quantizes
+                // while window k is on the wire. ONE meta for the whole
+                // chunk — wire format and numerics are unchanged.
+                tx_job.push_back(ctx.tx.send_meta(tag | kMetaBit, meta.encode()));
+                for (size_t w = 0; w < qw; ++w) {
+                    auto ws = chunk_of(send_span.n_elems,
+                                       static_cast<uint32_t>(qw),
+                                       static_cast<uint32_t>(w));
+                    quant_timed([&] {
+                        quant::quantize(meta, send_ptr + ws.start_elem * esz,
+                                        tx_scratch.data() + ws.start_elem * qsz,
+                                        ws.n_elems);
+                    });
+                    tx_job.push_back(ctx.tx.send_at(
+                        tag, ws.start_elem * qsz,
+                        {tx_scratch.data() + ws.start_elem * qsz,
+                         ws.n_elems * qsz},
+                        ctx.op_seq));
+                }
+            }
             ctx.tx_bytes += send_span.n_elems * qsz;
 
             // sink for THIS stage was registered a stage ahead; open the
@@ -314,23 +424,53 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             // later stages send chunks accumulated into recv at stage s-1
             const uint8_t *tx_ptr =
                 (lazy && s == 0) ? src8 + send_span.start_elem * esz : send_ptr;
-            tx_job = launch_tx(tag, {}, {tx_ptr, send_span.n_elems * esz});
-            ctx.tx_bytes += send_span.n_elems * esz;
+            const size_t send_bytes = send_span.n_elems * esz;
+            if (ahead_off > 0) {
+                // leading windows already left during stage s-1's accumulate
+                tx_job = std::move(ahead_hs);
+                ahead_hs.clear();
+                if (ahead_off < send_bytes)
+                    tx_job.push_back(ctx.tx.send_at(
+                        tag, ahead_off, {tx_ptr + ahead_off,
+                                         send_bytes - ahead_off},
+                        ctx.op_seq));
+            } else if (pipelined && win_bytes(send_bytes)) {
+                // single-conn in-order stream: striping across the pool
+                // would race page-aligned segments through the shared edge
+                // bucket and stall the receiver's contiguous prefix — the
+                // pipeline rides in-order arrival
+                tx_job.push_back(
+                    ctx.tx.send_at(tag, 0, {tx_ptr, send_bytes}, ctx.op_seq));
+            } else {
+                tx_job = launch_tx(tag, {}, {tx_ptr, send_bytes});
+            }
+            ahead_off = 0;
+            ctx.tx_bytes += send_bytes;
             const uint8_t *local_ptr =
                 lazy ? src8 + recv_span.start_elem * esz : recv_ptr;
             reg_stage(s + 1); // next stage's sink opens before we consume
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz, rx_scratch,
+            // the chunk accumulating here IS what the next stage (RS s+1,
+            // or AG 0 at the phase boundary) sends — the ring invariant the
+            // cross-stage send-ahead rides
+            const size_t chunk_bytes = recv_span.n_elems * esz;
+            const uint64_t next_tag =
+                s + 2 < world ? (base_tag | (s + 1)) : (base_tag | 0x4000u);
+            const size_t wb = win_bytes(chunk_bytes);
+            bool ok = stream_recv(ctx, tag, chunk_bytes, esz, rx_scratch,
                                   [&](const uint8_t *src, size_t lo, size_t hi) {
                                       size_t e0 = lo / esz, e1 = hi / esz;
                                       kernels::accumulate3(ctx.dtype, ctx.op,
                                                            recv_ptr + e0 * esz,
                                                            local_ptr + e0 * esz,
                                                            src, e1 - e0);
-                                  }, &prof);
+                                      if (wb)
+                                          send_ahead(next_tag, recv_ptr,
+                                                     chunk_bytes, wb, hi);
+                                  }, &prof, /*fill_if_unmapped=*/false, wb);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
-            ctx.rx_bytes += recv_span.n_elems * esz;
+            ctx.rx_bytes += chunk_bytes;
         }
     }
 
@@ -361,6 +501,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
 
         std::vector<net::SendHandle> tx_job;
         if (quantized) {
+            bool launched = false;
             if (s == 0) {
                 quant::Meta meta;
                 quant_timed([&] {
@@ -368,15 +509,50 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                                ctx.dtype, send_ptr,
                                                send_span.n_elems);
                     fwd_q.resize(send_span.n_elems * qsz);
-                    quant::quantize(meta, send_ptr, fwd_q.data(),
-                                    send_span.n_elems);
-                    // bit parity: owner keeps exactly what the others decode
-                    quant::dequantize_set(meta, fwd_q.data(), send_ptr,
-                                          send_span.n_elems);
                 });
                 fwd_meta = meta.encode();
+                const size_t qw =
+                    pipelined ? pipeline_windows(send_span.n_elems * qsz) : 1;
+                if (qw > 1) {
+                    // per-window quantize→send overlap (one whole-chunk
+                    // meta, wire format unchanged); the owner's bit-parity
+                    // self-dequantize rides the same window while it is
+                    // still cache-hot
+                    tx_job.push_back(
+                        ctx.tx.send_meta(tag | kMetaBit, fwd_meta));
+                    for (size_t w = 0; w < qw; ++w) {
+                        auto ws = chunk_of(send_span.n_elems,
+                                           static_cast<uint32_t>(qw),
+                                           static_cast<uint32_t>(w));
+                        quant_timed([&] {
+                            quant::quantize(meta,
+                                            send_ptr + ws.start_elem * esz,
+                                            fwd_q.data() + ws.start_elem * qsz,
+                                            ws.n_elems);
+                        });
+                        tx_job.push_back(ctx.tx.send_at(
+                            tag, ws.start_elem * qsz,
+                            {fwd_q.data() + ws.start_elem * qsz,
+                             ws.n_elems * qsz},
+                            ctx.op_seq));
+                        quant_timed([&] {
+                            quant::dequantize_set(
+                                meta, fwd_q.data() + ws.start_elem * qsz,
+                                send_ptr + ws.start_elem * esz, ws.n_elems);
+                        });
+                    }
+                    launched = true;
+                } else {
+                    quant_timed([&] {
+                        quant::quantize(meta, send_ptr, fwd_q.data(),
+                                        send_span.n_elems);
+                        // bit parity: owner keeps what the others decode
+                        quant::dequantize_set(meta, fwd_q.data(), send_ptr,
+                                              send_span.n_elems);
+                    });
+                }
             }
-            tx_job = launch_tx(tag, fwd_meta, fwd_q);
+            if (!launched) tx_job = launch_tx(tag, fwd_meta, fwd_q);
             ctx.tx_bytes += fwd_q.size();
 
             reg_stage(rs_stages + s + 1); // sink for THIS stage opened earlier
@@ -413,11 +589,30 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                 fwd_meta = mraw.value();
             }
         } else {
-            tx_job = launch_tx(tag, {}, {send_ptr, send_span.n_elems * esz});
-            ctx.tx_bytes += send_span.n_elems * esz;
+            const size_t send_bytes = send_span.n_elems * esz;
+            if (ahead_off > 0) {
+                tx_job = std::move(ahead_hs);
+                ahead_hs.clear();
+                if (ahead_off < send_bytes)
+                    tx_job.push_back(ctx.tx.send_at(
+                        tag, ahead_off, {send_ptr + ahead_off,
+                                         send_bytes - ahead_off},
+                        ctx.op_seq));
+            } else if (pipelined && win_bytes(send_bytes)) {
+                // single-conn in-order stream (see the reduce-scatter note)
+                tx_job.push_back(
+                    ctx.tx.send_at(tag, 0, {send_ptr, send_bytes}, ctx.op_seq));
+            } else {
+                tx_job = launch_tx(tag, {}, {send_ptr, send_bytes});
+            }
+            ahead_off = 0;
+            ctx.tx_bytes += send_bytes;
             // zero-copy sink was registered a stage ahead; open the next
             reg_stage(rs_stages + s + 1);
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * esz, esz, recv_ptr,
+            const size_t chunk_bytes = recv_span.n_elems * esz;
+            const uint64_t next_tag = base_tag | (0x4000u + s + 1);
+            const size_t wb = s + 2 < world ? win_bytes(chunk_bytes) : 0;
+            bool ok = stream_recv(ctx, tag, chunk_bytes, esz, recv_ptr,
                                   [&](const uint8_t *src, size_t lo, size_t hi) {
                                       // mapped-region consume: the copy into
                                       // the result IS the stage; TCP/pulled
@@ -425,11 +620,14 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       if (src != recv_ptr + lo)
                                           kernels::copy_stream(recv_ptr + lo, src,
                                                                hi - lo);
-                                  }, &prof, /*fill_if_unmapped=*/true);
+                                      if (wb)
+                                          send_ahead(next_tag, recv_ptr,
+                                                     chunk_bytes, wb, hi);
+                                  }, &prof, /*fill_if_unmapped=*/true, wb);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
-            ctx.rx_bytes += recv_span.n_elems * esz;
+            ctx.rx_bytes += chunk_bytes;
         }
     }
 
@@ -497,6 +695,16 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
     const bool trace = rec.on();
     Prof prof;
     auto op_t0 = now_ns();
+    // same windowed cross-stage send-ahead as the all-reduce (docs/08):
+    // the segment received at stage s is the one forwarded at stage s+1
+    const bool pipelined = pipeline_enabled() && !ctx.tx.cma_eligible();
+    size_t wb = 0;
+    if (pipelined) {
+        size_t w = pipeline_windows(seg);
+        if (w > 1) wb = std::max(esz, seg / w / esz * esz);
+    }
+    std::vector<net::SendHandle> ahead_hs;
+    size_t ahead_off = 0;
     for (uint32_t s = 0; s + 1 < world; ++s) {
         const uint64_t tag = base_tag | s;
         telemetry::Span stage_span("collective", "gather_stage", "stage", s,
@@ -504,19 +712,45 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
         const uint32_t fwd_rank = (rank + world - s) % world; // own at s=0
         const uint8_t *src = s == 0 ? static_cast<const uint8_t *>(send)
                                     : out + slot(fwd_rank) * seg;
-        auto tx_job = ctx.tx.send_async(tag, {src, seg}, ctx.op_seq);
+        std::vector<net::SendHandle> tx_job;
+        if (ahead_off > 0) {
+            tx_job = std::move(ahead_hs);
+            ahead_hs.clear();
+            if (ahead_off < seg)
+                tx_job.push_back(ctx.tx.send_at(tag, ahead_off,
+                                                {src + ahead_off,
+                                                 seg - ahead_off},
+                                                ctx.op_seq));
+        } else {
+            if (wb) // single-conn in-order stream (see the all-reduce note)
+                tx_job.push_back(
+                    ctx.tx.send_at(tag, 0, {src, seg}, ctx.op_seq));
+            else
+                tx_job = ctx.tx.send_async(tag, {src, seg}, ctx.op_seq);
+        }
+        ahead_off = 0;
         ctx.tx_bytes += seg;
         const uint32_t src_rank = (rank + world - s - 1) % world;
         uint8_t *dst = out + slot(src_rank) * seg;
         reg_stage(s + 1);
+        const uint64_t next_tag = base_tag | (s + 1);
+        const size_t swb = s + 2 < world ? wb : 0;
         bool ok = stream_recv(ctx, tag, seg, esz, dst,
                               [&](const uint8_t *p, size_t lo, size_t hi) {
                                   if (p != dst + lo)
                                       kernels::copy_stream(dst + lo, p, hi - lo);
-                              }, &prof, /*fill_if_unmapped=*/true);
+                                  if (swb)
+                                      send_ahead_windows(ctx.tx, next_tag, dst,
+                                                         seg, swb, hi,
+                                                         ctx.op_seq, &ahead_off,
+                                                         &ahead_hs);
+                              }, &prof, /*fill_if_unmapped=*/true, swb);
         ctx.rx.table().unregister_sink(tag);
         bool tx_ok = net::Link::wait_all(tx_job);
-        if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
+        if (!ok || !tx_ok) {
+            net::Link::wait_all(ahead_hs); // next-stage windows borrow `out`
+            return fail(!ctx.rx.alive() || !ctx.tx.alive());
+        }
         ctx.rx_bytes += seg;
     }
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);

@@ -1000,6 +1000,28 @@ static void test_e2e_concurrent_tags(size_t world, size_t ntags) {
 // abort mid-ring: one peer launches the collective then abruptly disconnects;
 // the survivors must see a failed op, recover via update_topology, retry, and
 // get a correct world-2 result (reference: SIGKILL churn e2e, done in-process)
+// Pipelined WAN data plane forced onto an in-process world (fallback
+// matrix, docs/08): PCCLT_CMA=0 turns every edge into a real TCP stream —
+// the windowed pipeline's gate — and a tiny window floor makes even the
+// selftest payload split into in-flight windows, so per-window quantize→
+// send and the cross-stage send-ahead actually run. The same worlds then
+// re-run with the pipeline forced OFF; results must be identical either
+// way (the e2e checks are exact). PCCLT_URING is inherited from the
+// environment: CI runs this binary once with it forced on and once forced
+// off, covering the uring→poll rungs of the ladder too.
+static void test_e2e_pipelined() {
+    setenv("PCCLT_CMA", "0", 1);
+    setenv("PCCLT_PIPELINE", "1", 1);
+    setenv("PCCLT_PIPELINE_MIN_BYTES", "256", 1);
+    test_e2e(3, proto::QuantAlgo::kNone);
+    test_e2e(3, proto::QuantAlgo::kZeroPointScale);
+    setenv("PCCLT_PIPELINE", "0", 1); // forced-off rung, still CMA-less
+    test_e2e(2, proto::QuantAlgo::kNone);
+    unsetenv("PCCLT_PIPELINE");
+    unsetenv("PCCLT_PIPELINE_MIN_BYTES");
+    unsetenv("PCCLT_CMA");
+}
+
 static void test_e2e_abort_mid_ring() {
     uint16_t port = alloc_test_ports(512);
     master::Master mm(port);
@@ -1113,6 +1135,9 @@ int main() {
     printf("e2e world=2 bf16: %s\n", g_failures ? "FAIL" : "ok");
     test_e2e_concurrent_tags(2, fast_mode() ? 2 : 4);
     printf("e2e world=2 concurrent tags: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e_pipelined();
+    printf("e2e pipelined data plane (fallback matrix): %s\n",
+           g_failures ? "FAIL" : "ok");
     test_e2e_abort_mid_ring();
     printf("e2e world=3 abort mid-ring: %s\n", g_failures ? "FAIL" : "ok");
     if (g_failures) {

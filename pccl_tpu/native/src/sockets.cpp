@@ -21,6 +21,7 @@
 #include "netem.hpp"
 #include "shm.hpp"
 #include "telemetry.hpp"
+#include "uring.hpp"
 #include "wire.hpp"
 
 namespace pcclt::net {
@@ -815,6 +816,9 @@ MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table,
       dom_(dom ? std::move(dom) : telemetry::default_domain()) {
     tx_chunk_base_ = env_size("PCCLT_MULTIPLEX_CHUNK_SIZE", 8 << 20);
     cma_min_ = env_size("PCCLT_CMA_MIN_BYTES", 64 << 10);
+    // io_uring backend gate, sampled per conn like the netem refresh below
+    uring_on_ = uring::enabled();
+    zc_min_ = uring_on_ ? uring::zc_min_bytes() : 0;
     // per-conn env re-read (old WirePacer::refresh semantics): a process
     // that flips the wire env between connections gets the new model
     netem::Registry::inst().refresh();
@@ -1015,6 +1019,16 @@ bool MultiplexConn::cma_post_desc(uint64_t tag, uint64_t off,
 }
 
 bool MultiplexConn::stream_payload(const SendReq &req) {
+    // io_uring path when the payload spans several frames (batched
+    // submission pays) or a single frame is zerocopy-eligible; everything
+    // else — including the fallback ladder's bottom — uses the classic
+    // per-frame gathered write below.
+    if (uring_on_ && !req.span.empty() &&
+        (req.span.size() > tx_chunk_ ||
+         (zc_min_ && req.span.size() >= zc_min_)))
+        // handles its own fallback internally — a false here is a dead
+        // socket, never "please retry" (a retry would duplicate frames)
+        return stream_payload_uring(req);
     size_t off = 0;
     do {
         size_t n = std::min(tx_chunk_, req.span.size() - off);
@@ -1022,6 +1036,180 @@ bool MultiplexConn::stream_payload(const SendReq &req) {
             return false;
         off += n;
     } while (off < req.span.size());
+    return true;
+}
+
+// Batched io_uring TX. Per batch: frames are paced and their headers built
+// OUTSIDE wr_mu_ (the netem sleep must only delay this writer), then the
+// whole batch is submitted under one lock hold as IOSQE_IO_LINK-chained
+// vectored SENDMSG SQEs — one submission per frame carrying header +
+// payload together (never two sendmsg calls), links preserving TCP stream
+// order, MSG_WAITALL making every completion all-or-error. Frames at or
+// above zc_min_ go as SENDMSG_ZC: the kernel pins the payload pages
+// instead of copying, and the frame's pages stay borrowed until its
+// completion NOTIF is reaped — all notifs are drained before returning, so
+// the caller's span-validity contract is unchanged.
+bool MultiplexConn::stream_payload_uring(const SendReq &req) {
+    constexpr size_t kBatch = 16;
+    struct Slot {
+        uint8_t hdr[21];
+        struct iovec iov[2];
+        struct msghdr msg;
+        uint32_t bytes = 0;   // 21 + payload
+        uint32_t sent = 0;    // completed bytes (recovery path)
+        bool zc = false;
+        bool ok = false;
+    };
+    Slot slots[kBatch];
+    const size_t total = req.span.size();
+    // On a paced (netem) edge, pace() blocks until each frame has fully
+    // drained through the emulated wire — batching N frames would sleep out
+    // N frame-times BEFORE the first byte is submitted, adding a whole
+    // batch of first-byte latency per stage. Cap the batch at 2 there (one
+    // frame paced ahead of the wire); the full batch depth is for real
+    // links, where pace() is a no-op and the win is one syscall per batch.
+    const size_t batch_cap = wire_->pace_enabled() ? 2 : kBatch;
+    size_t off = 0;
+    while (off < total) {
+        size_t nb = 0;
+        while (nb < batch_cap && off < total) {
+            size_t n = std::min(tx_chunk_, total - off);
+            Slot &sl = slots[nb];
+            uint32_t be_len = wire::to_be(static_cast<uint32_t>(17 + n));
+            uint64_t be_tag = wire::to_be(req.tag);
+            uint64_t be_off = wire::to_be(req.off + off);
+            memcpy(sl.hdr, &be_len, 4);
+            sl.hdr[4] = static_cast<uint8_t>(kData);
+            memcpy(sl.hdr + 5, &be_tag, 8);
+            memcpy(sl.hdr + 13, &be_off, 8);
+            sl.iov[0] = {sl.hdr, 21};
+            sl.iov[1] = {const_cast<uint8_t *>(req.span.data() + off), n};
+            memset(&sl.msg, 0, sizeof sl.msg);
+            sl.msg.msg_iov = sl.iov;
+            sl.msg.msg_iovlen = 2;
+            sl.bytes = static_cast<uint32_t>(21 + n);
+            sl.sent = 0;
+            sl.zc = zc_min_ && n >= zc_min_;
+            sl.ok = false;
+            // identical pacing + accounting to write_frame's. tx_zc_frames
+            // is NOT charged here: a frame only counts as zerocopy once the
+            // kernel confirms it pinned the pages (the F_MORE completion in
+            // the reap loop) — a fallback-to-plain or failed ZC send must
+            // not leave the tx_zc_reaps == tx_zc_frames invariant broken.
+            wire_->pace(21 + n);
+            edge().tx_frames.fetch_add(1, std::memory_order_relaxed);
+            edge().tx_bytes.fetch_add(n, std::memory_order_relaxed);
+            off += n;
+            ++nb;
+        }
+        MutexLock lk(wr_mu_);
+        int fd = sock_.fd();
+        if (fd < 0) return false;
+        if (!tx_ring_ && !tx_uring_down_) {
+            tx_ring_ = std::make_unique<uring::Ring>();
+            if (!tx_ring_->init(2 * kBatch)) {
+                tx_ring_.reset();
+                tx_uring_down_ = true;
+                PLOG(kWarn) << "io_uring TX ring setup failed; "
+                               "falling back to the poll loop";
+            }
+        }
+        auto plain_frame = [&](Slot &sl) {
+            // counters/pacing already charged above — write the raw bytes
+            const auto *pay = static_cast<const uint8_t *>(sl.iov[1].iov_base);
+            size_t pn = sl.iov[1].iov_len;
+            if (sl.sent < 21)
+                return sock_.send_all(sl.hdr + sl.sent, 21 - sl.sent) &&
+                       sock_.send_all(pay, pn);
+            return sock_.send_all(pay + (sl.sent - 21), pn - (sl.sent - 21));
+        };
+        if (tx_uring_down_) {
+            tx_ring_.reset();  // dead ring: free the fd + mmaps
+            for (size_t i = 0; i < nb; ++i)
+                if (!plain_frame(slots[i])) return false;
+            continue;
+        }
+        unsigned expect = 0;
+        for (size_t i = 0; i < nb; ++i) {
+            uring::Sqe *sqe = tx_ring_->get_sqe();
+            if (!sqe) {  // cannot happen at 2*kBatch entries; stay safe
+                tx_uring_down_ = true;
+                break;
+            }
+            sqe->opcode = slots[i].zc ? uring::kOpSendmsgZc : uring::kOpSendmsg;
+            sqe->fd = fd;
+            sqe->addr = reinterpret_cast<uint64_t>(&slots[i].msg);
+            sqe->len = 1;
+            sqe->msg_flags = MSG_NOSIGNAL | MSG_WAITALL;
+            sqe->user_data = i;
+            if (i + 1 < nb) sqe->flags |= uring::kSqeIoLink;
+            expect += slots[i].zc ? 2u : 1u;
+        }
+        if (tx_uring_down_) {
+            tx_ring_.reset();  // nothing submitted: safe to free now
+            for (size_t i = 0; i < nb; ++i)
+                if (!plain_frame(slots[i])) return false;
+            continue;
+        }
+        int rc = tx_ring_->submit();
+        if (rc < 0) {
+            // enter() errors without consuming: nothing is in flight
+            tx_uring_down_ = true;
+            tx_ring_.reset();
+            PLOG(kWarn) << "io_uring submit failed (" << strerror(-rc)
+                        << "); falling back to the poll loop";
+            for (size_t i = 0; i < nb; ++i)
+                if (!plain_frame(slots[i])) return false;
+            continue;
+        }
+        if (static_cast<unsigned>(rc) < nb) {
+            // short submission (async-context allocation failed mid-batch):
+            // only the consumed prefix is in flight — reap exactly those
+            // CQEs, then the recovery loop below streams the rest plainly,
+            // in order, and the ring is abandoned (a reap loop sized to the
+            // full batch would wait forever for CQEs that never come)
+            tx_uring_down_ = true;
+            expect = 0;
+            for (int i = 0; i < rc; ++i) expect += slots[i].zc ? 2u : 1u;
+        }
+        bool hard_fail = false;
+        unsigned reaped = 0;
+        while (reaped < expect) {
+            uring::Ring::Cqe c;
+            if (!tx_ring_->next_cqe(c)) return false;
+            ++reaped;
+            Slot &sl = slots[c.user_data];
+            if (c.flags & uring::kCqeFNotif) {
+                // zerocopy pages released by the kernel
+                edge().tx_zc_reaps.fetch_add(1, std::memory_order_relaxed);
+                continue;
+            }
+            if (sl.zc && (c.flags & uring::kCqeFMore))
+                // pages pinned, notif guaranteed to follow: THIS is a
+                // zerocopy frame (reap-side charge keeps the documented
+                // reaps == frames invariant exact on every fallback path)
+                edge().tx_zc_frames.fetch_add(1, std::memory_order_relaxed);
+            if (sl.zc && !(c.flags & uring::kCqeFMore))
+                --expect;  // failed/short ZC send posts no notif
+            if (c.res == -ECANCELED) {
+                // link chain broken by an earlier failure; recovered below
+            } else if (c.res < 0) {
+                if (c.res != -EINTR && c.res != -EAGAIN) hard_fail = true;
+            } else if (static_cast<uint32_t>(c.res) >= sl.bytes) {
+                sl.ok = true;
+            } else {
+                sl.sent = static_cast<uint32_t>(c.res);  // short: finish below
+            }
+        }
+        if (hard_fail) return false;  // real socket error: the conn is dying
+        // a short submission latched tx_uring_down_ above; its in-flight
+        // CQEs are now drained, so the dead ring can be freed like RX does
+        if (tx_uring_down_) tx_ring_.reset();
+        // rare recovery (signal-shortened send / canceled chain tail):
+        // complete the stream in order on the plain path
+        for (size_t i = 0; i < nb; ++i)
+            if (!slots[i].ok && !plain_frame(slots[i])) return false;
+    }
     return true;
 }
 
@@ -1382,6 +1570,106 @@ SinkTable::CmaClaim SinkTable::consume_cma(
     return conn->consumer_cma_pull(tag, d, slice_align, consume);
 }
 
+// Batched io_uring RX for one large data frame: up to 8 kRxSlice slices are
+// posted as IOSQE_IO_LINK-chained MSG_WAITALL RECVs into the registered sink
+// and submitted in ONE io_uring_enter. Writing into dst is always safe —
+// the caller holds the sink's busy refcount, so unregister/purge wait for
+// us — a cancel only downgrades the frame to "drained, not delivered"
+// (*cancelled), exactly like the poll loop's scratch drain. On a mid-frame
+// submit failure the frame is finished with plain recv_all, so the TCP
+// stream position never desynchronizes.
+bool MultiplexConn::uring_recv_sink(uint8_t *dst, size_t n, uint64_t tag,
+                                    bool *cancelled) {
+    constexpr unsigned kRxBatch = 8;
+    int fd = sock_.fd();
+    if (fd < 0) return false;
+    size_t done = 0;
+    while (done < n) {
+        struct {
+            size_t len = 0;
+        } segs[kRxBatch];
+        unsigned nb = 0;
+        size_t posted = 0;
+        while (nb < kRxBatch && done + posted < n) {
+            uring::Sqe *sqe = rx_ring_->get_sqe();
+            if (!sqe) break;
+            size_t want = std::min(kRxSlice, n - done - posted);
+            sqe->opcode = uring::kOpRecv;
+            sqe->fd = fd;
+            sqe->addr = reinterpret_cast<uint64_t>(dst + done + posted);
+            sqe->len = static_cast<uint32_t>(want);
+            sqe->msg_flags = MSG_WAITALL;
+            sqe->user_data = nb;
+            segs[nb].len = want;
+            posted += want;
+            ++nb;
+        }
+        if (nb == 0) {  // SQ unexpectedly full: never spin — poll loop
+            rx_uring_down_ = true;
+            while (done < n) {
+                size_t want = std::min(kRxSlice, n - done);
+                if (!sock_.recv_all(dst + done, want)) return false;
+                done += want;
+            }
+            return true;
+        }
+        // link all but the last: chained RECVs run strictly in order
+        // (we can set flags after the fact — nothing is published until
+        // submit()), and MSG_WAITALL makes each one all-or-error
+        for (unsigned i = 0; i + 1 < nb; ++i)
+            rx_ring_->sqe_at_tail(nb - i)->flags |= uring::kSqeIoLink;
+        int rc = rx_ring_->submit();
+        if (rc < 0) {
+            // enter() errored without consuming: nothing of this batch hit
+            // the wire-read position — finish the frame on the poll loop.
+            // rx_loop frees the ring once this frame is done;
+            // rx_uring_down_ keeps every later frame on the poll loop.
+            rx_uring_down_ = true;
+            PLOG(kWarn) << "io_uring RX submit failed (" << strerror(-rc)
+                        << "); falling back to the poll loop";
+            while (done < n) {
+                size_t want = std::min(kRxSlice, n - done);
+                if (!sock_.recv_all(dst + done, want)) return false;
+                done += want;
+            }
+            return true;
+        }
+        const unsigned submitted = static_cast<unsigned>(rc);
+        if (submitted < nb)
+            rx_uring_down_ = true;  // short submission: abandon the ring
+        bool dead = false;
+        size_t got = 0;
+        for (unsigned reaped = 0; reaped < submitted; ++reaped) {
+            uring::Ring::Cqe c;
+            if (!rx_ring_->next_cqe(c)) return false;
+            // a short read (EOF/reset) or error fails the conn, matching
+            // recv_all; later chained slices surface as -ECANCELED
+            if (c.res < 0 || static_cast<size_t>(c.res) < segs[c.user_data].len)
+                dead = true;
+            else
+                got += segs[c.user_data].len;
+        }
+        if (dead) return false;
+        done += got;
+        if (submitted < nb) {
+            // slices are posted in stream order, so the unsubmitted tail
+            // starts exactly at `done` — drain it (and the frame) plainly
+            while (done < n) {
+                size_t want = std::min(kRxSlice, n - done);
+                if (!sock_.recv_all(dst + done, want)) return false;
+                done += want;
+            }
+            return true;
+        }
+        if (!*cancelled && done < n) {
+            MutexLock lk(table_->mu_);
+            auto it = table_->sinks_.find(tag);
+            *cancelled = it == table_->sinks_.end() || it->second.cancel;
+        }
+    }
+    return true;
+}
+
 void MultiplexConn::rx_loop() {
     std::vector<uint8_t> scratch;
     while (alive_.load()) {
@@ -1583,20 +1871,39 @@ void MultiplexConn::rx_loop() {
         }
         if (dst) {
             bool ok = true, cancelled = false;
-            size_t done = 0;
-            while (done < n && ok) {
-                size_t want = std::min(kRxSlice, n - done);
-                if (!cancelled) {
-                    ok = sock_.recv_all(dst + done, want);
-                } else {
-                    scratch.resize(want); // drain + drop the rest of the frame
-                    ok = sock_.recv_all(scratch.data(), want);
+            if (uring_on_ && !rx_uring_down_ && n > kRxSlice && !rx_ring_) {
+                rx_ring_ = std::make_unique<uring::Ring>();
+                if (!rx_ring_->init(16)) {
+                    rx_ring_.reset();
+                    rx_uring_down_ = true;
+                    PLOG(kWarn) << "io_uring RX ring setup failed; "
+                                   "falling back to the poll loop";
                 }
-                done += want;
-                if (ok && !cancelled && done < n) {
-                    MutexLock lk(table_->mu_);
-                    auto it = table_->sinks_.find(tag);
-                    cancelled = it == table_->sinks_.end() || it->second.cancel;
+            }
+            if (rx_ring_ && !rx_uring_down_ && n > kRxSlice) {
+                // batched linked RECV slices straight into the sink
+                ok = uring_recv_sink(dst, n, tag, &cancelled);
+                // a mid-frame ring failure latched rx_uring_down_ and
+                // drained its in-flight completions — free the dead ring
+                // (fd + mmaps) instead of carrying it for the conn's life
+                if (rx_uring_down_) rx_ring_.reset();
+            } else {
+                size_t done = 0;
+                while (done < n && ok) {
+                    size_t want = std::min(kRxSlice, n - done);
+                    if (!cancelled) {
+                        ok = sock_.recv_all(dst + done, want);
+                    } else {
+                        scratch.resize(want); // drain + drop rest of the frame
+                        ok = sock_.recv_all(scratch.data(), want);
+                    }
+                    done += want;
+                    if (ok && !cancelled && done < n) {
+                        MutexLock lk(table_->mu_);
+                        auto it = table_->sinks_.find(tag);
+                        cancelled =
+                            it == table_->sinks_.end() || it->second.cancel;
+                    }
                 }
             }
             bool delivered = ok && !cancelled;
@@ -1774,6 +2081,29 @@ std::vector<SendHandle> Link::send_async(uint64_t tag, std::span<const uint8_t> 
                                                      allow_cma));
     }
     return hs;
+}
+
+SendHandle Link::send_at(uint64_t tag, uint64_t off,
+                         std::span<const uint8_t> payload, size_t rot) {
+    std::vector<std::shared_ptr<MultiplexConn>> live;
+    for (const auto &c : conns_)
+        if (c && c->alive()) live.push_back(c);
+    if (live.empty()) {
+        auto st = std::make_shared<SendState>();
+        st->complete(false);
+        return st;
+    }
+    // one stream per window; rotating windows across the pool stripes a
+    // stage over parallel TCP streams. allow_cma=false: a window is a
+    // partial span the fused same-host claim cannot cover.
+    return live[rot % live.size()]->send_async(tag, off, payload,
+                                               /*allow_cma=*/false);
+}
+
+bool Link::cma_eligible() const {
+    for (const auto &c : conns_)
+        if (c && c->alive() && c->cma_eligible()) return true;
+    return false;
 }
 
 SendHandle Link::send_meta(uint64_t tag, std::vector<uint8_t> payload) {

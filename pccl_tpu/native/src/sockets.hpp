@@ -66,6 +66,10 @@ namespace netem {
 class Edge;  // per-remote-endpoint wire emulation model (netem.hpp)
 }
 
+namespace uring {
+class Ring;  // io_uring submission/completion ring (uring.hpp)
+}
+
 class Socket {
 public:
     Socket() = default;
@@ -409,6 +413,19 @@ private:
     bool write_frame(Kind kind, uint64_t tag, uint64_t off,
                      std::span<const uint8_t> payload);
     bool stream_payload(const SendReq &req); // TCP frames of ≤ chunk bytes
+    // io_uring TX: the payload's frames are built (and netem-paced) outside
+    // wr_mu_, then submitted as a chain of LINKED vectored SQEs — header +
+    // payload always leave in one submission, frames ≥ zc_min_ go
+    // SENDMSG_ZC with completion-notification reaping. Falls back to the
+    // plain gathered-write path on any ring setup failure (fallback ladder,
+    // docs/08). Counters/pacing are identical to write_frame's.
+    bool stream_payload_uring(const SendReq &req);
+    // io_uring RX: batched linked MSG_WAITALL RECV slices straight into the
+    // registered sink at `dst`. Returns false on socket death (like
+    // recv_all); *cancelled is set when the sink cancels mid-frame (the
+    // remaining bytes are still drained into dst — the busy refcount keeps
+    // the buffer alive — but must not be marked delivered).
+    bool uring_recv_sink(uint8_t *dst, size_t n, uint64_t tag, bool *cancelled);
     // receiver side: pull `d` into the registered sink via process_vm_readv,
     // update the fill level, and ack/nack on this conn
     void do_cma_fill(uint64_t tag, const SinkTable::PendingDesc &d);
@@ -512,6 +529,21 @@ private:
     size_t tx_chunk_;       // active wire chunk (capped on emulated edges)
     size_t tx_chunk_base_;  // env-configured chunk, pre-cap
     size_t cma_min_;
+
+    // io_uring data plane (uring.hpp): sampled once at construction (env
+    // gate × kernel probe), so a test flipping PCCLT_URING affects the
+    // NEXT connection, mirroring the netem refresh contract. The TX ring
+    // is created and used only under wr_mu_ (an io-rank leaf — blocking
+    // submit/reap under it is the same contract as the blocking sendmsg
+    // it replaces); the RX ring is owned and used by the RX thread alone.
+    // *_down_ flags latch a ring failure so the conn stops retrying and
+    // stays on the poll-loop fallback.
+    bool uring_on_ = false;
+    size_t zc_min_ = 0;  // MSG_ZEROCOPY threshold; 0 = zerocopy off
+    std::unique_ptr<uring::Ring> tx_ring_ PCCLT_GUARDED_BY(wr_mu_);
+    bool tx_uring_down_ PCCLT_GUARDED_BY(wr_mu_) = false;
+    std::unique_ptr<uring::Ring> rx_ring_;  // RX-thread-only
+    bool rx_uring_down_ = false;
 };
 
 // --- Link: striped send view over a pool of conns sharing one SinkTable ---
@@ -532,7 +564,18 @@ public:
     // rotates the starting conn so concurrent ops spread over the pool.
     std::vector<SendHandle> send_async(uint64_t tag, std::span<const uint8_t> payload,
                                        size_t rot = 0, bool allow_cma = true);
+    // Window send for the pipelined data plane (reduce.cpp): one stream of
+    // `payload` landing at byte offset `off` of tag's sink, on the
+    // rot-selected pool conn — successive windows rotate across the pool,
+    // which stripes a stage's windows over parallel TCP streams. CMA is
+    // off by design: a window is a partial-buffer span the fused same-host
+    // descriptor claim cannot cover.
+    SendHandle send_at(uint64_t tag, uint64_t off, std::span<const uint8_t> payload,
+                       size_t rot = 0);
     SendHandle send_meta(uint64_t tag, std::vector<uint8_t> payload);
+    // any live pool conn negotiated the same-host CMA transport (the
+    // pipelined window path steps aside for the fused zero-copy claim)
+    bool cma_eligible() const;
     static bool wait_all(const std::vector<SendHandle> &hs, int timeout_ms = -1);
 
 private:

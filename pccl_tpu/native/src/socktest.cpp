@@ -21,6 +21,8 @@
 #include "protocol.hpp"
 #include "shm.hpp"
 #include "sockets.hpp"
+#include "telemetry.hpp"
+#include "uring.hpp"
 #include "wire.hpp"
 
 using namespace pcclt;
@@ -44,11 +46,14 @@ struct ConnPair {
 };
 
 // Build a connected MultiplexConn pair over loopback. Each side gets its own
-// SinkTable unless shared tables are passed in (pool striping tests). The
+// SinkTable unless shared tables are passed in (pool striping tests), and
+// its own telemetry domain when one is passed (metering tests). The
 // throwaway listener is stopped before returning, so no accept callback can
 // outlive this scope.
 ConnPair make_pair_conns(std::shared_ptr<net::SinkTable> ta = nullptr,
-                         std::shared_ptr<net::SinkTable> tb = nullptr) {
+                         std::shared_ptr<net::SinkTable> tb = nullptr,
+                         std::shared_ptr<pcclt::telemetry::Domain> da = nullptr,
+                         std::shared_ptr<pcclt::telemetry::Domain> db = nullptr) {
     ConnPair p;
     p.ta = ta ? ta : std::make_shared<net::SinkTable>();
     p.tb = tb ? tb : std::make_shared<net::SinkTable>();
@@ -66,8 +71,9 @@ ConnPair make_pair_conns(std::shared_ptr<net::SinkTable> ta = nullptr,
         std::this_thread::sleep_for(std::chrono::milliseconds(2));
     CHECK(accepted->load());
     listener.stop();
-    p.a = std::make_shared<net::MultiplexConn>(std::move(c), p.ta);
-    p.b = std::make_shared<net::MultiplexConn>(std::move(*accepted_sock), p.tb);
+    p.a = std::make_shared<net::MultiplexConn>(std::move(c), p.ta, da);
+    p.b = std::make_shared<net::MultiplexConn>(std::move(*accepted_sock), p.tb,
+                                               db);
     p.ta->attach(p.a);
     p.tb->attach(p.b);
     p.a->run();
@@ -546,6 +552,147 @@ void test_bench_probe() {
     fprintf(stderr, "bench probe: ok\n");
 }
 
+// Conn pair with per-side telemetry domains, so a test can meter exactly
+// what one transfer moved (the shared default domain accumulates across
+// the whole binary).
+struct MeteredPair {
+    ConnPair p;
+    std::shared_ptr<telemetry::Domain> da, db;
+};
+
+MeteredPair make_metered_pair() {
+    MeteredPair m;
+    m.da = std::make_shared<telemetry::Domain>();
+    m.db = std::make_shared<telemetry::Domain>();
+    m.p = make_pair_conns(nullptr, nullptr, m.da, m.db);
+    return m;
+}
+
+struct LegStats {
+    uint64_t tx_bytes = 0, tx_frames = 0, rx_bytes = 0, rx_frames = 0,
+             zc_frames = 0, zc_reaps = 0;
+};
+
+// one A→B transfer of `n` bytes over a fresh metered pair under the
+// CURRENT env (PCCLT_URING / PCCLT_ZEROCOPY_MIN_BYTES / chunk size),
+// returning the per-edge accounting both sides observed
+LegStats run_stream_leg(size_t n, uint64_t tag) {
+    auto m = make_metered_pair();
+    auto data = pattern(n, 0xC0FFEE ^ tag);
+    std::vector<uint8_t> dst(n, 0);
+    m.p.tb->register_sink(tag, dst.data(), n);
+    CHECK(m.p.a->send_bytes(tag, data, /*allow_cma=*/false));
+    CHECK(m.p.tb->wait_filled(tag, n, 10'000) == n);
+    m.p.tb->unregister_sink(tag);
+    CHECK(dst == data);
+    m.p.a->close();
+    m.p.b->close();
+    LegStats out;
+    for (const auto &e : m.da->snapshot_edges()) {
+        out.tx_bytes += e.tx_bytes;
+        out.tx_frames += e.tx_frames;
+        out.zc_frames += e.tx_zc_frames;
+        out.zc_reaps += e.tx_zc_reaps;
+    }
+    for (const auto &e : m.db->snapshot_edges()) {
+        out.rx_bytes += e.rx_bytes;
+        out.rx_frames += e.rx_frames;
+    }
+    return out;
+}
+
+void test_uring_stream_modes() {
+    // The fallback-matrix oracle: the SAME payload, streamed through every
+    // rung of the ladder (uring+zerocopy → uring → poll loop), must land
+    // bit-identical with IDENTICAL per-edge accounting — byte conservation
+    // and frame counts are invariant to the backend, and every frame's
+    // header+payload left as one vectored submission (a header/body split
+    // would double the frame count on the wire).
+    setenv("PCCLT_MULTIPLEX_CHUNK_SIZE", "262144", 1); // 3 MB -> 12 frames
+    const size_t n = 3u << 20;
+    const uint64_t frames = 12;
+
+    setenv("PCCLT_URING", "0", 1);
+    LegStats poll = run_stream_leg(n, 60);
+    CHECK(poll.tx_bytes == n && poll.rx_bytes == n);
+    CHECK(poll.tx_frames == frames && poll.rx_frames == frames);
+    CHECK(poll.zc_frames == 0 && poll.zc_reaps == 0);
+
+    if (net::uring::kernel_level() < 1) {
+        // skip WITH reason, never silently: CI greps for either verdict
+        fprintf(stderr, "uring stream modes: SKIP (io_uring unavailable on "
+                        "this kernel; poll-loop leg verified)\n");
+        unsetenv("PCCLT_MULTIPLEX_CHUNK_SIZE");
+        unsetenv("PCCLT_URING");
+        return;
+    }
+
+    setenv("PCCLT_URING", "1", 1);
+    setenv("PCCLT_ZEROCOPY_MIN_BYTES", "0", 1); // rung: uring, no zerocopy
+    LegStats ur = run_stream_leg(n, 61);
+    CHECK(ur.tx_bytes == n && ur.rx_bytes == n);
+    CHECK(ur.tx_frames == frames && ur.rx_frames == frames);
+    CHECK(ur.zc_frames == 0 && ur.zc_reaps == 0);
+
+    bool zc = net::uring::kernel_level() >= 2;
+    if (zc) {
+        // rung: uring + MSG_ZEROCOPY on every frame; each ZC send must be
+        // reaped exactly once (pages returned) before its handle completed
+        setenv("PCCLT_ZEROCOPY_MIN_BYTES", "1", 1);
+        LegStats z = run_stream_leg(n, 62);
+        CHECK(z.tx_bytes == n && z.rx_bytes == n);
+        CHECK(z.tx_frames == frames && z.rx_frames == frames);
+        CHECK(z.zc_frames == frames);
+        CHECK(z.zc_reaps == z.zc_frames);
+    } else {
+        fprintf(stderr, "uring stream modes: zerocopy rung SKIP (kernel "
+                        "lacks SENDMSG_ZC)\n");
+    }
+    unsetenv("PCCLT_ZEROCOPY_MIN_BYTES");
+    unsetenv("PCCLT_URING");
+    unsetenv("PCCLT_MULTIPLEX_CHUNK_SIZE");
+    fprintf(stderr, "uring stream modes: ok (12 frames each rung%s)\n",
+            zc ? ", zc reaped" : "");
+}
+
+void test_uring_wire_pacing() {
+    // netem must shape the io_uring path identically to the poll loop: the
+    // per-edge egress bucket paces every frame BEFORE submission, so a
+    // batched submit cannot outrun the emulated wire.
+    if (net::uring::kernel_level() < 1) {
+        fprintf(stderr, "uring wire pacing: SKIP (io_uring unavailable on "
+                        "this kernel)\n");
+        return;
+    }
+    setenv("PCCLT_URING", "1", 1);
+    setenv("PCCLT_WIRE_MBPS", "200", 1); // 25 MB/s
+    auto m = make_metered_pair();
+    CHECK(!m.p.a->cma_eligible());
+    const size_t n = 4 * 1024 * 1024;
+    auto data = pattern(n, 77);
+    std::vector<uint8_t> dst(n, 0);
+    m.p.tb->register_sink(70, dst.data(), n);
+    auto t0 = std::chrono::steady_clock::now();
+    CHECK(m.p.a->send_bytes(70, data, /*allow_cma=*/true));
+    CHECK(m.p.tb->wait_filled(70, n, 10'000) == n);
+    double s = std::chrono::duration<double>(
+                   std::chrono::steady_clock::now() - t0).count();
+    m.p.tb->unregister_sink(70);
+    CHECK(dst == data);
+    CHECK(s >= 0.140); // 4 MB at 25 MB/s = 160 ms minimum
+    CHECK(s < 2.0);
+    uint64_t tx = 0, rx = 0;
+    for (const auto &e : m.da->snapshot_edges()) tx += e.tx_bytes;
+    for (const auto &e : m.db->snapshot_edges()) rx += e.rx_bytes;
+    CHECK(tx == n && rx == n); // conservation under emulation + uring
+    m.p.a->close();
+    m.p.b->close();
+    unsetenv("PCCLT_WIRE_MBPS");
+    unsetenv("PCCLT_URING");
+    fprintf(stderr, "uring wire pacing: ok (%.0f ms for 4 MB @ 25 MB/s)\n",
+            s * 1e3);
+}
+
 void test_wire_pacing() {
     // PCCLT_WIRE_MBPS throttles egress to the emulated rate and must defeat
     // the same-host zero-copy transports (a WAN cannot be bypassed). Rate is
@@ -685,6 +832,8 @@ int main() {
     test_mux_death_wakes_waiters();
     test_shm_zero_copy_paths();
     test_link_striping();
+    test_uring_stream_modes();
+    test_uring_wire_pacing();
     test_wire_pacing();
     test_wire_per_edge();
     test_bench_probe();

@@ -63,6 +63,8 @@ std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
         s.tx_frames = e->tx_frames.load(std::memory_order_relaxed);
         s.rx_frames = e->rx_frames.load(std::memory_order_relaxed);
         s.stall_ns = e->stall_ns.load(std::memory_order_relaxed);
+        s.tx_zc_frames = e->tx_zc_frames.load(std::memory_order_relaxed);
+        s.tx_zc_reaps = e->tx_zc_reaps.load(std::memory_order_relaxed);
         out.push_back(std::move(s));
     }
     return out;

@@ -61,6 +61,12 @@ struct EdgeCounters {
     std::atomic<uint64_t> rx_frames{0};
     std::atomic<uint64_t> conns{0};      // connections established on this edge
     std::atomic<uint64_t> stall_ns{0};   // receiver wire-stall charged to this edge
+    // io_uring zerocopy (docs/08 fallback ladder): frames sent SENDMSG_ZC,
+    // and their completion notifications reaped (the kernel released the
+    // pinned pages). Quiescent invariant: tx_zc_reaps == tx_zc_frames —
+    // every ZC send's pages were returned before its handle completed.
+    std::atomic<uint64_t> tx_zc_frames{0};
+    std::atomic<uint64_t> tx_zc_reaps{0};
 };
 
 struct CommCounters {
@@ -84,7 +90,7 @@ struct CommCounters {
 struct EdgeSnapshot {
     std::string endpoint;
     uint64_t tx_bytes = 0, rx_bytes = 0, tx_frames = 0, rx_frames = 0,
-             conns = 0, stall_ns = 0;
+             conns = 0, stall_ns = 0, tx_zc_frames = 0, tx_zc_reaps = 0;
 };
 
 class Domain {

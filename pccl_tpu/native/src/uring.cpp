@@ -1,0 +1,246 @@
+#include "uring.hpp"
+
+#include <atomic>
+#include <cerrno>
+#include <cstdlib>
+#include <cstring>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include "log.hpp"
+
+namespace pcclt::net::uring {
+
+namespace {
+
+// setup/enter/register syscall numbers are identical across the 64-bit
+// ABIs (asm-generic); the distro unistd.h may predate them
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#endif
+#ifndef __NR_io_uring_enter
+#define __NR_io_uring_enter 426
+#endif
+#ifndef __NR_io_uring_register
+#define __NR_io_uring_register 427
+#endif
+
+struct SqOffsets {
+    uint32_t head, tail, ring_mask, ring_entries, flags, dropped, array, resv1;
+    uint64_t user_addr;
+};
+struct CqOffsets {
+    uint32_t head, tail, ring_mask, ring_entries, overflow, cqes, flags, resv1;
+    uint64_t user_addr;
+};
+struct Params {
+    uint32_t sq_entries, cq_entries, flags, sq_thread_cpu, sq_thread_idle,
+        features, wq_fd;
+    uint32_t resv[3];
+    SqOffsets sq_off;
+    CqOffsets cq_off;
+};
+struct CqeRaw {
+    uint64_t user_data;
+    int32_t res;
+    uint32_t flags;
+};
+struct ProbeOp {
+    uint8_t op, resv;
+    uint16_t flags;
+    uint32_t resv2;
+};
+struct ProbeHdr {
+    uint8_t last_op, ops_len;
+    uint16_t resv;
+    uint32_t resv2[3];
+    // ProbeOp ops[] follows
+};
+
+constexpr uint64_t kOffSqRing = 0;
+constexpr uint64_t kOffCqRing = 0x8000000ull;
+constexpr uint64_t kOffSqes = 0x10000000ull;
+constexpr uint32_t kEnterGetevents = 1u;
+constexpr uint32_t kFeatSingleMmap = 1u;
+constexpr unsigned kRegisterProbe = 8;
+constexpr uint16_t kOpSupported = 1u;
+
+int sys_setup(unsigned entries, Params *p) {
+    return static_cast<int>(syscall(__NR_io_uring_setup, entries, p));
+}
+int sys_enter(int fd, unsigned to_submit, unsigned min_complete,
+              unsigned flags) {
+    return static_cast<int>(syscall(__NR_io_uring_enter, fd, to_submit,
+                                    min_complete, flags, nullptr, 0));
+}
+int sys_register(int fd, unsigned opcode, void *arg, unsigned nr_args) {
+    return static_cast<int>(
+        syscall(__NR_io_uring_register, fd, opcode, arg, nr_args));
+}
+
+uint32_t load_acq(const uint32_t *p) {
+    return std::atomic_ref<const uint32_t>(*p).load(std::memory_order_acquire);
+}
+void store_rel(uint32_t *p, uint32_t v) {
+    std::atomic_ref<uint32_t>(*p).store(v, std::memory_order_release);
+}
+
+// IORING_OP_SOCKET landed in 5.19 — the same release as the MSG_WAITALL
+// retry semantics for send/recv that the batched backend depends on — so
+// its presence in the opcode probe is the version gate: anything older
+// (incl. pre-5.6 kernels whose REGISTER_PROBE itself fails) stays on the
+// poll loop rather than having routine short reads kill connections.
+constexpr uint8_t kOpSocket = 45;
+
+int probe_kernel() {
+    Params p{};
+    int fd = sys_setup(4, &p);
+    if (fd < 0) return 0;  // ENOSYS / EPERM / io_uring_disabled sysctl
+    alignas(8) uint8_t buf[sizeof(ProbeHdr) + 256 * sizeof(ProbeOp)] = {};
+    auto *hdr = reinterpret_cast<ProbeHdr *>(buf);
+    auto *ops = reinterpret_cast<ProbeOp *>(buf + sizeof(ProbeHdr));
+    int level = 0;
+    if (sys_register(fd, kRegisterProbe, buf, 256) == 0 &&
+        hdr->last_op >= kOpSocket &&
+        (ops[kOpSendmsg].flags & kOpSupported) &&
+        (ops[kOpRecv].flags & kOpSupported) &&
+        (ops[kOpSocket].flags & kOpSupported)) {
+        level = 1;
+        if (hdr->last_op >= kOpSendmsgZc &&
+            (ops[kOpSendmsgZc].flags & kOpSupported))
+            level = 2;
+    }
+    close(fd);
+    return level;
+}
+
+}  // namespace
+
+int kernel_level() {
+    static const int level = probe_kernel();
+    return level;
+}
+
+bool enabled() {
+    const char *e = std::getenv("PCCLT_URING");
+    if (e && e[0] == '0') return false;
+    return kernel_level() >= 1;
+}
+
+size_t zc_min_bytes() {
+    if (kernel_level() < 2) return 0;
+    if (const char *e = std::getenv("PCCLT_ZEROCOPY_MIN_BYTES")) {
+        long long v = atoll(e);
+        return v <= 0 ? 0 : static_cast<size_t>(v);
+    }
+    return 1u << 20;
+}
+
+Ring::~Ring() { unmap(); }
+
+void Ring::unmap() {
+    if (sqes_) munmap(sqes_, sqes_sz_);
+    if (sq_ring_) munmap(sq_ring_, sq_ring_sz_);
+    if (cq_ring_ && !single_mmap_) munmap(cq_ring_, cq_ring_sz_);
+    sqes_ = nullptr;
+    sq_ring_ = cq_ring_ = nullptr;
+    if (ring_fd_ >= 0) close(ring_fd_);
+    ring_fd_ = -1;
+}
+
+bool Ring::init(unsigned entries) {
+    Params p{};
+    int fd = sys_setup(entries, &p);
+    if (fd < 0) return false;
+    ring_fd_ = fd;
+    sq_entries_ = p.sq_entries;
+    cq_entries_ = p.cq_entries;
+    single_mmap_ = (p.features & kFeatSingleMmap) != 0;
+    sq_ring_sz_ = p.sq_off.array + p.sq_entries * sizeof(uint32_t);
+    cq_ring_sz_ = p.cq_off.cqes + p.cq_entries * sizeof(CqeRaw);
+    size_t sq_map = single_mmap_ ? std::max(sq_ring_sz_, cq_ring_sz_)
+                                 : sq_ring_sz_;
+    void *sq = mmap(nullptr, sq_map, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, kOffSqRing);
+    if (sq == MAP_FAILED) {
+        unmap();
+        return false;
+    }
+    sq_ring_ = static_cast<uint8_t *>(sq);
+    sq_ring_sz_ = sq_map;
+    if (single_mmap_) {
+        cq_ring_ = sq_ring_;
+    } else {
+        void *cq = mmap(nullptr, cq_ring_sz_, PROT_READ | PROT_WRITE,
+                        MAP_SHARED | MAP_POPULATE, fd, kOffCqRing);
+        if (cq == MAP_FAILED) {
+            unmap();
+            return false;
+        }
+        cq_ring_ = static_cast<uint8_t *>(cq);
+    }
+    sqes_sz_ = p.sq_entries * sizeof(Sqe);
+    void *sqes = mmap(nullptr, sqes_sz_, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd, kOffSqes);
+    if (sqes == MAP_FAILED) {
+        unmap();
+        return false;
+    }
+    sqes_ = static_cast<Sqe *>(sqes);
+    sq_khead_ = reinterpret_cast<uint32_t *>(sq_ring_ + p.sq_off.head);
+    sq_ktail_ = reinterpret_cast<uint32_t *>(sq_ring_ + p.sq_off.tail);
+    sq_mask_ = *reinterpret_cast<uint32_t *>(sq_ring_ + p.sq_off.ring_mask);
+    sq_array_ = reinterpret_cast<uint32_t *>(sq_ring_ + p.sq_off.array);
+    cq_khead_ = reinterpret_cast<uint32_t *>(cq_ring_ + p.cq_off.head);
+    cq_ktail_ = reinterpret_cast<uint32_t *>(cq_ring_ + p.cq_off.tail);
+    cq_mask_ = *reinterpret_cast<uint32_t *>(cq_ring_ + p.cq_off.ring_mask);
+    cqes_ = cq_ring_ + p.cq_off.cqes;
+    sqe_tail_ = *sq_ktail_;
+    return true;
+}
+
+Sqe *Ring::get_sqe() {
+    uint32_t head = load_acq(sq_khead_);
+    if (sqe_tail_ - head >= sq_entries_) return nullptr;
+    Sqe *s = &sqes_[sqe_tail_ & sq_mask_];
+    *s = Sqe{};
+    sq_array_[sqe_tail_ & sq_mask_] = sqe_tail_ & sq_mask_;
+    ++sqe_tail_;
+    return s;
+}
+
+int Ring::submit() {
+    uint32_t ktail = *sq_ktail_;
+    unsigned to_submit = sqe_tail_ - ktail;
+    if (to_submit == 0) return 0;
+    store_rel(sq_ktail_, sqe_tail_);
+    while (true) {
+        int r = sys_enter(ring_fd_, to_submit, 0, 0);
+        if (r >= 0) return r;
+        if (errno == EINTR) continue;
+        return -errno;
+    }
+}
+
+bool Ring::next_cqe(Cqe &out) {
+    while (true) {
+        uint32_t head = *cq_khead_;
+        uint32_t tail = load_acq(cq_ktail_);
+        if (head != tail) {
+            const auto *c = reinterpret_cast<const CqeRaw *>(
+                cqes_ + (head & cq_mask_) * sizeof(CqeRaw));
+            out = {c->user_data, c->res, c->flags};
+            store_rel(cq_khead_, head + 1);
+            return true;
+        }
+        int r = sys_enter(ring_fd_, 0, 1, kEnterGetevents);
+        if (r < 0 && errno != EINTR && errno != EAGAIN && errno != EBUSY) {
+            PLOG(kError) << "io_uring_enter(GETEVENTS) failed: "
+                         << strerror(errno);
+            return false;
+        }
+    }
+}
+
+}  // namespace pcclt::net::uring

@@ -1,0 +1,133 @@
+// Minimal raw-syscall io_uring backend for the WAN data plane.
+//
+// Why not liburing: the build must not grow dependencies, and the distro
+// header on the build hosts predates SEND_ZC — so the (stable, versioned)
+// kernel ABI is declared here directly and everything goes through
+// syscall(2). Scope is deliberately tiny: one submission/completion ring
+// per user, batched linked SQEs, no SQPOLL, no registered buffers.
+//
+// Fallback ladder (docs/08_performance.md):
+//   level 2: io_uring + MSG_ZEROCOPY  (IORING_OP_SENDMSG_ZC, kernel >= 6.1)
+//   level 1: io_uring                 (batched SENDMSG/RECV, kernel >= 5.19
+//                                      for MSG_WAITALL retry semantics)
+//   level 0: the classic poll + sendmsg/recv loop in sockets.cpp
+//
+// kernel_level() probes once per process (io_uring_setup + opcode probe);
+// enabled() additionally consults PCCLT_URING on every call so tests can
+// flip the env at runtime (0 = force the poll loop, 1/unset = use io_uring
+// when the kernel has it). Zerocopy is gated by PCCLT_ZEROCOPY_MIN_BYTES
+// (0 disables; frames below the threshold are cheaper to copy than to pin).
+//
+// Threading: a Ring is NOT thread-safe — each user owns one (the conn TX
+// ring is only touched under wr_mu_, the RX ring only on the RX thread),
+// so the backend itself needs no locks.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pcclt::net::uring {
+
+// ---- kernel ABI (linux/io_uring.h, stable) ----
+
+struct Sqe {
+    uint8_t opcode = 0;
+    uint8_t flags = 0;
+    uint16_t ioprio = 0;
+    int32_t fd = -1;
+    uint64_t off = 0;
+    uint64_t addr = 0;   // buffer (RECV) or struct msghdr * (SENDMSG[_ZC])
+    uint32_t len = 0;    // buffer length (RECV) or 1 (SENDMSG[_ZC])
+    uint32_t msg_flags = 0;
+    uint64_t user_data = 0;
+    uint16_t buf_index = 0;
+    uint16_t personality = 0;
+    int32_t splice_fd_in = 0;
+    uint64_t addr3 = 0;
+    uint64_t pad2 = 0;
+};
+static_assert(sizeof(Sqe) == 64, "io_uring_sqe ABI");
+
+inline constexpr uint8_t kOpSendmsg = 9;
+inline constexpr uint8_t kOpSend = 26;
+inline constexpr uint8_t kOpRecv = 27;
+inline constexpr uint8_t kOpSendmsgZc = 48;
+inline constexpr uint8_t kSqeIoLink = 1u << 2;   // IOSQE_IO_LINK
+inline constexpr uint32_t kCqeFMore = 1u << 1;   // IORING_CQE_F_MORE
+inline constexpr uint32_t kCqeFNotif = 1u << 3;  // IORING_CQE_F_NOTIF
+
+// ---- feature detection ----
+
+// 0 = no usable io_uring; 1 = batched SENDMSG/RECV; 2 = + SENDMSG_ZC.
+// Probed once per process (setup + IORING_REGISTER_PROBE) — the result is
+// a kernel property and cannot change at runtime.
+int kernel_level();
+
+// PCCLT_URING env gate over kernel_level(): "0" forces level 0; anything
+// else (incl. unset) uses what the kernel has. Read per call — conns
+// sample it at construction, so tests flip behavior per connection.
+bool enabled();
+
+// Zerocopy threshold in bytes: 0 = zerocopy off (also when the kernel
+// lacks SENDMSG_ZC). Default 1 MiB — below that, pinning pages +
+// completion reaping costs more than one copy into the socket buffer.
+size_t zc_min_bytes();
+
+// ---- one submission/completion ring ----
+
+class Ring {
+public:
+    Ring() = default;
+    ~Ring();
+    Ring(const Ring &) = delete;
+    Ring &operator=(const Ring &) = delete;
+
+    // mmap the rings; false → caller takes the poll-loop fallback
+    bool init(unsigned entries);
+    bool valid() const { return ring_fd_ >= 0; }
+
+    // Next free SQE (zeroed), or nullptr when the SQ is full (callers size
+    // batches under `entries`, so null is a programming-error guard, not a
+    // flow-control mechanism).
+    Sqe *get_sqe();
+
+    // A prepared-but-unsubmitted SQE, counting back from the local tail
+    // (back == 1 → most recently prepared). Lets a caller set link flags
+    // once the batch's final size is known — nothing is visible to the
+    // kernel until submit() publishes the tail.
+    Sqe *sqe_at_tail(unsigned back) {
+        return &sqes_[(sqe_tail_ - back) & sq_mask_];
+    }
+
+    // Publish all prepared SQEs in ONE io_uring_enter (the batched-
+    // submission point). Returns number consumed, or -errno.
+    int submit();
+
+    struct Cqe {
+        uint64_t user_data = 0;
+        int32_t res = 0;
+        uint32_t flags = 0;
+    };
+    // Block until a completion is available and pop it. false on a hard
+    // ring error (caller fails the stream like any socket error).
+    bool next_cqe(Cqe &out);
+
+private:
+    void unmap();
+
+    int ring_fd_ = -1;
+    unsigned sq_entries_ = 0, cq_entries_ = 0;
+    uint32_t sq_mask_ = 0, cq_mask_ = 0;
+    // local SQE cursor (kernel tail published at submit())
+    uint32_t sqe_tail_ = 0;
+    uint8_t *sq_ring_ = nullptr, *cq_ring_ = nullptr;
+    size_t sq_ring_sz_ = 0, cq_ring_sz_ = 0;
+    bool single_mmap_ = false;
+    Sqe *sqes_ = nullptr;
+    size_t sqes_sz_ = 0;
+    uint32_t *sq_khead_ = nullptr, *sq_ktail_ = nullptr, *sq_array_ = nullptr;
+    uint32_t *cq_khead_ = nullptr, *cq_ktail_ = nullptr;
+    uint8_t *cqes_ = nullptr;  // io_uring_cqe[] (16 bytes each)
+};
+
+}  // namespace pcclt::net::uring

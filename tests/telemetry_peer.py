@@ -62,8 +62,10 @@ def main() -> int:
 
     prof = Profiler()
     x = np.full(args.count, float(args.rank + 1), dtype=np.float32)
+    t0 = time.perf_counter()
     with prof.section("py/all_reduce"):
         comm.all_reduce(x, op=ReduceOp.SUM, tag=0)
+    elapsed = time.perf_counter() - t0
     expect = args.world * (args.world + 1) / 2
     if float(x[0]) != expect or float(x[-1]) != expect:
         print(json.dumps({"rank": args.rank,
@@ -73,7 +75,8 @@ def main() -> int:
     stats = comm.stats()
     if args.trace_out:
         prof.export_chrome_trace(args.trace_out, native_events=trace_events())
-    print(json.dumps({"rank": args.rank, "stats": stats}), flush=True)
+    print(json.dumps({"rank": args.rank, "stats": stats,
+                      "elapsed_s": elapsed}), flush=True)
     comm.destroy()
     return 0
 

@@ -28,7 +28,14 @@ pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
 from conftest import alloc_ports
 
 
-def test_edge_conservation_and_merged_trace(tmp_path):
+@pytest.mark.parametrize("plane", [
+    # the fallback matrix (docs/08 ladder): the windowed pipeline + io_uring
+    # backend forced ON, and forced OFF (uring unavailable → poll loop +
+    # un-windowed stages). Byte conservation must hold EXACTLY on both.
+    pytest.param({"PCCLT_PIPELINE": "1", "PCCLT_URING": "1"}, id="pipelined"),
+    pytest.param({"PCCLT_PIPELINE": "0", "PCCLT_URING": "0"}, id="poll-loop"),
+])
+def test_edge_conservation_and_merged_trace(tmp_path, plane):
     """The acceptance scenario: a wire_topology-emulated 4-peer all-reduce.
 
     Per-edge counters must conserve bytes exactly:
@@ -57,7 +64,8 @@ def test_edge_conservation_and_merged_trace(tmp_path):
                 cmd = [sys.executable, str(REPO / "tests" / "telemetry_peer.py"),
                        "--master-port", str(master.port), "--rank", str(r),
                        "--world", str(world), "--port-base", str(port_base),
-                       "--count", str(count), "--env", json.dumps(envs[r])]
+                       "--count", str(count),
+                       "--env", json.dumps({**envs[r], **plane})]
                 if r == 0:
                     cmd += ["--trace-out", str(trace_path)]
                 procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
@@ -123,6 +131,56 @@ def test_edge_conservation_and_merged_trace(tmp_path):
     py = next(e for e in events if e["name"] == "py/all_reduce")
     nat = next(e for e in events if e["name"] == "allreduce")
     assert py["ts"] <= nat["ts"] <= py["ts"] + py["dur"] + 1e3
+
+
+def test_netem_pacing_on_pipelined_path():
+    """The pipelined io_uring data plane must honor per-edge
+    PCCLT_WIRE_*_MAP pacing exactly like the poll loop: a 2-peer ring over
+    a 100 Mbit/s emulated mesh cannot beat the wire (each peer moves
+    2*(n-1)/n * payload = 4 MiB of egress at 12.5 MB/s → ≥ ~0.33 s), and
+    the per-edge counters still conserve bytes exactly."""
+    from pccl_tpu.comm import MasterNode
+    from pccl_tpu.comm.native_bench import wire_topology
+
+    world, count = 2, 1 << 20  # 4 MiB payload
+    plane = {"PCCLT_PIPELINE": "1", "PCCLT_URING": "1",
+             # small window floor so the pipeline actually windows the
+             # 2 MiB stage chunks
+             "PCCLT_PIPELINE_MIN_BYTES": str(256 << 10)}
+    port_base = alloc_ports(span=2300)
+    master = MasterNode("0.0.0.0", alloc_ports())
+    master.run()
+    procs = []
+    try:
+        with wire_topology(world, port_base, mbps=100.0) as envs:
+            for r in range(world):
+                cmd = [sys.executable, str(REPO / "tests" / "telemetry_peer.py"),
+                       "--master-port", str(master.port), "--rank", str(r),
+                       "--world", str(world), "--port-base", str(port_base),
+                       "--count", str(count),
+                       "--env", json.dumps({**envs[r], **plane})]
+                procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                              stderr=subprocess.STDOUT,
+                                              text=True))
+            outs = [p.communicate(timeout=180)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        master.interrupt()
+        master.destroy()
+    nbytes = count * 4
+    expected = 2 * (world - 1) * nbytes // world
+    for out in outs:
+        r = json.loads(out.strip().splitlines()[-1])
+        assert "error" not in r, out[-2000:]
+        edges = r["stats"]["edges"]
+        assert sum(e["tx_bytes"] for e in edges.values()) == expected
+        assert sum(e["rx_bytes"] for e in edges.values()) == expected
+        # the emulated wire's floor: 4 MiB egress at 12.5 MB/s. Anything
+        # meaningfully under it means the new path bypassed the pacer.
+        assert r["elapsed_s"] >= 0.28, \
+            f"pipelined path outran the emulated wire: {r['elapsed_s']:.3f}s"
 
 
 def _run_peers(master_port, world, worker, base):
