@@ -209,6 +209,16 @@ def main() -> None:
             print(f"bench: topology opt failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["topology_opt_speedup"] = None
+        # the observability plane's cost, pinned in history: loopback step
+        # time with digest pushes + trace capture ON vs OFF (docs/09's
+        # <= 1% bound; counters are always on in both legs)
+        try:
+            for k, v in native_bench.run_telemetry_overhead_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: telemetry overhead failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["telemetry_overhead_pct"] = None
 
     # On-chip model legs: the jitted bf16 train step on the real TPU —
     # tokens/s + MFU per family (skip-guarded when no TPU is attached;

@@ -46,7 +46,8 @@ class MasterProc:
 
     _instance = 0
 
-    def __init__(self, port: int, journal: str | None = None):
+    def __init__(self, port: int, journal: str | None = None,
+                 metrics_port: int | None = None):
         self.port = port
         import os
         MasterProc._instance += 1
@@ -56,6 +57,8 @@ class MasterProc:
         cmd = [sys.executable, "-m", "pccl_tpu.comm.master", "--port", str(port)]
         if journal:
             cmd += ["--journal", journal]
+        if metrics_port is not None:
+            cmd += ["--metrics-port", str(metrics_port)]
         self.proc = subprocess.Popen(
             cmd, cwd=str(REPO), stdout=out, stderr=subprocess.STDOUT)
         deadline = time.time() + 15
@@ -145,9 +148,22 @@ def main() -> int:
     ap.add_argument("--stall-seconds", type=float, default=120.0,
                     help="fail if NO peer makes progress for this long "
                          "(reference uses 5 minutes)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="observability plane: master serves /metrics + "
+                         "/health here, peers push telemetry digests, and "
+                         "the exit summary prints the fleet health view "
+                         "(docs/09_observability.md)")
+    ap.add_argument("--telemetry-push-ms", type=int, default=250,
+                    help="digest cadence for the peers when --metrics-port "
+                         "is set")
     args = ap.parse_args()
 
-    master = MasterProc(args.master_port, args.journal)
+    if args.metrics_port is not None:
+        # peers inherit the cadence; the master flag rides the CLI
+        import os
+        os.environ["PCCLT_TELEMETRY_PUSH_MS"] = str(args.telemetry_push_ms)
+
+    master = MasterProc(args.master_port, args.journal, args.metrics_port)
     peers: list[Peer] = []
     seed = 1
     total_relaunches = 0
@@ -188,7 +204,8 @@ def main() -> int:
                 t_kill = time.time()
                 master.kill()
                 time.sleep(args.master_down_time)
-                master = MasterProc(args.master_port, args.journal)
+                master = MasterProc(args.master_port, args.journal,
+                                    args.metrics_port)
                 down = time.time() - t_kill
                 master_downtime_s.append(down)
                 print(f"master restarted (downtime {down:.2f}s)", flush=True)
@@ -229,6 +246,28 @@ def main() -> int:
         print(f"recovery mix: {resumes} session resumes, {rejoins} full "
               f"rejoins (journal={'on' if args.journal else 'off'})",
               flush=True)
+        if args.metrics_port is not None:
+            # fleet-health exit summary: one line an operator (or the CI
+            # lane's grep) can eyeball — what the MASTER thinks the world
+            # looked like when the soak ended
+            try:
+                import json
+                import urllib.request
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{args.metrics_port}/health",
+                        timeout=5) as r:
+                    h = json.load(r)
+                up = sum(1 for p in h["peers"] if p["up"])
+                stragglers = sum(1 for e in h["edges"] if e["straggler"])
+                print(f"FLEET HEALTH: epoch={h['epoch']} "
+                      f"world={h['world_size']} peers_up={up}/"
+                      f"{len(h['peers'])} digests={h['telemetry_digests']} "
+                      f"stragglers={stragglers}", flush=True)
+            except (OSError, ValueError, KeyError) as e:
+                # the summary is informational: a malformed /health body
+                # must not fail a soak that already passed
+                print(f"FLEET HEALTH: scrape failed "
+                      f"({type(e).__name__}: {e})", flush=True)
         print(f"SOAK PASSED: {total} heartbeat steps, "
               f"{total_relaunches} relaunches, "
               f"{master_restarts} master restarts in {args.duration:.0f}s",

@@ -135,6 +135,10 @@ class CommStats(ctypes.Structure):
         ("peers_left", ctypes.c_uint64),
         ("master_reconnects", ctypes.c_uint64),
         ("p2p_conns_reused", ctypes.c_uint64),
+        # observability plane: digests pushed to the master, and
+        # flight-recorder events lost to ring wrap (process-global)
+        ("telemetry_digests", ctypes.c_uint64),
+        ("trace_ring_dropped", ctypes.c_uint64),
     ]
 
 
@@ -175,6 +179,17 @@ def _declare(lib):
                                             P(c.c_void_p)]
         lib.pccltMasterEpoch.restype = c.c_uint64
         lib.pccltMasterEpoch.argtypes = [c.c_void_p]
+    except AttributeError:
+        pass
+
+    # observability plane: metrics/health endpoint mirror (same older-build
+    # tolerance as the HA surface above)
+    try:
+        lib.pccltMasterMetricsPort.restype = c.c_uint16
+        lib.pccltMasterMetricsPort.argtypes = [c.c_void_p]
+        lib.pccltMasterGetHealth.restype = c.c_int
+        lib.pccltMasterGetHealth.argtypes = [c.c_void_p, c.c_char_p,
+                                             c.c_uint64, P(c.c_uint64)]
     except AttributeError:
         pass
 

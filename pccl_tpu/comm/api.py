@@ -297,6 +297,45 @@ class MasterNode:
             return 0
         return int(self._lib.pccltMasterEpoch(self._h))
 
+    @property
+    def metrics_port(self) -> int:
+        """Bound port of the plain-HTTP ``/metrics`` (Prometheus text) +
+        ``/health`` (JSON) endpoint — enabled by the
+        ``PCCLT_MASTER_METRICS_PORT`` env var (``"0"`` = kernel-assigned,
+        read the real port here). 0 while disabled or before run()."""
+        if not hasattr(self._lib, "pccltMasterMetricsPort"):
+            return 0
+        return int(self._lib.pccltMasterMetricsPort(self._h))
+
+    def health(self) -> dict:
+        """The master's fleet health model as a dict (the ``/health`` JSON:
+        epoch, world/client/limbo counts, per-peer digest freshness and
+        per-edge EWMA throughput/stall with straggler flags). Works with
+        the HTTP endpoint disabled — this reads the native state directly.
+        Peers appear once they push telemetry digests
+        (``PCCLT_TELEMETRY_PUSH_MS``); see docs/09_observability.md."""
+        import json
+
+        if not hasattr(self._lib, "pccltMasterGetHealth"):
+            raise PcclError(Result.INVALID_USAGE,
+                            "this libpcclt.so predates the observability "
+                            "plane (pccltMasterGetHealth); rebuild")
+        need = ctypes.c_uint64()
+        _check(self._lib.pccltMasterGetHealth(self._h, None, 0,
+                                              ctypes.byref(need)), "health")
+        # size-then-fetch can race live digests growing the document: the
+        # copy call re-reports the true length, so retry until it fits
+        for _ in range(8):
+            cap = need.value + 256  # slack absorbs small growth in one trip
+            buf = ctypes.create_string_buffer(cap)
+            _check(self._lib.pccltMasterGetHealth(self._h, buf, cap,
+                                                  ctypes.byref(need)),
+                   "health")
+            if need.value < cap:
+                return json.loads(buf.value.decode())
+        raise PcclError(Result.INTERNAL_ERROR,
+                        "health document kept outgrowing its buffer")
+
     def interrupt(self) -> None:
         _check(self._lib.pccltInterruptMaster(self._h))
 

@@ -15,6 +15,7 @@ re-registering (docs/10_high_availability.md).
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 
@@ -29,12 +30,21 @@ def main() -> int:
                     help="HA journal path (restart on the same journal = "
                          "resume the world, not reset it); default: the "
                          "PCCLT_MASTER_JOURNAL env var, else disabled")
+    ap.add_argument("--metrics-port", default=None, metavar="PORT",
+                    help="serve plain-HTTP /metrics (Prometheus) + /health "
+                         "(JSON) on this port (0 = kernel-assigned); "
+                         "default: the PCCLT_MASTER_METRICS_PORT env var, "
+                         "else disabled (docs/09_observability.md)")
     args = ap.parse_args()
 
+    if args.metrics_port is not None:
+        # the native core reads the env at pccltRunMaster
+        os.environ["PCCLT_MASTER_METRICS_PORT"] = str(args.metrics_port)
     m = MasterNode(args.listen, args.port, journal_path=args.journal)
     m.run()
-    print(f"master listening on {args.listen}:{m.port} (epoch {m.epoch})",
-          flush=True)
+    extra = f", metrics on :{m.metrics_port}" if m.metrics_port else ""
+    print(f"master listening on {args.listen}:{m.port} (epoch {m.epoch}"
+          f"{extra})", flush=True)
 
     # sigwait instead of a signal handler: a handler would never run while
     # the main thread is blocked inside the foreign await_termination call

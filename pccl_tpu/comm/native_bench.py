@@ -972,6 +972,70 @@ def run_master_recovery_bench(world: int = 3, n_steps: int = 60,
     }
 
 
+def _peer_tele_overhead(rank, master_port, q, nbytes, iters, port_base):
+    """One loopback peer of the telemetry-overhead A/B: the observability
+    plane's state (digest push cadence + trace capture) is inherited via
+    env from the orchestrating leg."""
+    from pccl_tpu.comm.api import ReduceOp, trace_clear, trace_enable
+
+    plane_on = os.environ.get("PCCLT_TELEMETRY_PUSH_MS", "0") != "0"
+    env_capture = bool(os.environ.get("PCCLT_TRACE"))
+    if plane_on:
+        trace_enable(True)
+    comm = _connect(rank, master_port, 2, port_base)
+    count = nbytes // 4
+    x = np.full(count, float(rank + 1), dtype=np.float32)
+    y = np.empty_like(x)
+    comm.all_reduce(x, y, op=ReduceOp.SUM)  # warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        comm.all_reduce(x, y, op=ReduceOp.SUM)
+        times.append(time.perf_counter() - t0)
+    assert float(y[0]) == 3.0
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+    if plane_on and not env_capture:
+        trace_enable(False)
+        trace_clear()  # rank 0 runs inline: later legs start clean
+
+
+def run_telemetry_overhead_bench(nbytes: int = 8 << 20,
+                                 iters: int = 12) -> Dict[str, float]:
+    """The observability plane's cost, pinned (docs/09): median loopback
+    2-peer all-reduce step time with the full plane ON (100 ms digest
+    cadence + flight-recorder capture) vs OFF. Returns the step medians
+    and ``telemetry_overhead_pct`` — the acceptance bound is <= 1%, noise
+    floor included (counters are always on in BOTH legs; the A/B isolates
+    the digest thread + event capture)."""
+    def leg(plane_on: bool) -> float:
+        # pin the cadence explicitly for BOTH legs (and restore whatever
+        # the caller had): an inherited PCCLT_TELEMETRY_PUSH_MS would
+        # silently turn the OFF leg on and zero the A/B
+        prior = os.environ.get("PCCLT_TELEMETRY_PUSH_MS")
+        os.environ["PCCLT_TELEMETRY_PUSH_MS"] = "100" if plane_on else "0"
+        try:
+            res = _spawn_world(
+                2, _peer_tele_overhead,
+                _port("PCCLT_BENCH_MASTER_PORT_OBS", 48721),
+                (nbytes, iters, 43900))
+        finally:
+            if prior is None:
+                os.environ.pop("PCCLT_TELEMETRY_PUSH_MS", None)
+            else:
+                os.environ["PCCLT_TELEMETRY_PUSH_MS"] = prior
+        r0 = next(r for r in res if r["rank"] == 0)
+        ts = sorted(r0["times"])
+        return ts[(len(ts) - 1) // 2]
+    t_off = leg(False)
+    t_on = leg(True)
+    return {
+        "telemetry_off_step_s": t_off,
+        "telemetry_on_step_s": t_on,
+        "telemetry_overhead_pct": 100.0 * (t_on - t_off) / t_off,
+    }
+
+
 def _peer_hier(rank, master_port, q, elems, iters, quantize, port_base):
     """One emulated TPU slice (4 virtual CPU devices) of the hierarchical
     all-reduce: ICI staging on the slice mesh, the native ring across

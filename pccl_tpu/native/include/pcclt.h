@@ -186,6 +186,20 @@ PCCLT_EXPORT pccltResult_t pccltMasterAwaitTermination(pccltMaster_t *m);
 PCCLT_EXPORT pccltResult_t pccltDestroyMaster(pccltMaster_t *m);
 PCCLT_EXPORT uint16_t pccltMasterPort(pccltMaster_t *m); /* bound port */
 
+/* Observability plane (docs/09_observability.md). When the
+ * PCCLT_MASTER_METRICS_PORT env var is set, pccltRunMaster also serves
+ * plain HTTP on that port ("0" = kernel-assigned, query it here):
+ * GET /metrics -> Prometheus text format, GET /health -> fleet health
+ * JSON. Returns 0 while disabled or before pccltRunMaster. */
+PCCLT_EXPORT uint16_t pccltMasterMetricsPort(pccltMaster_t *m);
+
+/* Copy the master's current fleet-health JSON (the /health payload) into
+ * buf (NUL-terminated, at most cap bytes) and store the full length
+ * (excluding the NUL) into *need — call with cap=0 to size the buffer.
+ * Valid after pccltRunMaster; works with the HTTP endpoint disabled. */
+PCCLT_EXPORT pccltResult_t pccltMasterGetHealth(pccltMaster_t *m, char *buf,
+                                                uint64_t cap, uint64_t *need);
+
 PCCLT_EXPORT pccltResult_t pccltCreateCommunicator(const pccltCommCreateParams_t *params,
                                                    pccltComm_t **out);
 PCCLT_EXPORT pccltResult_t pccltDestroyCommunicator(pccltComm_t *c);
@@ -292,6 +306,13 @@ typedef struct pccltCommStats_t {
     /* master HA */
     uint64_t master_reconnects; /* control sessions resumed after a restart */
     uint64_t p2p_conns_reused;  /* p2p conns kept alive across topology rounds */
+    /* observability plane (docs/09) */
+    uint64_t telemetry_digests;   /* digests pushed to the master (off unless
+                                   * PCCLT_TELEMETRY_PUSH_MS sets a cadence) */
+    uint64_t trace_ring_dropped;  /* flight-recorder events lost to ring wrap
+                                   * since the last clear (process-global): a
+                                   * nonzero value means PCCLT_TRACE dumps are
+                                   * silently truncated to the newest 64k */
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {

@@ -131,6 +131,23 @@ pccltResult_t pccltDestroyMaster(pccltMaster_t *m) {
 
 uint16_t pccltMasterPort(pccltMaster_t *m) { return m ? m->master->port() : 0; }
 
+uint16_t pccltMasterMetricsPort(pccltMaster_t *m) {
+    return m ? m->master->metrics_port() : 0;
+}
+
+pccltResult_t pccltMasterGetHealth(pccltMaster_t *m, char *buf, uint64_t cap,
+                                   uint64_t *need) {
+    if (!m || !need || (cap && !buf)) return pccltInvalidArgument;
+    std::string j = m->master->health_json();
+    *need = j.size();
+    if (cap) {
+        uint64_t n = j.size() < cap - 1 ? j.size() : cap - 1;
+        memcpy(buf, j.data(), n);
+        buf[n] = 0;
+    }
+    return pccltSuccess;
+}
+
 // ---------------- communicator ----------------
 
 pccltResult_t pccltCreateCommunicator(const pccltCommCreateParams_t *params,
@@ -386,6 +403,10 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     out->peers_left = ld(m.peers_left);
     out->master_reconnects = ld(m.master_reconnects);
     out->p2p_conns_reused = ld(m.p2p_conns_reused);
+    out->telemetry_digests = ld(m.telemetry_digests);
+    // process-global ring accounting (the recorder is shared by every comm
+    // in the process): nonzero = traces are truncated to the newest 64k
+    out->trace_ring_dropped = pcclt::telemetry::Recorder::inst().dropped();
     return pccltSuccess;
 }
 

@@ -249,7 +249,9 @@ Status Client::connect() {
         // so an older master still welcomes us
         try {
             r.str();
-            master_epoch_.store(r.u64(), std::memory_order_relaxed);
+            uint64_t ep = r.u64();
+            master_epoch_.store(ep, std::memory_order_relaxed);
+            telemetry::Recorder::inst().set_epoch(ep);
         } catch (...) {}
     } catch (...) { return Status::kInternal; }
     connected_ = true;
@@ -260,13 +262,55 @@ Status Client::connect() {
         connected_ = false;
         return st;
     }
+    // fleet observability plane (docs/09): periodic digest pushes to the
+    // master. Off unless PCCLT_TELEMETRY_PUSH_MS gives a cadence.
+    int push_ms = env_int("PCCLT_TELEMETRY_PUSH_MS", 0);
+    if (push_ms > 0) {
+        tele_stop_ = false;
+        tele_thread_ = std::thread([this, push_ms] {
+            telemetry_push_loop(push_ms);
+        });
+    }
     PLOG(kInfo) << "connected as " << proto::uuid_str(uuid_) << ", group world "
                 << group_world();
     return Status::kOk;
 }
 
+void Client::telemetry_push_loop(int push_ms) {
+    telemetry::DigestSnapshotter snap(tele_);
+    // sleep in short slices so disconnect() joins promptly even on a
+    // multi-second cadence
+    const auto slice = std::chrono::milliseconds(20);
+    auto next = std::chrono::steady_clock::now() +
+                std::chrono::milliseconds(push_ms);
+    while (!tele_stop_.load(std::memory_order_relaxed)) {
+        if (std::chrono::steady_clock::now() < next) {
+            std::this_thread::sleep_for(slice);
+            continue;
+        }
+        next += std::chrono::milliseconds(push_ms);
+        auto d = snap.snapshot();
+        proto::TelemetryDigestC2M pkt;
+        pkt.epoch = master_epoch_.load(std::memory_order_relaxed);
+        pkt.last_seq = d.last_seq;
+        pkt.interval_ms = d.interval_ns / 1'000'000;
+        pkt.ring_dropped = d.ring_dropped;
+        pkt.collectives_ok = d.collectives_ok;
+        for (auto &e : d.edges)
+            pkt.edges.push_back({e.endpoint, e.tx_mbps, e.rx_mbps,
+                                 e.stall_ratio, e.tx_bytes, e.rx_bytes});
+        for (auto &o : d.ops) pkt.ops.push_back({o.seq, o.dur_ns, o.stall_ns});
+        // fire and forget: a down master link is the resume path's problem,
+        // not ours — the next digest after a resume carries fresh rates
+        if (master_.send(PacketType::kC2MTelemetryDigest, pkt.encode()))
+            tele_->comm.telemetry_digests.fetch_add(1,
+                                                    std::memory_order_relaxed);
+    }
+}
+
 void Client::disconnect() {
     connected_ = false; // unparks an in-flight resume loop promptly
+    tele_stop_ = true;  // telemetry push thread drains within a sleep slice
     std::unique_ptr<util::WorkerPool> pool;
     {
         MutexLock lk(ops_mu_);
@@ -278,6 +322,14 @@ void Client::disconnect() {
         pool = std::move(op_pool_); // taken under the admission lock
     }
     pool.reset(); // joins the pooled worker threads (they never take ops_mu_)
+    // Join the push thread BEFORE master_.close() tears the socket down
+    // (a send racing the fd teardown is UB) but AFTER shutting the wire:
+    // a digest send stuck in a blocking ::send against a master that
+    // stopped reading (wedged process, black-holed link) would otherwise
+    // hold the join for the kernel TCP timeout. Ops are already drained,
+    // so nothing else needs the control conn.
+    master_.shutdown_wire();
+    if (tele_thread_.joinable()) tele_thread_.join();
     {
         // serialize against resume_master_session's reconnect of master_
         MutexLock lk(resume_mu_);
@@ -404,6 +456,7 @@ Status Client::resume_master_session() {
             return Status::kMasterUnreachable;
         }
         master_epoch_.store(ack->epoch, std::memory_order_relaxed);
+        telemetry::Recorder::inst().set_epoch(ack->epoch);
         // the master's journaled group revision may be AHEAD of what we saw
         // complete (its Done to us was lost in the crash); adopt the max so
         // the app can skip re-syncing an already-completed revision
@@ -1108,6 +1161,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         ctx.quant = desc.quant;
         ctx.q_dtype = desc.quant_dtype;
         ctx.backup = snapshot.empty() ? nullptr : snapshot.data();
+        ctx.tele = tele_.get();
         {
             // receiver wire-stall is charged to the inbound edge: the ring
             // predecessor's canonical endpoint (the netem/telemetry key)

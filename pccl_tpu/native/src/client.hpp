@@ -210,6 +210,13 @@ private:
     // waits until at least one inbound conn from `peer` is up
     net::Link rx_link(const proto::Uuid &peer, int timeout_ms);
 
+    // Telemetry push loop (fleet observability plane, docs/09): every
+    // `push_ms` fold the Domain counters into a DigestSnapshotter digest
+    // and fire-and-forget it to the master over the control connection.
+    // Runs on its own thread while connected; PCCLT_TELEMETRY_PUSH_MS=0 /
+    // unset disables (connect never spawns the thread).
+    void telemetry_push_loop(int push_ms);
+
     ClientConfig cfg_;
     proto::Uuid uuid_{};
     std::atomic<bool> connected_{false};
@@ -230,6 +237,10 @@ private:
     std::atomic<uint64_t> session_gen_{0};
     std::shared_ptr<telemetry::Domain> tele_ =
         std::make_shared<telemetry::Domain>();
+    // telemetry push thread (spawned by connect when PCCLT_TELEMETRY_PUSH_MS
+    // > 0; stopped+joined by disconnect before the control conn closes)
+    std::thread tele_thread_;
+    std::atomic<bool> tele_stop_{false};
 
     net::ControlClient master_;
     net::Listener p2p_listener_, ss_listener_, bench_listener_;

@@ -1,6 +1,12 @@
 #include "master.hpp"
 
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
 #include "log.hpp"
+#include "telemetry.hpp"
 
 namespace pcclt::master {
 
@@ -26,8 +32,29 @@ bool Master::launch() {
         state_.attach_journal(&journal_);
     }
     port_ = listener_.port();
+    // trace correlation: stamp this incarnation's epoch into every event
+    // the (possibly in-process) recorder captures from here on
+    telemetry::Recorder::inst().set_epoch(state_.epoch());
     running_ = true;
     dispatcher_ = std::thread([this] { dispatcher_loop(); });
+
+    // observability plane egress: plain-HTTP /metrics + /health when
+    // PCCLT_MASTER_METRICS_PORT is set ("0" = kernel-assigned ephemeral
+    // port, reported by metrics_port(); unset/empty = disabled)
+    if (const char *mp = std::getenv("PCCLT_MASTER_METRICS_PORT");
+        mp && mp[0]) {
+        int want = std::atoi(mp);
+        if (want >= 0 && want <= 65535 &&
+            metrics_listener_.listen(static_cast<uint16_t>(want), 1)) {
+            metrics_port_ = metrics_listener_.port();
+            metrics_listener_.run_async([this](net::Socket sock) {
+                serve_metrics_conn(std::move(sock));
+            });
+            PLOG(kInfo) << "metrics/health endpoint on port " << metrics_port_;
+        } else {
+            PLOG(kWarn) << "metrics endpoint disabled: cannot bind port " << mp;
+        }
+    }
 
     listener_.run_async([this](net::Socket sock) {
         // the reader handle must be assigned BEFORE any event from this conn
@@ -62,6 +89,59 @@ bool Master::launch() {
     });
     PLOG(kInfo) << "master listening on port " << port_;
     return true;
+}
+
+void Master::serve_metrics_conn(net::Socket sock) {
+    // Minimal HTTP/1.0-style exchange, served inline on the accept thread:
+    // read the request head (bounded, 2 s), answer one GET, close. The
+    // render methods read only the health_mu_-published snapshot, so a
+    // scrape never touches (or waits on) the dispatcher's state machine.
+    char req[2048];
+    size_t got = 0;
+    // overall wall-clock deadline, not just per-recv: a client trickling
+    // one byte per recv timeout would otherwise hold the accept thread
+    // (and Master::interrupt's listener join) for the whole head buffer
+    const auto head_deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(3);
+    while (got < sizeof req - 1 &&
+           std::chrono::steady_clock::now() < head_deadline) {
+        ssize_t n = sock.recv_some(req + got, sizeof req - 1 - got, 1000);
+        if (n <= 0) break;
+        got += static_cast<size_t>(n);
+        req[got] = 0;
+        if (strstr(req, "\r\n\r\n") || strstr(req, "\n\n")) break;
+    }
+    req[got] = 0;
+    std::string path = "/";
+    if (strncmp(req, "GET ", 4) == 0) {
+        const char *p = req + 4;
+        const char *e = strchr(p, ' ');
+        if (e) path.assign(p, e);
+    }
+    std::string body;
+    const char *ctype = "text/plain; charset=utf-8";
+    const char *status = "200 OK";
+    if (path == "/metrics") {
+        // Prometheus text exposition format 0.0.4
+        body = state_.render_metrics();
+        ctype = "text/plain; version=0.0.4; charset=utf-8";
+    } else if (path == "/health" || path == "/health.json") {
+        body = state_.render_health_json();
+        ctype = "application/json";
+    } else if (path == "/") {
+        body = "pcclt master: /metrics (prometheus), /health (json)\n";
+    } else {
+        status = "404 Not Found";
+        body = "not found\n";
+    }
+    char head[256];
+    int hn = snprintf(head, sizeof head,
+                      "HTTP/1.1 %s\r\nContent-Type: %s\r\n"
+                      "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                      status, ctype, body.size());
+    if (hn > 0 && sock.send_all(head, static_cast<size_t>(hn)))
+        sock.send_all(body.data(), body.size());
+    sock.close();
 }
 
 void Master::push_event(Event ev) {
@@ -215,6 +295,11 @@ void Master::dispatcher_loop() {
                 case PacketType::kC2MOptimizeWorkDone:
                     out = state_.on_optimize_work_done(ev.conn_id);
                     break;
+                case PacketType::kC2MTelemetryDigest: {
+                    auto d = proto::TelemetryDigestC2M::decode(p);
+                    if (d) out = state_.on_telemetry_digest(ev.conn_id, *d);
+                    break;
+                }
                 default:
                     PLOG(kWarn) << "master: unknown packet type 0x" << std::hex
                                 << ev.frame.type;
@@ -231,6 +316,7 @@ void Master::dispatcher_loop() {
 void Master::interrupt() {
     if (!running_.exchange(false)) return;
     listener_.stop();
+    metrics_listener_.stop();
     {
         MutexLock lk(conns_mu_);
         for (auto &[_, c] : conns_) c->sock.shutdown();

@@ -39,6 +39,11 @@ public:
     void join();
     uint16_t port() const { return port_; }
     uint64_t epoch() const { return state_.epoch(); }
+    // observability plane: bound metrics/health HTTP port (0 = disabled —
+    // PCCLT_MASTER_METRICS_PORT unset), and the /health JSON on demand
+    // (pccltMasterGetHealth / MasterNode.health() read it without HTTP)
+    uint16_t metrics_port() const { return metrics_port_; }
+    std::string health_json() const { return state_.render_health_json(); }
 
 private:
     struct Conn {
@@ -56,11 +61,17 @@ private:
     void dispatcher_loop();
     void push_event(Event ev);
     void apply_outbox(const std::vector<Outbox> &out);
+    // one plain-HTTP exchange on the metrics listener's accept thread:
+    // GET /metrics (Prometheus text) | /health (JSON). Short timeouts —
+    // a stalled scraper must not wedge the accept loop for long.
+    void serve_metrics_conn(net::Socket sock);
 
     uint16_t port_;
     std::string journal_path_;
     journal::Journal journal_;
     net::Listener listener_;
+    net::Listener metrics_listener_;
+    uint16_t metrics_port_ = 0;
     MasterState state_;
     ThreadGuard state_guard_;
     Mutex conns_mu_; // lock-rank: 30

@@ -1,6 +1,8 @@
 #include "master_state.hpp"
 
 #include <algorithm>
+#include <cinttypes>
+#include <cstdio>
 #include <cstdlib>
 
 #include "atsp.hpp"
@@ -195,6 +197,7 @@ std::vector<Outbox> MasterState::on_session_resume(uint64_t conn,
     ack.ok = 1;
     ack.last_revision = g.last_revision;
     clients_[conn] = c;
+    ++membership_gen_;
     journal_client(c);
     PLOG(kInfo) << "session resumed: " << proto::uuid_str(c.uuid) << " group "
                 << c.peer_group << " (" << limbo_.size() << " still in limbo)";
@@ -209,6 +212,9 @@ std::vector<Outbox> MasterState::on_session_resume(uint64_t conn,
 
 std::vector<Outbox> MasterState::on_tick() {
     std::vector<Outbox> out;
+    // keep the published health summary fresh even while no digests flow
+    // (membership changes between digests must show up in /health promptly)
+    publish_health_summary();
     if (limbo_.empty()) return out;
     auto now = std::chrono::steady_clock::now();
     std::vector<Uuid> expired;
@@ -258,6 +264,7 @@ std::vector<Outbox> MasterState::on_hello(uint64_t conn, const net::Addr &src_ip
         if (auto a = net::Addr::parse(h.adv_ip, 0)) c.ip = *a;
     }
     clients_[conn] = c;
+    ++membership_gen_;
     PLOG(kInfo) << "client " << proto::uuid_str(c.uuid) << " joined (pending), group "
                 << c.peer_group << ", world=" << world_size();
     telemetry::Recorder::inst().instant("membership", "master_join_pending",
@@ -1102,6 +1109,458 @@ std::vector<Outbox> MasterState::on_optimize_work_done(uint64_t conn) {
     return out;
 }
 
+// ---------- fleet health (observability plane, docs/09) ----------
+
+namespace {
+
+double straggler_fraction() {
+    static const double v = [] {
+        if (const char *e = std::getenv("PCCLT_STRAGGLER_FRACTION")) {
+            double f = std::atof(e);
+            if (f > 0 && f < 1) return f;
+        }
+        return 0.5;
+    }();
+    return v;
+}
+
+bool straggler_reopt_enabled() {
+    static const bool v = [] {
+        const char *e = std::getenv("PCCLT_STRAGGLER_REOPT");
+        return e && e[0] == '1';
+    }();
+    return v;
+}
+
+// edges quieter than this carry no meaningful throughput sample — an idle
+// edge must never read as "degraded"
+constexpr double kMinActiveMbps = 0.05;
+
+// the receiver must have spent at least this fraction of the interval
+// BLOCKED on the edge for its throughput to count as a capacity sample:
+// achieved rate only witnesses degradation when the wire (not compute or
+// a light duty cycle) is pacing the run — without this gate any healthy
+// link carrying sparse traffic would read as a straggler, and with
+// PCCLT_STRAGGLER_REOPT=1 its load-limited rate would corrupt the matrix
+constexpr double kMinStallRatio = 0.15;
+
+} // namespace
+
+void MasterState::publish_health_summary() {
+    const size_t w = world_size();
+    const size_t nc = clients_.size();
+    const size_t nl = limbo_.size();
+    const uint64_t now = telemetry::now_ns();
+    MutexLock lk(health_mu_);
+    health_world_ = w;
+    health_clients_ = nc;
+    health_limbo_ = nl;
+    // Retention: departed entries stay visible for post-mortems but must
+    // not accumulate forever under peer churn (every relaunch is a fresh
+    // uuid). Sweep every ~5 s of ticks; evict departed peers idle past the
+    // horizon — or past a hard cap, oldest first — plus their edges.
+    constexpr uint64_t kRetainNs = 10ull * 60 * 1'000'000'000;  // 10 min
+    constexpr size_t kMaxPeers = 4096;
+    if (++health_sweep_tick_ % 50 != 0) return;
+    std::vector<std::string> evict;
+    for (const auto &[uuid, p] : fleet_peers_)
+        if (p.departed && now - p.last_digest_ns > kRetainNs)
+            evict.push_back(uuid);
+    if (fleet_peers_.size() - evict.size() > kMaxPeers) {
+        std::vector<std::pair<uint64_t, std::string>> departed;
+        for (const auto &[uuid, p] : fleet_peers_)
+            if (p.departed && now - p.last_digest_ns <= kRetainNs)
+                departed.emplace_back(p.last_digest_ns, uuid);
+        std::sort(departed.begin(), departed.end());
+        for (const auto &[_, uuid] : departed) {
+            if (fleet_peers_.size() - evict.size() <= kMaxPeers) break;
+            evict.push_back(uuid);
+        }
+    }
+    for (const auto &uuid : evict) {
+        fleet_peers_.erase(uuid);
+        for (auto it = fleet_edges_.begin(); it != fleet_edges_.end();)
+            it = it->first.first == uuid ? fleet_edges_.erase(it) : ++it;
+    }
+}
+
+std::vector<Outbox> MasterState::on_telemetry_digest(
+    uint64_t conn, const proto::TelemetryDigestC2M &d) {
+    std::vector<Outbox> out; // fire-and-forget: never replies
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    const std::string from = proto::uuid_str(c->uuid);
+    const uint64_t now = telemetry::now_ns();
+
+    // Resolve each digest edge's endpoint to a peer + its bandwidth-matrix
+    // entry OUTSIDE health_mu_: clients_/bandwidth_ are dispatcher-only
+    // state, and the lock ranks (health 36 > moon 34) forbid acting on the
+    // consensus machine while holding the health lock anyway.
+    struct Resolved {
+        const proto::TelemetryDigestC2M::Edge *e;
+        std::string to_uuid;
+        Uuid to_raw{};
+        double expected_mbps = 0;
+    };
+    // endpoint->client index, rebuilt only when membership changed since
+    // the last digest — a per-digest rebuild (let alone a per-edge scan)
+    // would put O(world) string builds on the dispatcher thread, which
+    // also runs consensus, for every push in the fleet
+    if (endpoint_index_gen_ != membership_gen_) {
+        endpoint_index_.clear();
+        for (auto &[cid, cc] : clients_) {
+            net::Addr a = cc.ip;
+            a.port = cc.p2p_port;
+            endpoint_index_.emplace(a.str(), cid);
+        }
+        endpoint_index_gen_ = membership_gen_;
+    }
+    std::vector<Resolved> resolved;
+    resolved.reserve(d.edges.size());
+    for (const auto &e : d.edges) {
+        Resolved r;
+        r.e = &e;
+        auto it = endpoint_index_.find(e.endpoint);
+        if (it != endpoint_index_.end()) {
+            if (auto cit = clients_.find(it->second); cit != clients_.end()) {
+                r.to_uuid = proto::uuid_str(cit->second.uuid);
+                r.to_raw = cit->second.uuid;
+                // the straggler verdict judges the INBOUND direction
+                // (remote -> reporter): the reporter's wire-stall on this
+                // edge is the degradation witness, so the matrix entry to
+                // compare against is remote->reporter too
+                if (auto bw = bandwidth_.get(cit->second.uuid, c->uuid))
+                    r.expected_mbps = *bw;
+            }
+        }
+        resolved.push_back(std::move(r));
+    }
+
+    // fold into the fleet model; collect straggler TRANSITIONS (edges newly
+    // below threshold) to act on after the lock drops
+    struct Flagged {
+        std::string endpoint, to_uuid;
+        Uuid to_raw{};
+        double measured = 0, expected = 0;
+    };
+    std::vector<Flagged> newly_flagged;
+    {
+        MutexLock lk(health_mu_);
+        ++digests_total_;
+        auto &p = fleet_peers_[from];
+        p.uuid = from;
+        p.group = c->peer_group;
+        p.last_seq = d.last_seq;
+        p.ring_dropped = d.ring_dropped;
+        p.collectives_ok = d.collectives_ok;
+        ++p.digests;
+        p.last_digest_ns = now;
+        p.departed = false;
+        for (const auto &r : resolved) {
+            auto &eh = fleet_edges_[{from, r.e->endpoint}];
+            eh.from_uuid = from;
+            eh.to_endpoint = r.e->endpoint;
+            eh.to_uuid = r.to_uuid;
+            eh.tx_mbps = r.e->tx_mbps;
+            eh.rx_mbps = r.e->rx_mbps;
+            eh.stall_ratio = r.e->stall_ratio;
+            eh.tx_bytes = r.e->tx_bytes;
+            eh.rx_bytes = r.e->rx_bytes;
+            eh.expected_mbps = r.expected_mbps;
+            // Degradation witness = the RECEIVER's wire-stall: achieved
+            // ingress rate only samples link capacity while the receiver
+            // is blocked on the wire (stall gate). Without it, any healthy
+            // edge under a light duty cycle would read as a straggler and
+            // (under REOPT) corrupt the matrix with a load-limited rate.
+            const bool active = r.e->rx_mbps >= kMinActiveMbps;
+            if (!eh.straggler) {
+                const bool degraded =
+                    active && r.expected_mbps > 0 &&
+                    r.e->stall_ratio >= kMinStallRatio &&
+                    r.e->rx_mbps < straggler_fraction() * r.expected_mbps;
+                if (degraded) {
+                    eh.straggler = true;
+                    eh.flag_baseline_mbps = r.expected_mbps;
+                    ++stragglers_flagged_;
+                    newly_flagged.push_back({r.e->endpoint, r.to_uuid,
+                                             r.to_raw, r.e->rx_mbps,
+                                             r.expected_mbps});
+                }
+            } else if (active) {
+                // recovery is judged against the baseline captured when
+                // the flag went up — the REOPT hook rewrites the matrix
+                // with the degraded rate, and measuring against THAT
+                // would self-clear the flag mid-incident. An idle edge
+                // keeps its verdict: no sample, no change.
+                const double base = eh.flag_baseline_mbps > 0
+                                        ? eh.flag_baseline_mbps
+                                        : r.expected_mbps;
+                if (r.e->rx_mbps >= straggler_fraction() * base) {
+                    eh.straggler = false;
+                    eh.flag_baseline_mbps = 0;
+                }
+            }
+        }
+    }
+    publish_health_summary();
+
+    for (const auto &f : newly_flagged) {
+        PLOG(kWarn) << "straggler edge flagged: " << f.endpoint << " -> "
+                    << from << " measured " << f.measured
+                    << " Mbit/s vs matrix " << f.expected
+                    << " Mbit/s (receiver wire-stall witnessed)";
+        telemetry::Recorder::inst().instant(
+            "fleet", "master_straggler", "measured_mbps",
+            static_cast<uint64_t>(f.measured), "expected_mbps",
+            static_cast<uint64_t>(f.expected), telemetry::intern(f.endpoint));
+        if (straggler_reopt_enabled() && !f.to_uuid.empty()) {
+            // telemetry-refreshed matrix: the measured (degraded) rate
+            // replaces the stale probe value — in the witnessed direction,
+            // remote -> reporter — so the background ATSP pass actually
+            // routes around the slow hop; the next optimize round adopts
+            // the improved ring (check_optimize moonshot path)
+            bandwidth_.store(f.to_raw, c->uuid, f.measured);
+            if (journal_) journal_->record_bandwidth(f.to_raw, c->uuid, f.measured);
+            request_straggler_reopt(c->peer_group);
+        }
+    }
+    return out;
+}
+
+void MasterState::request_straggler_reopt(uint32_t gid) {
+    auto members = group_members(gid);
+    if (members.size() < 3) return; // a 2-ring has no alternative route
+    std::vector<Uuid> m_uuids;
+    for (auto *m : members) m_uuids.push_back(m->uuid);
+    const size_t n = m_uuids.size();
+    std::vector<double> cost(n * n, 0.0);
+    for (size_t i = 0; i < n; ++i)
+        for (size_t j = 0; j < n; ++j) {
+            if (i == j) continue;
+            auto bw = bandwidth_.get(m_uuids[i], m_uuids[j]);
+            cost[i * n + j] = bw && *bw > 0 ? 1000.0 / *bw : 1e9;
+        }
+    // seed from the current ring so the improvement starts at the adopted
+    // tour; membership drift since the last round falls back to identity
+    std::vector<int> tour;
+    const auto &ring = groups_[gid].ring;
+    if (ring.size() == n) {
+        for (const auto &u : ring) {
+            auto it = std::find(m_uuids.begin(), m_uuids.end(), u);
+            if (it == m_uuids.end()) {
+                tour.clear();
+                break;
+            }
+            tour.push_back(static_cast<int>(it - m_uuids.begin()));
+        }
+    }
+    if (tour.size() != n) {
+        tour.resize(n);
+        for (size_t i = 0; i < n; ++i) tour[i] = static_cast<int>(i);
+    }
+    PLOG(kInfo) << "straggler re-opt requested for group " << gid
+                << " (background moonshot over the refreshed matrix)";
+    telemetry::Recorder::inst().instant("fleet", "master_straggler_reopt",
+                                        "group", gid);
+    spawn_moonshot(gid, std::move(m_uuids), std::move(cost), std::move(tour));
+}
+
+namespace {
+
+void json_str(std::string &o, const std::string &s) {
+    o += '"';
+    for (char ch : s) {
+        if (ch == '"' || ch == '\\') {
+            o += '\\';
+            o += ch;
+        } else if (static_cast<unsigned char>(ch) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", ch);
+            o += buf;
+        } else {
+            o += ch;
+        }
+    }
+    o += '"';
+}
+
+std::string num(double v) {
+    char buf[32];
+    snprintf(buf, sizeof buf, "%.6g", v);
+    return buf;
+}
+
+std::string num(uint64_t v) {
+    char buf[24];
+    snprintf(buf, sizeof buf, "%" PRIu64, v);
+    return buf;
+}
+
+} // namespace
+
+std::string MasterState::render_metrics() const {
+    const uint64_t now = telemetry::now_ns();
+    std::string o;
+    o.reserve(4096);
+    auto gauge = [&](const char *name, const char *help) {
+        o += "# HELP ";
+        o += name;
+        o += ' ';
+        o += help;
+        o += "\n# TYPE ";
+        o += name;
+        o += " gauge\n";
+    };
+    auto counter = [&](const char *name, const char *help) {
+        o += "# HELP ";
+        o += name;
+        o += ' ';
+        o += help;
+        o += "\n# TYPE ";
+        o += name;
+        o += " counter\n";
+    };
+    // copy the model out under a SHORT critical section, render outside:
+    // the dispatcher takes health_mu_ on every digest/tick, and a large
+    // fleet's exposition is thousands of heap-allocating appends — string
+    // building under the lock would stall consensus for the whole scrape
+    std::map<std::string, PeerHealth> fleet_peers_copy;
+    std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
+    uint64_t digests_total_copy, stragglers_copy;
+    size_t world_copy, clients_copy, limbo_copy;
+    {
+        MutexLock lk(health_mu_);
+        fleet_peers_copy = fleet_peers_;
+        fleet_edges_copy = fleet_edges_;
+        digests_total_copy = digests_total_;
+        stragglers_copy = stragglers_flagged_;
+        world_copy = health_world_;
+        clients_copy = health_clients_;
+        limbo_copy = health_limbo_;
+    }
+    gauge("pcclt_master_epoch", "master incarnation (bumped per journaled restart)");
+    o += "pcclt_master_epoch " + num(epoch_) + "\n";
+    gauge("pcclt_master_world_size", "accepted clients across all groups");
+    o += "pcclt_master_world_size " + num(static_cast<uint64_t>(world_copy)) + "\n";
+    gauge("pcclt_master_clients", "connected control sessions");
+    o += "pcclt_master_clients " + num(static_cast<uint64_t>(clients_copy)) + "\n";
+    gauge("pcclt_master_limbo_sessions", "rehydrated sessions awaiting resume");
+    o += "pcclt_master_limbo_sessions " + num(static_cast<uint64_t>(limbo_copy)) + "\n";
+    counter("pcclt_master_telemetry_digests_total", "telemetry digests received");
+    o += "pcclt_master_telemetry_digests_total " + num(digests_total_copy) + "\n";
+    counter("pcclt_master_stragglers_flagged_total",
+            "straggler edge flag transitions");
+    o += "pcclt_master_stragglers_flagged_total " + num(stragglers_copy) + "\n";
+
+    counter("pcclt_peer_collectives_ok_total", "collectives completed ok, per peer");
+    gauge("pcclt_peer_last_seq", "newest collective seq the peer completed");
+    gauge("pcclt_peer_trace_ring_dropped",
+          "peer flight-recorder events lost to ring wrap");
+    gauge("pcclt_peer_staleness_ms", "ms since the peer's last digest");
+    gauge("pcclt_peer_up", "1 while the peer's control session is live");
+    for (const auto &[uuid, p] : fleet_peers_copy) {
+        std::string lbl = "{peer=\"" + uuid + "\",group=\"" +
+                          num(static_cast<uint64_t>(p.group)) + "\"} ";
+        o += "pcclt_peer_collectives_ok_total" + lbl + num(p.collectives_ok) + "\n";
+        o += "pcclt_peer_last_seq" + lbl + num(p.last_seq) + "\n";
+        o += "pcclt_peer_trace_ring_dropped" + lbl + num(p.ring_dropped) + "\n";
+        o += "pcclt_peer_staleness_ms" + lbl +
+             num((now - p.last_digest_ns) / 1'000'000) + "\n";
+        o += "pcclt_peer_up" + lbl + (p.departed ? "0" : "1");
+        o += "\n";
+    }
+
+    gauge("pcclt_edge_tx_mbps", "EWMA achieved egress per edge, Mbit/s");
+    gauge("pcclt_edge_rx_mbps", "EWMA achieved ingress per edge, Mbit/s");
+    gauge("pcclt_edge_stall_ratio", "EWMA receiver wire-stall per interval");
+    counter("pcclt_edge_tx_bytes_total", "cumulative payload bytes sent on the edge");
+    counter("pcclt_edge_rx_bytes_total", "cumulative payload bytes received on the edge");
+    gauge("pcclt_edge_expected_mbps", "bandwidth-matrix entry for the edge");
+    gauge("pcclt_edge_straggler",
+          "1 while measured throughput sits below the straggler threshold");
+    for (const auto &[key, e] : fleet_edges_copy) {
+        std::string lbl = "{from=\"" + e.from_uuid + "\",to=\"" + e.to_endpoint +
+                          "\",to_peer=\"" + e.to_uuid + "\"} ";
+        o += "pcclt_edge_tx_mbps" + lbl + num(e.tx_mbps) + "\n";
+        o += "pcclt_edge_rx_mbps" + lbl + num(e.rx_mbps) + "\n";
+        o += "pcclt_edge_stall_ratio" + lbl + num(e.stall_ratio) + "\n";
+        o += "pcclt_edge_tx_bytes_total" + lbl + num(e.tx_bytes) + "\n";
+        o += "pcclt_edge_rx_bytes_total" + lbl + num(e.rx_bytes) + "\n";
+        o += "pcclt_edge_expected_mbps" + lbl + num(e.expected_mbps) + "\n";
+        o += "pcclt_edge_straggler" + lbl + (e.straggler ? "1" : "0");
+        o += "\n";
+    }
+    return o;
+}
+
+std::string MasterState::render_health_json() const {
+    const uint64_t now = telemetry::now_ns();
+    std::string o;
+    o.reserve(2048);
+    // copy-then-render, as in render_metrics: never build strings while
+    // holding the lock the dispatcher needs per digest/tick
+    std::map<std::string, PeerHealth> fleet_peers_copy;
+    std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
+    uint64_t digests_total_copy, stragglers_copy;
+    size_t world_copy, clients_copy, limbo_copy;
+    {
+        MutexLock lk(health_mu_);
+        fleet_peers_copy = fleet_peers_;
+        fleet_edges_copy = fleet_edges_;
+        digests_total_copy = digests_total_;
+        stragglers_copy = stragglers_flagged_;
+        world_copy = health_world_;
+        clients_copy = health_clients_;
+        limbo_copy = health_limbo_;
+    }
+    o += "{\"epoch\":" + num(epoch_);
+    o += ",\"world_size\":" + num(static_cast<uint64_t>(world_copy));
+    o += ",\"clients\":" + num(static_cast<uint64_t>(clients_copy));
+    o += ",\"limbo_sessions\":" + num(static_cast<uint64_t>(limbo_copy));
+    o += ",\"telemetry_digests\":" + num(digests_total_copy);
+    o += ",\"stragglers_flagged\":" + num(stragglers_copy);
+    o += ",\"peers\":[";
+    bool first = true;
+    for (const auto &[uuid, p] : fleet_peers_copy) {
+        if (!first) o += ',';
+        first = false;
+        o += "{\"uuid\":";
+        json_str(o, uuid);
+        o += ",\"group\":" + num(static_cast<uint64_t>(p.group));
+        o += ",\"last_seq\":" + num(p.last_seq);
+        o += ",\"collectives_ok\":" + num(p.collectives_ok);
+        o += ",\"ring_dropped\":" + num(p.ring_dropped);
+        o += ",\"digests\":" + num(p.digests);
+        o += ",\"staleness_ms\":" + num((now - p.last_digest_ns) / 1'000'000);
+        o += ",\"up\":";
+        o += p.departed ? "false" : "true";
+        o += '}';
+    }
+    o += "],\"edges\":[";
+    first = true;
+    for (const auto &[key, e] : fleet_edges_copy) {
+        if (!first) o += ',';
+        first = false;
+        o += "{\"from\":";
+        json_str(o, e.from_uuid);
+        o += ",\"to\":";
+        json_str(o, e.to_endpoint);
+        o += ",\"to_peer\":";
+        json_str(o, e.to_uuid);
+        o += ",\"tx_mbps\":" + num(e.tx_mbps);
+        o += ",\"rx_mbps\":" + num(e.rx_mbps);
+        o += ",\"stall_ratio\":" + num(e.stall_ratio);
+        o += ",\"tx_bytes\":" + num(e.tx_bytes);
+        o += ",\"rx_bytes\":" + num(e.rx_bytes);
+        o += ",\"expected_mbps\":" + num(e.expected_mbps);
+        o += ",\"straggler\":";
+        o += e.straggler ? "true" : "false";
+        o += '}';
+    }
+    o += "]}";
+    return o;
+}
+
 // ---------- disconnect recovery ----------
 
 std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
@@ -1110,6 +1569,7 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
     if (it == clients_.end()) return out;
     ClientInfo gone = it->second;
     clients_.erase(it);
+    ++membership_gen_;
     if (journal_) journal_->record_client_remove(gone.uuid);
     PLOG(kInfo) << "client " << proto::uuid_str(gone.uuid) << " disconnected, world="
                 << world_size();
@@ -1124,6 +1584,14 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
 // of clients_/limbo_ — prune its traces and re-check every consensus
 void MasterState::remove_client(std::vector<Outbox> &out, const ClientInfo &gone) {
     bandwidth_.forget(gone.uuid);
+    {
+        // fleet health: keep the record for post-mortems, mark it down
+        // (pcclt_peer_up 0; the next digest after a session resume revives)
+        MutexLock lk(health_mu_);
+        auto fit = fleet_peers_.find(proto::uuid_str(gone.uuid));
+        if (fit != fleet_peers_.end()) fit->second.departed = true;
+    }
+    publish_health_summary();
 
     // abort running collectives in its group, prune its votes from ops
     abort_group_collectives(out, gone.peer_group);

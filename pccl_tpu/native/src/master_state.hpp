@@ -78,6 +78,38 @@ struct CollectiveOp {
     std::set<Uuid> completed;
 };
 
+// ---- fleet health model (observability plane, docs/09) ----
+// Soft state folded from kC2MTelemetryDigest pushes. Lives behind its own
+// mutex (NOT dispatcher-only like the consensus machine) because the
+// metrics/health HTTP threads read it concurrently; the dispatcher is the
+// only writer. Deliberately unjournaled: rates are meaningless across a
+// restart — a restarted master rebuilds the picture from the next digests.
+
+struct PeerHealth {
+    std::string uuid;   // uuid_str form (label-friendly)
+    uint32_t group = 0;
+    uint64_t last_seq = 0;       // newest collective seq the peer completed
+    uint64_t ring_dropped = 0;   // its flight-recorder events lost to wrap
+    uint64_t collectives_ok = 0;
+    uint64_t digests = 0;        // digests received from this peer
+    uint64_t last_digest_ns = 0; // telemetry clock at the last digest
+    bool departed = false;       // disconnected (entry kept for post-mortems)
+};
+
+struct EdgeHealth {
+    std::string from_uuid;    // reporting peer
+    std::string to_endpoint;  // canonical remote endpoint ("ip:port")
+    std::string to_uuid;      // resolved target peer ("" = unknown endpoint)
+    double tx_mbps = 0, rx_mbps = 0, stall_ratio = 0;  // peer EWMAs
+    uint64_t tx_bytes = 0, rx_bytes = 0;               // cumulative
+    double expected_mbps = 0;  // bandwidth-matrix entry (0 = unmeasured)
+    bool straggler = false;    // measured below the straggler threshold
+    // matrix entry captured when the flag went up: recovery is judged
+    // against THIS, not the live matrix — the REOPT hook rewrites the
+    // matrix with the degraded rate, which must not self-clear the flag
+    double flag_baseline_mbps = 0;
+};
+
 struct GroupState {
     bool revision_initialized = false;
     uint64_t last_revision = 0;                 // last completed sync revision
@@ -121,7 +153,17 @@ public:
     std::vector<Outbox> on_optimize(uint64_t conn);
     std::vector<Outbox> on_bandwidth_report(uint64_t conn, const Uuid &to, double mbps);
     std::vector<Outbox> on_optimize_work_done(uint64_t conn);
+    // fire-and-forget telemetry digest: folds into the fleet health model,
+    // runs the straggler detector (vs the bandwidth matrix), never replies
+    std::vector<Outbox> on_telemetry_digest(uint64_t conn,
+                                            const proto::TelemetryDigestC2M &d);
     std::vector<Outbox> on_disconnect(uint64_t conn);
+
+    // --- fleet health egress (HTTP threads; dispatcher is the only writer).
+    // Prometheus text-format gauges/counters, and the /health JSON the C
+    // API (pccltMasterGetHealth) and MasterNode.health() mirror.
+    std::string render_metrics() const;
+    std::string render_health_json() const;
 
     // conns the dispatcher should close (kicked); cleared on read
     std::vector<uint64_t> take_pending_closes();
@@ -190,6 +232,33 @@ private:
     bool optimize_in_flight_ = false;
     bool optimize_work_phase_ = false;
     BandwidthStore bandwidth_;
+
+    // fleet health (observability plane): dispatcher-written on digest /
+    // tick / membership change, HTTP-thread-read by the render methods.
+    // publish_health_summary republishes the dispatcher-only world view
+    // (counts) so readers never touch clients_/limbo_ themselves.
+    void publish_health_summary() PCCLT_EXCLUDES(health_mu_);
+    // spawn a background ATSP improvement seeded from the current ring,
+    // with the straggler's measured rate substituted into the cost matrix
+    // (PCCLT_STRAGGLER_REOPT=1 hook; adopted at the next optimize round)
+    void request_straggler_reopt(uint32_t gid);
+    // endpoint->client index for digest resolution, rebuilt lazily when
+    // membership changes (dispatcher-only, like clients_ itself) — a
+    // per-digest rebuild would be O(world log world) string builds on the
+    // consensus thread per push
+    std::map<std::string, uint64_t> endpoint_index_; // endpoint -> conn_id
+    uint64_t membership_gen_ = 1;   // bumped on every clients_ mutation
+    uint64_t endpoint_index_gen_ = 0;
+    mutable Mutex health_mu_; // lock-rank: 36
+    std::map<std::string, PeerHealth> fleet_peers_ PCCLT_GUARDED_BY(health_mu_);
+    std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_
+        PCCLT_GUARDED_BY(health_mu_);
+    uint64_t digests_total_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    uint64_t stragglers_flagged_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    size_t health_world_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    size_t health_clients_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    size_t health_limbo_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    uint64_t health_sweep_tick_ PCCLT_GUARDED_BY(health_mu_) = 0;
 
     // "moonshot" background ATSP improvement (reference: 30 s budget on a
     // thread pool, adopted on a LATER optimize round —

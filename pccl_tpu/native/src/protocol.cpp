@@ -1,5 +1,7 @@
 #include "protocol.hpp"
 
+#include <cctype>
+#include <cmath>
 #include <random>
 #include <stdexcept>
 
@@ -314,6 +316,93 @@ std::optional<OptimizeResponse> OptimizeResponse::decode(const std::vector<uint8
             o.requests.push_back(q);
         }
         return o;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- TelemetryDigestC2M ---
+
+std::vector<uint8_t> TelemetryDigestC2M::encode() const {
+    wire::Writer w;
+    w.u64(epoch);
+    w.u64(last_seq);
+    w.u64(interval_ms);
+    w.u64(ring_dropped);
+    w.u64(collectives_ok);
+    w.u32(static_cast<uint32_t>(edges.size()));
+    for (const auto &e : edges) {
+        w.str(e.endpoint);
+        w.f64(e.tx_mbps);
+        w.f64(e.rx_mbps);
+        w.f64(e.stall_ratio);
+        w.u64(e.tx_bytes);
+        w.u64(e.rx_bytes);
+    }
+    w.u32(static_cast<uint32_t>(ops.size()));
+    for (const auto &o : ops) {
+        w.u64(o.seq);
+        w.u64(o.dur_ns);
+        w.u64(o.stall_ns);
+    }
+    return w.take();
+}
+
+namespace {
+
+// digest floats feed the master's /metrics text and /health JSON, and the
+// endpoint string becomes a Prometheus label: reject anything a renderer
+// could choke on (NaN/Inf are invalid JSON; quotes/newlines/backslashes
+// corrupt the label set). Endpoints are Addr::str() output — ip:port.
+bool valid_rate(double v) { return std::isfinite(v) && v >= 0; }
+
+bool valid_endpoint(const std::string &s) {
+    if (s.empty() || s.size() > 63) return false;
+    for (char c : s)
+        if (!isalnum(static_cast<unsigned char>(c)) && c != '.' && c != ':' &&
+            c != '[' && c != ']' && c != '%' && c != '-')
+            return false;
+    return true;
+}
+
+} // namespace
+
+std::optional<TelemetryDigestC2M> TelemetryDigestC2M::decode(
+    const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        TelemetryDigestC2M d;
+        d.epoch = r.u64();
+        d.last_seq = r.u64();
+        d.interval_ms = r.u64();
+        d.ring_dropped = r.u64();
+        d.collectives_ok = r.u64();
+        uint32_t ne = r.u32();
+        // sanity bounds: a digest describes one peer's live edges and a
+        // tiny op ring — a count beyond these is a corrupt/hostile frame,
+        // not a bigger fleet
+        if (ne > 4096) return std::nullopt;
+        for (uint32_t i = 0; i < ne; ++i) {
+            Edge e;
+            e.endpoint = r.str();
+            e.tx_mbps = r.f64();
+            e.rx_mbps = r.f64();
+            e.stall_ratio = r.f64();
+            e.tx_bytes = r.u64();
+            e.rx_bytes = r.u64();
+            if (!valid_endpoint(e.endpoint) || !valid_rate(e.tx_mbps) ||
+                !valid_rate(e.rx_mbps) || !valid_rate(e.stall_ratio))
+                return std::nullopt;
+            d.edges.push_back(std::move(e));
+        }
+        uint32_t no = r.u32();
+        if (no > 256) return std::nullopt;
+        for (uint32_t i = 0; i < no; ++i) {
+            Op o;
+            o.seq = r.u64();
+            o.dur_ns = r.u64();
+            o.stall_ns = r.u64();
+            d.ops.push_back(o);
+        }
+        return d;
     } catch (...) { return std::nullopt; }
 }
 

@@ -47,6 +47,12 @@ enum PacketType : uint16_t {
     // only honored when the restarted master rehydrated this session from
     // its journal — see journal.hpp and docs/10_high_availability.md)
     kC2MSessionResume = 0x100C,
+    // fire-and-forget telemetry digest (fleet observability plane, docs/09):
+    // per-edge EWMA throughput/stall + last-N op timings pushed on the
+    // PCCLT_TELEMETRY_PUSH_MS cadence; the master folds these into its
+    // fleet health model (/metrics, /health, straggler detection). Never
+    // answered — a slow master must not back-pressure the data plane.
+    kC2MTelemetryDigest = 0x100D,
 
     // master -> client
     kM2CWelcome = 0x2001,
@@ -199,6 +205,31 @@ struct SharedStateSyncResp {
     std::vector<uint64_t> expected_hashes; // parallel to outdated_keys
     std::vector<uint8_t> encode() const;
     static std::optional<SharedStateSyncResp> decode(const std::vector<uint8_t> &);
+};
+
+// Telemetry digest (fleet observability plane). Compact by construction:
+// one fixed-size record per live edge (edge count = ring degree, not
+// world size) plus at most kOpRing op samples — a digest stays well under
+// a KiB even on wide worlds, so the default cadence costs nothing
+// next to a single data frame.
+struct TelemetryDigestC2M {
+    uint64_t epoch = 0;         // master epoch the client observes
+    uint64_t last_seq = 0;      // newest collective seq completed
+    uint64_t interval_ms = 0;   // wall time this digest folds
+    uint64_t ring_dropped = 0;  // flight-recorder events lost to wrap
+    uint64_t collectives_ok = 0;
+    struct Edge {
+        std::string endpoint;   // canonical "ip:port" (netem/telemetry key)
+        double tx_mbps = 0, rx_mbps = 0, stall_ratio = 0;
+        uint64_t tx_bytes = 0, rx_bytes = 0;
+    };
+    std::vector<Edge> edges;
+    struct Op {
+        uint64_t seq = 0, dur_ns = 0, stall_ns = 0;
+    };
+    std::vector<Op> ops;
+    std::vector<uint8_t> encode() const;
+    static std::optional<TelemetryDigestC2M> decode(const std::vector<uint8_t> &);
 };
 
 struct BenchRequest {

@@ -639,6 +639,8 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     uint64_t op_t1 = now_ns();
     if (ctx.rx_edge)  // receiver wire-stall charged to the inbound edge
         ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns, std::memory_order_relaxed);
+    if (ctx.tele)  // digest op sample (last-N phase timings)
+        ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
     if (trace) {
         rec.span("collective", "all_gather", ag_t0, op_t1, "seq", ctx.op_seq,
                  "bytes", (count * esz / world) * (world - 1));
@@ -755,10 +757,13 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
     }
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    uint64_t op_t1 = now_ns();
     if (ctx.rx_edge)
         ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns, std::memory_order_relaxed);
+    if (ctx.tele)
+        ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
     if (trace) {
-        rec.span("collective", "allgather", op_t0, now_ns(), "seq", ctx.op_seq,
+        rec.span("collective", "allgather", op_t0, op_t1, "seq", ctx.op_seq,
                  "bytes", static_cast<uint64_t>(world) * seg);
         rec.instant("collective", "wire_stall", "ns", prof.wait_ns, "seq",
                     ctx.op_seq);

@@ -14,6 +14,7 @@
 
 namespace pcclt::telemetry {
 struct EdgeCounters;  // per-edge flight-recorder counters (telemetry.hpp)
+class Domain;         // per-comm counter registry (telemetry.hpp)
 }
 
 namespace pcclt::reduce {
@@ -44,6 +45,9 @@ struct RingCtx {
     // predecessor's canonical endpoint) — receiver wire-stall time is
     // charged here at op end. Optional; null skips attribution.
     telemetry::EdgeCounters *rx_edge = nullptr;
+    // the comm's counter domain: completed ops deposit an OpSample
+    // (seq/duration/stall) for the telemetry digest. Optional.
+    telemetry::Domain *tele = nullptr;
     // all-gather only: destination slot per ring position (stable ordering
     // by sorted peer uuid — ring positions reshuffle across topology
     // rounds, so they cannot define the user-visible segment order)

@@ -195,6 +195,96 @@ static void test_telemetry() {
     rec.enable(was_on);
 }
 
+// Observability plane units (docs/09): the digest snapshotter's EWMA fold,
+// the op-sample ring, the recorder's ring-drop accounting, and the master's
+// fleet-health render fed through a real digest packet round-trip.
+static void test_observability() {
+    // op-sample ring: keeps the newest kOpRing, last_seq tracks the max
+    auto dom = std::make_shared<telemetry::Domain>();
+    for (uint64_t i = 1; i <= 12; ++i) dom->record_op(i, i * 100, i * 10);
+    auto ops = dom->recent_ops();
+    CHECK(ops.size() == telemetry::Domain::kOpRing);
+    CHECK(ops.front().seq == 12 - telemetry::Domain::kOpRing + 1);
+    CHECK(ops.back().seq == 12 && ops.back().dur_ns == 1200);
+    dom->record_op(5, 1, 1); // stale seq must not regress last_seq
+    CHECK(dom->last_seq() == 12);
+
+    // digest snapshotter: rates from interval deltas, cumulative carried
+    telemetry::DigestSnapshotter snap(dom);
+    dom->edge("10.0.0.1:1").conns.fetch_add(1);
+    dom->edge("10.0.0.1:1").tx_bytes.fetch_add(1'000'000);
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    auto d1 = snap.snapshot();
+    CHECK(d1.edges.size() == 1);
+    CHECK(d1.edges[0].tx_bytes == 1'000'000);
+    CHECK(d1.edges[0].tx_mbps > 0);
+    CHECK(d1.last_seq == 12 && d1.ops.size() == telemetry::Domain::kOpRing);
+    dom->edge("10.0.0.1:1").tx_bytes.fetch_add(500);
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    auto d2 = snap.snapshot();
+    CHECK(d2.edges[0].tx_bytes == 1'000'500);
+    CHECK(d2.edges[0].tx_mbps < d1.edges[0].tx_mbps); // EWMA decays
+
+    // digest wire round-trip
+    proto::TelemetryDigestC2M pkt;
+    pkt.epoch = 3;
+    pkt.last_seq = d2.last_seq;
+    pkt.ring_dropped = 7;
+    pkt.collectives_ok = 9;
+    pkt.edges.push_back({"10.0.0.1:1", 12.5, 3.25, 0.125, 1'000'500, 77});
+    pkt.ops.push_back({12, 1200, 120});
+    auto dec = proto::TelemetryDigestC2M::decode(pkt.encode());
+    CHECK(dec.has_value());
+    CHECK(dec->epoch == 3 && dec->edges.size() == 1 && dec->ops.size() == 1);
+    CHECK(dec->edges[0].endpoint == "10.0.0.1:1");
+    CHECK(dec->edges[0].tx_mbps == 12.5 && dec->edges[0].rx_bytes == 77);
+
+    // fleet health render: a registered client's digest shows up in both
+    // the Prometheus text and the /health JSON
+    master::MasterState st;
+    proto::HelloC2M h;
+    h.p2p_port = 1;
+    auto src = net::Addr::parse("10.0.0.9", 0);
+    CHECK(src.has_value());
+    auto out = st.on_hello(1, *src, h);
+    CHECK(!out.empty());
+    CHECK(st.on_telemetry_digest(1, *dec).empty()); // fire-and-forget
+    CHECK(st.on_telemetry_digest(99, *dec).empty()); // unknown conn: ignored
+    auto prom = st.render_metrics();
+    CHECK(prom.find("pcclt_master_telemetry_digests_total 1") != std::string::npos);
+    CHECK(prom.find("pcclt_edge_tx_mbps{") != std::string::npos);
+    CHECK(prom.find("to=\"10.0.0.1:1\"") != std::string::npos);
+    CHECK(prom.find("pcclt_peer_last_seq{") != std::string::npos);
+    auto health = st.render_health_json();
+    CHECK(health.find("\"telemetry_digests\":1") != std::string::npos);
+    CHECK(health.find("\"ring_dropped\":7") != std::string::npos);
+    CHECK(health.find("\"straggler\":false") != std::string::npos);
+
+    // recorder ring-drop accounting: overflow the 64k ring, count the loss
+    auto &rec = telemetry::Recorder::inst();
+    const bool was_on = rec.on();
+    rec.clear();
+    CHECK(rec.dropped() == 0);
+    rec.enable(true);
+    const uint64_t push_n = (1u << 16) + 1000;
+    for (uint64_t i = 0; i < push_n; ++i)
+        rec.instant("unit", "flood", "i", i);
+    CHECK(rec.pushed() == push_n);
+    CHECK(rec.dropped() == 1000);
+    CHECK(rec.snapshot().size() == (1u << 16));
+    rec.clear();
+    CHECK(rec.dropped() == 0); // clear re-anchors the window
+    // epoch stamping: events pushed after set_epoch carry it
+    rec.set_epoch(42);
+    rec.instant("unit", "stamped");
+    auto evs = rec.snapshot();
+    CHECK(evs.size() == 1 && evs[0].epoch == 42);
+    rec.set_epoch(0);
+    rec.clear();
+    rec.enable(was_on);
+    fprintf(stderr, "observability: ok\n");
+}
+
 static void test_wire() {
     wire::Writer w;
     w.u8(7);
@@ -1094,6 +1184,7 @@ static void test_e2e_abort_mid_ring() {
 int main() {
     test_lock_annotations();
     test_telemetry();
+    test_observability();
     test_wire();
     test_hash();
     test_kernels();

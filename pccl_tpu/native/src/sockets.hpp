@@ -168,6 +168,12 @@ public:
                                         const FramePred &pred, int timeout_ms = -1,
                                         bool no_wait = false);
     bool connected() const { return connected_.load(); }
+    // Half-teardown: shutdown(2) the wire WITHOUT closing the fd or
+    // joining the reader — unblocks any thread stuck in a blocking send
+    // (e.g. the telemetry push thread against a master that stopped
+    // reading) so its owner can join it BEFORE close() tears the socket
+    // down. Safe concurrently with send/recv: the fd stays valid.
+    void shutdown_wire() { sock_.shutdown(); }
     void close();
 
 private:

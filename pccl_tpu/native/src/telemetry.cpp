@@ -70,6 +70,78 @@ std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
     return out;
 }
 
+void Domain::record_op(uint64_t seq, uint64_t dur_ns, uint64_t stall_ns) {
+    // keep the max: concurrent ops can complete out of seq order
+    uint64_t prev = last_seq_.load(std::memory_order_relaxed);
+    while (seq > prev &&
+           !last_seq_.compare_exchange_weak(prev, seq,
+                                            std::memory_order_relaxed)) {
+    }
+    MutexLock lk(op_mu_);
+    ops_[op_head_ % kOpRing] = {seq, dur_ns, stall_ns};
+    ++op_head_;
+}
+
+std::vector<OpSample> Domain::recent_ops() const {
+    MutexLock lk(op_mu_);
+    std::vector<OpSample> out;
+    const uint64_t n = op_head_ < kOpRing ? op_head_ : kOpRing;
+    out.reserve(n);
+    for (uint64_t i = op_head_ - n; i < op_head_; ++i)
+        out.push_back(ops_[i % kOpRing]);
+    return out;
+}
+
+// ------------------------------------------------------- DigestSnapshotter
+
+Digest DigestSnapshotter::snapshot() {
+    Digest d;
+    const uint64_t now = now_ns();
+    const uint64_t dt = now > prev_t_ ? now - prev_t_ : 1;
+    prev_t_ = now;
+    d.interval_ns = dt;
+    d.last_seq = d_->last_seq();
+    d.ring_dropped = Recorder::inst().dropped();
+    d.collectives_ok =
+        d_->comm.collectives_ok.load(std::memory_order_relaxed);
+    d.ops = d_->recent_ops();
+    const double dt_s = dt / 1e9;
+    for (const auto &e : d_->snapshot_edges()) {
+        auto &p = prev_[e.endpoint];
+        auto rate_mbps = [&](uint64_t cur, uint64_t prev_bytes) {
+            uint64_t db = cur > prev_bytes ? cur - prev_bytes : 0;
+            return db * 8.0 / (dt_s * 1e6);
+        };
+        double tx = rate_mbps(e.tx_bytes, p.tx_bytes);
+        double rx = rate_mbps(e.rx_bytes, p.rx_bytes);
+        double stall =
+            (e.stall_ns > p.stall_ns ? e.stall_ns - p.stall_ns : 0) /
+            static_cast<double>(dt);
+        if (!p.seeded) {
+            p.tx_mbps = tx;
+            p.rx_mbps = rx;
+            p.stall_ratio = stall;
+            p.seeded = true;
+        } else {
+            p.tx_mbps = alpha_ * tx + (1 - alpha_) * p.tx_mbps;
+            p.rx_mbps = alpha_ * rx + (1 - alpha_) * p.rx_mbps;
+            p.stall_ratio = alpha_ * stall + (1 - alpha_) * p.stall_ratio;
+        }
+        p.tx_bytes = e.tx_bytes;
+        p.rx_bytes = e.rx_bytes;
+        p.stall_ns = e.stall_ns;
+        EdgeDigest ed;
+        ed.endpoint = e.endpoint;
+        ed.tx_mbps = p.tx_mbps;
+        ed.rx_mbps = p.rx_mbps;
+        ed.stall_ratio = p.stall_ratio;
+        ed.tx_bytes = e.tx_bytes;
+        ed.rx_bytes = e.rx_bytes;
+        d.edges.push_back(std::move(ed));
+    }
+    return d;
+}
+
 const std::shared_ptr<Domain> &default_domain() {
     static const std::shared_ptr<Domain> *d =
         new std::shared_ptr<Domain>(std::make_shared<Domain>());  // leaked
@@ -107,7 +179,9 @@ Recorder::Recorder() : ring_(new Slot[kCap]) {
 
 void Recorder::push(const Event &ev) {
     uint64_t buf[kEvWords] = {0};
-    memcpy(buf, &ev, sizeof(Event));
+    Event stamped = ev;
+    stamped.epoch = epoch_.load(std::memory_order_relaxed);
+    memcpy(buf, &stamped, sizeof(Event));
     uint64_t idx = head_.fetch_add(1, std::memory_order_relaxed);
     Slot &s = ring_[idx % kCap];
     uint64_t gen = (idx / kCap + 1) * 2;  // even, strictly increasing per slot
@@ -185,7 +259,10 @@ std::vector<Event> Recorder::snapshot() const {
 void Recorder::clear() {
     for (size_t i = 0; i < kCap; ++i)
         ring_[i].seq.store(0, std::memory_order_relaxed);
-    // head_ keeps counting: generations stay strictly increasing
+    // head_ keeps counting: generations stay strictly increasing. base_
+    // re-anchors so pushed()/dropped() count this capture window only.
+    base_.store(head_.load(std::memory_order_relaxed),
+                std::memory_order_relaxed);
 }
 
 namespace {
@@ -214,6 +291,16 @@ bool Recorder::dump_json(const std::string &path) const {
             "{\"ph\":\"M\",\"name\":\"process_name\",\"pid\":%d,"
             "\"args\":{\"name\":\"pcclt native (pid %d)\"}}",
             pid, pid);
+    // dump header: ring accounting so a saturated capture is VISIBLE in
+    // the artifact itself (dropped > 0 = the ring wrapped and this trace
+    // is the newest kCap events, not the whole run), plus the master
+    // epoch for cross-peer correlation (tools/trace_merge).
+    fprintf(f,
+            ",\n{\"ph\":\"M\",\"name\":\"pcclt_trace_meta\",\"pid\":%d,"
+            "\"args\":{\"captured\":%zu,\"pushed\":%" PRIu64
+            ",\"dropped\":%" PRIu64 ",\"ring_cap\":%zu,\"epoch\":%" PRIu64
+            "}}",
+            pid, events.size(), pushed(), dropped(), kCap, epoch());
     for (const auto &ev : events) {
         fputs(",\n", f);
         fprintf(f, "{\"name\":\"");
@@ -237,6 +324,7 @@ bool Recorder::dump_json(const std::string &path) const {
         };
         arg_u64(ev.arg0, ev.v0);
         arg_u64(ev.arg1, ev.v1);
+        if (ev.epoch) arg_u64("epoch", ev.epoch);
         if (ev.detail) {
             fprintf(f, "%s\"detail\":\"", first ? "" : ",");
             json_escaped(f, ev.detail);

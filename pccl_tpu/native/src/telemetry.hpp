@@ -85,12 +85,24 @@ struct CommCounters {
     // connections kept alive across a topology round (blip, not rebuild)
     std::atomic<uint64_t> master_reconnects{0};
     std::atomic<uint64_t> p2p_conns_reused{0};
+    // observability plane: telemetry digests pushed to the master
+    // (kC2MTelemetryDigest; 0 unless PCCLT_TELEMETRY_PUSH_MS enables it)
+    std::atomic<uint64_t> telemetry_digests{0};
 };
 
 struct EdgeSnapshot {
     std::string endpoint;
     uint64_t tx_bytes = 0, rx_bytes = 0, tx_frames = 0, rx_frames = 0,
              conns = 0, stall_ns = 0, tx_zc_frames = 0, tx_zc_reaps = 0;
+};
+
+// One completed collective's coarse timing, kept in a small per-Domain
+// ring so a telemetry digest can carry the last-N phase timings without
+// reading (or enabling) the event ring.
+struct OpSample {
+    uint64_t seq = 0;       // master-issued collective seq
+    uint64_t dur_ns = 0;    // whole-op wall time (ring entry to ring exit)
+    uint64_t stall_ns = 0;  // receiver wire-stall within the op
 };
 
 class Domain {
@@ -103,12 +115,25 @@ public:
 
     std::vector<EdgeSnapshot> snapshot_edges() const;
 
+    // Record one completed collective (reduce.cpp, op end). Keeps the
+    // newest kOpRing samples and the highest seq observed.
+    void record_op(uint64_t seq, uint64_t dur_ns, uint64_t stall_ns);
+    // newest-last, at most kOpRing entries
+    std::vector<OpSample> recent_ops() const;
+    uint64_t last_seq() const { return last_seq_.load(std::memory_order_relaxed); }
+
+    static constexpr size_t kOpRing = 8;
+
 private:
     mutable Mutex mu_; // lock-rank: 66
     // values are never erased and pointees never move: edge() hands out
     // references that outlive the lock (counter adds are lock-free atomics)
     std::map<std::string, std::unique_ptr<EdgeCounters>> edges_
         PCCLT_GUARDED_BY(mu_);
+    mutable Mutex op_mu_; // lock-rank: 67
+    OpSample ops_[kOpRing] PCCLT_GUARDED_BY(op_mu_);
+    uint64_t op_head_ PCCLT_GUARDED_BY(op_mu_) = 0;
+    std::atomic<uint64_t> last_seq_{0};
 };
 
 // Shared fallback for conns constructed without a comm (socktest, tools).
@@ -125,6 +150,10 @@ struct Event {
     const char *arg1 = nullptr;
     uint64_t v0 = 0, v1 = 0;
     const char *detail = nullptr;  // optional interned string arg
+    // master epoch at push time (set_epoch — welcome/resume/journal
+    // rehydrate). Stamped into every event so tools/trace_merge can
+    // correlate per-peer traces on (epoch, seq) across master restarts.
+    uint64_t epoch = 0;
     uint32_t tid = 0;
 };
 
@@ -148,6 +177,25 @@ public:
     // time-ordered copy of the ring (newest kCap events survive)
     std::vector<Event> snapshot() const;
     void clear();
+
+    // events pushed since the last clear(), and how many of those were
+    // LOST to ring wrap (overwritten before any snapshot could see them).
+    // A nonzero drop count means traces/digests are silently truncated —
+    // surfaced in Communicator.stats() and the PCCLT_TRACE dump header.
+    uint64_t pushed() const {
+        return head_.load(std::memory_order_relaxed) -
+               base_.load(std::memory_order_relaxed);
+    }
+    uint64_t dropped() const {
+        uint64_t p = pushed();
+        return p > kCap ? p - kCap : 0;
+    }
+
+    // Master epoch stamped into every subsequent event (client: welcome /
+    // resume ack; master: journal rehydrate). Process-global like the
+    // recorder itself; 0 = no master contact yet.
+    void set_epoch(uint64_t e) { epoch_.store(e, std::memory_order_relaxed); }
+    uint64_t epoch() const { return epoch_.load(std::memory_order_relaxed); }
 
     // Chrome trace-event JSON (chrome://tracing, ui.perfetto.dev). ts/dur
     // in microseconds on the raw CLOCK_MONOTONIC timebase, so a consumer
@@ -176,7 +224,62 @@ private:
     };
     std::atomic<bool> on_{false};
     std::atomic<uint64_t> head_{0};
+    std::atomic<uint64_t> base_{0};  // head_ at the last clear()
+    std::atomic<uint64_t> epoch_{0};
     std::unique_ptr<Slot[]> ring_;
+};
+
+// ---------------------------------------------------------------- digests
+//
+// Tier 1 of the fleet observability plane (docs/09): fold the always-on
+// counters into a compact fixed-size digest suitable for pushing to the
+// master on a cadence (kC2MTelemetryDigest). Rates are EWMAs over the
+// push intervals so a transient dip neither vanishes (a point sample
+// would miss it) nor sticks forever (a lifetime mean would dilute it).
+
+struct EdgeDigest {
+    std::string endpoint;    // canonical "ip:port" (netem/telemetry key)
+    double tx_mbps = 0;      // EWMA achieved egress, megabits/s
+    double rx_mbps = 0;      // EWMA achieved ingress, megabits/s
+    double stall_ratio = 0;  // EWMA wire-stall ns per interval ns (0..~1)
+    uint64_t tx_bytes = 0;   // cumulative counters at snapshot time —
+    uint64_t rx_bytes = 0;   //   the master re-exports these, so a scrape
+                             //   can be reconciled against peer stats()
+};
+
+// (the master epoch is NOT part of the digest fold: the push loop stamps
+// it onto the wire packet directly from the session state)
+struct Digest {
+    uint64_t last_seq = 0;     // newest collective seq completed locally
+    uint64_t interval_ns = 0;  // wall time folded into this digest
+    uint64_t ring_dropped = 0; // flight-recorder events lost to wrap
+    uint64_t collectives_ok = 0;
+    std::vector<EdgeDigest> edges;
+    std::vector<OpSample> ops; // last-N completed op timings (newest last)
+};
+
+// Folds a Domain's counters into interval rates. Owned and driven by ONE
+// thread (the client's telemetry push thread); not thread-safe itself —
+// the counters it reads are.
+class DigestSnapshotter {
+public:
+    explicit DigestSnapshotter(std::shared_ptr<Domain> d, double alpha = 0.3)
+        : d_(std::move(d)), alpha_(alpha) {}
+
+    // Delta since the previous snapshot() (first call: since construction
+    // counters, rates seeded from the first interval).
+    Digest snapshot();
+
+private:
+    std::shared_ptr<Domain> d_;
+    double alpha_;
+    uint64_t prev_t_ = now_ns();
+    struct PrevEdge {
+        uint64_t tx_bytes = 0, rx_bytes = 0, stall_ns = 0;
+        double tx_mbps = 0, rx_mbps = 0, stall_ratio = 0;
+        bool seeded = false;
+    };
+    std::map<std::string, PrevEdge> prev_;
 };
 
 // RAII span: records [ctor, dtor) when the recorder is enabled at ctor time.

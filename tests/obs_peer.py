@@ -1,0 +1,101 @@
+"""Observability test peer (subprocess worker).
+
+One peer of a wire_topology/netem-emulated loopback world with the fleet
+observability plane on: applies its per-rank env (wire maps, telemetry
+cadence) BEFORE touching the native layer, optionally runs an
+optimize_topology round (fills the master's bandwidth matrix), runs a few
+fp32 ring all-reduces, then prints one JSON line with its stats()
+snapshot. ``--hold`` keeps the peer alive (digests still flowing) until a
+line arrives on stdin — the orchestrating test scrapes the master's
+/metrics and /health mid-run against live peers, then releases them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-port", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--port-base", type=int, required=True)
+    ap.add_argument("--count", type=int, default=1 << 18)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--push-ms", type=int, default=150)
+    ap.add_argument("--optimize", action="store_true",
+                    help="run an optimize_topology round first (fills the "
+                         "bandwidth matrix the straggler detector compares "
+                         "against)")
+    ap.add_argument("--hold", action="store_true",
+                    help="after printing stats, stay connected (digests "
+                         "keep flowing) until a line arrives on stdin")
+    ap.add_argument("--trace-out", default=None,
+                    help="dump this peer's native Chrome trace here at the "
+                         "end (tools/trace_merge input)")
+    ap.add_argument("--env", default="{}",
+                    help="JSON env dict applied before the native load")
+    args = ap.parse_args()
+
+    os.environ.update(json.loads(args.env))
+    os.environ["PCCLT_TELEMETRY_PUSH_MS"] = str(args.push_ms)
+
+    import numpy as np
+
+    from pccl_tpu.comm import Communicator, ReduceOp, trace_dump, trace_enable
+    from pccl_tpu.comm.native_bench import _rank_ports
+
+    trace_enable(True)
+    p2p, ss, bench = _rank_ports(args.port_base, args.rank)
+    comm = Communicator("127.0.0.1", args.master_port,
+                        p2p_port=p2p, ss_port=ss, bench_port=bench)
+    comm.connect()
+    deadline = time.time() + 60
+    while comm.world_size < args.world:
+        if time.time() > deadline:
+            print(json.dumps({"rank": args.rank, "error": "world timeout"}),
+                  flush=True)
+            return 2
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+
+    if args.optimize:
+        comm.optimize_topology()
+
+    x = np.full(args.count, float(args.rank + 1), dtype=np.float32)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        y = x.copy()
+        comm.all_reduce(y, op=ReduceOp.SUM, tag=0)
+        expect = args.world * (args.world + 1) / 2
+        if float(y[0]) != expect or float(y[-1]) != expect:
+            print(json.dumps({"rank": args.rank,
+                              "error": f"bad result {y[0]} != {expect}"}),
+                  flush=True)
+            return 3
+    elapsed = time.perf_counter() - t0
+
+    # sit out at least two push intervals so a digest covering the final
+    # op's bytes reaches the master before the test scrapes
+    time.sleep(max(0.3, 2.5 * args.push_ms / 1000.0))
+    print(json.dumps({"rank": args.rank, "stats": comm.stats(),
+                      "elapsed_s": elapsed}), flush=True)
+    if args.hold:
+        sys.stdin.readline()
+    if args.trace_out:
+        trace_dump(args.trace_out)
+    comm.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

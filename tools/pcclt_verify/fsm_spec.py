@@ -51,6 +51,7 @@ MASTER_DISPATCH = {
     "kC2MOptimizeTopology": "on_optimize",
     "kC2MBandwidthReport": "on_bandwidth_report",
     "kC2MOptimizeWorkDone": "on_optimize_work_done",
+    "kC2MTelemetryDigest": "on_telemetry_digest",
 }
 
 # kM2C ids the master machine can emit (master_state.cpp)
@@ -680,6 +681,13 @@ class MasterModel:
         c.optimize_work_done = True
         self.check_optimize(out)
         return out
+
+    def on_telemetry_digest(self, uuid: str) -> "list[Packet]":
+        # fire-and-forget observability input: folds into the fleet health
+        # model (soft state, no replies, no consensus interaction) — by
+        # construction it cannot change any control-flow the checker
+        # explores, so the model consumes it as a no-op
+        return []
 
     def on_disconnect(self, uuid: str) -> "list[Packet]":
         out: "list[Packet]" = []

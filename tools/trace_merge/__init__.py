@@ -1,0 +1,133 @@
+"""Cross-peer trace correlation: merge N per-peer Chrome traces into one
+fleet timeline (``python -m tools.trace_merge peer*.json -o fleet.json``).
+
+Every native collective span carries the master-issued ``seq`` (and, since
+the observability plane, the master ``epoch``) in its args, and a
+collective COMPLETES at nearly the same instant on every member — the ring
+finishes when the last chunk lands, and the members' final stages are one
+chunk apart. That makes (epoch, seq) a shared event in every peer's local
+CLOCK_MONOTONIC timeline: for each non-reference peer we take the median
+over shared (epoch, seq) keys of (reference op end - peer op end) as the
+peer's clock offset and shift its whole trace by it. Median, not mean — a
+straggling op on one peer must not skew the alignment.
+
+The result loads in chrome://tracing / ui.perfetto.dev with one process
+track per (peer, original pid), process names prefixed ``peer<i>:`` so a
+merged python+native trace keeps both tracks attributable.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+from pathlib import Path
+from typing import Any, Dict, List, Sequence, Tuple
+
+# spans whose end time anchors the alignment (native collective op spans;
+# they carry args.seq and complete near-simultaneously fleet-wide)
+ANCHOR_NAMES = ("allreduce", "allgather")
+
+
+def _events_of(doc: Any) -> List[dict]:
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents", [])
+    else:  # bare event-array form is also legal Chrome trace JSON
+        evs = doc
+    return [e for e in evs if isinstance(e, dict)]
+
+
+def _anchor_ends(events: Sequence[dict]) -> Dict[Tuple[int, int], float]:
+    """(epoch, seq) -> µs end time of that collective's op span."""
+    out: Dict[Tuple[int, int], float] = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") not in ANCHOR_NAMES:
+            continue
+        args = e.get("args") or {}
+        if "seq" not in args:
+            continue
+        key = (int(args.get("epoch", 0)), int(args["seq"]))
+        end = float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+        out[key] = max(out.get(key, 0.0), end)
+    return out
+
+
+def merge_traces(docs: Sequence[Any],
+                 labels: "Sequence[str] | None" = None) -> dict:
+    """Merge parsed per-peer trace documents into one fleet trace dict.
+
+    docs[0] is the reference timeline; every other doc is shifted by the
+    median (epoch, seq)-anchored offset against it. Peers sharing no
+    anchor with the reference merge unshifted (offset 0) — visible in the
+    returned metadata, never a silent misalignment.
+    """
+    if not docs:
+        return {"traceEvents": [], "metadata": {"peers": 0}}
+    labels = list(labels) if labels else [f"peer{i}" for i in range(len(docs))]
+    per_peer_events = [_events_of(d) for d in docs]
+    ref_ends = _anchor_ends(per_peer_events[0])
+
+    merged: List[dict] = []
+    offsets_us: Dict[str, float] = {}
+    anchors: Dict[str, int] = {}
+    pid_map: Dict[Tuple[int, int], int] = {}
+
+    def new_pid(peer: int, old: int) -> int:
+        key = (peer, old)
+        if key not in pid_map:
+            pid_map[key] = len(pid_map) + 1
+        return pid_map[key]
+
+    for i, events in enumerate(per_peer_events):
+        if i == 0:
+            offset = 0.0
+            shared = len(ref_ends)
+        else:
+            ends = _anchor_ends(events)
+            deltas = [ref_ends[k] - v for k, v in ends.items()
+                      if k in ref_ends]
+            shared = len(deltas)
+            offset = statistics.median(deltas) if deltas else 0.0
+        offsets_us[labels[i]] = offset
+        anchors[labels[i]] = shared
+        for e in events:
+            e = dict(e)  # never mutate the caller's events
+            if "pid" in e:
+                e["pid"] = new_pid(i, int(e["pid"]))
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + offset
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                args = dict(e.get("args") or {})
+                args["name"] = f"{labels[i]}: {args.get('name', '')}"
+                e["args"] = args
+            merged.append(e)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": merged,
+        "metadata": {
+            "peers": len(docs),
+            "labels": labels,
+            "offsets_us": offsets_us,
+            "shared_anchors": anchors,
+        },
+    }
+
+
+def _unique_labels(names: Sequence[str]) -> List[str]:
+    """Disambiguate duplicate stems (peer dirs often share a filename) —
+    colliding labels would overwrite each other's offset/anchor metadata
+    and let an unanchored peer slip past the CLI's exit-1 check."""
+    out: List[str] = []
+    seen: Dict[str, int] = {}
+    for n in names:
+        k = seen.get(n, 0)
+        seen[n] = k + 1
+        out.append(n if k == 0 else f"{n}#{k}")
+    return out
+
+
+def merge_files(paths: Sequence[Path],
+                labels: "Sequence[str] | None" = None) -> dict:
+    docs = [json.loads(Path(p).read_text()) for p in paths]
+    return merge_traces(docs,
+                        _unique_labels(list(labels) if labels
+                                       else [Path(p).stem for p in paths]))

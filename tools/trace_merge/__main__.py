@@ -1,0 +1,51 @@
+"""CLI: ``python -m tools.trace_merge peer0.json peer1.json -o fleet.json``.
+
+Exit 1 when any non-reference peer shares no (epoch, seq) anchor with the
+reference (its track would merge unaligned) unless --allow-unanchored.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import merge_files
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.trace_merge",
+        description="merge per-peer PCCLT_TRACE dumps into one fleet "
+                    "timeline aligned on (epoch, seq)")
+    ap.add_argument("traces", nargs="+", type=Path,
+                    help="per-peer Chrome trace JSON files (first = "
+                         "reference timeline)")
+    ap.add_argument("-o", "--out", type=Path, default=Path("fleet_trace.json"))
+    ap.add_argument("--allow-unanchored", action="store_true",
+                    help="merge peers sharing no collective anchor with the "
+                         "reference at offset 0 instead of failing")
+    args = ap.parse_args()
+
+    doc = merge_files(args.traces)
+    meta = doc["metadata"]
+    bad = [lbl for lbl, n in meta["shared_anchors"].items()
+           if n == 0 and lbl != meta["labels"][0]]
+    for lbl in meta["labels"]:
+        print(f"  {lbl}: offset {meta['offsets_us'][lbl]:+.1f} us over "
+              f"{meta['shared_anchors'][lbl]} shared (epoch, seq) anchors")
+    if bad and not args.allow_unanchored:
+        print(f"error: no shared collective anchors for {', '.join(bad)} — "
+              "were these traces captured in the same run with the flight "
+              "recorder on? (--allow-unanchored to merge anyway)",
+              file=sys.stderr)
+        return 1
+    args.out.write_text(json.dumps(doc))
+    print(f"wrote {args.out} ({len(doc['traceEvents'])} events from "
+          f"{meta['peers']} peers)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
