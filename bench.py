@@ -219,6 +219,18 @@ def main() -> None:
             print(f"bench: telemetry overhead failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["telemetry_overhead_pct"] = None
+        # straggler-immune data plane (docs/05): mid-run edge degradation →
+        # wall-clock to the first back-to-baseline step (watchdog →
+        # re-issue → relay ladder), plus the armed-but-idle plane's step
+        # overhead (<= 1% bound)
+        try:
+            for k, v in native_bench.run_degraded_recovery_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: degraded recovery failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["degraded_recovery_s"] = None
+            extra["relay_overhead_pct"] = None
 
     # On-chip model legs: the jitted bf16 train step on the real TPU —
     # tokens/s + MFU per family (skip-guarded when no TPU is attached;

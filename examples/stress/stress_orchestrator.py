@@ -83,16 +83,24 @@ class MasterProc:
 
 class Peer:
     def __init__(self, master_port: int, idx: int, base_port: int,
-                 die_prob: float, seed: int):
+                 die_prob: float, seed: int, env: dict | None = None,
+                 count: int = 4096, extra_args: list | None = None):
         self.idx = idx
         self.base_port = base_port
         cmd = [sys.executable, str(PEER), "--master-port", str(master_port),
                "--rank", str(idx), "--base-port", str(base_port),
                "--steps", "1000000", "--min-world", "2",
-               "--step-interval", "0.05",
+               "--step-interval", "0.05", "--count", str(count),
                "--die-prob", str(die_prob), "--seed", str(seed)]
+        cmd += extra_args or []
+        if env:
+            cmd += ["--stats-every", "10"]
+        import os
+        penv = {**os.environ, **(env or {})}
         self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                     stderr=subprocess.STDOUT, text=True)
+                                     stderr=subprocess.STDOUT, text=True,
+                                     env=penv)
+        self.stats: dict = {}  # newest STATS snapshot (chaos runs)
         self.steps = 0
         self.resumes = 0  # total session resumes across this peer's comm lives
         self.rejoins = 0  # full re-registrations (fresh communicator)
@@ -108,6 +116,15 @@ class Peer:
         for line in self.proc.stdout:
             if line.startswith("STEP "):
                 self.steps += 1
+            elif line.startswith("STATS "):
+                try:
+                    import json
+                    self.stats = json.loads(line[6:])
+                except ValueError:
+                    pass
+            elif line.startswith("INJECT"):
+                # surface the victim's chaos injection (or its failure)
+                print(f"peer {self.idx}: {line.rstrip()}", flush=True)
             elif line.startswith("RESUMED total="):
                 try:
                     n = int(line.split("total=")[1].split()[0])
@@ -156,12 +173,52 @@ def main() -> int:
     ap.add_argument("--telemetry-push-ms", type=int, default=250,
                     help="digest cadence for the peers when --metrics-port "
                          "is set")
+    ap.add_argument("--chaos", default=None, metavar="SCHEDULE",
+                    help="scripted fault injection (docs/05): the victim "
+                         "sender (peer 1) injects this chaos schedule "
+                         "(e.g. 'flap@t=10s:200msx5;degrade@t=20s:"
+                         "20mbit/15s') on its OUTBOUND ring edge mid-run "
+                         "(self-discovered from stats, ring-order-proof); "
+                         "the edge watchdog + window failover turn on "
+                         "fleet-wide and a CHAOS SUMMARY exit line prints. "
+                         "A raw 'endpoint=schedule,...' map is applied "
+                         "verbatim via PCCLT_WIRE_CHAOS_MAP instead.")
+    ap.add_argument("--chaos-mbps", type=float, default=300.0,
+                    help="baseline emulated per-edge bandwidth for chaos "
+                         "runs (per-endpoint netem edges must exist for "
+                         "the schedule to retune)")
+    ap.add_argument("--count", type=int, default=4096,
+                    help="per-step all-reduce element count (chaos runs "
+                         "want real payloads so windows exist to fail over)")
     args = ap.parse_args()
 
     if args.metrics_port is not None:
         # peers inherit the cadence; the master flag rides the CLI
         import os
         os.environ["PCCLT_TELEMETRY_PUSH_MS"] = str(args.telemetry_push_ms)
+
+    # chaos plane (docs/05): every peer gets a uniform emulated mesh + the
+    # watchdog. Schedule mode: the victim SENDER (peer 1) injects the
+    # schedule on its OUTBOUND ring edge at runtime, discovered from its
+    # own stats() — the ATSP-adopted ring order decides who its successor
+    # is, so a hardcoded edge could land on one the ring never uses. Its
+    # failover then relays through a peer whose edges stay healthy. A raw
+    # "endpoint=schedule" map is still applied verbatim via the env.
+    chaos_env: dict[int, dict] = {}
+    chaos_args: dict[int, list] = {}
+    if args.chaos:
+        p2p = {i: args.base_port + i * 16 for i in range(args.peers)}
+        mbps_map = ",".join(f"127.0.0.1:{p}={args.chaos_mbps}"
+                            for p in p2p.values())
+        base = {"PCCLT_WIRE_MBPS_MAP": mbps_map, "PCCLT_WATCHDOG": "1"}
+        for i in range(args.peers):
+            chaos_env[i] = dict(base)
+        raw_map = "=" in args.chaos.split("@", 1)[0]
+        if raw_map:
+            for i in range(args.peers):
+                chaos_env[i]["PCCLT_WIRE_CHAOS_MAP"] = args.chaos
+        elif args.peers >= 2:
+            chaos_args[1] = ["--inject-spec", args.chaos, "--inject-at", "10"]
 
     master = MasterProc(args.master_port, args.journal, args.metrics_port)
     peers: list[Peer] = []
@@ -174,10 +231,31 @@ def main() -> int:
     retired_rejoins = 0
     next_master_kill = (time.time() + args.master_kill_interval
                         if args.master_kill_interval > 0 else None)
+    # chaos accounting, folded across peer lives (relaunches reset stats)
+    chaos_acc = {"faults_armed": 0, "faults_activated": 0, "failovers": 0,
+                 "relays": 0, "relay_forwarded": 0, "dup_bytes": 0,
+                 "suspects": 0, "confirms": 0, "aborted": 0}
+
+    def fold_chaos(stats: dict) -> None:
+        if not stats:
+            return
+        c = stats.get("counters", {})
+        chaos_acc["relay_forwarded"] += c.get("relay_forwarded", 0)
+        chaos_acc["aborted"] += c.get("collectives_aborted", 0)
+        chaos_acc["faults_armed"] += c.get("chaos_faults_armed", 0)
+        chaos_acc["faults_activated"] += c.get("chaos_faults_activated", 0)
+        for e in stats.get("edges", {}).values():
+            chaos_acc["failovers"] += e.get("wd_reissues", 0)
+            chaos_acc["relays"] += e.get("wd_relays", 0)
+            chaos_acc["dup_bytes"] += e.get("dup_bytes", 0)
+            chaos_acc["suspects"] += e.get("wd_suspects", 0)
+            chaos_acc["confirms"] += e.get("wd_confirms", 0)
+
     try:
         for i in range(args.peers):
             peers.append(Peer(args.master_port, i, args.base_port + i * 16,
-                              args.die_prob, seed))
+                              args.die_prob, seed, chaos_env.get(i),
+                              args.count, chaos_args.get(i)))
             seed += 1
         deadline = time.time() + args.duration
         last_progress = time.time()
@@ -222,10 +300,13 @@ def main() -> int:
                     retired_steps += p.steps
                     retired_resumes += p.resumes
                     retired_rejoins += p.rejoins
+                    fold_chaos(p.stats)
                     print(f"peer {p.idx} died (steps={p.steps}); relaunching "
                           f"(#{total_relaunches})", flush=True)
                     peers[i] = Peer(args.master_port, p.idx, p.base_port,
-                                    args.die_prob, seed)
+                                    args.die_prob, seed,
+                                    chaos_env.get(p.idx), args.count,
+                                    chaos_args.get(p.idx))
                     seed += 1
         total = retired_steps + sum(p.steps for p in peers)
         if total == 0:
@@ -268,6 +349,38 @@ def main() -> int:
                 # must not fail a soak that already passed
                 print(f"FLEET HEALTH: scrape failed "
                       f"({type(e).__name__}: {e})", flush=True)
+        if args.chaos:
+            for p in peers:
+                fold_chaos(p.stats)
+            reopts = "n/a"
+            if args.metrics_port is not None:
+                try:
+                    import urllib.request
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{args.metrics_port}/metrics",
+                            timeout=5) as r:
+                        for line in r.read().decode().splitlines():
+                            if line.startswith(
+                                    "pcclt_master_stragglers_flagged_total "):
+                                reopts = line.split()[-1]
+                except OSError:
+                    pass
+            print(f"CHAOS SUMMARY: faults_armed={chaos_acc['faults_armed']} "
+                  f"activated={chaos_acc['faults_activated']} "
+                  f"failovers={chaos_acc['failovers']} "
+                  f"relays={chaos_acc['relays']} "
+                  f"relay_forwarded={chaos_acc['relay_forwarded']} "
+                  f"suspects={chaos_acc['suspects']} "
+                  f"confirms={chaos_acc['confirms']} "
+                  f"dup_bytes={chaos_acc['dup_bytes']} "
+                  f"reopts={reopts} aborted={chaos_acc['aborted']}",
+                  flush=True)
+            if args.die_prob == 0 and chaos_acc["aborted"] > 0:
+                # scripted faults alone must never abort an op: the ladder
+                # (watchdog -> failover/relay -> re-opt) limps home instead
+                print("CHAOS FAILED: scripted faults aborted collectives",
+                      flush=True)
+                return 1
         print(f"SOAK PASSED: {total} heartbeat steps, "
               f"{total_relaunches} relaunches, "
               f"{master_restarts} master restarts in {args.duration:.0f}s",

@@ -33,6 +33,7 @@ from .api import (  # noqa: F401
     TooFewPeersError,
     TensorInfo,
     shm_ndarray,
+    netem_inject,
     trace_clear,
     trace_dump,
     trace_enable,

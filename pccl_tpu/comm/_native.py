@@ -139,6 +139,11 @@ class CommStats(ctypes.Structure):
         # flight-recorder events lost to ring wrap (process-global)
         ("telemetry_digests", ctypes.c_uint64),
         ("trace_ring_dropped", ctypes.c_uint64),
+        # straggler-immune data plane (docs/05): windows forwarded as the
+        # relay hop, and process-global netem chaos fault accounting
+        ("relay_forwarded", ctypes.c_uint64),
+        ("chaos_faults_armed", ctypes.c_uint64),
+        ("chaos_faults_activated", ctypes.c_uint64),
     ]
 
 
@@ -153,6 +158,17 @@ class EdgeStats(ctypes.Structure):
         ("stall_ms", ctypes.c_uint64),
         ("tx_zc_frames", ctypes.c_uint64),
         ("tx_zc_reaps", ctypes.c_uint64),
+        # edge watchdog + window failover (docs/05); quiescent invariant:
+        # rx_bytes + rx_relay_bytes - dup_bytes == unique payload delivered
+        ("wd_state", ctypes.c_uint64),
+        ("wd_suspects", ctypes.c_uint64),
+        ("wd_confirms", ctypes.c_uint64),
+        ("wd_reissues", ctypes.c_uint64),
+        ("wd_relays", ctypes.c_uint64),
+        ("rx_relay_bytes", ctypes.c_uint64),
+        ("rx_relay_windows", ctypes.c_uint64),
+        ("dup_bytes", ctypes.c_uint64),
+        ("dup_windows", ctypes.c_uint64),
     ]
 
 
@@ -245,6 +261,13 @@ def _declare(lib):
         lib.pccltWireModelQuery.argtypes = [c.c_char_p, c.c_uint16,
                                             P(c.c_double), P(c.c_double),
                                             P(c.c_double), P(c.c_double)]
+    except AttributeError:
+        pass
+
+    # runtime chaos injection (docs/05; same older-build tolerance)
+    try:
+        lib.pccltNetemInject.restype = c.c_int
+        lib.pccltNetemInject.argtypes = [c.c_char_p, c.c_char_p]
     except AttributeError:
         pass
 

@@ -226,6 +226,18 @@ def trace_dump(path: str) -> None:
     _check(lib.pccltTraceDump(path.encode()), "trace dump")
 
 
+def netem_inject(endpoint: str, spec: str) -> None:
+    """Arm a time-scripted chaos fault schedule on the wire-emulation edge
+    toward ``endpoint`` ("ip:port"), offsets relative to NOW — e.g.
+    ``"degrade@t=0s:40mbit/8s"``, ``"flap@t=1s:200msx5"``,
+    ``"blackhole@t=0s:2s"`` (';'-separate multiple faults). Mirrors
+    ``pccltNetemInject``; see docs/05_fault_tolerance.md for the grammar
+    and the live-connection caveat. An empty spec disarms the edge."""
+    lib = _native.load()
+    _check(lib.pccltNetemInject(endpoint.encode(), spec.encode()),
+           "netem inject")
+
+
 def trace_events() -> list:
     """The native recorder's current events as a list of Chrome trace-event
     dicts (the parsed form of trace_dump's output)."""
@@ -730,6 +742,16 @@ class Communicator:
                     "connects": int(e.connects), "stall_ms": int(e.stall_ms),
                     "tx_zc_frames": int(e.tx_zc_frames),
                     "tx_zc_reaps": int(e.tx_zc_reaps),
+                    # edge watchdog + window failover (docs/05)
+                    "wd_state": int(e.wd_state),
+                    "wd_suspects": int(e.wd_suspects),
+                    "wd_confirms": int(e.wd_confirms),
+                    "wd_reissues": int(e.wd_reissues),
+                    "wd_relays": int(e.wd_relays),
+                    "rx_relay_bytes": int(e.rx_relay_bytes),
+                    "rx_relay_windows": int(e.rx_relay_windows),
+                    "dup_bytes": int(e.dup_bytes),
+                    "dup_windows": int(e.dup_windows),
                 }
         return {"counters": counters, "edges": edges}
 

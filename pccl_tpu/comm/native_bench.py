@@ -1036,6 +1036,85 @@ def run_telemetry_overhead_bench(nbytes: int = 8 << 20,
     }
 
 
+def _peer_degraded(rank, master_port, q, world, count, steps, fault_at,
+                   fault, port_base, mbps_map, watchdog):
+    """One peer of the degraded-recovery bench: deterministic fp32 ring
+    steps on a uniform emulated mesh; rank 0 injects the chaos fault on its
+    outbound ring edge (discovered from stats — no ring-order knowledge
+    needed) before step `fault_at`."""
+    os.environ["PCCLT_WIRE_MBPS_MAP"] = mbps_map
+    os.environ["PCCLT_WATCHDOG"] = watchdog
+    import numpy as np
+
+    from pccl_tpu.comm.api import ReduceOp, netem_inject
+
+    comm = _connect(rank, master_port, world, port_base)
+    x = np.ones(count, np.float32)
+    y = np.empty_like(x)
+    times = []
+    for step in range(steps):
+        if rank == 0 and fault and step == fault_at:
+            edges = comm.stats()["edges"]
+            ep = max(edges.items(), key=lambda kv: kv[1]["tx_bytes"])[0]
+            netem_inject(ep, fault)
+        t0 = time.perf_counter()
+        comm.all_reduce(x, y, op=ReduceOp.SUM)
+        times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "times": times})
+    comm.destroy()
+
+
+def run_degraded_recovery_bench(world: int = 4, count: int = 1 << 20,
+                                steps: int = 10, fault_at: int = 4,
+                                mbps: float = 300.0,
+                                degrade_mbit: float = 10.0,
+                                base: int = 33000) -> Dict[str, float]:
+    """Straggler-immune data plane, pinned in history (docs/05):
+
+    * ``degraded_recovery_s`` — one ring edge degrades mbps→degrade_mbit
+      MID-RUN (pccltNetemInject); measured wall-clock from the fault-step's
+      start until the first step back under 2x the healthy baseline. The
+      watchdog→failover/relay ladder should land this within seconds — the
+      un-protected world stays degraded for the fault's whole duration.
+    * ``relay_overhead_pct`` — the chaos/watchdog plane compiled in and
+      ARMED but idle (no fault): median step vs the watchdog disabled,
+      same map. Acceptance bound <= 1%.
+    """
+    endpoints = ",".join(
+        f"127.0.0.1:{_rank_ports(base, r)[0]}={mbps}" for r in range(world))
+    out: Dict[str, float] = {}
+
+    fault = f"degrade@t=0s:{degrade_mbit}mbit/600s"
+    res = _spawn_world(world, _peer_degraded,
+                       _port("PCCLT_BENCH_MASTER_PORT_CHAOS", 48689),
+                       (world, count, steps, fault_at, fault, base,
+                        endpoints, "1"), inline_rank0=False)
+    times = next(r["times"] for r in res if r["rank"] == 0)
+    baseline = sorted(times[1:fault_at])[(fault_at - 2) // 2]
+    recovery = 0.0
+    for t in times[fault_at:]:
+        recovery += t
+        if t < 2 * baseline:
+            break
+    out["degraded_step_baseline_s"] = baseline
+    out["degraded_recovery_s"] = recovery
+    out["degraded_recovered_step_s"] = times[-1]
+
+    # idle-plane overhead: watchdog ON (armed, never tripping) vs OFF
+    def leg(watchdog: str, port_env_dflt: int, leg_base: int) -> float:
+        r = _spawn_world(world, _peer_degraded, port_env_dflt,
+                         (world, count, steps, -1, "", leg_base,
+                          ",".join(f"127.0.0.1:{_rank_ports(leg_base, i)[0]}"
+                                   f"={mbps}" for i in range(world)),
+                          watchdog), inline_rank0=False)
+        ts = sorted(next(x["times"] for x in r if x["rank"] == 0)[1:])
+        return ts[(len(ts) - 1) // 2]
+    t_on = leg("1", _port("PCCLT_BENCH_MASTER_PORT_CHAOS2", 48691), 33400)
+    t_off = leg("0", _port("PCCLT_BENCH_MASTER_PORT_CHAOS3", 48693), 33800)
+    out["relay_overhead_pct"] = 100.0 * (t_on - t_off) / t_off
+    return out
+
+
 def _peer_hier(rank, master_port, q, elems, iters, quantize, port_base):
     """One emulated TPU slice (4 virtual CPU devices) of the hierarchical
     all-reduce: ICI staging on the slice mesh, the native ring across

@@ -280,6 +280,20 @@ PCCLT_EXPORT pccltResult_t pccltWireModelQuery(const char *ip, uint16_t port,
                                                double *mbps, double *rtt_ms,
                                                double *jitter_ms, double *drop);
 
+/* Runtime chaos injection (pcclt extension, docs/05). Arm a time-scripted
+ * fault schedule on the wire-emulation edge toward endpoint "ip:port",
+ * with fault offsets relative to NOW:
+ *   "degrade@t=0s:40mbit/8s;flap@t=10s:200msx5"   (';'-separated faults;
+ *   kinds: degrade@t=T:<R>mbit/<D>, flap@t=T:<D>x<N>, blackhole@t=T:<D>)
+ * Replaces any schedule already armed on the edge; an empty spec disarms.
+ * Live connections are affected immediately when they resolved to a
+ * per-endpoint edge (the endpoint appears in a PCCLT_WIRE_*_MAP /
+ * PCCLT_WIRE_CHAOS_MAP); otherwise the schedule applies to connections
+ * created after this call. Returns InvalidArgument on an unparsable
+ * endpoint or spec. */
+PCCLT_EXPORT pccltResult_t pccltNetemInject(const char *endpoint,
+                                            const char *spec);
+
 /* --- flight-recorder telemetry (pcclt extension) ---
  *
  * Monotonic counters are always on (relaxed atomic adds at frame
@@ -313,6 +327,11 @@ typedef struct pccltCommStats_t {
                                    * since the last clear (process-global): a
                                    * nonzero value means PCCLT_TRACE dumps are
                                    * silently truncated to the newest 64k */
+    /* straggler-immune data plane (docs/05) */
+    uint64_t relay_forwarded;     /* windows this peer forwarded as the RELAY
+                                   * hop of another peer's failover detour */
+    uint64_t chaos_faults_armed;      /* netem chaos faults armed (process) */
+    uint64_t chaos_faults_activated;  /* fault windows observed active */
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {
@@ -325,6 +344,18 @@ typedef struct pccltEdgeStats_t {
     uint64_t stall_ms;  /* receiver wire-stall charged to this edge */
     uint64_t tx_zc_frames; /* frames sent via io_uring MSG_ZEROCOPY */
     uint64_t tx_zc_reaps;  /* zerocopy completion notifications reaped */
+    /* edge watchdog + window failover (docs/05). Conservation invariant at
+     * quiescence per inbound edge:
+     *   rx_bytes + rx_relay_bytes - dup_bytes == unique payload delivered */
+    uint64_t wd_state;         /* 0 ok, 1 suspect, 2 confirmed (relaying) */
+    uint64_t wd_suspects;      /* SUSPECT verdicts raised on this edge */
+    uint64_t wd_confirms;      /* SUSPECT -> CONFIRMED escalations */
+    uint64_t wd_reissues;      /* windows re-issued on a fresh pool conn */
+    uint64_t wd_relays;        /* windows detoured via a healthy neighbor */
+    uint64_t rx_relay_bytes;   /* relayed payload delivered (origin-charged) */
+    uint64_t rx_relay_windows;
+    uint64_t dup_bytes;        /* duplicate arrivals dropped by the dedupe */
+    uint64_t dup_windows;
 } pccltEdgeStats_t;
 
 /* Snapshot this communicator's counters. */

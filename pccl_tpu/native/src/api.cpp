@@ -407,6 +407,11 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     // process-global ring accounting (the recorder is shared by every comm
     // in the process): nonzero = traces are truncated to the newest 64k
     out->trace_ring_dropped = pcclt::telemetry::Recorder::inst().dropped();
+    out->relay_forwarded = ld(m.relay_forwarded);
+    // chaos accounting is process-global like the netem registry itself
+    auto cs = pcclt::net::netem::chaos_stats();
+    out->chaos_faults_armed = cs.armed;
+    out->chaos_faults_activated = cs.activated;
     return pccltSuccess;
 }
 
@@ -427,8 +432,23 @@ pccltResult_t pccltCommGetEdgeStats(pccltComm_t *c, pccltEdgeStats_t *out,
         o.stall_ms = e.stall_ns / 1000000;
         o.tx_zc_frames = e.tx_zc_frames;
         o.tx_zc_reaps = e.tx_zc_reaps;
+        o.wd_state = e.wd_health;
+        o.wd_suspects = e.wd_suspects;
+        o.wd_confirms = e.wd_confirms;
+        o.wd_reissues = e.wd_reissues;
+        o.wd_relays = e.wd_relays;
+        o.rx_relay_bytes = e.rx_relay_bytes;
+        o.rx_relay_windows = e.rx_relay_windows;
+        o.dup_bytes = e.dup_bytes;
+        o.dup_windows = e.dup_windows;
     }
     return pccltSuccess;
+}
+
+pccltResult_t pccltNetemInject(const char *endpoint, const char *spec) {
+    if (!endpoint || !spec) return pccltInvalidArgument;
+    return pcclt::net::netem::inject(endpoint, spec) ? pccltSuccess
+                                                     : pccltInvalidArgument;
 }
 
 pccltResult_t pccltTraceEnable(int on) {

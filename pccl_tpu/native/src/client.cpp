@@ -32,6 +32,14 @@ int env_int(const char *name, int dflt) {
     return dflt;
 }
 
+double env_double(const char *name, double dflt) {
+    if (const char *e = std::getenv(name)) {
+        double v = atof(e);
+        if (v > 0) return v;
+    }
+    return dflt;
+}
+
 } // namespace
 
 Client::~Client() { disconnect(); }
@@ -117,6 +125,9 @@ void Client::on_p2p_accept(net::Socket sock) {
         auto conn = std::make_shared<net::MultiplexConn>(std::move(sock), table,
                                                          tele_);
         fd->store(-1); // handed off: the conn owns the fd now
+        // relay windows (kRelayFwd/kRelayDeliver) can arrive on ANY conn —
+        // accepted ones included — so every conn gets the router
+        install_relay_handlers(conn);
         if (peer_p2p_port != 0) {
             // canonical peer endpoint = observed source ip + advertised p2p
             // port: per-edge wire emulation resolves against it (before
@@ -298,7 +309,8 @@ void Client::telemetry_push_loop(int push_ms) {
         pkt.collectives_ok = d.collectives_ok;
         for (auto &e : d.edges)
             pkt.edges.push_back({e.endpoint, e.tx_mbps, e.rx_mbps,
-                                 e.stall_ratio, e.tx_bytes, e.rx_bytes});
+                                 e.stall_ratio, e.tx_bytes, e.rx_bytes,
+                                 static_cast<uint8_t>(e.wd_state)});
         for (auto &o : d.ops) pkt.ops.push_back({o.seq, o.dur_ns, o.stall_ns});
         // fire and forget: a down master link is the resume path's problem,
         // not ours — the next digest after a resume carries fresh rates
@@ -548,36 +560,15 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
         std::vector<std::shared_ptr<net::MultiplexConn>> pool;
         bool ok = true;
         for (size_t i = 0; i < cfg_.pool_size; ++i) {
-            net::Socket s;
-            net::Addr pa = ep.ip;
-            pa.port = ep.p2p_port;
-            if (!s.connect(pa, 5000)) {
+            // dial_p2p retries transient connect/handshake failures on a
+            // bounded backoff (p2p reconnect hardening) and installs the
+            // straggler-relay routing before the conn runs
+            auto conn = dial_p2p(ep, static_cast<uint32_t>(i), table);
+            if (!conn) {
                 ok = false;
                 break;
             }
-            s.set_keepalive();
-            s.set_bufsizes(8 << 20);
-            wire::Writer w;
-            proto::put_uuid(w, uuid_);
-            w.u32(static_cast<uint32_t>(i));
-            // our p2p listen port: lets the acceptor key its side of this
-            // conn by our canonical endpoint (per-edge wire emulation)
-            w.u16(p2p_listener_.port());
-            Mutex mu;
-            if (!net::send_frame(s, mu, PacketType::kP2PHello, w.data())) {
-                ok = false;
-                break;
-            }
-            auto ack = net::recv_frame(s, 15'000);
-            if (!ack || ack->type != PacketType::kP2PHelloAck) {
-                ok = false;
-                break;
-            }
-            auto conn = std::make_shared<net::MultiplexConn>(std::move(s), table,
-                                                             tele_);
-            conn->set_wire_peer(pa); // canonical endpoint (= the addr dialed)
-            conn->run();
-            pool.push_back(conn);
+            pool.push_back(std::move(conn));
         }
         if (!ok) {
             failed.push_back(ep.uuid);
@@ -627,6 +618,32 @@ void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid
                 ++left;
         ring_ = ring;
         topo_revision_ = info.revision;
+        // Sweep stale watchdog verdicts (docs/05): the in-op re-probe only
+        // runs while an edge is the CURRENT ring successor, so a verdict on
+        // an edge the re-opt routed AWAY from would otherwise latch forever
+        // — its digests would keep the master's straggler flag up and the
+        // substituted matrix rate in place long after the link recovered.
+        // A verdict older than the CONFIRMED hold has served its purpose;
+        // dropping it lets the edge prove itself if it re-enters the ring.
+        const uint64_t hold_ns = static_cast<uint64_t>(
+            env_int("PCCLT_WATCHDOG_HOLD_MS", 5000)) * 1'000'000ull;
+        const uint64_t now = telemetry::now_ns();
+        for (auto &[uuid, pc] : peers_) {
+            net::Addr pa = pc.ep.ip;
+            pa.port = pc.ep.p2p_port;
+            auto &e = tele_->edge(pa.str());
+            uint32_t h = e.wd_health.load(std::memory_order_relaxed);
+            if (h == 0) continue;
+            uint64_t since = e.wd_confirmed_at_ns.load(std::memory_order_relaxed);
+            const bool succ = !ring.empty() &&
+                              uuid == ring[(static_cast<size_t>(
+                                                std::find(ring.begin(), ring.end(),
+                                                          uuid_) -
+                                            ring.begin()) + 1) % ring.size()];
+            if (!succ && (h == 1 || now - since > hold_ns))
+                e.wd_health.compare_exchange_strong(h, 0,
+                                                    std::memory_order_relaxed);
+        }
     }
     tele_->comm.peers_joined.fetch_add(joined, std::memory_order_relaxed);
     tele_->comm.peers_left.fetch_add(left, std::memory_order_relaxed);
@@ -876,6 +893,191 @@ Status Client::gather_slot(uint64_t *slot) {
     if (it == sorted.end()) return Status::kInternal;
     *slot = static_cast<uint64_t>(it - sorted.begin());
     return Status::kOk;
+}
+
+// ---------------- straggler-immune data plane (docs/05) ----------------
+
+void Client::install_relay_handlers(
+    const std::shared_ptr<net::MultiplexConn> &conn) {
+    conn->set_relay_handlers(
+        // RELAY hop: re-emit the window toward its final destination over
+        // our own healthy link. Runs on the conn's RX thread holding no
+        // lock; the send is enqueue-only (send_owned never writes inline).
+        [this](const uint8_t *dst, const uint8_t *origin, uint64_t tag,
+               uint64_t off, std::vector<uint8_t> bytes) {
+            proto::Uuid d;
+            memcpy(d.data(), dst, 16);
+            std::shared_ptr<net::MultiplexConn> out;
+            {
+                MutexLock lk(state_mu_);
+                auto it = peers_.find(d);
+                if (it != peers_.end())
+                    for (const auto &c : it->second.tx)
+                        if (c && c->alive()) {
+                            out = c;
+                            break;
+                        }
+            }
+            if (!out) {
+                PLOG(kDebug) << "relay: no live link toward final dst; "
+                                "dropping window tag=" << tag;
+                return;
+            }
+            std::vector<uint8_t> payload(16 + bytes.size());
+            memcpy(payload.data(), origin, 16);
+            if (!bytes.empty())
+                memcpy(payload.data() + 16, bytes.data(), bytes.size());
+            out->send_owned(net::MultiplexConn::kRelayDeliver, tag, off,
+                            std::move(payload));
+            tele_->comm.relay_forwarded.fetch_add(1,
+                                                  std::memory_order_relaxed);
+        },
+        // FINAL destination: the window belongs to the ORIGIN peer's
+        // inbound link — place it into that link's sink table (dedupe +
+        // conservation accounting charge the origin's edge)
+        [this](const uint8_t *origin, uint64_t tag, uint64_t off,
+               std::vector<uint8_t> bytes) {
+            proto::Uuid o;
+            memcpy(o.data(), origin, 16);
+            std::shared_ptr<net::SinkTable> table;
+            telemetry::EdgeCounters *edge = nullptr;
+            {
+                MutexLock lk(state_mu_);
+                auto it = peers_.find(o);
+                if (it != peers_.end() && it->second.rx_table) {
+                    table = it->second.rx_table;
+                    net::Addr pa = it->second.ep.ip;
+                    pa.port = it->second.ep.p2p_port;
+                    edge = &tele_->edge(pa.str());
+                }
+            }
+            if (!table) {
+                PLOG(kDebug) << "relay-deliver for unknown origin; dropping "
+                                "window tag=" << tag;
+                return;
+            }
+            table->deliver_window(tag, off, std::move(bytes), edge);
+        });
+}
+
+std::shared_ptr<net::MultiplexConn> Client::dial_p2p(
+    const proto::PeerEndpoint &ep, uint32_t idx,
+    const std::shared_ptr<net::SinkTable> &table, int attempts_override) {
+    // p2p connect/reconnect hardening: a peer mid-restart refuses or
+    // resets the first dial — retry on a bounded exponential backoff with
+    // jitter (the PR-3 reconnect_* family) instead of failing the round.
+    // The default p2p budget is intentionally smaller than the master's:
+    // a genuinely dead peer must still fail the round promptly so the
+    // master can kick it.
+    int attempts = attempts_override > 0
+                       ? attempts_override
+                       : std::min(2, std::max(1, cfg_.reconnect_attempts > 0
+                                                     ? cfg_.reconnect_attempts
+                                                     : env_int("PCCLT_RECONNECT_ATTEMPTS", 8)));
+    const int backoff_ms = cfg_.reconnect_backoff_ms > 0
+                               ? cfg_.reconnect_backoff_ms
+                               : env_int("PCCLT_RECONNECT_BACKOFF_MS", 100);
+    const int cap_ms = cfg_.reconnect_backoff_cap_ms > 0
+                           ? cfg_.reconnect_backoff_cap_ms
+                           : env_int("PCCLT_RECONNECT_MAX_BACKOFF_MS", 2000);
+    std::mt19937_64 rng{std::random_device{}() ^
+                        static_cast<uint64_t>(reinterpret_cast<uintptr_t>(this)) ^
+                        idx};
+    for (int a = 0; a < attempts; ++a) {
+        if (a > 0) {
+            double d = std::min<double>(cap_ms,
+                                        backoff_ms * double(1ull << (a - 1)));
+            d *= 0.5 + std::uniform_real_distribution<>{}(rng);
+            std::this_thread::sleep_for(
+                std::chrono::duration<double, std::milli>(d));
+        }
+        net::Socket s;
+        net::Addr pa = ep.ip;
+        pa.port = ep.p2p_port;
+        if (!s.connect(pa, 5000)) continue;
+        s.set_keepalive();
+        s.set_bufsizes(8 << 20);
+        wire::Writer w;
+        proto::put_uuid(w, uuid_);
+        w.u32(idx);
+        // our p2p listen port: lets the acceptor key its side of this
+        // conn by our canonical endpoint (per-edge wire emulation)
+        w.u16(p2p_listener_.port());
+        Mutex mu;
+        if (!net::send_frame(s, mu, PacketType::kP2PHello, w.data())) continue;
+        auto ack = net::recv_frame(s, 15'000);
+        if (!ack || ack->type != PacketType::kP2PHelloAck) continue;
+        auto conn = std::make_shared<net::MultiplexConn>(std::move(s), table,
+                                                         tele_);
+        conn->set_wire_peer(pa); // canonical endpoint (= the addr dialed)
+        install_relay_handlers(conn);
+        conn->run();
+        return conn;
+    }
+    return nullptr;
+}
+
+net::Link Client::fresh_pool_conn(const proto::Uuid &peer) {
+    proto::PeerEndpoint ep;
+    std::shared_ptr<net::SinkTable> table;
+    uint32_t idx = 0;
+    {
+        MutexLock lk(state_mu_);
+        auto it = peers_.find(peer);
+        if (it == peers_.end() || !it->second.tx_table) return {};
+        ep = it->second.ep;
+        table = it->second.tx_table;
+        idx = static_cast<uint32_t>(it->second.tx.size());
+    }
+    // exactly one dial: the watchdog already burned a deadline getting
+    // here — a second stall escalates to the relay rung instead
+    auto conn = dial_p2p(ep, idx, table, /*attempts_override=*/1);
+    if (!conn) return {};
+    bool adopted = false;
+    {
+        MutexLock lk(state_mu_);
+        auto it = peers_.find(peer);
+        if (it != peers_.end()) {
+            it->second.tx.push_back(conn); // heals the pool for later ops
+            adopted = true;
+        }
+    }
+    if (!adopted) {
+        conn->close();
+        return {};
+    }
+    return net::Link({conn}, table);
+}
+
+bool Client::relay_window_via(const proto::Uuid &dst, uint64_t tag,
+                              uint64_t off, std::span<const uint8_t> payload) {
+    std::shared_ptr<net::MultiplexConn> via;
+    {
+        MutexLock lk(state_mu_);
+        for (const auto &u : ring_) {
+            if (u == uuid_ || u == dst) continue;
+            auto it = peers_.find(u);
+            if (it == peers_.end()) continue;
+            for (const auto &c : it->second.tx)
+                if (c && c->alive()) {
+                    via = c;
+                    break;
+                }
+            if (via) break;
+        }
+    }
+    if (!via) return false;
+    std::vector<uint8_t> buf(32 + payload.size());
+    memcpy(buf.data(), dst.data(), 16);
+    memcpy(buf.data() + 16, uuid_.data(), 16);
+    if (!payload.empty())
+        memcpy(buf.data() + 32, payload.data(), payload.size());
+    auto h = via->send_owned(net::MultiplexConn::kRelayFwd, tag, off,
+                             std::move(buf));
+    // wait out the first (local, healthy) hop: a failure here lets the
+    // caller fall back to the direct path; the relay->dst hop is covered
+    // by receiver-side dedupe + the degraded direct copy still in flight
+    return h->wait(-1);
 }
 
 net::Link Client::tx_link(const proto::Uuid &peer) {
@@ -1164,7 +1366,8 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         ctx.tele = tele_.get();
         {
             // receiver wire-stall is charged to the inbound edge: the ring
-            // predecessor's canonical endpoint (the netem/telemetry key)
+            // predecessor's canonical endpoint (the netem/telemetry key);
+            // the watchdog additionally tracks the OUTBOUND edge (successor)
             MutexLock lk(state_mu_);
             auto it = peers_.find(prev);
             if (it != peers_.end()) {
@@ -1172,6 +1375,29 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
                 pa.port = it->second.ep.p2p_port;
                 ctx.rx_edge = &tele_->edge(pa.str());
             }
+            auto nt = peers_.find(next);
+            if (nt != peers_.end()) {
+                net::Addr pa = nt->second.ep.ip;
+                pa.port = nt->second.ep.p2p_port;
+                ctx.tx_edge = &tele_->edge(pa.str());
+            }
+        }
+        // edge watchdog + live failover (docs/05): opt-in via PCCLT_WATCHDOG
+        // =1; env re-read per op so tests can flip it at runtime
+        if (const char *wde = std::getenv("PCCLT_WATCHDOG");
+            wde && wde[0] == '1' && ctx.tx_edge) {
+            ctx.wd_factor = env_double("PCCLT_WATCHDOG_FACTOR", 4.0);
+            ctx.wd_min_ns = static_cast<uint64_t>(
+                env_int("PCCLT_WATCHDOG_MIN_MS", 300)) * 1'000'000ull;
+            ctx.wd_hold_ns = static_cast<uint64_t>(
+                env_int("PCCLT_WATCHDOG_HOLD_MS", 5000)) * 1'000'000ull;
+            proto::Uuid succ = next;
+            ctx.fresh_tx_conn = [this, succ] { return fresh_pool_conn(succ); };
+            if (world >= 3)
+                ctx.relay_window = [this, succ](uint64_t tag, uint64_t off,
+                                                std::span<const uint8_t> p) {
+                    return relay_window_via(succ, tag, off, p);
+                };
         }
         auto scratch = take_scratch();
         ctx.scratch = &scratch;

@@ -210,6 +210,28 @@ private:
     // waits until at least one inbound conn from `peer` is up
     net::Link rx_link(const proto::Uuid &peer, int timeout_ms);
 
+    // ---- straggler-immune data plane (docs/05) ----
+    // Install kRelayFwd/kRelayDeliver routing on a conn (must run before
+    // conn->run(): the RX thread reads the handlers lock-free).
+    void install_relay_handlers(const std::shared_ptr<net::MultiplexConn> &conn);
+    // Dial + hello-handshake ONE p2p conn to `ep`. Transient connect/
+    // handshake failures (ECONNRESET/ETIMEDOUT while a peer restarts its
+    // listener) get bounded exponential backoff + jitter via the PR-3
+    // reconnect_* knob family; attempts_override > 0 caps the budget
+    // (the mid-op fresh-conn rung dials exactly once).
+    std::shared_ptr<net::MultiplexConn> dial_p2p(
+        const proto::PeerEndpoint &ep, uint32_t idx,
+        const std::shared_ptr<net::SinkTable> &table,
+        int attempts_override = 0);
+    // failover rung 1: one extra pool conn to `peer`, appended to its pool
+    // (heals the pool for later ops); Link holds ONLY the new conn
+    net::Link fresh_pool_conn(const proto::Uuid &peer);
+    // failover rung 2: detour a window toward `dst` through any healthy
+    // third ring peer; waits out the first (local) hop so a false return
+    // lets the caller fall back to the direct path
+    bool relay_window_via(const proto::Uuid &dst, uint64_t tag, uint64_t off,
+                          std::span<const uint8_t> payload);
+
     // Telemetry push loop (fleet observability plane, docs/09): every
     // `push_ms` fold the Domain counters into a DigestSnapshotter digest
     // and fire-and-forget it to the master over the control connection.

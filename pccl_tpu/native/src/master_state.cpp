@@ -1200,7 +1200,9 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
         const proto::TelemetryDigestC2M::Edge *e;
         std::string to_uuid;
         Uuid to_raw{};
-        double expected_mbps = 0;
+        double expected_mbps = 0;      // remote -> reporter (inbound)
+        double expected_out_mbps = 0;  // reporter -> remote (outbound): the
+                                       // direction a watchdog CONFIRM judges
     };
     // endpoint->client index, rebuilt only when membership changed since
     // the last digest — a per-digest rebuild (let alone a per-edge scan)
@@ -1231,6 +1233,8 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
                 // compare against is remote->reporter too
                 if (auto bw = bandwidth_.get(cit->second.uuid, c->uuid))
                     r.expected_mbps = *bw;
+                if (auto bw = bandwidth_.get(c->uuid, cit->second.uuid))
+                    r.expected_out_mbps = *bw;
             }
         }
         resolved.push_back(std::move(r));
@@ -1242,6 +1246,9 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
         std::string endpoint, to_uuid;
         Uuid to_raw{};
         double measured = 0, expected = 0;
+        // outbound = a watchdog CONFIRM (reporter -> remote): the matrix
+        // substitution goes in that direction, with the achieved tx rate
+        bool outbound = false;
     };
     std::vector<Flagged> newly_flagged;
     {
@@ -1267,6 +1274,27 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
             eh.tx_bytes = r.e->tx_bytes;
             eh.rx_bytes = r.e->rx_bytes;
             eh.expected_mbps = r.expected_mbps;
+            eh.wd_state = r.e->wd_state;
+            // Watchdog fast path: a CONFIRMED edge means the reporter's
+            // data plane already failed over mid-collective — no rate
+            // heuristics needed, the re-opt should fire NOW so the next
+            // ring routes around the hop while the current op limps home.
+            if (r.e->wd_state == 2 && !eh.straggler && !r.to_uuid.empty()) {
+                eh.straggler = true;
+                eh.wd_flagged = true;
+                eh.flag_baseline_mbps = r.expected_out_mbps > 0
+                                            ? r.expected_out_mbps
+                                            : r.expected_mbps;
+                ++stragglers_flagged_;
+                newly_flagged.push_back({r.e->endpoint, r.to_uuid, r.to_raw,
+                                         r.e->tx_mbps, r.expected_out_mbps,
+                                         /*outbound=*/true});
+            } else if (eh.straggler && eh.wd_flagged && r.e->wd_state == 0) {
+                // the peer's hold expired and the edge proved itself again
+                eh.straggler = false;
+                eh.wd_flagged = false;
+                eh.flag_baseline_mbps = 0;
+            }
             // Degradation witness = the RECEIVER's wire-stall: achieved
             // ingress rate only samples link capacity while the receiver
             // is blocked on the wire (stall gate). Without it, any healthy
@@ -1286,7 +1314,7 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
                                              r.to_raw, r.e->rx_mbps,
                                              r.expected_mbps});
                 }
-            } else if (active) {
+            } else if (active && !eh.wd_flagged) {
                 // recovery is judged against the baseline captured when
                 // the flag went up — the REOPT hook rewrites the matrix
                 // with the degraded rate, and measuring against THAT
@@ -1305,22 +1333,34 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
     publish_health_summary();
 
     for (const auto &f : newly_flagged) {
-        PLOG(kWarn) << "straggler edge flagged: " << f.endpoint << " -> "
-                    << from << " measured " << f.measured
-                    << " Mbit/s vs matrix " << f.expected
-                    << " Mbit/s (receiver wire-stall witnessed)";
+        PLOG(kWarn) << "straggler edge flagged: "
+                    << (f.outbound ? from : f.endpoint) << " -> "
+                    << (f.outbound ? f.endpoint : from) << " measured "
+                    << f.measured << " Mbit/s vs matrix " << f.expected
+                    << " Mbit/s ("
+                    << (f.outbound ? "watchdog CONFIRMED in-collective"
+                                   : "receiver wire-stall witnessed")
+                    << ")";
         telemetry::Recorder::inst().instant(
             "fleet", "master_straggler", "measured_mbps",
             static_cast<uint64_t>(f.measured), "expected_mbps",
             static_cast<uint64_t>(f.expected), telemetry::intern(f.endpoint));
         if (straggler_reopt_enabled() && !f.to_uuid.empty()) {
             // telemetry-refreshed matrix: the measured (degraded) rate
-            // replaces the stale probe value — in the witnessed direction,
-            // remote -> reporter — so the background ATSP pass actually
+            // replaces the stale probe value — in the WITNESSED direction:
+            // remote -> reporter for the rate detector, reporter -> remote
+            // for a watchdog CONFIRM — so the background ATSP pass actually
             // routes around the slow hop; the next optimize round adopts
             // the improved ring (check_optimize moonshot path)
-            bandwidth_.store(f.to_raw, c->uuid, f.measured);
-            if (journal_) journal_->record_bandwidth(f.to_raw, c->uuid, f.measured);
+            if (f.outbound) {
+                bandwidth_.store(c->uuid, f.to_raw, f.measured);
+                if (journal_)
+                    journal_->record_bandwidth(c->uuid, f.to_raw, f.measured);
+            } else {
+                bandwidth_.store(f.to_raw, c->uuid, f.measured);
+                if (journal_)
+                    journal_->record_bandwidth(f.to_raw, c->uuid, f.measured);
+            }
             request_straggler_reopt(c->peer_group);
         }
     }
@@ -1478,6 +1518,9 @@ std::string MasterState::render_metrics() const {
     gauge("pcclt_edge_expected_mbps", "bandwidth-matrix entry for the edge");
     gauge("pcclt_edge_straggler",
           "1 while measured throughput sits below the straggler threshold");
+    gauge("pcclt_edge_wd_state",
+          "reporter's data-plane watchdog verdict: 0 ok, 1 suspect, "
+          "2 confirmed (relaying in-collective)");
     for (const auto &[key, e] : fleet_edges_copy) {
         std::string lbl = "{from=\"" + e.from_uuid + "\",to=\"" + e.to_endpoint +
                           "\",to_peer=\"" + e.to_uuid + "\"} ";
@@ -1489,6 +1532,8 @@ std::string MasterState::render_metrics() const {
         o += "pcclt_edge_expected_mbps" + lbl + num(e.expected_mbps) + "\n";
         o += "pcclt_edge_straggler" + lbl + (e.straggler ? "1" : "0");
         o += "\n";
+        o += "pcclt_edge_wd_state" + lbl +
+             num(static_cast<uint64_t>(e.wd_state)) + "\n";
     }
     return o;
 }
@@ -1555,6 +1600,7 @@ std::string MasterState::render_health_json() const {
         o += ",\"expected_mbps\":" + num(e.expected_mbps);
         o += ",\"straggler\":";
         o += e.straggler ? "true" : "false";
+        o += ",\"wd_state\":" + num(static_cast<uint64_t>(e.wd_state));
         o += '}';
     }
     o += "]}";

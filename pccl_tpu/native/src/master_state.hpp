@@ -108,6 +108,13 @@ struct EdgeHealth {
     // against THIS, not the live matrix — the REOPT hook rewrites the
     // matrix with the degraded rate, which must not self-clear the flag
     double flag_baseline_mbps = 0;
+    // the reporter's data-plane watchdog verdict for its OUTBOUND hop to
+    // this endpoint (0 ok / 1 suspect / 2 confirmed); a CONFIRMED report
+    // means the peer is already relaying around the edge in-collective
+    uint32_t wd_state = 0;
+    // this straggler flag came from a watchdog CONFIRM (outbound witness),
+    // so recovery is judged by the watchdog clearing, not the rx rate
+    bool wd_flagged = false;
 };
 
 struct GroupState {

@@ -6,6 +6,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <optional>
 #include <thread>
 
 #include "log.hpp"
@@ -38,7 +39,192 @@ std::string trim(const std::string &s) {
 
 }  // namespace
 
+// ---------- chaos schedules ----------
+
+namespace {
+
+// process-wide chaos accounting (CHAOS SUMMARY)
+std::atomic<uint64_t> g_chaos_armed{0};
+std::atomic<uint64_t> g_chaos_activated{0};
+
+// "5s" / "200ms" / bare seconds -> ns; nullopt on garbage
+std::optional<uint64_t> parse_dur_ns(const std::string &s) {
+    char *endp = nullptr;
+    double v = strtod(s.c_str(), &endp);
+    if (endp == s.c_str() || !(v >= 0) || !std::isfinite(v)) return std::nullopt;
+    std::string unit = trim(endp);
+    double scale;
+    if (unit.empty() || unit == "s") scale = 1e9;
+    else if (unit == "ms") scale = 1e6;
+    else return std::nullopt;
+    return static_cast<uint64_t>(v * scale);
+}
+
+}  // namespace
+
+std::vector<ChaosFault> parse_chaos(const std::string &spec, const char *what) {
+    std::vector<ChaosFault> out;
+    size_t pos = 0;
+    while (pos <= spec.size()) {
+        size_t semi = spec.find(';', pos);
+        std::string f = trim(spec.substr(
+            pos, semi == std::string::npos ? std::string::npos : semi - pos));
+        pos = semi == std::string::npos ? spec.size() + 1 : semi + 1;
+        if (f.empty()) continue;
+        auto bad = [&](const char *why) {
+            PLOG(kWarn) << what << ": skipping malformed fault '" << f << "' ("
+                        << why << ")";
+        };
+        // <kind>@t=<T>:<args>   (@t=... optional: omitted = fire on arming)
+        size_t at = f.find('@');
+        std::string kind = trim(at == std::string::npos ? f.substr(0, f.find(':'))
+                                                        : f.substr(0, at));
+        ChaosFault cf;
+        std::string args;
+        if (at != std::string::npos) {
+            size_t colon = f.find(':', at);
+            if (colon == std::string::npos) {
+                bad("want kind@t=T:args");
+                continue;
+            }
+            std::string t = trim(f.substr(at + 1, colon - at - 1));
+            if (t.rfind("t=", 0) != 0) {
+                bad("want t=<time> after '@'");
+                continue;
+            }
+            auto tn = parse_dur_ns(t.substr(2));
+            if (!tn) {
+                bad("bad start time");
+                continue;
+            }
+            cf.start_ns = *tn;
+            args = trim(f.substr(colon + 1));
+        } else {
+            size_t colon = f.find(':');
+            args = colon == std::string::npos ? "" : trim(f.substr(colon + 1));
+        }
+        if (kind == "degrade") {
+            // <R>mbit/<D>
+            size_t slash = args.find('/');
+            if (slash == std::string::npos) {
+                bad("want <rate>mbit/<duration>");
+                continue;
+            }
+            std::string rate = trim(args.substr(0, slash));
+            if (rate.size() > 4 && rate.substr(rate.size() - 4) == "mbit")
+                rate = trim(rate.substr(0, rate.size() - 4));
+            char *endp = nullptr;
+            double r = strtod(rate.c_str(), &endp);
+            auto d = parse_dur_ns(trim(args.substr(slash + 1)));
+            if (endp == rate.c_str() || *trim(endp).c_str() != '\0' ||
+                !(r > 0) || !std::isfinite(r) || !d || *d == 0) {
+                bad("bad rate or duration");
+                continue;
+            }
+            cf.kind = ChaosFault::kDegrade;
+            cf.mbps = r;
+            cf.dur_ns = *d;
+        } else if (kind == "flap") {
+            // <D>x<N>  ('x' or the Unicode '×')
+            size_t x = args.find('x');
+            size_t cut = x, skip = 1;
+            if (x == std::string::npos) {
+                cut = args.find("\xc3\x97");  // UTF-8 '×'
+                skip = 2;
+            }
+            if (cut == std::string::npos) {
+                bad("want <duration>x<count>");
+                continue;
+            }
+            auto d = parse_dur_ns(trim(args.substr(0, cut)));
+            long n = atol(trim(args.substr(cut + skip)).c_str());
+            if (!d || *d == 0 || n <= 0 || n > 100000) {
+                bad("bad duration or count");
+                continue;
+            }
+            cf.kind = ChaosFault::kFlap;
+            cf.dur_ns = *d;
+            cf.repeat = static_cast<uint32_t>(n);
+        } else if (kind == "blackhole") {
+            auto d = parse_dur_ns(args);
+            if (!d || *d == 0) {
+                bad("bad duration");
+                continue;
+            }
+            cf.kind = ChaosFault::kBlackhole;
+            cf.dur_ns = *d;
+        } else {
+            bad("unknown fault kind");
+            continue;
+        }
+        out.push_back(cf);
+    }
+    return out;
+}
+
+ChaosStats chaos_stats() {
+    return {g_chaos_armed.load(std::memory_order_relaxed),
+            g_chaos_activated.load(std::memory_order_relaxed)};
+}
+
 // ---------- Edge ----------
+
+void Edge::arm_chaos(std::vector<ChaosFault> faults) {
+    MutexLock lk(mu_);
+    chaos_ = std::move(faults);
+    chaos_t0_ = mono_ns();
+    fired_outages_.assign(chaos_.size(), 0);
+    chaos_armed_.store(!chaos_.empty(), std::memory_order_relaxed);
+    if (!chaos_.empty())
+        g_chaos_armed.fetch_add(chaos_.size(), std::memory_order_relaxed);
+}
+
+ChaosVerdict Edge::chaos_at(uint64_t now_ns) {
+    if (!chaos_armed_.load(std::memory_order_relaxed)) return {};
+    if (now_ns == 0) now_ns = mono_ns();
+    MutexLock lk(mu_);
+    return chaos_eval(now_ns);
+}
+
+// Shared by pace()/delivery_delay_ns() (which already hold mu_) and
+// chaos_at. Scans the (tiny) fault list; counts newly-observed fault
+// windows into the process activation counter.
+ChaosVerdict Edge::chaos_eval(uint64_t now_ns) {
+    ChaosVerdict v;
+    for (size_t i = 0; i < chaos_.size(); ++i) {
+        const ChaosFault &f = chaos_[i];
+        uint64_t t0 = chaos_t0_ + f.start_ns;
+        if (now_ns < t0) continue;
+        uint64_t rel = now_ns - t0;
+        if (f.kind == ChaosFault::kDegrade) {
+            if (rel < f.dur_ns) {
+                v.mbps_override = f.mbps;  // last active degrade wins
+                if (fired_outages_[i] == 0) {
+                    fired_outages_[i] = 1;
+                    g_chaos_activated.fetch_add(1, std::memory_order_relaxed);
+                }
+            }
+        } else {
+            // flap: outage windows of dur_ns at period 2*dur_ns, repeat
+            // times; blackhole: one outage window
+            uint64_t period = f.kind == ChaosFault::kFlap ? 2 * f.dur_ns
+                                                          : f.dur_ns;
+            uint32_t reps = f.kind == ChaosFault::kFlap ? f.repeat : 1;
+            uint64_t k = rel / period;
+            if (k < reps && rel - k * period < f.dur_ns) {
+                v.outage = true;
+                v.outage_end_ns =
+                    std::max(v.outage_end_ns, t0 + k * period + f.dur_ns);
+                if (fired_outages_[i] < k + 1) {
+                    g_chaos_activated.fetch_add(k + 1 - fired_outages_[i],
+                                                std::memory_order_relaxed);
+                    fired_outages_[i] = static_cast<uint32_t>(k + 1);
+                }
+            }
+        }
+    }
+    return v;
+}
 
 void Edge::configure(const EdgeParams &p) {
     ns_per_byte_.store(p.mbps > 0 ? 8000.0 / p.mbps : 0.0,
@@ -66,7 +252,8 @@ EdgeParams Edge::params() const {
 
 void Edge::pace(size_t bytes) {
     double npb = ns_per_byte_.load(std::memory_order_relaxed);
-    if (npb <= 0) return;
+    const bool armed = chaos_armed_.load(std::memory_order_relaxed);
+    if (npb <= 0 && !armed) return;
     uint64_t end;
     {
         MutexLock lk(mu_);
@@ -75,6 +262,13 @@ void Edge::pace(size_t bytes) {
         // frame has fully drained — a sender cannot complete a send faster
         // than the wire carries it (no burst credit: next never lags now)
         uint64_t start = std::max(next_ns_, now);
+        if (armed) {
+            // chaos verdict at reservation time: an outage pushes the slot
+            // past the outage window; a degrade caps the drain rate
+            ChaosVerdict cv = chaos_eval(now);
+            if (cv.outage) start = std::max(start, cv.outage_end_ns);
+            if (cv.mbps_override > 0) npb = 8000.0 / cv.mbps_override;
+        }
         end = start + static_cast<uint64_t>(static_cast<double>(bytes) * npb);
         next_ns_ = end;
     }
@@ -100,8 +294,16 @@ uint64_t Edge::delivery_delay_ns() {
     uint64_t d = owd_ns_.load(std::memory_order_relaxed);
     uint64_t jit = jitter_ns_.load(std::memory_order_relaxed);
     double drop = drop_.load(std::memory_order_relaxed);
-    if (jit == 0 && drop <= 0) return d;
+    const bool armed = chaos_armed_.load(std::memory_order_relaxed);
+    if (jit == 0 && drop <= 0 && !armed) return d;
     MutexLock lk(mu_);
+    if (armed) {
+        // a frame already off the (emulated) wire during an outage window
+        // becomes visible only once the outage lifts
+        uint64_t now = mono_ns();
+        ChaosVerdict cv = chaos_eval(now);
+        if (cv.outage && cv.outage_end_ns > now) d += cv.outage_end_ns - now;
+    }
     if (jit > 0) d += splitmix64(rng_) % jit;
     if (drop > 0 &&
         static_cast<double>(splitmix64(rng_) >> 11) * 0x1.0p-53 < drop) {
@@ -211,6 +413,35 @@ double env_f(const char *name) {
 }
 }  // namespace
 
+namespace {
+
+// chaos-map split: values contain '=' (t=5s) and faults are ';'-joined,
+// so the generic parse_map (last-'=' split, numeric values) cannot serve —
+// split entries on ',' and the key at the FIRST '='
+std::map<std::string, std::string> parse_chaos_map(const char *spec) {
+    std::map<std::string, std::string> out;
+    if (!spec) return out;
+    std::string s(spec);
+    size_t pos = 0;
+    while (pos <= s.size()) {
+        size_t comma = s.find(',', pos);
+        std::string entry = trim(s.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos));
+        pos = comma == std::string::npos ? s.size() + 1 : comma + 1;
+        if (entry.empty()) continue;
+        size_t eq = entry.find('=');
+        if (eq == std::string::npos || eq == 0 || eq + 1 >= entry.size()) {
+            PLOG(kWarn) << "PCCLT_WIRE_CHAOS_MAP: skipping malformed entry '"
+                        << entry << "' (want key=schedule)";
+            continue;
+        }
+        out[trim(entry.substr(0, eq))] = trim(entry.substr(eq + 1));
+    }
+    return out;
+}
+
+}  // namespace
+
 void Registry::refresh() {
     MutexLock lk(mu_);
     mbps_ = parse_map(std::getenv("PCCLT_WIRE_MBPS_MAP"),
@@ -221,6 +452,7 @@ void Registry::refresh() {
                         "PCCLT_WIRE_JITTER_MS_MAP");
     drop_ = parse_map(std::getenv("PCCLT_WIRE_DROP_MAP"),
                       "PCCLT_WIRE_DROP_MAP");
+    chaos_specs_ = parse_chaos_map(std::getenv("PCCLT_WIRE_CHAOS_MAP"));
     global_.mbps = env_f("PCCLT_WIRE_MBPS");
     global_.rtt_ms = env_f("PCCLT_WIRE_RTT_MS");
     global_.jitter_ms = 0;
@@ -229,9 +461,21 @@ void Registry::refresh() {
     default_->configure(global_);
     // retune live edges in place: conns keep their shared_ptr (and their
     // shared bucket) across refreshes; keys that dropped out of the maps
-    // fall back to the current global defaults field by field
-    for (auto &[key, e] : edges_)
+    // fall back to the current global defaults field by field. Chaos
+    // schedules arm ONCE per EDGE (an armed script keeps its t0 across
+    // refreshes — a mid-run env re-read must not restart the timeline; an
+    // ip-keyed schedule arms EVERY edge on that host, each on its own
+    // timeline, so the armed marker is per edge key, not per spec key).
+    for (auto &[key, e] : edges_) {
         e.edge->configure(params_for(e.exact_key, e.ip_key));
+        auto cs = chaos_specs_.find(e.exact_key);
+        if (cs == chaos_specs_.end()) cs = chaos_specs_.find(e.ip_key);
+        if (cs != chaos_specs_.end() && !chaos_armed_keys_[key]) {
+            chaos_armed_keys_[key] = true;
+            e.edge->arm_chaos(parse_chaos(cs->second,
+                                          "PCCLT_WIRE_CHAOS_MAP"));
+        }
+    }
 }
 
 EdgeParams Registry::params_for(const std::string &exact_key,
@@ -261,11 +505,15 @@ std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
     // inherit the caller's lock set under -Wthread-safety
     std::string match;
     if (mbps_.count(exact) || rtt_.count(exact) || jitter_.count(exact) ||
-        drop_.count(exact)) {
+        drop_.count(exact) || chaos_specs_.count(exact)) {
         match = exact;  // per-endpoint bucket
     } else if (mbps_.count(ip) || rtt_.count(ip) || jitter_.count(ip) ||
-               drop_.count(ip)) {
+               drop_.count(ip) || chaos_specs_.count(ip)) {
         match = ip;  // per-host bucket, shared by every port on that ip
+    } else if (edges_.count(exact)) {
+        match = exact;  // injected per-endpoint edge (pccltNetemInject)
+    } else if (edges_.count(ip)) {
+        match = ip;
     } else {
         return default_;  // globals: the one process-wide bucket (legacy)
     }
@@ -279,8 +527,52 @@ std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
         e.ip_key = ip;
         e.edge = std::make_shared<Edge>(params_for(e.exact_key, ip));
         it = edges_.emplace(match, std::move(e)).first;
+        // a chaos schedule covering this edge (exact entry, or the
+        // host-wide ip wildcard) arms the moment the edge exists
+        auto cs = chaos_specs_.find(it->second.exact_key);
+        if (cs == chaos_specs_.end())
+            cs = chaos_specs_.find(it->second.ip_key);
+        if (cs != chaos_specs_.end() && !chaos_armed_keys_[match]) {
+            chaos_armed_keys_[match] = true;
+            it->second.edge->arm_chaos(
+                parse_chaos(cs->second, "PCCLT_WIRE_CHAOS_MAP"));
+        }
     }
     return it->second.edge;
+}
+
+bool inject(const std::string &endpoint, const std::string &spec) {
+    size_t colon = endpoint.rfind(':');
+    if (colon == std::string::npos) return false;
+    long port = atol(endpoint.substr(colon + 1).c_str());
+    auto addr = Addr::parse(endpoint.substr(0, colon),
+                            static_cast<uint16_t>(port));
+    if (!addr || port <= 0 || port > 65535) return false;
+    auto faults = parse_chaos(spec, "pccltNetemInject");
+    // an empty schedule is a valid DISARM request, but a spec that parses
+    // to nothing while non-empty is an error the caller should hear about
+    if (faults.empty() && !trim(spec).empty()) return false;
+    auto &reg = Registry::inst();
+    // force a per-endpoint edge: live conns to this endpoint hold the edge
+    // resolve() returns, so arming it mid-run affects them immediately.
+    // (Conns that resolved to the shared DEFAULT edge — no map entry for
+    // the endpoint at connect time — keep the default model; arm before
+    // connecting, or list the endpoint in a PCCLT_WIRE_*_MAP. docs/05.)
+    {
+        MutexLock lk(reg.mu_);
+        std::string exact = addr->str();
+        auto it = reg.edges_.find(exact);
+        if (it == reg.edges_.end()) {
+            std::string ip = exact.substr(0, exact.rfind(':'));
+            Registry::Entry e;
+            e.exact_key = exact;
+            e.ip_key = ip;
+            e.edge = std::make_shared<Edge>(reg.params_for(exact, ip));
+            it = reg.edges_.emplace(exact, std::move(e)).first;
+        }
+        it->second.edge->arm_chaos(std::move(faults));
+    }
+    return true;
 }
 
 std::shared_ptr<Edge> Registry::default_edge() {

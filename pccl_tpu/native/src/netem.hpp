@@ -44,6 +44,7 @@
 #include <map>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "annotations.hpp"
 #include "net_addr.hpp"
@@ -58,6 +59,54 @@ struct EdgeParams {
     double drop = 0;       // P(frame "lost") -> delivered late by ~RTO
 };
 
+// ---- chaos layer: time-scripted fault schedules (docs/05) ----
+//
+// PCCLT_WIRE_CHAOS_MAP=ip:port=fault;fault,...  where each fault is one of
+//   degrade@t=<T>:<R>mbit/<D>   at T, cap the edge to R Mbit/s for D
+//   flap@t=<T>:<D>x<N>          N outages of D each, one outage per 2D period
+//   blackhole@t=<T>:<D>         total outage (no frame moves) for D
+// T/D accept 5s / 200ms / plain seconds; 'x' may also be the Unicode '×'.
+// Faults for one edge are ';'-separated (',' separates edges, '=' after the
+// endpoint key). t=0 means "on arming": env schedules arm when the registry
+// first installs them (once per process per key — the per-conn refresh
+// never re-arms a running script); pccltNetemInject arms at call time, so
+// tests and the stress orchestrator can fire faults mid-run
+// deterministically.
+struct ChaosFault {
+    enum Kind : int { kDegrade = 0, kFlap = 1, kBlackhole = 2 };
+    Kind kind = kDegrade;
+    uint64_t start_ns = 0;   // relative to the schedule's arm time
+    uint64_t dur_ns = 0;     // one window (degrade/blackhole) or one outage
+    uint32_t repeat = 1;     // flap: number of outages
+    double mbps = 0;         // degrade: the capped rate
+};
+
+// what the schedule says the wire looks like *right now*
+struct ChaosVerdict {
+    bool outage = false;        // flap/blackhole window active
+    uint64_t outage_end_ns = 0; // absolute mono ns the outage lifts
+    double mbps_override = 0;   // >0: degrade window active at this rate
+};
+
+// Parse one ';'-separated fault schedule. Malformed faults are skipped
+// with a warning (mirroring parse_map); empty result = nothing usable.
+std::vector<ChaosFault> parse_chaos(const std::string &spec,
+                                    const char *what);
+
+// Arm `spec` on the edge resolved for `endpoint` ("ip:port") right now
+// (offsets relative to the call). Returns false when the spec parses to
+// nothing or the endpoint is not a valid ip:port. Backs pccltNetemInject.
+bool inject(const std::string &endpoint, const std::string &spec);
+
+// process-wide chaos accounting (stress orchestrator CHAOS SUMMARY):
+// schedules armed, and fault windows that actually activated (a flap of
+// N outages counts N activations)
+struct ChaosStats {
+    uint64_t armed = 0;
+    uint64_t activated = 0;
+};
+ChaosStats chaos_stats();
+
 // One emulated edge: this process -> one remote endpoint. Holds the
 // reservation-based leaky bucket (shared by every conn on the edge) and
 // computes per-frame delivery delays. Parameters are atomics so refresh()
@@ -69,16 +118,24 @@ public:
     EdgeParams params() const;
 
     bool pace_enabled() const {
-        return ns_per_byte_.load(std::memory_order_relaxed) > 0;
+        return ns_per_byte_.load(std::memory_order_relaxed) > 0 ||
+               chaos_armed_.load(std::memory_order_relaxed);
     }
     bool delay_enabled() const {
         return owd_ns_.load(std::memory_order_relaxed) > 0 ||
                jitter_ns_.load(std::memory_order_relaxed) > 0 ||
-               drop_.load(std::memory_order_relaxed) > 0;
+               drop_.load(std::memory_order_relaxed) > 0 ||
+               chaos_armed_.load(std::memory_order_relaxed);
     }
     // any emulation at all: callers use this to defeat the same-host
     // zero-copy transports (an emulated WAN cannot be bypassed)
     bool emulated() const { return pace_enabled() || delay_enabled(); }
+
+    // Arm a chaos schedule NOW (fault offsets relative to this call).
+    // Replaces any prior schedule on the edge; an empty list disarms.
+    void arm_chaos(std::vector<ChaosFault> faults);
+    // the schedule's verdict at mono time `now_ns` (0 = current time)
+    ChaosVerdict chaos_at(uint64_t now_ns = 0);
 
     // Reserve [next, next+bytes*ns_per_byte) in the edge's bucket and
     // sleep until the frame has fully drained. Small frames (<= 4 KiB)
@@ -91,16 +148,27 @@ public:
     uint64_t delivery_delay_ns();
 
 private:
+    // schedule scan under mu_ (pace/delivery already hold it)
+    ChaosVerdict chaos_eval(uint64_t now_ns) PCCLT_REQUIRES(mu_);
+
     std::atomic<double> ns_per_byte_{0};
     std::atomic<uint64_t> owd_ns_{0};
     std::atomic<uint64_t> jitter_ns_{0};
     std::atomic<double> drop_{0};
+    std::atomic<bool> chaos_armed_{false};
 
-    Mutex mu_;  // bucket + rng; lock-rank: 62
+    Mutex mu_;  // bucket + rng + chaos script; lock-rank: 62
     // bucket: end of the last reserved slot
     uint64_t next_ns_ PCCLT_GUARDED_BY(mu_) = 0;
     // splitmix64 state (jitter/drop)
     uint64_t rng_ PCCLT_GUARDED_BY(mu_) = 0x9E3779B97F4A7C15ull;
+    // chaos script: armed fault list + arm time; fired_ marks fault
+    // windows already counted as activated (flap: one bit per outage is
+    // overkill — the first outage of a fault marks it, per-outage
+    // activations are counted by index in fired_outages_)
+    std::vector<ChaosFault> chaos_ PCCLT_GUARDED_BY(mu_);
+    uint64_t chaos_t0_ PCCLT_GUARDED_BY(mu_) = 0;
+    std::vector<uint32_t> fired_outages_ PCCLT_GUARDED_BY(mu_);
 };
 
 // Deadline-ordered delivery timer shared by every delayed edge: one
@@ -148,6 +216,8 @@ public:
 
 private:
     Registry() { refresh(); }
+    // runtime chaos injection force-creates per-endpoint entries
+    friend bool inject(const std::string &endpoint, const std::string &spec);
     EdgeParams params_for(const std::string &exact_key,
                           const std::string &ip_key) const PCCLT_REQUIRES(mu_);
 
@@ -164,6 +234,12 @@ private:
         rtt_ PCCLT_GUARDED_BY(mu_), jitter_ PCCLT_GUARDED_BY(mu_),
         drop_ PCCLT_GUARDED_BY(mu_);
     EdgeParams global_ PCCLT_GUARDED_BY(mu_);
+    // PCCLT_WIRE_CHAOS_MAP schedules by key. A key arms ONCE per process
+    // (first resolve that matches it): refresh() re-reads the env but an
+    // armed script keeps its original t0 — mid-run re-reads must not
+    // restart a fault timeline that peers are already living through.
+    std::map<std::string, std::string> chaos_specs_ PCCLT_GUARDED_BY(mu_);
+    std::map<std::string, bool> chaos_armed_keys_ PCCLT_GUARDED_BY(mu_);
 };
 
 }  // namespace pcclt::net::netem

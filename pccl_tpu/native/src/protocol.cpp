@@ -336,6 +336,7 @@ std::vector<uint8_t> TelemetryDigestC2M::encode() const {
         w.f64(e.stall_ratio);
         w.u64(e.tx_bytes);
         w.u64(e.rx_bytes);
+        w.u8(e.wd_state);
     }
     w.u32(static_cast<uint32_t>(ops.size()));
     for (const auto &o : ops) {
@@ -388,8 +389,10 @@ std::optional<TelemetryDigestC2M> TelemetryDigestC2M::decode(
             e.stall_ratio = r.f64();
             e.tx_bytes = r.u64();
             e.rx_bytes = r.u64();
+            e.wd_state = r.u8();
             if (!valid_endpoint(e.endpoint) || !valid_rate(e.tx_mbps) ||
-                !valid_rate(e.rx_mbps) || !valid_rate(e.stall_ratio))
+                !valid_rate(e.rx_mbps) || !valid_rate(e.stall_ratio) ||
+                e.wd_state > 2)
                 return std::nullopt;
             d.edges.push_back(std::move(e));
         }

@@ -222,6 +222,11 @@ struct TelemetryDigestC2M {
         std::string endpoint;   // canonical "ip:port" (netem/telemetry key)
         double tx_mbps = 0, rx_mbps = 0, stall_ratio = 0;
         uint64_t tx_bytes = 0, rx_bytes = 0;
+        // data-plane watchdog verdict (telemetry::EdgeHealth): 0 ok /
+        // 1 suspect / 2 confirmed. A CONFIRMED report short-circuits the
+        // master's rate-based straggler detector — the peer is already
+        // relaying around the edge, so the background re-opt fires now.
+        uint8_t wd_state = 0;
     };
     std::vector<Edge> edges;
     struct Op {

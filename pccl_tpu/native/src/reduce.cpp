@@ -2,6 +2,7 @@
 
 #include <cstdlib>
 #include <cstring>
+#include <set>
 #include <vector>
 
 #include "kernels.hpp"
@@ -85,6 +86,294 @@ void send_ahead_windows(net::Link &tx, uint64_t tag, const uint8_t *src,
     }
 }
 
+// ---- edge watchdog + live window failover (docs/05 three-stage ladder) --
+//
+// Sender-side per-window progress deadlines: a window (send handle) that
+// misses factor x its EWMA-predicted drain time marks the outbound edge
+// SUSPECT and is RE-ISSUED over a fresh pool connection on the same edge
+// (flap recovery). If that also stalls, the edge is CONFIRMED and the
+// window — plus everything after it this op, and whole stages of later
+// ops while the verdict holds — detours through a healthy neighbor
+// (kRelayFwd). The receiver dedupes by byte range with first-arrival-wins
+// (SinkTable::place_deduped), so duplicate copies are dropped + counted
+// and numerics/byte-conservation hold exactly. Stalled direct handles the
+// op moved past become "zombies": their borrowed buffer spans stay valid
+// until they complete, so the op waits them out at the RS->AG boundary
+// (before the all-gather overwrites sent chunks) and at op end.
+struct Wd {
+    bool on = false;
+    bool relay_all = false;    // CONFIRMED: direct sends bypassed this op
+    bool skip_reissue = false; // edge has prior history: escalate faster
+    bool tripped = false;      // any escalation this op: blocks the clear
+    std::vector<net::SendHandle> zombies;
+    net::Link fresh;           // rung-1 extra pool conn (dialed once/op)
+    bool fresh_tried = false;
+    // Every direct send is launch-stamped here; the watchdog polls handle
+    // AGE both at the stage join and from inside stream_recv's wait slices
+    // — in a coupled ring stall the op thread lives in the RECEIVE loop
+    // (everyone's progress gates on the slow hop) and a join-only deadline
+    // would never observe its own stalled egress.
+    std::vector<std::pair<net::SendHandle, uint64_t>> inflight;
+    // handles already escalated (relayed): the join must zombie them, not
+    // escalate twice
+    std::set<const net::SendState *> detoured;
+};
+
+void wd_track(Wd &wd, const std::vector<net::SendHandle> &hs, size_t from = 0) {
+    if (!wd.on) return;
+    const uint64_t t = now_ns();
+    for (size_t i = from; i < hs.size(); ++i)
+        if (hs[i] && !hs[i]->span.empty()) wd.inflight.emplace_back(hs[i], t);
+}
+
+uint64_t wd_deadline_ns(const RingCtx &ctx, const telemetry::EdgeCounters *e,
+                        size_t bytes) {
+    uint64_t rate = e->wd_rate_bps.load(std::memory_order_relaxed);
+    // unseeded edges get a generous fixed envelope: a fresh world must not
+    // trip on its very first (cold, possibly slow) window
+    uint64_t base = rate > 0
+                        ? static_cast<uint64_t>(bytes * 1e9 / rate)
+                        : 500'000'000ull;
+    auto dl = static_cast<uint64_t>(base * ctx.wd_factor);
+    return std::max(dl, ctx.wd_min_ns);
+}
+
+void wd_update_rate(telemetry::EdgeCounters *e, size_t bytes, uint64_t dur_ns) {
+    // tiny windows and sub-ms joins sample scheduler noise, not the wire
+    if (!e || dur_ns < 1'000'000 || bytes < (64u << 10)) return;
+    auto rate = static_cast<uint64_t>(bytes * 1e9 / dur_ns);
+    uint64_t old = e->wd_rate_bps.load(std::memory_order_relaxed);
+    e->wd_rate_bps.store(
+        old ? static_cast<uint64_t>(0.7 * old + 0.3 * rate) : rate,
+        std::memory_order_relaxed);
+}
+
+void wd_init(Wd &wd, RingCtx &ctx) {
+    if (ctx.wd_factor <= 0 || !ctx.tx_edge) return;
+    // same-host zero-copy links opt out entirely: they have no WAN
+    // straggler mode worth a detour, and keeping them out makes relay
+    // frames and in-flight CMA fills mutually exclusive by construction —
+    // do_cma_fill writes outside the lock WITHOUT a dedupe claim, so a
+    // concurrent failover copy into the same sink would race it and break
+    // the delivered-unique conservation accounting
+    if (ctx.tx.cma_eligible()) return;
+    wd.on = true;
+    auto *e = ctx.tx_edge;
+    uint32_t h = e->wd_health.load(std::memory_order_relaxed);
+    using telemetry::EdgeHealth;
+    if (h == static_cast<uint32_t>(EdgeHealth::kConfirmed)) {
+        uint64_t since = e->wd_confirmed_at_ns.load(std::memory_order_relaxed);
+        if (ctx.relay_window && now_ns() - since < ctx.wd_hold_ns) {
+            wd.relay_all = true;  // verdict still holds: start in relay mode
+        } else {
+            // hold expired: re-probe the edge directly, but remember the
+            // history — a re-trip skips the reissue rung and relays at once
+            e->wd_health.store(static_cast<uint32_t>(EdgeHealth::kSuspect),
+                               std::memory_order_relaxed);
+            wd.skip_reissue = true;
+        }
+    } else if (h == static_cast<uint32_t>(EdgeHealth::kSuspect)) {
+        wd.skip_reissue = true;
+    }
+}
+
+void wd_mark(telemetry::EdgeCounters *e, telemetry::EdgeHealth v) {
+    auto nv = static_cast<uint32_t>(v);
+    uint32_t cur = e->wd_health.load(std::memory_order_relaxed);
+    while (cur < nv && !e->wd_health.compare_exchange_weak(
+                           cur, nv, std::memory_order_relaxed)) {
+    }
+    if (v == telemetry::EdgeHealth::kSuspect)
+        e->wd_suspects.fetch_add(1, std::memory_order_relaxed);
+    if (v == telemetry::EdgeHealth::kConfirmed) {
+        e->wd_confirms.fetch_add(1, std::memory_order_relaxed);
+        e->wd_confirmed_at_ns.store(now_ns(), std::memory_order_relaxed);
+    }
+}
+
+// detour [p, p+bytes) for `tag` through the relay in bounded windows (the
+// receiver's stream overlap granularity); false = no relay path
+bool wd_relay_span(RingCtx &ctx, uint64_t tag, uint64_t base_off,
+                   const uint8_t *p, size_t bytes) {
+    if (!ctx.relay_window) return false;
+    constexpr size_t kRelayWin = 1u << 20;
+    for (size_t off = 0; off < bytes; off += kRelayWin) {
+        size_t n = std::min(kRelayWin, bytes - off);
+        if (!ctx.relay_window(tag, base_off + off, {p + off, n})) return false;
+        ctx.tx_edge->wd_relays.fetch_add(1, std::memory_order_relaxed);
+    }
+    return true;
+}
+
+// Escalation ladder for ONE stalled window: SUSPECT -> re-issue over a
+// fresh pool conn on the same edge (flap recovery) -> CONFIRMED + relay
+// through a healthy neighbor. On success the direct handle (and a losing
+// re-issue) become zombies and the handle is marked detoured so neither
+// the join nor a later poll escalates it twice. Returns false when no
+// rung could take the window (caller keeps waiting the old way).
+bool wd_escalate(Wd &wd, RingCtx &ctx, const net::SendHandle &h) {
+    auto &rec = telemetry::Recorder::inst();
+    using telemetry::EdgeHealth;
+    const size_t b = h->span.size();
+    wd.tripped = true;
+    wd_mark(ctx.tx_edge, EdgeHealth::kSuspect);
+    if (rec.on())
+        rec.instant("watchdog", "edge_suspect", "bytes", b, "seq", ctx.op_seq);
+    net::SendHandle h2;
+    if (!wd.skip_reissue) {
+        if (!wd.fresh_tried) {
+            wd.fresh_tried = true;
+            if (ctx.fresh_tx_conn) wd.fresh = ctx.fresh_tx_conn();
+        }
+        if (wd.fresh.valid()) {
+            h2 = wd.fresh.send_at(h->tag, h->off, h->span, 0);
+            ctx.tx_edge->wd_reissues.fetch_add(1, std::memory_order_relaxed);
+            // the re-issue race gets a per-window allowance of its own: a
+            // flapped conn recovers here, a degraded EDGE (shared bucket)
+            // stalls both copies and escalates
+            const uint64_t rdl = wd_deadline_ns(ctx, ctx.tx_edge, b);
+            const uint64_t r0 = now_ns();
+            while (now_ns() - r0 < rdl) {
+                if (h->done() && h2->done()) break;  // both failed: relay
+                if ((h->done() && h->wait(0)) || (h2->done() && h2->wait(0))) {
+                    // first success wins; the loser keeps draining and its
+                    // frames dedupe receiver-side
+                    if (!h->done()) {
+                        wd.detoured.insert(h.get());
+                        wd.zombies.push_back(h);
+                    }
+                    if (!h2->done()) wd.zombies.push_back(h2);
+                    return true;
+                }
+                // park on whichever copy is still in flight (waiting on a
+                // DONE handle returns immediately — a failed direct copy
+                // must not turn this race into a busy-spin)
+                (h->done() ? h2 : h)->wait(20);
+            }
+        }
+    }
+    // --- CONFIRMED: relay the window through a neighbor ---
+    if (wd_relay_span(ctx, h->tag, h->off, h->span.data(), b)) {
+        wd_mark(ctx.tx_edge, EdgeHealth::kConfirmed);
+        wd.relay_all = true;
+        if (rec.on())
+            rec.instant("watchdog", "edge_confirm", "bytes", b, "seq",
+                        ctx.op_seq);
+        wd.detoured.insert(h.get());
+        wd.zombies.push_back(h);
+        if (h2 && !h2->done()) wd.zombies.push_back(h2);
+        return true;
+    }
+    if (h2 && !h2->done()) wd.zombies.push_back(h2);
+    return false;
+}
+
+// Age-based stall poll, run from stream_recv wait slices AND the stage
+// join. In a coupled ring stall every peer's op thread lives in its
+// RECEIVE loop (progress gates on the slow hop) and each stage join sees
+// handles that completed "just in time" — so the verdict anchors on how
+// long the OLDEST pending direct send has been in flight vs the deadline
+// for the WHOLE pending backlog (launches overlap; judging each window in
+// isolation would false-trip deep healthy queues and miss slow shallow
+// ones).
+void wd_poll(Wd &wd, RingCtx &ctx) {
+    if (!wd.on) return;
+    const uint64_t now = now_ns();
+    const net::SendHandle *oldest = nullptr;
+    uint64_t oldest_t = ~0ull;
+    size_t backlog = 0;
+    for (auto it = wd.inflight.begin(); it != wd.inflight.end();) {
+        const auto &h = it->first;
+        if (wd.detoured.count(h.get())) {
+            it = wd.inflight.erase(it);
+            continue;
+        }
+        if (h->done()) {
+            // healthy-state completions feed the EWMA baseline (a flagged
+            // edge's drain times would poison the recovered-state deadline)
+            if (ctx.tx_edge->wd_health.load(std::memory_order_relaxed) == 0)
+                wd_update_rate(ctx.tx_edge, h->span.size(), now - it->second);
+            it = wd.inflight.erase(it);
+            continue;
+        }
+        if (wd.relay_all) {
+            // edge already confirmed: detour every still-pending window now
+            if (wd_relay_span(ctx, h->tag, h->off, h->span.data(),
+                              h->span.size())) {
+                wd.detoured.insert(h.get());
+                wd.zombies.push_back(h);
+                it = wd.inflight.erase(it);
+                continue;
+            }
+        }
+        backlog += h->span.size();
+        if (it->second < oldest_t) {
+            oldest_t = it->second;
+            oldest = &it->first;
+        }
+        ++it;
+    }
+    if (!oldest || wd.relay_all) return;
+    if (now - oldest_t > wd_deadline_ns(ctx, ctx.tx_edge, backlog)) {
+        net::SendHandle h = *oldest;  // escalate mutates inflight bookkeeping
+        wd_escalate(wd, ctx, h);
+    }
+}
+
+// watchdog-aware stage join, replacing Link::wait_all on the TX handles.
+// Waits in slices, running the same age/backlog poll as the receive loop;
+// escalated handles surface as zombies. Returns false only when a window
+// could not be delivered by ANY rung (direct, re-issue, relay) — the
+// caller fails the op exactly as before.
+bool wd_join(Wd &wd, RingCtx &ctx, std::vector<net::SendHandle> &hs) {
+    bool ok = true;
+    for (auto &h : hs) {
+        if (!h) continue;
+        const size_t b = h->span.size();
+        while (!h->done() && !wd.detoured.count(h.get())) {
+            if (b > 0 && wd.relay_all) {
+                if (wd_relay_span(ctx, h->tag, h->off, h->span.data(), b)) {
+                    wd.detoured.insert(h.get());
+                    wd.zombies.push_back(h);
+                    break;
+                }
+            }
+            if (b > 0) wd_poll(wd, ctx);
+            if (wd.detoured.count(h.get())) break;
+            h->wait(50);
+        }
+        if (wd.detoured.count(h.get())) {
+            // already zombied by whichever site detoured it (wd_escalate /
+            // wd_poll / the relay branch above) — nothing more to do
+            continue;
+        }
+        if (!h->wait(0)) {
+            // failed outright (conn death/flap): the relay rescues it
+            if (b > 0 && wd_relay_span(ctx, h->tag, h->off, h->span.data(),
+                                       b)) {
+                wd.tripped = true;
+                wd_mark(ctx.tx_edge, telemetry::EdgeHealth::kConfirmed);
+                wd.relay_all = true;
+            } else {
+                ok = false;
+            }
+        }
+    }
+    return ok;
+}
+
+// A clean op proves the edge: every direct window met its deadline and no
+// rung ran — a SUSPECT verdict (prior history / expired hold) drops back
+// to OK so digests, the master's straggler flag, the EWMA feed and the
+// reissue rung all recover once the edge behaves again. CONFIRMED is not
+// cleared here: only wd_init's hold-expiry re-probe can demote it.
+void wd_op_clean(Wd &wd, RingCtx &ctx) {
+    if (!wd.on || wd.tripped || wd.relay_all) return;
+    uint32_t susp = static_cast<uint32_t>(telemetry::EdgeHealth::kSuspect);
+    ctx.tx_edge->wd_health.compare_exchange_strong(
+        susp, 0, std::memory_order_relaxed);
+}
+
 struct ChunkSpan {
     size_t start_elem, n_elems;
 };
@@ -108,13 +397,25 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
                  const uint8_t *scratch,
                  const std::function<void(const uint8_t *src, size_t lo, size_t hi)> &on_data,
                  Prof *prof = nullptr, bool fill_if_unmapped = false,
-                 size_t step = 0) {
+                 size_t step = 0, Wd *wd = nullptr) {
     // step: wait/consume granularity — the windowed pipeline passes its
     // window granule so cross-stage send-ahead fires per window instead of
     // per kSubChunk (0 = the classic sub-chunk streaming)
     if (step == 0 || step > kSubChunk) step = kSubChunk;
     using Claim = net::SinkTable::CmaClaim;
     size_t consumed = 0;
+    // receiver-side watchdog witness: contiguous-prefix progress past its
+    // deadline envelope marks the INBOUND edge SUSPECT — per-direction
+    // verdict (the sender side owns failover; this side feeds the digest).
+    // Disabled when rx and tx alias the same EdgeCounters (world == 2:
+    // predecessor == successor): the rx clean-stream clear and the rx
+    // whole-stream EWMA would stomp the TX ladder's state mid-escalation.
+    const bool rx_wd =
+        ctx.wd_factor > 0 && ctx.rx_edge && ctx.rx_edge != ctx.tx_edge;
+    uint64_t rx_t0 = rx_wd ? now_ns() : 0;
+    uint64_t last_prog_t = rx_t0;
+    size_t last_prog = 0;
+    bool rx_suspected = false;
     while (consumed < target) {
         if (consumed == 0) {
             // a pending same-host descriptor covers the whole payload: pull
@@ -142,6 +443,32 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
         bool cma_pending = false;
         size_t filled = ctx.rx.table().wait_filled(tag, want, 100, &cma_pending);
         if (prof) prof->wait_ns += now_ns() - t0;
+        // sender-side stall poll from the RECEIVE loop: in a coupled ring
+        // stall the op thread lives here, never long in the stage join —
+        // the age-based verdict must run where the thread actually is
+        if (wd && wd->on) wd_poll(*wd, ctx);
+        if (rx_wd) {
+            if (filled > last_prog) {
+                last_prog = filled;
+                last_prog_t = now_ns();
+            } else if (!rx_suspected &&
+                       now_ns() - last_prog_t >
+                           wd_deadline_ns(ctx, ctx.rx_edge,
+                                          std::min(step, target))) {
+                rx_suspected = true;
+                ctx.rx_edge->wd_suspects.fetch_add(1,
+                                                   std::memory_order_relaxed);
+                uint32_t zero = 0;
+                ctx.rx_edge->wd_health.compare_exchange_strong(
+                    zero,
+                    static_cast<uint32_t>(telemetry::EdgeHealth::kSuspect),
+                    std::memory_order_relaxed);
+                if (telemetry::Recorder::inst().on())
+                    telemetry::Recorder::inst().instant(
+                        "watchdog", "rx_stall_suspect", "filled", filled,
+                        "target", target);
+            }
+        }
         if (cma_pending) {
             if (consumed == 0) continue; // claim fused at the top of the loop
             // fused no longer possible (TCP bytes already consumed): a late
@@ -160,6 +487,20 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
         if (consumed >= target) break;
         if (ctx.should_abort && ctx.should_abort()) return false;
         if (!ctx.rx.alive()) return false;
+    }
+    if (rx_wd) {
+        // inbound EWMA baseline: whole-stream achieved rate (includes the
+        // fused compute — an under-estimate, i.e. a LONGER rx deadline;
+        // the witness stays conservative)
+        wd_update_rate(ctx.rx_edge, target, now_ns() - rx_t0);
+        if (!rx_suspected) {
+            // clean stream: a suspect verdict from a past op clears once
+            // the edge delivers inside its envelope again
+            uint32_t susp =
+                static_cast<uint32_t>(telemetry::EdgeHealth::kSuspect);
+            ctx.rx_edge->wd_health.compare_exchange_strong(
+                susp, 0, std::memory_order_relaxed);
+        }
     }
     return true;
 }
@@ -215,6 +556,10 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // stage's accumulation callback as windows complete.
     std::vector<net::SendHandle> ahead_hs;
     size_t ahead_off = 0;
+    // edge watchdog (docs/05): relay mode persists across ops via the
+    // tx edge's health verdict while the CONFIRMED hold lasts
+    Wd wd;
+    wd_init(wd, ctx);
 
     auto restore = [&] {
         // purge FIRST: stage-ahead all-gather sinks point into `recv`, and an
@@ -228,6 +573,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         // in-flight send-ahead windows borrow spans of `recv`: they must
         // complete (or fail with their conn) before restore can overwrite it
         net::Link::wait_all(ahead_hs);
+        // ...as do zombie direct sends the failover moved past
+        net::Link::wait_all(wd.zombies);
+        wd.zombies.clear();
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " failing (conn_lost="
                      << conn_lost << "), purging";
         restore();
@@ -254,8 +602,13 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                          std::span<const uint8_t> payload) {
         std::vector<net::SendHandle> hs;
         if (!meta.empty()) hs.push_back(ctx.tx.send_meta(tag | kMetaBit, std::move(meta)));
+        if (wd.relay_all &&
+            wd_relay_span(ctx, tag, 0, payload.data(), payload.size()))
+            return hs;  // confirmed edge: the whole chunk detours (metas
+                        // stay direct — a degraded pipe still moves 100 B)
         auto ph = ctx.tx.send_async(tag, payload, ctx.op_seq);
         hs.insert(hs.end(), ph.begin(), ph.end());
+        wd_track(wd, hs);
         return hs;
     };
     // Phase accumulators are always collected: the per-edge stall counter
@@ -266,9 +619,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     const bool trace = rec.on();
     Prof prof;
     auto op_t0 = now_ns();
-    auto join_tx = [&](const std::vector<net::SendHandle> &hs) -> bool {
+    auto join_tx = [&](std::vector<net::SendHandle> &hs) -> bool {
         auto t0 = now_ns();
-        bool ok = net::Link::wait_all(hs);
+        bool ok = wd.on ? wd_join(wd, ctx, hs) : net::Link::wait_all(hs);
         prof.join_ns += now_ns() - t0;
         return ok;
     };
@@ -288,13 +641,17 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // queued-copy path, never lost.
     auto send_ahead = [&](uint64_t next_tag, const uint8_t *src,
                           size_t chunk_bytes, size_t wb, size_t prefix) {
+        size_t pre = ahead_hs.size();
         send_ahead_windows(ctx.tx, next_tag, src, chunk_bytes, wb, prefix,
                            ctx.op_seq, &ahead_off, &ahead_hs);
+        wd_track(wd, ahead_hs, pre);
     };
     // window granule for a chunk, 0 = no windowing (pipeline off or chunk
     // below the window floor)
     auto win_bytes = [&](size_t chunk_bytes) -> size_t {
-        if (!pipelined) return 0;
+        // relay mode sends whole stage chunks through the detour — the
+        // cross-stage send-ahead would direct-send around it
+        if (!pipelined || wd.relay_all) return 0;
         size_t w = pipeline_windows(chunk_bytes);
         if (w <= 1) return 0;
         return std::max(esz, chunk_bytes / w / esz * esz);
@@ -362,8 +719,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                 meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
                                            send_ptr, send_span.n_elems);
             });
-            const size_t qw =
-                pipelined ? pipeline_windows(send_span.n_elems * qsz) : 1;
+            const size_t qw = pipelined && !wd.relay_all
+                                  ? pipeline_windows(send_span.n_elems * qsz)
+                                  : 1;
             if (qw <= 1) {
                 quant_timed([&] {
                     quant::quantize(meta, send_ptr, tx_scratch.data(),
@@ -414,7 +772,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       quant::dequantize_accumulate(
                                           rx_meta, ctx.op, src,
                                           recv_ptr + e0 * esz, e1 - e0);
-                                  }, &prof);
+                                  }, &prof, /*fill_if_unmapped=*/false, 0, &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -466,12 +824,22 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       if (wb)
                                           send_ahead(next_tag, recv_ptr,
                                                      chunk_bytes, wb, hi);
-                                  }, &prof, /*fill_if_unmapped=*/false, wb);
+                                  }, &prof, /*fill_if_unmapped=*/false, wb,
+                                  &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += chunk_bytes;
         }
+    }
+
+    // RS->AG boundary: zombie direct sends borrow spans of chunks the
+    // all-gather is about to overwrite — they must drain (or fail with
+    // their conn) first. Only the transition op pays this; later ops under
+    // a held CONFIRMED verdict start in relay mode and leave no zombies.
+    if (!wd.zombies.empty()) {
+        net::Link::wait_all(wd.zombies);
+        wd.zombies.clear();
     }
 
     if (trace)
@@ -512,7 +880,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                 });
                 fwd_meta = meta.encode();
                 const size_t qw =
-                    pipelined ? pipeline_windows(send_span.n_elems * qsz) : 1;
+                    pipelined && !wd.relay_all
+                        ? pipeline_windows(send_span.n_elems * qsz)
+                        : 1;
                 if (qw > 1) {
                     // per-window quantize→send overlap (one whole-chunk
                     // meta, wire format unchanged); the owner's bit-parity
@@ -577,7 +947,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
                                       quant::dequantize_set(*m, src,
                                                             recv_ptr + e0 * esz, e1 - e0);
-                                  }, &prof);
+                                  }, &prof, /*fill_if_unmapped=*/false, 0, &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -623,7 +993,8 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       if (wb)
                                           send_ahead(next_tag, recv_ptr,
                                                      chunk_bytes, wb, hi);
-                                  }, &prof, /*fill_if_unmapped=*/true, wb);
+                                  }, &prof, /*fill_if_unmapped=*/true, wb,
+                                  &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
             if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
@@ -634,6 +1005,13 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     if (ctx.op == proto::RedOp::kAvg)
         kernels::finalize_avg(ctx.dtype, recv, count, world);
 
+    // zombie direct sends still borrow result-buffer spans; the purge also
+    // needs their tags quiet before retiring the op's range
+    if (!wd.zombies.empty()) {
+        net::Link::wait_all(wd.zombies);
+        wd.zombies.clear();
+    }
+    wd_op_clean(wd, ctx);  // clean direct op: SUSPECT history drops to OK
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
     uint64_t op_t1 = now_ns();
@@ -700,8 +1078,10 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // same windowed cross-stage send-ahead as the all-reduce (docs/08):
     // the segment received at stage s is the one forwarded at stage s+1
     const bool pipelined = pipeline_enabled() && !ctx.tx.cma_eligible();
+    Wd wd;
+    wd_init(wd, ctx);
     size_t wb = 0;
-    if (pipelined) {
+    if (pipelined && !wd.relay_all) {
         size_t w = pipeline_windows(seg);
         if (w > 1) wb = std::max(esz, seg / w / esz * esz);
     }
@@ -715,7 +1095,10 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
         const uint8_t *src = s == 0 ? static_cast<const uint8_t *>(send)
                                     : out + slot(fwd_rank) * seg;
         std::vector<net::SendHandle> tx_job;
-        if (ahead_off > 0) {
+        if (wd.relay_all && ahead_off == 0 &&
+            wd_relay_span(ctx, tag, 0, src, seg)) {
+            // confirmed edge: the whole segment detours via the relay
+        } else if (ahead_off > 0) {
             tx_job = std::move(ahead_hs);
             ahead_hs.clear();
             if (ahead_off < seg)
@@ -746,15 +1129,22 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                                          seg, swb, hi,
                                                          ctx.op_seq, &ahead_off,
                                                          &ahead_hs);
-                              }, &prof, /*fill_if_unmapped=*/true, swb);
+                              }, &prof, /*fill_if_unmapped=*/true, swb, &wd);
         ctx.rx.table().unregister_sink(tag);
-        bool tx_ok = net::Link::wait_all(tx_job);
+        bool tx_ok = wd.on ? wd_join(wd, ctx, tx_job)
+                           : net::Link::wait_all(tx_job);
         if (!ok || !tx_ok) {
             net::Link::wait_all(ahead_hs); // next-stage windows borrow `out`
+            net::Link::wait_all(wd.zombies);
             return fail(!ctx.rx.alive() || !ctx.tx.alive());
         }
         ctx.rx_bytes += seg;
     }
+    if (!wd.zombies.empty()) {  // zombie sends borrow spans of `out`
+        net::Link::wait_all(wd.zombies);
+        wd.zombies.clear();
+    }
+    wd_op_clean(wd, ctx);
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
     uint64_t op_t1 = now_ns();

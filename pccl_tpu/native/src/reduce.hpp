@@ -45,6 +45,27 @@ struct RingCtx {
     // predecessor's canonical endpoint) — receiver wire-stall time is
     // charged here at op end. Optional; null skips attribution.
     telemetry::EdgeCounters *rx_edge = nullptr;
+    // ---- straggler-immune data plane (docs/05 three-stage ladder) ----
+    // Edge watchdog config, resolved by the client per op from
+    // PCCLT_WATCHDOG / PCCLT_WATCHDOG_FACTOR / PCCLT_WATCHDOG_MIN_MS.
+    // wd_factor == 0 disables the watchdog entirely (the default).
+    double wd_factor = 0;     // deadline = factor x EWMA window drain time
+    uint64_t wd_min_ns = 0;   // deadline floor (absorbs scheduler noise)
+    uint64_t wd_hold_ns = 0;  // how long a CONFIRMED verdict keeps the op
+                              // in relay mode before re-probing direct
+    // outbound edge's counters (ring successor) — watchdog verdicts,
+    // EWMA baseline and failover accounting live here
+    telemetry::EdgeCounters *tx_edge = nullptr;
+    // failover rung 1: dial ONE extra pool connection to the ring
+    // successor (flap recovery) and return a Link holding only it;
+    // an invalid Link means the dial failed
+    std::function<net::Link()> fresh_tx_conn;
+    // failover rung 2: detour a window around the outbound edge through a
+    // healthy neighbor (kRelayFwd). The implementation copies the bytes
+    // (fire-and-forget toward the relay); false = no relay path exists
+    // (world < 3 or no live link to any third peer).
+    std::function<bool(uint64_t tag, uint64_t off,
+                       std::span<const uint8_t> payload)> relay_window;
     // the comm's counter domain: completed ops deposit an OpSample
     // (seq/duration/stall) for the telemetry digest. Optional.
     telemetry::Domain *tele = nullptr;

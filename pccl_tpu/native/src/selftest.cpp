@@ -15,6 +15,7 @@
 
 #include "atsp.hpp"
 #include "client.hpp"
+#include "netem.hpp"
 #include "guarded_alloc.hpp"
 #include "journal.hpp"
 #include "hash.hpp"
@@ -283,6 +284,145 @@ static void test_observability() {
     rec.clear();
     rec.enable(was_on);
     fprintf(stderr, "observability: ok\n");
+}
+
+// Chaos schedule grammar + timing (netem.hpp, docs/05): parser accepts the
+// documented fault kinds and skips garbage; an armed script degrades /
+// blacks out the edge at its scripted offsets; runtime injection validates
+// its inputs. Timing checks use generous windows (sanitizer lanes run on
+// loaded single-core boxes).
+static void test_chaos_schedule() {
+    using namespace net::netem;
+    constexpr uint64_t kMs = 1'000'000ull;
+
+    auto fs = parse_chaos(
+        "degrade@t=0s:40mbit/200ms; flap@t=100ms:50msx3; blackhole@t=1s:2s",
+        "selftest");
+    CHECK(fs.size() == 3);
+    CHECK(fs[0].kind == ChaosFault::kDegrade && fs[0].mbps == 40.0 &&
+          fs[0].start_ns == 0 && fs[0].dur_ns == 200 * kMs);
+    CHECK(fs[1].kind == ChaosFault::kFlap && fs[1].repeat == 3 &&
+          fs[1].start_ns == 100 * kMs && fs[1].dur_ns == 50 * kMs);
+    CHECK(fs[2].kind == ChaosFault::kBlackhole &&
+          fs[2].dur_ns == 2000 * kMs);
+    // Unicode multiplication sign + the no-@ (fire-on-arm) form
+    auto f2 = parse_chaos("flap:10ms\xc3\x97""2", "selftest");
+    CHECK(f2.size() == 1 && f2[0].repeat == 2 && f2[0].start_ns == 0);
+    // malformed faults are skipped, good neighbors survive
+    CHECK(parse_chaos("junk", "selftest").empty());
+    CHECK(parse_chaos("degrade@t=0s:xmbit/1s", "selftest").empty());
+    CHECK(parse_chaos("meteor@t=0s:1s;blackhole@t=0s:1s", "selftest").size() ==
+          1);
+
+    auto st0 = chaos_stats();
+    // a degrade window overrides the rate, then lifts
+    Edge e;
+    e.arm_chaos({ChaosFault{ChaosFault::kDegrade, 0, 150 * kMs, 1, 25.0}});
+    CHECK(e.pace_enabled());  // armed chaos counts as emulation
+    auto v = e.chaos_at(0);
+    CHECK(v.mbps_override == 25.0 && !v.outage);
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    v = e.chaos_at(0);
+    CHECK(v.mbps_override == 0 && !v.outage);
+
+    // a blackhole stalls pace() until the outage lifts
+    Edge b;
+    b.arm_chaos({ChaosFault{ChaosFault::kBlackhole, 0, 120 * kMs, 1, 0}});
+    auto t0 = std::chrono::steady_clock::now();
+    b.pace(1);
+    auto waited = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+    CHECK(waited >= 60);  // slept out (most of) the outage window
+    CHECK(b.delivery_delay_ns() == 0 || waited < 120);  // lifted afterwards
+
+    // flap periodicity: outage windows of D at period 2D, `repeat` times
+    Edge f;
+    f.arm_chaos({ChaosFault{ChaosFault::kFlap, 0, 100 * kMs, 2, 0}});
+    std::this_thread::sleep_for(std::chrono::milliseconds(40));
+    CHECK(f.chaos_at(0).outage);  // inside outage 1 [0, 100ms)
+    std::this_thread::sleep_for(std::chrono::milliseconds(110));
+    CHECK(!f.chaos_at(0).outage);  // gap [100ms, 200ms)
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    CHECK(f.chaos_at(0).outage);  // outage 2 [200ms, 300ms)
+    std::this_thread::sleep_for(std::chrono::milliseconds(160));
+    CHECK(!f.chaos_at(0).outage);  // repeat budget spent
+
+    auto st1 = chaos_stats();
+    CHECK(st1.armed >= st0.armed + 3);
+    CHECK(st1.activated >= st0.activated + 4);  // degrade + hole + 2 flaps
+
+    // runtime injection validates endpoint + spec; empty spec disarms
+    CHECK(inject("127.0.0.1:45997", "blackhole@t=0s:50ms"));
+    CHECK(inject("127.0.0.1:45997", ""));
+    CHECK(!inject("no-port", "blackhole@t=0s:1s"));
+    CHECK(!inject("127.0.0.1:45997", "meteor@t=0s:1s"));
+}
+
+// Straggler-failover delivery + dedupe (SinkTable::deliver_window,
+// docs/05): first arrival wins byte-exactly, duplicates and late copies
+// for completed tags are dropped AND counted, windows racing registration
+// park and drain — the conservation identity
+// rx + rx_relay - dup == unique holds by construction.
+static void test_watchdog() {
+    telemetry::EdgeCounters origin;
+    auto ld = [&](const std::atomic<uint64_t> &a) { return a.load(); };
+    net::SinkTable t;
+    std::vector<uint8_t> sink(8192, 0);
+
+    t.register_sink(7, sink.data(), sink.size());
+    std::vector<uint8_t> w(4096, 0xAA);
+    t.deliver_window(7, 0, w, &origin);
+    CHECK(ld(origin.rx_relay_bytes) == 4096 && ld(origin.dup_bytes) == 0);
+    CHECK(t.wait_filled(7, 4096, 0) == 4096);
+    CHECK(sink[0] == 0xAA && sink[4095] == 0xAA);
+
+    // exact duplicate: dropped, counted — bytes in the sink untouched
+    std::vector<uint8_t> w2(4096, 0xBB);
+    t.deliver_window(7, 0, w2, &origin);
+    CHECK(ld(origin.rx_relay_bytes) == 8192);
+    CHECK(ld(origin.dup_bytes) == 4096 && ld(origin.dup_windows) == 1);
+    CHECK(sink[0] == 0xAA);  // first arrival won
+
+    // partial overlap: only the uncovered tail lands, the rest is dup
+    std::vector<uint8_t> w3(4096, 0xCC);
+    t.deliver_window(7, 2048, w3, &origin);
+    CHECK(t.wait_filled(7, 6144, 0) == 6144);
+    CHECK(sink[4095] == 0xAA && sink[4096] == 0xCC && sink[6143] == 0xCC);
+    CHECK(ld(origin.dup_bytes) == 4096 + 2048);
+    CHECK(ld(origin.dup_windows) == 1);  // partially useful != duplicate
+
+    // a window racing ahead of registration parks, then drains deduped
+    std::vector<uint8_t> small(1024, 0xDD);
+    t.deliver_window(9, 0, small, &origin);
+    uint64_t relayed_before = ld(origin.rx_relay_bytes);
+    std::vector<uint8_t> sink2(1024, 0);
+    t.register_sink(9, sink2.data(), sink2.size());
+    CHECK(t.wait_filled(9, 1024, 0) == 1024);
+    CHECK(sink2[0] == 0xDD);
+    CHECK(ld(origin.rx_relay_bytes) == relayed_before + 1024);
+
+    // a FULLY delivered sink retires its tag: late copies count as dup...
+    t.unregister_sink(9);
+    uint64_t dup_before = ld(origin.dup_bytes);
+    t.deliver_window(9, 0, small, &origin);
+    CHECK(ld(origin.dup_bytes) == dup_before + 1024);
+    // ...but re-registration un-retires (tag reuse stays legal)
+    std::fill(sink2.begin(), sink2.end(), 0);
+    t.register_sink(9, sink2.data(), sink2.size());
+    t.deliver_window(9, 0, small, &origin);
+    CHECK(t.wait_filled(9, 1024, 0) == 1024 && sink2[0] == 0xDD);
+    t.unregister_sink(9);
+    t.unregister_sink(7);
+
+    // watchdog health ladder on the counters themselves
+    telemetry::EdgeCounters e;
+    CHECK(e.wd_health.load() ==
+          static_cast<uint32_t>(telemetry::EdgeHealth::kOk));
+    e.wd_health.store(static_cast<uint32_t>(telemetry::EdgeHealth::kSuspect));
+    e.wd_health.store(
+        static_cast<uint32_t>(telemetry::EdgeHealth::kConfirmed));
+    CHECK(e.wd_health.load() == 2u);
 }
 
 static void test_wire() {
@@ -1185,6 +1325,8 @@ int main() {
     test_lock_annotations();
     test_telemetry();
     test_observability();
+    test_chaos_schedule();
+    test_watchdog();
     test_wire();
     test_hash();
     test_kernels();

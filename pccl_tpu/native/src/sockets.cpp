@@ -550,6 +550,115 @@ bool SendState::wait(int timeout_ms) const {
 
 // ---------- SinkTable ----------
 
+bool SinkTable::Sink::fully_covered(size_t off, size_t end) const {
+    // walk [off, end) against prefix + extents + claims; any gap = false
+    size_t at = off;
+    while (at < end) {
+        if (at < prefix) {
+            at = prefix;
+            continue;
+        }
+        size_t next = end;  // nearest covered interval starting at/before at
+        bool advanced = false;
+        for (const auto *m : {&extents, &claims}) {
+            auto it = m->upper_bound(at);
+            if (it != m->begin()) {
+                auto p = std::prev(it);
+                if (p->second > at) {
+                    at = p->second;
+                    advanced = true;
+                    break;
+                }
+            }
+            if (it != m->end()) next = std::min(next, it->first);
+        }
+        if (!advanced) {
+            if (at < next) return false;  // a genuine gap
+        }
+    }
+    return true;
+}
+
+size_t SinkTable::place_deduped(Sink &s, uint64_t tag, uint64_t off,
+                                const uint8_t *bytes, size_t len) {
+    // copy only the gaps the coverage map leaves open. Claimed ranges are
+    // skipped WITHOUT publishing an extent over them — the claiming RX
+    // thread publishes when its write completes (publishing early would
+    // let a consumer read bytes still being written).
+    size_t delivered = 0;
+    size_t at = off;
+    const size_t end = off + len;
+    while (at < end) {
+        // find the covered interval (prefix/extent/claim) containing `at`
+        size_t covered_to = 0;
+        if (at < s.prefix) covered_to = s.prefix;
+        for (const auto *m : {&s.extents, &s.claims}) {
+            auto it = m->upper_bound(at);
+            if (it != m->begin()) {
+                auto p = std::prev(it);
+                if (p->second > at) covered_to = std::max(covered_to, p->second);
+            }
+        }
+        if (covered_to > at) {
+            at = std::min(covered_to, end);
+            continue;
+        }
+        // gap starts at `at`: runs to the nearest covered interval start
+        size_t gap_end = end;
+        for (const auto *m : {&s.extents, &s.claims}) {
+            auto it = m->upper_bound(at);
+            if (it != m->end()) gap_end = std::min(gap_end, it->first);
+        }
+        memcpy(s.base + at, bytes + (at - off), gap_end - at);
+        s.add_extent(at, gap_end);
+        delivered += gap_end - at;
+        at = gap_end;
+    }
+    (void)tag;
+    return delivered;
+}
+
+void SinkTable::deliver_window(uint64_t tag, uint64_t off,
+                               std::vector<uint8_t> bytes,
+                               telemetry::EdgeCounters *origin) {
+    const size_t n = bytes.size();
+    size_t delivered = 0;
+    bool handled = false;
+    {
+        MutexLock lk(mu_);
+        if (is_retired(tag)) {
+            handled = true;  // straggler for a finished op: drop + count dup
+        } else {
+            auto it = sinks_.find(tag);
+            if (it != sinks_.end() && !it->second.cancel &&
+                off + n <= it->second.cap) {
+                delivered = place_deduped(it->second, tag, off, bytes.data(), n);
+                handled = true;
+            } else if (it == sinks_.end()) {
+                // raced ahead of the stage's registration: park it;
+                // register_sink drains with the same dedupe + accounting
+                relay_pending_.emplace(tag,
+                                       PendingRelay{off, std::move(bytes),
+                                                    origin});
+            } else {
+                handled = true;  // cancelled/overflow: unwanted, count dup
+            }
+        }
+    }
+    signal_tag(tag);
+    if (!handled || !origin) return;
+    // symmetric with the direct path's rx_bytes: EVERY handled relay byte
+    // counts as received, and the not-delivered remainder as duplicate —
+    // so rx_bytes + rx_relay_bytes - dup_bytes == unique payload, exactly
+    origin->rx_relay_bytes.fetch_add(n, std::memory_order_relaxed);
+    origin->rx_relay_windows.fetch_add(1, std::memory_order_relaxed);
+    if (delivered < n) {
+        origin->dup_bytes.fetch_add(n - delivered, std::memory_order_relaxed);
+        if (delivered == 0)
+            origin->dup_windows.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
 void SinkTable::Sink::add_extent(size_t off, size_t end) {
     if (off <= prefix) {
         prefix = std::max(prefix, end);
@@ -581,6 +690,12 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
     std::vector<PendingDesc> descs;
     {
         MutexLock lk(mu_);
+        // un-retire a completed-tag marker (single-tag entries from
+        // unregister_sink): re-registration means the tag is live again
+        for (auto it = retired_.begin(); it != retired_.end();)
+            it = (it->first == tag && it->second == tag + 1)
+                     ? retired_.erase(it)
+                     : std::next(it);
         Sink s;
         s.base = base;
         s.cap = cap;
@@ -602,7 +717,33 @@ void SinkTable::register_sink(uint64_t tag, uint8_t *base, size_t cap,
             }
             queues_.erase(qit);
         }
-        sinks_[tag] = std::move(s);
+        auto &sink = sinks_[tag] = std::move(s);
+        // failover windows that raced this registration: place them now,
+        // with the same dedupe + origin accounting as a live delivery
+        auto rrange = relay_pending_.equal_range(tag);
+        for (auto it = rrange.first; it != rrange.second; ++it) {
+            PendingRelay &pr = it->second;
+            const size_t n = pr.bytes.size();
+            size_t delivered = 0;
+            if (!sink.cancel && pr.off + n <= sink.cap)
+                delivered =
+                    place_deduped(sink, tag, pr.off, pr.bytes.data(), n);
+            if (pr.origin) {
+                // same received/duplicate split as a live delivery
+                pr.origin->rx_relay_bytes.fetch_add(
+                    n, std::memory_order_relaxed);
+                pr.origin->rx_relay_windows.fetch_add(
+                    1, std::memory_order_relaxed);
+                if (delivered < n) {
+                    pr.origin->dup_bytes.fetch_add(
+                        n - delivered, std::memory_order_relaxed);
+                    if (delivered == 0)
+                        pr.origin->dup_windows.fetch_add(
+                            1, std::memory_order_relaxed);
+                }
+            }
+        }
+        relay_pending_.erase(rrange.first, rrange.second);
         if (!consumer_pull) {
             auto range = pending_descs_.equal_range(tag);
             for (auto it = range.first; it != range.second; ++it)
@@ -687,8 +828,20 @@ void SinkTable::unregister_sink(uint64_t tag) {
     auto it = sinks_.find(tag);
     if (it == sinks_.end()) return;
     it->second.cancel = true;
+    // a FULLY streamed sink retires its tag: any copy arriving later (a
+    // zombie direct send whose window the failover already delivered via
+    // re-issue/relay) is by definition a duplicate — it must be dropped
+    // AND counted, not parked in a queue nobody will ever read (that
+    // silently broke the delivered-unique conservation invariant).
+    // register_sink un-retires on reuse, so non-op tag reuse stays legal.
+    const bool complete =
+        it->second.cap > 0 && it->second.prefix >= it->second.cap;
     wait_not_busy_range(tag, tag + 1);
     sinks_.erase(tag);
+    if (complete) {
+        retired_.emplace_back(tag, tag + 1);
+        if (retired_.size() > 512) retired_.pop_front();
+    }
 }
 
 std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
@@ -733,6 +886,9 @@ void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
             it = (it->first >= lo && it->first < hi) ? sinks_.erase(it) : std::next(it);
         for (auto it = queues_.begin(); it != queues_.end();)
             it = (it->first >= lo && it->first < hi) ? queues_.erase(it) : std::next(it);
+        for (auto it = relay_pending_.begin(); it != relay_pending_.end();)
+            it = (it->first >= lo && it->first < hi) ? relay_pending_.erase(it)
+                                                     : std::next(it);
         for (auto it = pending_descs_.begin(); it != pending_descs_.end();) {
             if (it->first >= lo && it->first < hi) {
                 dropped.push_back(it->second);
@@ -744,7 +900,7 @@ void SinkTable::purge_range(uint64_t lo, uint64_t hi) {
         // remember the purge: stragglers for these tags arriving from now on
         // are dropped on receipt (tag ranges are never reused)
         retired_.emplace_back(lo, hi);
-        if (retired_.size() > 128) retired_.pop_front();
+        if (retired_.size() > 512) retired_.pop_front();
     }
     // wake every waiter: a consumer parked on a purged tag must notice the
     // missing sink now, not at its next poll slice
@@ -955,6 +1111,25 @@ SendHandle MultiplexConn::send_copy(uint64_t tag, std::vector<uint8_t> payload) 
 bool MultiplexConn::send_bytes(uint64_t tag, std::span<const uint8_t> data,
                                bool allow_cma) {
     return send_async(tag, 0, data, allow_cma)->wait(-1);
+}
+
+SendHandle MultiplexConn::send_owned(uint8_t kind, uint64_t tag, uint64_t off,
+                                     std::vector<uint8_t> payload) {
+    auto st = std::make_shared<SendState>();
+    st->tag = tag;
+    st->off = off;
+    // always via the TX thread: relay senders run on RX threads and must
+    // not block on wr_mu_ (or pace) inline
+    auto *req = new SendReq;
+    req->kind = static_cast<Kind>(kind);
+    req->tag = tag;
+    req->off = off;
+    req->owned = std::move(payload);
+    req->span = req->owned;
+    req->allow_cma = false;
+    req->state = st;
+    enqueue(req);
+    return st;
 }
 
 void MultiplexConn::send_ctl(Kind kind, uint64_t tag, uint64_t off) {
@@ -1242,6 +1417,13 @@ void MultiplexConn::tx_loop() {
         case kCmaAckDrop:
         case kCmaNack:
             sock_ok = write_frame(req->kind, req->tag, req->off, {});
+            break;
+        case kRelayFwd:
+        case kRelayDeliver:
+            // one frame per window (windows are pipeline-granular, well
+            // under the frame cap); tag/off are the ORIGINAL coordinates
+            sock_ok = write_frame(req->kind, req->tag, req->off, req->span);
+            if (req->state) req->state->complete(sock_ok);
             break;
         case kCmaHello:
             sock_ok = write_frame(kCmaHello, 0, 0, req->span);
@@ -1851,6 +2033,39 @@ void MultiplexConn::rx_loop() {
             continue;
         }
 
+        if (kind == kRelayFwd || kind == kRelayDeliver) {
+            // straggler failover detour (docs/05). Read the whole frame
+            // owned — these are single-window frames on a HEALTHY edge of
+            // a degraded op; they never ride the registered-sink path here
+            // (the final placement dedupes into the origin link's table).
+            const size_t hdr_uuids = kind == kRelayFwd ? 32u : 16u;
+            if (n < hdr_uuids) {
+                PLOG(kError) << "multiplex rx: short relay frame";
+                break;
+            }
+            std::vector<uint8_t> buf(n);
+            if (n > 0 && !sock_.recv_all(buf.data(), n)) break;
+            std::vector<uint8_t> bytes(buf.begin() + hdr_uuids, buf.end());
+            if (kind == kRelayFwd) {
+                if (relay_fwd_)
+                    relay_fwd_(buf.data(), buf.data() + 16, tag, off,
+                               std::move(bytes));
+                else
+                    PLOG(kWarn) << "relay-forward frame with no router; "
+                                   "dropping (tag=" << tag << ")";
+            } else {
+                if (relay_deliver_)
+                    relay_deliver_(buf.data(), tag, off, std::move(bytes));
+                else
+                    // standalone conns (socktest): deliver into OUR table,
+                    // charging this conn's edge — lets the transport be
+                    // exercised without a client-side router
+                    table_->deliver_window(tag, off, std::move(bytes),
+                                           &edge());
+            }
+            continue;
+        }
+
         // kData — sink fast path: read straight into the registered
         // destination at the frame's offset. busy guards the buffer against
         // unregister/purge while we write outside the lock; the frame is
@@ -1860,14 +2075,42 @@ void MultiplexConn::rx_loop() {
         edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
         edge().rx_bytes.fetch_add(n, std::memory_order_relaxed);
         uint8_t *dst = nullptr;
+        bool already_covered = false;
+        bool tag_retired = false;
         {
             MutexLock lk(table_->mu_);
             auto it = table_->sinks_.find(tag);
             if (it != table_->sinks_.end() && !it->second.cancel &&
                 off + n <= it->second.cap) {
-                dst = it->second.base + off;
-                ++it->second.busy;
+                if (it->second.fully_covered(off, off + n)) {
+                    // (op, stage, window) dedupe — first arrival won (a
+                    // relayed/re-issued copy, or a writer mid-claim): drain
+                    // this copy off the stream and count it, never rewrite
+                    // published bytes under a consumer
+                    already_covered = true;
+                } else {
+                    dst = it->second.base + off;
+                    ++it->second.busy;
+                    // claim before writing: a concurrent failover delivery
+                    // must skip (not republish) the range we're filling
+                    it->second.claims[off] =
+                        std::max(it->second.claims[off], off + n);
+                }
+            } else {
+                // no live sink claimed: only now is the retired scan worth
+                // paying (a live sink implies not-retired — register_sink
+                // un-retires — so the fast path skips the deque walk)
+                tag_retired = table_->is_retired(tag);
             }
+        }
+        const bool drop_dup = already_covered || (tag_retired && !dst);
+        if (drop_dup) {
+            // duplicate (or post-purge straggler): rx_bytes already counted
+            // this copy — the dup counter keeps delivered-unique accounting
+            // exact: unique == rx_bytes + rx_relay_bytes - dup_bytes
+            edge().dup_bytes.fetch_add(n, std::memory_order_relaxed);
+            if (already_covered)
+                edge().dup_windows.fetch_add(1, std::memory_order_relaxed);
         }
         if (dst) {
             bool ok = true, cancelled = false;
@@ -1916,6 +2159,11 @@ void MultiplexConn::rx_loop() {
                 auto it = table_->sinks_.find(tag);
                 if (it != table_->sinks_.end()) {
                     --it->second.busy;   // buffer write done: release NOW
+                    // the claim holds until the extent publishes (the
+                    // delayed path keeps it so a failover copy arriving
+                    // inside the visibility delay still reads as covered)
+                    if (!(delivered && delay_ns > 0))
+                        it->second.claims.erase(off);
                     if (delivered && delay_ns == 0)
                         it->second.add_extent(off, off + n);
                 }
@@ -1928,10 +2176,12 @@ void MultiplexConn::rx_loop() {
                         {
                             MutexLock lk(tbl->mu_);
                             auto it = tbl->sinks_.find(tag);
-                            if (it != tbl->sinks_.end() &&
-                                !it->second.cancel &&
-                                off + n <= it->second.cap)
-                                it->second.add_extent(off, off + n);
+                            if (it != tbl->sinks_.end()) {
+                                it->second.claims.erase(off);
+                                if (!it->second.cancel &&
+                                    off + n <= it->second.cap)
+                                    it->second.add_extent(off, off + n);
+                            }
                         }
                         tbl->signal_tag(tag);
                     });
@@ -1942,16 +2192,28 @@ void MultiplexConn::rx_loop() {
         } else {
             scratch.resize(n);
             if (n > 0 && !sock_.recv_all(scratch.data(), n)) break;
+            if (drop_dup) {
+                // dedupe/post-purge drop: bytes drained off the stream and
+                // discarded; accounting happened at the verdict above
+                table_->signal_tag(tag);
+                continue;
+            }
             uint64_t delay_ns =
                 wire_->delay_enabled() ? wire_->delivery_delay_ns() : 0;
             if (delay_ns > 0) {
                 // move the payload onto the delay line (scratch is resized
                 // fresh next iteration); the closure re-runs the
-                // sink-or-queue logic at visibility time
+                // sink-or-queue logic at visibility time. Placement goes
+                // through the dedupe (a failover copy may have covered the
+                // range during the delay); short-delivered bytes are
+                // charged as duplicates to this conn's edge.
                 std::vector<uint8_t> bytes(std::move(scratch));
                 netem::DelayLine::inst().deliver(
                     delay_ns,
-                    [tbl = table_, tag, off, bytes = std::move(bytes)] {
+                    [tbl = table_, tag, off, bytes = std::move(bytes),
+                     dom = dom_, ec = &edge()] {
+                        size_t delivered = 0;
+                        bool placed = false;
                         {
                             MutexLock lk(tbl->mu_);
                             auto it = tbl->sinks_.find(tag);
@@ -1959,20 +2221,30 @@ void MultiplexConn::rx_loop() {
                             if (it != tbl->sinks_.end() &&
                                 !it->second.cancel &&
                                 off + n <= it->second.cap) {
-                                memcpy(it->second.base + off, bytes.data(), n);
-                                it->second.add_extent(off, off + n);
+                                delivered = tbl->place_deduped(
+                                    it->second, tag, off, bytes.data(), n);
+                                placed = true;
                             } else if (!tbl->is_retired(tag)) {
                                 std::vector<uint8_t> qf(8 + n);
                                 memcpy(qf.data(), &off, 8);
                                 if (n > 0)
                                     memcpy(qf.data() + 8, bytes.data(), n);
                                 tbl->queues_[tag].push_back(std::move(qf));
+                                delivered = n;
+                                placed = true;
                             }
                         }
+                        if (!placed || delivered < bytes.size())
+                            ec->dup_bytes.fetch_add(
+                                bytes.size() - (placed ? delivered : 0),
+                                std::memory_order_relaxed);
+                        (void)dom;  // keeps the counter domain alive
                         tbl->signal_tag(tag);
                     });
                 continue;
             }
+            size_t delivered = n;
+            bool placed = true;
             {
                 // re-check: a sink may have been registered while we were in
                 // recv_all above — queueing now would strand the bytes where
@@ -1981,17 +2253,22 @@ void MultiplexConn::rx_loop() {
                 auto it = table_->sinks_.find(tag);
                 if (it != table_->sinks_.end() && !it->second.cancel &&
                     off + n <= it->second.cap) {
-                    memcpy(it->second.base + off, scratch.data(), n);
-                    it->second.add_extent(off, off + n);
+                    delivered = table_->place_deduped(it->second, tag, off,
+                                                      scratch.data(), n);
                 } else if (!table_->is_retired(tag)) {
                     // queued frames carry their offset in the first 8 bytes
                     std::vector<uint8_t> qf(8 + n);
                     memcpy(qf.data(), &off, 8);
                     if (n > 0) memcpy(qf.data() + 8, scratch.data(), n);
                     table_->queues_[tag].push_back(std::move(qf));
+                } else {
+                    // retired tag: straggler from a purged op — drop
+                    placed = false;
                 }
-                // retired tag: straggler from a purged op — drop the bytes
             }
+            if (!placed || delivered < n)
+                edge().dup_bytes.fetch_add(n - (placed ? delivered : 0),
+                                           std::memory_order_relaxed);
             table_->signal_tag(tag);
         }
     }

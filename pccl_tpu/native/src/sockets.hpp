@@ -269,6 +269,20 @@ public:
     // this tag — so a late CMA stripe can never strand un-acked.
     void fill_pending(uint64_t tag);
 
+    // --- straggler failover delivery (docs/05) ---
+    // Place one re-issued/relayed window [off, off+len) for `tag`, deduping
+    // against everything already delivered or in flight: regions covered by
+    // the sink's prefix/extents or CLAIMED by an RX thread mid-write are
+    // skipped (first arrival wins), only the uncovered gaps are copied and
+    // published. Dropped/duplicate bytes are charged to `origin` (the edge
+    // of the peer whose hop the relay routed around); delivered bytes are
+    // charged to origin->rx_relay_*. With no sink yet (window raced ahead
+    // of the stage's registration) the window parks in relay_pending_ and
+    // register_sink drains it with the same dedupe + accounting.
+    void deliver_window(uint64_t tag, uint64_t off,
+                        std::vector<uint8_t> bytes,
+                        telemetry::EdgeCounters *origin);
+
     // Drop all sinks, queued frames, and pending CMA descriptors with
     // lo <= tag < hi (end-of-op cleanup).
     void purge_range(uint64_t lo, uint64_t hi);
@@ -285,10 +299,18 @@ private:
         size_t cap = 0;
         size_t prefix = 0;               // contiguous bytes from offset 0
         std::map<size_t, size_t> extents; // out-of-order [off,end) past prefix
+        // [off,end) ranges an RX thread is writing into OUTSIDE the lock.
+        // The failover dedupe treats a claim as covered (the claimant was
+        // first) but never publishes an extent over it — the claimant does
+        // when its write completes. Claims are removed on completion AND
+        // on failure (a failed claim's conn is dying; the op fails with it).
+        std::map<size_t, size_t> claims;
         int busy = 0;    // RX/CMA writers currently writing outside the lock
         bool cancel = false; // unregister requested: stop writing, drop rest
         bool consumer_pull = false; // CMA descs held for consume_cma()
         void add_extent(size_t off, size_t end);
+        // covered-by prefix/extents/claims test for the dedupe
+        bool fully_covered(size_t off, size_t end) const;
     };
     struct PendingDesc { // CMA descriptor that arrived before its sink
         std::weak_ptr<MultiplexConn> ack_conn; // conn to pull through and ack on
@@ -327,9 +349,21 @@ private:
         for (auto &e : shard_evs_) e.signal();
         ev_.signal();
     }
+    // REQUIRES(mu_): place `bytes` at [off, off+len) of `s`, copying only
+    // the gaps not already covered/claimed; publishes extents per gap.
+    // Returns delivered byte count (len - delivered = duplicate bytes).
+    size_t place_deduped(Sink &s, uint64_t tag, uint64_t off,
+                         const uint8_t *bytes, size_t len) PCCLT_REQUIRES(mu_);
+
     std::map<uint64_t, Sink> sinks_ PCCLT_GUARDED_BY(mu_);
     std::map<uint64_t, std::deque<std::vector<uint8_t>>> queues_
         PCCLT_GUARDED_BY(mu_);
+    struct PendingRelay {  // failover window that raced sink registration
+        uint64_t off = 0;
+        std::vector<uint8_t> bytes;
+        telemetry::EdgeCounters *origin = nullptr;
+    };
+    std::multimap<uint64_t, PendingRelay> relay_pending_ PCCLT_GUARDED_BY(mu_);
     std::multimap<uint64_t, PendingDesc> pending_descs_ PCCLT_GUARDED_BY(mu_);
     std::vector<std::weak_ptr<MultiplexConn>> members_ PCCLT_GUARDED_BY(mu_);
     // recently purged tag ranges: data/descriptors that straggle in AFTER an
@@ -367,8 +401,31 @@ public:
     // Owned small frame (metadata): copied into the queue, completes when
     // written to the kernel.
     SendHandle send_copy(uint64_t tag, std::vector<uint8_t> payload);
+    // Owned frame of an explicit kind at an explicit offset (relay path).
+    // Always queued to the TX thread — relay senders run on RX threads and
+    // must never block on this socket's write mutex.
+    SendHandle send_owned(uint8_t kind, uint64_t tag, uint64_t off,
+                          std::vector<uint8_t> payload);
     // Blocking convenience (tests, small transfers).
     bool send_bytes(uint64_t tag, std::span<const uint8_t> data, bool allow_cma = true);
+
+    // Relay routing hooks (straggler failover). Set by the owning client
+    // BEFORE run() — the RX thread reads them lock-free. on_fwd: this conn
+    // received a kRelayFwd and should re-emit toward dst; on_deliver: a
+    // kRelayDeliver window for one of this client's inbound links arrived.
+    // Both run on the RX thread holding no lock; implementations must not
+    // block (enqueue-only sends).
+    using RelayFwdFn = std::function<void(const uint8_t *dst_uuid,
+                                          const uint8_t *origin_uuid,
+                                          uint64_t tag, uint64_t off,
+                                          std::vector<uint8_t> bytes)>;
+    using RelayDeliverFn = std::function<void(const uint8_t *origin_uuid,
+                                              uint64_t tag, uint64_t off,
+                                              std::vector<uint8_t> bytes)>;
+    void set_relay_handlers(RelayFwdFn fwd, RelayDeliverFn deliver) {
+        relay_fwd_ = std::move(fwd);
+        relay_deliver_ = std::move(deliver);
+    }
 
     SinkTable &table() { return *table_; }
     const std::shared_ptr<SinkTable> &table_ptr() { return table_; }
@@ -379,9 +436,8 @@ public:
     Socket &socket() { return sock_; }
     bool cma_eligible() const { return cma_ok_.load(); }
 
-private:
-    friend class SinkTable;
-
+    // public: the client's relay router names kRelayFwd/kRelayDeliver when
+    // re-emitting windows via send_owned
     enum Kind : uint8_t {
         kData = 0,
         kCmaDesc = 1,
@@ -398,7 +454,20 @@ private:
         // payload was discarded (op aborted/purged receiver-side), so the
         // sender must not account it as delivered on the edge counters
         kCmaAckDrop = 7,
+        // straggler failover relay (docs/05): a window detouring around a
+        // degraded edge. kRelayFwd rides sender -> relay peer, payload
+        // [16B final-dst uuid][16B origin uuid][window bytes]; the relay
+        // re-emits it to the final destination as kRelayDeliver, payload
+        // [16B origin uuid][window bytes]. tag/off in the header are the
+        // ORIGINAL window coordinates. Delivery dedupes via
+        // SinkTable::deliver_window; neither kind counts into the direct
+        // tx/rx byte counters (relayed payload is accounted separately).
+        kRelayFwd = 8,
+        kRelayDeliver = 9,
     };
+
+private:
+    friend class SinkTable;
 
     struct SendReq : mpsc::Node {
         Kind kind = kData;
@@ -535,6 +604,10 @@ private:
     size_t tx_chunk_;       // active wire chunk (capped on emulated edges)
     size_t tx_chunk_base_;  // env-configured chunk, pre-cap
     size_t cma_min_;
+
+    // relay routing (set before run(), RX-thread-read only)
+    RelayFwdFn relay_fwd_;
+    RelayDeliverFn relay_deliver_;
 
     // io_uring data plane (uring.hpp): sampled once at construction (env
     // gate × kernel probe), so a test flipping PCCLT_URING affects the

@@ -65,6 +65,16 @@ std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
         s.stall_ns = e->stall_ns.load(std::memory_order_relaxed);
         s.tx_zc_frames = e->tx_zc_frames.load(std::memory_order_relaxed);
         s.tx_zc_reaps = e->tx_zc_reaps.load(std::memory_order_relaxed);
+        s.wd_health = e->wd_health.load(std::memory_order_relaxed);
+        s.wd_suspects = e->wd_suspects.load(std::memory_order_relaxed);
+        s.wd_confirms = e->wd_confirms.load(std::memory_order_relaxed);
+        s.wd_reissues = e->wd_reissues.load(std::memory_order_relaxed);
+        s.wd_relays = e->wd_relays.load(std::memory_order_relaxed);
+        s.rx_relay_bytes = e->rx_relay_bytes.load(std::memory_order_relaxed);
+        s.rx_relay_windows =
+            e->rx_relay_windows.load(std::memory_order_relaxed);
+        s.dup_bytes = e->dup_bytes.load(std::memory_order_relaxed);
+        s.dup_windows = e->dup_windows.load(std::memory_order_relaxed);
         out.push_back(std::move(s));
     }
     return out;
@@ -137,6 +147,7 @@ Digest DigestSnapshotter::snapshot() {
         ed.stall_ratio = p.stall_ratio;
         ed.tx_bytes = e.tx_bytes;
         ed.rx_bytes = e.rx_bytes;
+        ed.wd_state = e.wd_health;
         d.edges.push_back(std::move(ed));
     }
     return d;

@@ -54,6 +54,13 @@ const char *intern(const std::string &s);
 
 // ---------------------------------------------------------------- counters
 
+// per-direction edge watchdog verdict (docs/05 three-stage ladder)
+enum class EdgeHealth : uint32_t {
+    kOk = 0,       // progressing within its deadline envelope
+    kSuspect = 1,  // one window missed its deadline; failover re-issued it
+    kConfirmed = 2 // re-issue stalled too; data plane is relaying around it
+};
+
 struct EdgeCounters {
     std::atomic<uint64_t> tx_bytes{0};   // data payload bytes sent (TCP or CMA)
     std::atomic<uint64_t> rx_bytes{0};   // data payload bytes received
@@ -67,6 +74,29 @@ struct EdgeCounters {
     // every ZC send's pages were returned before its handle completed.
     std::atomic<uint64_t> tx_zc_frames{0};
     std::atomic<uint64_t> tx_zc_reaps{0};
+    // ---- straggler-immune data plane (docs/05) ----
+    // watchdog verdict for this edge (EdgeHealth; worst of tx/rx
+    // witnesses) + transition counters; cleared back to kOk when the edge
+    // proves itself again (reduce.cpp probe / topology change)
+    std::atomic<uint32_t> wd_health{0};
+    std::atomic<uint64_t> wd_confirmed_at_ns{0};  // mono ns of the verdict
+    std::atomic<uint64_t> wd_suspects{0};   // SUSPECT verdicts raised
+    std::atomic<uint64_t> wd_confirms{0};   // SUSPECT -> CONFIRMED escalations
+    std::atomic<uint64_t> wd_reissues{0};   // windows re-issued on a fresh conn
+    std::atomic<uint64_t> wd_relays{0};     // windows relayed via a neighbor (tx)
+    // EWMA achieved per-window egress rate (bytes/s) the watchdog derives
+    // deadlines from; persists across ops so a mid-run fault is judged
+    // against the healthy baseline
+    std::atomic<uint64_t> wd_rate_bps{0};
+    // receiver side: relayed payload delivered here, charged to the edge
+    // of the ORIGIN peer (the hop the relay routed around), and duplicate
+    // arrivals dropped by the (op, stage, window) first-arrival-wins
+    // dedupe. Conservation invariant per inbound edge at quiescence:
+    //   rx_bytes + rx_relay_bytes - dup_bytes == unique payload delivered.
+    std::atomic<uint64_t> rx_relay_bytes{0};
+    std::atomic<uint64_t> rx_relay_windows{0};
+    std::atomic<uint64_t> dup_bytes{0};
+    std::atomic<uint64_t> dup_windows{0};
 };
 
 struct CommCounters {
@@ -88,12 +118,19 @@ struct CommCounters {
     // observability plane: telemetry digests pushed to the master
     // (kC2MTelemetryDigest; 0 unless PCCLT_TELEMETRY_PUSH_MS enables it)
     std::atomic<uint64_t> telemetry_digests{0};
+    // straggler-immune data plane: windows this peer forwarded as the
+    // RELAY hop (neither sender nor final receiver of the window)
+    std::atomic<uint64_t> relay_forwarded{0};
 };
 
 struct EdgeSnapshot {
     std::string endpoint;
     uint64_t tx_bytes = 0, rx_bytes = 0, tx_frames = 0, rx_frames = 0,
              conns = 0, stall_ns = 0, tx_zc_frames = 0, tx_zc_reaps = 0;
+    uint32_t wd_health = 0;
+    uint64_t wd_suspects = 0, wd_confirms = 0, wd_reissues = 0, wd_relays = 0,
+             rx_relay_bytes = 0, rx_relay_windows = 0, dup_bytes = 0,
+             dup_windows = 0;
 };
 
 // One completed collective's coarse timing, kept in a small per-Domain
@@ -245,6 +282,10 @@ struct EdgeDigest {
     uint64_t tx_bytes = 0;   // cumulative counters at snapshot time —
     uint64_t rx_bytes = 0;   //   the master re-exports these, so a scrape
                              //   can be reconciled against peer stats()
+    uint32_t wd_state = 0;   // EdgeHealth at snapshot time: a CONFIRMED
+                             //   edge tells the master to fire the
+                             //   straggler re-opt without waiting for the
+                             //   rate-based detector to notice
 };
 
 // (the master epoch is NOT part of the digest fold: the push loop stamps

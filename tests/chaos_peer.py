@@ -1,0 +1,113 @@
+"""Chaos/fault-injection test peer (subprocess worker, docs/05).
+
+One peer of a wire_topology-emulated loopback world running a fixed number
+of deterministic fp32 ring all-reduces and timing each step. The designated
+victim rank injects a netem chaos fault on its OWN outbound ring edge
+mid-run via ``netem_inject`` (the edge is discovered from stats() — the one
+edge carrying the ring tx — so the test needs no knowledge of the ATSP ring
+order). Inputs are small integers, so the fp32 ring sum is exact and the
+final result must be BIT-identical whether windows traveled the direct
+edge, a fresh pool connection, or a relay detour.
+
+Prints one JSON line: per-step wall times, final-result SHA-256, and the
+Communicator.stats() snapshot (watchdog/relay/dup counters included).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-port", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--port-base", type=int, required=True)
+    ap.add_argument("--count", type=int, default=1 << 20)
+    ap.add_argument("--steps", type=int, required=True,
+                    help="total collectives (warmup included)")
+    ap.add_argument("--fault-at", type=int, default=-1,
+                    help="victim: inject the fault BEFORE this step")
+    ap.add_argument("--victim", type=int, default=0,
+                    help="rank that injects on its outbound ring edge")
+    ap.add_argument("--fault", default="",
+                    help="chaos spec for netem_inject, e.g. "
+                         "'degrade@t=0s:10mbit/60s'")
+    ap.add_argument("--env", default="{}",
+                    help="JSON env dict applied before the native load")
+    args = ap.parse_args()
+
+    os.environ.update(json.loads(args.env))
+
+    import numpy as np
+
+    from pccl_tpu.comm import Communicator, ReduceOp, netem_inject
+    from pccl_tpu.comm.native_bench import _rank_ports
+
+    p2p, ss, bench = _rank_ports(args.port_base, args.rank)
+    comm = Communicator("127.0.0.1", args.master_port, p2p_port=p2p,
+                        ss_port=ss, bench_port=bench)
+    comm.connect()
+    deadline = time.time() + 90
+    while comm.world_size < args.world:
+        if time.time() > deadline:
+            print(json.dumps({"rank": args.rank, "error": "world timeout"}),
+                  flush=True)
+            return 2
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.02)
+
+    n, world = args.count, args.world
+    idx = np.arange(n, dtype=np.float32)
+    out = np.empty(n, dtype=np.float32)
+    step_s = []
+    injected = False
+    for step in range(args.steps):
+        if (args.rank == args.victim and args.fault and not injected
+                and step == args.fault_at):
+            # the outbound ring edge is the ONE p2p edge carrying our tx
+            edges = comm.stats()["edges"]
+            succ_ep = max(edges.items(), key=lambda kv: kv[1]["tx_bytes"])[0]
+            netem_inject(succ_ep, args.fault)
+            injected = True
+            print(json.dumps({"rank": args.rank, "injected_on": succ_ep}),
+                  flush=True)
+        # small-integer inputs: the fp32 ring sum is EXACT, so results are
+        # bit-identical regardless of ring order or window routing
+        x = np.float32((idx + step) % 5 + (args.rank + 1))
+        t0 = time.perf_counter()
+        comm.all_reduce(x, out, op=ReduceOp.SUM)
+        step_s.append(time.perf_counter() - t0)
+        expect = world * ((idx + step) % 5) + world * (world + 1) / 2
+        if not np.array_equal(out, np.float32(expect)):
+            bad = int(np.argmax(out != np.float32(expect)))
+            print(json.dumps({"rank": args.rank, "error":
+                              f"step {step} wrong result at {bad}: "
+                              f"{out[bad]} != {expect[bad]}"}), flush=True)
+            return 3
+    # let straggler frames of the last op's zombie sends drain into the
+    # receivers' dedupe counters before snapshotting (they travel at the
+    # DEGRADED rate; a bounded wait keeps conservation exact)
+    time.sleep(2.0 if args.fault else 0.5)
+    print(json.dumps({
+        "rank": args.rank,
+        "steps": step_s,
+        "digest": hashlib.sha256(out.tobytes()).hexdigest(),
+        "stats": comm.stats(),
+    }), flush=True)
+    comm.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,16 @@ def main() -> int:
     ap.add_argument("--step-interval", type=float, default=0.0,
                     help="sleep between steps (paces incumbents so churn "
                          "events land mid-run)")
+    ap.add_argument("--stats-every", type=int, default=0,
+                    help="print a 'STATS {json}' line with the comm's "
+                         "counter/edge snapshot every N steps (the stress "
+                         "orchestrator's CHAOS SUMMARY aggregates these)")
+    ap.add_argument("--inject-spec", default="",
+                    help="chaos schedule (docs/05 grammar) injected on this "
+                         "peer's OUTBOUND ring edge — discovered from "
+                         "stats() max-tx, so no ring-order assumption — "
+                         "via netem_inject before step --inject-at")
+    ap.add_argument("--inject-at", type=int, default=-1)
     args = ap.parse_args()
 
     if args.join_delay > 0:
@@ -174,6 +184,24 @@ def main() -> int:
         last_resumes = rc  # a rejoin resets the comm's counter to 0
         print(f"STEP {step} world={world} rank={args.rank}", flush=True)
         step += 1
+        if args.inject_spec and step == args.inject_at:
+            from pccl_tpu.comm import netem_inject
+
+            edges = comm.stats()["edges"]
+            if edges:
+                ep = max(edges.items(), key=lambda kv: kv[1]["tx_bytes"])[0]
+                try:
+                    netem_inject(ep, args.inject_spec)
+                    print(f"INJECTED {ep}", flush=True)
+                except PcclError as e:
+                    print(f"INJECT FAILED {e}", flush=True)
+        if args.stats_every > 0 and step % args.stats_every == 0:
+            import json
+
+            try:
+                print("STATS " + json.dumps(comm.stats()), flush=True)
+            except Exception:  # noqa: BLE001 — mid-rejoin snapshot race
+                pass
         if args.step_interval > 0:
             time.sleep(args.step_interval)
     comm.destroy()

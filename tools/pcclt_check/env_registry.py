@@ -40,7 +40,8 @@ from . import Finding
 DOC_TABLE = "docs/03_api_overview.md"
 
 _NATIVE_READ = re.compile(
-    r"(?:getenv|env_f|env_int|env_size|env_bool)\s*\(\s*\"(PCCLT_[A-Z0-9_]+)\"")
+    r"(?:getenv|env_f|env_int|env_size|env_bool|env_double)"
+    r"\s*\(\s*\"(PCCLT_[A-Z0-9_]+)\"")
 _PY_READ = re.compile(
     r"(?:environ\.get|getenv)\s*\(\s*\"(PCCLT_[A-Z0-9_]+)\"")
 _PY_SUBSCRIPT = re.compile(r"environ\[\s*\"(PCCLT_[A-Z0-9_]+)\"\s*\]\s*([=\w]?)")

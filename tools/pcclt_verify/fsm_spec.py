@@ -686,7 +686,19 @@ class MasterModel:
         # fire-and-forget observability input: folds into the fleet health
         # model (soft state, no replies, no consensus interaction) — by
         # construction it cannot change any control-flow the checker
-        # explores, so the model consumes it as a no-op
+        # explores, so the model consumes it as a no-op.
+        #
+        # Straggler-immune data plane (docs/05): the digest now also
+        # carries per-edge watchdog verdicts (wd_state), and a CONFIRMED
+        # edge may fire the PCCLT_STRAGGLER_REOPT background moonshot.
+        # That stays OUT of the model on purpose: the re-opt only spawns
+        # an async ATSP improvement whose adoption rides the ALREADY
+        # MODELED optimize round (check_optimize); it emits no packets,
+        # holds no votes, and cannot park a client — the watchdog/relay
+        # ladder itself lives entirely in the data plane (reduce.cpp /
+        # sockets.cpp), below the control-plane state machine this spec
+        # mirrors. on_disconnect/remove_client invariants are unaffected:
+        # relay frames ride existing p2p conns and die with them.
         return []
 
     def on_disconnect(self, uuid: str) -> "list[Packet]":
