@@ -219,6 +219,16 @@ def main() -> None:
             print(f"bench: telemetry overhead failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["telemetry_overhead_pct"] = None
+        # critical-path attribution (docs/09): every BENCH run explains its
+        # own numbers — trace_critic decomposes a paced 2-peer world's
+        # steps into stall/codec/setup fractions + the dominant verdict
+        try:
+            for k, v in native_bench.run_attribution_bench().items():
+                extra[k] = round(v, 4) if isinstance(v, float) else v
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: attribution failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["attribution_coverage"] = None
         # straggler-immune data plane (docs/05): mid-run edge degradation →
         # wall-clock to the first back-to-baseline step (watchdog →
         # re-issue → relay ladder), plus the armed-but-idle plane's step

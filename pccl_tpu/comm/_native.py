@@ -136,7 +136,7 @@ class CommStats(ctypes.Structure):
         ("master_reconnects", ctypes.c_uint64),
         ("p2p_conns_reused", ctypes.c_uint64),
         # observability plane: digests pushed to the master, and
-        # flight-recorder events lost to ring wrap (process-global)
+        # flight-recorder ring drop accounting (process-global)
         ("telemetry_digests", ctypes.c_uint64),
         ("trace_ring_dropped", ctypes.c_uint64),
         # straggler-immune data plane (docs/05): windows forwarded as the
@@ -144,6 +144,11 @@ class CommStats(ctypes.Structure):
         ("relay_forwarded", ctypes.c_uint64),
         ("chaos_faults_armed", ctypes.c_uint64),
         ("chaos_faults_activated", ctypes.c_uint64),
+        # appended (not inserted mid-struct, matching pcclt.h): ring
+        # saturation gauges — dropped > 0 means traces hold only the
+        # newest trace_ring_capacity events
+        ("trace_ring_pushed", ctypes.c_uint64),
+        ("trace_ring_capacity", ctypes.c_uint64),
     ]
 
 

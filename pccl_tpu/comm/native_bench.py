@@ -18,6 +18,7 @@ communicator processes, PCCP wire protocol):
 
 from __future__ import annotations
 
+import json
 import multiprocessing as mp
 import os
 import time
@@ -1033,6 +1034,97 @@ def run_telemetry_overhead_bench(nbytes: int = 8 << 20,
         "telemetry_off_step_s": t_off,
         "telemetry_on_step_s": t_on,
         "telemetry_overhead_pct": 100.0 * (t_on - t_off) / t_off,
+    }
+
+
+def _peer_attribution(rank, master_port, q, nbytes, iters, port_base,
+                      out_dir):
+    """One peer of the attribution bench: flight recorder on, a few paced
+    fp32 ring steps, then dump this peer's trace for trace_critic."""
+    from pccl_tpu.comm.api import (ReduceOp, trace_clear, trace_dump,
+                                   trace_enable, trace_events)
+
+    env_capture = bool(os.environ.get("PCCLT_TRACE"))
+    # rank 0 runs inline in the bench process, so the shared ring may hold
+    # earlier legs' collectives — and their (epoch, seq) keys collide with
+    # this run's, silently merging foreign timelines into the attribution.
+    # Pick this leg's events out by timestamp instead (perf_counter shares
+    # the recorder's CLOCK_MONOTONIC timebase, same idiom as
+    # _peer_allreduce), so a user-requested PCCLT_TRACE capture is neither
+    # cleared nor disabled.
+    t_mark_us = time.perf_counter() * 1e6
+    trace_enable(True)
+    comm = _connect(rank, master_port, 2, port_base)
+    count = nbytes // 4
+    x = np.full(count, float(rank + 1), dtype=np.float32)
+    y = np.empty_like(x)
+    for _ in range(iters):
+        comm.all_reduce(x, y, op=ReduceOp.SUM)
+    assert float(y[0]) == 3.0
+    path = os.path.join(out_dir, f"attr-peer{rank}.json")
+    if rank == 0:
+        evs = [e for e in trace_events() if e.get("ts", 0) >= t_mark_us]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+    else:
+        trace_dump(path)  # fresh subprocess: the whole ring is this leg's
+    q.put({"rank": rank, "trace": path})
+    comm.destroy()
+    if rank == 0 and not env_capture:
+        trace_enable(False)
+        trace_clear()  # rank 0 runs inline: later legs start clean
+
+
+def run_attribution_bench(nbytes: int = 4 << 20, iters: int = 4,
+                          base: int = 44200) -> Dict[str, Any]:
+    """Critical-path attribution keys (docs/09): a netem-paced 2-peer
+    world runs with the flight recorder on, each peer dumps its trace, and
+    ``tools/trace_critic`` decomposes every collective into (peer, stage,
+    edge, phase) segments — so every BENCH run carries WHERE its step time
+    went (stall/codec/setup fractions + the dominant verdict), not just
+    how long it took."""
+    import tempfile
+
+    from tools.trace_critic import analyze_files
+
+    wire_map = ",".join(f"127.0.0.1:{_rank_ports(base, r)[0]}=800"
+                        for r in range(2))
+    prior = os.environ.get("PCCLT_WIRE_MBPS_MAP")
+    os.environ["PCCLT_WIRE_MBPS_MAP"] = wire_map
+    # TemporaryDirectory (not mkdtemp): the multi-MB per-peer trace dumps
+    # are consumed by analyze_files below and must not pile up in /tmp
+    # across bench runs
+    with tempfile.TemporaryDirectory(prefix="pcclt-attr-") as tmp:
+        try:
+            res = _spawn_world(2, _peer_attribution,
+                               _port("PCCLT_BENCH_MASTER_PORT_ATTR", 48731),
+                               (nbytes, iters, base, tmp))
+        finally:
+            if prior is None:
+                os.environ.pop("PCCLT_WIRE_MBPS_MAP", None)
+            else:
+                os.environ["PCCLT_WIRE_MBPS_MAP"] = prior
+        report = analyze_files(
+            [r["trace"] for r in sorted(res, key=lambda r: r["rank"])],
+            labels=[f"rank{r['rank']}" for r in
+                    sorted(res, key=lambda r: r["rank"])])
+    agg = report["aggregate"]
+    pt = agg["phase_totals_us"]
+    # the denominator is the DISJOINT wall decomposition (cw + setup +
+    # stage + stall + drain); codec time runs inside the stage windows, so
+    # including it would double-count and bias every fraction low
+    tot = sum(v for k, v in pt.items() if k != "codec") or 1.0
+    verdicts = agg["verdicts"]
+    top = max(verdicts.items(), key=lambda kv: kv[1])[0] if verdicts else ""
+    return {
+        "attribution_ops": float(agg["ops"]),
+        "attribution_coverage": agg["mean_coverage"],
+        "attribution_stall_frac": (pt.get("stall", 0.0) +
+                                   pt.get("drain", 0.0)) / tot,
+        "attribution_codec_frac": pt.get("codec", 0.0) / tot,
+        "attribution_setup_frac": (pt.get("commence_wait", 0.0) +
+                                   pt.get("op_setup", 0.0)) / tot,
+        "attribution_verdict": top,
     }
 
 

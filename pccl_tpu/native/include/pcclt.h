@@ -332,6 +332,12 @@ typedef struct pccltCommStats_t {
                                    * hop of another peer's failover detour */
     uint64_t chaos_faults_armed;      /* netem chaos faults armed (process) */
     uint64_t chaos_faults_activated;  /* fault windows observed active */
+    /* appended (not inserted mid-struct): consumers compiled against an
+     * older layout keep valid offsets for everything above */
+    uint64_t trace_ring_pushed;   /* events pushed into the ring since the
+                                   * last clear (process-global) */
+    uint64_t trace_ring_capacity; /* ring capacity: dropped > 0 means traces
+                                   * hold only the newest this-many events */
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {

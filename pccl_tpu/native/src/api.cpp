@@ -407,6 +407,8 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     // process-global ring accounting (the recorder is shared by every comm
     // in the process): nonzero = traces are truncated to the newest 64k
     out->trace_ring_dropped = pcclt::telemetry::Recorder::inst().dropped();
+    out->trace_ring_pushed = pcclt::telemetry::Recorder::inst().pushed();
+    out->trace_ring_capacity = pcclt::telemetry::Recorder::ring_capacity();
     out->relay_forwarded = ld(m.relay_forwarded);
     // chaos accounting is process-global like the netem registry itself
     auto cs = pcclt::net::netem::chaos_stats();

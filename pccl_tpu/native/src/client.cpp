@@ -1,8 +1,10 @@
 #include "client.hpp"
 
 #include <sys/socket.h>
+#include <sys/stat.h>
 
 #include <algorithm>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <random>
@@ -233,6 +235,12 @@ Status Client::connect() {
     bench_listener_.run_async([this](net::Socket s) { on_bench_accept(std::move(s)); });
 
     if (!master_.connect(cfg_.master)) return Status::kMasterUnreachable;
+    // incident black box (docs/09): consume the fire-and-forget capture
+    // order on the reader — no recv_match ever waits for it, and the map
+    // must be populated before the first run() (it survives resumes)
+    master_.set_notify(
+        static_cast<uint16_t>(PacketType::kM2CIncidentDump),
+        [this](net::Frame &&f) { on_incident_dump(std::move(f)); });
     master_.run();
 
     proto::HelloC2M h;
@@ -307,17 +315,136 @@ void Client::telemetry_push_loop(int push_ms) {
         pkt.interval_ms = d.interval_ns / 1'000'000;
         pkt.ring_dropped = d.ring_dropped;
         pkt.collectives_ok = d.collectives_ok;
-        for (auto &e : d.edges)
-            pkt.edges.push_back({e.endpoint, e.tx_mbps, e.rx_mbps,
-                                 e.stall_ratio, e.tx_bytes, e.rx_bytes,
-                                 static_cast<uint8_t>(e.wd_state)});
+        for (auto &e : d.edges) {
+            proto::TelemetryDigestC2M::Edge pe;
+            pe.endpoint = e.endpoint;
+            pe.tx_mbps = e.tx_mbps;
+            pe.rx_mbps = e.rx_mbps;
+            pe.stall_ratio = e.stall_ratio;
+            pe.tx_bytes = e.tx_bytes;
+            pe.rx_bytes = e.rx_bytes;
+            pe.wd_state = static_cast<uint8_t>(e.wd_state);
+            pe.stage_wire_hist = {e.stage_wire_hist.sum_ns,
+                                  telemetry::hist_sparse(e.stage_wire_hist)};
+            pe.stall_hist = {e.stall_hist.sum_ns,
+                             telemetry::hist_sparse(e.stall_hist)};
+            pkt.edges.push_back(std::move(pe));
+        }
         for (auto &o : d.ops) pkt.ops.push_back({o.seq, o.dur_ns, o.stall_ns});
+        // trailing attribution section: ring accounting + the comm-level
+        // phase latency histograms (empty phases stay off the wire)
+        pkt.ring_pushed = d.ring_pushed;
+        pkt.ring_cap = d.ring_cap;
+        for (size_t p = 0; p < telemetry::kPhaseCount; ++p)
+            if (!d.phases[p].empty())
+                pkt.phase_hists.emplace_back(
+                    static_cast<uint8_t>(p),
+                    proto::WireHist{d.phases[p].sum_ns,
+                                    telemetry::hist_sparse(d.phases[p])});
         // fire and forget: a down master link is the resume path's problem,
         // not ours — the next digest after a resume carries fresh rates
         if (master_.send(PacketType::kC2MTelemetryDigest, pkt.encode()))
             tele_->comm.telemetry_digests.fetch_add(1,
                                                     std::memory_order_relaxed);
     }
+}
+
+// ---------------- incident black box (docs/09) ----------------
+
+void Client::on_incident_dump(net::Frame &&f) {
+    auto d = proto::IncidentDumpM2C::decode(f.payload);
+    if (!d) return;
+    if (const char *e = std::getenv("PCCLT_INCIDENT_DIR"); !e || !e[0])
+        return; // peer opted out of the black box
+    std::thread prev;
+    {
+        MutexLock lk(incident_mu_);
+        if (d->incident_id == last_incident_id_) return; // duplicate order
+        if (incident_busy_ && incident_busy_->load(std::memory_order_acquire)) {
+            // previous bundle still writing (rate limiter off or a slow
+            // disk): skip rather than stall the control reader — abort /
+            // commence packets must keep flowing during an incident storm
+            PLOG(kWarn) << "incident " << d->incident_id
+                        << " skipped: previous bundle still writing";
+            return;
+        }
+        last_incident_id_ = d->incident_id;
+        prev = std::move(incident_thread_);
+        auto busy = std::make_shared<std::atomic<bool>>(true);
+        incident_busy_ = busy;
+        incident_thread_ = std::thread([this, dump = *d, busy] {
+            write_incident_bundle(dump);
+            busy->store(false, std::memory_order_release);
+        });
+    }
+    // the previous writer already cleared busy, so this join is instant
+    if (prev.joinable()) prev.join();
+}
+
+void Client::write_incident_bundle(const proto::IncidentDumpM2C &d) {
+    const char *env = std::getenv("PCCLT_INCIDENT_DIR");
+    if (!env || !env[0]) return;
+    std::string dir(env);
+    ::mkdir(dir.c_str(), 0755);
+    dir += "/" + d.incident_id; // id is charset-validated at decode
+    ::mkdir(dir.c_str(), 0755);
+    const std::string me = proto::uuid_str(uuid_).substr(0, 8);
+    PLOG(kWarn) << "incident " << d.incident_id << " (" << d.trigger
+                << "): writing black-box bundle under " << dir;
+    // 1. the flight-recorder ring as-is (the pcclt_trace_meta header
+    //    documents capture state even when the recorder was off)
+    telemetry::Recorder::inst().dump_json(dir + "/peer-" + me +
+                                          ".trace.json");
+    // 2. counters + per-edge stats snapshot, with the trigger context
+    FILE *f = fopen((dir + "/peer-" + me + ".stats.json").c_str(), "w");
+    if (!f) return;
+    auto esc = [](const std::string &s) { return telemetry::json_escape(s); };
+    const auto &cm = tele_->comm;
+    auto ld = [](const std::atomic<uint64_t> &a) {
+        return a.load(std::memory_order_relaxed);
+    };
+    fprintf(f,
+            "{\"incident_id\":\"%s\",\"trigger\":\"%s\",\"epoch\":%llu,"
+            "\"uuid\":\"%s\",\"counters\":{"
+            "\"collectives_ok\":%llu,\"collectives_aborted\":%llu,"
+            "\"collectives_lost\":%llu,\"kicked\":%llu,"
+            "\"master_reconnects\":%llu,\"relay_forwarded\":%llu,"
+            "\"trace_ring_pushed\":%llu,\"trace_ring_dropped\":%llu},"
+            "\"edges\":{",
+            esc(d.incident_id).c_str(), esc(d.trigger).c_str(),
+            (unsigned long long)d.epoch, proto::uuid_str(uuid_).c_str(),
+            (unsigned long long)ld(cm.collectives_ok),
+            (unsigned long long)ld(cm.collectives_aborted),
+            (unsigned long long)ld(cm.collectives_lost),
+            (unsigned long long)ld(cm.kicked),
+            (unsigned long long)ld(cm.master_reconnects),
+            (unsigned long long)ld(cm.relay_forwarded),
+            (unsigned long long)telemetry::Recorder::inst().pushed(),
+            (unsigned long long)telemetry::Recorder::inst().dropped());
+    bool first = true;
+    for (const auto &e : tele_->snapshot_edges()) {
+        fprintf(f,
+                "%s\"%s\":{\"tx_bytes\":%llu,\"rx_bytes\":%llu,"
+                "\"stall_ms\":%llu,\"wd_state\":%u,\"wd_suspects\":%llu,"
+                "\"wd_confirms\":%llu,\"wd_reissues\":%llu,"
+                "\"wd_relays\":%llu,\"rx_relay_bytes\":%llu,"
+                "\"dup_bytes\":%llu,"
+                "\"stage_p99_ms\":%.3f,\"stall_p99_ms\":%.3f}",
+                first ? "" : ",", esc(e.endpoint).c_str(),
+                (unsigned long long)e.tx_bytes, (unsigned long long)e.rx_bytes,
+                (unsigned long long)(e.stall_ns / 1000000),
+                e.wd_health, (unsigned long long)e.wd_suspects,
+                (unsigned long long)e.wd_confirms,
+                (unsigned long long)e.wd_reissues,
+                (unsigned long long)e.wd_relays,
+                (unsigned long long)e.rx_relay_bytes,
+                (unsigned long long)e.dup_bytes,
+                e.stage_wire_hist.quantile_ns(0.99) / 1e6,
+                e.stall_hist.quantile_ns(0.99) / 1e6);
+        first = false;
+    }
+    fputs("}}\n", f);
+    fclose(f);
 }
 
 void Client::disconnect() {
@@ -347,6 +474,17 @@ void Client::disconnect() {
         MutexLock lk(resume_mu_);
         master_.close();
     }
+    // incident writer: join AFTER master_.close() — the control reader is
+    // the only spawner of incident_thread_ (set_notify dispatch), and
+    // close() joins it, so a kM2CIncidentDump read during teardown cannot
+    // respawn the writer behind this join. Join outside incident_mu_
+    // (blocking-under-lock).
+    std::thread inc;
+    {
+        MutexLock lk(incident_mu_);
+        inc = std::move(incident_thread_);
+    }
+    if (inc.joinable()) inc.join();
     p2p_listener_.stop();
     ss_listener_.stop();
     bench_listener_.stop();
@@ -1215,12 +1353,32 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         {static_cast<uint16_t>(PacketType::kM2CCollectiveCommence),
          static_cast<uint16_t>(PacketType::kM2CCollectiveAbort)},
         frame_tag_pred, 600'000);
-    if (telemetry::Recorder::inst().on())
-        telemetry::Recorder::inst().span("collective", "commence_wait",
-                                         commence_t0, telemetry::now_ns(),
-                                         "tag", desc.tag);
-    if (!commence) return classify_master_loss();
+    const uint64_t commence_t1 = telemetry::now_ns();
+    // the span is emitted on EVERY exit from this wait (seq 0 when none
+    // was issued): an op that dies here is exactly the one an incident
+    // bundle needs consensus-wait evidence for
+    auto commence_span = [&](uint64_t seq_v) {
+        if (telemetry::Recorder::inst().on())
+            telemetry::Recorder::inst().span("collective", "commence_wait",
+                                             commence_t0, commence_t1, "tag",
+                                             desc.tag, "seq", seq_v);
+    };
+    if (!commence) {
+        // master loss / 600 s timeout: NOT a consensus-wait sample — one
+        // overflow-bucket entry would pin the cumulative commence_wait
+        // p99 gauge to ~137 s for the rest of the process lifetime
+        commence_span(0);
+        return classify_master_loss();
+    }
+    // attribution histogram: the consensus wait is a first-class phase —
+    // the residual ~40 ms/op the ROADMAP multipath item hunts lives here.
+    // Recorded only when the master actually answered (commence or a
+    // replayed verdict), so the distribution measures consensus latency,
+    // not failure timeouts.
+    tele_->record_phase(telemetry::Phase::kCommenceWait,
+                        commence_t1 - commence_t0);
     if (commence->type == static_cast<uint16_t>(PacketType::kM2CCollectiveAbort)) {
+        commence_span(0);
         bool replay_aborted = true;
         uint32_t replay_world = 0;
         try {
@@ -1239,14 +1397,23 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         op->info.world = replay_world;
         return replay_aborted ? Status::kAborted : Status::kOk;
     }
-    if (session_flipped()) return Status::kConnectionLost;
+    if (session_flipped()) {
+        commence_span(0);
+        return Status::kConnectionLost;
+    }
     uint64_t seq;
     try {
         wire::Reader r(commence->payload);
         r.u64();
         seq = r.u64();
-    } catch (...) { return Status::kInternal; }
+    } catch (...) {
+        commence_span(0);
+        return Status::kInternal;
+    }
     *observed_seq = seq; // the incarnation a session-loss retry refers to
+    // emitted here, not at the recv: the span carries the master-issued
+    // seq (known only now) so trace_critic can pin it to its collective
+    commence_span(seq);
 
     // 2. snapshot ring + neighbor connections
     std::vector<proto::Uuid> ring;
@@ -1328,9 +1495,11 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         if (rx.valid() || std::chrono::steady_clock::now() >= rx_deadline) break;
         if (op->abort.load() || consume_abort(true)) break;
     }
+    const uint64_t links_t1 = telemetry::now_ns();
+    tele_->record_phase(telemetry::Phase::kOpSetup, links_t1 - links_t0);
     if (telemetry::Recorder::inst().on())
         telemetry::Recorder::inst().span("collective", "op_setup", links_t0,
-                                         telemetry::now_ns(), "seq", seq);
+                                         links_t1, "seq", seq);
     if (dbg_phases)
         fprintf(stderr, "[op %llu] links tx=%d rx=%d abort=%d seq=%llu\n",
                 (unsigned long long)desc.tag, tx.valid(), rx.valid(),
@@ -1374,12 +1543,14 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
                 net::Addr pa = it->second.ep.ip;
                 pa.port = it->second.ep.p2p_port;
                 ctx.rx_edge = &tele_->edge(pa.str());
+                ctx.rx_endpoint = telemetry::intern(pa.str());
             }
             auto nt = peers_.find(next);
             if (nt != peers_.end()) {
                 net::Addr pa = nt->second.ep.ip;
                 pa.port = nt->second.ep.p2p_port;
                 ctx.tx_edge = &tele_->edge(pa.str());
+                ctx.tx_endpoint = telemetry::intern(pa.str());
             }
         }
         // edge watchdog + live failover (docs/05): opt-in via PCCLT_WATCHDOG

@@ -239,6 +239,15 @@ private:
     // unset disables (connect never spawns the thread).
     void telemetry_push_loop(int push_ms);
 
+    // Incident black box (docs/09): kM2CIncidentDump arrives on the
+    // control reader via ControlClient::set_notify — dedupe by id and hand
+    // the write to a dedicated thread (a trace dump is tens of ms; the
+    // reader must keep consuming abort/commence packets meanwhile).
+    void on_incident_dump(net::Frame &&f);
+    // writes <PCCLT_INCIDENT_DIR>/<id>/peer-<uuid8>.trace.json (the
+    // flight-recorder ring) + peer-<uuid8>.stats.json (counters + edges)
+    void write_incident_bundle(const proto::IncidentDumpM2C &d);
+
     ClientConfig cfg_;
     proto::Uuid uuid_{};
     std::atomic<bool> connected_{false};
@@ -263,6 +272,16 @@ private:
     // > 0; stopped+joined by disconnect before the control conn closes)
     std::thread tele_thread_;
     std::atomic<bool> tele_stop_{false};
+    // incident black box: one writer slot + the last id seen for dedupe.
+    // incident_busy_ lets the control reader SKIP a new incident while the
+    // previous bundle is still being written instead of blocking on a
+    // join (the reader must keep consuming abort/commence packets); a
+    // finished writer's join is instant.
+    Mutex incident_mu_; // lock-rank: 27
+    std::thread incident_thread_ PCCLT_GUARDED_BY(incident_mu_);
+    std::string last_incident_id_ PCCLT_GUARDED_BY(incident_mu_);
+    std::shared_ptr<std::atomic<bool>> incident_busy_
+        PCCLT_GUARDED_BY(incident_mu_);
 
     net::ControlClient master_;
     net::Listener p2p_listener_, ss_listener_, bench_listener_;

@@ -1,9 +1,12 @@
 #include "master_state.hpp"
 
+#include <sys/stat.h>
+
 #include <algorithm>
 #include <cinttypes>
 #include <cstdio>
 #include <cstdlib>
+#include <ctime>
 
 #include "atsp.hpp"
 #include "log.hpp"
@@ -81,6 +84,9 @@ void MasterState::kick(std::vector<Outbox> &out, ClientInfo &c, const std::strin
     w.str(reason);
     out.push_back({c.conn_id, PacketType::kM2CKicked, w.take()});
     pending_closes_.push_back(c.conn_id);
+    // a kick is the classic "it just stopped" incident (docs/09): order a
+    // fleet black-box capture while the evidence is still in the rings
+    maybe_incident(out, "kick:" + reason, c.peer_group);
     // removal + consensus re-checks happen when the dispatcher closes the
     // conn and feeds the disconnect event back in.
 }
@@ -229,6 +235,7 @@ std::vector<Outbox> MasterState::on_tick() {
         telemetry::Recorder::inst().instant("membership", "master_limbo_expired",
                                             "group", gone.peer_group, "world",
                                             world_size());
+        maybe_incident(out, "limbo_expiry", gone.peer_group);
         remove_client(out, gone);
     }
     return out;
@@ -660,6 +667,7 @@ std::vector<Outbox> MasterState::on_collective_complete(uint64_t conn, uint64_t 
                 out.push_back({m->conn_id, PacketType::kM2CCollectiveAbort, w.take()});
             }
             PLOG(kWarn) << "collective tag " << tag << " aborted by peer failure report";
+            maybe_incident(out, "collective_abort", c->peer_group);
         }
     }
     check_collective(out, c->peer_group, tag);
@@ -669,10 +677,12 @@ std::vector<Outbox> MasterState::on_collective_complete(uint64_t conn, uint64_t 
 void MasterState::abort_group_collectives(std::vector<Outbox> &out, uint32_t group) {
     auto git = groups_.find(group);
     if (git == groups_.end()) return;
+    bool any_aborted = false;
     for (auto &[tag, op] : git->second.ops) {
         if (!op.commenced || op.abort_broadcast) continue;
         op.abort_broadcast = true;
         op.any_aborted = true;
+        any_aborted = true;
         for (const auto &u : op.members) {
             auto *m = by_uuid(u);
             if (!m) continue;
@@ -683,6 +693,7 @@ void MasterState::abort_group_collectives(std::vector<Outbox> &out, uint32_t gro
         }
         PLOG(kWarn) << "aborting collective tag " << tag << " in group " << group;
     }
+    if (any_aborted) maybe_incident(out, "collective_abort", group);
 }
 
 // ---------- shared state ----------
@@ -1259,10 +1270,22 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
         p.group = c->peer_group;
         p.last_seq = d.last_seq;
         p.ring_dropped = d.ring_dropped;
+        p.ring_pushed = d.ring_pushed;
+        p.ring_cap = d.ring_cap;
         p.collectives_ok = d.collectives_ok;
         ++p.digests;
         p.last_digest_ns = now;
         p.departed = false;
+        // phase latency histograms are cumulative peer-side: replace, not
+        // merge — a missed digest loses nothing. Ids beyond this build's
+        // Phase table are dropped: they would all render as phase="?" and
+        // two of them would emit duplicate label sets, which Prometheus
+        // rejects for the WHOLE scrape (the wire bound is looser than
+        // kPhaseCount on purpose — newer peers may know more phases).
+        for (const auto &[phase, h] : d.phase_hists)
+            if (phase < telemetry::kPhaseCount)
+                p.phase_hists[phase] =
+                    telemetry::hist_dense(h.sum_ns, h.buckets);
         for (const auto &r : resolved) {
             auto &eh = fleet_edges_[{from, r.e->endpoint}];
             eh.from_uuid = from;
@@ -1275,6 +1298,12 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
             eh.rx_bytes = r.e->rx_bytes;
             eh.expected_mbps = r.expected_mbps;
             eh.wd_state = r.e->wd_state;
+            if (!r.e->stage_wire_hist.empty())
+                eh.stage_wire_hist = telemetry::hist_dense(
+                    r.e->stage_wire_hist.sum_ns, r.e->stage_wire_hist.buckets);
+            if (!r.e->stall_hist.empty())
+                eh.stall_hist = telemetry::hist_dense(
+                    r.e->stall_hist.sum_ns, r.e->stall_hist.buckets);
             // Watchdog fast path: a CONFIRMED edge means the reporter's
             // data plane already failed over mid-collective — no rate
             // heuristics needed, the re-opt should fire NOW so the next
@@ -1363,6 +1392,12 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
             }
             request_straggler_reopt(c->peer_group);
         }
+        // a watchdog CONFIRM means the data plane is already relaying
+        // around a dead-slow hop mid-collective — exactly the evidence
+        // that evaporates by the time anyone looks: capture it NOW
+        if (f.outbound)
+            maybe_incident(out, "watchdog_confirm:" + from + "->" + f.endpoint,
+                           c->peer_group);
     }
     return out;
 }
@@ -1409,18 +1444,7 @@ namespace {
 
 void json_str(std::string &o, const std::string &s) {
     o += '"';
-    for (char ch : s) {
-        if (ch == '"' || ch == '\\') {
-            o += '\\';
-            o += ch;
-        } else if (static_cast<unsigned char>(ch) < 0x20) {
-            char buf[8];
-            snprintf(buf, sizeof buf, "\\u%04x", ch);
-            o += buf;
-        } else {
-            o += ch;
-        }
-    }
+    o += telemetry::json_escape(s);
     o += '"';
 }
 
@@ -1437,6 +1461,89 @@ std::string num(uint64_t v) {
 }
 
 } // namespace
+
+// ---------- incident black box (docs/09) ----------
+
+namespace {
+
+std::string incident_dir() {
+    const char *e = std::getenv("PCCLT_INCIDENT_DIR");
+    return e && e[0] ? std::string(e) : std::string();
+}
+
+uint64_t incident_min_ns() {
+    // re-read per trigger (rare): tests flip it at runtime
+    if (const char *e = std::getenv("PCCLT_INCIDENT_MIN_MS")) {
+        long long v = atoll(e);
+        if (v >= 0) return static_cast<uint64_t>(v) * 1'000'000ull;
+    }
+    return 30'000ull * 1'000'000ull;
+}
+
+} // namespace
+
+void MasterState::maybe_incident(std::vector<Outbox> &out,
+                                 const std::string &trigger, uint32_t group) {
+    const std::string dir = incident_dir();
+    if (dir.empty()) return; // plane disabled
+    const uint64_t now = telemetry::now_ns();
+    if (last_incident_ns_ && now - last_incident_ns_ < incident_min_ns()) {
+        // rate limited: a flapping edge or an abort storm must not spam
+        // disk — the suppression is still counted and visible on /health
+        MutexLock lk(health_mu_);
+        ++incidents_suppressed_;
+        return;
+    }
+    last_incident_ns_ = now;
+    const std::string id = "inc-e" + std::to_string(epoch_) + "-" +
+                           std::to_string(++incident_seq_);
+    {
+        MutexLock lk(health_mu_);
+        ++incidents_total_;
+        recent_incidents_.push_back({id, trigger, now});
+        while (recent_incidents_.size() > 8) recent_incidents_.pop_front();
+    }
+    PLOG(kWarn) << "incident " << id << " (" << trigger
+                << "): broadcasting black-box capture to " << clients_.size()
+                << " clients";
+    telemetry::Recorder::inst().instant("fleet", "master_incident", "group",
+                                        group, nullptr, 0,
+                                        telemetry::intern(trigger));
+    proto::IncidentDumpM2C pkt;
+    pkt.incident_id = id;
+    pkt.trigger = trigger;
+    pkt.epoch = epoch_;
+    auto payload = pkt.encode();
+    // fleet-wide, not group-scoped: a cross-group master sees one shared
+    // control plane, and the neighbors' rings are part of the evidence
+    for (auto &[cid, c] : clients_)
+        out.push_back({cid, PacketType::kM2CIncidentDump, payload});
+    // master-side manifest: the trigger + the fleet-health snapshot at the
+    // moment of the incident (per-peer digest tails, edge EWMAs, watchdog
+    // verdicts). Written lock-free on the dispatcher; a manifest is a few
+    // KiB and incidents are rate-limited, so this cannot pace consensus.
+    ::mkdir(dir.c_str(), 0755);
+    const std::string idir = dir + "/" + id;
+    ::mkdir(idir.c_str(), 0755);
+    FILE *f = fopen((idir + "/manifest.json").c_str(), "w");
+    if (!f) {
+        PLOG(kWarn) << "incident " << id << ": cannot write manifest under "
+                    << dir;
+        return;
+    }
+    std::string o = "{\"incident_id\":";
+    json_str(o, id);
+    o += ",\"trigger\":";
+    json_str(o, trigger);
+    o += ",\"epoch\":" + num(epoch_);
+    o += ",\"group\":" + num(static_cast<uint64_t>(group));
+    o += ",\"t_mono_ns\":" + num(now);
+    o += ",\"t_unix\":" + num(static_cast<uint64_t>(time(nullptr)));
+    o += ",\"health\":" + render_health_json();
+    o += "}\n";
+    fwrite(o.data(), 1, o.size(), f);
+    fclose(f);
+}
 
 std::string MasterState::render_metrics() const {
     const uint64_t now = telemetry::now_ns();
@@ -1467,6 +1574,7 @@ std::string MasterState::render_metrics() const {
     std::map<std::string, PeerHealth> fleet_peers_copy;
     std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
     uint64_t digests_total_copy, stragglers_copy;
+    uint64_t incidents_copy, incidents_suppressed_copy;
     size_t world_copy, clients_copy, limbo_copy;
     {
         MutexLock lk(health_mu_);
@@ -1474,6 +1582,8 @@ std::string MasterState::render_metrics() const {
         fleet_edges_copy = fleet_edges_;
         digests_total_copy = digests_total_;
         stragglers_copy = stragglers_flagged_;
+        incidents_copy = incidents_total_;
+        incidents_suppressed_copy = incidents_suppressed_;
         world_copy = health_world_;
         clients_copy = health_clients_;
         limbo_copy = health_limbo_;
@@ -1491,11 +1601,108 @@ std::string MasterState::render_metrics() const {
     counter("pcclt_master_stragglers_flagged_total",
             "straggler edge flag transitions");
     o += "pcclt_master_stragglers_flagged_total " + num(stragglers_copy) + "\n";
+    counter("pcclt_master_incidents_total",
+            "black-box incident captures fired (docs/09 incident plane)");
+    o += "pcclt_master_incidents_total " + num(incidents_copy) + "\n";
+    counter("pcclt_master_incidents_suppressed_total",
+            "incident triggers swallowed by the rate limiter");
+    o += "pcclt_master_incidents_suppressed_total " +
+         num(incidents_suppressed_copy) + "\n";
+    // the master's OWN flight-recorder ring (the per-peer mirror rides the
+    // digest): saturation is visible to a scraper, not just in artifacts
+    {
+        auto &rec = telemetry::Recorder::inst();
+        gauge("pcclt_master_trace_ring_pushed",
+              "events pushed into the master's flight-recorder ring");
+        o += "pcclt_master_trace_ring_pushed " + num(rec.pushed()) + "\n";
+        gauge("pcclt_master_trace_ring_dropped",
+              "master flight-recorder events lost to ring wrap");
+        o += "pcclt_master_trace_ring_dropped " + num(rec.dropped()) + "\n";
+        gauge("pcclt_master_trace_ring_capacity",
+              "master flight-recorder ring capacity");
+        o += "pcclt_master_trace_ring_capacity " +
+             num(static_cast<uint64_t>(telemetry::Recorder::ring_capacity())) +
+             "\n";
+    }
+
+    // ---- latency histograms (critical-path attribution, docs/09) ----
+    // Prometheus histogram exposition from the log2 grid: zero buckets are
+    // elided (the `le` values present still define the boundaries), +Inf
+    // always closes the series. Values are seconds.
+    auto hist_le = [&](size_t i) -> std::string {
+        char buf[32];
+        snprintf(buf, sizeof buf, "%.9g", telemetry::hist_upper_ns(i) / 1e9);
+        return buf;
+    };
+    auto render_hist = [&](const char *name, const std::string &labels,
+                           const telemetry::HistSnapshot &h) {
+        uint64_t cum = 0;
+        for (size_t i = 0; i + 1 < telemetry::kHistBuckets; ++i) {
+            if (!h.buckets[i]) continue;
+            cum += h.buckets[i];
+            o += std::string(name) + "_bucket{" + labels + ",le=\"" +
+                 hist_le(i) + "\"} " + num(cum) + "\n";
+        }
+        cum += h.buckets[telemetry::kHistBuckets - 1];
+        o += std::string(name) + "_bucket{" + labels + ",le=\"+Inf\"} " +
+             num(cum) + "\n";
+        o += std::string(name) + "_sum{" + labels + "} " + num(h.sum_ns / 1e9) +
+             "\n";
+        o += std::string(name) + "_count{" + labels + "} " + num(cum) + "\n";
+    };
+    auto histo = [&](const char *name, const char *help) {
+        o += "# HELP ";
+        o += name;
+        o += ' ';
+        o += help;
+        o += "\n# TYPE ";
+        o += name;
+        o += " histogram\n";
+    };
+    // each family rendered in its own pass: a histogram family whose
+    // bucket series are interleaved with other metrics is rejected by
+    // strict OpenMetrics parsers (promtool: "metric families must be
+    // grouped"), even though the classic server parser tolerates it
+    auto each_phase = [&](auto &&fn) {
+        for (const auto &[uuid, p] : fleet_peers_copy)
+            for (const auto &[phase, h] : p.phase_hists) {
+                if (h.empty()) continue;
+                std::string labels =
+                    "peer=\"" + uuid + "\",group=\"" +
+                    num(static_cast<uint64_t>(p.group)) + "\",phase=\"" +
+                    telemetry::phase_name(
+                        static_cast<telemetry::Phase>(phase)) +
+                    "\"";
+                fn(labels, h);
+            }
+    };
+    histo("pcclt_phase_latency_seconds",
+          "per-peer data-plane phase latency distribution (log2 buckets)");
+    each_phase([&](const std::string &labels, const telemetry::HistSnapshot &h) {
+        render_hist("pcclt_phase_latency_seconds", labels, h);
+    });
+    gauge("pcclt_phase_latency_p50_seconds",
+          "bucket-resolution median of the phase latency distribution");
+    each_phase([&](const std::string &labels, const telemetry::HistSnapshot &h) {
+        o += "pcclt_phase_latency_p50_seconds{" + labels + "} " +
+             num(h.quantile_ns(0.5) / 1e9) + "\n";
+    });
+    gauge("pcclt_phase_latency_p99_seconds",
+          "bucket-resolution p99 of the phase latency distribution");
+    each_phase([&](const std::string &labels, const telemetry::HistSnapshot &h) {
+        o += "pcclt_phase_latency_p99_seconds{" + labels + "} " +
+             num(h.quantile_ns(0.99) / 1e9) + "\n";
+    });
 
     counter("pcclt_peer_collectives_ok_total", "collectives completed ok, per peer");
     gauge("pcclt_peer_last_seq", "newest collective seq the peer completed");
     gauge("pcclt_peer_trace_ring_dropped",
           "peer flight-recorder events lost to ring wrap");
+    gauge("pcclt_peer_trace_ring_pushed",
+          "events pushed into the peer's flight-recorder ring");
+    gauge("pcclt_peer_trace_ring_capacity",
+          "the peer's flight-recorder ring capacity (dropped > 0 means its "
+          "traces are truncated to the newest ring_capacity events)");
     gauge("pcclt_peer_staleness_ms", "ms since the peer's last digest");
     gauge("pcclt_peer_up", "1 while the peer's control session is live");
     for (const auto &[uuid, p] : fleet_peers_copy) {
@@ -1504,6 +1711,8 @@ std::string MasterState::render_metrics() const {
         o += "pcclt_peer_collectives_ok_total" + lbl + num(p.collectives_ok) + "\n";
         o += "pcclt_peer_last_seq" + lbl + num(p.last_seq) + "\n";
         o += "pcclt_peer_trace_ring_dropped" + lbl + num(p.ring_dropped) + "\n";
+        o += "pcclt_peer_trace_ring_pushed" + lbl + num(p.ring_pushed) + "\n";
+        o += "pcclt_peer_trace_ring_capacity" + lbl + num(p.ring_cap) + "\n";
         o += "pcclt_peer_staleness_ms" + lbl +
              num((now - p.last_digest_ns) / 1'000'000) + "\n";
         o += "pcclt_peer_up" + lbl + (p.departed ? "0" : "1");
@@ -1535,6 +1744,29 @@ std::string MasterState::render_metrics() const {
         o += "pcclt_edge_wd_state" + lbl +
              num(static_cast<uint64_t>(e.wd_state)) + "\n";
     }
+    // per-(edge, phase) latency distributions: the histogram that names
+    // the HOP a stage's wall time / stall tail binds on. One pass per
+    // family, same grouping rule as the phase histograms above.
+    histo("pcclt_edge_stage_latency_seconds",
+          "per-edge ring-stage wall-time distribution (inbound hop)");
+    for (const auto &[key, e] : fleet_edges_copy) {
+        if (e.stage_wire_hist.empty()) continue;
+        std::string labels = "from=\"" + e.from_uuid + "\",to=\"" +
+                             e.to_endpoint + "\",to_peer=\"" + e.to_uuid +
+                             "\"";
+        render_hist("pcclt_edge_stage_latency_seconds", labels,
+                    e.stage_wire_hist);
+    }
+    histo("pcclt_edge_stall_latency_seconds",
+          "per-edge receiver wire-stall distribution (per stage)");
+    for (const auto &[key, e] : fleet_edges_copy) {
+        if (e.stall_hist.empty()) continue;
+        std::string labels = "from=\"" + e.from_uuid + "\",to=\"" +
+                             e.to_endpoint + "\",to_peer=\"" + e.to_uuid +
+                             "\"";
+        render_hist("pcclt_edge_stall_latency_seconds", labels,
+                    e.stall_hist);
+    }
     return o;
 }
 
@@ -1547,6 +1779,8 @@ std::string MasterState::render_health_json() const {
     std::map<std::string, PeerHealth> fleet_peers_copy;
     std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
     uint64_t digests_total_copy, stragglers_copy;
+    uint64_t incidents_copy, incidents_suppressed_copy;
+    std::deque<IncidentRec> incidents_recent_copy;
     size_t world_copy, clients_copy, limbo_copy;
     {
         MutexLock lk(health_mu_);
@@ -1554,6 +1788,9 @@ std::string MasterState::render_health_json() const {
         fleet_edges_copy = fleet_edges_;
         digests_total_copy = digests_total_;
         stragglers_copy = stragglers_flagged_;
+        incidents_copy = incidents_total_;
+        incidents_suppressed_copy = incidents_suppressed_;
+        incidents_recent_copy = recent_incidents_;
         world_copy = health_world_;
         clients_copy = health_clients_;
         limbo_copy = health_limbo_;
@@ -1564,6 +1801,25 @@ std::string MasterState::render_health_json() const {
     o += ",\"limbo_sessions\":" + num(static_cast<uint64_t>(limbo_copy));
     o += ",\"telemetry_digests\":" + num(digests_total_copy);
     o += ",\"stragglers_flagged\":" + num(stragglers_copy);
+    o += ",\"incidents_total\":" + num(incidents_copy);
+    o += ",\"incidents_suppressed\":" + num(incidents_suppressed_copy);
+    // newest-last recent incident ids: the pointer from a live /health
+    // scrape into the PCCLT_INCIDENT_DIR bundle directories
+    o += ",\"incidents\":[";
+    {
+        bool first_inc = true;
+        for (const auto &inc : incidents_recent_copy) {
+            if (!first_inc) o += ',';
+            first_inc = false;
+            o += "{\"id\":";
+            json_str(o, inc.id);
+            o += ",\"trigger\":";
+            json_str(o, inc.trigger);
+            o += ",\"age_ms\":" + num((now - inc.t_ns) / 1'000'000);
+            o += '}';
+        }
+    }
+    o += "]";
     o += ",\"peers\":[";
     bool first = true;
     for (const auto &[uuid, p] : fleet_peers_copy) {
@@ -1575,6 +1831,8 @@ std::string MasterState::render_health_json() const {
         o += ",\"last_seq\":" + num(p.last_seq);
         o += ",\"collectives_ok\":" + num(p.collectives_ok);
         o += ",\"ring_dropped\":" + num(p.ring_dropped);
+        o += ",\"ring_pushed\":" + num(p.ring_pushed);
+        o += ",\"ring_cap\":" + num(p.ring_cap);
         o += ",\"digests\":" + num(p.digests);
         o += ",\"staleness_ms\":" + num((now - p.last_digest_ns) / 1'000'000);
         o += ",\"up\":";

@@ -21,6 +21,7 @@
 #include <atomic>
 #include <chrono>
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <memory>
 #include <optional>
@@ -33,6 +34,7 @@
 #include "bandwidth.hpp"
 #include "journal.hpp"
 #include "protocol.hpp"
+#include "telemetry.hpp"
 
 namespace pcclt::master {
 
@@ -90,10 +92,16 @@ struct PeerHealth {
     uint32_t group = 0;
     uint64_t last_seq = 0;       // newest collective seq the peer completed
     uint64_t ring_dropped = 0;   // its flight-recorder events lost to wrap
+    uint64_t ring_pushed = 0;    // events pushed into its recorder ring
+    uint64_t ring_cap = 0;       // its ring capacity (saturation gauge)
     uint64_t collectives_ok = 0;
     uint64_t digests = 0;        // digests received from this peer
     uint64_t last_digest_ns = 0; // telemetry clock at the last digest
     bool departed = false;       // disconnected (entry kept for post-mortems)
+    // comm-level phase latency histograms (cumulative; keyed by
+    // telemetry::Phase wire value) — rendered as Prometheus histogram
+    // series + quantile summary gauges
+    std::map<uint8_t, telemetry::HistSnapshot> phase_hists;
 };
 
 struct EdgeHealth {
@@ -115,6 +123,8 @@ struct EdgeHealth {
     // this straggler flag came from a watchdog CONFIRM (outbound witness),
     // so recovery is judged by the watchdog clearing, not the rx rate
     bool wd_flagged = false;
+    // per-edge latency distributions (cumulative, from the digest)
+    telemetry::HistSnapshot stage_wire_hist, stall_hist;
 };
 
 struct GroupState {
@@ -245,6 +255,23 @@ private:
     // publish_health_summary republishes the dispatcher-only world view
     // (counts) so readers never touch clients_/limbo_ themselves.
     void publish_health_summary() PCCLT_EXCLUDES(health_mu_);
+    // ---- incident black box (docs/09) ----
+    // When PCCLT_INCIDENT_DIR is set and an incident trigger fires
+    // (collective abort, kick, watchdog CONFIRM, limbo expiry), broadcast
+    // a fire-and-forget kM2CIncidentDump to every connected client under a
+    // fresh shared incident id and write the master-side manifest
+    // (trigger + fleet-health snapshot) under that id. Rate-limited by
+    // PCCLT_INCIDENT_MIN_MS (default 30000) so a flapping edge cannot
+    // spam disk — suppressed triggers only bump the counter.
+    void maybe_incident(std::vector<Outbox> &out, const std::string &trigger,
+                        uint32_t group);
+    struct IncidentRec {
+        std::string id, trigger;
+        uint64_t t_ns = 0; // telemetry clock at the trigger
+    };
+    // dispatcher-only: rate limiter + id counter
+    uint64_t last_incident_ns_ = 0;
+    uint64_t incident_seq_ = 0;
     // spawn a background ATSP improvement seeded from the current ring,
     // with the straggler's measured rate substituted into the cost matrix
     // (PCCLT_STRAGGLER_REOPT=1 hook; adopted at the next optimize round)
@@ -262,6 +289,11 @@ private:
         PCCLT_GUARDED_BY(health_mu_);
     uint64_t digests_total_ PCCLT_GUARDED_BY(health_mu_) = 0;
     uint64_t stragglers_flagged_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    // incident plane: fired incidents (newest last, bounded) + trigger
+    // totals incl. rate-limited suppressions, listed on /health
+    std::deque<IncidentRec> recent_incidents_ PCCLT_GUARDED_BY(health_mu_);
+    uint64_t incidents_total_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    uint64_t incidents_suppressed_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_world_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_clients_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_limbo_ PCCLT_GUARDED_BY(health_mu_) = 0;

@@ -5,6 +5,8 @@
 #include <random>
 #include <stdexcept>
 
+#include "telemetry.hpp"
+
 namespace pcclt::proto {
 
 std::string uuid_str(const Uuid &u) {
@@ -321,6 +323,52 @@ std::optional<OptimizeResponse> OptimizeResponse::decode(const std::vector<uint8
 
 // --- TelemetryDigestC2M ---
 
+namespace {
+
+// sparse histogram blob: u64 sum, u8 n, n x (u8 idx, u64 count).
+// n and every idx are bounded by the fixed log2 grid.
+constexpr uint8_t kWireHistBuckets = 26;
+// growing the telemetry grid without widening the wire bound would make
+// get_hist reject every digest carrying the new buckets — the fleet view
+// would silently go stale with no diagnostic
+static_assert(kWireHistBuckets == telemetry::kHistBuckets,
+              "wire histogram grid must match telemetry::kHistBuckets");
+// the decode bound below accepts phase ids <= 16 (looser than kPhaseCount
+// on purpose: a newer peer's extra phases are dropped at the fold, not
+// rejected) — but if the Phase enum itself outgrows the wire bound, every
+// digest from a new peer is rejected wholesale and the fleet view goes
+// silently stale
+static_assert(telemetry::kPhaseCount <= 17,
+              "Phase outgrew the digest decode bound (phase > 16): widen "
+              "the wire bound in TelemetryDigestC2M::decode in lockstep");
+
+void put_hist(wire::Writer &w, const WireHist &h) {
+    w.u64(h.sum_ns);
+    w.u8(static_cast<uint8_t>(h.buckets.size()));
+    for (const auto &[idx, count] : h.buckets) {
+        w.u8(idx);
+        w.u64(count);
+    }
+}
+
+// throws on structural damage (via Reader); returns nullopt on a blob
+// that parses but violates the grid bounds
+std::optional<WireHist> get_hist(wire::Reader &r) {
+    WireHist h;
+    h.sum_ns = r.u64();
+    uint8_t n = r.u8();
+    if (n > kWireHistBuckets) return std::nullopt;
+    for (uint8_t i = 0; i < n; ++i) {
+        uint8_t idx = r.u8();
+        uint64_t count = r.u64();
+        if (idx >= kWireHistBuckets) return std::nullopt;
+        h.buckets.emplace_back(idx, count);
+    }
+    return h;
+}
+
+} // namespace
+
 std::vector<uint8_t> TelemetryDigestC2M::encode() const {
     wire::Writer w;
     w.u64(epoch);
@@ -343,6 +391,19 @@ std::vector<uint8_t> TelemetryDigestC2M::encode() const {
         w.u64(o.seq);
         w.u64(o.dur_ns);
         w.u64(o.stall_ns);
+    }
+    // trailing attribution section (decoders without it stop above)
+    w.u64(ring_pushed);
+    w.u64(ring_cap);
+    w.u8(static_cast<uint8_t>(phase_hists.size()));
+    for (const auto &[phase, h] : phase_hists) {
+        w.u8(phase);
+        put_hist(w, h);
+    }
+    // per-edge hists, parallel to `edges` by index (same count, in order)
+    for (const auto &e : edges) {
+        put_hist(w, e.stage_wire_hist);
+        put_hist(w, e.stall_hist);
     }
     return w.take();
 }
@@ -405,6 +466,61 @@ std::optional<TelemetryDigestC2M> TelemetryDigestC2M::decode(
             o.stall_ns = r.u64();
             d.ops.push_back(o);
         }
+        // trailing attribution section: absent on older peers (clean EOF
+        // right here), malformed content still rejects the frame
+        bool has_tail = true;
+        try {
+            d.ring_pushed = r.u64();
+        } catch (...) { has_tail = false; }
+        if (has_tail) {
+            d.ring_cap = r.u64();
+            uint8_t np = r.u8();
+            if (np > 16) return std::nullopt; // telemetry::kPhaseCount is 7
+            for (uint8_t i = 0; i < np; ++i) {
+                uint8_t phase = r.u8();
+                auto h = get_hist(r);
+                if (!h || phase > 16) return std::nullopt;
+                d.phase_hists.emplace_back(phase, std::move(*h));
+            }
+            for (auto &e : d.edges) {
+                auto hw = get_hist(r);
+                auto hs = get_hist(r);
+                if (!hw || !hs) return std::nullopt;
+                e.stage_wire_hist = std::move(*hw);
+                e.stall_hist = std::move(*hs);
+            }
+        }
+        return d;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- IncidentDumpM2C ---
+
+std::vector<uint8_t> IncidentDumpM2C::encode() const {
+    wire::Writer w;
+    w.str(incident_id);
+    w.str(trigger);
+    w.u64(epoch);
+    return w.take();
+}
+
+std::optional<IncidentDumpM2C> IncidentDumpM2C::decode(
+    const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        IncidentDumpM2C d;
+        d.incident_id = r.str();
+        d.trigger = r.str();
+        d.epoch = r.u64();
+        // the id becomes a directory name on every peer: refuse anything
+        // that could traverse or hide ("" / separators / dotfiles)
+        if (d.incident_id.empty() || d.incident_id.size() > 128 ||
+            d.incident_id[0] == '.')
+            return std::nullopt;
+        for (char c : d.incident_id)
+            if (!isalnum(static_cast<unsigned char>(c)) && c != '-' &&
+                c != '_')
+                return std::nullopt;
         return d;
     } catch (...) { return std::nullopt; }
 }

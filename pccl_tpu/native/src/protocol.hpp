@@ -75,6 +75,13 @@ enum PacketType : uint16_t {
     // MasterState::defer_topology_voters)
     kM2CTopologyDeferred = 0x200D,
     kM2CSessionResumeAck = 0x200E,
+    // fire-and-forget black-box capture order (incident plane, docs/09):
+    // broadcast by the master when an incident trigger fires (collective
+    // abort, kick, watchdog CONFIRM, limbo expiry) and PCCLT_INCIDENT_DIR
+    // is set. Each peer writes its trace ring + stats snapshot under the
+    // shared incident id; never answered and rate-limited master-side so
+    // a flapping edge cannot spam disk.
+    kM2CIncidentDump = 0x200F,
 
     // p2p handshake
     kP2PHello = 0x3001,
@@ -212,6 +219,16 @@ struct SharedStateSyncResp {
 // world size) plus at most kOpRing op samples — a digest stays well under
 // a KiB even on wide worlds, so the default cadence costs nothing
 // next to a single data frame.
+// Latency histogram on the wire (critical-path attribution, docs/09):
+// sparse (index, count) pairs over the fixed log2 bucket grid — a hist
+// with k nonzero buckets costs 9k+9 bytes, bounded by the grid size, so
+// the digest stays compact even with every phase populated.
+struct WireHist {
+    uint64_t sum_ns = 0;
+    std::vector<std::pair<uint8_t, uint64_t>> buckets; // (bucket idx, count)
+    bool empty() const { return buckets.empty(); }
+};
+
 struct TelemetryDigestC2M {
     uint64_t epoch = 0;         // master epoch the client observes
     uint64_t last_seq = 0;      // newest collective seq completed
@@ -227,14 +244,31 @@ struct TelemetryDigestC2M {
         // master's rate-based straggler detector — the peer is already
         // relaying around the edge, so the background re-opt fires now.
         uint8_t wd_state = 0;
+        // cumulative per-edge latency distributions (stage wall / stall)
+        WireHist stage_wire_hist, stall_hist;
     };
     std::vector<Edge> edges;
     struct Op {
         uint64_t seq = 0, dur_ns = 0, stall_ns = 0;
     };
     std::vector<Op> ops;
+    // trailing attribution section (older peers simply omit it):
+    // flight-recorder ring accounting + comm-level phase histograms
+    // keyed by telemetry::Phase values (u8 on the wire)
+    uint64_t ring_pushed = 0;
+    uint64_t ring_cap = 0;
+    std::vector<std::pair<uint8_t, WireHist>> phase_hists;
     std::vector<uint8_t> encode() const;
     static std::optional<TelemetryDigestC2M> decode(const std::vector<uint8_t> &);
+};
+
+// Black-box capture order (kM2CIncidentDump, docs/09 incident plane).
+struct IncidentDumpM2C {
+    std::string incident_id; // shared bundle key ("inc-e<epoch>-<n>")
+    std::string trigger;     // what fired: collective_abort / kick / ...
+    uint64_t epoch = 0;      // master epoch at the trigger
+    std::vector<uint8_t> encode() const;
+    static std::optional<IncidentDumpM2C> decode(const std::vector<uint8_t> &);
 };
 
 struct BenchRequest {

@@ -30,7 +30,7 @@ bool prof_enabled() {
 // counter and the "wire_stall" trace event both read from it.
 struct Prof {
     uint64_t wait_ns = 0, compute_ns = 0, join_ns = 0, reg_ns = 0,
-             quant_ns = 0;
+             quant_ns = 0, dequant_ns = 0;
 };
 
 using telemetry::now_ns;
@@ -77,11 +77,16 @@ size_t pipeline_windows(size_t bytes) {
 void send_ahead_windows(net::Link &tx, uint64_t tag, const uint8_t *src,
                         size_t total, size_t wb, size_t prefix, size_t rot,
                         size_t *ahead_off, std::vector<net::SendHandle> *hs) {
+    auto &rec = telemetry::Recorder::inst();
+    const bool wt = rec.on() && telemetry::win_trace_enabled();
     while (*ahead_off < total) {
         size_t seg = std::min(wb, total - *ahead_off);
         if (total - (*ahead_off + seg) < wb) seg = total - *ahead_off;
         if (prefix < *ahead_off + seg) break;
         hs->push_back(tx.send_at(tag, *ahead_off, {src + *ahead_off, seg}, rot));
+        if (wt)
+            rec.instant("window", "win_submit", "off", *ahead_off, "bytes",
+                        seg, nullptr, "seq", rot);
         *ahead_off += seg;
     }
 }
@@ -218,7 +223,8 @@ bool wd_escalate(Wd &wd, RingCtx &ctx, const net::SendHandle &h) {
     wd.tripped = true;
     wd_mark(ctx.tx_edge, EdgeHealth::kSuspect);
     if (rec.on())
-        rec.instant("watchdog", "edge_suspect", "bytes", b, "seq", ctx.op_seq);
+        rec.instant("watchdog", "edge_suspect", "bytes", b, "seq", ctx.op_seq,
+                    ctx.tx_endpoint);
     net::SendHandle h2;
     if (!wd.skip_reissue) {
         if (!wd.fresh_tried) {
@@ -258,7 +264,7 @@ bool wd_escalate(Wd &wd, RingCtx &ctx, const net::SendHandle &h) {
         wd.relay_all = true;
         if (rec.on())
             rec.instant("watchdog", "edge_confirm", "bytes", b, "seq",
-                        ctx.op_seq);
+                        ctx.op_seq, ctx.tx_endpoint);
         wd.detoured.insert(h.get());
         wd.zombies.push_back(h);
         if (h2 && !h2->done()) wd.zombies.push_back(h2);
@@ -293,6 +299,11 @@ void wd_poll(Wd &wd, RingCtx &ctx) {
             // edge's drain times would poison the recovered-state deadline)
             if (ctx.tx_edge->wd_health.load(std::memory_order_relaxed) == 0)
                 wd_update_rate(ctx.tx_edge, h->span.size(), now - it->second);
+            if (telemetry::win_trace_enabled() &&
+                telemetry::Recorder::inst().on())
+                telemetry::Recorder::inst().instant(
+                    "window", "win_drained", "bytes", h->span.size(),
+                    "age_ns", now - it->second, nullptr, "seq", ctx.op_seq);
             it = wd.inflight.erase(it);
             continue;
         }
@@ -359,6 +370,11 @@ bool wd_join(Wd &wd, RingCtx &ctx, std::vector<net::SendHandle> &hs) {
             }
         }
     }
+    // sweep completed handles out of wd.inflight NOW: the per-handle loop
+    // above exits on done() without a final poll, and a handle left in the
+    // map until the next stage's poll would feed the EWMA an inflated
+    // drain time and stamp its win_drained event with a stale age
+    wd_poll(wd, ctx);
     return ok;
 }
 
@@ -372,6 +388,49 @@ void wd_op_clean(Wd &wd, RingCtx &ctx) {
     uint32_t susp = static_cast<uint32_t>(telemetry::EdgeHealth::kSuspect);
     ctx.tx_edge->wd_health.compare_exchange_strong(
         susp, 0, std::memory_order_relaxed);
+}
+
+// Per-stage attribution (docs/09 critical-path plane): every ring stage's
+// wall time and its stall slice land in the always-on edge/phase
+// histograms, and — recorder on — in an enriched stage span carrying
+// (stage, seq, stall_ns) plus the inbound edge endpoint, the tuple
+// tools/trace_critic reconstructs the binding chain from. Call sites wrap
+// this in a ScopeExit so the FAILING stage of an aborted op still leaves
+// its span — the incident bundle's whole point is that exact evidence.
+void stage_attrib(RingCtx &ctx, const Prof &prof, const char *name,
+                  uint32_t s, uint64_t t0, uint64_t wait0) {
+    const uint64_t t1 = now_ns();
+    const uint64_t stall = prof.wait_ns - wait0;
+    if (ctx.tele)
+        ctx.tele->record_phase(telemetry::Phase::kStageWire, t1 - t0);
+    if (ctx.rx_edge) {
+        ctx.rx_edge->stage_wire_hist.record(t1 - t0);
+        ctx.rx_edge->stall_hist.record(stall);
+    }
+    auto &rec = telemetry::Recorder::inst();
+    if (rec.on())
+        rec.span("collective", name, t0, t1, "stage", s, "seq", ctx.op_seq,
+                 ctx.rx_endpoint, "stall_ns", stall);
+}
+
+template <class F> struct ScopeExit {
+    F f;
+    ~ScopeExit() { f(); }
+};
+template <class F> ScopeExit(F) -> ScopeExit<F>;
+
+// Post-failover zombie wait, attributed: stalled direct copies crawl out
+// at the DEGRADED rate, and on the transition op this wait can dominate
+// the wall time — trace_critic must see where it went.
+void drain_zombies(RingCtx &ctx, std::vector<net::SendHandle> &zs) {
+    if (zs.empty()) return;
+    const uint64_t t0 = now_ns();
+    net::Link::wait_all(zs);
+    zs.clear();
+    auto &rec = telemetry::Recorder::inst();
+    if (rec.on())
+        rec.span("collective", "zombie_drain", t0, now_ns(), "seq",
+                 ctx.op_seq, nullptr, 0, ctx.tx_endpoint);
 }
 
 struct ChunkSpan {
@@ -466,7 +525,8 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
                 if (telemetry::Recorder::inst().on())
                     telemetry::Recorder::inst().instant(
                         "watchdog", "rx_stall_suspect", "filled", filled,
-                        "target", target);
+                        "target", target, ctx.rx_endpoint, "seq",
+                        ctx.op_seq);
             }
         }
         if (cma_pending) {
@@ -482,6 +542,11 @@ bool stream_recv(RingCtx &ctx, uint64_t tag, size_t target, size_t elem_size,
             t0 = now_ns();
             on_data(scratch + consumed, consumed, usable);
             if (prof) prof->compute_ns += now_ns() - t0;
+            if (telemetry::win_trace_enabled() &&
+                telemetry::Recorder::inst().on())
+                telemetry::Recorder::inst().instant(
+                    "window", "rx_slice", "lo", consumed, "hi", usable,
+                    nullptr, "seq", ctx.op_seq);
             consumed = usable;
         }
         if (consumed >= target) break;
@@ -617,12 +682,23 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // recorder's relaxed atomic flag.
     auto &rec = telemetry::Recorder::inst();
     const bool trace = rec.on();
+    // verbose per-window lifecycle tier (docs/09 attribution plane)
+    const bool wtrace = trace && telemetry::win_trace_enabled();
     Prof prof;
     auto op_t0 = now_ns();
     auto join_tx = [&](std::vector<net::SendHandle> &hs) -> bool {
         auto t0 = now_ns();
         bool ok = wd.on ? wd_join(wd, ctx, hs) : net::Link::wait_all(hs);
         prof.join_ns += now_ns() - t0;
+        // watchdog on: wd_poll already emitted win_drained (with age_ns)
+        // when it erased each completed handle — emitting here too would
+        // double-count every window in the verbose tier
+        if (wtrace && !wd.on && rec.on())
+            for (const auto &h : hs)
+                if (h && h->done())  // drain observed at the stage join
+                    rec.instant("window", "win_drained", "bytes",
+                                h->span.size(), nullptr, 0, nullptr, "seq",
+                                ctx.op_seq);
         return ok;
     };
     auto reg_sink = [&](uint64_t tag, uint8_t *base, size_t cap, bool consumer_pull) {
@@ -634,6 +710,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         auto t0 = now_ns();
         fn();
         prof.quant_ns += now_ns() - t0;
+    };
+    auto dequant_timed = [&](auto &&fn) {
+        auto t0 = now_ns();
+        fn();
+        prof.dequant_ns += now_ns() - t0;
     };
     // send_ahead_windows bound to this op's state. The receiver's sink for
     // the next stage is already registered (reg_stage runs one stage
@@ -700,8 +781,13 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     auto rs_t0 = now_ns();
     for (uint32_t s = 0; s + 1 < world; ++s) {
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " rs stage " << s;
-        telemetry::Span stage_span("collective", "rs_stage", "stage", s,
-                                   "seq", ctx.op_seq);
+        const uint64_t stage_t0 = now_ns();
+        const uint64_t stage_wait0 = prof.wait_ns;
+        // scope-exit, not end-of-loop: a failing stage's early return must
+        // still leave its (truncated) span — incident forensics need it
+        ScopeExit stage_span{[&, s] {
+            stage_attrib(ctx, prof, "rs_stage", s, stage_t0, stage_wait0);
+        }};
         const uint64_t tag = base_tag | s;
         const uint32_t send_c = (rank + world - s) % world;
         const uint32_t recv_c = (rank + world - s - 1) % world;
@@ -738,16 +824,25 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                     auto ws = chunk_of(send_span.n_elems,
                                        static_cast<uint32_t>(qw),
                                        static_cast<uint32_t>(w));
+                    const uint64_t qt0 = now_ns();
                     quant_timed([&] {
                         quant::quantize(meta, send_ptr + ws.start_elem * esz,
                                         tx_scratch.data() + ws.start_elem * qsz,
                                         ws.n_elems);
                     });
+                    if (wtrace)
+                        rec.span("window", "win_quant", qt0, now_ns(), "win",
+                                 w, "seq", ctx.op_seq);
                     tx_job.push_back(ctx.tx.send_at(
                         tag, ws.start_elem * qsz,
                         {tx_scratch.data() + ws.start_elem * qsz,
                          ws.n_elems * qsz},
                         ctx.op_seq));
+                    if (wtrace)
+                        rec.instant("window", "win_submit", "off",
+                                    ws.start_elem * qsz, "bytes",
+                                    ws.n_elems * qsz, nullptr, "seq",
+                                    ctx.op_seq);
                 }
             }
             ctx.tx_bytes += send_span.n_elems * qsz;
@@ -769,9 +864,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
             bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
                                   [&](const uint8_t *src, size_t lo, size_t hi) {
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
-                                      quant::dequantize_accumulate(
-                                          rx_meta, ctx.op, src,
-                                          recv_ptr + e0 * esz, e1 - e0);
+                                      dequant_timed([&] {
+                                          quant::dequantize_accumulate(
+                                              rx_meta, ctx.op, src,
+                                              recv_ptr + e0 * esz, e1 - e0);
+                                      });
                                   }, &prof, /*fill_if_unmapped=*/false, 0, &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
@@ -837,10 +934,7 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // all-gather is about to overwrite — they must drain (or fail with
     // their conn) first. Only the transition op pays this; later ops under
     // a held CONFIRMED verdict start in relay mode and leave no zombies.
-    if (!wd.zombies.empty()) {
-        net::Link::wait_all(wd.zombies);
-        wd.zombies.clear();
-    }
+    drain_zombies(ctx, wd.zombies);
 
     if (trace)
         rec.span("collective", "reduce_scatter", rs_t0, now_ns(), "seq",
@@ -856,8 +950,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     std::vector<uint8_t> fwd_meta;   // encoded meta to forward
     for (uint32_t s = 0; s + 1 < world; ++s) {
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " ag stage " << s;
-        telemetry::Span stage_span("collective", "ag_stage", "stage", s,
-                                   "seq", ctx.op_seq);
+        const uint64_t stage_t0 = now_ns();
+        const uint64_t stage_wait0 = prof.wait_ns;
+        ScopeExit stage_span{[&, s] {
+            stage_attrib(ctx, prof, "ag_stage", s, stage_t0, stage_wait0);
+        }};
         const uint64_t tag = base_tag | (0x4000u + s);
         const uint32_t send_c = (rank + 1 + world - s) % world;
         const uint32_t recv_c = (rank + world - s) % world;
@@ -894,18 +991,27 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                         auto ws = chunk_of(send_span.n_elems,
                                            static_cast<uint32_t>(qw),
                                            static_cast<uint32_t>(w));
+                        const uint64_t qt0 = now_ns();
                         quant_timed([&] {
                             quant::quantize(meta,
                                             send_ptr + ws.start_elem * esz,
                                             fwd_q.data() + ws.start_elem * qsz,
                                             ws.n_elems);
                         });
+                        if (wtrace)
+                            rec.span("window", "win_quant", qt0, now_ns(),
+                                     "win", w, "seq", ctx.op_seq);
                         tx_job.push_back(ctx.tx.send_at(
                             tag, ws.start_elem * qsz,
                             {fwd_q.data() + ws.start_elem * qsz,
                              ws.n_elems * qsz},
                             ctx.op_seq));
-                        quant_timed([&] {
+                        if (wtrace)
+                            rec.instant("window", "win_submit", "off",
+                                        ws.start_elem * qsz, "bytes",
+                                        ws.n_elems * qsz, nullptr, "seq",
+                                        ctx.op_seq);
+                        dequant_timed([&] {
                             quant::dequantize_set(
                                 meta, fwd_q.data() + ws.start_elem * qsz,
                                 send_ptr + ws.start_elem * esz, ws.n_elems);
@@ -916,6 +1022,8 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                     quant_timed([&] {
                         quant::quantize(meta, send_ptr, fwd_q.data(),
                                         send_span.n_elems);
+                    });
+                    dequant_timed([&] {
                         // bit parity: owner keeps what the others decode
                         quant::dequantize_set(meta, fwd_q.data(), send_ptr,
                                               send_span.n_elems);
@@ -945,8 +1053,11 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       if (fwd_needed && src != rx_scratch + lo)
                                           memcpy(rx_scratch + lo, src, hi - lo);
                                       size_t e0 = lo / qsz, e1 = hi / qsz;
-                                      quant::dequantize_set(*m, src,
-                                                            recv_ptr + e0 * esz, e1 - e0);
+                                      dequant_timed([&] {
+                                          quant::dequantize_set(
+                                              *m, src, recv_ptr + e0 * esz,
+                                              e1 - e0);
+                                      });
                                   }, &prof, /*fill_if_unmapped=*/false, 0, &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
@@ -1007,18 +1118,25 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
 
     // zombie direct sends still borrow result-buffer spans; the purge also
     // needs their tags quiet before retiring the op's range
-    if (!wd.zombies.empty()) {
-        net::Link::wait_all(wd.zombies);
-        wd.zombies.clear();
-    }
+    drain_zombies(ctx, wd.zombies);
     wd_op_clean(wd, ctx);  // clean direct op: SUSPECT history drops to OK
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
     uint64_t op_t1 = now_ns();
     if (ctx.rx_edge)  // receiver wire-stall charged to the inbound edge
         ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns, std::memory_order_relaxed);
-    if (ctx.tele)  // digest op sample (last-N phase timings)
+    if (ctx.tele) {  // digest op sample (last-N phase timings)
         ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
+        // attribution histograms (docs/09): the distributions /metrics
+        // renders — per-op so the tail a coupled ring binds on is visible
+        using telemetry::Phase;
+        ctx.tele->record_phase(Phase::kOp, op_t1 - op_t0);
+        ctx.tele->record_phase(Phase::kStall, prof.wait_ns);
+        if (quantized) {
+            ctx.tele->record_phase(Phase::kQuantize, prof.quant_ns);
+            ctx.tele->record_phase(Phase::kDequantize, prof.dequant_ns);
+        }
+    }
     if (trace) {
         rec.span("collective", "all_gather", ag_t0, op_t1, "seq", ctx.op_seq,
                  "bytes", (count * esz / world) * (world - 1));
@@ -1026,15 +1144,19 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                  "bytes", count * esz);
         rec.instant("collective", "wire_stall", "ns", prof.wait_ns, "seq",
                     ctx.op_seq);
-        if (quantized)
+        if (quantized) {
             rec.instant("collective", "quantize", "ns", prof.quant_ns, "seq",
                         ctx.op_seq);
+            rec.instant("collective", "dequantize", "ns", prof.dequant_ns,
+                        "seq", ctx.op_seq);
+        }
     }
     if (prof_enabled())
         PLOG(kInfo) << "reduce prof: total=" << (op_t1 - op_t0) / 1e6
                     << "ms wait=" << prof.wait_ns / 1e6
                     << " compute=" << prof.compute_ns / 1e6
                     << " quant=" << prof.quant_ns / 1e6
+                    << " dequant=" << prof.dequant_ns / 1e6
                     << " join=" << prof.join_ns / 1e6
                     << " reg=" << prof.reg_ns / 1e6;
     return Result::kOk;
@@ -1089,8 +1211,11 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
     size_t ahead_off = 0;
     for (uint32_t s = 0; s + 1 < world; ++s) {
         const uint64_t tag = base_tag | s;
-        telemetry::Span stage_span("collective", "gather_stage", "stage", s,
-                                   "seq", ctx.op_seq);
+        const uint64_t stage_t0 = now_ns();
+        const uint64_t stage_wait0 = prof.wait_ns;
+        ScopeExit stage_span{[&, s] {
+            stage_attrib(ctx, prof, "gather_stage", s, stage_t0, stage_wait0);
+        }};
         const uint32_t fwd_rank = (rank + world - s) % world; // own at s=0
         const uint8_t *src = s == 0 ? static_cast<const uint8_t *>(send)
                                     : out + slot(fwd_rank) * seg;
@@ -1140,18 +1265,19 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
         }
         ctx.rx_bytes += seg;
     }
-    if (!wd.zombies.empty()) {  // zombie sends borrow spans of `out`
-        net::Link::wait_all(wd.zombies);
-        wd.zombies.clear();
-    }
+    // zombie sends borrow spans of `out`
+    drain_zombies(ctx, wd.zombies);
     wd_op_clean(wd, ctx);
     ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
     ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
     uint64_t op_t1 = now_ns();
     if (ctx.rx_edge)
         ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns, std::memory_order_relaxed);
-    if (ctx.tele)
+    if (ctx.tele) {
         ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
+        ctx.tele->record_phase(telemetry::Phase::kOp, op_t1 - op_t0);
+        ctx.tele->record_phase(telemetry::Phase::kStall, prof.wait_ns);
+    }
     if (trace) {
         rec.span("collective", "allgather", op_t0, op_t1, "seq", ctx.op_seq,
                  "bytes", static_cast<uint64_t>(world) * seg);

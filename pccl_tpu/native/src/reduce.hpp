@@ -45,6 +45,11 @@ struct RingCtx {
     // predecessor's canonical endpoint) — receiver wire-stall time is
     // charged here at op end. Optional; null skips attribution.
     telemetry::EdgeCounters *rx_edge = nullptr;
+    // interned canonical endpoints of the inbound/outbound hops, stamped
+    // into per-stage trace events so tools/trace_critic can attribute a
+    // binding segment to a concrete EDGE, not just a peer. Optional.
+    const char *rx_endpoint = nullptr;
+    const char *tx_endpoint = nullptr;
     // ---- straggler-immune data plane (docs/05 three-stage ladder) ----
     // Edge watchdog config, resolved by the client per op from
     // PCCLT_WATCHDOG / PCCLT_WATCHDOG_FACTOR / PCCLT_WATCHDOG_MIN_MS.

@@ -200,6 +200,45 @@ static void test_telemetry() {
 // the op-sample ring, the recorder's ring-drop accounting, and the master's
 // fleet-health render fed through a real digest packet round-trip.
 static void test_observability() {
+    // log2 latency histogram (attribution plane, docs/09): bucket edges,
+    // overflow bucket, merge, quantile resolution, sparse<->dense
+    {
+        using telemetry::kHistBuckets;
+        CHECK(telemetry::hist_bucket(0) == 0);
+        CHECK(telemetry::hist_bucket(8191) == 0);   // < 8 µs -> bucket 0
+        CHECK(telemetry::hist_bucket(8192) == 1);   // [2^13, 2^14)
+        CHECK(telemetry::hist_bucket(16383) == 1);
+        CHECK(telemetry::hist_bucket(16384) == 2);
+        CHECK(telemetry::hist_bucket(~0ull) == kHistBuckets - 1);
+        CHECK(telemetry::hist_upper_ns(0) == 8192);
+        CHECK(telemetry::hist_upper_ns(kHistBuckets - 1) == ~0ull);
+        telemetry::Hist h;
+        h.record(0);
+        h.record(10'000);
+        h.record(1ull << 40);  // ~18 min: lands in the overflow bucket
+        auto s = h.snapshot();
+        CHECK(s.count() == 3);
+        CHECK(s.buckets[0] == 1 && s.buckets[1] == 1);
+        CHECK(s.buckets[kHistBuckets - 1] == 1);
+        CHECK(s.sum_ns == 10'000 + (1ull << 40));
+        auto m = s;
+        m.merge(s);
+        CHECK(m.count() == 6 && m.sum_ns == 2 * s.sum_ns);
+        // quantiles resolve to the holding bucket's upper edge; the
+        // overflow bucket reports its finite lower edge, never +Inf
+        telemetry::Hist q;
+        for (int i = 0; i < 99; ++i) q.record(10'000);
+        q.record(1'000'000'000);  // one ~1 s outlier
+        CHECK(q.snapshot().quantile_ns(0.5) == 16384);
+        CHECK(q.snapshot().quantile_ns(1.0) >= (1ull << 30));
+        CHECK(s.quantile_ns(1.0) < ~0ull);  // overflow stays finite
+        // sparse wire form is lossless over the grid
+        auto dn = telemetry::hist_dense(s.sum_ns, telemetry::hist_sparse(s));
+        CHECK(dn.sum_ns == s.sum_ns && dn.count() == s.count());
+        for (size_t i = 0; i < kHistBuckets; ++i)
+            CHECK(dn.buckets[i] == s.buckets[i]);
+    }
+
     // op-sample ring: keeps the newest kOpRing, last_seq tracks the max
     auto dom = std::make_shared<telemetry::Domain>();
     for (uint64_t i = 1; i <= 12; ++i) dom->record_op(i, i * 100, i * 10);
@@ -226,19 +265,50 @@ static void test_observability() {
     CHECK(d2.edges[0].tx_bytes == 1'000'500);
     CHECK(d2.edges[0].tx_mbps < d1.edges[0].tx_mbps); // EWMA decays
 
-    // digest wire round-trip
+    // digest wire round-trip, incl. the trailing attribution section
+    // (ring accounting + sparse phase/edge histograms)
     proto::TelemetryDigestC2M pkt;
     pkt.epoch = 3;
     pkt.last_seq = d2.last_seq;
     pkt.ring_dropped = 7;
     pkt.collectives_ok = 9;
-    pkt.edges.push_back({"10.0.0.1:1", 12.5, 3.25, 0.125, 1'000'500, 77});
+    pkt.edges.push_back({"10.0.0.1:1", 12.5, 3.25, 0.125, 1'000'500, 77, 0, {}, {}});
     pkt.ops.push_back({12, 1200, 120});
+    pkt.ring_pushed = 5000;
+    pkt.ring_cap = 65536;
+    pkt.phase_hists.emplace_back(
+        0, proto::WireHist{123456, {{1, 42}, {7, 3}}});  // Phase::kOp
+    pkt.edges[0].stage_wire_hist = {888, {{3, 5}}};
+    pkt.edges[0].stall_hist = {999, {{2, 7}, {25, 1}}};  // incl. overflow
     auto dec = proto::TelemetryDigestC2M::decode(pkt.encode());
     CHECK(dec.has_value());
     CHECK(dec->epoch == 3 && dec->edges.size() == 1 && dec->ops.size() == 1);
     CHECK(dec->edges[0].endpoint == "10.0.0.1:1");
     CHECK(dec->edges[0].tx_mbps == 12.5 && dec->edges[0].rx_bytes == 77);
+    CHECK(dec->ring_pushed == 5000 && dec->ring_cap == 65536);
+    CHECK(dec->phase_hists.size() == 1 && dec->phase_hists[0].first == 0);
+    CHECK(dec->phase_hists[0].second.sum_ns == 123456);
+    CHECK(dec->phase_hists[0].second.buckets.size() == 2);
+    CHECK(dec->edges[0].stall_hist.buckets.size() == 2);
+    CHECK(dec->edges[0].stage_wire_hist.sum_ns == 888);
+    {
+        // a digest WITHOUT the tail (older peer) still decodes: chop the
+        // encoded frame at the tail's start (ring_pushed u64)
+        proto::TelemetryDigestC2M no_tail;
+        no_tail.epoch = 3;
+        no_tail.edges.push_back({"10.0.0.1:1", 1.0, 1.0, 0.0, 1, 1, 0, {}, {}});
+        auto enc = no_tail.encode();
+        // strip the tail: ring_pushed(8) + ring_cap(8) + n_phase(1) +
+        // two empty per-edge hists (sum u64 + n u8 = 9 each)
+        enc.resize(enc.size() - (8 + 8 + 1 + 9 + 9));
+        auto dec2 = proto::TelemetryDigestC2M::decode(enc);
+        CHECK(dec2.has_value() && dec2->ring_cap == 0 &&
+              dec2->phase_hists.empty());
+        // out-of-grid bucket index rejects the frame
+        proto::TelemetryDigestC2M bad = no_tail;
+        bad.phase_hists.emplace_back(0, proto::WireHist{1, {{26, 1}}});
+        CHECK(!proto::TelemetryDigestC2M::decode(bad.encode()).has_value());
+    }
 
     // fleet health render: a registered client's digest shows up in both
     // the Prometheus text and the /health JSON
@@ -256,10 +326,72 @@ static void test_observability() {
     CHECK(prom.find("pcclt_edge_tx_mbps{") != std::string::npos);
     CHECK(prom.find("to=\"10.0.0.1:1\"") != std::string::npos);
     CHECK(prom.find("pcclt_peer_last_seq{") != std::string::npos);
+    // attribution plane: histogram series (cumulative le buckets + +Inf),
+    // quantile summary gauges, ring-saturation gauges, incident counters
+    CHECK(prom.find("pcclt_phase_latency_seconds_bucket{") != std::string::npos);
+    CHECK(prom.find("phase=\"op\"") != std::string::npos);
+    CHECK(prom.find("le=\"+Inf\"} 45") != std::string::npos);  // 42 + 3
+    CHECK(prom.find("pcclt_phase_latency_seconds_count{") != std::string::npos);
+    CHECK(prom.find("pcclt_phase_latency_p99_seconds{") != std::string::npos);
+    CHECK(prom.find("pcclt_edge_stall_latency_seconds_bucket{") !=
+          std::string::npos);
+    CHECK(prom.find("pcclt_peer_trace_ring_pushed{") != std::string::npos);
+    CHECK(prom.find("pcclt_peer_trace_ring_capacity{") != std::string::npos);
+    CHECK(prom.find("pcclt_master_trace_ring_capacity ") != std::string::npos);
+    CHECK(prom.find("pcclt_master_incidents_total 0") != std::string::npos);
     auto health = st.render_health_json();
     CHECK(health.find("\"telemetry_digests\":1") != std::string::npos);
     CHECK(health.find("\"ring_dropped\":7") != std::string::npos);
+    CHECK(health.find("\"ring_pushed\":5000") != std::string::npos);
     CHECK(health.find("\"straggler\":false") != std::string::npos);
+    CHECK(health.find("\"incidents\":[]") != std::string::npos);
+
+    // scrape-cost guard (ROADMAP fleet-scale groundwork): a fleet-sized
+    // model — 128 peers x 8 edges = 1024 edge series with full histograms
+    // on every edge and phase — must render in bounded time. The bound is
+    // deliberately loose (sanitizer lanes, loaded CI boxes): it catches a
+    // quadratic render, not scheduler noise.
+    {
+        master::MasterState big;
+        proto::WireHist full{1'000'000, {}};
+        for (uint8_t i = 0; i < 26; ++i) full.buckets.emplace_back(i, i + 1);
+        const int peers = fast_mode() ? 32 : 128;
+        for (int c = 0; c < peers; ++c) {
+            proto::HelloC2M h;
+            h.p2p_port = static_cast<uint16_t>(1000 + c);
+            auto a = net::Addr::parse("10.1." + std::to_string(c / 250) + "." +
+                                          std::to_string(c % 250 + 1),
+                                      0);
+            CHECK(a.has_value());
+            big.on_hello(static_cast<uint64_t>(c + 1), *a, h);
+            proto::TelemetryDigestC2M dg;
+            dg.last_seq = c;
+            dg.ring_pushed = 100;
+            dg.ring_cap = 65536;
+            for (size_t p = 0; p < telemetry::kPhaseCount; ++p)
+                dg.phase_hists.emplace_back(static_cast<uint8_t>(p), full);
+            for (int e = 0; e < 8; ++e) {
+                proto::TelemetryDigestC2M::Edge ed;
+                ed.endpoint = "10.2.0." + std::to_string(e + 1) + ":1";
+                ed.tx_mbps = 1.0;
+                ed.rx_mbps = 1.0;
+                ed.stage_wire_hist = full;
+                ed.stall_hist = full;
+                dg.edges.push_back(std::move(ed));
+            }
+            big.on_telemetry_digest(static_cast<uint64_t>(c + 1), dg);
+        }
+        auto t0 = telemetry::now_ns();
+        auto text = big.render_metrics();
+        auto dt_ms = (telemetry::now_ns() - t0) / 1'000'000;
+        CHECK(text.size() > 100'000);  // the series are actually there
+        CHECK(text.find("pcclt_edge_stage_latency_seconds_bucket{") !=
+              std::string::npos);
+        CHECK(dt_ms < 15'000);
+        fprintf(stderr,
+                "observability: %d-peer scrape = %zu bytes in %llu ms\n",
+                peers, text.size(), (unsigned long long)dt_ms);
+    }
 
     // recorder ring-drop accounting: overflow the 64k ring, count the loss
     auto &rec = telemetry::Recorder::inst();

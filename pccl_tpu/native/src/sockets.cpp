@@ -471,6 +471,13 @@ void ControlClient::run(std::function<void()> on_disconnect) {
         while (connected_.load()) {
             auto f = recv_frame(sock_);
             if (!f) break;
+            // fire-and-forget notifications never enter the queue: no
+            // recv_match will ever consume them, and a leaked frame per
+            // push would grow the queue for the session's lifetime
+            if (auto it = notify_.find(f->type); it != notify_.end()) {
+                it->second(std::move(*f));
+                continue;
+            }
             {
                 MutexLock lk(mu_);
                 queue_.push_back(std::move(*f));
@@ -2074,6 +2081,13 @@ void MultiplexConn::rx_loop() {
         PLOG(kTrace) << "rx data tag=" << tag << " off=" << off << " len=" << n;
         edge().rx_frames.fetch_add(1, std::memory_order_relaxed);
         edge().rx_bytes.fetch_add(n, std::memory_order_relaxed);
+        // per-window attribution tier (docs/09): frame arrival on the RX
+        // thread, the wire-side counterpart of reduce.cpp's rx_slice
+        if (telemetry::win_trace_enabled() &&
+            telemetry::Recorder::inst().on())
+            telemetry::Recorder::inst().instant("window", "rx_frame", "off",
+                                                off, "bytes", n, nullptr,
+                                                "tag", tag);
         uint8_t *dst = nullptr;
         bool already_covered = false;
         bool tag_retired = false;

@@ -155,6 +155,16 @@ public:
     bool reconnect(const Addr &addr);
     // spawn reader thread; on_disconnect fires once when the socket dies
     void run(std::function<void()> on_disconnect = nullptr);
+    // Fire-and-forget notification handler for `type`: the reader thread
+    // dispatches matching frames to `fn` INSTEAD of queueing them — the
+    // consumption path for M2C packets no recv_match will ever wait for
+    // (kM2CIncidentDump). Set BEFORE the first run() and never again: the
+    // map is read lock-free by the reader; handlers survive reconnect().
+    // Keep handlers brief (they run on the reader thread) — hand heavy
+    // work to another thread.
+    void set_notify(uint16_t type, std::function<void(Frame &&)> fn) {
+        notify_[type] = std::move(fn);
+    }
     bool send(uint16_t type, std::span<const uint8_t> payload);
 
     using Pred = std::function<bool(const std::vector<uint8_t> &)>;
@@ -188,6 +198,8 @@ private:
     Mutex write_mu_; // lock-rank: io (serializes this socket's writes)
     std::thread reader_;
     std::atomic<bool> connected_{false};
+    // written before the first run(), read lock-free by reader threads
+    std::map<uint16_t, std::function<void(Frame &&)>> notify_;
     Mutex mu_; // lock-rank: 56
     CondVar cv_;
     std::deque<Frame> queue_ PCCLT_GUARDED_BY(mu_);

@@ -29,6 +29,63 @@ const char *intern(const std::string &s) {
     return table->insert(s).first->c_str();
 }
 
+bool win_trace_enabled() {
+    static const bool on = [] {
+        const char *e = std::getenv("PCCLT_TRACE_WINDOWS");
+        return e && e[0] == '1';
+    }();
+    return on;
+}
+
+const char *phase_name(Phase p) {
+    switch (p) {
+    case Phase::kOp: return "op";
+    case Phase::kCommenceWait: return "commence_wait";
+    case Phase::kOpSetup: return "op_setup";
+    case Phase::kQuantize: return "quantize";
+    case Phase::kDequantize: return "dequantize";
+    case Phase::kStageWire: return "stage_wire";
+    case Phase::kStall: return "stall";
+    case Phase::kCount: break;
+    }
+    return "?";
+}
+
+uint64_t HistSnapshot::quantile_ns(double q) const {
+    const uint64_t total = count();
+    if (total == 0) return 0;
+    if (q < 0) q = 0;
+    if (q > 1) q = 1;
+    // rank of the q-th sample, 1-based; walk the buckets to it
+    auto rank = static_cast<uint64_t>(q * (total - 1)) + 1;
+    uint64_t seen = 0;
+    for (size_t i = 0; i < kHistBuckets; ++i) {
+        seen += buckets[i];
+        // overflow bucket: report its (finite) lower edge, not +Inf
+        if (seen >= rank)
+            return i + 1 >= kHistBuckets ? (1ull << (12 + kHistBuckets - 1))
+                                         : hist_upper_ns(i);
+    }
+    return 1ull << (12 + kHistBuckets - 1);
+}
+
+std::vector<std::pair<uint8_t, uint64_t>> hist_sparse(const HistSnapshot &h) {
+    std::vector<std::pair<uint8_t, uint64_t>> out;
+    for (size_t i = 0; i < kHistBuckets; ++i)
+        if (h.buckets[i])
+            out.emplace_back(static_cast<uint8_t>(i), h.buckets[i]);
+    return out;
+}
+
+HistSnapshot hist_dense(uint64_t sum_ns,
+                        const std::vector<std::pair<uint8_t, uint64_t>> &b) {
+    HistSnapshot h;
+    h.sum_ns = sum_ns;
+    for (const auto &[idx, count] : b)
+        if (idx < kHistBuckets) h.buckets[idx] += count;
+    return h;
+}
+
 namespace {
 
 uint32_t tid_now() {
@@ -75,6 +132,8 @@ std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
             e->rx_relay_windows.load(std::memory_order_relaxed);
         s.dup_bytes = e->dup_bytes.load(std::memory_order_relaxed);
         s.dup_windows = e->dup_windows.load(std::memory_order_relaxed);
+        s.stage_wire_hist = e->stage_wire_hist.snapshot();
+        s.stall_hist = e->stall_hist.snapshot();
         out.push_back(std::move(s));
     }
     return out;
@@ -112,9 +171,13 @@ Digest DigestSnapshotter::snapshot() {
     d.interval_ns = dt;
     d.last_seq = d_->last_seq();
     d.ring_dropped = Recorder::inst().dropped();
+    d.ring_pushed = Recorder::inst().pushed();
+    d.ring_cap = Recorder::ring_capacity();
     d.collectives_ok =
         d_->comm.collectives_ok.load(std::memory_order_relaxed);
     d.ops = d_->recent_ops();
+    for (size_t p = 0; p < kPhaseCount; ++p)
+        d.phases[p] = d_->phase_snapshot(static_cast<Phase>(p));
     const double dt_s = dt / 1e9;
     for (const auto &e : d_->snapshot_edges()) {
         auto &p = prev_[e.endpoint];
@@ -148,6 +211,8 @@ Digest DigestSnapshotter::snapshot() {
         ed.tx_bytes = e.tx_bytes;
         ed.rx_bytes = e.rx_bytes;
         ed.wd_state = e.wd_health;
+        ed.stage_wire_hist = e.stage_wire_hist;
+        ed.stall_hist = e.stall_hist;
         d.edges.push_back(std::move(ed));
     }
     return d;
@@ -206,7 +271,8 @@ void Recorder::push(const Event &ev) {
 
 void Recorder::span(const char *cat, const char *name, uint64_t t0_ns,
                     uint64_t t1_ns, const char *arg0, uint64_t v0,
-                    const char *arg1, uint64_t v1, const char *detail) {
+                    const char *arg1, uint64_t v1, const char *detail,
+                    const char *arg2, uint64_t v2) {
     if (!on()) return;
     Event ev;
     ev.ts_ns = t0_ns;
@@ -215,8 +281,10 @@ void Recorder::span(const char *cat, const char *name, uint64_t t0_ns,
     ev.name = name;
     ev.arg0 = arg0;
     ev.arg1 = arg1;
+    ev.arg2 = arg2;
     ev.v0 = v0;
     ev.v1 = v1;
+    ev.v2 = v2;
     ev.detail = detail;
     ev.tid = tid_now();
     push(ev);
@@ -224,7 +292,7 @@ void Recorder::span(const char *cat, const char *name, uint64_t t0_ns,
 
 void Recorder::instant(const char *cat, const char *name, const char *arg0,
                        uint64_t v0, const char *arg1, uint64_t v1,
-                       const char *detail) {
+                       const char *detail, const char *arg2, uint64_t v2) {
     if (!on()) return;
     Event ev;
     ev.ts_ns = now_ns();
@@ -232,8 +300,10 @@ void Recorder::instant(const char *cat, const char *name, const char *arg0,
     ev.name = name;
     ev.arg0 = arg0;
     ev.arg1 = arg1;
+    ev.arg2 = arg2;
     ev.v0 = v0;
     ev.v1 = v1;
+    ev.v2 = v2;
     ev.detail = detail;
     ev.tid = tid_now();
     push(ev);
@@ -276,15 +346,28 @@ void Recorder::clear() {
                 std::memory_order_relaxed);
 }
 
+std::string json_escape(const std::string &s) {
+    std::string o;
+    o.reserve(s.size());
+    for (unsigned char c : s) {
+        if (c == '"' || c == '\\') {
+            o += '\\';
+            o += static_cast<char>(c);
+        } else if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            o += buf;
+        } else {
+            o += static_cast<char>(c);
+        }
+    }
+    return o;
+}
+
 namespace {
 
 void json_escaped(FILE *f, const char *s) {
-    for (; *s; ++s) {
-        unsigned char c = *s;
-        if (c == '"' || c == '\\') fprintf(f, "\\%c", c);
-        else if (c < 0x20) fprintf(f, "\\u%04x", c);
-        else fputc(c, f);
-    }
+    fputs(json_escape(s).c_str(), f);
 }
 
 }  // namespace
@@ -335,6 +418,7 @@ bool Recorder::dump_json(const std::string &path) const {
         };
         arg_u64(ev.arg0, ev.v0);
         arg_u64(ev.arg1, ev.v1);
+        arg_u64(ev.arg2, ev.v2);
         if (ev.epoch) arg_u64("epoch", ev.epoch);
         if (ev.detail) {
             fprintf(f, "%s\"detail\":\"", first ? "" : ",");

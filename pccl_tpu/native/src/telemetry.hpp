@@ -30,7 +30,9 @@
 // per-phase visibility as called for by arxiv 2606.01680.
 #pragma once
 
+#include <array>
 #include <atomic>
+#include <bit>
 #include <cstdint>
 #include <map>
 #include <memory>
@@ -51,6 +53,106 @@ uint64_t now_ns();
 // process-wide table so events can carry `const char *` only. Bounded use:
 // callers intern from small closed sets, never per-frame.
 const char *intern(const std::string &s);
+
+// THE JSON string escaper for every hand-rolled JSON emitter in the
+// native tree (trace dumps, /health, incident bundles): returns the
+// escaped CONTENTS (no surrounding quotes) — quote/backslash prefixed,
+// control chars as \u00XX, never dropped. One copy so an escaping fix
+// can't land in one emitter and drift from the others.
+std::string json_escape(const std::string &s);
+
+// PCCLT_TRACE_WINDOWS=1 (cached once): per-window data-plane lifecycle
+// events (win_quant / win_submit / win_drained / rx_slice / rx_frame) —
+// the verbose attribution tier on top of the recorder. Only
+// meaningful while the recorder itself is on; callers must check both.
+bool win_trace_enabled();
+
+// ------------------------------------------------------------- histograms
+//
+// Log2-bucket latency histograms (critical-path attribution plane,
+// docs/09). Always-on like the counters: record() is two relaxed atomic
+// adds, so every op/stage/stall duration lands in a DISTRIBUTION, not
+// just an average — averages hide the tail, and in a coupled ring the
+// tail IS the step time. Bucket 0 covers [0, 8 µs); bucket i covers
+// [2^(12+i), 2^(13+i)) ns; the last bucket is the overflow (>= ~137 s).
+
+constexpr size_t kHistBuckets = 26;
+
+// exclusive upper edge of bucket i in ns (the Prometheus `le` boundary);
+// the last bucket is +Inf
+inline uint64_t hist_upper_ns(size_t i) {
+    return i + 1 >= kHistBuckets ? ~0ull : (1ull << (13 + i));
+}
+
+inline size_t hist_bucket(uint64_t ns) {
+    uint64_t q = ns >> 13;
+    size_t idx = q == 0 ? 0 : static_cast<size_t>(std::bit_width(q));
+    return idx < kHistBuckets ? idx : kHistBuckets - 1;
+}
+
+// Plain (non-atomic) copy: what snapshots, digests and the master's fleet
+// model carry. Buckets are per-bucket counts (NOT cumulative); renderers
+// accumulate for the Prometheus `le` form.
+struct HistSnapshot {
+    std::array<uint64_t, kHistBuckets> buckets{};
+    uint64_t sum_ns = 0;
+    uint64_t count() const {
+        uint64_t c = 0;
+        for (auto b : buckets) c += b;
+        return c;
+    }
+    void merge(const HistSnapshot &o) {
+        for (size_t i = 0; i < kHistBuckets; ++i) buckets[i] += o.buckets[i];
+        sum_ns += o.sum_ns;
+    }
+    // bucket-resolution quantile (upper edge of the bucket holding the
+    // q-th sample): good to a factor of 2, which is what a log2 histogram
+    // promises — enough to tell an 8 ms stall tail from an 800 ms one
+    uint64_t quantile_ns(double q) const;
+    bool empty() const { return count() == 0; }
+};
+
+// sparse <-> dense bucket conversion for the wire form (proto::WireHist
+// carries (idx, count) pairs; out-of-grid indices are dropped on fold)
+std::vector<std::pair<uint8_t, uint64_t>> hist_sparse(const HistSnapshot &h);
+HistSnapshot hist_dense(uint64_t sum_ns,
+                        const std::vector<std::pair<uint8_t, uint64_t>> &b);
+
+class Hist {
+public:
+    void record(uint64_t ns) {
+        buckets_[hist_bucket(ns)].fetch_add(1, std::memory_order_relaxed);
+        sum_ns_.fetch_add(ns, std::memory_order_relaxed);
+    }
+    HistSnapshot snapshot() const {
+        HistSnapshot s;
+        for (size_t i = 0; i < kHistBuckets; ++i)
+            s.buckets[i] = buckets_[i].load(std::memory_order_relaxed);
+        s.sum_ns = sum_ns_.load(std::memory_order_relaxed);
+        return s;
+    }
+
+private:
+    std::atomic<uint64_t> buckets_[kHistBuckets] = {};
+    std::atomic<uint64_t> sum_ns_{0};
+};
+
+// Data-plane phases a duration can be attributed to. Comm-level phases
+// live on the Domain (one Hist each); the wire-facing pair (kStageWire,
+// kStall) additionally lives per edge, so a distribution can name the
+// hop, not just the peer.
+enum class Phase : uint8_t {
+    kOp = 0,        // whole collective (ring entry to ring exit)
+    kCommenceWait,  // init sent -> commence received (master consensus)
+    kOpSetup,       // commence -> ring links ready (snapshot + link waits)
+    kQuantize,      // quantize kernel time within the op
+    kDequantize,    // dequantize/accumulate kernel time within the op
+    kStageWire,     // one ring stage wall time (wire + overlap compute)
+    kStall,         // receiver wire-stall (op thread blocked on bytes)
+    kCount
+};
+constexpr size_t kPhaseCount = static_cast<size_t>(Phase::kCount);
+const char *phase_name(Phase p);
 
 // ---------------------------------------------------------------- counters
 
@@ -97,6 +199,12 @@ struct EdgeCounters {
     std::atomic<uint64_t> rx_relay_windows{0};
     std::atomic<uint64_t> dup_bytes{0};
     std::atomic<uint64_t> dup_windows{0};
+    // ---- critical-path attribution (docs/09) ----
+    // latency distributions for the two phases where the EDGE is the
+    // attribution key: per-ring-stage wall time on the inbound hop, and
+    // receiver wire-stall slices charged to it. Always-on log2 buckets.
+    Hist stage_wire_hist;
+    Hist stall_hist;
 };
 
 struct CommCounters {
@@ -131,6 +239,7 @@ struct EdgeSnapshot {
     uint64_t wd_suspects = 0, wd_confirms = 0, wd_reissues = 0, wd_relays = 0,
              rx_relay_bytes = 0, rx_relay_windows = 0, dup_bytes = 0,
              dup_windows = 0;
+    HistSnapshot stage_wire_hist, stall_hist;
 };
 
 // One completed collective's coarse timing, kept in a small per-Domain
@@ -159,6 +268,17 @@ public:
     std::vector<OpSample> recent_ops() const;
     uint64_t last_seq() const { return last_seq_.load(std::memory_order_relaxed); }
 
+    // comm-level phase latency distributions (critical-path attribution):
+    // one always-on log2 histogram per Phase. The edge-keyed pair
+    // (kStageWire/kStall) is ALSO recorded here so the comm-level view
+    // stays complete when edge resolution is unavailable.
+    void record_phase(Phase p, uint64_t ns) {
+        phase_hist_[static_cast<size_t>(p)].record(ns);
+    }
+    HistSnapshot phase_snapshot(Phase p) const {
+        return phase_hist_[static_cast<size_t>(p)].snapshot();
+    }
+
     static constexpr size_t kOpRing = 8;
 
 private:
@@ -171,6 +291,7 @@ private:
     OpSample ops_[kOpRing] PCCLT_GUARDED_BY(op_mu_);
     uint64_t op_head_ PCCLT_GUARDED_BY(op_mu_) = 0;
     std::atomic<uint64_t> last_seq_{0};
+    Hist phase_hist_[kPhaseCount];  // lock-free like the edge counters
 };
 
 // Shared fallback for conns constructed without a comm (socktest, tools).
@@ -185,7 +306,8 @@ struct Event {
     const char *name = "";       // static string
     const char *arg0 = nullptr;  // optional arg names (static/interned)
     const char *arg1 = nullptr;
-    uint64_t v0 = 0, v1 = 0;
+    const char *arg2 = nullptr;
+    uint64_t v0 = 0, v1 = 0, v2 = 0;
     const char *detail = nullptr;  // optional interned string arg
     // master epoch at push time (set_epoch — welcome/resume/journal
     // rehydrate). Stamped into every event so tools/trace_merge can
@@ -205,11 +327,13 @@ public:
     void span(const char *cat, const char *name, uint64_t t0_ns, uint64_t t1_ns,
               const char *arg0 = nullptr, uint64_t v0 = 0,
               const char *arg1 = nullptr, uint64_t v1 = 0,
-              const char *detail = nullptr);
+              const char *detail = nullptr,
+              const char *arg2 = nullptr, uint64_t v2 = 0);
     void instant(const char *cat, const char *name,
                  const char *arg0 = nullptr, uint64_t v0 = 0,
                  const char *arg1 = nullptr, uint64_t v1 = 0,
-                 const char *detail = nullptr);
+                 const char *detail = nullptr,
+                 const char *arg2 = nullptr, uint64_t v2 = 0);
 
     // time-ordered copy of the ring (newest kCap events survive)
     std::vector<Event> snapshot() const;
@@ -227,6 +351,9 @@ public:
         uint64_t p = pushed();
         return p > kCap ? p - kCap : 0;
     }
+    // ring capacity (events that survive a capture window) — surfaced on
+    // /metrics so a scraper can judge pushed/dropped against it
+    static constexpr size_t ring_capacity() { return kCap; }
 
     // Master epoch stamped into every subsequent event (client: welcome /
     // resume ack; master: journal rehydrate). Process-global like the
@@ -286,6 +413,10 @@ struct EdgeDigest {
                              //   edge tells the master to fire the
                              //   straggler re-opt without waiting for the
                              //   rate-based detector to notice
+    // cumulative latency distributions for the edge-keyed phases (the
+    // master re-exports these as Prometheus histogram series; cumulative,
+    // not interval, so a missed digest never loses samples)
+    HistSnapshot stage_wire_hist, stall_hist;
 };
 
 // (the master epoch is NOT part of the digest fold: the push loop stamps
@@ -294,9 +425,14 @@ struct Digest {
     uint64_t last_seq = 0;     // newest collective seq completed locally
     uint64_t interval_ns = 0;  // wall time folded into this digest
     uint64_t ring_dropped = 0; // flight-recorder events lost to wrap
+    uint64_t ring_pushed = 0;  // events pushed since the last clear
+    uint64_t ring_cap = 0;     // recorder ring capacity (saturation gauge)
     uint64_t collectives_ok = 0;
     std::vector<EdgeDigest> edges;
     std::vector<OpSample> ops; // last-N completed op timings (newest last)
+    // comm-level phase latency distributions, cumulative (indexed by
+    // telemetry::Phase; empty hists are skipped on the wire)
+    std::array<HistSnapshot, kPhaseCount> phases{};
 };
 
 // Folds a Domain's counters into interval rates. Owned and driven by ONE

@@ -54,13 +54,20 @@ MASTER_DISPATCH = {
     "kC2MTelemetryDigest": "on_telemetry_digest",
 }
 
-# kM2C ids the master machine can emit (master_state.cpp)
+# kM2C ids the master machine can emit (master_state.cpp).
+# kM2CIncidentDump is fire-and-forget and env-gated (PCCLT_INCIDENT_DIR):
+# it never participates in consensus — no vote, no reply, no state the
+# client FSM observes — so the model checker keeps it OUT of the explored
+# state space (like the data-plane watchdog, docs/11): MasterModel never
+# emits it and the client model never consumes it. Conformance still pins
+# the id to its emission site and the client's set_notify consumption.
 MASTER_EMITS = {
     "kM2CWelcome", "kM2CSessionResumeAck", "kM2CPeersPendingReply",
     "kM2CP2PConnInfo", "kM2CP2PEstablishedResp", "kM2CTopologyDeferred",
     "kM2CCollectiveCommence", "kM2CCollectiveAbort", "kM2CCollectiveDone",
     "kM2CSharedStateSyncResp", "kM2CSharedStateDone",
     "kM2COptimizeResponse", "kM2COptimizeComplete", "kM2CKicked",
+    "kM2CIncidentDump",
 }
 
 # kM2C ids the client session FSM consumes (client.cpp recv_match sites)

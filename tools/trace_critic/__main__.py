@@ -1,0 +1,58 @@
+"""CLI: ``python -m tools.trace_critic peer*.json [-o report.json]``.
+
+Walks per-peer PCCLT_TRACE dumps (or an incident bundle's ``peer-*.trace
+.json`` files), reconstructs each collective's critical path, prints the
+per-op attribution table, and optionally writes the full JSON report.
+Exit 2 when ``--min-coverage`` is given and the mean attribution coverage
+falls below it (the decomposition failed to explain the timeline — stage
+spans missing or traces from mismatched runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import analyze_files, format_report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.trace_critic",
+        description="attribute each collective's wall time to concrete "
+                    "(peer, stage, edge, phase) segments and name the "
+                    "binding chain")
+    ap.add_argument("traces", nargs="+", type=Path,
+                    help="per-peer Chrome trace JSON files (PCCLT_TRACE "
+                         "dumps or incident-bundle peer-*.trace.json)")
+    ap.add_argument("-o", "--out", type=Path, default=None,
+                    help="write the full JSON report here")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the printed per-op table")
+    ap.add_argument("--min-coverage", type=float, default=None,
+                    help="exit 2 when mean attribution coverage is below "
+                         "this fraction (e.g. 0.95)")
+    args = ap.parse_args()
+
+    report = analyze_files(args.traces)
+    print(format_report(report, top=args.top))
+    if args.out:
+        args.out.write_text(json.dumps(report, indent=1))
+        print(f"wrote {args.out}")
+    if not report["aggregate"]["ops"]:
+        print("error: no collectives found — were these traces captured "
+              "with the flight recorder on?", file=sys.stderr)
+        return 1
+    if (args.min_coverage is not None
+            and report["aggregate"]["mean_coverage"] < args.min_coverage):
+        print(f"error: attribution coverage "
+              f"{report['aggregate']['mean_coverage']:.1%} < "
+              f"{args.min_coverage:.1%}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
