@@ -241,6 +241,16 @@ def main() -> None:
                   file=sys.stderr)
             extra["degraded_recovery_s"] = None
             extra["relay_overhead_pct"] = None
+        # shared-state chunk plane (docs/04): N cold joiners over the
+        # content-addressed multi-source fetch vs the single-seeder
+        # baseline (acceptance gate >= 2x), conservation byte-exact
+        try:
+            for k, v in native_bench.run_sync_swarm_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: sync swarm failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["sync_swarm_speedup"] = None
 
     # On-chip model legs: the jitted bf16 train step on the real TPU —
     # tokens/s + MFU per family (skip-guarded when no TPU is attached;

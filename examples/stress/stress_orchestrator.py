@@ -102,6 +102,7 @@ class Peer:
                                      env=penv)
         self.stats: dict = {}  # newest STATS snapshot (chaos runs)
         self.steps = 0
+        self.wrong_sync = 0  # bit-wrong shared-state adoptions (gate: 0)
         self.resumes = 0  # total session resumes across this peer's comm lives
         self.rejoins = 0  # full re-registrations (fresh communicator)
         # RESUMED total=N is per-COMMUNICATOR and resets to 0 on a rejoin, so
@@ -116,6 +117,9 @@ class Peer:
         for line in self.proc.stdout:
             if line.startswith("STEP "):
                 self.steps += 1
+            elif line.startswith("WRONG SYNC"):
+                self.wrong_sync += 1
+                print(f"peer {self.idx}: {line.rstrip()}", flush=True)
             elif line.startswith("STATS "):
                 try:
                     import json
@@ -190,6 +194,24 @@ def main() -> int:
     ap.add_argument("--count", type=int, default=4096,
                     help="per-step all-reduce element count (chaos runs "
                          "want real payloads so windows exist to fail over)")
+    ap.add_argument("--sync-churn", type=int, default=0, metavar="ELEMS",
+                    help="churn-proof shared-state lane (docs/04): every "
+                         "peer syncs an ELEMS-float32 state per step over "
+                         "the content-addressed chunk plane; the schedule "
+                         "adds a JOINER FLOOD (half the peers SIGKILLed at "
+                         "once at 1/3 duration, relaunched as cold "
+                         "joiners) and a busiest-SEEDER kill at 2/3 "
+                         "duration (picked by ss_seeder_chunks_served). "
+                         "Exit prints a SYNC SUMMARY with gates: zero "
+                         "wrong-content adoptions, zero failed syncs on "
+                         "live peers.")
+    ap.add_argument("--sync-chunk-bytes", type=int, default=262144,
+                    help="PCCLT_SS_CHUNK_BYTES for --sync-churn peers")
+    ap.add_argument("--sync-mbps", type=float, default=250.0,
+                    help="per-process wildcard egress pacing for "
+                         "--sync-churn (models a per-NIC bottleneck so "
+                         "multi-source fetch genuinely multiplies "
+                         "bandwidth)")
     args = ap.parse_args()
 
     if args.metrics_port is not None:
@@ -220,6 +242,17 @@ def main() -> int:
         elif args.peers >= 2:
             chaos_args[1] = ["--inject-spec", args.chaos, "--inject-at", "10"]
 
+    # churn-sync lane env + per-peer args (docs/04)
+    sync_args: list = []
+    if args.sync_churn > 0:
+        sync_args = ["--sync-state", str(args.sync_churn)]
+        base_env = {"PCCLT_SS_CHUNK_BYTES": str(args.sync_chunk_bytes),
+                    "PCCLT_WIRE_MBPS_MAP": f"127.0.0.1={args.sync_mbps}"}
+        for i in range(args.peers):
+            chaos_env.setdefault(i, {}).update(base_env)
+        for i in range(args.peers):
+            chaos_args.setdefault(i, []).extend(sync_args)
+
     master = MasterProc(args.master_port, args.journal, args.metrics_port)
     peers: list[Peer] = []
     seed = 1
@@ -235,6 +268,22 @@ def main() -> int:
     chaos_acc = {"faults_armed": 0, "faults_activated": 0, "failovers": 0,
                  "relays": 0, "relay_forwarded": 0, "dup_bytes": 0,
                  "suspects": 0, "confirms": 0, "aborted": 0}
+    # churn-sync accounting (docs/04), folded the same way
+    sync_acc = {"chunks_fetched": 0, "chunks_resourced": 0, "chunks_dup": 0,
+                "promotions": 0, "seeder_deaths_survived": 0,
+                "legacy_syncs": 0, "syncs_ok": 0, "syncs_failed": 0}
+    sync_events = {"floods": 0, "seeder_kills": 0, "wrong": 0}
+
+    def fold_sync(stats: dict) -> None:
+        c = stats.get("counters", {}) if stats else {}
+        sync_acc["chunks_fetched"] += c.get("ss_chunks_fetched", 0)
+        sync_acc["chunks_resourced"] += c.get("ss_chunks_resourced", 0)
+        sync_acc["chunks_dup"] += c.get("ss_chunks_dup", 0)
+        sync_acc["promotions"] += c.get("ss_seeder_promotions", 0)
+        sync_acc["seeder_deaths_survived"] += c.get("ss_seeders_lost", 0)
+        sync_acc["legacy_syncs"] += c.get("ss_legacy_syncs", 0)
+        sync_acc["syncs_ok"] += c.get("syncs_ok", 0)
+        sync_acc["syncs_failed"] += c.get("syncs_failed", 0)
 
     def fold_chaos(stats: dict) -> None:
         if not stats:
@@ -260,6 +309,12 @@ def main() -> int:
         deadline = time.time() + args.duration
         last_progress = time.time()
         last_total = 0
+        # churn-sync schedule (docs/04): one joiner flood at 1/3 duration,
+        # one busiest-seeder kill at 2/3
+        flood_at = (time.time() + args.duration / 3
+                    if args.sync_churn > 0 else None)
+        seeder_kill_at = (time.time() + 2 * args.duration / 3
+                          if args.sync_churn > 0 else None)
         while time.time() < deadline:
             time.sleep(1.0)
             # monotone: a relaunched peer restarts at 0, so dead peers'
@@ -293,6 +348,31 @@ def main() -> int:
                 print(f"MASTER DIED unexpectedly (exit code "
                       f"{master.proc.returncode})", flush=True)
                 return 1
+            # churn-sync events: flood half the peers at once (they come
+            # back as simultaneous cold joiners), then kill the peer the
+            # STATS lines prove is the busiest seeder — mid-serve death,
+            # the exact failure the chunk plane exists to survive
+            if flood_at is not None and time.time() >= flood_at:
+                flood_at = None
+                victims = peers[1:1 + max(1, args.peers // 2)]
+                print(f"JOINER FLOOD: SIGKILLing {len(victims)} peers at "
+                      "once", flush=True)
+                sync_events["floods"] += 1
+                for p in victims:
+                    p.kill()
+            if seeder_kill_at is not None and time.time() >= seeder_kill_at:
+                seeder_kill_at = None
+
+                def served_of(p):
+                    return ((p.stats or {}).get("counters", {})
+                            .get("ss_seeder_chunks_served", 0))
+                busiest = max((p for p in peers if p.alive()),
+                              key=served_of, default=None)
+                if busiest is not None:
+                    print(f"SEEDER KILL: peer {busiest.idx} "
+                          f"(served={served_of(busiest)} chunks)", flush=True)
+                    sync_events["seeder_kills"] += 1
+                    busiest.kill()
             # relaunch the dead (the churn is the point)
             for i, p in enumerate(peers):
                 if not p.alive():
@@ -301,6 +381,8 @@ def main() -> int:
                     retired_resumes += p.resumes
                     retired_rejoins += p.rejoins
                     fold_chaos(p.stats)
+                    fold_sync(p.stats)
+                    sync_events["wrong"] += p.wrong_sync
                     print(f"peer {p.idx} died (steps={p.steps}); relaunching "
                           f"(#{total_relaunches})", flush=True)
                     peers[i] = Peer(args.master_port, p.idx, p.base_port,
@@ -380,6 +462,40 @@ def main() -> int:
                 # (watchdog -> failover/relay -> re-opt) limps home instead
                 print("CHAOS FAILED: scripted faults aborted collectives",
                       flush=True)
+                return 1
+        if args.sync_churn > 0:
+            live_failed = 0
+            for p in peers:
+                fold_sync(p.stats)
+                sync_events["wrong"] += p.wrong_sync
+                live_failed += ((p.stats or {}).get("counters", {})
+                                .get("syncs_failed", 0))
+            print(f"SYNC SUMMARY: "
+                  f"chunks_fetched={sync_acc['chunks_fetched']} "
+                  f"resourced={sync_acc['chunks_resourced']} "
+                  f"dup={sync_acc['chunks_dup']} "
+                  f"promotions={sync_acc['promotions']} "
+                  f"seeder_deaths_survived={sync_acc['seeder_deaths_survived']} "
+                  f"legacy_syncs={sync_acc['legacy_syncs']} "
+                  f"syncs_ok={sync_acc['syncs_ok']} "
+                  f"syncs_failed={sync_acc['syncs_failed']} "
+                  f"floods={sync_events['floods']} "
+                  f"seeder_kills={sync_events['seeder_kills']} "
+                  f"wrong={sync_events['wrong']} "
+                  f"aborted={live_failed}", flush=True)
+            if sync_events["wrong"] > 0:
+                print("SYNC FAILED: bit-wrong shared-state adoption",
+                      flush=True)
+                return 1
+            if live_failed > 0:
+                # the churn-proof claim: scheduled seeder death + joiner
+                # floods never FAIL a round for a surviving peer — the
+                # chunk plane re-sources around every loss
+                print("SYNC FAILED: unrecovered sync failures on live peers",
+                      flush=True)
+                return 1
+            if sync_events["floods"] == 0 or sync_events["seeder_kills"] == 0:
+                print("SYNC FAILED: churn schedule never fired", flush=True)
                 return 1
         print(f"SOAK PASSED: {total} heartbeat steps, "
               f"{total_relaunches} relaunches, "

@@ -149,6 +149,19 @@ class CommStats(ctypes.Structure):
         # newest trace_ring_capacity events
         ("trace_ring_pushed", ctypes.c_uint64),
         ("trace_ring_capacity", ctypes.c_uint64),
+        # shared-state chunk plane (docs/04); conservation identity:
+        # ss_chunk_bytes_fetched + ss_chunk_bytes_resourced -
+        # ss_chunk_bytes_dup == unique chunk bytes delivered
+        ("ss_chunks_fetched", ctypes.c_uint64),
+        ("ss_chunks_resourced", ctypes.c_uint64),
+        ("ss_chunks_dup", ctypes.c_uint64),
+        ("ss_chunk_bytes_fetched", ctypes.c_uint64),
+        ("ss_chunk_bytes_resourced", ctypes.c_uint64),
+        ("ss_chunk_bytes_dup", ctypes.c_uint64),
+        ("ss_seeder_chunks_served", ctypes.c_uint64),
+        ("ss_seeder_promotions", ctypes.c_uint64),
+        ("ss_seeders_lost", ctypes.c_uint64),
+        ("ss_legacy_syncs", ctypes.c_uint64),
     ]
 
 
@@ -174,6 +187,9 @@ class EdgeStats(ctypes.Structure):
         ("rx_relay_windows", ctypes.c_uint64),
         ("dup_bytes", ctypes.c_uint64),
         ("dup_windows", ctypes.c_uint64),
+        # shared-state chunk plane (docs/04): sync payload on this edge
+        ("tx_sync_bytes", ctypes.c_uint64),
+        ("rx_sync_bytes", ctypes.c_uint64),
     ]
 
 

@@ -752,6 +752,9 @@ class Communicator:
                     "rx_relay_windows": int(e.rx_relay_windows),
                     "dup_bytes": int(e.dup_bytes),
                     "dup_windows": int(e.dup_windows),
+                    # shared-state chunk plane (docs/04)
+                    "tx_sync_bytes": int(e.tx_sync_bytes),
+                    "rx_sync_bytes": int(e.rx_sync_bytes),
                 }
         return {"counters": counters, "edges": edges}
 

@@ -1648,3 +1648,89 @@ def run_diloco_outer_bench(world: int = 2, params_n: int = 100_000_000,
     med = sorted(r0["times"])[len(r0["times"]) // 2]
     phases = {k: round(v, 3) for k, v in (r0.get("phases") or {}).items()}
     return med, phases
+
+
+# ------------------------------------------------- shared-state chunk plane
+
+def _peer_sync_swarm(rank, master_port, q, world, seeders, keys, elems,
+                     chunk_bytes, mbps, port_base):
+    # env BEFORE any native object exists: the chunk size is read per sync,
+    # the wildcard pacing map per connection construction. The wildcard ip
+    # edge gives each PROCESS one egress bucket (a per-NIC stand-in), so a
+    # single distributor is a genuine bottleneck and N seeders genuinely
+    # multiply bandwidth — what the chunk plane exists to exploit.
+    os.environ["PCCLT_SS_CHUNK_BYTES"] = str(chunk_bytes)
+    os.environ["PCCLT_WIRE_MBPS_MAP"] = f"127.0.0.1={mbps}"
+    comm = _connect(rank, master_port, world, port_base)
+    rng = np.random.default_rng(424242)
+    role_seeder = rank < seeders
+    if role_seeder:
+        arrays = {f"k{i}": rng.standard_normal(elems).astype(np.float32)
+                  for i in range(keys)}
+        rev = 1
+    else:
+        arrays = {f"k{i}": np.zeros(elems, dtype=np.float32)
+                  for i in range(keys)}
+        rev = 0
+    from pccl_tpu.comm.api import SharedState, TensorInfo
+    st = SharedState([TensorInfo.from_numpy(k, v) for k, v in arrays.items()],
+                     revision=rev)
+    t0 = time.perf_counter()
+    info = comm.sync_shared_state(st)
+    wall = time.perf_counter() - t0
+    digest = float(sum(v.sum() for v in arrays.values()))
+    q.put({"rank": rank, "wall": wall, "rx": info.rx_bytes,
+           "digest": digest, "counters": comm.stats()["counters"]})
+    comm.destroy()
+
+
+def run_sync_swarm_bench(world: int = 8, seeders: int = 4, keys: int = 8,
+                         elems: int = 262144, chunk_bytes: int = 262144,
+                         mbps: float = 250.0,
+                         base: int = 34200) -> Dict[str, float]:
+    """Shared-state swarm scaling (ISSUE-13 acceptance, docs/04):
+    ``world - seeders`` simultaneous cold joiners adopt an
+    ``keys * elems * 4``-byte state, once over the content-addressed chunk
+    plane (multi-source fetch + mid-round seeder promotion) and once on
+    the forced single-seeder baseline (PCCLT_SS_CHUNK_BYTES=0). Keys:
+
+    * ``sync_swarm_chunked_s`` / ``sync_swarm_legacy_s`` — slowest
+      joiner's sync wall per leg;
+    * ``sync_swarm_speedup`` — legacy / chunked (gate: >= 2x);
+    * ``sync_swarm_resourced_chunks`` / ``_dup_chunks`` — failover noise.
+
+    Per-chunk conservation is asserted byte-exact on every joiner:
+    fetched + re-sourced - dup == unique state bytes.
+    """
+    nbytes = keys * elems * 4
+    out: Dict[str, float] = {}
+
+    def leg(chunk: int, port_env: str, dflt: int, leg_base: int):
+        res = _spawn_world(world, _peer_sync_swarm, _port(port_env, dflt),
+                           (world, seeders, keys, elems, chunk, mbps,
+                            leg_base),
+                           inline_rank0=False, timeout_s=420)
+        joiners = [r for r in res if r["rank"] >= seeders]
+        ref = next(r for r in res if r["rank"] == 0)["digest"]
+        for r in joiners:
+            assert r["digest"] == ref, "joiner diverged from popular content"
+            assert r["rx"] == nbytes, (r["rx"], nbytes)
+            c = r["counters"]
+            if chunk:
+                got = (c["ss_chunk_bytes_fetched"]
+                       + c["ss_chunk_bytes_resourced"]
+                       - c["ss_chunk_bytes_dup"])
+                assert got == nbytes, f"conservation broken: {got} != {nbytes}"
+        return (max(r["wall"] for r in joiners),
+                sum(r["counters"]["ss_chunks_resourced"] for r in joiners),
+                sum(r["counters"]["ss_chunks_dup"] for r in joiners))
+
+    chunked, resourced, dup = leg(chunk_bytes, "PCCLT_BENCH_MASTER_PORT_SS",
+                                  48691, base)
+    legacy, _, _ = leg(0, "PCCLT_BENCH_MASTER_PORT_SS2", 48693, base + 600)
+    out["sync_swarm_chunked_s"] = chunked
+    out["sync_swarm_legacy_s"] = legacy
+    out["sync_swarm_speedup"] = legacy / chunked if chunked > 0 else 0.0
+    out["sync_swarm_resourced_chunks"] = float(resourced)
+    out["sync_swarm_dup_chunks"] = float(dup)
+    return out

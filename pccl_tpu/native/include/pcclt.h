@@ -338,6 +338,19 @@ typedef struct pccltCommStats_t {
                                    * last clear (process-global) */
     uint64_t trace_ring_capacity; /* ring capacity: dropped > 0 means traces
                                    * hold only the newest this-many events */
+    /* shared-state chunk plane (docs/04). Conservation identity at sync
+     * completion: ss_chunk_bytes_fetched + ss_chunk_bytes_resourced -
+     * ss_chunk_bytes_dup == unique chunk bytes delivered. */
+    uint64_t ss_chunks_fetched;        /* first-assignment chunk arrivals */
+    uint64_t ss_chunks_resourced;      /* arrivals from re-sourced fetches */
+    uint64_t ss_chunks_dup;            /* arrivals for already-done chunks */
+    uint64_t ss_chunk_bytes_fetched;
+    uint64_t ss_chunk_bytes_resourced;
+    uint64_t ss_chunk_bytes_dup;
+    uint64_t ss_seeder_chunks_served;  /* chunks this peer served as seeder */
+    uint64_t ss_seeder_promotions;     /* keys this peer completed + seeded */
+    uint64_t ss_seeders_lost;          /* sources lost mid-fetch (survived) */
+    uint64_t ss_legacy_syncs;          /* syncs on the 1-seeder fallback */
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {
@@ -362,6 +375,11 @@ typedef struct pccltEdgeStats_t {
     uint64_t rx_relay_windows;
     uint64_t dup_bytes;        /* duplicate arrivals dropped by the dedupe */
     uint64_t dup_windows;
+    /* shared-state chunk plane (docs/04): sync payload served to (tx) /
+     * fetched from (rx) this edge, kept apart from the collective
+     * data-plane byte counters and their conservation invariant */
+    uint64_t tx_sync_bytes;
+    uint64_t rx_sync_bytes;
 } pccltEdgeStats_t;
 
 /* Snapshot this communicator's counters. */

@@ -414,6 +414,16 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     auto cs = pcclt::net::netem::chaos_stats();
     out->chaos_faults_armed = cs.armed;
     out->chaos_faults_activated = cs.activated;
+    out->ss_chunks_fetched = ld(m.ss_chunks_fetched);
+    out->ss_chunks_resourced = ld(m.ss_chunks_resourced);
+    out->ss_chunks_dup = ld(m.ss_chunks_dup);
+    out->ss_chunk_bytes_fetched = ld(m.ss_chunk_bytes_fetched);
+    out->ss_chunk_bytes_resourced = ld(m.ss_chunk_bytes_resourced);
+    out->ss_chunk_bytes_dup = ld(m.ss_chunk_bytes_dup);
+    out->ss_seeder_chunks_served = ld(m.ss_seeder_chunks_served);
+    out->ss_seeder_promotions = ld(m.ss_seeder_promotions);
+    out->ss_seeders_lost = ld(m.ss_seeders_lost);
+    out->ss_legacy_syncs = ld(m.ss_legacy_syncs);
     return pccltSuccess;
 }
 
@@ -443,6 +453,8 @@ pccltResult_t pccltCommGetEdgeStats(pccltComm_t *c, pccltEdgeStats_t *out,
         o.rx_relay_windows = e.rx_relay_windows;
         o.dup_bytes = e.dup_bytes;
         o.dup_windows = e.dup_windows;
+        o.tx_sync_bytes = e.tx_sync_bytes;
+        o.rx_sync_bytes = e.rx_sync_bytes;
     }
     return pccltSuccess;
 }

@@ -13,6 +13,7 @@
 #include "benchmark.hpp"
 #include "hash.hpp"
 #include "log.hpp"
+#include "netem.hpp"
 #include "reduce.hpp"
 
 namespace pcclt::client {
@@ -40,6 +41,20 @@ double env_double(const char *name, double dflt) {
         if (v > 0) return v;
     }
     return dflt;
+}
+
+// shared-state chunk size (docs/04). 0 disables the chunk plane (legacy
+// single-distributor transport + whole-entry hashes). Must agree
+// group-wide, like PCCLT_SS_HASH: the chunk-tree root of identical
+// content depends on it.
+uint64_t ss_chunk_bytes_env() {
+    if (const char *e = std::getenv("PCCLT_SS_CHUNK_BYTES")) {
+        long long v = atoll(e);
+        if (v <= 0) return 0;
+        return static_cast<uint64_t>(
+            std::clamp<long long>(v, 4096, 64ll << 20));
+    }
+    return 1ull << 20;
 }
 
 } // namespace
@@ -158,57 +173,222 @@ void Client::on_ss_accept(net::Socket sock) {
     spawn_service(std::move(sock), [this](net::Socket &sock,
                                           const std::shared_ptr<std::atomic<int>> &) {
         auto req = net::recv_frame(sock, 15'000);
-        if (!req || req->type != PacketType::kC2SStateRequest) return;
-        uint64_t revision;
-        std::vector<std::string> keys;
-        try {
-            wire::Reader r(req->payload);
-            revision = r.u64();
-            uint32_t n = r.u32();
-            for (uint32_t i = 0; i < n; ++i) keys.push_back(r.str());
-        } catch (...) { return; }
-
-        std::vector<SharedStateEntry> entries;
-        bool ok;
-        {
-            MutexLock lk(dist_mu_);
-            ok = dist_open_ && revision == dist_revision_;
-            if (ok)
-                for (const auto &k : keys) {
-                    auto it = dist_entries_.find(k);
-                    if (it == dist_entries_.end()) {
-                        ok = false;
-                        break;
-                    }
-                    entries.push_back(it->second);
-                }
+        if (!req) return;
+        if (req->type == PacketType::kC2SStateRequest) {
+            ss_serve_legacy(sock, *req);
+            return;
         }
-        wire::Writer w;
-        w.u8(ok ? 1 : 0);
-        w.u32(ok ? static_cast<uint32_t>(entries.size()) : 0);
-        for (const auto &e : entries) {
-            w.str(e.name);
-            w.u8(static_cast<uint8_t>(e.dtype));
-            w.u64(e.count);
-        }
-        Mutex mu;
-        if (!net::send_frame(sock, mu, PacketType::kS2CStateHeader, w.data())) return;
-        if (!ok) return;
-        for (const auto &e : entries) {
-            // lazily-staged accelerator entries materialize exactly once
-            // per window, before their first byte is served; concurrent
-            // fetchers block on the once-flag until the bytes are real
-            if (e.materialize && e.mat_once)
-                std::call_once(*e.mat_once, e.materialize, e.materialize_ctx);
-            size_t nbytes = e.count * proto::dtype_size(e.dtype);
-            // count BEFORE sending: the requester can complete its fetch and
-            // the whole dist-done handshake the instant the last byte lands,
-            // and the distributor reads this counter right after Done — a
-            // post-send increment could still be pending on this thread
-            dist_tx_bytes_.fetch_add(nbytes);
-            if (!sock.send_all(e.data, nbytes)) return;
+        // chunk plane (docs/04): persistent serve loop — one fetch worker
+        // issues many range requests over this socket; the conn dies on
+        // refusal, socket error, or 30 s idle
+        while (req && req->type == PacketType::kC2SChunkRequest) {
+            if (!ss_serve_chunk(sock, *req)) return;
+            req = net::recv_frame(sock, 30'000);
         }
     });
+}
+
+// resolve the netem edge + telemetry counters for a shared-state peer,
+// keyed by its CANONICAL endpoint (advertised ip + p2p port — the same
+// key the collective data plane, PCCLT_WIRE_*_MAP and PCCLT_WIRE_CHAOS_MAP
+// use; port 0 falls back to the shared-state port so un-upgraded peers
+// still resolve to something stable)
+static std::shared_ptr<net::netem::Edge> ss_edge_for(
+    const net::Addr &ip, uint16_t p2p_port, uint16_t fallback_port,
+    telemetry::Domain &dom, telemetry::EdgeCounters **ec,
+    std::string *key_out = nullptr) {
+    net::Addr canon = ip;
+    canon.port = p2p_port ? p2p_port : fallback_port;
+    std::string key = canon.str();
+    *ec = &dom.edge(key);
+    if (key_out) *key_out = key;
+    return net::netem::Registry::inst().resolve(canon);
+}
+
+bool Client::ss_serve_enter(uint64_t revision, const std::string &key) {
+    MutexLock lk(dist_mu_);
+    if (!dist_open_ || revision != dist_revision_ ||
+        !dist_servable_.count(key))
+        return false;
+    ++dist_serving_;
+    return true;
+}
+
+void Client::ss_serve_exit() {
+    MutexLock lk(dist_mu_);
+    if (--dist_serving_ == 0) dist_cv_.notify_all();
+}
+
+void Client::ss_close_window() {
+    MutexLock lk(dist_mu_);
+    dist_open_ = false;
+    // wait out in-flight serve slices: their SharedStateEntry copies
+    // point into the sync caller's buffers, which the app may free the
+    // moment sync_shared_state returns. Slices re-check the window, so
+    // this drains within one paced slice.
+    while (dist_serving_ > 0) dist_cv_.wait(dist_mu_);
+    dist_entries_.clear();
+    dist_servable_.clear();
+}
+
+void Client::ss_serve_legacy(net::Socket &sock, const net::Frame &req) {
+    uint64_t revision;
+    std::vector<std::string> keys;
+    uint16_t req_p2p = 0;
+    try {
+        wire::Reader r(req.payload);
+        revision = r.u64();
+        uint32_t n = r.u32();
+        for (uint32_t i = 0; i < n; ++i) keys.push_back(r.str());
+        // trailing: requester's advertised p2p port (its canonical
+        // data-plane endpoint) so wire emulation + telemetry key this
+        // serve by the same edge the collectives use
+        try {
+            req_p2p = r.u16();
+        } catch (...) {}
+    } catch (...) { return; }
+
+    std::vector<SharedStateEntry> entries;
+    bool ok;
+    {
+        MutexLock lk(dist_mu_);
+        ok = dist_open_ && revision == dist_revision_;
+        if (ok)
+            for (const auto &k : keys) {
+                auto it = dist_entries_.find(k);
+                if (it == dist_entries_.end() || !dist_servable_.count(k)) {
+                    ok = false;
+                    break;
+                }
+                entries.push_back(it->second);
+            }
+    }
+    wire::Writer w;
+    w.u8(ok ? 1 : 0);
+    w.u32(ok ? static_cast<uint32_t>(entries.size()) : 0);
+    for (const auto &e : entries) {
+        w.str(e.name);
+        w.u8(static_cast<uint8_t>(e.dtype));
+        w.u64(e.count);
+    }
+    Mutex mu;
+    if (!net::send_frame(sock, mu, PacketType::kS2CStateHeader, w.data())) return;
+    if (!ok) return;
+    telemetry::EdgeCounters *ec = nullptr;
+    auto edge = ss_edge_for(sock.peer_addr(), req_p2p,
+                            sock.peer_addr().port, *tele_, &ec);
+    for (const auto &e : entries) {
+        // lazily-staged accelerator entries materialize exactly once
+        // per window, before their first byte is served; concurrent
+        // fetchers block on the once-flag until the bytes are real.
+        // Materialize writes the app's buffer — serving-guarded too.
+        if (e.materialize && e.mat_once) {
+            if (!ss_serve_enter(revision, e.name)) return;
+            std::call_once(*e.mat_once, e.materialize, e.materialize_ctx);
+            ss_serve_exit();
+        }
+        size_t nbytes = e.count * proto::dtype_size(e.dtype);
+        // count BEFORE sending: the requester can complete its fetch and
+        // the whole dist-done handshake the instant the last byte lands,
+        // and the distributor reads this counter right after Done — a
+        // post-send increment could still be pending on this thread
+        dist_tx_bytes_.fetch_add(nbytes);
+        ec->tx_sync_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+        // pace in bounded slices so a chaos window (degrade/blackhole)
+        // lands mid-transfer instead of being charged up front — and so
+        // a window close (sync returning, app reclaiming its buffers)
+        // stops the serve at a slice boundary instead of racing it
+        const uint8_t *p = static_cast<const uint8_t *>(e.data);
+        size_t off = 0;
+        while (off < nbytes) {
+            size_t n = std::min<size_t>(nbytes - off, 1 << 20);
+            if (!ss_serve_enter(revision, e.name)) return;
+            if (edge && edge->pace_enabled()) edge->pace(n);
+            bool ok = sock.send_all(p + off, n);
+            ss_serve_exit();
+            if (!ok) return;
+            off += n;
+        }
+    }
+}
+
+bool Client::ss_serve_chunk(net::Socket &sock, const net::Frame &req) {
+    uint64_t revision, cb;
+    std::string key;
+    uint32_t first, count;
+    uint16_t req_p2p = 0;
+    try {
+        wire::Reader r(req.payload);
+        revision = r.u64();
+        key = r.str();
+        cb = r.u64();
+        first = r.u32();
+        count = r.u32();
+        try {
+            req_p2p = r.u16();
+        } catch (...) {}
+    } catch (...) { return false; }
+
+    // status: 0 = ok (payload follows), 1 = retry later (window/key not
+    // ready — the fetcher backs off without blacklisting us), 2 = refuse
+    // (unknown key / bad range — the fetcher re-sources elsewhere)
+    SharedStateEntry e;
+    int status = 0;
+    {
+        MutexLock lk(dist_mu_);
+        if (!dist_open_ || revision != dist_revision_) {
+            status = 1;
+        } else {
+            auto it = dist_entries_.find(key);
+            if (it == dist_entries_.end()) status = 2;
+            else if (!dist_servable_.count(key)) status = 1;
+            else e = it->second;
+        }
+    }
+    uint64_t nbytes = status == 0 ? e.count * proto::dtype_size(e.dtype) : 0;
+    if (status == 0) {
+        uint32_t nchunks = ssc::chunk_count(nbytes, cb);
+        if (cb == 0 || cb > (64ull << 20) || count == 0 || first >= nchunks ||
+            count > nchunks - first)
+            status = 2;
+    }
+    uint64_t payload = 0;
+    for (uint32_t i = 0; status == 0 && i < count; ++i)
+        payload += ssc::chunk_len(nbytes, cb, first + i);
+    wire::Writer w;
+    w.u8(static_cast<uint8_t>(status));
+    w.u64(payload);
+    Mutex mu;
+    if (!net::send_frame(sock, mu, PacketType::kS2CChunkHeader, w.data()))
+        return false;
+    if (status != 0) return status == 1;  // retry keeps the conn alive
+    if (e.materialize && e.mat_once) {
+        // materialize writes the app's buffer — serving-guarded
+        if (!ss_serve_enter(revision, key)) return false;
+        std::call_once(*e.mat_once, e.materialize, e.materialize_ctx);
+        ss_serve_exit();
+    }
+    telemetry::EdgeCounters *ec = nullptr;
+    auto edge = ss_edge_for(sock.peer_addr(), req_p2p,
+                            sock.peer_addr().port, *tele_, &ec);
+    const auto *base = static_cast<const uint8_t *>(e.data);
+    for (uint32_t i = 0; i < count; ++i) {
+        uint64_t len = ssc::chunk_len(nbytes, cb, first + i);
+        // per-chunk serving guard: the entry bytes belong to the sync
+        // caller; once the window closes (sync returning) this serve
+        // must stop touching them — ss_close_window waits us out
+        if (!ss_serve_enter(revision, key)) return false;
+        if (edge && edge->pace_enabled()) edge->pace(len);
+        dist_tx_bytes_.fetch_add(len);
+        ec->tx_sync_bytes.fetch_add(len, std::memory_order_relaxed);
+        tele_->comm.ss_seeder_chunks_served.fetch_add(
+            1, std::memory_order_relaxed);
+        bool ok =
+            sock.send_all(base + static_cast<uint64_t>(first + i) * cb, len);
+        ss_serve_exit();
+        if (!ok) return false;
+    }
+    return true;
 }
 
 void Client::on_bench_accept(net::Socket sock) {
@@ -1726,31 +1906,38 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
         return session_gen_.load(std::memory_order_acquire) != gen0;
     };
 
-    // open the distribution window (we may be elected distributor)
+    // open the distribution window (we may be elected distributor; in
+    // chunk mode every peer with popular content is a seeder)
     {
         MutexLock lk(dist_mu_);
         dist_open_ = true;
         dist_revision_ = revision;
         dist_entries_.clear();
+        dist_servable_.clear();
         for (const auto &e : entries) {
             auto &d = dist_entries_[e.name] = e;
+            dist_servable_.insert(e.name);
             if (d.materialize)   // fresh once-flag per sync window
                 d.mat_once = std::make_shared<std::once_flag>();
         }
         dist_tx_bytes_ = 0;
     }
-    auto close_window = [this] {
-        MutexLock lk(dist_mu_);
-        dist_open_ = false;
-        dist_entries_.clear();
-    };
+    // closing waits out in-flight serve slices: the entries borrow the
+    // caller's buffers, which may be freed the moment we return
+    auto close_window = [this] { ss_close_window(); };
+    // leftover seeder-promotion broadcasts from an earlier round would
+    // otherwise rot in the control queue forever (fire-and-forget, no
+    // recv_match ever waits for them outside a fetch)
+    while (master_.recv_match(PacketType::kM2CSeederUpdate, nullptr, 0, true)) {}
 
     // hoisted: one env read per sync, so request-time and verify-time hashes
     // always use the same algorithm even if the env changes mid-sync
     const hash::Type hash_type = hash::type_from_env();
+    const uint64_t chunk_bytes = ss_chunk_bytes_env();
     proto::SharedStateSyncC2M req;
     req.revision = revision;
     req.strategy = strategy;
+    req.chunk_bytes = chunk_bytes;
     for (const auto &e : entries) {
         proto::SharedStateEntryMeta m;
         m.name = e.name;
@@ -1761,12 +1948,24 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
         // accelerator digested its resident bytes and shipped 8 bytes to
         // host, so a clean sync never stages the array (the type must
         // match PCCLT_SS_HASH group-wide — kSimpleTpu is the one a TPU
-        // can compute, ops/hashing.py:jax_simplehash_device)
-        m.hash = e.allow_content_inequality ? 0
-                 : e.has_precomputed_hash   ? e.precomputed_hash
-                                            : hash::content_hash(
-                                       hash_type, e.data,
-                                       e.count * proto::dtype_size(e.dtype));
+        // can compute, ops/hashing.py:jax_simplehash_device). Such
+        // entries carry no chunk leaves; if dirty they ride the legacy
+        // transport. Host entries under the chunk plane offer the chunk
+        // hash tree: per-chunk leaves + the root as the entry hash (the
+        // leaves subsume the old whole-entry digest, docs/04).
+        if (e.allow_content_inequality) {
+            m.hash = 0;
+        } else if (e.has_precomputed_hash) {
+            m.hash = e.precomputed_hash;
+        } else if (chunk_bytes) {
+            m.chunk_leaves = ssc::leaf_hashes(
+                hash_type, e.data, e.count * proto::dtype_size(e.dtype),
+                chunk_bytes);
+            m.hash = ssc::root_hash(hash_type, m.chunk_leaves);
+        } else {
+            m.hash = hash::content_hash(hash_type, e.data,
+                                        e.count * proto::dtype_size(e.dtype));
+        }
         req.entries.push_back(std::move(m));
     }
     if (!master_.send(PacketType::kC2MSharedStateSync, req.encode()) ||
@@ -1810,79 +2009,57 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
 
     uint64_t rx_bytes = 0;
     Status st = Status::kOk;
+    // ---- transport choice (docs/04): content-addressed multi-source
+    // chunk fetch when the master brokered a chunk map and it pays off;
+    // the legacy single-distributor stream for tiny states, world=2,
+    // leafless (device-hash) keys, or an un-upgraded master ----
+    const bool have_map = resp->has_chunk_map && resp->chunk_bytes > 0;
+    bool any_leaves = false;
+    uint64_t total_dirty = 0;
     if (resp->outdated) {
-        // update the distribution window so we don't serve stale content
-        {
-            MutexLock lk(dist_mu_);
-            dist_open_ = false;
+        for (size_t k = 0; k < resp->outdated_keys.size(); ++k) {
+            for (const auto &e : entries)
+                if (e.name == resp->outdated_keys[k])
+                    total_dirty += e.count * proto::dtype_size(e.dtype);
+            if (have_map && k < resp->key_leaves.size() &&
+                !resp->key_leaves[k].empty())
+                any_leaves = true;
         }
-        net::Socket sock;
-        net::Addr da = resp->dist_ip;
-        da.port = resp->dist_port;
-        if (!sock.connect(da, 10'000)) {
-            st = Status::kConnectionLost;
-        } else {
-            wire::Writer w;
-            w.u64(resp->revision);
-            w.u32(static_cast<uint32_t>(resp->outdated_keys.size()));
-            for (const auto &k : resp->outdated_keys) w.str(k);
-            Mutex mu;
-            if (!net::send_frame(sock, mu, PacketType::kC2SStateRequest, w.data())) {
-                st = Status::kConnectionLost;
+    }
+    const bool use_chunks = resp->outdated && have_map && any_leaves &&
+                            group_world() > 2 &&
+                            total_dirty > resp->chunk_bytes;
+    {
+        // from here the window serves the CANONICAL revision: clean keys
+        // hold popular bytes regardless of the revision we offered
+        // (drag-along seeding). Dirty keys leave the servable set until
+        // their last chunk verifies; the legacy path still closes the
+        // window wholesale (old single-seeder semantics).
+        MutexLock lk(dist_mu_);
+        if (dist_open_) {
+            dist_revision_ = resp->revision;
+            if (resp->outdated && !use_chunks) {
+                dist_open_ = false;
             } else {
-                auto hdr = net::recv_frame(sock, 30'000);
-                if (!hdr || hdr->type != PacketType::kS2CStateHeader) {
-                    st = Status::kConnectionLost;
-                } else {
-                    try {
-                        wire::Reader r(hdr->payload);
-                        bool ok = r.u8() != 0;
-                        uint32_t n = r.u32();
-                        if (!ok) {
-                            st = Status::kAborted;
-                        } else {
-                            for (uint32_t i = 0; i < n && st == Status::kOk; ++i) {
-                                std::string name = r.str();
-                                auto dt = static_cast<proto::DType>(r.u8());
-                                uint64_t cnt = r.u64();
-                                const SharedStateEntry *target = nullptr;
-                                for (const auto &e : entries)
-                                    if (e.name == name) target = &e;
-                                if (!target || target->dtype != dt || target->count != cnt) {
-                                    st = Status::kContentMismatch;
-                                    break;
-                                }
-                                size_t nbytes = cnt * proto::dtype_size(dt);
-                                if (!sock.recv_all(target->data, nbytes)) {
-                                    st = Status::kConnectionLost;
-                                    break;
-                                }
-                                rx_bytes += nbytes;
-                                // the host buffer now holds authoritative
-                                // content; the caller must push it back to
-                                // the device (TPU entries)
-                                if (target->updated) *target->updated = 1;
-                                // verify against the mask's expected hash
-                                for (size_t k = 0; k < resp->outdated_keys.size(); ++k) {
-                                    if (resp->outdated_keys[k] != name) continue;
-                                    uint64_t h = hash::content_hash(
-                                        hash_type, target->data, nbytes);
-                                    if (h != resp->expected_hashes[k]) {
-                                        st = Status::kContentMismatch;
-                                        tele_->comm.sync_hash_mismatches
-                                            .fetch_add(1,
-                                                       std::memory_order_relaxed);
-                                        telemetry::Recorder::inst().instant(
-                                            "membership", "sync_hash_mismatch",
-                                            "revision", resp->revision, nullptr,
-                                            0, telemetry::intern(name));
-                                    }
-                                }
-                            }
-                        }
-                    } catch (...) { st = Status::kInternal; }
-                }
+                for (const auto &k : resp->outdated_keys)
+                    dist_servable_.erase(k);
             }
+        }
+    }
+    if (resp->outdated) {
+        if (use_chunks) {
+            std::vector<std::string> legacy_keys;
+            for (size_t k = 0; k < resp->outdated_keys.size(); ++k)
+                if (k >= resp->key_leaves.size() || resp->key_leaves[k].empty())
+                    legacy_keys.push_back(resp->outdated_keys[k]);
+            st = ss_fetch_chunked(*resp, entries, hash_type, gen0, &rx_bytes);
+            if (st == Status::kOk && !legacy_keys.empty())
+                st = ss_fetch_legacy(*resp, legacy_keys, entries, hash_type,
+                                     &rx_bytes);
+        } else {
+            tele_->comm.ss_legacy_syncs.fetch_add(1, std::memory_order_relaxed);
+            st = ss_fetch_legacy(*resp, resp->outdated_keys, entries,
+                                 hash_type, &rx_bytes);
         }
     }
 
@@ -1916,6 +2093,475 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
         info->revision = done_rev;
     }
     return st;
+}
+
+Status Client::ss_fetch_legacy(const proto::SharedStateSyncResp &resp,
+                               const std::vector<std::string> &keys,
+                               const std::vector<SharedStateEntry> &entries,
+                               hash::Type ht, uint64_t *rx_bytes) {
+    if (keys.empty()) return Status::kOk;
+    // expected hash (+ chunk leaves when the mask hashed with the chunk
+    // tree — the verify must recompute with the SAME scheme) by key name
+    std::map<std::string, std::pair<uint64_t, const std::vector<uint64_t> *>>
+        expect;
+    for (size_t k = 0; k < resp.outdated_keys.size(); ++k) {
+        const std::vector<uint64_t> *lv = nullptr;
+        if (resp.has_chunk_map && resp.chunk_bytes && k < resp.key_leaves.size() &&
+            !resp.key_leaves[k].empty())
+            lv = &resp.key_leaves[k];
+        if (k < resp.expected_hashes.size())
+            expect[resp.outdated_keys[k]] = {resp.expected_hashes[k], lv};
+    }
+    net::Socket sock;
+    net::Addr da = resp.dist_ip;
+    da.port = resp.dist_port;
+    if (!sock.connect(da, 10'000)) return Status::kConnectionLost;
+    wire::Writer w;
+    w.u64(resp.revision);
+    w.u32(static_cast<uint32_t>(keys.size()));
+    for (const auto &k : keys) w.str(k);
+    // trailing: our canonical data-plane port, so the distributor's wire
+    // emulation + telemetry key this transfer by the same edge as the
+    // collectives (netem satellite, docs/04)
+    w.u16(p2p_listener_.port());
+    Mutex mu;
+    if (!net::send_frame(sock, mu, PacketType::kC2SStateRequest, w.data()))
+        return Status::kConnectionLost;
+    auto hdr = net::recv_frame(sock, 30'000);
+    if (!hdr || hdr->type != PacketType::kS2CStateHeader)
+        return Status::kConnectionLost;
+    telemetry::EdgeCounters *ec = nullptr;
+    auto edge = ss_edge_for(resp.dist_ip, resp.dist_p2p_port, resp.dist_port,
+                            *tele_, &ec);
+    Status st = Status::kOk;
+    try {
+        wire::Reader r(hdr->payload);
+        bool ok = r.u8() != 0;
+        uint32_t n = r.u32();
+        if (!ok) return Status::kAborted;
+        for (uint32_t i = 0; i < n && st == Status::kOk; ++i) {
+            std::string name = r.str();
+            auto dt = static_cast<proto::DType>(r.u8());
+            uint64_t cnt = r.u64();
+            const SharedStateEntry *target = nullptr;
+            for (const auto &e : entries)
+                if (e.name == name) target = &e;
+            if (!target || target->dtype != dt || target->count != cnt) {
+                st = Status::kContentMismatch;
+                break;
+            }
+            size_t nbytes = cnt * proto::dtype_size(dt);
+            // netem ingress on the distributor's canonical edge: delivery
+            // delay incl. any scripted chaos outage
+            if (edge && edge->delay_enabled())
+                std::this_thread::sleep_for(
+                    std::chrono::nanoseconds(edge->delivery_delay_ns()));
+            // the bulk read is bounded now (the data-phase twin of the
+            // 30 s header deadline): a blackholed distributor fails the
+            // round with kConnectionLost instead of wedging it until the
+            // kernel TCP timeout. Sliced so a slow-but-moving paced wire
+            // never trips it — only true no-progress windows do.
+            auto t0 = telemetry::now_ns();
+            auto *p = static_cast<uint8_t *>(target->data);
+            size_t off = 0;
+            bool lost = false;
+            while (off < nbytes) {
+                size_t slice = std::min<size_t>(nbytes - off, 1 << 20);
+                if (!sock.recv_all_deadline(p + off, slice, 30'000)) {
+                    lost = true;
+                    break;
+                }
+                off += slice;
+            }
+            if (lost) {
+                st = Status::kConnectionLost;
+                break;
+            }
+            tele_->record_phase(telemetry::Phase::kSyncFetch,
+                                telemetry::now_ns() - t0);
+            *rx_bytes += nbytes;
+            ec->rx_sync_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+            // the host buffer now holds authoritative content; the caller
+            // must push it back to the device (TPU entries)
+            if (target->updated) *target->updated = 1;
+            // verify against the mask's expected hash, with the mask's
+            // hashing scheme: the brokered leaves' chunk grid when
+            // present; otherwise reconstruct it — with the chunk plane
+            // on, HOST entries were offered as chunk-tree roots even if
+            // the response carried no map (un-upgraded master, torn
+            // tail), so a plain whole-entry digest would hard-fail every
+            // adoption. Group-wide env agreement makes our own
+            // chunk_bytes the mask's. Device-hash entries (precomputed,
+            // leafless) verify with the whole-entry digest as before.
+            auto it = expect.find(name);
+            if (it != expect.end()) {
+                auto v0 = telemetry::now_ns();
+                const uint64_t own_cb = ss_chunk_bytes_env();
+                uint64_t h;
+                if (it->second.second)
+                    h = ssc::root_hash(ht, ssc::leaf_hashes(
+                                               ht, target->data, nbytes,
+                                               resp.chunk_bytes));
+                else if (own_cb && !target->has_precomputed_hash)
+                    h = ssc::root_hash(ht, ssc::leaf_hashes(
+                                               ht, target->data, nbytes,
+                                               own_cb));
+                else
+                    h = hash::content_hash(ht, target->data, nbytes);
+                tele_->record_phase(telemetry::Phase::kSyncVerify,
+                                    telemetry::now_ns() - v0);
+                if (h != it->second.first) {
+                    st = Status::kContentMismatch;
+                    tele_->comm.sync_hash_mismatches.fetch_add(
+                        1, std::memory_order_relaxed);
+                    telemetry::Recorder::inst().instant(
+                        "membership", "sync_hash_mismatch", "revision",
+                        resp.revision, nullptr, 0, telemetry::intern(name));
+                }
+            }
+        }
+    } catch (...) { return Status::kInternal; }
+    return st;
+}
+
+Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
+                                const std::vector<SharedStateEntry> &entries,
+                                hash::Type ht, uint64_t gen0,
+                                uint64_t *rx_bytes) {
+    auto t_fetch0 = telemetry::now_ns();
+    std::vector<ssc::KeySpec> specs;
+    std::vector<size_t> resp_idx;  // spec index -> outdated_keys index
+    std::vector<const SharedStateEntry *> targets;
+    for (size_t k = 0; k < resp.outdated_keys.size(); ++k) {
+        if (k >= resp.key_leaves.size() || resp.key_leaves[k].empty()) continue;
+        const auto &name = resp.outdated_keys[k];
+        const SharedStateEntry *t = nullptr;
+        for (const auto &e : entries)
+            if (e.name == name) t = &e;
+        if (!t) return Status::kContentMismatch;
+        uint64_t nbytes = t->count * proto::dtype_size(t->dtype);
+        const auto &lv = resp.key_leaves[k];
+        // the brokered map must cohere: leaf count matches the entry's
+        // chunk grid and the leaves fold to the expected root — otherwise
+        // a torn map would verify chunk-by-chunk into a whole-entry
+        // mismatch at the end of an expensive fetch
+        if (lv.size() != ssc::chunk_count(nbytes, resp.chunk_bytes) ||
+            (k < resp.expected_hashes.size() &&
+             ssc::root_hash(ht, lv) != resp.expected_hashes[k])) {
+            tele_->comm.sync_hash_mismatches.fetch_add(
+                1, std::memory_order_relaxed);
+            return Status::kContentMismatch;
+        }
+        ssc::KeySpec ks;
+        ks.name = name;
+        ks.nbytes = nbytes;
+        ks.dst = static_cast<uint8_t *>(t->data);
+        ks.leaves = lv;
+        specs.push_back(std::move(ks));
+        resp_idx.push_back(k);
+        targets.push_back(t);
+    }
+    if (specs.empty()) return Status::kOk;
+
+    uint64_t rot = 0;
+    for (uint8_t b : uuid_) rot = rot * 131 + b;
+    auto plan = std::make_shared<ssc::FetchPlan>(
+        std::move(specs), resp.chunk_bytes,
+        env_double("PCCLT_SS_FETCH_FACTOR", 4.0),
+        static_cast<uint64_t>(std::max(1, env_int("PCCLT_SS_FETCH_MIN_MS", 500))) *
+            1'000'000ull,
+        static_cast<uint32_t>(std::max(1, env_int("PCCLT_SS_FETCH_RANGE", 8))),
+        rot);
+
+    std::vector<std::thread> workers;
+    // per-worker live-fd handles (the spawn_service pattern): once the
+    // plan finishes, shut the fds down so a worker parked in a blocking
+    // recv exits NOW, not at its recv budget — only the dispatcher
+    // thread mutates this vector
+    std::vector<std::shared_ptr<std::atomic<int>>> worker_fds;
+    std::map<std::string, uint32_t> started;  // endpoint -> seeder index
+    auto spawn_for = [&](const proto::SeederRec &rec) -> int {
+        if (rec.uuid == uuid_) return -1;  // self-seeding is a no-op
+        net::Addr canon = rec.ip;
+        canon.port = rec.p2p_port ? rec.p2p_port : rec.ss_port;
+        std::string key = canon.str();
+        uint32_t sidx = plan->add_seeder(key);
+        if (!started.count(key)) {
+            started[key] = sidx;
+            auto fd_h = std::make_shared<std::atomic<int>>(-1);
+            worker_fds.push_back(fd_h);
+            workers.emplace_back(
+                [this, plan, sidx, rec, rev = resp.revision, ht, fd_h] {
+                    ss_fetch_worker(plan, sidx, rec, rev, ht, fd_h);
+                });
+        }
+        return static_cast<int>(sidx);
+    };
+    for (uint32_t ki = 0; ki < plan->key_count(); ++ki) {
+        size_t k = resp_idx[ki];
+        if (k >= resp.key_seeders.size()) continue;
+        for (uint32_t si : resp.key_seeders[k]) {
+            int sidx = spawn_for(resp.seeders[si]);
+            if (sidx >= 0) plan->add_key_seeder(ki, static_cast<uint32_t>(sidx));
+        }
+    }
+    plan->check_liveness();  // a key with no viable source fails out now
+
+    auto key_index_of = [&](const std::string &name) -> int {
+        for (uint32_t ki = 0; ki < plan->key_count(); ++ki)
+            if (plan->key_spec(ki).name == name) return static_cast<int>(ki);
+        return -1;
+    };
+    auto session_flipped = [&] {
+        return session_gen_.load(std::memory_order_acquire) != gen0;
+    };
+    auto drain_completions = [&] {
+        for (uint32_t ki : plan->take_completed_keys()) {
+            const auto &name = plan->key_spec(ki).name;
+            {
+                // mid-round seeder promotion: our bytes for this key are
+                // canonical now — serve them for the rest of the round
+                MutexLock lk(dist_mu_);
+                if (dist_open_) dist_servable_.insert(name);
+            }
+            if (targets[ki]->updated) *targets[ki]->updated = 1;
+            proto::SyncKeyDoneC2M kd;
+            kd.revision = resp.revision;
+            kd.key = name;
+            // best-effort fire-and-forget: a dead master fails the sync
+            // at the dist-done handshake, not mid-fetch
+            master_.send(PacketType::kC2MSyncKeyDone, kd.encode());
+            tele_->comm.ss_seeder_promotions.fetch_add(
+                1, std::memory_order_relaxed);
+            telemetry::Recorder::inst().instant(
+                "membership", "sync_key_seeding", "revision", resp.revision,
+                nullptr, 0, telemetry::intern(name));
+        }
+    };
+
+    while (!plan->finished()) {
+        plan->wait_event(50);
+        plan->expire_overdue(telemetry::now_ns());
+        plan->check_liveness();
+        drain_completions();
+        // fold other peers' mid-round promotions into the source set
+        while (auto fr = master_.recv_match(PacketType::kM2CSeederUpdate,
+                                            nullptr, 0, true)) {
+            auto up = proto::SeederUpdateM2C::decode(fr->payload);
+            if (!up || up->revision != resp.revision) continue;
+            int ki = key_index_of(up->key);
+            if (ki < 0) continue;
+            int sidx = spawn_for(up->seeder);
+            if (sidx >= 0)
+                plan->add_key_seeder(static_cast<uint32_t>(ki),
+                                     static_cast<uint32_t>(sidx));
+        }
+        if (session_flipped()) plan->abort();
+    }
+    // unblock stragglers: a worker mid-recv on a dead/blackholed edge
+    // would otherwise hold the join (and thus the group's dist-done
+    // barrier) for its whole recv budget. The plan is finished by now,
+    // so any worker dialing PAST this sweep sees finished() right after
+    // its connect returns and closes itself.
+    for (auto &h : worker_fds) {
+        int fd = h->load(std::memory_order_acquire);
+        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto &t : workers)
+        if (t.joinable()) t.join();
+    drain_completions();
+
+    auto ps = plan->stats();
+    auto &c = tele_->comm;
+    auto add = [](std::atomic<uint64_t> &a, uint64_t v) {
+        if (v) a.fetch_add(v, std::memory_order_relaxed);
+    };
+    add(c.ss_chunks_fetched, ps.chunks_fetched);
+    add(c.ss_chunks_resourced, ps.chunks_resourced);
+    add(c.ss_chunks_dup, ps.chunks_dup);
+    add(c.ss_chunk_bytes_fetched, ps.bytes_fetched);
+    add(c.ss_chunk_bytes_resourced, ps.bytes_resourced);
+    add(c.ss_chunk_bytes_dup, ps.bytes_dup);
+    *rx_bytes += ps.unique_bytes;
+    telemetry::Recorder::inst().span(
+        "membership", "sync_fetch", t_fetch0, telemetry::now_ns(), "bytes",
+        ps.unique_bytes, "resourced", ps.chunks_resourced);
+    if (plan->complete_ok()) return Status::kOk;
+    if (session_flipped()) return Status::kConnectionLost;
+    return plan->saw_hash_mismatch() ? Status::kContentMismatch
+                                     : Status::kConnectionLost;
+}
+
+void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
+                             uint32_t sidx, proto::SeederRec rec,
+                             uint64_t revision, hash::Type ht,
+                             const std::shared_ptr<std::atomic<int>> &fd_h) {
+    telemetry::EdgeCounters *ec = nullptr;
+    std::string canon_key;
+    auto edge = ss_edge_for(rec.ip, rec.p2p_port, rec.ss_port, *tele_, &ec,
+                            &canon_key);
+    net::Addr ss_addr = rec.ip;
+    ss_addr.port = rec.ss_port;
+    net::Socket sock;
+    bool connected = false;
+    int fails = 0;     // consecutive transport failures against this seeder
+    int refusals = 0;  // consecutive status-1 "window not ready" answers
+    std::vector<uint8_t> scratch;
+    const uint16_t my_p2p = p2p_listener_.port();
+    auto retire = [&] {
+        plan->seeder_gone(sidx);
+        tele_->comm.ss_seeders_lost.fetch_add(1, std::memory_order_relaxed);
+        telemetry::Recorder::inst().instant(
+            "membership", "sync_seeder_lost", "revision", revision, nullptr, 0,
+            telemetry::intern(canon_key));
+    };
+    while (!plan->finished() && plan->seeder_alive(sidx)) {
+        auto take = plan->take(sidx, telemetry::now_ns());
+        if (!take) {
+            plan->wait_event(25);
+            continue;
+        }
+        const auto &ks = plan->key_spec(take->key);
+        const uint64_t cb = plan->chunk_bytes();
+        auto fail_range = [&](uint32_t from, bool hash_bad = false) {
+            for (uint32_t i = from; i < take->count; ++i)
+                plan->failed(take->key, take->first + i, sidx,
+                             hash_bad && i == from);
+        };
+        if (!connected) {
+            if (plan->finished()) break;
+            fd_h->store(-1, std::memory_order_release);  // before the close
+            sock = net::Socket();
+            if (!sock.connect(ss_addr, 3'000)) {
+                fail_range(0);
+                retire();
+                break;
+            }
+            // a dial can complete AFTER the dispatcher's shutdown sweep
+            // (the sweep saw -1): finished() is already true by then, so
+            // this re-check closes the race before any blocking recv
+            if (plan->finished()) break;
+            sock.set_bufsizes(4 << 20);
+            fd_h->store(sock.fd(), std::memory_order_release);
+            connected = true;
+        }
+        wire::Writer w;
+        w.u64(revision);
+        w.str(ks.name);
+        w.u64(cb);
+        w.u32(take->first);
+        w.u32(take->count);
+        w.u16(my_p2p);
+        Mutex mu;
+        bool sent = net::send_frame(sock, mu, PacketType::kC2SChunkRequest,
+                                    w.data());
+        std::optional<net::Frame> hdr;
+        if (sent) {
+            int ms = static_cast<int>(std::min<uint64_t>(
+                plan->chunk_budget_ns() / 1'000'000 + 1'000, 60'000));
+            hdr = net::recv_frame(sock, ms);
+        }
+        if (!sent || !hdr || hdr->type != PacketType::kS2CChunkHeader) {
+            fail_range(0);
+            connected = false;
+            if (++fails >= 2) {
+                retire();
+                break;
+            }
+            continue;
+        }
+        uint8_t status = 2;
+        try {
+            wire::Reader r(hdr->payload);
+            status = r.u8();
+            (void)r.u64();  // payload length (implied by the chunk grid)
+        } catch (...) {}
+        if (status == 1) {
+            // serve window not ready (peer still processing its response
+            // / key not yet complete there): back off, don't blacklist —
+            // but BOUNDED. A window that closed for good (the seeder's
+            // own sync errored out while its process lives) would
+            // otherwise requeue/backoff forever with nothing ever
+            // marking the seeder tried, and the plan could neither fail
+            // out nor finish. ~20 refusals ≈ 2 s of backoff is far past
+            // any response-processing race; after that the refusal is a
+            // real failure and the normal retire ladder applies.
+            if (++refusals >= 20) {
+                fail_range(0);
+                retire();
+                break;
+            }
+            for (uint32_t i = 0; i < take->count; ++i)
+                plan->requeue(take->key, take->first + i, sidx);
+            plan->seeder_backoff(sidx, telemetry::now_ns() + 100'000'000ull);
+            continue;
+        }
+        if (status != 0) {
+            fail_range(0);
+            if (++fails >= 2) {
+                retire();
+                break;
+            }
+            continue;
+        }
+        for (uint32_t i = 0; i < take->count; ++i) {
+            uint32_t idx = take->first + i;
+            uint64_t len = ssc::chunk_len(ks.nbytes, cb, idx);
+            scratch.resize(len);
+            // netem ingress on the seeder's canonical edge: delivery
+            // delay incl. scripted chaos — a blackholed sync edge parks
+            // HERE while the dispatcher's deadline re-sources the chunk
+            // from a different seeder (per-chunk failover, docs/04).
+            // Sliced so a finished plan reclaims this worker promptly
+            // even mid-outage.
+            if (edge && edge->delay_enabled()) {
+                uint64_t d = edge->delivery_delay_ns();
+                while (d > 0 && !plan->finished()) {
+                    uint64_t slice = std::min<uint64_t>(d, 100'000'000ull);
+                    std::this_thread::sleep_for(
+                        std::chrono::nanoseconds(slice));
+                    d -= slice;
+                }
+            }
+            uint64_t t0 = telemetry::now_ns();
+            int budget_ms = static_cast<int>(std::min<uint64_t>(
+                plan->chunk_budget_ns() / 1'000'000 + 100, 60'000));
+            if (!sock.recv_all_deadline(scratch.data(), len, budget_ms)) {
+                fail_range(i);
+                connected = false;
+                if (++fails >= 2) retire();
+                break;
+            }
+            uint64_t t1 = telemetry::now_ns();
+            tele_->record_phase(telemetry::Phase::kSyncFetch, t1 - t0);
+            uint64_t h = hash::content_hash(ht, scratch.data(), len);
+            tele_->record_phase(telemetry::Phase::kSyncVerify,
+                                telemetry::now_ns() - t1);
+            if (h != ks.leaves[idx]) {
+                // content-addressing is the defense: a corrupt source
+                // costs one re-source, never a poisoned buffer
+                tele_->comm.sync_hash_mismatches.fetch_add(
+                    1, std::memory_order_relaxed);
+                telemetry::Recorder::inst().instant(
+                    "membership", "sync_chunk_mismatch", "revision", revision,
+                    "chunk", idx, telemetry::intern(ks.name));
+                fail_range(i, /*hash_bad=*/true);
+                connected = false;  // stream alignment is suspect too
+                break;
+            }
+            ec->rx_sync_bytes.fetch_add(len, std::memory_order_relaxed);
+            if (uint8_t *dst = plan->claim(take->key, idx)) {
+                memcpy(dst, scratch.data(), len);
+                plan->published(take->key, idx, sidx, take->gens[i],
+                                telemetry::now_ns());
+            } else {
+                plan->duplicate(take->key, idx, sidx, take->gens[i]);
+            }
+            fails = 0;
+            refusals = 0;
+        }
+        if (!connected && !plan->seeder_alive(sidx)) break;
+    }
 }
 
 // ---------------- attributes ----------------

@@ -21,9 +21,11 @@
 #include <vector>
 
 #include "annotations.hpp"
+#include "hash.hpp"
 #include "pool.hpp"
 #include "protocol.hpp"
 #include "sockets.hpp"
+#include "ss_chunk.hpp"
 #include "telemetry.hpp"
 
 namespace pcclt::client {
@@ -206,6 +208,50 @@ private:
     void on_ss_accept(net::Socket sock);
     void on_bench_accept(net::Socket sock);
 
+    // ---- shared-state chunk plane (docs/04) ----
+    // Serving guard: every slice a serve thread spends reading an
+    // entry's app-owned bytes sits between enter (window still open at
+    // `revision`, `key` still servable, count bumped) and exit;
+    // ss_close_window flips the window shut and WAITS the count out, so
+    // the sync call can only return — and the app only free its buffers
+    // — once no serve thread is mid-read.
+    bool ss_serve_enter(uint64_t revision, const std::string &key);
+    void ss_serve_exit();
+    void ss_close_window();
+    // Serve one legacy whole-entry request (kC2SStateRequest) on a
+    // service thread; netem-paced + sync-byte metered.
+    void ss_serve_legacy(net::Socket &sock, const net::Frame &req);
+    // Serve one chunk-range request (kC2SChunkRequest). Returns true to
+    // keep the persistent serve connection alive.
+    bool ss_serve_chunk(net::Socket &sock, const net::Frame &req);
+    // Multi-source fetch of the chunk-mapped outdated keys: a FetchPlan
+    // dispatched across one worker (socket) per seeder, per-chunk
+    // verify/deadline/re-source, mid-round seeder promotion. gen0 is the
+    // session generation the sync started under.
+    Status ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
+                            const std::vector<SharedStateEntry> &entries,
+                            hash::Type ht, uint64_t gen0, uint64_t *rx_bytes);
+    // One fetch worker: a persistent socket to one seeder draining plan
+    // assignments (dial -> request range -> verify each chunk). `fd_h`
+    // publishes the worker's live socket fd (-1 while none, the
+    // spawn_service pattern) so the dispatcher can shut a straggler's
+    // recv down the moment the plan finishes — one stuck worker must not
+    // stall the group's dist-done barrier for its whole recv budget.
+    // The worker re-checks plan->finished() after every dial, closing
+    // the shutdown-vs-fresh-dial race.
+    void ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
+                         uint32_t sidx, proto::SeederRec rec,
+                         uint64_t revision, hash::Type ht,
+                         const std::shared_ptr<std::atomic<int>> &fd_h);
+    // Legacy single-distributor fetch of `keys` (the pre-chunk-plane
+    // transport, kept for tiny states / world=2 / leafless device
+    // entries), now with a 30 s-class no-progress deadline and netem
+    // routing on the distributor edge.
+    Status ss_fetch_legacy(const proto::SharedStateSyncResp &resp,
+                           const std::vector<std::string> &keys,
+                           const std::vector<SharedStateEntry> &entries,
+                           hash::Type ht, uint64_t *rx_bytes);
+
     net::Link tx_link(const proto::Uuid &peer);
     // waits until at least one inbound conn from `peer` is up
     net::Link rx_link(const proto::Uuid &peer, int timeout_ms);
@@ -315,12 +361,26 @@ private:
     std::vector<uint8_t> take_scratch();
     void give_scratch(std::vector<uint8_t> v);
 
-    // shared-state distribution window (serve only while a sync is active)
+    // shared-state distribution window (serve only while a sync is active).
+    // Chunk plane: dist_servable_ names the keys whose bytes are currently
+    // canonical — clean keys from the response on, dirty keys once their
+    // last chunk verified (mid-round seeder promotion). The window stays
+    // OPEN on an outdated peer in chunk mode; the legacy path still
+    // closes it wholesale.
     Mutex dist_mu_; // lock-rank: 24
     bool dist_open_ PCCLT_GUARDED_BY(dist_mu_) = false;
     uint64_t dist_revision_ PCCLT_GUARDED_BY(dist_mu_) = 0;
     std::map<std::string, SharedStateEntry> dist_entries_
         PCCLT_GUARDED_BY(dist_mu_);
+    std::set<std::string> dist_servable_ PCCLT_GUARDED_BY(dist_mu_);
+    // serve threads read entry bytes the APP owns only inside a
+    // serving-guard slice (dist_serving_ held > 0); closing the window
+    // waits the count out, so sync_shared_state never returns — and the
+    // caller never frees its buffers — while a paced serve is mid-read.
+    // Serves re-check the window between slices, bounding the wait to
+    // one paced slice.
+    int dist_serving_ PCCLT_GUARDED_BY(dist_mu_) = 0;
+    CondVar dist_cv_;
     std::atomic<uint64_t> dist_tx_bytes_{0};
 
     // Per-connection service threads (p2p handshakes, shared-state serving,

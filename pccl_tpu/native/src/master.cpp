@@ -282,6 +282,11 @@ void Master::dispatcher_loop() {
                 case PacketType::kC2MSharedStateDistDone:
                     out = state_.on_dist_done(ev.conn_id);
                     break;
+                case PacketType::kC2MSyncKeyDone: {
+                    auto d = proto::SyncKeyDoneC2M::decode(p);
+                    if (d) out = state_.on_sync_key_done(ev.conn_id, *d);
+                    break;
+                }
                 case PacketType::kC2MOptimizeTopology:
                     out = state_.on_optimize(ev.conn_id);
                     break;

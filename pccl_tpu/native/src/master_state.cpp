@@ -863,6 +863,43 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
             return; // disconnect event re-runs this check
         }
     }
+    // ---- chunk map (docs/04): seeder directory + per-key leaf hashes ----
+    // A key's seeders are ALL members whose offered hash matches the mask
+    // for that key — revision-lagging drag-along peers with identical
+    // content included (matching hash == matching bytes). The directory is
+    // shared by every response; fetchers drop themselves by uuid.
+    const uint64_t chunk_bytes = distributor->sync_req->chunk_bytes;
+    std::set<size_t> dirty_idx;  // mask-entry indices dirty for ANYONE
+    for (size_t k = 0; k < members.size(); ++k)
+        for (size_t i = 0; i < mask_entries.size(); ++i)
+            if (!mask_entries[i].allow_content_inequality &&
+                members[k]->sync_req->entries[i].hash != mask_entries[i].hash)
+                dirty_idx.insert(i);
+    std::vector<proto::SeederRec> seeders;
+    std::map<Uuid, uint32_t> seeder_by_uuid;
+    std::map<std::string, std::vector<uint32_t>> seeders_of_key;
+    std::map<std::string, const proto::SharedStateEntryMeta *> mask_by_name;
+    if (chunk_bytes) {
+        for (size_t i : dirty_idx) {
+            const auto &me = mask_entries[i];
+            mask_by_name[me.name] = &me;
+            for (auto *m : members) {
+                if (m->sync_req->entries[i].hash != me.hash) continue;
+                auto it = seeder_by_uuid.find(m->uuid);
+                uint32_t idx;
+                if (it == seeder_by_uuid.end()) {
+                    idx = static_cast<uint32_t>(seeders.size());
+                    seeders.push_back({m->uuid, m->ip, m->ss_port, m->p2p_port});
+                    seeder_by_uuid[m->uuid] = idx;
+                } else {
+                    idx = it->second;
+                }
+                seeders_of_key[me.name].push_back(idx);
+            }
+        }
+    }
+    g.sync_chunked_keys.clear();
+    g.sync_promoted.clear();
     for (size_t k = 0; k < members.size(); ++k) {
         auto *m = members[k];
         proto::SharedStateSyncResp resp;
@@ -872,10 +909,47 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
         resp.revision = expected;
         resp.outdated_keys = dirty_per[k];
         resp.expected_hashes = hashes_per[k];
+        if (chunk_bytes) {
+            resp.has_chunk_map = 1;
+            resp.chunk_bytes = chunk_bytes;
+            resp.dist_p2p_port = distributor->p2p_port;
+            resp.seeders = seeders;
+            for (const auto &name : dirty_per[k]) {
+                const auto *me = mask_by_name.at(name);
+                resp.key_leaves.push_back(me->chunk_leaves);
+                resp.key_seeders.push_back(seeders_of_key[name]);
+                if (!me->chunk_leaves.empty()) g.sync_chunked_keys.insert(name);
+            }
+        }
         out.push_back({m->conn_id, PacketType::kM2CSharedStateSyncResp, resp.encode()});
     }
     g.sync_in_flight = true;
     g.sync_revision = expected;
+}
+
+std::vector<Outbox> MasterState::on_sync_key_done(uint64_t conn,
+                                                  const proto::SyncKeyDoneC2M &d) {
+    std::vector<Outbox> out;
+    auto *c = by_conn(conn);
+    if (!c || !c->accepted) return out;
+    auto &g = groups_[c->peer_group];
+    // stale or bogus reports (previous round, unknown key, duplicate) are
+    // silently ignored — the packet is fire-and-forget by design
+    if (!g.sync_in_flight || d.revision != g.sync_revision) return out;
+    if (!g.sync_chunked_keys.count(d.key)) return out;
+    if (!g.sync_promoted.insert({c->uuid, d.key}).second) return out;
+    proto::SeederUpdateM2C up;
+    up.revision = d.revision;
+    up.key = d.key;
+    up.seeder = {c->uuid, c->ip, c->ss_port, c->p2p_port};
+    auto payload = up.encode();
+    for (auto *m : group_members(c->peer_group))
+        if (m->conn_id != conn && m->sync_req)
+            out.push_back({m->conn_id, PacketType::kM2CSeederUpdate, payload});
+    telemetry::Recorder::inst().instant(
+        "membership", "master_seeder_promoted", "group", c->peer_group,
+        "revision", d.revision, telemetry::intern(d.key));
+    return out;
 }
 
 std::vector<Outbox> MasterState::on_dist_done(uint64_t conn) {
@@ -897,6 +971,8 @@ std::vector<Outbox> MasterState::on_dist_done(uint64_t conn) {
     g.last_revision = g.sync_revision;
     g.revision_initialized = true;
     g.sync_in_flight = false;
+    g.sync_chunked_keys.clear();
+    g.sync_promoted.clear();
     if (journal_) journal_->record_group(c->peer_group, g.last_revision, true);
     PLOG(kDebug) << "shared-state sync complete, group " << c->peer_group << " revision "
                  << g.last_revision;

@@ -132,6 +132,11 @@ struct GroupState {
     uint64_t last_revision = 0;                 // last completed sync revision
     bool sync_in_flight = false;                // responses sent, awaiting dist-done
     uint64_t sync_revision = 0;                 // canonical revision of current round
+    // chunk plane (docs/04): keys the in-flight round distributes as
+    // chunk maps, and (uuid, key) promotions already broadcast — a
+    // re-sent kC2MSyncKeyDone must not re-broadcast
+    std::set<std::string> sync_chunked_keys;
+    std::set<std::pair<Uuid, std::string>> sync_promoted;
     std::map<uint64_t, CollectiveOp> ops;       // by tag
     std::vector<Uuid> ring;                     // current ring order
 };
@@ -167,6 +172,11 @@ public:
     std::vector<Outbox> on_shared_state_sync(uint64_t conn,
                                              const proto::SharedStateSyncC2M &req);
     std::vector<Outbox> on_dist_done(uint64_t conn);
+    // chunk plane: an outdated peer completed (verified) one key mid-round
+    // — promote it to seeder and broadcast kM2CSeederUpdate to the group.
+    // Fire-and-forget: never answered, invalid/duplicate reports ignored.
+    std::vector<Outbox> on_sync_key_done(uint64_t conn,
+                                         const proto::SyncKeyDoneC2M &d);
     std::vector<Outbox> on_optimize(uint64_t conn);
     std::vector<Outbox> on_bandwidth_report(uint64_t conn, const Uuid &to, double mbps);
     std::vector<Outbox> on_optimize_work_done(uint64_t conn);

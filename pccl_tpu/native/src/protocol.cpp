@@ -234,6 +234,15 @@ std::vector<uint8_t> SharedStateSyncC2M::encode() const {
         w.u8(e.allow_content_inequality);
         w.u64(e.hash);
     }
+    // trailing chunk-plane section (older peers stop reading above):
+    // chunk size + one leaf list per entry, same order
+    if (chunk_bytes) {
+        w.u64(chunk_bytes);
+        for (const auto &e : entries) {
+            w.u32(static_cast<uint32_t>(e.chunk_leaves.size()));
+            for (uint64_t h : e.chunk_leaves) w.u64(h);
+        }
+    }
     return w.take();
 }
 
@@ -253,6 +262,27 @@ std::optional<SharedStateSyncC2M> SharedStateSyncC2M::decode(const std::vector<u
             e.hash = r.u64();
             s.entries.push_back(std::move(e));
         }
+        if (!r.done()) {
+            // chunk-plane tail: all-or-nothing — a torn tail degrades to
+            // the legacy (unchunked) interpretation instead of failing
+            // the whole request
+            try {
+                uint64_t cb = r.u64();
+                std::vector<std::vector<uint64_t>> leaves(s.entries.size());
+                for (uint32_t i = 0; i < n; ++i) {
+                    uint32_t nl = r.u32();
+                    if (nl > (64u << 20) / 8) throw std::runtime_error("leaves");
+                    leaves[i].reserve(nl);
+                    for (uint32_t j = 0; j < nl; ++j) leaves[i].push_back(r.u64());
+                }
+                s.chunk_bytes = cb;
+                for (uint32_t i = 0; i < n; ++i)
+                    s.entries[i].chunk_leaves = std::move(leaves[i]);
+            } catch (...) {
+                s.chunk_bytes = 0;
+                for (auto &e : s.entries) e.chunk_leaves.clear();
+            }
+        }
         return s;
     } catch (...) { return std::nullopt; }
 }
@@ -270,6 +300,31 @@ std::vector<uint8_t> SharedStateSyncResp::encode() const {
     for (const auto &k : outdated_keys) w.str(k);
     w.u32(static_cast<uint32_t>(expected_hashes.size()));
     for (auto h : expected_hashes) w.u64(h);
+    // trailing chunk map (docs/04): seeder directory + per-outdated-key
+    // leaf hashes and seeder indices. Older clients stop reading above
+    // and use the legacy single-distributor fields.
+    if (has_chunk_map) {
+        w.u8(1);
+        w.u64(chunk_bytes);
+        w.u16(dist_p2p_port);
+        w.u32(static_cast<uint32_t>(seeders.size()));
+        for (const auto &sd : seeders) {
+            put_uuid(w, sd.uuid);
+            put_addr(w, sd.ip);
+            w.u16(sd.ss_port);
+            w.u16(sd.p2p_port);
+        }
+        for (size_t i = 0; i < outdated_keys.size(); ++i) {
+            const auto &lv = i < key_leaves.size() ? key_leaves[i]
+                                                   : std::vector<uint64_t>{};
+            const auto &ks = i < key_seeders.size() ? key_seeders[i]
+                                                    : std::vector<uint32_t>{};
+            w.u32(static_cast<uint32_t>(lv.size()));
+            for (uint64_t h : lv) w.u64(h);
+            w.u32(static_cast<uint32_t>(ks.size()));
+            for (uint32_t s : ks) w.u32(s);
+        }
+    }
     return w.take();
 }
 
@@ -286,6 +341,95 @@ std::optional<SharedStateSyncResp> SharedStateSyncResp::decode(const std::vector
         for (uint32_t i = 0; i < n; ++i) s.outdated_keys.push_back(r.str());
         uint32_t m = r.u32();
         for (uint32_t i = 0; i < m; ++i) s.expected_hashes.push_back(r.u64());
+        if (!r.done()) {
+            // chunk-map tail, all-or-nothing like the C2M tail
+            try {
+                SharedStateSyncResp t = s;
+                t.has_chunk_map = r.u8();
+                t.chunk_bytes = r.u64();
+                t.dist_p2p_port = r.u16();
+                uint32_t ns = r.u32();
+                if (ns > 65536) throw std::runtime_error("seeders");
+                for (uint32_t i = 0; i < ns; ++i) {
+                    SeederRec sd;
+                    sd.uuid = get_uuid(r);
+                    sd.ip = get_addr(r);
+                    sd.ss_port = r.u16();
+                    sd.p2p_port = r.u16();
+                    t.seeders.push_back(sd);
+                }
+                for (uint32_t i = 0; i < n; ++i) {
+                    uint32_t nl = r.u32();
+                    if (nl > (64u << 20) / 8) throw std::runtime_error("leaves");
+                    std::vector<uint64_t> lv;
+                    lv.reserve(nl);
+                    for (uint32_t j = 0; j < nl; ++j) lv.push_back(r.u64());
+                    uint32_t nk = r.u32();
+                    if (nk > ns) throw std::runtime_error("key seeders");
+                    std::vector<uint32_t> ks;
+                    ks.reserve(nk);
+                    for (uint32_t j = 0; j < nk; ++j) {
+                        uint32_t idx = r.u32();
+                        // index-bounds-validated: a bad index must not
+                        // become an out-of-range seeder dereference
+                        if (idx >= ns) throw std::runtime_error("seeder idx");
+                        ks.push_back(idx);
+                    }
+                    t.key_leaves.push_back(std::move(lv));
+                    t.key_seeders.push_back(std::move(ks));
+                }
+                if (t.has_chunk_map) s = std::move(t);
+            } catch (...) {
+                s.has_chunk_map = 0;
+                s.seeders.clear();
+                s.key_leaves.clear();
+                s.key_seeders.clear();
+            }
+        }
+        return s;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- SyncKeyDoneC2M / SeederUpdateM2C (chunk plane, docs/04) ---
+
+std::vector<uint8_t> SyncKeyDoneC2M::encode() const {
+    wire::Writer w;
+    w.u64(revision);
+    w.str(key);
+    return w.take();
+}
+
+std::optional<SyncKeyDoneC2M> SyncKeyDoneC2M::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        SyncKeyDoneC2M s;
+        s.revision = r.u64();
+        s.key = r.str();
+        return s;
+    } catch (...) { return std::nullopt; }
+}
+
+std::vector<uint8_t> SeederUpdateM2C::encode() const {
+    wire::Writer w;
+    w.u64(revision);
+    w.str(key);
+    put_uuid(w, seeder.uuid);
+    put_addr(w, seeder.ip);
+    w.u16(seeder.ss_port);
+    w.u16(seeder.p2p_port);
+    return w.take();
+}
+
+std::optional<SeederUpdateM2C> SeederUpdateM2C::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        SeederUpdateM2C s;
+        s.revision = r.u64();
+        s.key = r.str();
+        s.seeder.uuid = get_uuid(r);
+        s.seeder.ip = get_addr(r);
+        s.seeder.ss_port = r.u16();
+        s.seeder.p2p_port = r.u16();
         return s;
     } catch (...) { return std::nullopt; }
 }

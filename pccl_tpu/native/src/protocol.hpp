@@ -53,6 +53,12 @@ enum PacketType : uint16_t {
     // fleet health model (/metrics, /health, straggler detection). Never
     // answered — a slow master must not back-pressure the data plane.
     kC2MTelemetryDigest = 0x100D,
+    // chunk plane (docs/04): an outdated peer finished verifying every
+    // chunk of one key mid-round — the master promotes it to a seeder for
+    // that key and broadcasts kM2CSeederUpdate, so late fetchers scale
+    // ~O(1/peers) instead of hammering the original seeders. Never
+    // answered (the fetch engine must not block on the control plane).
+    kC2MSyncKeyDone = 0x100E,
 
     // master -> client
     kM2CWelcome = 0x2001,
@@ -82,6 +88,10 @@ enum PacketType : uint16_t {
     // shared incident id; never answered and rate-limited master-side so
     // a flapping edge cannot spam disk.
     kM2CIncidentDump = 0x200F,
+    // chunk plane: a peer was promoted to seeder for (revision, key)
+    // mid-round. Fire-and-forget broadcast to the syncing group; fetch
+    // engines fold the new source in, idle receivers drain and drop it.
+    kM2CSeederUpdate = 0x2010,
 
     // p2p handshake
     kP2PHello = 0x3001,
@@ -90,6 +100,13 @@ enum PacketType : uint16_t {
     // shared-state distribution
     kC2SStateRequest = 0x4001,
     kS2CStateHeader = 0x4002,
+    // chunk plane (docs/04): request a contiguous chunk range of one key
+    // at one revision from a seeder's serve window; the seeder answers
+    // kS2CChunkHeader{status, payload_bytes} followed by the raw chunk
+    // bytes. Connections are persistent — a fetch worker issues many
+    // requests over one socket.
+    kC2SChunkRequest = 0x4003,
+    kS2CChunkHeader = 0x4004,
 
     // bandwidth benchmark handshake
     kBenchHello = 0x5001,
@@ -191,15 +208,37 @@ struct SharedStateEntryMeta {
     DType dtype = DType::kF32;
     uint64_t count = 0;
     uint8_t allow_content_inequality = 0;
+    // chunk plane ON (request carries chunk_bytes > 0): the root of the
+    // entry's chunk hash tree (ssc::root_hash over chunk_leaves) — the
+    // leaves subsume the old whole-entry digest. Device-precomputed
+    // entries keep their on-device whole-array digest and ship no leaves
+    // (their dirty keys take the legacy transport).
     uint64_t hash = 0;
+    // per-chunk content hashes; empty = unchunked (trailing on the wire,
+    // absent from older clients)
+    std::vector<uint64_t> chunk_leaves;
 };
 
 struct SharedStateSyncC2M {
     uint64_t revision = 0;
     SyncStrategy strategy = SyncStrategy::kEnforcePopular;
     std::vector<SharedStateEntryMeta> entries;
+    // chunk size the leaves were computed with; 0 = chunk plane off.
+    // Must agree group-wide (like PCCLT_SS_HASH): the root hash of
+    // identical content depends on it. Trailing on the wire.
+    uint64_t chunk_bytes = 0;
     std::vector<uint8_t> encode() const;
     static std::optional<SharedStateSyncC2M> decode(const std::vector<uint8_t> &);
+};
+
+// One peer that already holds the popular revision of some key: where to
+// fetch chunks from (ss_port) and the canonical data-plane endpoint the
+// wire emulation / telemetry key the edge by (ip + p2p_port).
+struct SeederRec {
+    Uuid uuid{};
+    net::Addr ip{};
+    uint16_t ss_port = 0;
+    uint16_t p2p_port = 0;
 };
 
 struct SharedStateSyncResp {
@@ -210,8 +249,37 @@ struct SharedStateSyncResp {
     uint64_t revision = 0;
     std::vector<std::string> outdated_keys;
     std::vector<uint64_t> expected_hashes; // parallel to outdated_keys
+    // ---- chunk map (trailing; absent from an older master = legacy) ----
+    // has_chunk_map gates the whole section. key_leaves / key_seeders are
+    // parallel to outdated_keys; key_seeders holds indices into seeders.
+    // A key with no leaves (device-hash entry) falls back to the legacy
+    // single-distributor transport; its expected hash still verifies.
+    uint8_t has_chunk_map = 0;
+    uint64_t chunk_bytes = 0;
+    uint16_t dist_p2p_port = 0; // legacy path's netem/telemetry edge key
+    std::vector<SeederRec> seeders;
+    std::vector<std::vector<uint64_t>> key_leaves;
+    std::vector<std::vector<uint32_t>> key_seeders;
     std::vector<uint8_t> encode() const;
     static std::optional<SharedStateSyncResp> decode(const std::vector<uint8_t> &);
+};
+
+// kC2MSyncKeyDone: fetcher completed (verified) every chunk of `key` at
+// `revision` and can serve it for the rest of the round.
+struct SyncKeyDoneC2M {
+    uint64_t revision = 0;
+    std::string key;
+    std::vector<uint8_t> encode() const;
+    static std::optional<SyncKeyDoneC2M> decode(const std::vector<uint8_t> &);
+};
+
+// kM2CSeederUpdate: mid-round seeder promotion broadcast.
+struct SeederUpdateM2C {
+    uint64_t revision = 0;
+    std::string key;
+    SeederRec seeder;
+    std::vector<uint8_t> encode() const;
+    static std::optional<SeederUpdateM2C> decode(const std::vector<uint8_t> &);
 };
 
 // Telemetry digest (fleet observability plane). Compact by construction:

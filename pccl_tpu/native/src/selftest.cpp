@@ -22,6 +22,7 @@
 #include "kernels.hpp"
 #include "master.hpp"
 #include "quantize.hpp"
+#include "ss_chunk.hpp"
 #include "telemetry.hpp"
 #include "wire.hpp"
 
@@ -666,6 +667,272 @@ static void test_hash() {
             for (int off = 0; off < 3; ++off)
                 CHECK(hash::crc32(buf.data() + off, n, 0x12345678u) ==
                       ref_crc(buf.data() + off, n, 0x12345678u));
+    }
+}
+
+// shared-state chunk plane (docs/04): hash tree + multi-source fetch plan
+static void test_ss_chunk() {
+    using namespace ssc;
+    // ---- hash tree: boundaries, odd sizes, leaf/root round trip ----
+    CHECK(chunk_count(0, 1024) == 0);
+    CHECK(chunk_count(1, 1024) == 1);
+    CHECK(chunk_count(1024, 1024) == 1);
+    CHECK(chunk_count(1025, 1024) == 2);
+    CHECK(chunk_count(4096, 1024) == 4);
+    CHECK(chunk_len(1025, 1024, 0) == 1024);
+    CHECK(chunk_len(1025, 1024, 1) == 1);
+    CHECK(chunk_len(1024, 1024, 0) == 1024);
+    CHECK(chunk_len(1024, 1024, 1) == 0);
+
+    std::mt19937_64 rng{42};
+    std::vector<uint8_t> buf(10 * 1024 + 37);  // odd tail chunk
+    for (auto &b : buf) b = static_cast<uint8_t>(rng());
+    auto hv = hash::Type::kSimple;
+    auto leaves = leaf_hashes(hv, buf.data(), buf.size(), 1024);
+    CHECK(leaves.size() == 11);
+    // each leaf is the content hash of its slice
+    CHECK(leaves[0] == hash::content_hash(hv, buf.data(), 1024));
+    CHECK(leaves[10] == hash::content_hash(hv, buf.data() + 10 * 1024, 37));
+    uint64_t root = root_hash(hv, leaves);
+    CHECK(root != 0);
+    // flipping one byte in the LAST (partial) chunk changes exactly that
+    // leaf, and the root
+    buf.back() ^= 1;
+    auto leaves2 = leaf_hashes(hv, buf.data(), buf.size(), 1024);
+    CHECK(leaves2[10] != leaves[10]);
+    for (size_t i = 0; i < 10; ++i) CHECK(leaves2[i] == leaves[i]);
+    CHECK(root_hash(hv, leaves2) != root);
+    // chunk size is part of the identity: same bytes, different grid,
+    // different root (why PCCLT_SS_CHUNK_BYTES must agree group-wide)
+    buf.back() ^= 1;
+    auto leaves3 = leaf_hashes(hv, buf.data(), buf.size(), 2048);
+    CHECK(root_hash(hv, leaves3) != root);
+    // single-chunk entry: root != leaf (the tree is never the identity)
+    auto lone = leaf_hashes(hv, buf.data(), 512, 1024);
+    CHECK(lone.size() == 1 && root_hash(hv, lone) != lone[0]);
+
+    // ---- fetch plan: assignment, dedupe, re-source, failover ----
+    auto mk_keys = [&](std::vector<uint8_t> &dst_a, std::vector<uint8_t> &dst_b) {
+        dst_a.assign(4096, 0);
+        dst_b.assign(2048 + 100, 0);
+        std::vector<KeySpec> ks(2);
+        ks[0] = {"a", 4096, dst_a.data(), std::vector<uint64_t>(4, 1)};
+        ks[1] = {"b", 2048 + 100, dst_b.data(), std::vector<uint64_t>(3, 2)};
+        return ks;
+    };
+    {
+        // two seeders drain disjoint assignments; conservation exact
+        std::vector<uint8_t> da, db;
+        FetchPlan p(mk_keys(da, db), 1024, 4.0, 1'000'000, 2, /*rot*/ 0);
+        uint32_t s0 = p.add_seeder("h:1"), s1 = p.add_seeder("h:2");
+        for (uint32_t k = 0; k < 2; ++k) {
+            p.add_key_seeder(k, s0);
+            p.add_key_seeder(k, s1);
+        }
+        uint64_t now = 1000;
+        size_t assigned = 0;
+        while (true) {
+            bool any = false;
+            for (uint32_t s : {s0, s1}) {
+                auto t = p.take(s, now);
+                if (!t) continue;
+                any = true;
+                CHECK(t->count >= 1 && t->count <= 2);  // max_range honored
+                for (uint32_t i = 0; i < t->count; ++i) {
+                    uint8_t *dst = p.claim(t->key, t->first + i);
+                    CHECK(dst != nullptr);
+                    memset(dst, 0x5A, 1);
+                    p.published(t->key, t->first + i, s, t->gens[i], now + 10);
+                    ++assigned;
+                }
+            }
+            if (!any) break;
+        }
+        CHECK(assigned == 7);
+        CHECK(p.complete_ok() && p.finished() && !p.failed_out());
+        auto st = p.stats();
+        CHECK(st.chunks_fetched == 7 && st.chunks_resourced == 0 &&
+              st.chunks_dup == 0);
+        CHECK(st.bytes_fetched == 4096 + 2048 + 100);
+        CHECK(st.bytes_fetched + st.bytes_resourced - st.bytes_dup ==
+              st.unique_bytes);
+        auto done = p.take_completed_keys();
+        CHECK(done.size() == 2);  // both keys reported exactly once
+        CHECK(p.take_completed_keys().empty());
+    }
+    {
+        // deadline expiry re-sources to the other seeder; the straggler's
+        // late arrival dedupes (gen classification: fetched vs resourced)
+        std::vector<uint8_t> da, db;
+        auto ks = mk_keys(da, db);
+        ks.pop_back();  // single key "a", 4 chunks
+        FetchPlan p(std::move(ks), 1024, 4.0, 1'000'000, 4, 0);
+        uint32_t s0 = p.add_seeder("h:1"), s1 = p.add_seeder("h:2");
+        p.add_key_seeder(0, s0);
+        p.add_key_seeder(0, s1);
+        auto t0 = p.take(s0, 0);
+        CHECK(t0 && t0->count == 4);
+        // s1 has nothing: everything is inflight to s0
+        CHECK(!p.take(s1, 0));
+        // chunk 0's deadline passes -> re-sourceable
+        CHECK(p.expire_overdue(5'000'000'000ull) == 4);
+        auto t1 = p.take(s1, 5'000'000'000ull);
+        CHECK(t1 && t1->first == 0 && t1->count == 4);
+        for (uint32_t i = 0; i < 4; ++i)
+            CHECK(t1->gens[i] == 2);  // second assignment generation
+        // s1 delivers all four (resourced)
+        for (uint32_t i = 0; i < 4; ++i) {
+            uint8_t *dst = p.claim(0, i);
+            CHECK(dst != nullptr);
+            p.published(0, i, s1, t1->gens[i], 5'000'000'100ull);
+        }
+        CHECK(p.complete_ok());
+        // the stuck s0 worker finally lands chunk 0 -> duplicate
+        CHECK(p.claim(0, 0) == nullptr);
+        p.duplicate(0, 0, s0, t0->gens[0]);
+        auto st = p.stats();
+        CHECK(st.chunks_resourced == 4 && st.chunks_dup == 1 &&
+              st.chunks_fetched == 1);  // the dup arrival was gen-1
+        CHECK(st.bytes_fetched + st.bytes_resourced - st.bytes_dup ==
+              st.unique_bytes);
+        CHECK(st.unique_bytes == 4096);
+    }
+    {
+        // ghost assignments never park a chunk: expired straggler counts
+        // must not keep a failed chunk invisible (kInflight) until the
+        // straggler's far-future deadline — it is re-takeable the moment
+        // the failure lands
+        std::vector<uint8_t> da, db;
+        auto ks = mk_keys(da, db);
+        ks.pop_back();
+        FetchPlan p(std::move(ks), 1024, 4.0, 1'000'000'000ull, 4, 0);
+        uint32_t s0 = p.add_seeder("h:1"), s1 = p.add_seeder("h:2");
+        p.add_key_seeder(0, s0);
+        p.add_key_seeder(0, s1);
+        auto t0 = p.take(s0, 0);
+        CHECK(t0 && t0->count == 4);
+        // staggered deadlines reach (i+1)*budget = up to 16 s here
+        CHECK(p.expire_overdue(20'000'000'000ull) == 4);  // s0 straggling
+        auto t1 = p.take(s1, 20'000'000'000ull);          // re-sourced to s1
+        CHECK(t1 && t1->count == 4);
+        for (uint32_t i = 0; i < 4; ++i) p.failed(0, i, s1);  // s1 fails them
+        // s0's ghost assignment (inflight, deadline far out) must not
+        // block the retry: the chunks are pending again right now
+        auto t2 = p.take(s0, 20'000'000'100ull);
+        CHECK(t2 && t2->count == 4);
+        for (uint32_t i = 0; i < 4; ++i) {
+            uint8_t *dst = p.claim(0, i);
+            CHECK(dst);
+            p.published(0, i, s0, t2->gens[i], 20'000'000'200ull);
+        }
+        CHECK(p.complete_ok());
+    }
+    {
+        // precise invalidation: a seeder death re-sources ITS outstanding
+        // chunks only — healthy inflight transfers keep their deadlines
+        // (a plan-wide expiry would re-fetch everything and count it all
+        // as duplicate traffic)
+        std::vector<uint8_t> da, db;
+        auto ks = mk_keys(da, db);
+        ks.pop_back();
+        FetchPlan p(std::move(ks), 1024, 4.0, 1'000'000'000ull, 2, 0);
+        uint32_t s0 = p.add_seeder("h:1"), s1 = p.add_seeder("h:2");
+        p.add_key_seeder(0, s0);
+        p.add_key_seeder(0, s1);
+        auto t0 = p.take(s0, 0);
+        auto t1 = p.take(s1, 0);
+        CHECK(t0 && t0->count == 2 && t1 && t1->count == 2);
+        p.seeder_gone(s1);
+        CHECK(p.expire_overdue(1) == 2);  // exactly s1's two chunks
+    }
+    {
+        // seeder death: chunks fail over to the survivor; losing BOTH
+        // fails the plan out (bounded, never wedges)
+        std::vector<uint8_t> da, db;
+        auto ks = mk_keys(da, db);
+        ks.pop_back();
+        FetchPlan p(std::move(ks), 1024, 4.0, 1'000'000, 4, 0);
+        uint32_t s0 = p.add_seeder("h:1"), s1 = p.add_seeder("h:2");
+        p.add_key_seeder(0, s0);
+        p.add_key_seeder(0, s1);
+        auto t0 = p.take(s0, 0);
+        CHECK(t0 && t0->count == 4);
+        for (uint32_t i = 0; i < 4; ++i) p.failed(0, i, s0);
+        p.seeder_gone(s0);
+        CHECK(!p.finished());
+        CHECK(!p.take(s0, 10));  // dead seeders get nothing
+        auto t1 = p.take(s1, 10);
+        CHECK(t1 && t1->count == 4);
+        for (uint32_t i = 0; i < 2; ++i) {
+            uint8_t *dst = p.claim(0, i);
+            CHECK(dst);
+            p.published(0, i, s1, t1->gens[i], 20);
+        }
+        for (uint32_t i = 2; i < 4; ++i) p.failed(0, i, s1);
+        p.seeder_gone(s1);
+        CHECK(p.finished() && p.failed_out() && !p.complete_ok());
+        CHECK(p.stats().seeders_lost == 2);
+    }
+    {
+        // hash-mismatch failover: a corrupt seeder costs a re-source, an
+        // honest one completes the plan (content addressing in action)
+        std::vector<uint8_t> da, db;
+        auto ks = mk_keys(da, db);
+        ks.pop_back();
+        FetchPlan p(std::move(ks), 1024, 4.0, 1'000'000, 1, 0);
+        uint32_t bad = p.add_seeder("h:bad"), good = p.add_seeder("h:good");
+        p.add_key_seeder(0, bad);
+        p.add_key_seeder(0, good);
+        size_t served_bad = 0, served_good = 0;
+        while (!p.finished()) {
+            if (auto t = p.take(bad, 0)) {
+                p.failed(t->key, t->first, bad, /*hash_bad=*/true);
+                ++served_bad;
+            }
+            if (auto t = p.take(good, 0)) {
+                uint8_t *dst = p.claim(t->key, t->first);
+                CHECK(dst);
+                p.published(t->key, t->first, good, t->gens[0], 5);
+                ++served_good;
+            }
+            CHECK(served_bad + served_good < 64);  // bounded
+        }
+        CHECK(p.complete_ok() && p.saw_hash_mismatch());
+        CHECK(served_good == 4);
+        CHECK(p.stats().hash_mismatches == served_bad);
+    }
+    {
+        // retry-later backoff: a not-ready seeder is neither blacklisted
+        // nor hammered; requeue leaves no tried mark
+        std::vector<uint8_t> da, db;
+        auto ks = mk_keys(da, db);
+        ks.pop_back();
+        FetchPlan p(std::move(ks), 1024, 4.0, 1'000'000, 4, 0);
+        uint32_t s0 = p.add_seeder("h:1");
+        p.add_key_seeder(0, s0);
+        auto t0 = p.take(s0, 0);
+        CHECK(t0 && t0->count == 4);
+        for (uint32_t i = 0; i < 4; ++i) p.requeue(0, i, s0);
+        p.seeder_backoff(s0, 1'000'000);
+        CHECK(!p.take(s0, 500'000));        // parked during backoff
+        auto t1 = p.take(s0, 2'000'000);    // and assignable after (no tried)
+        CHECK(t1 && t1->count == 4);
+        for (uint32_t i = 0; i < 4; ++i) {
+            uint8_t *dst = p.claim(0, i);
+            CHECK(dst);
+            p.published(0, i, s0, t1->gens[i], 2'000'100);
+        }
+        CHECK(p.complete_ok());
+    }
+    {
+        // a key with no viable source fails out via check_liveness
+        // instead of spinning (empty seeder set = nobody can ever serve)
+        std::vector<uint8_t> da, db;
+        FetchPlan p(mk_keys(da, db), 1024, 4.0, 1'000'000, 4, 0);
+        uint32_t s0 = p.add_seeder("h:1");
+        p.add_key_seeder(0, s0);  // key "b" has NO seeders
+        p.check_liveness();
+        CHECK(p.finished() && p.failed_out());
     }
 }
 
@@ -1461,6 +1728,7 @@ int main() {
     test_watchdog();
     test_wire();
     test_hash();
+    test_ss_chunk();
     test_kernels();
     test_quant();
     test_quant_16bit_parity();

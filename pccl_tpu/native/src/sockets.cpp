@@ -179,6 +179,25 @@ bool Socket::recv_all(void *data, size_t n) {
     return true;
 }
 
+bool Socket::recv_all_deadline(void *data, size_t n, int timeout_ms) {
+    auto *p = static_cast<uint8_t *>(data);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    size_t off = 0;
+    while (off < n) {
+        auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                        deadline - std::chrono::steady_clock::now())
+                        .count();
+        if (left <= 0) return false;
+        ssize_t r = recv_some(p + off, n - off,
+                              static_cast<int>(std::min<long long>(left, 200)));
+        if (r == -2) continue;  // poll slice elapsed; re-check deadline
+        if (r <= 0) return false;
+        off += static_cast<size_t>(r);
+    }
+    return true;
+}
+
 ssize_t Socket::recv_some(void *data, size_t n, int timeout_ms) {
     int fd = fd_.load();
     if (fd < 0) return -1;

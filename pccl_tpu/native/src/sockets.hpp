@@ -91,6 +91,11 @@ public:
     // gathered write: header + payload in one syscall (no staging copy)
     bool send_all2(const void *a, size_t na, const void *b, size_t nb);
     bool recv_all(void *data, size_t n);
+    // recv_all with a wall deadline: false on error, close, or deadline.
+    // The shared-state plane's bulk reads go through this — an unbounded
+    // recv_all let one blackholed seeder wedge a sync round until the
+    // kernel TCP timeout (docs/04).
+    bool recv_all_deadline(void *data, size_t n, int timeout_ms);
     // recv with timeout; returns bytes read (0 on orderly close), -1 error, -2 timeout
     ssize_t recv_some(void *data, size_t n, int timeout_ms);
     // SO_SNDBUF/SO_RCVBUF — large buffers keep the p2p data plane streaming

@@ -1,0 +1,433 @@
+#include "ss_chunk.hpp"
+
+#include <algorithm>
+#include <chrono>
+
+#include "wire.hpp"
+
+namespace pcclt::ssc {
+
+uint32_t chunk_count(uint64_t nbytes, uint64_t chunk_bytes) {
+    if (nbytes == 0 || chunk_bytes == 0) return 0;
+    return static_cast<uint32_t>((nbytes + chunk_bytes - 1) / chunk_bytes);
+}
+
+uint64_t chunk_len(uint64_t nbytes, uint64_t chunk_bytes, uint32_t idx) {
+    uint64_t off = static_cast<uint64_t>(idx) * chunk_bytes;
+    if (off >= nbytes) return 0;
+    return std::min(chunk_bytes, nbytes - off);
+}
+
+std::vector<uint64_t> leaf_hashes(hash::Type t, const void *data,
+                                  uint64_t nbytes, uint64_t chunk_bytes) {
+    std::vector<uint64_t> leaves;
+    uint32_t n = chunk_count(nbytes, chunk_bytes);
+    leaves.reserve(n);
+    const auto *p = static_cast<const uint8_t *>(data);
+    for (uint32_t i = 0; i < n; ++i)
+        leaves.push_back(hash::content_hash(
+            t, p + static_cast<uint64_t>(i) * chunk_bytes,
+            chunk_len(nbytes, chunk_bytes, i)));
+    return leaves;
+}
+
+uint64_t root_hash(hash::Type t, const std::vector<uint64_t> &leaves) {
+    // hash the big-endian leaf array so the root is endian-stable on the
+    // wire like every other hash this protocol ships
+    std::vector<uint8_t> buf;
+    buf.reserve(leaves.size() * 8);
+    for (uint64_t h : leaves) {
+        uint64_t be = wire::to_be(h);
+        const auto *p = reinterpret_cast<const uint8_t *>(&be);
+        buf.insert(buf.end(), p, p + 8);
+    }
+    return hash::content_hash(t, buf.data(), buf.size());
+}
+
+// ------------------------------------------------------------- FetchPlan
+
+FetchPlan::FetchPlan(std::vector<KeySpec> keys, uint64_t chunk_bytes,
+                     double factor, uint64_t min_ns, uint32_t max_range,
+                     uint64_t rot_seed)
+    : chunk_bytes_(chunk_bytes == 0 ? 1 : chunk_bytes),
+      factor_(factor > 0 ? factor : 4.0),
+      min_ns_(min_ns),
+      max_range_(max_range == 0 ? 1 : max_range),
+      rot_seed_(rot_seed) {
+    MutexLock lk(mu_);
+    for (auto &ks : keys) {
+        Key k;
+        k.nchunks = chunk_count(ks.nbytes, chunk_bytes_);
+        k.chunks.resize(k.nchunks);
+        total_bytes_ += ks.nbytes;
+        total_chunks_ += k.nchunks;
+        k.spec = std::move(ks);
+        keys_.push_back(std::move(k));
+    }
+    // a zero-chunk key (empty entry) is born complete
+    for (uint32_t i = 0; i < keys_.size(); ++i)
+        if (keys_[i].nchunks == 0) completed_keys_.push_back(i);
+}
+
+uint32_t FetchPlan::add_seeder(const std::string &endpoint) {
+    MutexLock lk(mu_);
+    auto it = seeder_idx_.find(endpoint);
+    if (it != seeder_idx_.end()) return it->second;
+    uint32_t idx = static_cast<uint32_t>(seeders_.size());
+    seeders_.push_back(Seeder{endpoint, true, 0});
+    seeder_idx_[endpoint] = idx;
+    cv_.notify_all();
+    return idx;
+}
+
+void FetchPlan::add_key_seeder(uint32_t key, uint32_t seeder) {
+    MutexLock lk(mu_);
+    if (key >= keys_.size() || seeder >= seeders_.size()) return;
+    keys_[key].seeders.insert(seeder);
+    cv_.notify_all();
+}
+
+void FetchPlan::seeder_gone(uint32_t seeder) {
+    MutexLock lk(mu_);
+    if (seeder >= seeders_.size() || !seeders_[seeder].alive) return;
+    seeders_[seeder].alive = false;
+    stats_.seeders_lost++;
+    // its outstanding assignments can never complete: re-source exactly
+    // those now. deadline_ns is per-chunk (the NEWEST assignment's), so
+    // only zero it when the dead seeder owns EVERY outstanding
+    // assignment — with a healthy co-owner inflight, its live deadline
+    // stands and the dead straggler entry is reaped by the worker's own
+    // failure report
+    for (auto &k : keys_)
+        for (auto &c : k.chunks)
+            if (c.state == CState::kInflight && !c.owners.empty() &&
+                c.owners.count(seeder) == c.owners.size())
+                c.deadline_ns = 0;
+    maybe_fail_out();
+    cv_.notify_all();
+}
+
+void FetchPlan::seeder_backoff(uint32_t seeder, uint64_t until_ns) {
+    MutexLock lk(mu_);
+    if (seeder >= seeders_.size()) return;
+    seeders_[seeder].backoff_until_ns = until_ns;
+}
+
+bool FetchPlan::seeder_alive(uint32_t seeder) const {
+    MutexLock lk(mu_);
+    return seeder < seeders_.size() && seeders_[seeder].alive;
+}
+
+std::string FetchPlan::seeder_endpoint(uint32_t seeder) const {
+    MutexLock lk(mu_);
+    return seeder < seeders_.size() ? seeders_[seeder].endpoint : std::string();
+}
+
+size_t FetchPlan::seeder_count() const {
+    MutexLock lk(mu_);
+    return seeders_.size();
+}
+
+uint64_t FetchPlan::budget_locked() const {
+    uint64_t b = ewma_ns_ > 0
+                     ? static_cast<uint64_t>(ewma_ns_ * factor_)
+                     : min_ns_ * 4;  // no sample yet: generous first envelope
+    return std::max(b, min_ns_);
+}
+
+uint64_t FetchPlan::chunk_budget_ns() const {
+    MutexLock lk(mu_);
+    return budget_locked();
+}
+
+bool FetchPlan::assignable(const Key &k, const Chunk &c,
+                           uint32_t seeder) const {
+    if (c.state != CState::kPending) return false;
+    if (c.tried.count(seeder)) return false;
+    return k.seeders.count(seeder) != 0;
+}
+
+std::optional<FetchPlan::Take> FetchPlan::take(uint32_t seeder,
+                                               uint64_t now_ns) {
+    MutexLock lk(mu_);
+    if (failed_out_ || done_chunks_ == total_chunks_) return std::nullopt;
+    if (seeder >= seeders_.size() || !seeders_[seeder].alive) return std::nullopt;
+    if (seeders_[seeder].backoff_until_ns > now_ns) return std::nullopt;
+    const size_t nk = keys_.size();
+    if (nk == 0) return std::nullopt;
+    // per-peer key rotation + per-seeder offset: a swarm of joiners starts
+    // on DIFFERENT keys (promotion multiplies seeders) and two seeders of
+    // one joiner start on different keys (less range overlap)
+    size_t start = (rot_seed_ + seeder) % nk;
+    for (size_t pass = 0; pass < nk; ++pass) {
+        uint32_t ki = static_cast<uint32_t>((start + pass) % nk);
+        Key &k = keys_[ki];
+        if (k.done == k.nchunks || k.seeders.count(seeder) == 0) continue;
+        for (uint32_t ci = 0; ci < k.nchunks; ++ci) {
+            if (!assignable(k, k.chunks[ci], seeder)) continue;
+            Take t;
+            t.key = ki;
+            t.first = ci;
+            uint64_t budget = budget_locked();
+            while (t.count < max_range_ && ci + t.count < k.nchunks &&
+                   assignable(k, k.chunks[ci + t.count], seeder)) {
+                Chunk &c = k.chunks[ci + t.count];
+                c.state = CState::kInflight;
+                c.inflight++;
+                c.attempts++;
+                c.owners.insert(seeder);
+                c.taken_ns = now_ns;
+                // staggered: later chunks of the run arrive serially
+                c.deadline_ns = now_ns + (t.count + 1) * budget;
+                t.gens.push_back(c.attempts);
+                t.count++;
+            }
+            return t;
+        }
+    }
+    return std::nullopt;
+}
+
+uint8_t *FetchPlan::claim(uint32_t key, uint32_t idx) {
+    MutexLock lk(mu_);
+    if (key >= keys_.size()) return nullptr;
+    Key &k = keys_[key];
+    if (idx >= k.nchunks) return nullptr;
+    Chunk &c = k.chunks[idx];
+    if (c.state == CState::kDone || c.state == CState::kWriting) return nullptr;
+    c.state = CState::kWriting;
+    return k.spec.dst + static_cast<uint64_t>(idx) * chunk_bytes_;
+}
+
+void FetchPlan::abandon(uint32_t key, uint32_t idx) {
+    MutexLock lk(mu_);
+    Chunk &c = keys_[key].chunks[idx];
+    if (c.state == CState::kWriting) c.state = CState::kPending;
+    cv_.notify_all();
+}
+
+void FetchPlan::published(uint32_t key, uint32_t idx, uint32_t seeder,
+                          uint32_t gen, uint64_t now_ns) {
+    MutexLock lk(mu_);
+    Key &k = keys_[key];
+    Chunk &c = k.chunks[idx];
+    uint64_t len = chunk_len(k.spec.nbytes, chunk_bytes_, idx);
+    if (gen <= 1) {
+        stats_.chunks_fetched++;
+        stats_.bytes_fetched += len;
+    } else {
+        stats_.chunks_resourced++;
+        stats_.bytes_resourced += len;
+    }
+    if (c.inflight > 0) c.inflight--;
+    auto own = c.owners.find(seeder);
+    if (own != c.owners.end()) c.owners.erase(own);
+    if (c.state != CState::kWriting) return;  // defensive: claim protocol
+    c.state = CState::kDone;
+    stats_.unique_bytes += len;
+    done_chunks_++;
+    k.done++;
+    if (k.done == k.nchunks && !k.reported) {
+        k.reported = true;
+        completed_keys_.push_back(key);
+    }
+    // EWMA over completed fetch round-trips (the watchdog envelope's
+    // feed). Only the LATEST assignment's arrival is a valid sample:
+    // taken_ns was overwritten by any re-take, so an older generation
+    // landing now would be measured from the wrong start and feed an
+    // artificially tiny sample into the deadline — a premature-expiry
+    // feedback loop
+    if (gen == c.attempts && c.taken_ns && now_ns > c.taken_ns) {
+        double sample = static_cast<double>(now_ns - c.taken_ns);
+        ewma_ns_ = ewma_ns_ <= 0 ? sample : 0.7 * ewma_ns_ + 0.3 * sample;
+    }
+    cv_.notify_all();
+}
+
+void FetchPlan::duplicate(uint32_t key, uint32_t idx, uint32_t seeder,
+                          uint32_t gen) {
+    MutexLock lk(mu_);
+    Key &k = keys_[key];
+    uint64_t len = chunk_len(k.spec.nbytes, chunk_bytes_, idx);
+    if (gen <= 1) {
+        stats_.chunks_fetched++;
+        stats_.bytes_fetched += len;
+    } else {
+        stats_.chunks_resourced++;
+        stats_.bytes_resourced += len;
+    }
+    stats_.chunks_dup++;
+    stats_.bytes_dup += len;
+    Chunk &c = k.chunks[idx];
+    if (c.inflight > 0) c.inflight--;
+    auto own = c.owners.find(seeder);
+    if (own != c.owners.end()) c.owners.erase(own);
+    cv_.notify_all();
+}
+
+void FetchPlan::fail_locked(uint32_t key, uint32_t idx, uint32_t seeder,
+                            bool hash_bad) {
+    Key &k = keys_[key];
+    Chunk &c = k.chunks[idx];
+    if (hash_bad) stats_.hash_mismatches++;
+    if (seeder < seeders_.size()) c.tried.insert(seeder);
+    if (c.inflight > 0) c.inflight--;
+    auto own = c.owners.find(seeder);
+    if (own != c.owners.end()) c.owners.erase(own);
+    // re-assignable NOW even with a ghost assignment outstanding (an
+    // expired straggler's count): waiting out the ghost's far-future
+    // deadline would park the chunk invisibly — not kPending for
+    // maybe_fail_out's exhaustion scan, not takeable. kPending with
+    // inflight > 0 is already a legal post-expiry state; a straggler's
+    // late arrival dedupes via the claim protocol.
+    if (c.state == CState::kInflight) c.state = CState::kPending;
+    maybe_fail_out();
+}
+
+void FetchPlan::failed(uint32_t key, uint32_t idx, uint32_t seeder,
+                       bool hash_bad) {
+    MutexLock lk(mu_);
+    if (key >= keys_.size() || idx >= keys_[key].nchunks) return;
+    fail_locked(key, idx, seeder, hash_bad);
+    cv_.notify_all();
+}
+
+void FetchPlan::requeue(uint32_t key, uint32_t idx, uint32_t seeder) {
+    MutexLock lk(mu_);
+    if (key >= keys_.size() || idx >= keys_[key].nchunks) return;
+    Chunk &c = keys_[key].chunks[idx];
+    if (c.inflight > 0) c.inflight--;
+    auto own = c.owners.find(seeder);
+    if (own != c.owners.end()) c.owners.erase(own);
+    // same ghost rule as fail_locked: a refusal must leave the chunk
+    // takeable by other seeders immediately
+    if (c.state == CState::kInflight) c.state = CState::kPending;
+    cv_.notify_all();
+}
+
+void FetchPlan::abort() {
+    MutexLock lk(mu_);
+    failed_out_ = true;
+    cv_.notify_all();
+}
+
+void FetchPlan::check_liveness() {
+    MutexLock lk(mu_);
+    if (failed_out_ || done_chunks_ == total_chunks_) return;
+    maybe_fail_out();
+}
+
+size_t FetchPlan::expire_overdue(uint64_t now_ns) {
+    MutexLock lk(mu_);
+    size_t n = 0;
+    for (auto &k : keys_)
+        for (auto &c : k.chunks)
+            if (c.state == CState::kInflight && now_ns >= c.deadline_ns) {
+                // overdue: make it assignable AGAIN without failing the
+                // outstanding fetch — first verified arrival wins, the
+                // loser dedupes. The slow seeder is NOT marked tried (it
+                // may merely be paced); a second expiry against it will
+                // fail through the worker's own recv deadline instead.
+                c.state = CState::kPending;
+                ++n;
+            }
+    if (n) cv_.notify_all();
+    return n;
+}
+
+void FetchPlan::maybe_fail_out() {
+    // a pending chunk that every alive eligible seeder has already failed
+    // starts a new wave (tried sets clear); kMaxWaves fruitless waves — or
+    // no alive eligible seeder at all — fails the plan
+    bool any_alive_for_all = true;
+    bool any_exhausted = false;
+    for (auto &k : keys_) {
+        if (k.done == k.nchunks) continue;
+        bool key_has_alive = false;
+        for (uint32_t s : k.seeders)
+            if (s < seeders_.size() && seeders_[s].alive) key_has_alive = true;
+        if (!key_has_alive) {
+            any_alive_for_all = false;
+            continue;
+        }
+        for (auto &c : k.chunks) {
+            if (c.state != CState::kPending) continue;
+            bool open = false;
+            for (uint32_t s : k.seeders)
+                if (s < seeders_.size() && seeders_[s].alive &&
+                    c.tried.count(s) == 0)
+                    open = true;
+            if (!open) any_exhausted = true;
+        }
+    }
+    if (!any_alive_for_all) {
+        failed_out_ = true;
+        cv_.notify_all();
+        return;
+    }
+    if (any_exhausted) {
+        if (++waves_ > kMaxWaves) {
+            failed_out_ = true;
+        } else {
+            for (auto &k : keys_)
+                for (auto &c : k.chunks)
+                    if (c.state == CState::kPending) c.tried.clear();
+        }
+        cv_.notify_all();
+    }
+}
+
+std::vector<uint32_t> FetchPlan::take_completed_keys() {
+    MutexLock lk(mu_);
+    auto v = std::move(completed_keys_);
+    completed_keys_.clear();
+    return v;
+}
+
+bool FetchPlan::finished() const {
+    MutexLock lk(mu_);
+    return failed_out_ || done_chunks_ == total_chunks_;
+}
+
+bool FetchPlan::complete_ok() const {
+    MutexLock lk(mu_);
+    return done_chunks_ == total_chunks_;
+}
+
+bool FetchPlan::failed_out() const {
+    MutexLock lk(mu_);
+    return failed_out_;
+}
+
+bool FetchPlan::saw_hash_mismatch() const {
+    MutexLock lk(mu_);
+    return stats_.hash_mismatches > 0;
+}
+
+PlanStats FetchPlan::stats() const {
+    MutexLock lk(mu_);
+    return stats_;
+}
+
+const KeySpec &FetchPlan::key_spec(uint32_t key) const {
+    MutexLock lk(mu_);
+    return keys_[key].spec;
+}
+
+size_t FetchPlan::key_count() const {
+    MutexLock lk(mu_);
+    return keys_.size();
+}
+
+uint32_t FetchPlan::key_chunks(uint32_t key) const {
+    MutexLock lk(mu_);
+    return keys_[key].nchunks;
+}
+
+void FetchPlan::wait_event(int timeout_ms) {
+    MutexLock lk(mu_);
+    if (failed_out_ || done_chunks_ == total_chunks_) return;
+    cv_.wait_for(mu_, std::chrono::milliseconds(timeout_ms));
+}
+
+}  // namespace pcclt::ssc

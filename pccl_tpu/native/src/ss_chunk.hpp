@@ -1,0 +1,248 @@
+// Content-addressed shared-state chunk plane (docs/04).
+//
+// SyncSharedState used to fan the whole state out from ONE elected
+// distributor over ONE raw TCP connection — a single preempted VM mid-sync
+// failed the round for everyone. This module is the churn-proof core that
+// replaces it: entries are split into fixed-size chunks under a per-entry
+// hash tree (leaf = content hash of one chunk, root = content hash over
+// the leaf array — the root subsumes the old whole-entry drift hash), and
+// outdated peers fetch chunks from MANY seeders in parallel, verifying
+// each chunk on arrival and re-sourcing slow/dead fetches from a
+// different seeder (the PR-10 watchdog ladder, applied to the state
+// plane: EWMA deadline -> re-issue -> alternate source).
+//
+// Two deliberately separable pieces:
+//   * the hash tree (chunk_count / leaf_hashes / root_hash) — pure
+//     functions over buffers;
+//   * FetchPlan — the multi-source assignment/verify/retry state machine,
+//     time passed in explicitly so the selftest can drive deadlines
+//     deterministically. client.cpp owns the sockets and threads; the
+//     plan owns WHICH chunk goes to WHICH seeder and the conservation
+//     accounting (fetched + re-sourced - dup == unique chunk bytes,
+//     asserted byte-exact by the swarm bench).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "annotations.hpp"
+#include "hash.hpp"
+
+namespace pcclt::ssc {
+
+// chunks covering nbytes (last chunk may be short); 0 for an empty entry
+uint32_t chunk_count(uint64_t nbytes, uint64_t chunk_bytes);
+
+// byte length of chunk `idx` of an nbytes-long entry
+uint64_t chunk_len(uint64_t nbytes, uint64_t chunk_bytes, uint32_t idx);
+
+// one content hash per chunk, in chunk order
+std::vector<uint64_t> leaf_hashes(hash::Type t, const void *data,
+                                  uint64_t nbytes, uint64_t chunk_bytes);
+
+// tree root: content hash over the big-endian leaf array. This IS the
+// entry hash offered to the master when the chunk plane is on — drift
+// detection was already hash-based, so the leaves subsume the old
+// whole-entry digest (device-precomputed hashes keep their own digest and
+// simply carry no leaves; their dirty keys take the legacy path).
+uint64_t root_hash(hash::Type t, const std::vector<uint64_t> &leaves);
+
+// ------------------------------------------------------------- fetch plan
+
+// One outdated key the plan must fill.
+struct KeySpec {
+    std::string name;
+    uint64_t nbytes = 0;
+    uint8_t *dst = nullptr;            // receive buffer (entry host memory)
+    std::vector<uint64_t> leaves;      // expected per-chunk hashes
+};
+
+// Cumulative plan counters (chunk granularity + bytes). Every verified
+// arrival lands in exactly one of fetched/resourced (by assignment
+// generation: first assignment vs a re-sourced one); arrivals for an
+// already-delivered chunk ALSO land in dup. Hence the conservation
+// identity at completion:
+//   fetched_bytes + resourced_bytes - dup_bytes == sum(chunk bytes)
+struct PlanStats {
+    uint64_t chunks_fetched = 0, chunks_resourced = 0, chunks_dup = 0;
+    uint64_t bytes_fetched = 0, bytes_resourced = 0, bytes_dup = 0;
+    uint64_t hash_mismatches = 0;
+    uint64_t seeders_lost = 0;
+    uint64_t unique_bytes = 0;         // delivered into buffers (verified)
+};
+
+// Multi-source fetch state machine. Thread-safe: workers (one per seeder
+// connection) call take/claim/published/duplicate/failed; the dispatcher
+// calls expire_overdue/take_completed_keys/add_seeder. All waits go
+// through wait_event so a worker never spins.
+class FetchPlan {
+public:
+    // factor/min_ns parameterize the per-chunk deadline envelope:
+    //   budget = max(min_ns, factor * EWMA(chunk fetch ns))
+    // max_range caps chunks per assignment (one request serves a
+    // contiguous run); rot_seed staggers the key order per peer so a
+    // swarm of cold joiners completes DIFFERENT keys first and the
+    // mid-round promotions multiply the seeder set.
+    FetchPlan(std::vector<KeySpec> keys, uint64_t chunk_bytes, double factor,
+              uint64_t min_ns, uint32_t max_range, uint64_t rot_seed);
+
+    // Register a seeder (keyed by its canonical endpoint string). Returns
+    // its index; re-adding an endpoint returns the existing index (a
+    // retired seeder is NOT revived — a dead endpoint stays dead).
+    uint32_t add_seeder(const std::string &endpoint);
+    // Mark seeder eligible to serve `key` (per-key seeder sets from the
+    // master's chunk map / a mid-round promotion).
+    void add_key_seeder(uint32_t key, uint32_t seeder);
+    // Seeder died (dial/socket failure): its inflight chunks return to
+    // pending for other seeders.
+    void seeder_gone(uint32_t seeder);
+    // Transient refusal (serve window not ready yet): back the seeder off
+    // without retiring it or marking chunks tried.
+    void seeder_backoff(uint32_t seeder, uint64_t until_ns);
+    bool seeder_alive(uint32_t seeder) const;
+    std::string seeder_endpoint(uint32_t seeder) const;
+    size_t seeder_count() const;
+
+    struct Take {
+        uint32_t key = 0;
+        uint32_t first = 0;                // chunk index within the key
+        uint32_t count = 0;
+        std::vector<uint32_t> gens;        // per-chunk assignment ordinal
+    };
+    // Next contiguous run of pending chunks this seeder may serve; nullopt
+    // when nothing is currently assignable to it. Chunks are stamped
+    // inflight with staggered deadlines (chunk i of the run gets
+    // (i+1) * budget).
+    std::optional<Take> take(uint32_t seeder, uint64_t now_ns);
+
+    // Verified-arrival protocol (tsan-safe two-phase write):
+    //   dst = claim(key, idx); if dst: memcpy; published(...);
+    //   else duplicate(...)  [chunk already delivered or being written]
+    // A claim the caller cannot complete (socket died mid-copy cannot
+    // happen — bytes are already local — but keep abandon for symmetry).
+    uint8_t *claim(uint32_t key, uint32_t idx);
+    void abandon(uint32_t key, uint32_t idx);
+    void published(uint32_t key, uint32_t idx, uint32_t seeder, uint32_t gen,
+                   uint64_t now_ns);
+    void duplicate(uint32_t key, uint32_t idx, uint32_t seeder, uint32_t gen);
+    // Fetch failed (timeout / socket error / hash mismatch): chunk back to
+    // pending, seeder remembered in its tried set. hash_bad additionally
+    // counts a verify failure (a corrupt seeder must not fail the round
+    // while an honest one remains).
+    void failed(uint32_t key, uint32_t idx, uint32_t seeder,
+                bool hash_bad = false);
+    // Transient refusal (seeder's serve window not ready): chunk back to
+    // pending WITHOUT marking the seeder tried — pair with seeder_backoff.
+    void requeue(uint32_t key, uint32_t idx, uint32_t seeder);
+
+    // Force the plan to a failed terminal state (caller abandoning the
+    // sync, e.g. a master-session flip mid-fetch): workers drain out.
+    void abort();
+    // Re-evaluate fail-out (a key whose seeder set is empty can never
+    // complete); dispatchers call this each poll so a plan with no viable
+    // source terminates instead of spinning.
+    void check_liveness();
+
+    // Dispatcher: re-source inflight chunks whose deadline passed (they
+    // become assignable to OTHER seeders; the stuck worker's eventual
+    // arrival dedupes). Returns how many expired.
+    size_t expire_overdue(uint64_t now_ns);
+
+    // Keys that newly completed (all chunks verified), each reported once
+    // — the caller marks them servable and sends the promotion packet.
+    std::vector<uint32_t> take_completed_keys();
+
+    // Plan lifecycle: finished = every chunk delivered OR the plan failed
+    // out (no alive seeder can serve some pending chunk and the retry
+    // waves are exhausted).
+    bool finished() const;
+    bool complete_ok() const;
+    bool failed_out() const;
+    bool saw_hash_mismatch() const;
+
+    // Current per-chunk deadline budget (workers bound their recv with it).
+    uint64_t chunk_budget_ns() const;
+
+    PlanStats stats() const;
+    uint64_t chunk_bytes() const { return chunk_bytes_; }
+    // key metadata is immutable after construction and keys_ is never
+    // resized, so the returned reference stays valid without the lock —
+    // the accessors still lock to keep the annotation contract honest
+    const KeySpec &key_spec(uint32_t key) const;
+    size_t key_count() const;
+    uint32_t key_chunks(uint32_t key) const;
+    uint64_t total_bytes() const { return total_bytes_; }
+
+    // Park until something changed (arrival, expiry, promotion) or
+    // timeout; spurious wakeups are fine — callers re-poll.
+    void wait_event(int timeout_ms);
+
+private:
+    enum class CState : uint8_t { kPending, kInflight, kWriting, kDone };
+    struct Chunk {
+        CState state = CState::kPending;
+        uint32_t attempts = 0;           // assignment generations handed out
+        uint32_t inflight = 0;           // outstanding assignments
+        uint64_t deadline_ns = 0;        // newest assignment's deadline
+        uint64_t taken_ns = 0;           // newest assignment time (EWMA)
+        std::set<uint32_t> tried;        // seeders that failed/expired it
+        // seeders with an OUTSTANDING assignment for this chunk, so a
+        // seeder death invalidates exactly ITS fetches — not every
+        // healthy inflight transfer in the plan (one entry per
+        // outstanding assignment; a seeder can legitimately appear twice
+        // after an expire/re-take cycle)
+        std::multiset<uint32_t> owners;
+    };
+    struct Key {
+        KeySpec spec;
+        uint32_t nchunks = 0;
+        uint32_t done = 0;
+        bool reported = false;
+        std::set<uint32_t> seeders;      // eligible seeder indices
+        std::vector<Chunk> chunks;
+    };
+    struct Seeder {
+        std::string endpoint;
+        bool alive = true;
+        uint64_t backoff_until_ns = 0;
+    };
+
+    bool assignable(const Key &k, const Chunk &c, uint32_t seeder) const
+        PCCLT_REQUIRES(mu_);
+    void fail_locked(uint32_t key, uint32_t idx, uint32_t seeder,
+                     bool hash_bad) PCCLT_REQUIRES(mu_);
+    void maybe_fail_out() PCCLT_REQUIRES(mu_);
+    uint64_t budget_locked() const PCCLT_REQUIRES(mu_);
+
+    const uint64_t chunk_bytes_;
+    const double factor_;
+    const uint64_t min_ns_;
+    const uint32_t max_range_;
+    const uint64_t rot_seed_;
+    uint64_t total_bytes_ = 0;
+    uint64_t total_chunks_ = 0;
+
+    mutable Mutex mu_; // lock-rank: 25
+    CondVar cv_;
+    std::vector<Key> keys_ PCCLT_GUARDED_BY(mu_);
+    std::vector<Seeder> seeders_ PCCLT_GUARDED_BY(mu_);
+    std::map<std::string, uint32_t> seeder_idx_ PCCLT_GUARDED_BY(mu_);
+    std::vector<uint32_t> completed_keys_ PCCLT_GUARDED_BY(mu_);
+    uint64_t done_chunks_ PCCLT_GUARDED_BY(mu_) = 0;
+    // retry waves: when every pending chunk has been tried against every
+    // alive eligible seeder, tried sets clear and a wave is consumed; the
+    // plan fails out after kMaxWaves fruitless sweeps (bounded retry, the
+    // chunk-plane analogue of the legacy path's single hard failure)
+    uint32_t waves_ PCCLT_GUARDED_BY(mu_) = 0;
+    bool failed_out_ PCCLT_GUARDED_BY(mu_) = false;
+    double ewma_ns_ PCCLT_GUARDED_BY(mu_) = 0;
+    PlanStats stats_ PCCLT_GUARDED_BY(mu_);
+
+    static constexpr uint32_t kMaxWaves = 4;
+};
+
+}  // namespace pcclt::ssc

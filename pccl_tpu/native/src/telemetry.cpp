@@ -46,6 +46,8 @@ const char *phase_name(Phase p) {
     case Phase::kDequantize: return "dequantize";
     case Phase::kStageWire: return "stage_wire";
     case Phase::kStall: return "stall";
+    case Phase::kSyncFetch: return "sync_fetch";
+    case Phase::kSyncVerify: return "sync_verify";
     case Phase::kCount: break;
     }
     return "?";
@@ -113,8 +115,11 @@ std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
         EdgeSnapshot s;
         s.endpoint = key;
         s.conns = e->conns.load(std::memory_order_relaxed);
-        if (s.conns == 0) continue;  // pre-rekey ephemeral-port stub: no
-                                     // conn ever ran keyed here — noise
+        s.tx_sync_bytes = e->tx_sync_bytes.load(std::memory_order_relaxed);
+        s.rx_sync_bytes = e->rx_sync_bytes.load(std::memory_order_relaxed);
+        if (s.conns == 0 && s.tx_sync_bytes == 0 && s.rx_sync_bytes == 0)
+            continue;  // pre-rekey ephemeral-port stub: no conn ever ran
+                       // keyed here — noise (sync-only edges stay visible)
         s.tx_bytes = e->tx_bytes.load(std::memory_order_relaxed);
         s.rx_bytes = e->rx_bytes.load(std::memory_order_relaxed);
         s.tx_frames = e->tx_frames.load(std::memory_order_relaxed);

@@ -149,6 +149,11 @@ enum class Phase : uint8_t {
     kDequantize,    // dequantize/accumulate kernel time within the op
     kStageWire,     // one ring stage wall time (wire + overlap compute)
     kStall,         // receiver wire-stall (op thread blocked on bytes)
+    // shared-state chunk plane (docs/04): per-chunk fetch round-trip
+    // (request -> last byte, netem included) and per-chunk hash-verify
+    // time — the distributions that attribute a slow join
+    kSyncFetch,
+    kSyncVerify,
     kCount
 };
 constexpr size_t kPhaseCount = static_cast<size_t>(Phase::kCount);
@@ -199,6 +204,13 @@ struct EdgeCounters {
     std::atomic<uint64_t> rx_relay_windows{0};
     std::atomic<uint64_t> dup_bytes{0};
     std::atomic<uint64_t> dup_windows{0};
+    // ---- shared-state chunk plane (docs/04) ----
+    // sync payload bytes moved on this edge: chunk/legacy state served
+    // (tx) and fetched (rx). Kept apart from tx_bytes/rx_bytes — those
+    // count the collective data plane and carry a conservation invariant
+    // the sync traffic must not dilute.
+    std::atomic<uint64_t> tx_sync_bytes{0};
+    std::atomic<uint64_t> rx_sync_bytes{0};
     // ---- critical-path attribution (docs/09) ----
     // latency distributions for the two phases where the EDGE is the
     // attribution key: per-ring-stage wall time on the inbound hop, and
@@ -229,6 +241,20 @@ struct CommCounters {
     // straggler-immune data plane: windows this peer forwarded as the
     // RELAY hop (neither sender nor final receiver of the window)
     std::atomic<uint64_t> relay_forwarded{0};
+    // ---- shared-state chunk plane (docs/04) ----
+    // Conservation identity at sync completion (asserted by the swarm
+    // bench): ss_chunk_bytes_fetched + ss_chunk_bytes_resourced -
+    // ss_chunk_bytes_dup == unique chunk bytes delivered.
+    std::atomic<uint64_t> ss_chunks_fetched{0};    // first-assignment arrivals
+    std::atomic<uint64_t> ss_chunks_resourced{0};  // re-sourced arrivals
+    std::atomic<uint64_t> ss_chunks_dup{0};        // already-delivered arrivals
+    std::atomic<uint64_t> ss_chunk_bytes_fetched{0};
+    std::atomic<uint64_t> ss_chunk_bytes_resourced{0};
+    std::atomic<uint64_t> ss_chunk_bytes_dup{0};
+    std::atomic<uint64_t> ss_seeder_chunks_served{0};  // chunks this peer served
+    std::atomic<uint64_t> ss_seeder_promotions{0};     // keys promoted mid-round
+    std::atomic<uint64_t> ss_seeders_lost{0};          // sources lost mid-fetch
+    std::atomic<uint64_t> ss_legacy_syncs{0};          // fell back to 1-seeder path
 };
 
 struct EdgeSnapshot {
@@ -239,6 +265,7 @@ struct EdgeSnapshot {
     uint64_t wd_suspects = 0, wd_confirms = 0, wd_reissues = 0, wd_relays = 0,
              rx_relay_bytes = 0, rx_relay_windows = 0, dup_bytes = 0,
              dup_windows = 0;
+    uint64_t tx_sync_bytes = 0, rx_sync_bytes = 0;
     HistSnapshot stage_wire_hist, stall_hist;
 };
 

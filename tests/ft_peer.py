@@ -40,6 +40,13 @@ def main() -> int:
     ap.add_argument("--step-interval", type=float, default=0.0,
                     help="sleep between steps (paces incumbents so churn "
                          "events land mid-run)")
+    ap.add_argument("--sync-state", type=int, default=0, metavar="ELEMS",
+                    help="churn-sync lane (docs/04): sync an ELEMS-float32 "
+                         "shared state every step (revision advances one "
+                         "per step, content = full(revision)); a relaunched "
+                         "peer offers revision 0 and adopts as a cold "
+                         "joiner. Prints 'WRONG SYNC' and exits 3 if "
+                         "adopted content ever disagrees with its revision.")
     ap.add_argument("--stats-every", type=int, default=0,
                     help="print a 'STATS {json}' line with the comm's "
                          "counter/edge snapshot every N steps (the stress "
@@ -118,6 +125,11 @@ def main() -> int:
     y = np.empty_like(x)
     step = 0
     last_resumes = 0
+    # churn-sync lane state: offered revision + its content. Invariant the
+    # whole lane hangs on: the content synced at revision R is full(R), so
+    # any adopter can verify bit-correct adoption locally.
+    sync_rev = 0
+    w = np.zeros(max(1, args.sync_state), dtype=np.float32)
     while step < args.steps:
         if args.die_prob > 0 and rng.rand() < args.die_prob:
             print(f"DYING at step {step}", flush=True)
@@ -168,6 +180,30 @@ def main() -> int:
             # alone: everyone else died or left; count as progress
             y[:] = x
             info = None
+        if args.sync_state > 0:
+            from pccl_tpu.comm import SharedState, TensorInfo
+            try:
+                sinfo = comm.sync_shared_state(
+                    SharedState([TensorInfo.from_numpy("w", w)],
+                                revision=sync_rev))
+            except (KickedError, MasterUnreachableError):
+                comm = rejoin(comm)
+                sync_rev = 0
+                w[:] = 0
+                continue
+            except (ConnectionLostError, OperationAbortedError) as e:
+                print(f"SYNC RETRY step={step} cause={type(e).__name__}",
+                      flush=True)
+                continue
+            # bit-correct adoption check: whatever revision won, its
+            # content must be full(revision) everywhere
+            if sinfo.revision > 0 and (float(w[0]) != float(sinfo.revision)
+                                       or float(w[-1]) != float(sinfo.revision)):
+                print(f"WRONG SYNC step={step} rev={sinfo.revision} "
+                      f"w0={w[0]}", flush=True)
+                return 3
+            sync_rev = sinfo.revision + 1
+            w[:] = float(sync_rev)
         world = info.world_size if info is not None else 1
         tol = 1e-5 if args.quantize == "none" else 2e-2 * world
         if info is not None and abs(float(y[0]) - world) > tol:

@@ -1,0 +1,113 @@
+"""Subprocess peer for shared-state chunk-plane scenario tests (docs/04).
+
+One OS process per peer so a scenario can SIGKILL a seeder mid-sync — the
+acceptance gate of the churn-proof chunk plane is that the round completes
+bit-identically for every survivor with zero aborts.
+
+Roles:
+  seeder  — offers the popular content (deterministic rng) at --revision
+  joiner  — offers zeros at revision 0, adopts the popular content
+
+``--suicide-after-served N`` arms a watcher thread that SIGKILLs THIS
+process the moment its own ``ss_seeder_chunks_served`` counter reaches N:
+a deterministic "the busiest seeder dies mid-serve", no orchestrator
+timing games. Results are written as JSON to --result-file (absent for
+the killed peer, by design).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def content_arrays(keys: int, elems: int, popular: bool) -> dict:
+    if popular:
+        rng = np.random.default_rng(20260804)
+        return {f"k{i}": rng.standard_normal(elems).astype(np.float32)
+                for i in range(keys)}
+    return {f"k{i}": np.zeros(elems, dtype=np.float32) for i in range(keys)}
+
+
+def digest_of(arrays: dict) -> str:
+    h = hashlib.sha256()
+    for k in sorted(arrays):
+        h.update(k.encode())
+        h.update(arrays[k].tobytes())
+    return h.hexdigest()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-port", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--role", choices=["seeder", "joiner"], required=True)
+    ap.add_argument("--keys", type=int, default=8)
+    ap.add_argument("--elems", type=int, default=65536)
+    ap.add_argument("--revision", type=int, default=1)
+    ap.add_argument("--suicide-after-served", type=int, default=0)
+    ap.add_argument("--result-file", required=True)
+    args = ap.parse_args()
+
+    from pccl_tpu.comm import (Communicator, SharedState,
+                               SharedStateSyncStrategy, TensorInfo)
+
+    comm = Communicator("127.0.0.1", args.master_port)
+    comm.connect()
+    deadline = time.time() + 60
+    while comm.global_world_size < args.world:
+        if time.time() > deadline:
+            print(f"rank {args.rank}: world never formed", file=sys.stderr)
+            return 2
+        if comm.are_peers_pending():
+            comm.update_topology()
+        time.sleep(0.01)
+
+    if args.suicide_after_served > 0:
+        def watcher():
+            while True:
+                served = comm.stats()["counters"]["ss_seeder_chunks_served"]
+                if served >= args.suicide_after_served:
+                    # mid-serve by construction: this peer IS actively
+                    # seeding the in-flight round when it dies
+                    os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(0.002)
+        threading.Thread(target=watcher, daemon=True).start()
+
+    arrays = content_arrays(args.keys, args.elems, args.role == "seeder")
+    rev = args.revision if args.role == "seeder" else 0
+    st = SharedState([TensorInfo.from_numpy(k, v) for k, v in arrays.items()],
+                     revision=rev)
+    t0 = time.perf_counter()
+    info = comm.sync_shared_state(st, SharedStateSyncStrategy.ENFORCE_POPULAR)
+    wall = time.perf_counter() - t0
+
+    stats = comm.stats()
+    res = {
+        "rank": args.rank,
+        "role": args.role,
+        "revision": info.revision,
+        "tx_bytes": info.tx_bytes,
+        "rx_bytes": info.rx_bytes,
+        "sync_wall_s": wall,
+        "digest": digest_of(arrays),
+        "counters": stats["counters"],
+        "edges": stats["edges"],
+    }
+    with open(args.result_file, "w") as f:
+        json.dump(res, f)
+    comm.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ asserts the same accept / kick / retransmit outcome:
 - peer-group isolation with different keys            (TestNoSyncIdentical...PeerGroups...)
 """
 
+import os
 import threading
 import time
 from pathlib import Path
@@ -591,6 +592,250 @@ def test_device_hash_clean_sync_never_stages(master, monkeypatch):
             assert not updated
             assert sentinel_intact, "materialize ran on a clean sync"
             assert v3 == 1.5               # jax_value = untouched device arr
+
+
+# ------------------------------------------- chunk plane (docs/04)
+#
+# Content-addressed multi-source sync: entries split into hashed chunks,
+# the master brokers a chunk map + per-key seeder sets, outdated peers
+# fetch from many seeders in parallel with per-chunk verify/deadline/
+# re-source, and peers that complete a key are promoted to seeders
+# mid-round. The scenarios below are the churn-proof acceptance gates.
+
+
+def _spawn_ss_peer(master_port, world, rank, role, tmp, keys, elems,
+                   env_extra=None, revision=1, suicide_after_served=0):
+    import subprocess
+    import sys
+    result = Path(tmp) / f"peer-{rank}.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env_extra:
+        env.update(env_extra)
+    cmd = [sys.executable, str(Path(__file__).resolve().parent / "ss_peer.py"),
+           "--master-port", str(master_port), "--world", str(world),
+           "--rank", str(rank), "--role", role, "--keys", str(keys),
+           "--elems", str(elems), "--revision", str(revision),
+           "--result-file", str(result)]
+    if suicide_after_served:
+        cmd += ["--suicide-after-served", str(suicide_after_served)]
+    return subprocess.Popen(cmd, env=env), result
+
+
+def test_chunk_plane_basic(master, monkeypatch):
+    """Chunk-plane happy path at world=4: one advanced peer, three cold
+    adopters — everyone converges bit-identically, the fetch rides chunks
+    (not the legacy stream), conservation is byte-exact, and outdated
+    peers that completed keys were PROMOTED to seeders mid-round."""
+    monkeypatch.setenv("PCCLT_SS_CHUNK_BYTES", "131072")
+    keys, elems = 4, 131072  # 4 x 512 KiB
+    nbytes = keys * elems * 4
+
+    def worker(comm, rank):
+        rng = np.random.default_rng(7)
+        if rank == 0:
+            arrs = {f"k{i}": rng.standard_normal(elems).astype(np.float32)
+                    for i in range(keys)}
+            rev = 1
+        else:
+            arrs = {f"k{i}": np.zeros(elems, dtype=np.float32)
+                    for i in range(keys)}
+            rev = 0
+        info = _sync(comm, arrs, revision=rev)
+        return (info.tx_bytes, info.rx_bytes, info.revision,
+                {k: v.tobytes() for k, v in arrs.items()},
+                comm.stats()["counters"])
+
+    results, errors = _run_peers(master.port, 4, worker)
+    assert not errors, errors
+    popular = results[0][3]
+    served_total = 0
+    for rank in range(4):
+        tx, rx, rev, content, c = results[rank]
+        assert rev == 1
+        assert content == popular, f"rank {rank} diverged"
+        served_total += c["ss_seeder_chunks_served"]
+        if rank == 0:
+            assert rx == 0 and c["ss_legacy_syncs"] == 0
+        else:
+            assert rx == nbytes
+            # the transport was the chunk plane, with exact conservation
+            assert c["ss_chunks_fetched"] + c["ss_chunks_resourced"] > 0
+            assert (c["ss_chunk_bytes_fetched"]
+                    + c["ss_chunk_bytes_resourced"]
+                    - c["ss_chunk_bytes_dup"]) == nbytes
+            assert c["ss_legacy_syncs"] == 0
+            # every adopter announced its completed keys for mid-round
+            # seeding (the promotion mechanism itself is exercised)
+            assert c["ss_seeder_promotions"] == keys
+    # all unique bytes came off SOMEONE's serve window
+    assert served_total * 131072 >= 3 * nbytes
+
+
+def test_seeder_death_failover(master, tmp_path):
+    """ISSUE-13 acceptance: SIGKILL a busy seeder mid-sync at world=8 —
+    every remaining peer still completes the round bit-identically, zero
+    aborts/kicks, per-chunk conservation exact. The victim self-SIGKILLs
+    the instant its served-chunk counter proves it is actively seeding
+    the in-flight round (no orchestrator timing games)."""
+    import json
+
+    world, keys, elems = 8, 8, 65536  # 8 x 256 KiB = 2 MiB state
+    nbytes = keys * elems * 4
+    chunk_env = {"PCCLT_SS_CHUNK_BYTES": "131072",
+                 "PCCLT_SS_FETCH_MIN_MS": "300"}
+    # seeders pace their egress (wildcard ip edge: one bucket per process,
+    # like a NIC) so the round is long enough that the kill is mid-round
+    seeder_env = dict(chunk_env, PCCLT_WIRE_MBPS_MAP="127.0.0.1=200")
+    procs = {}
+    for rank in range(world):
+        role = "seeder" if rank < 2 else "joiner"
+        procs[rank] = _spawn_ss_peer(
+            master.port, world, rank, role, tmp_path, keys, elems,
+            env_extra=seeder_env if role == "seeder" else chunk_env,
+            suicide_after_served=2 if rank == 1 else 0)
+    deadline = time.time() + 150
+    for rank, (p, _) in procs.items():
+        p.wait(timeout=max(1, deadline - time.time()))
+    assert procs[1][0].returncode == -9, "victim was not SIGKILLed"
+    # victim writes no result by design
+    assert not procs[1][1].exists()
+
+    import ss_peer as ssp
+    expected = ssp.digest_of(ssp.content_arrays(keys, elems, popular=True))
+    joiner_results = []
+    for rank, (p, rfile) in procs.items():
+        if rank == 1:
+            continue
+        assert p.returncode == 0, f"rank {rank} failed rc={p.returncode}"
+        res = json.loads(rfile.read_text())
+        # bit-identical convergence on the popular revision, zero aborts,
+        # zero kicks — the whole point of the chunk plane
+        assert res["revision"] == 1
+        assert res["digest"] == expected, f"rank {rank} diverged"
+        c = res["counters"]
+        assert c["syncs_ok"] == 1 and c["syncs_failed"] == 0
+        assert c["kicked"] == 0 and c["collectives_aborted"] == 0
+        if res["role"] == "joiner":
+            joiner_results.append(res)
+            assert res["rx_bytes"] == nbytes
+            # per-chunk conservation: fetched + re-sourced - dup == unique
+            assert (c["ss_chunk_bytes_fetched"] +
+                    c["ss_chunk_bytes_resourced"] -
+                    c["ss_chunk_bytes_dup"]) == nbytes
+    assert len(joiner_results) == 6
+    # at least one joiner observed the death and re-sourced around it
+    assert sum(r["counters"]["ss_seeders_lost"] for r in joiner_results) >= 1
+
+
+def test_chunk_blackhole_failover(master, monkeypatch):
+    """ISSUE-13 acceptance: a scripted blackhole on a sync edge recovers
+    via per-chunk failover INSIDE the round (re-source to another seeder),
+    not by failing it."""
+    from pccl_tpu.comm import netem_inject
+
+    monkeypatch.setenv("PCCLT_SS_CHUNK_BYTES", "65536")
+    monkeypatch.setenv("PCCLT_SS_FETCH_MIN_MS", "200")
+    monkeypatch.setenv("PCCLT_SS_FETCH_RANGE", "2")
+    keys, elems = 4, 32768  # 4 x 128 KiB
+    nbytes = keys * elems * 4
+    base = alloc_ports()
+    p2p = {r: base + 10 + 4 * r for r in range(3)}
+
+    def worker(comm, rank):
+        rng = np.random.default_rng(5)
+        if rank < 2:
+            arrs = {f"k{i}": rng.standard_normal(elems).astype(np.float32)
+                    for i in range(keys)}
+            rev = 1
+        else:
+            arrs = {f"k{i}": np.zeros(elems, dtype=np.float32)
+                    for i in range(keys)}
+            rev = 0
+            # blackhole the sync edge toward seeder rank 0 (its canonical
+            # p2p endpoint — the same key the collective chaos layer uses)
+            netem_inject(f"127.0.0.1:{p2p[0]}", "blackhole@t=0:1500ms")
+        info = _sync(comm, arrs, revision=rev)
+        return (info.rx_bytes, info.revision,
+                {k: float(v.sum()) for k, v in arrs.items()},
+                comm.stats()["counters"])
+
+    # fixed p2p ports so the injection key is known up front
+    from pccl_tpu.comm import Communicator
+    results, errors = {}, {}
+
+    def peer(rank):
+        comm = Communicator("127.0.0.1", master.port, p2p_port=p2p[rank],
+                            ss_port=base + 40 + 4 * rank,
+                            bench_port=base + 52 + 4 * rank)
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.global_world_size < 3:
+                if time.time() > deadline:
+                    raise TimeoutError("world never formed")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            results[rank] = worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors[rank] = e
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    rx, rev, digest, c = results[2]
+    assert rev == 1 and rx == nbytes
+    assert digest == results[0][2] == results[1][2]
+    # the round recovered BY re-sourcing chunks away from the blackholed
+    # edge — in-round failover, not a failed sync
+    assert c["syncs_ok"] >= 1 and c["syncs_failed"] == 0
+    assert c["ss_chunks_resourced"] >= 1
+    assert (c["ss_chunk_bytes_fetched"] + c["ss_chunk_bytes_resourced"]
+            - c["ss_chunk_bytes_dup"]) == nbytes
+
+
+@pytest.mark.slow
+def test_swarm_cold_joiners_beat_single_seeder(master, tmp_path):
+    """ISSUE-13 acceptance (test twin of the sync_swarm_speedup bench):
+    4 simultaneous cold joiners at world=8 complete sync measurably
+    faster on the chunk plane than on the forced single-seeder baseline."""
+    import json
+
+    keys, elems = 8, 262144  # 8 MiB state
+    pace = {"PCCLT_WIRE_MBPS_MAP": "127.0.0.1=250"}
+
+    def leg(tmp, chunked):
+        env = dict(pace)
+        env["PCCLT_SS_CHUNK_BYTES"] = "262144" if chunked else "0"
+        procs = {}
+        for rank in range(8):
+            role = "seeder" if rank < 4 else "joiner"
+            procs[rank] = _spawn_ss_peer(
+                master.port, 8, rank, role, tmp, keys, elems, env_extra=env)
+        for rank, (p, _) in procs.items():
+            p.wait(timeout=240)
+            assert p.returncode == 0, f"rank {rank} rc={p.returncode}"
+        walls = []
+        for rank, (_, rfile) in procs.items():
+            res = json.loads(rfile.read_text())
+            if res["role"] == "joiner":
+                walls.append(res["sync_wall_s"])
+        return max(walls)
+
+    d1 = tmp_path / "chunked"
+    d2 = tmp_path / "legacy"
+    d1.mkdir()
+    d2.mkdir()
+    chunked = leg(d1, chunked=True)
+    legacy = leg(d2, chunked=False)
+    assert legacy / chunked >= 1.5, (legacy, chunked)
 
 
 def test_device_hash_divergent_peer_syncs(master, monkeypatch):

@@ -52,6 +52,7 @@ MASTER_DISPATCH = {
     "kC2MBandwidthReport": "on_bandwidth_report",
     "kC2MOptimizeWorkDone": "on_optimize_work_done",
     "kC2MTelemetryDigest": "on_telemetry_digest",
+    "kC2MSyncKeyDone": "on_sync_key_done",
 }
 
 # kM2C ids the master machine can emit (master_state.cpp).
@@ -61,13 +62,19 @@ MASTER_DISPATCH = {
 # state space (like the data-plane watchdog, docs/11): MasterModel never
 # emits it and the client model never consumes it. Conformance still pins
 # the id to its emission site and the client's set_notify consumption.
+# kC2MSyncKeyDone / kM2CSeederUpdate (chunk plane, docs/04) are the same
+# class of out-of-model traffic: a promotion is data-plane routing advice
+# inside one sync round — no vote, no reply, no consensus state change
+# (on_sync_key_done mutates only the round's promotion dedupe set). The
+# model's on_sync_key_done is a no-op and the client model never consumes
+# the update; conformance pins both ids to their real sites.
 MASTER_EMITS = {
     "kM2CWelcome", "kM2CSessionResumeAck", "kM2CPeersPendingReply",
     "kM2CP2PConnInfo", "kM2CP2PEstablishedResp", "kM2CTopologyDeferred",
     "kM2CCollectiveCommence", "kM2CCollectiveAbort", "kM2CCollectiveDone",
     "kM2CSharedStateSyncResp", "kM2CSharedStateDone",
     "kM2COptimizeResponse", "kM2COptimizeComplete", "kM2CKicked",
-    "kM2CIncidentDump",
+    "kM2CIncidentDump", "kM2CSeederUpdate",
 }
 
 # kM2C ids the client session FSM consumes (client.cpp recv_match sites)
@@ -706,6 +713,20 @@ class MasterModel:
         # sockets.cpp), below the control-plane state machine this spec
         # mirrors. on_disconnect/remove_client invariants are unaffected:
         # relay frames ride existing p2p conns and die with them.
+        return []
+
+    def on_sync_key_done(self, uuid: str) -> "list[Packet]":
+        # chunk-plane seeder promotion (docs/04): fire-and-forget routing
+        # advice WITHIN one sync round. The real handler only inserts into
+        # the round's promotion dedupe set and broadcasts the (equally
+        # fire-and-forget) kM2CSeederUpdate; no vote, no reply, no
+        # revision/ring/membership state changes, and the dist-done
+        # barrier the model DOES explore is untouched — so the model
+        # consumes it as a no-op, like the telemetry digest above. A
+        # promoted seeder dying mid-round is also out of scope here: the
+        # fetch engine re-sources from remaining seeders in the data
+        # plane, and the member's disconnect rides the already-modeled
+        # on_disconnect path (dist-done barrier completion included).
         return []
 
     def on_disconnect(self, uuid: str) -> "list[Packet]":
