@@ -186,6 +186,17 @@ def main() -> None:
             print(f"bench: wan pipelined failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["wan_pipelined_speedup"] = None
+        # multipath striping on the SAME fat-long-pipe map (docs/08): the
+        # full pipelined plane with the op's window chain striped across 4
+        # pool conns sharing one striped-bucket edge, vs the same plane
+        # pinned to ONE conn (the PR-8 baseline) — same run, same host
+        try:
+            for k, v in native_bench.run_wan_striped_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: wan striped failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["wan_striped_speedup"] = None
         # master HA recovery: SIGKILL the journaled master mid-run, restart
         # on the same port; master_recovery_s = SIGKILL -> first
         # post-restart collective completing over resumed sessions

@@ -162,6 +162,10 @@ class CommStats(ctypes.Structure):
         ("ss_seeder_promotions", ctypes.c_uint64),
         ("ss_seeders_lost", ctypes.c_uint64),
         ("ss_legacy_syncs", ctypes.c_uint64),
+        # straggler-failover relay acks (docs/05): end-to-end delivery
+        # acks received back at the origin, and zombie sends retired early
+        ("relay_acks", ctypes.c_uint64),
+        ("relay_retired_early", ctypes.c_uint64),
     ]
 
 
@@ -190,6 +194,10 @@ class EdgeStats(ctypes.Structure):
         # shared-state chunk plane (docs/04): sync payload on this edge
         ("tx_sync_bytes", ctypes.c_uint64),
         ("rx_sync_bytes", ctypes.c_uint64),
+        # multipath striping (docs/08): windows/bytes the striped window
+        # scheduler round-robined across the conn pool
+        ("tx_stripe_windows", ctypes.c_uint64),
+        ("tx_stripe_bytes", ctypes.c_uint64),
     ]
 
 

@@ -755,6 +755,9 @@ class Communicator:
                     # shared-state chunk plane (docs/04)
                     "tx_sync_bytes": int(e.tx_sync_bytes),
                     "rx_sync_bytes": int(e.rx_sync_bytes),
+                    # multipath striping (docs/08)
+                    "tx_stripe_windows": int(e.tx_stripe_windows),
+                    "tx_stripe_bytes": int(e.tx_stripe_bytes),
                 }
         return {"counters": counters, "edges": edges}
 

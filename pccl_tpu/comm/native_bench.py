@@ -563,6 +563,71 @@ def run_wan_pipelined_bench(world: int = 4, nbytes: int = 16 << 20,
     return out
 
 
+def run_wan_striped_bench(world: int = 4, nbytes: int = 16 << 20,
+                          iters: int = 3, mbps: float = 1000.0,
+                          rtt_ms: float = 50.0, stripes: int = 4,
+                          cwnd_bytes: int = 3 << 19,
+                          mports: Tuple[int, int] = (48709, 48711),
+                          bases: Tuple[int, int] = (47400, 47800),
+                          ) -> Dict[str, float]:
+    """Multipath striping A/B on the exact fat-long-pipe map of
+    run_wan_pipelined_bench (same mbps × rtt × payload). BOTH legs run the
+    full pipelined data plane; the baseline pins every op's window chain
+    to ONE pool conn (PCCLT_STRIPE_CONNS=1 — PR 8's behavior and its
+    0.0945 busbw), the striped leg round-robins the windows across
+    ``stripes`` pool conns that share the one emulated edge bucket (the
+    striped per-lane token bucket, docs/08 "multipath striping").
+
+    Why striping wins when the bucket is honest about total bandwidth: a
+    single flow is one TX thread serially pacing+writing 256 KiB frames —
+    every scheduler oversleep between frames is modeled wire time nothing
+    else can reclaim. K stripes keep K reservations queued in the bucket,
+    so the wire stays busy across any one sender's scheduling jitter —
+    the same reason real WANs run parallel TCP flows on fat-long pipes
+    (one cwnd/seriality-limited flow cannot fill the pipe).
+
+    The plain pair keeps the r05-comparable physics (no per-flow window:
+    the emulated single flow is only seriality-limited, so the striping
+    win there is the scheduler-jitter absorption of the striped bucket).
+    The ``_cwnd_`` pair additionally models TCP's per-flow congestion
+    window (PCCLT_WIRE_CWND_BYTES = 1.5 MiB over the 50 ms RTT ≈ 30 MB/s
+    per flow — the cwnd-limited single flow the ROADMAP describes); BOTH
+    its legs run under the same cap, and striping multiplies flows exactly
+    the way parallel TCP does on a real fat-long pipe.
+
+    Keys: wan_striped_single_busbw_gbps (same-run pinned baseline),
+    wan_striped_busbw_gbps, wan_striped_speedup (striped / single), and
+    the wan_striped_cwnd_* triple."""
+    out: Dict[str, float] = {}
+    legs = [
+        ("wan_striped_single_busbw_gbps", 1, mports[0], bases[0], None),
+        ("wan_striped_busbw_gbps", stripes, mports[1], bases[1], None),
+        ("wan_striped_cwnd_single_busbw_gbps", 1, mports[0] + 4, bases[0],
+         str(cwnd_bytes)),
+        ("wan_striped_cwnd_busbw_gbps", stripes, mports[1] + 4, bases[1],
+         str(cwnd_bytes)),
+    ]
+    with _paced_wire(mbps), _rtt_wire(rtt_ms):
+        for name, sc, mport, base, cwnd in legs:
+            env = {"PCCLT_PIPELINE": "1", "PCCLT_STRIPE_CONNS": str(sc),
+                   "PCCLT_PIPELINE_WINDOW": "8"}
+            if cwnd is not None:
+                env["PCCLT_WIRE_CWND_BYTES"] = cwnd
+            res = _spawn_world(world, _peer_wan_rtt,
+                               _port("PCCLT_BENCH_MASTER_PORT_STRIPE", mport),
+                               (world, nbytes, iters, 1, base, env),
+                               inline_rank0=False)
+            times = next(r["times"] for r in res if r["rank"] == 0)
+            med = sorted(times)[len(times) // 2]
+            out[name] = (2 * (world - 1) / world) * nbytes / med / 1e9
+    out["wan_striped_speedup"] = (out["wan_striped_busbw_gbps"] /
+                                  out["wan_striped_single_busbw_gbps"])
+    out["wan_striped_cwnd_speedup"] = (
+        out["wan_striped_cwnd_busbw_gbps"] /
+        out["wan_striped_cwnd_single_busbw_gbps"])
+    return out
+
+
 def _peer_topo(rank, master_port, q, world, nbytes, iters, port_base, envs,
                gate_dir):
     """Peer for the topology-optimizer proof: joins in RANK ORDER (file

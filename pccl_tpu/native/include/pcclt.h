@@ -351,6 +351,11 @@ typedef struct pccltCommStats_t {
     uint64_t ss_seeder_promotions;     /* keys this peer completed + seeded */
     uint64_t ss_seeders_lost;          /* sources lost mid-fetch (survived) */
     uint64_t ss_legacy_syncs;          /* syncs on the 1-seeder fallback */
+    /* straggler-failover relay acks (docs/05): end-to-end delivery acks
+     * received back at the ORIGIN (kRelayAck), and CONFIRMED-stalled
+     * zombie sends retired early because an ack covered their span */
+    uint64_t relay_acks;
+    uint64_t relay_retired_early;
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {
@@ -380,6 +385,11 @@ typedef struct pccltEdgeStats_t {
      * data-plane byte counters and their conservation invariant */
     uint64_t tx_sync_bytes;
     uint64_t rx_sync_bytes;
+    /* multipath striping (docs/08): windows (and their payload bytes)
+     * the striped scheduler round-robined across the conn pool — a
+     * subset of tx_bytes/tx_frames, zero when PCCLT_STRIPE_CONNS <= 1 */
+    uint64_t tx_stripe_windows;
+    uint64_t tx_stripe_bytes;
 } pccltEdgeStats_t;
 
 /* Snapshot this communicator's counters. */

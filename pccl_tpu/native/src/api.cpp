@@ -424,6 +424,8 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     out->ss_seeder_promotions = ld(m.ss_seeder_promotions);
     out->ss_seeders_lost = ld(m.ss_seeders_lost);
     out->ss_legacy_syncs = ld(m.ss_legacy_syncs);
+    out->relay_acks = ld(m.relay_acks);
+    out->relay_retired_early = ld(m.relay_retired_early);
     return pccltSuccess;
 }
 
@@ -455,6 +457,8 @@ pccltResult_t pccltCommGetEdgeStats(pccltComm_t *c, pccltEdgeStats_t *out,
         o.dup_windows = e.dup_windows;
         o.tx_sync_bytes = e.tx_sync_bytes;
         o.rx_sync_bytes = e.rx_sync_bytes;
+        o.tx_stripe_windows = e.tx_stripe_windows;
+        o.tx_stripe_bytes = e.tx_stripe_bytes;
     }
     return pccltSuccess;
 }

@@ -836,8 +836,21 @@ Status Client::classify_master_loss() {
 
 // ---------------- topology / establishment ----------------
 
+size_t Client::pool_width() const {
+    // configured width, grown to what the striped data plane wants
+    // (PCCLT_STRIPE_CONNS, docs/08) so setting the env alone provisions
+    // enough parallel paths for the window scheduler; capped at 8.
+    size_t n = cfg_.pool_size ? cfg_.pool_size : 1;
+    if (const char *e = std::getenv("PCCLT_STRIPE_CONNS")) {
+        long v = atol(e);
+        if (v > 1) n = std::max(n, static_cast<size_t>(std::min<long>(v, 8)));
+    }
+    return n;
+}
+
 Status Client::establish_from_info(const proto::P2PConnInfo &info,
                                    std::vector<proto::Uuid> &failed) {
+    const size_t width = pool_width();
     for (const auto &ep : info.peers) {
         // take the old pool + shared table under the lock, then do all the
         // blocking connect/handshake work OUTSIDE state_mu_ so attribute
@@ -854,7 +867,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
             // reconnects: it comes back under a fresh UUID (or, post-resume,
             // with its old conns dead).
             bool reusable = pc.ep.ip == ep.ip && pc.ep.p2p_port == ep.p2p_port &&
-                            pc.tx.size() == cfg_.pool_size && !pc.tx.empty();
+                            pc.tx.size() == width && !pc.tx.empty();
             if (reusable)
                 for (const auto &c : pc.tx)
                     if (!c || !c->alive()) reusable = false;
@@ -877,7 +890,7 @@ Status Client::establish_from_info(const proto::P2PConnInfo &info,
 
         std::vector<std::shared_ptr<net::MultiplexConn>> pool;
         bool ok = true;
-        for (size_t i = 0; i < cfg_.pool_size; ++i) {
+        for (size_t i = 0; i < width; ++i) {
             // dial_p2p retries transient connect/handshake failures on a
             // bounded backoff (p2p reconnect hardening) and installs the
             // straggler-relay routing before the conn runs
@@ -1252,13 +1265,17 @@ void Client::install_relay_handlers(
         },
         // FINAL destination: the window belongs to the ORIGIN peer's
         // inbound link — place it into that link's sink table (dedupe +
-        // conservation accounting charge the origin's edge)
+        // conservation accounting charge the origin's edge), then ack
+        // delivery END-TO-END so the origin can retire its stalled direct
+        // copy early (kRelayAck rides our own reverse link to the origin,
+        // which is a different direction from the degraded hop)
         [this](const uint8_t *origin, uint64_t tag, uint64_t off,
                std::vector<uint8_t> bytes) {
             proto::Uuid o;
             memcpy(o.data(), origin, 16);
             std::shared_ptr<net::SinkTable> table;
             telemetry::EdgeCounters *edge = nullptr;
+            std::shared_ptr<net::MultiplexConn> ack_out;
             {
                 MutexLock lk(state_mu_);
                 auto it = peers_.find(o);
@@ -1267,6 +1284,11 @@ void Client::install_relay_handlers(
                     net::Addr pa = it->second.ep.ip;
                     pa.port = it->second.ep.p2p_port;
                     edge = &tele_->edge(pa.str());
+                    for (const auto &c : it->second.tx)
+                        if (c && c->alive()) {
+                            ack_out = c;
+                            break;
+                        }
                 }
             }
             if (!table) {
@@ -1274,8 +1296,64 @@ void Client::install_relay_handlers(
                                 "window tag=" << tag;
                 return;
             }
+            const uint64_t len = bytes.size();
             table->deliver_window(tag, off, std::move(bytes), edge);
+            if (ack_out) {
+                // fire-and-forget (enqueue-only: we are on an RX thread);
+                // the ack covers the RANGE — whether this copy or an
+                // earlier one placed the bytes, [off, off+len) is complete
+                wire::Writer w;
+                w.u64(len);
+                ack_out->send_owned(net::MultiplexConn::kRelayAck, tag, off,
+                                    w.take());
+            }
+        },
+        // ORIGIN side: merge the acked range so drain_zombies can query it
+        [this](uint64_t tag, uint64_t off, uint64_t len) {
+            note_relay_ack(tag, off, len);
         });
+}
+
+void Client::note_relay_ack(uint64_t tag, uint64_t off, uint64_t len) {
+    if (len == 0) return;
+    MutexLock lk(relay_mu_);
+    // bounded: tags are op-scoped and monotone, so evicting the lowest tag
+    // range when full can only drop stale ops' acks
+    if (relay_acks_.size() > 64 && !relay_acks_.count(tag))
+        relay_acks_.erase(relay_acks_.begin());
+    auto &m = relay_acks_[tag];
+    uint64_t lo = off, hi = off + len;
+    auto it = m.upper_bound(lo);
+    if (it != m.begin()) {
+        auto p = std::prev(it);
+        if (p->second >= lo) {
+            lo = p->first;
+            hi = std::max(hi, p->second);
+            it = m.erase(p);
+        }
+    }
+    while (it != m.end() && it->first <= hi) {
+        hi = std::max(hi, it->second);
+        it = m.erase(it);
+    }
+    m[lo] = hi;
+    tele_->comm.relay_acks.fetch_add(1, std::memory_order_relaxed);
+}
+
+bool Client::relay_ack_covered(uint64_t tag, uint64_t off, size_t len) {
+    MutexLock lk(relay_mu_);
+    auto t = relay_acks_.find(tag);
+    if (t == relay_acks_.end()) return false;
+    auto it = t->second.upper_bound(off);
+    if (it == t->second.begin()) return false;
+    return std::prev(it)->second >= off + len;
+}
+
+void Client::purge_relay_acks(uint64_t lo, uint64_t hi) {
+    MutexLock lk(relay_mu_);
+    for (auto it = relay_acks_.lower_bound(lo);
+         it != relay_acks_.end() && it->first < hi;)
+        it = relay_acks_.erase(it);
 }
 
 std::shared_ptr<net::MultiplexConn> Client::dial_p2p(
@@ -1369,7 +1447,12 @@ net::Link Client::fresh_pool_conn(const proto::Uuid &peer) {
 
 bool Client::relay_window_via(const proto::Uuid &dst, uint64_t tag,
                               uint64_t off, std::span<const uint8_t> payload) {
-    std::shared_ptr<net::MultiplexConn> via;
+    // relay-path load balancing (docs/05): collect EVERY healthy third
+    // peer and rotate successive windows across them — one funnel neighbor
+    // caps detour throughput at a single relay's egress, striping detours
+    // multiplies it. PCCLT_RELAY_FANOUT caps the candidate set (in ring
+    // order): 1 = the PR-10 single-neighbor behavior.
+    std::vector<std::shared_ptr<net::MultiplexConn>> candidates;
     {
         MutexLock lk(state_mu_);
         for (const auto &u : ring_) {
@@ -1378,13 +1461,16 @@ bool Client::relay_window_via(const proto::Uuid &dst, uint64_t tag,
             if (it == peers_.end()) continue;
             for (const auto &c : it->second.tx)
                 if (c && c->alive()) {
-                    via = c;
+                    candidates.push_back(c);
                     break;
                 }
-            if (via) break;
         }
     }
-    if (!via) return false;
+    if (candidates.empty()) return false;
+    size_t fan = static_cast<size_t>(env_int("PCCLT_RELAY_FANOUT", 0));
+    if (fan == 0 || fan > candidates.size()) fan = candidates.size();
+    auto via = candidates[relay_rr_.fetch_add(1, std::memory_order_relaxed) %
+                          fan];
     std::vector<uint8_t> buf(32 + payload.size());
     memcpy(buf.data(), dst.data(), 16);
     memcpy(buf.data() + 16, uuid_.data(), 16);
@@ -1516,6 +1602,35 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         } catch (...) { return false; }
     };
     if (session_flipped()) return Status::kConnectionLost;
+    // ---- pre-arm (docs/08): local op setup overlapped with the master
+    // consensus round trip. The in-place snapshot memcpy (the largest
+    // fixed local cost after PR 8's buffer pooling) and the optimistic
+    // ring/link resolution run WHILE the commence wait is in flight, so
+    // op_setup afterwards is a re-validation, not work. The snapshot does
+    // not depend on the commence at all (the caller owns the buffer for
+    // the op's whole lifetime); the links are re-checked against the
+    // post-commence ring and redone on the rare mid-wait reshuffle.
+    const size_t nbytes = count * proto::dtype_size(dtype);
+    std::vector<uint8_t> snapshot;
+    if (send == recv) {
+        snapshot = take_scratch();
+        if (snapshot.capacity() < nbytes) snapshot = std::vector<uint8_t>();
+        snapshot.resize(nbytes);
+        memcpy(snapshot.data(), recv, nbytes);
+    }
+    std::vector<proto::Uuid> ring0;
+    {
+        MutexLock lk(state_mu_);
+        ring0 = ring_;
+    }
+    net::Link pre_tx, pre_rx;
+    auto self0 = std::find(ring0.begin(), ring0.end(), uuid_);
+    if (self0 != ring0.end() && ring0.size() >= 2) {
+        uint32_t r0 = static_cast<uint32_t>(self0 - ring0.begin());
+        uint32_t w0 = static_cast<uint32_t>(ring0.size());
+        pre_tx = tx_link(ring0[(r0 + 1) % w0]);
+        pre_rx = rx_link(ring0[(r0 + w0 - 1) % w0], 0);  // no wait: optimistic
+    }
     // Wait for commence OR an abort verdict. An abort BEFORE any commence
     // is a restarted master replaying the outcome of an op that completed
     // under its previous incarnation (our Done was lost in the crash, the
@@ -1543,11 +1658,15 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
                                              commence_t0, commence_t1, "tag",
                                              desc.tag, "seq", seq_v);
     };
+    // pre-armed snapshot on paths that never reach the ring: back to the
+    // pool (warm pages), not the allocator
+    auto drop_prearm = [&] { give_scratch(std::move(snapshot)); };
     if (!commence) {
         // master loss / 600 s timeout: NOT a consensus-wait sample — one
         // overflow-bucket entry would pin the cumulative commence_wait
         // p99 gauge to ~137 s for the rest of the process lifetime
         commence_span(0);
+        drop_prearm();
         return classify_master_loss();
     }
     // attribution histogram: the consensus wait is a first-class phase —
@@ -1569,6 +1688,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         } catch (...) {}
         auto done =
             master_.recv_match(PacketType::kM2CCollectiveDone, tag_pred, 600'000);
+        drop_prearm();
         if (!done) return classify_master_loss();
         // kOk: our ring ran to completion back then — the retry's recv
         // buffer (same args per the retry contract, and uniquely for this
@@ -1579,6 +1699,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
     }
     if (session_flipped()) {
         commence_span(0);
+        drop_prearm();
         return Status::kConnectionLost;
     }
     uint64_t seq;
@@ -1588,6 +1709,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         seq = r.u64();
     } catch (...) {
         commence_span(0);
+        drop_prearm();
         return Status::kInternal;
     }
     *observed_seq = seq; // the incarnation a session-loss retry refers to
@@ -1615,6 +1737,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         wire::Writer w;
         w.u64(desc.tag);
         w.u8(1);
+        drop_prearm();
         if (!master_.send(PacketType::kC2MCollectiveComplete, w.data()))
             return classify_master_loss();
         auto verdict =
@@ -1648,33 +1771,32 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         fprintf(stderr, "[op %llu] commenced seq=%llu\n",
                 (unsigned long long)desc.tag, (unsigned long long)seq);
     Status st = Status::kOk;
-    // snapshot the in-place input here (not just inside the ring) so a
-    // post-hoc abort verdict can also restore it — all ranks must retry a
-    // failed collective from identical inputs
-    const size_t nbytes = count * proto::dtype_size(dtype);
+    // The in-place snapshot (abort restore source: all ranks must retry a
+    // failed collective from identical inputs) and the optimistic links
+    // were PRE-ARMED before the commence wait — op_setup here only
+    // re-validates them against the post-commence ring, so the memcpy and
+    // the pool lookups are off the critical path entirely.
     uint64_t links_t0 = telemetry::now_ns();
-    std::vector<uint8_t> snapshot;
-    if (send == recv) {
-        // pooled like the RX scratch: a FRESH params-sized vector here costs
-        // a zero-fill plus a page-fault storm per op (~tens of ms at WAN
-        // sizes on a loaded host) before the first byte can leave the wire —
-        // the pipelined data plane made this the largest fixed op cost
-        snapshot = take_scratch();
-        if (snapshot.capacity() < nbytes) snapshot = std::vector<uint8_t>();
-        snapshot.resize(nbytes);
-        memcpy(snapshot.data(), recv, nbytes);
+    net::Link tx, rx;
+    if (ring == ring0) {
+        // a pool rebuild mid-wait leaves a pre-armed link pointing at
+        // closed conns — fall through to a fresh lookup in that case
+        if (pre_tx.valid() && pre_tx.alive()) tx = pre_tx;
+        if (pre_rx.valid() && pre_rx.alive()) rx = pre_rx;
     }
-    auto tx = tx_link(next);
+    if (!tx.valid()) tx = tx_link(next);
     // wait for the inbound link in short slices so an abort that already
     // landed (our prev died before establishing) fails the op immediately
     // instead of sitting out the whole mesh-formation timeout
-    net::Link rx;
-    for (auto rx_deadline = std::chrono::steady_clock::now() +
-                            std::chrono::seconds(10);;) {
-        rx = rx_link(prev, 250);
-        if (rx.valid() || std::chrono::steady_clock::now() >= rx_deadline) break;
-        if (op->abort.load() || consume_abort(true)) break;
-    }
+    if (!rx.valid())
+        for (auto rx_deadline = std::chrono::steady_clock::now() +
+                                std::chrono::seconds(10);;) {
+            rx = rx_link(prev, 250);
+            if (rx.valid() ||
+                std::chrono::steady_clock::now() >= rx_deadline)
+                break;
+            if (op->abort.load() || consume_abort(true)) break;
+        }
     const uint64_t links_t1 = telemetry::now_ns();
     tele_->record_phase(telemetry::Phase::kOpSetup, links_t1 - links_t0);
     if (telemetry::Recorder::inst().on())
@@ -1744,11 +1866,18 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
                 env_int("PCCLT_WATCHDOG_HOLD_MS", 5000)) * 1'000'000ull;
             proto::Uuid succ = next;
             ctx.fresh_tx_conn = [this, succ] { return fresh_pool_conn(succ); };
-            if (world >= 3)
+            if (world >= 3) {
                 ctx.relay_window = [this, succ](uint64_t tag, uint64_t off,
                                                 std::span<const uint8_t> p) {
                     return relay_window_via(succ, tag, off, p);
                 };
+                // end-to-end delivery acks let drain_zombies retire
+                // CONFIRMED-stalled direct copies early (docs/05)
+                ctx.relay_acked = [this](uint64_t tag, uint64_t off,
+                                         size_t len) {
+                    return relay_ack_covered(tag, off, len);
+                };
+            }
         }
         auto scratch = take_scratch();
         ctx.scratch = &scratch;
@@ -1784,6 +1913,8 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
             res = reduce::ring_allreduce(ctx, send, recv, count);
         }
         give_scratch(std::move(scratch));
+        // relay delivery acks are op-scoped (tag ranges are never reused)
+        purge_relay_acks(seq << 16, (seq << 16) + 0x10000);
         op->info.tx_bytes = ctx.tx_bytes;
         op->info.rx_bytes = ctx.rx_bytes;
         op->info.world = world;

@@ -252,6 +252,10 @@ private:
                            const std::vector<SharedStateEntry> &entries,
                            hash::Type ht, uint64_t *rx_bytes);
 
+    // p2p pool width per peer: cfg_.pool_size grown to PCCLT_STRIPE_CONNS
+    // (docs/08 multipath striping), capped at 8
+    size_t pool_width() const;
+
     net::Link tx_link(const proto::Uuid &peer);
     // waits until at least one inbound conn from `peer` is up
     net::Link rx_link(const proto::Uuid &peer, int timeout_ms);
@@ -272,11 +276,22 @@ private:
     // failover rung 1: one extra pool conn to `peer`, appended to its pool
     // (heals the pool for later ops); Link holds ONLY the new conn
     net::Link fresh_pool_conn(const proto::Uuid &peer);
-    // failover rung 2: detour a window toward `dst` through any healthy
-    // third ring peer; waits out the first (local) hop so a false return
-    // lets the caller fall back to the direct path
+    // failover rung 2: detour a window toward `dst` through a healthy
+    // third ring peer — successive windows ROTATE across all healthy
+    // candidates (PCCLT_RELAY_FANOUT caps the set; 1 = the PR-10
+    // single-neighbor funnel), the same round-robin the striped window
+    // scheduler uses. Waits out the first (local) hop so a false return
+    // lets the caller fall back to the direct path.
     bool relay_window_via(const proto::Uuid &dst, uint64_t tag, uint64_t off,
                           std::span<const uint8_t> payload);
+    // end-to-end relay delivery acks (docs/05): the deliver handler sends
+    // kRelayAck back to the ORIGIN over this peer's own reverse link; the
+    // origin merges covered byte ranges here so drain_zombies can retire
+    // CONFIRMED-stalled direct copies early instead of parking them to op
+    // end. Tag-keyed merged intervals, purged per op.
+    void note_relay_ack(uint64_t tag, uint64_t off, uint64_t len);
+    bool relay_ack_covered(uint64_t tag, uint64_t off, size_t len);
+    void purge_relay_acks(uint64_t lo, uint64_t hi);
 
     // Telemetry push loop (fleet observability plane, docs/09): every
     // `push_ms` fold the Domain counters into a DigestSnapshotter digest
@@ -337,6 +352,14 @@ private:
     std::map<proto::Uuid, PeerConns> peers_ PCCLT_GUARDED_BY(state_mu_);
     std::vector<proto::Uuid> ring_ PCCLT_GUARDED_BY(state_mu_);
     uint64_t topo_revision_ PCCLT_GUARDED_BY(state_mu_) = 0;
+
+    // relay ack ranges (leaf: RX threads write, op threads read) + the
+    // fanout rotation counter for striped detours
+    Mutex relay_mu_; // lock-rank: 23
+    // tag -> {off -> end}, overlapping acks merged
+    std::map<uint64_t, std::map<uint64_t, uint64_t>> relay_acks_
+        PCCLT_GUARDED_BY(relay_mu_);
+    std::atomic<uint64_t> relay_rr_{0};
 
     Mutex ops_mu_; // lock-rank: 22
     std::map<uint64_t, std::unique_ptr<AsyncOp>> ops_ PCCLT_GUARDED_BY(ops_mu_);

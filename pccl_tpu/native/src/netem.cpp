@@ -229,6 +229,12 @@ ChaosVerdict Edge::chaos_eval(uint64_t now_ns) {
 void Edge::configure(const EdgeParams &p) {
     ns_per_byte_.store(p.mbps > 0 ? 8000.0 / p.mbps : 0.0,
                        std::memory_order_relaxed);
+    // per-flow cwnd cap: a lane drains at most cwnd/rtt bytes/s (needs a
+    // modeled rtt — on a zero-latency wire TCP's window never binds)
+    cwnd_npb_.store(p.cwnd_bytes > 0 && p.rtt_ms > 0
+                        ? (p.rtt_ms * 1e6) / p.cwnd_bytes
+                        : 0.0,
+                    std::memory_order_relaxed);
     owd_ns_.store(p.rtt_ms > 0 ? static_cast<uint64_t>(p.rtt_ms * 0.5e6) : 0,
                   std::memory_order_relaxed);
     jitter_ns_.store(
@@ -244,24 +250,47 @@ EdgeParams Edge::params() const {
     p.mbps = npb > 0 ? 8000.0 / npb : 0.0;
     p.rtt_ms = static_cast<double>(owd_ns_.load(std::memory_order_relaxed)) /
                0.5e6;
+    double cn = cwnd_npb_.load(std::memory_order_relaxed);
+    p.cwnd_bytes = cn > 0 && p.rtt_ms > 0 ? (p.rtt_ms * 1e6) / cn : 0.0;
     p.jitter_ms =
         static_cast<double>(jitter_ns_.load(std::memory_order_relaxed)) / 1e6;
     p.drop = drop_.load(std::memory_order_relaxed);
     return p;
 }
 
-void Edge::pace(size_t bytes) {
+uint32_t Edge::alloc_lane() {
+    MutexLock lk(mu_);
+    for (size_t l = 1; l < lane_used_.size(); ++l)
+        if (!lane_used_[l]) {
+            lane_used_[l] = 1;
+            lane_next_[l] = 0;
+            return static_cast<uint32_t>(l);
+        }
+    lane_used_.push_back(1);
+    lane_next_.push_back(0);
+    return static_cast<uint32_t>(lane_used_.size() - 1);
+}
+
+void Edge::release_lane(uint32_t lane) {
+    MutexLock lk(mu_);
+    if (lane > 0 && lane < lane_used_.size()) lane_used_[lane] = 0;
+}
+
+void Edge::pace(size_t bytes, uint32_t lane) {
     double npb = ns_per_byte_.load(std::memory_order_relaxed);
+    const double cwnd_npb = cwnd_npb_.load(std::memory_order_relaxed);
     const bool armed = chaos_armed_.load(std::memory_order_relaxed);
-    if (npb <= 0 && !armed) return;
+    if (npb <= 0 && cwnd_npb <= 0 && !armed) return;
     uint64_t end;
     {
         MutexLock lk(mu_);
         uint64_t now = mono_ns();
-        // reserve the transmission slot [start, end) and sleep until the
-        // frame has fully drained — a sender cannot complete a send faster
-        // than the wire carries it (no burst credit: next never lags now)
-        uint64_t start = std::max(next_ns_, now);
+        if (lane >= lane_next_.size() || !lane_used_[lane]) lane = 0;
+        // reserve the transmission slot [start, end) in THIS lane's
+        // sub-schedule and sleep until the frame has fully drained — a
+        // sender cannot complete a send faster than the wire carries it
+        // (no burst credit: a lane's next never lags now)
+        uint64_t start = std::max(lane_next_[lane], now);
         if (armed) {
             // chaos verdict at reservation time: an outage pushes the slot
             // past the outage window; a degrade caps the drain rate
@@ -269,8 +298,21 @@ void Edge::pace(size_t bytes) {
             if (cv.outage) start = std::max(start, cv.outage_end_ns);
             if (cv.mbps_override > 0) npb = 8000.0 / cv.mbps_override;
         }
-        end = start + static_cast<uint64_t>(static_cast<double>(bytes) * npb);
-        next_ns_ = end;
+        // fair share: lanes still draining a prior reservation at `now`
+        // split the modeled rate evenly with this one. Idle lanes count
+        // zero — the work-conserving reclaim — so a single backlogged
+        // lane drains at the full modeled rate (the exact pre-striping
+        // behavior), K backlogged lanes sum to it.
+        uint32_t active = 1;
+        for (size_t l = 0; l < lane_next_.size(); ++l)
+            if (l != lane && lane_used_[l] && lane_next_[l] > now) ++active;
+        // per-flow cwnd cap (fat-long-pipe physics): one lane never drains
+        // faster than cwnd/rtt even with the whole edge to itself — the
+        // reason parallel flows (stripes) exist on real high-BDP links
+        double lane_npb = std::max(npb * active, cwnd_npb);
+        end = start +
+              static_cast<uint64_t>(static_cast<double>(bytes) * lane_npb);
+        lane_next_[lane] = end;
     }
     // small frames (ctl, quant metadata) charge the bucket but may run a
     // bounded window ahead of the wire: a real qdisc interleaves a sub-MTU
@@ -452,11 +494,14 @@ void Registry::refresh() {
                         "PCCLT_WIRE_JITTER_MS_MAP");
     drop_ = parse_map(std::getenv("PCCLT_WIRE_DROP_MAP"),
                       "PCCLT_WIRE_DROP_MAP");
+    cwnd_ = parse_map(std::getenv("PCCLT_WIRE_CWND_MAP"),
+                      "PCCLT_WIRE_CWND_MAP");
     chaos_specs_ = parse_chaos_map(std::getenv("PCCLT_WIRE_CHAOS_MAP"));
     global_.mbps = env_f("PCCLT_WIRE_MBPS");
     global_.rtt_ms = env_f("PCCLT_WIRE_RTT_MS");
     global_.jitter_ms = 0;
     global_.drop = 0;
+    global_.cwnd_bytes = env_f("PCCLT_WIRE_CWND_BYTES");
     if (!default_) default_ = std::make_shared<Edge>();
     default_->configure(global_);
     // retune live edges in place: conns keep their shared_ptr (and their
@@ -493,6 +538,7 @@ EdgeParams Registry::params_for(const std::string &exact_key,
     p.rtt_ms = field(rtt_, global_.rtt_ms);
     p.jitter_ms = field(jitter_, global_.jitter_ms);
     p.drop = field(drop_, global_.drop);
+    p.cwnd_bytes = field(cwnd_, global_.cwnd_bytes);
     return p;
 }
 
@@ -505,10 +551,11 @@ std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
     // inherit the caller's lock set under -Wthread-safety
     std::string match;
     if (mbps_.count(exact) || rtt_.count(exact) || jitter_.count(exact) ||
-        drop_.count(exact) || chaos_specs_.count(exact)) {
+        drop_.count(exact) || cwnd_.count(exact) ||
+        chaos_specs_.count(exact)) {
         match = exact;  // per-endpoint bucket
     } else if (mbps_.count(ip) || rtt_.count(ip) || jitter_.count(ip) ||
-               drop_.count(ip) || chaos_specs_.count(ip)) {
+               drop_.count(ip) || cwnd_.count(ip) || chaos_specs_.count(ip)) {
         match = ip;  // per-host bucket, shared by every port on that ip
     } else if (edges_.count(exact)) {
         match = exact;  // injected per-endpoint edge (pccltNetemInject)

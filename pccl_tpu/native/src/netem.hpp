@@ -13,6 +13,10 @@
 //   PCCLT_WIRE_RTT_MS_MAP=ip:port=ms,...            round-trip time
 //   PCCLT_WIRE_JITTER_MS_MAP=ip:port=ms,...         uniform extra delay
 //   PCCLT_WIRE_DROP_MAP=ip:port=p,...               frame-loss probability
+//   PCCLT_WIRE_CWND_MAP=ip:port=bytes,...           per-FLOW cwnd cap
+//     (global twin PCCLT_WIRE_CWND_BYTES; needs a modeled rtt): one flow
+//     moves at most cwnd/rtt bytes/s even on an idle edge — the reason a
+//     single TCP flow cannot fill a high-BDP pipe and striping exists
 //
 // Key resolution is exact "ip:port" first, then bare-"ip" wildcard, then
 // the process-global PCCLT_WIRE_MBPS / PCCLT_WIRE_RTT_MS vars — which thus
@@ -29,6 +33,19 @@
 // called per conn construction and updates parameters of existing Edge
 // objects in place, so a process can re-point the env between connections
 // (bench legs, tests) without restarting — and without splitting buckets.
+//
+// STRIPED bucket (docs/08 "multipath striping"): the one bucket is divided
+// into per-sender LANES. Each concurrent sender (pool conn) registers a
+// lane via alloc_lane(); a frame on lane L reserves a slot in L's own
+// sub-schedule and drains at R / K, where K is the number of lanes
+// backlogged at reservation time — so K conns on one edge sum to the
+// modeled rate (never exceed it), idle lanes are reclaimed the moment they
+// go quiet (work conserving), and no lane head-of-line-blocks another's
+// pacing slots the way the old single-reservation queue did. Chaos
+// schedules, watchdog deadlines and byte metering still see the ONE
+// canonical edge: an outage pushes every lane's next slot past the window,
+// a degrade rescales every lane's drain rate. Lane 0 is the shared default
+// for callers that never registered (shared-state serves, bench probes).
 //
 // Drop emulation is TCP-honest: PCCP frames ride TCP, which never loses
 // frames, so a "dropped" frame is delivered late by a retransmit penalty
@@ -57,6 +74,12 @@ struct EdgeParams {
     double rtt_ms = 0;     // round-trip time; delivery delays by rtt/2
     double jitter_ms = 0;  // uniform extra delivery delay in [0, jitter)
     double drop = 0;       // P(frame "lost") -> delivered late by ~RTO
+    // per-FLOW congestion-window cap in bytes: one flow (pacing lane) can
+    // carry at most cwnd/rtt bytes/s even when the edge has headroom —
+    // the fat-long-pipe physics that makes a single TCP flow unable to
+    // fill a high-BDP link and parallel flows the standard fix. 0 (or no
+    // modeled rtt) = off. PCCLT_WIRE_CWND_BYTES / PCCLT_WIRE_CWND_MAP.
+    double cwnd_bytes = 0;
 };
 
 // ---- chaos layer: time-scripted fault schedules (docs/05) ----
@@ -119,6 +142,7 @@ public:
 
     bool pace_enabled() const {
         return ns_per_byte_.load(std::memory_order_relaxed) > 0 ||
+               cwnd_npb_.load(std::memory_order_relaxed) > 0 ||
                chaos_armed_.load(std::memory_order_relaxed);
     }
     bool delay_enabled() const {
@@ -137,11 +161,19 @@ public:
     // the schedule's verdict at mono time `now_ns` (0 = current time)
     ChaosVerdict chaos_at(uint64_t now_ns = 0);
 
-    // Reserve [next, next+bytes*ns_per_byte) in the edge's bucket and
-    // sleep until the frame has fully drained. Small frames (<= 4 KiB)
-    // charge the bucket but may run a bounded window ahead of the wire —
-    // the same qdisc-interleaving allowance the old global pacer had.
-    void pace(size_t bytes);
+    // Reserve [next, next+bytes*ns_per_byte*K) in `lane`'s sub-schedule of
+    // the edge's bucket (K = lanes backlogged at reservation — the fair
+    // share) and sleep until the frame has fully drained. Small frames
+    // (<= 4 KiB) charge the bucket but may run a bounded window ahead of
+    // the wire — the same qdisc-interleaving allowance the old global
+    // pacer had. With one lane active the reservation degenerates to the
+    // exact pre-striping single-bucket behavior.
+    void pace(size_t bytes, uint32_t lane = 0);
+
+    // Register / retire a pacing lane (one per pool conn). Lane 0 is
+    // never handed out: it is the shared default for unregistered callers.
+    uint32_t alloc_lane();
+    void release_lane(uint32_t lane);
 
     // Per-frame delivery delay: owd (rtt/2) + U[0, jitter) + the
     // retransmit penalty when the frame rolls a "loss". 0 = deliver now.
@@ -152,14 +184,20 @@ private:
     ChaosVerdict chaos_eval(uint64_t now_ns) PCCLT_REQUIRES(mu_);
 
     std::atomic<double> ns_per_byte_{0};
+    // per-flow cwnd cap as ns/byte (rtt / cwnd_bytes); 0 = off
+    std::atomic<double> cwnd_npb_{0};
     std::atomic<uint64_t> owd_ns_{0};
     std::atomic<uint64_t> jitter_ns_{0};
     std::atomic<double> drop_{0};
     std::atomic<bool> chaos_armed_{false};
 
     Mutex mu_;  // bucket + rng + chaos script; lock-rank: 62
-    // bucket: end of the last reserved slot
-    uint64_t next_ns_ PCCLT_GUARDED_BY(mu_) = 0;
+    // striped bucket: end of the last reserved slot PER LANE. Lane 0 (the
+    // unregistered-caller default) always exists; lane_used_ marks live
+    // registrations so released lanes stop counting toward the fair share
+    // and their slots are reclaimed by the next alloc.
+    std::vector<uint64_t> lane_next_ PCCLT_GUARDED_BY(mu_) = {0};
+    std::vector<uint8_t> lane_used_ PCCLT_GUARDED_BY(mu_) = {1};
     // splitmix64 state (jitter/drop)
     uint64_t rng_ PCCLT_GUARDED_BY(mu_) = 0x9E3779B97F4A7C15ull;
     // chaos script: armed fault list + arm time; fired_ marks fault
@@ -232,7 +270,7 @@ private:
     std::map<std::string, Entry> edges_ PCCLT_GUARDED_BY(mu_);
     std::map<std::string, double> mbps_ PCCLT_GUARDED_BY(mu_),
         rtt_ PCCLT_GUARDED_BY(mu_), jitter_ PCCLT_GUARDED_BY(mu_),
-        drop_ PCCLT_GUARDED_BY(mu_);
+        drop_ PCCLT_GUARDED_BY(mu_), cwnd_ PCCLT_GUARDED_BY(mu_);
     EdgeParams global_ PCCLT_GUARDED_BY(mu_);
     // PCCLT_WIRE_CHAOS_MAP schedules by key. A key arms ONCE per process
     // (first resolve that matches it): refresh() re-reads the env but an

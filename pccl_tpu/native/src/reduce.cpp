@@ -68,22 +68,204 @@ size_t pipeline_windows(size_t bytes) {
     return std::max<size_t>(1, w);
 }
 
+struct ChunkSpan {
+    size_t start_elem, n_elems;
+};
+
+ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
+    size_t base = count / world, rem = count % world;
+    size_t start = c * base + std::min<size_t>(c, rem);
+    size_t len = base + (c < rem ? 1 : 0);
+    return {start, len};
+}
+
+// ---- multipath striping (docs/08 "multipath striping") ----
+// How many pool conns an op's window chain round-robins across:
+// PCCLT_STRIPE_CONNS, default min(4, pool size); 1 = PR-8's pinned
+// single-conn behavior. A single TCP flow over a fat-long-pipe is
+// serialization-limited (one TX thread paces/writes frame by frame, and
+// every scheduler oversleep is wire time lost); K stripes keep K
+// reservations queued in the edge's striped bucket, so the modeled wire
+// never idles while one sender thread is between frames. Cross-conn
+// reassembly is the SinkTable's ordinary byte-range extent/claim
+// bookkeeping — arrival order across stripes does not matter — and the
+// PR-10 watchdog ladder applies per stripe (each window is its own
+// tracked handle).
+size_t stripe_conns(size_t pool) {
+    size_t s = env_size("PCCLT_STRIPE_CONNS", 0);
+    if (s == 0) s = 4;  // unset (or explicit 0): the default policy
+    return std::max<size_t>(1, std::min(s, pool));
+}
+
+// ---- per-window quantization meta (docs/08, PCCLT_QWIN_META=1) ----
+// Legacy wire format: ONE whole-chunk meta frame at offset 0 of
+// tag|kMetaBit, computed before the first window can leave — the reason
+// the quantized ring barriers at stage tops. The per-window protocol
+// sends window w's meta as its own small frame at offset w+1, payload
+// [u8 version=1][u8 qw][Meta::encode()], so stage s+1's quantized windows
+// launch from inside stage s's accumulation callback exactly like the
+// fp32 send-ahead. The offset keying makes the format self-describing
+// (receivers never guess the sender's window grid — qw rides every
+// frame), version-gated for forward evolution, and numerics are
+// bit-identical at equal meta: quantize/dequantize are untouched, only
+// WHICH meta covers which elements changes. Off by default: per-window
+// metas change quantized results vs the whole-chunk grid (all ranks agree
+// either way), so the mode is an explicit, group-consistent opt-in.
+bool qwin_enabled() {
+    const char *e = std::getenv("PCCLT_QWIN_META");
+    return e && e[0] == '1';
+}
+
+std::vector<uint8_t> qwin_encode(uint32_t qw, const quant::Meta &m) {
+    std::vector<uint8_t> out;
+    out.reserve(2 + 40);
+    out.push_back(1);  // version
+    out.push_back(static_cast<uint8_t>(qw));
+    auto enc = m.encode();
+    out.insert(out.end(), enc.begin(), enc.end());
+    return out;
+}
+
+// Receiver-side meta set for one stage: legacy whole-chunk, or per-window
+// frames collected lazily as they arrive (any order, any conn).
+// Forwarding re-encodes from the decoded metas (qwin_encode /
+// Meta::encode are deterministic, so the re-emitted frames are
+// byte-identical to the originals).
+struct RxMeta {
+    bool any = false;         // at least one frame decoded (mode known)
+    bool per_window = false;
+    uint32_t qw = 1;
+    quant::Meta whole;
+    std::vector<std::optional<quant::Meta>> win;
+
+    bool have(uint32_t w) const {
+        if (!any) return false;
+        if (!per_window) return true;
+        return w < win.size() && win[w].has_value();
+    }
+    const quant::Meta &get(uint32_t w) const {
+        return per_window ? *win[w] : whole;
+    }
+};
+
+// Pull meta frames for `mtag` until window `need_w` (or the legacy whole
+// meta) is decodable. Bounded waits so master aborts and conn death
+// interrupt the wait. false = abort/death/decode failure.
+bool fetch_meta(RingCtx &ctx, uint64_t mtag, RxMeta &ms, uint32_t need_w) {
+    const auto deadline = now_ns() + 60'000'000'000ull;
+    while (!ms.have(need_w)) {
+        if (ctx.should_abort && ctx.should_abort()) return false;
+        if (!ctx.rx.alive()) return false;
+        if (now_ns() > deadline) return false;
+        auto fr = ctx.rx.table().recv_queued_any(mtag, 100);
+        if (!fr) continue;
+        if (fr->first == 0) {
+            auto m = quant::Meta::decode(fr->second);
+            if (!m) return false;
+            ms.whole = *m;
+            ms.per_window = false;
+            ms.any = true;
+        } else {
+            const auto &p = fr->second;
+            if (p.size() < 2 || p[0] != 1) return false;  // unknown version
+            uint32_t qw = p[1];
+            uint32_t w = static_cast<uint32_t>(fr->first - 1);
+            if (qw == 0 || w >= qw) return false;
+            auto m = quant::Meta::decode({p.begin() + 2, p.end()});
+            if (!m) return false;
+            ms.per_window = true;
+            ms.any = true;
+            ms.qw = qw;
+            if (ms.win.size() < qw) ms.win.resize(qw);
+            ms.win[w] = *m;
+        }
+    }
+    return true;
+}
+
+// Which window of chunk_of(n, qw, ·) covers element e (inverse of the
+// chunk_of start formula: the first `rem` windows are one element longer).
+uint32_t window_of(size_t n, uint32_t qw, size_t e) {
+    size_t base = n / qw, rem = n % qw;
+    if (e < rem * (base + 1)) return static_cast<uint32_t>(e / (base + 1));
+    return static_cast<uint32_t>(rem + (e - rem * (base + 1)) / base);
+}
+
+// Run fn(meta, e0, e1) over [e0, e1) split at the meta set's window
+// boundaries, fetching late metas as needed. false = fetch failed.
+bool for_each_meta_span(RingCtx &ctx, uint64_t mtag, RxMeta &ms,
+                        size_t n_elems, size_t e0, size_t e1,
+                        const std::function<void(const quant::Meta &, size_t,
+                                                 size_t)> &fn) {
+    while (e0 < e1) {
+        uint32_t w = ms.per_window ? window_of(n_elems, ms.qw, e0) : 0;
+        if (!ms.have(w) && !fetch_meta(ctx, mtag, ms, w)) return false;
+        size_t hi = e1;
+        if (ms.per_window) {
+            auto ws = chunk_of(n_elems, ms.qw, w);
+            hi = std::min(e1, ws.start_elem + ws.n_elems);
+        }
+        fn(ms.get(w), e0, hi);
+        e0 = hi;
+    }
+    return true;
+}
+
+// Emit ONE window [base_off, base_off+len) of `tag`, striped into
+// `stripes` sub-spans round-robin across the pool. Striping WITHIN the
+// window (not window-per-conn) is load-bearing: a whole window parked on
+// one fair-share lane drains at R/K, so every window of a stage would
+// finish simultaneously at stage end and the cross-stage send-ahead
+// would degenerate to stage-serial (measured: 0.82x). Sub-striping keeps
+// window completion staggered exactly like the pinned chain — sub j of
+// every window rides conn (rot+j), so each conn's in-order queue is the
+// window sequence — while K senders keep K reservations live in the
+// striped bucket. Sub floor 64 KiB keeps frames meaningful; stripes == 1
+// or small windows go as one in-order stream (the PR-8 behavior).
+void striped_window_send(net::Link &tx, uint64_t tag, const uint8_t *src,
+                         uint64_t base_off, size_t len, size_t rot,
+                         size_t stripes, telemetry::EdgeCounters *edge,
+                         std::vector<net::SendHandle> *hs) {
+    constexpr size_t kSubMin = 64 << 10;
+    if (stripes <= 1 || len < 2 * kSubMin) {
+        hs->push_back(tx.send_at(tag, base_off, {src, len}, rot));
+        return;
+    }
+    size_t sub = (len + stripes - 1) / stripes;
+    if (sub < kSubMin) sub = kSubMin;
+    for (size_t off = 0, j = 0; off < len; off += sub, ++j) {
+        size_t n = std::min(sub, len - off);
+        hs->push_back(tx.send_at(tag, base_off + off, {src + off, n},
+                                 rot + j % stripes));
+    }
+    if (edge) {
+        edge->tx_stripe_windows.fetch_add(1, std::memory_order_relaxed);
+        edge->tx_stripe_bytes.fetch_add(len, std::memory_order_relaxed);
+    }
+}
+
 // Launch completed windows [*ahead_off, prefix) of the NEXT stage's send
 // chunk (`src`, `total` bytes, granule `wb`) — called from inside a
 // stream_recv accumulation callback, so the next stage's first bytes are
 // on the wire while this stage's later windows are still arriving. A
-// sub-window tail is absorbed into the last window. The one place this
-// arithmetic lives; both ring_allreduce and ring_allgather ride it.
+// sub-window tail is absorbed into the last window. Each window stripes
+// across `stripes` pool conns via striped_window_send (multipath
+// striping; 1 = the PR-8 pinned single-conn chain). The one place this
+// arithmetic lives; both ring_allreduce and ring_allgather ride it —
+// with prefix == total it doubles as the striped stage-top submit.
 void send_ahead_windows(net::Link &tx, uint64_t tag, const uint8_t *src,
                         size_t total, size_t wb, size_t prefix, size_t rot,
-                        size_t *ahead_off, std::vector<net::SendHandle> *hs) {
+                        size_t *ahead_off, std::vector<net::SendHandle> *hs,
+                        size_t stripes = 1,
+                        telemetry::EdgeCounters *edge = nullptr) {
     auto &rec = telemetry::Recorder::inst();
     const bool wt = rec.on() && telemetry::win_trace_enabled();
     while (*ahead_off < total) {
         size_t seg = std::min(wb, total - *ahead_off);
         if (total - (*ahead_off + seg) < wb) seg = total - *ahead_off;
         if (prefix < *ahead_off + seg) break;
-        hs->push_back(tx.send_at(tag, *ahead_off, {src + *ahead_off, seg}, rot));
+        striped_window_send(tx, tag, src + *ahead_off, *ahead_off, seg, rot,
+                            stripes, edge, hs);
         if (wt)
             rec.instant("window", "win_submit", "off", *ahead_off, "bytes",
                         seg, nullptr, "seq", rot);
@@ -296,9 +478,28 @@ void wd_poll(Wd &wd, RingCtx &ctx) {
         }
         if (h->done()) {
             // healthy-state completions feed the EWMA baseline (a flagged
-            // edge's drain times would poison the recovered-state deadline)
-            if (ctx.tx_edge->wd_health.load(std::memory_order_relaxed) == 0)
-                wd_update_rate(ctx.tx_edge, h->span.size(), now - it->second);
+            // edge's drain times would poison the recovered-state deadline).
+            // Anti-poisoning clamp: a completion an order of magnitude
+            // under the current envelope is evidence of degradation, not a
+            // new baseline — adapting to it stretches the deadline exactly
+            // as fast as the fault stretches drains and blinds the age
+            // trigger (measured: a uniform 30x degrade under striping
+            // never tripped, because each steady sub-window completion
+            // re-taught the EWMA the degraded rate before any poll caught
+            // an over-age handle). Modest slowdowns — congestion, fair-
+            // share queue depth — still adapt (< 8x keeps feeding).
+            if (ctx.tx_edge->wd_health.load(std::memory_order_relaxed) == 0) {
+                const uint64_t dur = now - it->second;
+                const uint64_t rate =
+                    ctx.tx_edge->wd_rate_bps.load(std::memory_order_relaxed);
+                const bool degraded_sample =
+                    rate > 0 && dur > 0 &&
+                    static_cast<double>(h->span.size()) * 1e9 / dur <
+                        rate / 8.0;
+                if (!degraded_sample)
+                    wd_update_rate(ctx.tx_edge, h->span.size(),
+                                   now - it->second);
+            }
             if (telemetry::win_trace_enabled() &&
                 telemetry::Recorder::inst().on())
                 telemetry::Recorder::inst().instant(
@@ -425,23 +626,33 @@ template <class F> ScopeExit(F) -> ScopeExit<F>;
 void drain_zombies(RingCtx &ctx, std::vector<net::SendHandle> &zs) {
     if (zs.empty()) return;
     const uint64_t t0 = now_ns();
+    // End-to-end relay acks (docs/05): a zombie whose span the FINAL
+    // receiver already confirmed delivered (via the relay) is dead weight
+    // crawling out at the degraded rate — flag it cancelled so the TX
+    // path stops at the next frame boundary and fails the handle without
+    // touching the span again. The conn itself stays alive (it may be the
+    // op's only pool conn, still carrying metas and later re-probes); the
+    // drain below then waits at most one in-flight frame per conn instead
+    // of whole spans at the degraded rate. Only a CONFIRMED edge
+    // qualifies — its direct windows are already detoured.
+    if (ctx.relay_acked && ctx.tx_edge &&
+        ctx.tx_edge->wd_health.load(std::memory_order_relaxed) ==
+            static_cast<uint32_t>(telemetry::EdgeHealth::kConfirmed)) {
+        for (auto &h : zs) {
+            if (!h || h->done() || h->span.empty()) continue;
+            if (!ctx.relay_acked(h->tag, h->off, h->span.size())) continue;
+            h->cancel.store(true, std::memory_order_relaxed);
+            if (ctx.tele)
+                ctx.tele->comm.relay_retired_early.fetch_add(
+                    1, std::memory_order_relaxed);
+        }
+    }
     net::Link::wait_all(zs);
     zs.clear();
     auto &rec = telemetry::Recorder::inst();
     if (rec.on())
         rec.span("collective", "zombie_drain", t0, now_ns(), "seq",
                  ctx.op_seq, nullptr, 0, ctx.tx_endpoint);
-}
-
-struct ChunkSpan {
-    size_t start_elem, n_elems;
-};
-
-ChunkSpan chunk_of(size_t count, uint32_t world, uint32_t c) {
-    size_t base = count / world, rem = count % world;
-    size_t start = c * base + std::min<size_t>(c, rem);
-    size_t len = base + (c < rem ? 1 : 0);
-    return {start, len};
 }
 
 // Wait until `target` bytes for `tag` arrived, reducing/consuming via
@@ -616,11 +827,22 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // already zero-copy and windowed frames would only fragment it — so the
     // loopback fast path is bit-for-bit the old one.
     const bool pipelined = pipeline_enabled() && !ctx.tx.cma_eligible();
-    // Cross-stage send-ahead state (unquantized): handles + contiguous byte
-    // progress of the NEXT stage's chunk, launched from inside the current
-    // stage's accumulation callback as windows complete.
+    // multipath striping (docs/08): windows round-robin across this many
+    // pool conns; 1 (default with a 1-conn pool) is the PR-8 pinned chain
+    const size_t stripes = pipelined ? stripe_conns(ctx.tx.size()) : 1;
+    // per-window quantization meta (PCCLT_QWIN_META=1): quantized stages
+    // send one meta per window, which unlocks the quantized cross-stage
+    // send-ahead below. Wire format is self-describing per frame, so this
+    // gate only needs to agree with what THIS rank sends.
+    const bool qwin = quantized && pipelined && qwin_enabled();
+    // Cross-stage send-ahead state (unquantized + qwin quantized): handles
+    // + contiguous byte progress of the NEXT stage's chunk, launched from
+    // inside the current stage's accumulation callback as windows complete.
     std::vector<net::SendHandle> ahead_hs;
     size_t ahead_off = 0;
+    // qwin send-ahead bookkeeping: next window of the NEXT stage's chunk
+    // to quantize+ship, and that chunk's window grid
+    uint32_t q_ahead_w = 0, q_ahead_qw = 0;
     // edge watchdog (docs/05): relay mode persists across ops via the
     // tx edge's health verdict while the CONFIRMED hold lasts
     Wd wd;
@@ -658,7 +880,14 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     std::vector<uint8_t> scratch_local;
     std::vector<uint8_t> &rx_vec = ctx.scratch ? *ctx.scratch : scratch_local;
     if (rx_vec.size() < 2 * max_chunk * qsz) rx_vec.resize(2 * max_chunk * qsz);
-    std::vector<uint8_t> tx_scratch(quantized ? max_chunk * qsz : 0);
+    // qwin: TWO tx slots alternating by stage — the cross-stage send-ahead
+    // quantizes stage s+1's windows while stage s's in-flight sends still
+    // borrow its slot (joined at stage s's end, one stage before reuse)
+    std::vector<uint8_t> tx_scratch(quantized ? (qwin ? 2 : 1) * max_chunk * qsz
+                                              : 0);
+    auto tx_scratch_at = [&](uint32_t seq) {
+        return tx_scratch.data() + (qwin ? (seq % 2) * max_chunk * qsz : 0);
+    };
 
     // Async TX via the conn's dedicated sender thread (or the same-host CMA
     // descriptor path). The payload span must stay untouched until the
@@ -724,7 +953,77 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                           size_t chunk_bytes, size_t wb, size_t prefix) {
         size_t pre = ahead_hs.size();
         send_ahead_windows(ctx.tx, next_tag, src, chunk_bytes, wb, prefix,
-                           ctx.op_seq, &ahead_off, &ahead_hs);
+                           ctx.op_seq, &ahead_off, &ahead_hs, stripes,
+                           ctx.tx_edge);
+        wd_track(wd, ahead_hs, pre);
+    };
+    // striped stage-top submit: the whole chunk's windows leave NOW,
+    // round-robin across the pool (stripes == 1 degenerates to the PR-8
+    // single-conn in-order stream, which is cheaper than per-window
+    // framing when there is nothing to stripe across)
+    auto stage_top_windows = [&](uint64_t tag, const uint8_t *src,
+                                 size_t total, size_t wb,
+                                 std::vector<net::SendHandle> *hs) {
+        if (stripes <= 1) {
+            hs->push_back(ctx.tx.send_at(tag, 0, {src, total}, ctx.op_seq));
+        } else {
+            size_t off0 = 0;
+            size_t pre = hs->size();
+            send_ahead_windows(ctx.tx, tag, src, total, wb, total, ctx.op_seq,
+                               &off0, hs, stripes, ctx.tx_edge);
+            wd_track(wd, *hs, pre);
+        }
+    };
+    // qwin cross-stage send-ahead: quantize + ship completed windows of
+    // the NEXT quantized stage's chunk (the one accumulating right now)
+    // from inside the current stage's consume callback — per-window meta
+    // makes each window independently decodable, so the quantized ring
+    // stops barriering at stage tops. `self_dq` keeps the AG-0 owner's
+    // bit-parity self-dequantize riding the same (cache-hot) window.
+    auto q_send_ahead = [&](uint64_t next_tag, uint8_t *src_f32,
+                            size_t n_elems, uint8_t *qdst, size_t done_elems,
+                            bool self_dq) {
+        if (q_ahead_qw == 0)
+            // the wire meta frame carries qw as one byte (qwin_encode):
+            // clamp the grid so an extreme PCCLT_PIPELINE_WINDOW cannot
+            // truncate it into a decode failure on the receiver
+            q_ahead_qw = static_cast<uint32_t>(std::min<size_t>(
+                pipeline_windows(n_elems * qsz), 255));
+        auto &rec2 = telemetry::Recorder::inst();
+        const bool wt = rec2.on() && telemetry::win_trace_enabled();
+        size_t pre = ahead_hs.size();
+        while (q_ahead_w < q_ahead_qw) {
+            auto ws = chunk_of(n_elems, q_ahead_qw, q_ahead_w);
+            if (ws.start_elem + ws.n_elems > done_elems) break;
+            quant::Meta m;
+            const uint64_t qt0 = now_ns();
+            quant_timed([&] {
+                m = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
+                                        src_f32 + ws.start_elem * esz,
+                                        ws.n_elems);
+                quant::quantize(m, src_f32 + ws.start_elem * esz,
+                                qdst + ws.start_elem * qsz, ws.n_elems);
+            });
+            if (wt)
+                rec2.span("window", "win_quant", qt0, now_ns(), "win",
+                          q_ahead_w, "seq", ctx.op_seq);
+            if (self_dq)
+                dequant_timed([&] {
+                    quant::dequantize_set(m, qdst + ws.start_elem * qsz,
+                                          src_f32 + ws.start_elem * esz,
+                                          ws.n_elems);
+                });
+            ahead_hs.push_back(ctx.tx.send_meta_at(
+                next_tag | kMetaBit, q_ahead_w + 1,
+                qwin_encode(q_ahead_qw, m)));
+            striped_window_send(ctx.tx, next_tag, qdst + ws.start_elem * qsz,
+                                ws.start_elem * qsz, ws.n_elems * qsz,
+                                ctx.op_seq, stripes,
+                                stripes > 1 ? ctx.tx_edge : nullptr,
+                                &ahead_hs);
+            ahead_off += ws.n_elems * qsz;
+            ++q_ahead_w;
+        }
         wd_track(wd, ahead_hs, pre);
     };
     // window granule for a chunk, 0 = no windowing (pipeline off or chunk
@@ -798,81 +1097,132 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
 
         uint8_t *rx_scratch = scratch_at(s);
         std::vector<net::SendHandle> tx_job;
-        quant::Meta rx_meta;
         if (quantized) {
-            quant::Meta meta;
-            quant_timed([&] {
-                meta = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
-                                           send_ptr, send_span.n_elems);
-            });
-            const size_t qw = pipelined && !wd.relay_all
-                                  ? pipeline_windows(send_span.n_elems * qsz)
-                                  : 1;
-            if (qw <= 1) {
-                quant_timed([&] {
-                    quant::quantize(meta, send_ptr, tx_scratch.data(),
-                                    send_span.n_elems);
-                });
-                tx_job = launch_tx(tag, meta.encode(),
-                                   {tx_scratch.data(), send_span.n_elems * qsz});
+            uint8_t *qbuf = tx_scratch_at(s);
+            if (ahead_off > 0) {
+                // qwin cross-stage send-ahead: this chunk's windows (and
+                // their per-window metas) already left from inside stage
+                // s-1's accumulation callback — the quantized ring no
+                // longer barriers at the stage top
+                tx_job = std::move(ahead_hs);
+                ahead_hs.clear();
             } else {
-                // per-window quantize→send overlap: window k+1 quantizes
-                // while window k is on the wire. ONE meta for the whole
-                // chunk — wire format and numerics are unchanged.
-                tx_job.push_back(ctx.tx.send_meta(tag | kMetaBit, meta.encode()));
-                for (size_t w = 0; w < qw; ++w) {
-                    auto ws = chunk_of(send_span.n_elems,
-                                       static_cast<uint32_t>(qw),
-                                       static_cast<uint32_t>(w));
-                    const uint64_t qt0 = now_ns();
+                const size_t qw = pipelined && !wd.relay_all
+                                      ? pipeline_windows(send_span.n_elems * qsz)
+                                      : 1;
+                if (qwin && qw > 1) {
+                    // per-window meta stage-top launch (stage 0): same
+                    // emission path as the send-ahead, everything complete
+                    q_ahead_w = 0;
+                    q_ahead_qw = 0;
+                    q_send_ahead(tag, send_ptr, send_span.n_elems, qbuf,
+                                 send_span.n_elems, /*self_dq=*/false);
+                    tx_job = std::move(ahead_hs);
+                    ahead_hs.clear();
+                } else {
+                    quant::Meta meta;
                     quant_timed([&] {
-                        quant::quantize(meta, send_ptr + ws.start_elem * esz,
-                                        tx_scratch.data() + ws.start_elem * qsz,
-                                        ws.n_elems);
+                        meta = quant::compute_meta(ctx.quant, ctx.q_dtype,
+                                                   ctx.dtype, send_ptr,
+                                                   send_span.n_elems);
                     });
-                    if (wtrace)
-                        rec.span("window", "win_quant", qt0, now_ns(), "win",
-                                 w, "seq", ctx.op_seq);
-                    tx_job.push_back(ctx.tx.send_at(
-                        tag, ws.start_elem * qsz,
-                        {tx_scratch.data() + ws.start_elem * qsz,
-                         ws.n_elems * qsz},
-                        ctx.op_seq));
-                    if (wtrace)
-                        rec.instant("window", "win_submit", "off",
-                                    ws.start_elem * qsz, "bytes",
-                                    ws.n_elems * qsz, nullptr, "seq",
-                                    ctx.op_seq);
+                    if (qw <= 1) {
+                        quant_timed([&] {
+                            quant::quantize(meta, send_ptr, qbuf,
+                                            send_span.n_elems);
+                        });
+                        tx_job = launch_tx(tag, meta.encode(),
+                                           {qbuf, send_span.n_elems * qsz});
+                    } else {
+                        // per-window quantize→send overlap: window k+1
+                        // quantizes while window k is on the wire. ONE meta
+                        // for the whole chunk — wire format and numerics
+                        // are unchanged; windows stripe across the pool.
+                        tx_job.push_back(
+                            ctx.tx.send_meta(tag | kMetaBit, meta.encode()));
+                        for (size_t w = 0; w < qw; ++w) {
+                            auto ws = chunk_of(send_span.n_elems,
+                                               static_cast<uint32_t>(qw),
+                                               static_cast<uint32_t>(w));
+                            const uint64_t qt0 = now_ns();
+                            quant_timed([&] {
+                                quant::quantize(meta,
+                                                send_ptr + ws.start_elem * esz,
+                                                qbuf + ws.start_elem * qsz,
+                                                ws.n_elems);
+                            });
+                            if (wtrace)
+                                rec.span("window", "win_quant", qt0, now_ns(),
+                                         "win", w, "seq", ctx.op_seq);
+                            size_t pre = tx_job.size();
+                            striped_window_send(
+                                ctx.tx, tag, qbuf + ws.start_elem * qsz,
+                                ws.start_elem * qsz, ws.n_elems * qsz,
+                                ctx.op_seq, stripes,
+                                stripes > 1 ? ctx.tx_edge : nullptr, &tx_job);
+                            wd_track(wd, tx_job, pre);
+                            if (wtrace)
+                                rec.instant("window", "win_submit", "off",
+                                            ws.start_elem * qsz, "bytes",
+                                            ws.n_elems * qsz, nullptr, "seq",
+                                            ctx.op_seq);
+                        }
+                    }
                 }
             }
+            ahead_off = 0;
+            q_ahead_w = 0;
+            q_ahead_qw = 0;
             ctx.tx_bytes += send_span.n_elems * qsz;
 
             // sink for THIS stage was registered a stage ahead; open the
             // next stage's sink before consuming, then take peer meta
+            // (first frame pins legacy-vs-per-window mode; stragglers are
+            // fetched lazily from inside the consume callback)
             reg_stage(s + 1);
-            auto mraw = ctx.rx.table().recv_queued(tag | kMetaBit, 60'000);
-            if (!mraw) {
+            RxMeta ms;
+            if (!fetch_meta(ctx, tag | kMetaBit, ms, 0)) {
                 join_tx(tx_job);
                 return fail(!ctx.rx.alive());
             }
-            auto m = quant::Meta::decode(*mraw);
-            if (!m) {
-                join_tx(tx_job);
-                return fail(false);
+            // qwin send-ahead target: the chunk accumulating here IS what
+            // the next stage (RS s+1, or AG 0 at the phase boundary) sends
+            const bool qa = qwin && !wd.relay_all;
+            const uint64_t next_tag =
+                s + 2 < world ? (base_tag | (s + 1)) : (base_tag | 0x4000u);
+            const bool next_is_ag0 = s + 2 >= world;
+            uint8_t *next_qbuf = tx_scratch_at(s + 1);
+            size_t q_rx_step = 0;
+            if (qa) {
+                size_t nq = pipeline_windows(recv_span.n_elems * qsz);
+                if (nq > 1)
+                    q_rx_step = std::max(
+                        qsz, recv_span.n_elems * qsz / nq / qsz * qsz);
             }
-            rx_meta = *m;
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
-                                  [&](const uint8_t *src, size_t lo, size_t hi) {
-                                      size_t e0 = lo / qsz, e1 = hi / qsz;
-                                      dequant_timed([&] {
-                                          quant::dequantize_accumulate(
-                                              rx_meta, ctx.op, src,
-                                              recv_ptr + e0 * esz, e1 - e0);
-                                      });
-                                  }, &prof, /*fill_if_unmapped=*/false, 0, &wd);
+            bool meta_ok = true;
+            bool ok = stream_recv(
+                ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
+                [&](const uint8_t *src, size_t lo, size_t hi) {
+                    size_t e0 = lo / qsz, e1 = hi / qsz;
+                    if (!for_each_meta_span(
+                            ctx, tag | kMetaBit, ms, recv_span.n_elems, e0, e1,
+                            [&](const quant::Meta &m, size_t a, size_t b) {
+                                dequant_timed([&] {
+                                    quant::dequantize_accumulate(
+                                        m, ctx.op, src + (a - e0) * qsz,
+                                        recv_ptr + a * esz, b - a);
+                                });
+                            }))
+                        meta_ok = false;
+                    if (qa && meta_ok)
+                        q_send_ahead(next_tag, recv_ptr, recv_span.n_elems,
+                                     next_qbuf, e1, next_is_ag0);
+                },
+                &prof, /*fill_if_unmapped=*/false, q_rx_step, &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
-            if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
+            if (!ok || !meta_ok || !tx_ok)
+                return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
         } else {
             // stage 0 sends the pristine chunk, readable from `send` directly;
@@ -889,13 +1239,12 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                         tag, ahead_off, {tx_ptr + ahead_off,
                                          send_bytes - ahead_off},
                         ctx.op_seq));
-            } else if (pipelined && win_bytes(send_bytes)) {
-                // single-conn in-order stream: striping across the pool
-                // would race page-aligned segments through the shared edge
-                // bucket and stall the receiver's contiguous prefix — the
-                // pipeline rides in-order arrival
-                tx_job.push_back(
-                    ctx.tx.send_at(tag, 0, {tx_ptr, send_bytes}, ctx.op_seq));
+            } else if (size_t swb = win_bytes(send_bytes); pipelined && swb) {
+                // windowed stage-top, striped round-robin across the pool
+                // (stripes == 1: the PR-8 single-conn in-order stream —
+                // with the striped per-lane bucket, stripes no longer race
+                // each other's pacing slots, so the old stall is gone)
+                stage_top_windows(tag, tx_ptr, send_bytes, swb, &tx_job);
             } else {
                 tx_job = launch_tx(tag, {}, {tx_ptr, send_bytes});
             }
@@ -947,7 +1296,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // for bit parity (reference reduce.cpp:673-738).
     auto ag_t0 = now_ns();
     std::vector<uint8_t> fwd_q;      // quantized bytes to forward next stage
-    std::vector<uint8_t> fwd_meta;   // encoded meta to forward
+    std::vector<uint8_t> fwd_meta;   // encoded meta to forward (legacy mode)
+    RxMeta fwd_ms;  // meta set received last stage: per-window chunks must
+                    // forward per-window even when OUR env has qwin off
     for (uint32_t s = 0; s + 1 < world; ++s) {
         PLOG(kDebug) << "ring seq=" << ctx.op_seq << " ag stage " << s;
         const uint64_t stage_t0 = now_ns();
@@ -967,107 +1318,209 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
         std::vector<net::SendHandle> tx_job;
         if (quantized) {
             bool launched = false;
-            if (s == 0) {
-                quant::Meta meta;
-                quant_timed([&] {
-                    meta = quant::compute_meta(ctx.quant, ctx.q_dtype,
-                                               ctx.dtype, send_ptr,
-                                               send_span.n_elems);
-                    fwd_q.resize(send_span.n_elems * qsz);
-                });
-                fwd_meta = meta.encode();
+            if (ahead_off > 0) {
+                // qwin: this stage's windows (own chunk at s == 0 via the
+                // last RS stage's accumulate, a forwarded chunk at s > 0
+                // via the previous AG stage's forward-ahead) already left
+                tx_job = std::move(ahead_hs);
+                ahead_hs.clear();
+                launched = true;
+            } else if (s == 0) {
                 const size_t qw =
                     pipelined && !wd.relay_all
                         ? pipeline_windows(send_span.n_elems * qsz)
                         : 1;
-                if (qw > 1) {
-                    // per-window quantize→send overlap (one whole-chunk
-                    // meta, wire format unchanged); the owner's bit-parity
-                    // self-dequantize rides the same window while it is
-                    // still cache-hot
-                    tx_job.push_back(
-                        ctx.tx.send_meta(tag | kMetaBit, fwd_meta));
-                    for (size_t w = 0; w < qw; ++w) {
-                        auto ws = chunk_of(send_span.n_elems,
-                                           static_cast<uint32_t>(qw),
-                                           static_cast<uint32_t>(w));
-                        const uint64_t qt0 = now_ns();
-                        quant_timed([&] {
-                            quant::quantize(meta,
-                                            send_ptr + ws.start_elem * esz,
-                                            fwd_q.data() + ws.start_elem * qsz,
-                                            ws.n_elems);
-                        });
-                        if (wtrace)
-                            rec.span("window", "win_quant", qt0, now_ns(),
-                                     "win", w, "seq", ctx.op_seq);
-                        tx_job.push_back(ctx.tx.send_at(
-                            tag, ws.start_elem * qsz,
-                            {fwd_q.data() + ws.start_elem * qsz,
-                             ws.n_elems * qsz},
-                            ctx.op_seq));
-                        if (wtrace)
-                            rec.instant("window", "win_submit", "off",
-                                        ws.start_elem * qsz, "bytes",
-                                        ws.n_elems * qsz, nullptr, "seq",
-                                        ctx.op_seq);
-                        dequant_timed([&] {
-                            quant::dequantize_set(
-                                meta, fwd_q.data() + ws.start_elem * qsz,
-                                send_ptr + ws.start_elem * esz, ws.n_elems);
-                        });
-                    }
+                if (qwin && qw > 1) {
+                    // per-window meta stage-top launch; the owner's
+                    // bit-parity self-dequantize rides each window
+                    q_ahead_w = 0;
+                    q_ahead_qw = 0;
+                    q_send_ahead(tag, send_ptr, send_span.n_elems,
+                                 tx_scratch_at(rs_stages), send_span.n_elems,
+                                 /*self_dq=*/true);
+                    tx_job = std::move(ahead_hs);
+                    ahead_hs.clear();
                     launched = true;
                 } else {
+                    quant::Meta meta;
                     quant_timed([&] {
-                        quant::quantize(meta, send_ptr, fwd_q.data(),
-                                        send_span.n_elems);
+                        meta = quant::compute_meta(ctx.quant, ctx.q_dtype,
+                                                   ctx.dtype, send_ptr,
+                                                   send_span.n_elems);
+                        fwd_q.resize(send_span.n_elems * qsz);
                     });
-                    dequant_timed([&] {
-                        // bit parity: owner keeps what the others decode
-                        quant::dequantize_set(meta, fwd_q.data(), send_ptr,
-                                              send_span.n_elems);
-                    });
+                    fwd_meta = meta.encode();
+                    if (qw > 1) {
+                        // per-window quantize→send overlap (one whole-chunk
+                        // meta, wire format unchanged); windows stripe
+                        // across the pool; the owner's bit-parity
+                        // self-dequantize rides the same window while it is
+                        // still cache-hot
+                        tx_job.push_back(
+                            ctx.tx.send_meta(tag | kMetaBit, fwd_meta));
+                        for (size_t w = 0; w < qw; ++w) {
+                            auto ws = chunk_of(send_span.n_elems,
+                                               static_cast<uint32_t>(qw),
+                                               static_cast<uint32_t>(w));
+                            const uint64_t qt0 = now_ns();
+                            quant_timed([&] {
+                                quant::quantize(
+                                    meta, send_ptr + ws.start_elem * esz,
+                                    fwd_q.data() + ws.start_elem * qsz,
+                                    ws.n_elems);
+                            });
+                            if (wtrace)
+                                rec.span("window", "win_quant", qt0, now_ns(),
+                                         "win", w, "seq", ctx.op_seq);
+                            size_t pre = tx_job.size();
+                            striped_window_send(
+                                ctx.tx, tag,
+                                fwd_q.data() + ws.start_elem * qsz,
+                                ws.start_elem * qsz, ws.n_elems * qsz,
+                                ctx.op_seq, stripes,
+                                stripes > 1 ? ctx.tx_edge : nullptr, &tx_job);
+                            wd_track(wd, tx_job, pre);
+                            if (wtrace)
+                                rec.instant("window", "win_submit", "off",
+                                            ws.start_elem * qsz, "bytes",
+                                            ws.n_elems * qsz, nullptr, "seq",
+                                            ctx.op_seq);
+                            dequant_timed([&] {
+                                quant::dequantize_set(
+                                    meta, fwd_q.data() + ws.start_elem * qsz,
+                                    send_ptr + ws.start_elem * esz,
+                                    ws.n_elems);
+                            });
+                        }
+                        launched = true;
+                    } else {
+                        quant_timed([&] {
+                            quant::quantize(meta, send_ptr, fwd_q.data(),
+                                            send_span.n_elems);
+                        });
+                        dequant_timed([&] {
+                            // bit parity: owner keeps what the others decode
+                            quant::dequantize_set(meta, fwd_q.data(), send_ptr,
+                                                  send_span.n_elems);
+                        });
+                    }
                 }
+            } else if (fwd_ms.per_window) {
+                // stage-top forward of a chunk the previous hop quantized
+                // with per-window metas (we did not forward-ahead — e.g.
+                // relay mode): re-emit every meta frame, then the bytes.
+                // The format is per-frame self-describing, so this works
+                // whether or not OUR env opted into qwin.
+                for (uint32_t w = 0; w < fwd_ms.qw; ++w)
+                    tx_job.push_back(ctx.tx.send_meta_at(
+                        tag | kMetaBit, w + 1,
+                        qwin_encode(fwd_ms.qw, fwd_ms.get(w))));
+                if (!(wd.relay_all &&
+                      wd_relay_span(ctx, tag, 0, fwd_q.data(), fwd_q.size()))) {
+                    size_t swb = win_bytes(fwd_q.size());
+                    if (swb)
+                        stage_top_windows(tag, fwd_q.data(), fwd_q.size(),
+                                          swb, &tx_job);
+                    else {
+                        auto ph = ctx.tx.send_async(tag, fwd_q, ctx.op_seq);
+                        tx_job.insert(tx_job.end(), ph.begin(), ph.end());
+                        wd_track(wd, tx_job);
+                    }
+                }
+                launched = true;
             }
+            ahead_off = 0;
+            q_ahead_w = 0;
+            q_ahead_qw = 0;
             if (!launched) tx_job = launch_tx(tag, fwd_meta, fwd_q);
-            ctx.tx_bytes += fwd_q.size();
+            ctx.tx_bytes += send_span.n_elems * qsz;
 
             reg_stage(rs_stages + s + 1); // sink for THIS stage opened earlier
-            auto mraw = ctx.rx.table().recv_queued(tag | kMetaBit, 60'000);
-            if (!mraw) {
+            RxMeta ms;
+            if (!fetch_meta(ctx, tag | kMetaBit, ms, 0)) {
                 join_tx(tx_job);
                 return fail(!ctx.rx.alive());
-            }
-            auto m = quant::Meta::decode(*mraw);
-            if (!m) {
-                join_tx(tx_job);
-                return fail(false);
             }
             // forwarding stages must keep the raw quantized bytes: the fused
             // CMA path consumes from a bounce buffer, so mirror each slice
             // into rx_scratch (cache-hot, and only when actually forwarding)
             const bool fwd_needed = s + 2 < world;
-            bool ok = stream_recv(ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
-                                  [&](const uint8_t *src, size_t lo, size_t hi) {
-                                      if (fwd_needed && src != rx_scratch + lo)
-                                          memcpy(rx_scratch + lo, src, hi - lo);
-                                      size_t e0 = lo / qsz, e1 = hi / qsz;
-                                      dequant_timed([&] {
-                                          quant::dequantize_set(
-                                              *m, src, recv_ptr + e0 * esz,
-                                              e1 - e0);
-                                      });
-                                  }, &prof, /*fill_if_unmapped=*/false, 0, &wd);
+            // qwin forward-ahead: re-emit received windows (and their meta
+            // frames) toward the NEXT stage from inside this consume
+            // callback — the all-gather's stage-top barrier disappears
+            const bool fa = qwin && fwd_needed && !wd.relay_all;
+            const uint64_t fnext_tag = base_tag | (0x4000u + s + 1);
+            uint32_t fwd_w = 0, fwd_qw = 0;
+            auto fwd_ahead = [&](size_t done_elems) {
+                if (fwd_qw == 0) {
+                    fwd_qw = ms.per_window
+                                 ? ms.qw
+                                 : static_cast<uint32_t>(pipeline_windows(
+                                       recv_span.n_elems * qsz));
+                    if (fwd_qw < 1) fwd_qw = 1;
+                    if (!ms.per_window)
+                        // legacy upstream: ONE whole-chunk meta forwards
+                        // ahead of the windows, byte-identical re-encode
+                        ahead_hs.push_back(ctx.tx.send_meta_at(
+                            fnext_tag | kMetaBit, 0, ms.whole.encode()));
+                }
+                size_t pre = ahead_hs.size();
+                while (fwd_w < fwd_qw) {
+                    auto ws = chunk_of(recv_span.n_elems, fwd_qw, fwd_w);
+                    if (ws.start_elem + ws.n_elems > done_elems) break;
+                    if (ms.per_window)
+                        ahead_hs.push_back(ctx.tx.send_meta_at(
+                            fnext_tag | kMetaBit, fwd_w + 1,
+                            qwin_encode(ms.qw, ms.get(fwd_w))));
+                    striped_window_send(ctx.tx, fnext_tag,
+                                        rx_scratch + ws.start_elem * qsz,
+                                        ws.start_elem * qsz,
+                                        ws.n_elems * qsz, ctx.op_seq, stripes,
+                                        stripes > 1 ? ctx.tx_edge : nullptr,
+                                        &ahead_hs);
+                    ahead_off += ws.n_elems * qsz;
+                    ++fwd_w;
+                }
+                wd_track(wd, ahead_hs, pre);
+            };
+            size_t q_rx_step = 0;
+            if (fa) {
+                size_t nq = pipeline_windows(recv_span.n_elems * qsz);
+                if (nq > 1)
+                    q_rx_step = std::max(
+                        qsz, recv_span.n_elems * qsz / nq / qsz * qsz);
+            }
+            bool meta_ok = true;
+            bool ok = stream_recv(
+                ctx, tag, recv_span.n_elems * qsz, qsz, rx_scratch,
+                [&](const uint8_t *src, size_t lo, size_t hi) {
+                    if (fwd_needed && src != rx_scratch + lo)
+                        memcpy(rx_scratch + lo, src, hi - lo);
+                    size_t e0 = lo / qsz, e1 = hi / qsz;
+                    if (!for_each_meta_span(
+                            ctx, tag | kMetaBit, ms, recv_span.n_elems, e0, e1,
+                            [&](const quant::Meta &m, size_t a, size_t b) {
+                                dequant_timed([&] {
+                                    quant::dequantize_set(
+                                        m, src + (a - e0) * qsz,
+                                        recv_ptr + a * esz, b - a);
+                                });
+                            }))
+                        meta_ok = false;
+                    if (fa && meta_ok) fwd_ahead(e1);
+                },
+                &prof, /*fill_if_unmapped=*/false, q_rx_step, &wd);
             ctx.rx.table().unregister_sink(tag);
             bool tx_ok = join_tx(tx_job);
-            if (!ok || !tx_ok) return fail(!ctx.rx.alive() || !ctx.tx.alive());
+            if (!ok || !meta_ok || !tx_ok)
+                return fail(!ctx.rx.alive() || !ctx.tx.alive());
             ctx.rx_bytes += recv_span.n_elems * qsz;
-            if (fwd_needed) {
+            if (fwd_needed && ahead_off == 0) {
                 // forward what we received on the next stage; the send buffer
                 // must be distinct from rx_scratch (next stage writes into it)
                 fwd_q.assign(rx_scratch, rx_scratch + recv_span.n_elems * qsz);
-                fwd_meta = mraw.value();
+                if (!ms.per_window) fwd_meta = ms.whole.encode();
+                fwd_ms = std::move(ms);
             }
         } else {
             const size_t send_bytes = send_span.n_elems * esz;
@@ -1079,10 +1532,9 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
                         tag, ahead_off, {send_ptr + ahead_off,
                                          send_bytes - ahead_off},
                         ctx.op_seq));
-            } else if (pipelined && win_bytes(send_bytes)) {
-                // single-conn in-order stream (see the reduce-scatter note)
-                tx_job.push_back(
-                    ctx.tx.send_at(tag, 0, {send_ptr, send_bytes}, ctx.op_seq));
+            } else if (size_t swb = win_bytes(send_bytes); pipelined && swb) {
+                // windowed stage-top, striped (see the reduce-scatter note)
+                stage_top_windows(tag, send_ptr, send_bytes, swb, &tx_job);
             } else {
                 tx_job = launch_tx(tag, {}, {send_ptr, send_bytes});
             }
@@ -1200,6 +1652,7 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // same windowed cross-stage send-ahead as the all-reduce (docs/08):
     // the segment received at stage s is the one forwarded at stage s+1
     const bool pipelined = pipeline_enabled() && !ctx.tx.cma_eligible();
+    const size_t stripes = pipelined ? stripe_conns(ctx.tx.size()) : 1;
     Wd wd;
     wd_init(wd, ctx);
     size_t wb = 0;
@@ -1232,11 +1685,22 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                                  seg - ahead_off},
                                                 ctx.op_seq));
         } else {
-            if (wb) // single-conn in-order stream (see the all-reduce note)
-                tx_job.push_back(
-                    ctx.tx.send_at(tag, 0, {src, seg}, ctx.op_seq));
-            else
+            if (wb) {
+                // windowed stage-top, striped round-robin across the pool
+                // (stripes == 1: the PR-8 single-conn in-order stream)
+                if (stripes <= 1) {
+                    tx_job.push_back(
+                        ctx.tx.send_at(tag, 0, {src, seg}, ctx.op_seq));
+                } else {
+                    size_t off0 = 0;
+                    send_ahead_windows(ctx.tx, tag, src, seg, wb, seg,
+                                       ctx.op_seq, &off0, &tx_job, stripes,
+                                       ctx.tx_edge);
+                    wd_track(wd, tx_job);
+                }
+            } else {
                 tx_job = ctx.tx.send_async(tag, {src, seg}, ctx.op_seq);
+            }
         }
         ahead_off = 0;
         ctx.tx_bytes += seg;
@@ -1253,7 +1717,8 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
                                       send_ahead_windows(ctx.tx, next_tag, dst,
                                                          seg, swb, hi,
                                                          ctx.op_seq, &ahead_off,
-                                                         &ahead_hs);
+                                                         &ahead_hs, stripes,
+                                                         ctx.tx_edge);
                               }, &prof, /*fill_if_unmapped=*/true, swb, &wd);
         ctx.rx.table().unregister_sink(tag);
         bool tx_ok = wd.on ? wd_join(wd, ctx, tx_job)

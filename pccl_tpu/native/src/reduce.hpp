@@ -68,9 +68,15 @@ struct RingCtx {
     // failover rung 2: detour a window around the outbound edge through a
     // healthy neighbor (kRelayFwd). The implementation copies the bytes
     // (fire-and-forget toward the relay); false = no relay path exists
-    // (world < 3 or no live link to any third peer).
+    // (world < 3 or no live link to any third peer). The client stripes
+    // successive detours across several healthy neighbors (docs/05).
     std::function<bool(uint64_t tag, uint64_t off,
                        std::span<const uint8_t> payload)> relay_window;
+    // end-to-end relay delivery acks (kRelayAck): true when the final
+    // receiver has confirmed delivery of the whole [off, off+len) span of
+    // `tag` — lets drain_zombies cancel a CONFIRMED-stalled direct copy's
+    // remaining frames early instead of parking it to op end. Optional.
+    std::function<bool(uint64_t tag, uint64_t off, size_t len)> relay_acked;
     // the comm's counter domain: completed ops deposit an OpSample
     // (seq/duration/stall) for the telemetry digest. Optional.
     telemetry::Domain *tele = nullptr;

@@ -492,6 +492,141 @@ static void test_chaos_schedule() {
     CHECK(!inject("127.0.0.1:45997", "meteor@t=0s:1s"));
 }
 
+// Striped token bucket (netem.hpp, docs/08 "multipath striping"): K lanes
+// on ONE edge share the modeled rate fairly (sum == modeled rate within
+// tolerance, no lane starved), a lone lane reclaims the full rate
+// (work-conserving), and a chaos blackhole stalls ALL lanes — the
+// canonical-edge contract.
+static void test_netem_striped_bucket() {
+    using namespace net::netem;
+    constexpr uint64_t kMs = 1'000'000ull;
+
+    // (1) aggregate conservation + fairness: 4 lanes, 200 Mbit (25 MB/s).
+    // 4 lanes x 16 frames x 64 KiB = 4 MiB -> 160 ms minimum on the wire.
+    {
+        EdgeParams p;
+        p.mbps = 200;
+        Edge e(p);
+        const int K = 4, frames = 16;
+        const size_t fb = 64 << 10;
+        std::vector<double> lane_s(K);
+        std::vector<std::thread> ths;
+        auto t0 = std::chrono::steady_clock::now();
+        for (int k = 0; k < K; ++k)
+            ths.emplace_back([&, k] {
+                uint32_t lane = e.alloc_lane();
+                auto lt0 = std::chrono::steady_clock::now();
+                for (int i = 0; i < frames; ++i) e.pace(fb, lane);
+                lane_s[k] = std::chrono::duration<double>(
+                                std::chrono::steady_clock::now() - lt0)
+                                .count();
+                e.release_lane(lane);
+            });
+        for (auto &t : ths) t.join();
+        double total = std::chrono::duration<double>(
+                           std::chrono::steady_clock::now() - t0)
+                           .count();
+        const double expect = K * frames * fb * 8 / (p.mbps * 1e6); // 0.168 s
+        // the bucket may not EXCEED the modeled rate (the ±5% gate's hard
+        // side); oversleep on a loaded host only slows it down
+        CHECK(total >= 0.95 * expect);
+        CHECK(total < 2.5 * expect);
+        // fairness / no slot starvation: under continuous backlog every
+        // lane drains at ~R/K, so all lanes finish together — a starved
+        // lane would finish far later than the aggregate, a greedy one far
+        // earlier
+        for (int k = 0; k < K; ++k) {
+            CHECK(lane_s[k] >= 0.5 * expect);
+            CHECK(lane_s[k] <= total + 0.01);
+        }
+    }
+
+    // (2) work-conserving reclaim: a single lane gets the FULL rate (the
+    // exact pre-striping behavior) — 1 MiB @ 25 MB/s = 40 ms minimum,
+    // nowhere near the 160 ms a 4-way fair share would take
+    {
+        EdgeParams p;
+        p.mbps = 200;
+        Edge e(p);
+        uint32_t lane = e.alloc_lane();
+        auto t0 = std::chrono::steady_clock::now();
+        for (int i = 0; i < 16; ++i) e.pace(64 << 10, lane);
+        double s = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+        e.release_lane(lane);
+        CHECK(s >= 0.038);
+        CHECK(s < 0.120);
+    }
+
+    // (3) chaos blackhole stalls ALL stripes: every lane's reservation is
+    // pushed past the outage window (the schedule lives on the ONE
+    // canonical edge, not per lane)
+    {
+        Edge e;  // no rate: only the chaos schedule paces
+        e.arm_chaos({ChaosFault{ChaosFault::kBlackhole, 0, 150 * kMs, 1, 0}});
+        std::vector<std::thread> ths;
+        std::vector<double> waited(3);
+        for (int k = 0; k < 3; ++k)
+            ths.emplace_back([&, k] {
+                uint32_t lane = e.alloc_lane();
+                auto t0 = std::chrono::steady_clock::now();
+                e.pace(64 << 10, lane);
+                waited[k] = std::chrono::duration<double>(
+                                std::chrono::steady_clock::now() - t0)
+                                .count();
+                e.release_lane(lane);
+            });
+        for (auto &t : ths) t.join();
+        for (int k = 0; k < 3; ++k) CHECK(waited[k] >= 0.080);
+    }
+
+    // (4) lane ids recycle: release makes the slot reusable
+    {
+        Edge e;
+        uint32_t a = e.alloc_lane(), b = e.alloc_lane();
+        CHECK(a != b && a != 0 && b != 0);
+        e.release_lane(a);
+        CHECK(e.alloc_lane() == a);
+    }
+
+    // (5) per-flow cwnd cap: one lane is window-limited to cwnd/rtt even
+    // on an idle edge; two lanes double the aggregate (the fat-long-pipe
+    // physics striping exists for), never past the edge rate
+    {
+        EdgeParams p;
+        p.mbps = 800;          // 100 MB/s edge
+        p.rtt_ms = 40;         // rtt so the window binds
+        p.cwnd_bytes = 1 << 20;  // 1 MiB / 40 ms = 25 MB/s per flow
+        Edge e(p);
+        CHECK(e.pace_enabled());
+        uint32_t lane = e.alloc_lane();
+        auto t0 = std::chrono::steady_clock::now();
+        for (int i = 0; i < 16; ++i) e.pace(64 << 10, lane);  // 1 MiB
+        double s = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+        e.release_lane(lane);
+        CHECK(s >= 0.038);  // 1 MiB at 25 MB/s = 40 ms (not 10 ms at edge rate)
+        CHECK(s < 0.150);
+        // two flows: each window-capped, aggregate ~2x
+        std::vector<std::thread> ths;
+        auto t1 = std::chrono::steady_clock::now();
+        for (int k = 0; k < 2; ++k)
+            ths.emplace_back([&] {
+                uint32_t l = e.alloc_lane();
+                for (int i = 0; i < 16; ++i) e.pace(64 << 10, l);
+                e.release_lane(l);
+            });
+        for (auto &t : ths) t.join();
+        double s2 = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - t1)
+                        .count();
+        CHECK(s2 >= 0.038);  // 2 MiB at 2 x 25 MB/s = 40 ms
+        CHECK(s2 < 0.150);   // NOT serialized to 80 ms: flows are parallel
+    }
+}
+
 // Straggler-failover delivery + dedupe (SinkTable::deliver_window,
 // docs/05): first arrival wins byte-exactly, duplicates and late copies
 // for completed tags are dropped AND counted, windows racing registration
@@ -1651,6 +1786,41 @@ static void test_e2e_pipelined() {
     unsetenv("PCCLT_CMA");
 }
 
+// Multipath striping matrix (docs/08): stripes x {uring on/off} x
+// {fp32, zps} x {qwin off/on} over the CMA-less pipelined plane.
+// test_e2e verifies the reduction element-wise (fp32 small-int sums are
+// exact — any cross-stripe reassembly error shows up as a wrong element,
+// not a tolerance miss) and the shared-state sync after it proves the
+// control plane survived. PCCLT_STRIPE_CONNS alone grows the client
+// pools (Client::pool_width), so no API plumbing is needed here.
+static void test_e2e_striped() {
+    setenv("PCCLT_CMA", "0", 1);
+    setenv("PCCLT_PIPELINE", "1", 1);
+    setenv("PCCLT_PIPELINE_MIN_BYTES", "256", 1);
+    setenv("PCCLT_STRIPE_CONNS", "2", 1);
+    test_e2e(3, proto::QuantAlgo::kNone);
+    test_e2e(3, proto::QuantAlgo::kZeroPointScale);
+    setenv("PCCLT_URING", "0", 1);  // poll-loop rung under striping
+    test_e2e(2, proto::QuantAlgo::kNone);
+    unsetenv("PCCLT_URING");
+    // per-window quantization meta + quantized cross-stage send-ahead
+    setenv("PCCLT_QWIN_META", "1", 1);
+    test_e2e(3, proto::QuantAlgo::kZeroPointScale);
+    if (!fast_mode()) {
+        setenv("PCCLT_STRIPE_CONNS", "4", 1);
+        test_e2e(4, proto::QuantAlgo::kNone);
+        test_e2e(3, proto::QuantAlgo::kZeroPointScale);
+        // qwin without striping: the send-ahead path alone
+        setenv("PCCLT_STRIPE_CONNS", "1", 1);
+        test_e2e(3, proto::QuantAlgo::kMinMax);
+    }
+    unsetenv("PCCLT_QWIN_META");
+    unsetenv("PCCLT_STRIPE_CONNS");
+    unsetenv("PCCLT_PIPELINE");
+    unsetenv("PCCLT_PIPELINE_MIN_BYTES");
+    unsetenv("PCCLT_CMA");
+}
+
 static void test_e2e_abort_mid_ring() {
     uint16_t port = alloc_test_ports(512);
     master::Master mm(port);
@@ -1725,6 +1895,7 @@ int main() {
     test_telemetry();
     test_observability();
     test_chaos_schedule();
+    test_netem_striped_bucket();
     test_watchdog();
     test_wire();
     test_hash();
@@ -1770,6 +1941,9 @@ int main() {
     printf("e2e world=2 concurrent tags: %s\n", g_failures ? "FAIL" : "ok");
     test_e2e_pipelined();
     printf("e2e pipelined data plane (fallback matrix): %s\n",
+           g_failures ? "FAIL" : "ok");
+    test_e2e_striped();
+    printf("e2e multipath striping matrix (stripes x uring x quant x qwin): %s\n",
            g_failures ? "FAIL" : "ok");
     test_e2e_abort_mid_ring();
     printf("e2e world=3 abort mid-ring: %s\n", g_failures ? "FAIL" : "ok");

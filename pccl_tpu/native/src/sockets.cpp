@@ -872,7 +872,15 @@ void SinkTable::unregister_sink(uint64_t tag) {
 
 std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
     uint64_t tag, int timeout_ms, const std::atomic<bool> *abort) {
-    std::optional<std::vector<uint8_t>> out;
+    auto got = recv_queued_any(tag, timeout_ms, abort);
+    if (!got) return std::nullopt;
+    return std::move(got->second);
+}
+
+std::optional<std::pair<uint64_t, std::vector<uint8_t>>>
+SinkTable::recv_queued_any(uint64_t tag, int timeout_ms,
+                           const std::atomic<bool> *abort) {
+    std::optional<std::pair<uint64_t, std::vector<uint8_t>>> out;
     park::wait_event(shard_ev(tag), timeout_ms, [&] {
         bool dead;
         {
@@ -881,9 +889,14 @@ std::optional<std::vector<uint8_t>> SinkTable::recv_queued(
             if (it != queues_.end() && !it->second.empty()) {
                 auto v = std::move(it->second.front());
                 it->second.pop_front();
-                // strip the 8-byte offset prefix queued frames carry
-                if (v.size() >= 8) v.erase(v.begin(), v.begin() + 8);
-                out = std::move(v);
+                // queued frames carry their wire offset in the first 8
+                // bytes (host order, written by the RX thread)
+                uint64_t off = 0;
+                if (v.size() >= 8) {
+                    memcpy(&off, v.data(), 8);
+                    v.erase(v.begin(), v.begin() + 8);
+                }
+                out = {off, std::move(v)};
                 return true;
             }
             dead = !members_.empty();
@@ -1012,7 +1025,15 @@ MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table,
 }
 
 void MultiplexConn::set_wire_peer(const Addr &peer) {
-    wire_ = netem::Registry::inst().resolve(peer);
+    auto resolved = netem::Registry::inst().resolve(peer);
+    if (resolved != wire_) {
+        // striped-bucket lane: one fair-share pacing lane per conn on its
+        // edge, moved (released + re-allocated) when a rekey lands the
+        // conn on a different Edge object
+        if (wire_) wire_->release_lane(lane_.load(std::memory_order_relaxed));
+        lane_.store(resolved->alloc_lane(), std::memory_order_relaxed);
+    }
+    wire_ = std::move(resolved);
     // per-edge telemetry keys by the same canonical endpoint as the wire
     // model; an accepted conn lands on the ephemeral source port until the
     // P2P hello rekeys it (bytes moved before that are handshake-free —
@@ -1179,7 +1200,7 @@ bool MultiplexConn::write_frame(Kind kind, uint64_t tag, uint64_t off,
     // head-of-line-block other frames on the conn. Reordering is safe —
     // within a tag only one thread streams (offsets carried per frame), and
     // the order-sensitive shm announce path is disabled under pacing.
-    wire_->pace(21 + payload.size());
+    wire_->pace(21 + payload.size(), lane_.load(std::memory_order_relaxed));
     if (kind == kData) {
         // per-edge data-plane accounting: payload bytes only (headers and
         // control frames excluded), so a ring op's per-edge tx total equals
@@ -1219,6 +1240,49 @@ bool MultiplexConn::cma_post_desc(uint64_t tag, uint64_t off,
     return ok;
 }
 
+// Lazy MSG_ZEROCOPY notif reaping (docs/08). Non-blocking: scoop whatever
+// notifs have already posted — the per-submit drop-in point. Blocking:
+// wait out every outstanding notif (quiescence: close(), ring teardown).
+// Either way the deferred backlog is bounded (kZcLazyCap, under the CQ
+// capacity) so deferred notifs can never overflow the completion ring.
+void MultiplexConn::reap_zc(bool block) {
+    if (!tx_ring_) {
+        // the ring (and its fd) is gone: teardown released every pinned
+        // page, so stragglers are charged here to keep the documented
+        // tx_zc_reaps == tx_zc_frames quiescence invariant exact
+        if (zc_unreaped_) {
+            edge().tx_zc_reaps.fetch_add(zc_unreaped_,
+                                         std::memory_order_relaxed);
+            zc_unreaped_ = 0;
+        }
+        zc_unreaped_hint_.store(0, std::memory_order_relaxed);
+        return;
+    }
+    constexpr unsigned kZcLazyCap = 24;  // CQ holds 2*2*kBatch = 64
+    uring::Ring::Cqe c;
+    while (zc_unreaped_ > 0) {
+        bool got = (block || zc_unreaped_ > kZcLazyCap)
+                       ? tx_ring_->next_cqe(c)
+                       : tx_ring_->peek_cqe(c);
+        if (!got) break;  // nothing posted yet (or ring failure while
+                          // blocking: teardown will charge the remainder)
+        if (c.flags & uring::kCqeFNotif) {
+            edge().tx_zc_reaps.fetch_add(1, std::memory_order_relaxed);
+            --zc_unreaped_;
+        }
+        // non-notif CQEs cannot appear: every batch drains its own send
+        // completions before returning — dropping one here is still safe
+        // (the stream that owned it has already failed its conn)
+    }
+    zc_unreaped_hint_.store(zc_unreaped_, std::memory_order_relaxed);
+}
+
+void MultiplexConn::drop_tx_ring() {
+    if (tx_ring_) reap_zc(/*block=*/true);  // drain what the ring still owes
+    tx_ring_.reset();
+    reap_zc(/*block=*/true);  // ring gone: charge any stragglers
+}
+
 bool MultiplexConn::stream_payload(const SendReq &req) {
     // io_uring path when the payload spans several frames (batched
     // submission pays) or a single frame is zerocopy-eligible; everything
@@ -1232,6 +1296,11 @@ bool MultiplexConn::stream_payload(const SendReq &req) {
         return stream_payload_uring(req);
     size_t off = 0;
     do {
+        // early-retire poll (frame boundary): a cancelled stream stops
+        // here with the socket healthy; the caller fails the handle
+        if (req.state &&
+            req.state->cancel.load(std::memory_order_relaxed))
+            return true;
         size_t n = std::min(tx_chunk_, req.span.size() - off);
         if (!write_frame(kData, req.tag, req.off + off, req.span.subspan(off, n)))
             return false;
@@ -1247,9 +1316,19 @@ bool MultiplexConn::stream_payload(const SendReq &req) {
 // payload together (never two sendmsg calls), links preserving TCP stream
 // order, MSG_WAITALL making every completion all-or-error. Frames at or
 // above zc_min_ go as SENDMSG_ZC: the kernel pins the payload pages
-// instead of copying, and the frame's pages stay borrowed until its
-// completion NOTIF is reaped — all notifs are drained before returning, so
-// the caller's span-validity contract is unchanged.
+// instead of copying. Completion NOTIFs are reaped LAZILY (docs/08): a
+// batch blocks only for its SEND completions and scoops whatever notifs
+// have already posted; the remainder are swept by later submits, the idle
+// TX loop, and close() — so a stream never stalls waiting for the peer's
+// ACK clock, and tx_zc_frames == tx_zc_reaps still holds at quiescence.
+// CAVEAT, documented deliberately: a notif outstanding past handle
+// completion means the kernel may still reference the pinned pages for a
+// TCP retransmit, so rewriting the span before the notif lands could put
+// the NEW bytes on the wire. This plane runs over loopback (the emulated
+// WANs pace loopback sockets), where segments are never lost and
+// retransmits do not occur; on a real lossy wire the lazy window
+// (bounded at kZcLazyCap) would have to shrink to zero — synchronous
+// reaping — or sends would need owned buffers.
 bool MultiplexConn::stream_payload_uring(const SendReq &req) {
     constexpr size_t kBatch = 16;
     struct Slot {
@@ -1272,6 +1351,10 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
     const size_t batch_cap = wire_->pace_enabled() ? 2 : kBatch;
     size_t off = 0;
     while (off < total) {
+        // early-retire poll (batch boundary), mirroring stream_payload's
+        if (req.state &&
+            req.state->cancel.load(std::memory_order_relaxed))
+            return true;
         size_t nb = 0;
         while (nb < batch_cap && off < total) {
             size_t n = std::min(tx_chunk_, total - off);
@@ -1297,7 +1380,7 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
             // kernel confirms it pinned the pages (the F_MORE completion in
             // the reap loop) — a fallback-to-plain or failed ZC send must
             // not leave the tx_zc_reaps == tx_zc_frames invariant broken.
-            wire_->pace(21 + n);
+            wire_->pace(21 + n, lane_.load(std::memory_order_relaxed));
             edge().tx_frames.fetch_add(1, std::memory_order_relaxed);
             edge().tx_bytes.fetch_add(n, std::memory_order_relaxed);
             off += n;
@@ -1325,12 +1408,15 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
             return sock_.send_all(pay + (sl.sent - 21), pn - (sl.sent - 21));
         };
         if (tx_uring_down_) {
-            tx_ring_.reset();  // dead ring: free the fd + mmaps
+            drop_tx_ring();  // dead ring: free the fd + mmaps
             for (size_t i = 0; i < nb; ++i)
                 if (!plain_frame(slots[i])) return false;
             continue;
         }
-        unsigned expect = 0;
+        // drop-in reap point: notifs for EARLIER batches that have posted
+        // by now cost one ring peek each here, zero waiting
+        reap_zc(/*block=*/false);
+        unsigned expect = 0;  // SEND completions only; notifs reap lazily
         for (size_t i = 0; i < nb; ++i) {
             uring::Sqe *sqe = tx_ring_->get_sqe();
             if (!sqe) {  // cannot happen at 2*kBatch entries; stay safe
@@ -1344,10 +1430,10 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
             sqe->msg_flags = MSG_NOSIGNAL | MSG_WAITALL;
             sqe->user_data = i;
             if (i + 1 < nb) sqe->flags |= uring::kSqeIoLink;
-            expect += slots[i].zc ? 2u : 1u;
+            ++expect;
         }
         if (tx_uring_down_) {
-            tx_ring_.reset();  // nothing submitted: safe to free now
+            drop_tx_ring();  // nothing submitted: safe to free now
             for (size_t i = 0; i < nb; ++i)
                 if (!plain_frame(slots[i])) return false;
             continue;
@@ -1356,7 +1442,7 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
         if (rc < 0) {
             // enter() errors without consuming: nothing is in flight
             tx_uring_down_ = true;
-            tx_ring_.reset();
+            drop_tx_ring();
             PLOG(kWarn) << "io_uring submit failed (" << strerror(-rc)
                         << "); falling back to the poll loop";
             for (size_t i = 0; i < nb; ++i)
@@ -1370,28 +1456,30 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
             // in order, and the ring is abandoned (a reap loop sized to the
             // full batch would wait forever for CQEs that never come)
             tx_uring_down_ = true;
-            expect = 0;
-            for (int i = 0; i < rc; ++i) expect += slots[i].zc ? 2u : 1u;
+            expect = static_cast<unsigned>(rc);
         }
         bool hard_fail = false;
-        unsigned reaped = 0;
-        while (reaped < expect) {
+        unsigned sends_seen = 0;
+        while (sends_seen < expect) {
             uring::Ring::Cqe c;
             if (!tx_ring_->next_cqe(c)) return false;
-            ++reaped;
-            Slot &sl = slots[c.user_data];
             if (c.flags & uring::kCqeFNotif) {
-                // zerocopy pages released by the kernel
+                // zerocopy pages released by the kernel — this batch's or a
+                // lazily-deferred notif from an earlier one, same counter
                 edge().tx_zc_reaps.fetch_add(1, std::memory_order_relaxed);
+                if (zc_unreaped_) --zc_unreaped_;
                 continue;
             }
-            if (sl.zc && (c.flags & uring::kCqeFMore))
+            ++sends_seen;
+            Slot &sl = slots[c.user_data];
+            if (sl.zc && (c.flags & uring::kCqeFMore)) {
                 // pages pinned, notif guaranteed to follow: THIS is a
                 // zerocopy frame (reap-side charge keeps the documented
-                // reaps == frames invariant exact on every fallback path)
+                // reaps == frames invariant exact on every fallback path).
+                // The notif itself reaps lazily — count it outstanding.
                 edge().tx_zc_frames.fetch_add(1, std::memory_order_relaxed);
-            if (sl.zc && !(c.flags & uring::kCqeFMore))
-                --expect;  // failed/short ZC send posts no notif
+                ++zc_unreaped_;
+            }
             if (c.res == -ECANCELED) {
                 // link chain broken by an earlier failure; recovered below
             } else if (c.res < 0) {
@@ -1402,10 +1490,14 @@ bool MultiplexConn::stream_payload_uring(const SendReq &req) {
                 sl.sent = static_cast<uint32_t>(c.res);  // short: finish below
             }
         }
+        // scoop already-posted notifs (and cap the deferred backlog so it
+        // can never overflow the CQ ring) without waiting for the rest
+        reap_zc(/*block=*/false);
+        zc_unreaped_hint_.store(zc_unreaped_, std::memory_order_relaxed);
         if (hard_fail) return false;  // real socket error: the conn is dying
         // a short submission latched tx_uring_down_ above; its in-flight
         // CQEs are now drained, so the dead ring can be freed like RX does
-        if (tx_uring_down_) tx_ring_.reset();
+        if (tx_uring_down_) drop_tx_ring();
         // rare recovery (signal-shortened send / canceled chain tail):
         // complete the stream in order on the plain path
         for (size_t i = 0; i < nb; ++i)
@@ -1419,6 +1511,13 @@ void MultiplexConn::tx_loop() {
         mpsc::Node *n = txq_.pop();
         if (!n) {
             if (closing_.load() || !alive_.load()) break;
+            // idle sweep for lazily-deferred zerocopy notifs: with no
+            // further submits coming, this is what converges
+            // tx_zc_reaps == tx_zc_frames at quiescence without a close
+            if (zc_unreaped_hint_.load(std::memory_order_relaxed) > 0) {
+                MutexLock lk(wr_mu_);
+                if (tx_ring_) reap_zc(/*block=*/false);
+            }
             uint32_t e = tx_ev_.epoch();
             if ((n = txq_.pop()) == nullptr) {
                 tx_ev_.wait(e, 100);
@@ -1429,14 +1528,23 @@ void MultiplexConn::tx_loop() {
         bool sock_ok = true;
         switch (req->kind) {
         case kData:
-            if (req->allow_cma && cma_ok_.load() && req->span.size() >= cma_min_) {
+            if (req->state &&
+                req->state->cancel.load(std::memory_order_relaxed)) {
+                // early-retired (relay ack covered the span): fail the
+                // handle without touching the span — the conn lives on
+                req->state->complete(false);
+            } else if (req->allow_cma && cma_ok_.load() &&
+                       req->span.size() >= cma_min_) {
                 // same-host fast path (queued variant; the common route is
                 // the inline post in send_async). Completion is deferred to
                 // the receiver's ack (rx_loop).
                 sock_ok = cma_post_desc(req->tag, req->off, req->span, req->state);
             } else {
                 sock_ok = stream_payload(*req);
-                if (req->state) req->state->complete(sock_ok);
+                if (req->state)
+                    req->state->complete(
+                        sock_ok && !req->state->cancel.load(
+                                       std::memory_order_relaxed));
             }
             break;
         case kCmaAck:
@@ -1446,6 +1554,7 @@ void MultiplexConn::tx_loop() {
             break;
         case kRelayFwd:
         case kRelayDeliver:
+        case kRelayAck:
             // one frame per window (windows are pipeline-granular, well
             // under the frame cap); tag/off are the ORIGINAL coordinates
             sock_ok = write_frame(req->kind, req->tag, req->off, req->span);
@@ -2092,6 +2201,21 @@ void MultiplexConn::rx_loop() {
             continue;
         }
 
+        if (kind == kRelayAck) {
+            // end-to-end relay delivery ack (docs/05): the final receiver
+            // confirms [off, off+len) of `tag` landed, letting the origin
+            // retire the stalled direct copy early. Fire-and-forget; an
+            // unrouted ack (standalone conn) is dropped harmlessly.
+            std::vector<uint8_t> buf(n);
+            if (n > 0 && !sock_.recv_all(buf.data(), n)) break;
+            if (relay_ack_ && n >= 8) {
+                uint64_t len;
+                memcpy(&len, buf.data(), 8);
+                relay_ack_(tag, off, wire::from_be(len));
+            }
+            continue;
+        }
+
         // kData — sink fast path: read straight into the registered
         // destination at the frame's offset. busy guards the buffer against
         // unregister/purge while we write outside the lock; the frame is
@@ -2339,6 +2463,15 @@ void MultiplexConn::close() {
     sock_.shutdown();
     if (tx_thread_.joinable()) tx_thread_.join();
     if (rx_thread_.joinable()) rx_thread_.join();
+    {
+        // lazily-deferred MSG_ZEROCOPY notifs: the shutdown above freed the
+        // socket's skbs, so every outstanding notif is posted (or posts
+        // promptly) — drain them so tx_zc_reaps == tx_zc_frames holds at
+        // quiescence, then drop the ring
+        MutexLock wlk(wr_mu_);
+        drop_tx_ring();
+    }
+    if (wire_) wire_->release_lane(lane_.load(std::memory_order_relaxed));
     // drain stragglers that were pushed before the gate closed
     mpsc::Node *n;
     while ((n = txq_.pop()) != nullptr) {
@@ -2419,6 +2552,21 @@ bool Link::cma_eligible() const {
 SendHandle Link::send_meta(uint64_t tag, std::vector<uint8_t> payload) {
     for (const auto &c : conns_)
         if (c && c->alive()) return c->send_copy(tag, std::move(payload));
+    auto st = std::make_shared<SendState>();
+    st->complete(false);
+    return st;
+}
+
+SendHandle Link::send_meta_at(uint64_t tag, uint64_t off,
+                              std::vector<uint8_t> payload) {
+    // per-window quantization metas (docs/08): offset-keyed small owned
+    // frames; tag has no sink, so the receiver reads them back through
+    // recv_queued_any. Rotating conns would gain nothing (metas are ~100 B)
+    // — any live conn serves.
+    for (const auto &c : conns_)
+        if (c && c->alive())
+            return c->send_owned(MultiplexConn::kData, tag, off,
+                                 std::move(payload));
     auto st = std::make_shared<SendState>();
     st->complete(false);
     return st;

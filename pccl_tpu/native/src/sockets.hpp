@@ -213,6 +213,8 @@ private:
     std::function<void()> on_disconnect_;
 };
 
+class MultiplexConn;
+
 // --- data-plane send completion handle ---
 struct SendState {
     std::atomic<int> status{0}; // 0 pending, 1 ok, 2 failed
@@ -221,6 +223,14 @@ struct SendState {
     // streaming the same bytes over TCP
     uint64_t tag = 0, off = 0;
     std::span<const uint8_t> span;
+    // early-retire request (docs/05): once a relay delivery ack covers
+    // this span, the remaining DIRECT frames are pure dead weight — the
+    // TX path checks this at frame boundaries and fails the handle
+    // without touching the span again, so the zombie drain ends in at
+    // most one in-flight frame instead of the whole span at the degraded
+    // rate. The conn itself stays alive (it may be the op's only pool
+    // conn, still carrying metas and later re-probes).
+    std::atomic<bool> cancel{false};
 
     // true once the send completed successfully; false on failure or timeout
     bool wait(int timeout_ms = -1) const;
@@ -231,8 +241,6 @@ struct SendState {
     bool done() const { return status.load(std::memory_order_acquire) != 0; }
 };
 using SendHandle = std::shared_ptr<SendState>;
-
-class MultiplexConn;
 
 // --- SinkTable: registered RX destinations, shared across a conn pool ---
 class SinkTable {
@@ -260,6 +268,14 @@ public:
     // frames for tags with no sink land in a per-tag queue.
     std::optional<std::vector<uint8_t>> recv_queued(uint64_t tag, int timeout_ms = -1,
                                                     const std::atomic<bool> *abort = nullptr);
+    // Same, but also returns the frame's wire OFFSET. The per-window
+    // quantization-meta protocol (docs/08) keys meta frames by offset
+    // (0 = legacy whole-chunk meta, w+1 = window w's meta) and frames
+    // arrive in any order across striped conns — the caller sorts them
+    // by the returned offset.
+    std::optional<std::pair<uint64_t, std::vector<uint8_t>>> recv_queued_any(
+        uint64_t tag, int timeout_ms = -1,
+        const std::atomic<bool> *abort = nullptr);
 
     // Fused same-host consume: if a CMA descriptor covering exactly [0, len)
     // is pending for `tag` (registered consumer_pull), pull it on the CALLING
@@ -439,9 +455,17 @@ public:
     using RelayDeliverFn = std::function<void(const uint8_t *origin_uuid,
                                               uint64_t tag, uint64_t off,
                                               std::vector<uint8_t> bytes)>;
-    void set_relay_handlers(RelayFwdFn fwd, RelayDeliverFn deliver) {
+    // End-to-end relay delivery ack (kRelayAck): the final receiver tells
+    // the ORIGIN that [off, off+len) of `tag` was delivered, so the origin
+    // can retire the stalled direct copy (zombie) early. Runs on the RX
+    // thread holding no lock; must not block.
+    using RelayAckFn = std::function<void(uint64_t tag, uint64_t off,
+                                          uint64_t len)>;
+    void set_relay_handlers(RelayFwdFn fwd, RelayDeliverFn deliver,
+                            RelayAckFn ack = nullptr) {
         relay_fwd_ = std::move(fwd);
         relay_deliver_ = std::move(deliver);
+        relay_ack_ = std::move(ack);
     }
 
     SinkTable &table() { return *table_; }
@@ -481,6 +505,12 @@ public:
         // tx/rx byte counters (relayed payload is accounted separately).
         kRelayFwd = 8,
         kRelayDeliver = 9,
+        // end-to-end relay delivery ack (docs/05): final receiver ->
+        // origin, over the receiver's own (reverse-direction) link to the
+        // origin. tag/off are the ORIGINAL window coordinates; payload is
+        // the delivered length as a BE u64. Fire-and-forget; lets the
+        // origin retire CONFIRMED-stalled zombies before op end.
+        kRelayAck = 10,
     };
 
 private:
@@ -625,6 +655,14 @@ private:
     // relay routing (set before run(), RX-thread-read only)
     RelayFwdFn relay_fwd_;
     RelayDeliverFn relay_deliver_;
+    RelayAckFn relay_ack_;
+
+    // striped-bucket pacing lane on wire_ (docs/08 multipath striping):
+    // allocated at construction / set_wire_peer rekey, released on close,
+    // so every pool conn paces in its own fair-share sub-schedule of the
+    // shared per-edge bucket instead of head-of-line-blocking the others.
+    // Atomic for the same reason as edge_: socktest rekeys a live conn.
+    std::atomic<uint32_t> lane_{0};
 
     // io_uring data plane (uring.hpp): sampled once at construction (env
     // gate × kernel probe), so a test flipping PCCLT_URING affects the
@@ -638,6 +676,19 @@ private:
     size_t zc_min_ = 0;  // MSG_ZEROCOPY threshold; 0 = zerocopy off
     std::unique_ptr<uring::Ring> tx_ring_ PCCLT_GUARDED_BY(wr_mu_);
     bool tx_uring_down_ PCCLT_GUARDED_BY(wr_mu_) = false;
+    // MSG_ZEROCOPY notifs submitted but not yet reaped (lazy reaping,
+    // docs/08): later submits, the idle TX loop, and close() scoop them
+    // opportunistically instead of each stream draining synchronously —
+    // tx_zc_frames == tx_zc_reaps still holds at quiescence. The atomic
+    // mirror lets the TX loop check for pending notifs without wr_mu_.
+    unsigned zc_unreaped_ PCCLT_GUARDED_BY(wr_mu_) = 0;
+    std::atomic<unsigned> zc_unreaped_hint_{0};
+    // reap posted CQEs without blocking; block==true additionally waits
+    // for every outstanding notif (close-time quiescence)
+    void reap_zc(bool block) PCCLT_REQUIRES(wr_mu_);
+    // drain-then-free the TX ring (fallback/teardown paths): keeps the
+    // reap accounting exact across every rung of the fallback ladder
+    void drop_tx_ring() PCCLT_REQUIRES(wr_mu_);
     std::unique_ptr<uring::Ring> rx_ring_;  // RX-thread-only
     bool rx_uring_down_ = false;
 };
@@ -653,6 +704,9 @@ public:
     bool valid() const { return !conns_.empty() && table_; }
     bool alive() const;
     SinkTable &table() { return *table_; }
+    // pool width: the upper bound on how many ways a window chain can
+    // stripe (reduce.cpp clamps PCCLT_STRIPE_CONNS against this)
+    size_t size() const { return conns_.size(); }
 
     // Send payload for `tag`, striping across the pool when it pays off
     // (TCP path, large payloads). Same-host CMA sends go as a single
@@ -669,6 +723,11 @@ public:
     SendHandle send_at(uint64_t tag, uint64_t off, std::span<const uint8_t> payload,
                        size_t rot = 0);
     SendHandle send_meta(uint64_t tag, std::vector<uint8_t> payload);
+    // Owned small frame at an explicit wire offset, queued to the TX
+    // thread (per-window quantization metas: offset 0 is the legacy
+    // whole-chunk meta, w+1 is window w's — recv_queued_any reads it back)
+    SendHandle send_meta_at(uint64_t tag, uint64_t off,
+                            std::vector<uint8_t> payload);
     // any live pool conn negotiated the same-host CMA transport (the
     // pipelined window path steps aside for the fused zero-copy claim)
     bool cma_eligible() const;

@@ -137,6 +137,9 @@ std::vector<EdgeSnapshot> Domain::snapshot_edges() const {
             e->rx_relay_windows.load(std::memory_order_relaxed);
         s.dup_bytes = e->dup_bytes.load(std::memory_order_relaxed);
         s.dup_windows = e->dup_windows.load(std::memory_order_relaxed);
+        s.tx_stripe_windows =
+            e->tx_stripe_windows.load(std::memory_order_relaxed);
+        s.tx_stripe_bytes = e->tx_stripe_bytes.load(std::memory_order_relaxed);
         s.stage_wire_hist = e->stage_wire_hist.snapshot();
         s.stall_hist = e->stall_hist.snapshot();
         out.push_back(std::move(s));

@@ -211,6 +211,12 @@ struct EdgeCounters {
     // the sync traffic must not dilute.
     std::atomic<uint64_t> tx_sync_bytes{0};
     std::atomic<uint64_t> rx_sync_bytes{0};
+    // ---- multipath striping (docs/08) ----
+    // windows (and their payload bytes) submitted round-robin across the
+    // pool by the striped window scheduler (PCCLT_STRIPE_CONNS > 1).
+    // Subset of tx_bytes/tx_frames — accounting, not conservation.
+    std::atomic<uint64_t> tx_stripe_windows{0};
+    std::atomic<uint64_t> tx_stripe_bytes{0};
     // ---- critical-path attribution (docs/09) ----
     // latency distributions for the two phases where the EDGE is the
     // attribution key: per-ring-stage wall time on the inbound hop, and
@@ -241,6 +247,11 @@ struct CommCounters {
     // straggler-immune data plane: windows this peer forwarded as the
     // RELAY hop (neither sender nor final receiver of the window)
     std::atomic<uint64_t> relay_forwarded{0};
+    // end-to-end relay delivery acks received back at the ORIGIN
+    // (kRelayAck), and CONFIRMED-stalled zombie sends retired early
+    // because an ack fully covered their span (docs/05)
+    std::atomic<uint64_t> relay_acks{0};
+    std::atomic<uint64_t> relay_retired_early{0};
     // ---- shared-state chunk plane (docs/04) ----
     // Conservation identity at sync completion (asserted by the swarm
     // bench): ss_chunk_bytes_fetched + ss_chunk_bytes_resourced -
@@ -266,6 +277,7 @@ struct EdgeSnapshot {
              rx_relay_bytes = 0, rx_relay_windows = 0, dup_bytes = 0,
              dup_windows = 0;
     uint64_t tx_sync_bytes = 0, rx_sync_bytes = 0;
+    uint64_t tx_stripe_windows = 0, tx_stripe_bytes = 0;
     HistSnapshot stage_wire_hist, stall_hist;
 };
 

@@ -223,6 +223,17 @@ int Ring::submit() {
     }
 }
 
+bool Ring::peek_cqe(Cqe &out) {
+    uint32_t head = *cq_khead_;
+    uint32_t tail = load_acq(cq_ktail_);
+    if (head == tail) return false;
+    const auto *c = reinterpret_cast<const CqeRaw *>(
+        cqes_ + (head & cq_mask_) * sizeof(CqeRaw));
+    out = {c->user_data, c->res, c->flags};
+    store_rel(cq_khead_, head + 1);
+    return true;
+}
+
 bool Ring::next_cqe(Cqe &out) {
     while (true) {
         uint32_t head = *cq_khead_;

@@ -111,6 +111,10 @@ public:
     // Block until a completion is available and pop it. false on a hard
     // ring error (caller fails the stream like any socket error).
     bool next_cqe(Cqe &out);
+    // Pop a completion only if one is already posted (no kernel wait).
+    // Backs the lazy MSG_ZEROCOPY notif reaping: later submits scoop
+    // earlier batches' notifs without ever blocking for them.
+    bool peek_cqe(Cqe &out);
 
 private:
     void unmap();

@@ -406,7 +406,8 @@ CHAOS_PEER = REPO / "tests" / "chaos_peer.py"
 
 
 def _run_chaos_world(world: int, count: int, steps: int, fault_at: int,
-                     fault: str, watchdog: str, port_base: int):
+                     fault: str, watchdog: str, port_base: int,
+                     extra_env: dict | None = None):
     """Launch a wire_topology-emulated world of chaos_peer subprocesses and
     return {rank: parsed-json}. The victim (rank 0) injects `fault` on its
     outbound ring edge before step `fault_at` via pccltNetemInject."""
@@ -425,7 +426,8 @@ def _run_chaos_world(world: int, count: int, steps: int, fault_at: int,
         # every peer, so the mid-run injection retunes the LIVE edge
         with wire_topology(world, port_base, mbps=300.0) as envs:
             for r in range(world):
-                env = {**envs[r], "PCCLT_WATCHDOG": watchdog}
+                env = {**envs[r], "PCCLT_WATCHDOG": watchdog,
+                       **(extra_env or {})}
                 cmd = [sys.executable, str(CHAOS_PEER),
                        "--master-port", str(master.port), "--rank", str(r),
                        "--world", str(world), "--port-base", str(port_base),
@@ -543,6 +545,69 @@ def test_mid_collective_degradation_failover():
     for r in range(world):
         for e in unprot[r]["stats"]["edges"].values():
             assert e["wd_relays"] == 0 and e["rx_relay_bytes"] == 0, (r, e)
+
+
+@pytest.mark.slow
+def test_striped_degradation_failover():
+    """ISSUE-15 acceptance: the fault ladder composes with multipath
+    striping. Same scripted mid-collective degrade as the ISSUE-10 test,
+    but with PCCLT_STRIPE_CONNS=2 — windows ride two pool conns per edge,
+    and a stalled stripe re-issues/relays PER STRIPE without dragging the
+    healthy one. Recovery inside the hold, zero aborts/kicks, bit-identical
+    results, exact delivered-unique conservation across stripes + relays +
+    dedupe, and detoured windows striped across >= 2 relay neighbors (the
+    PR-10 single-neighbor funnel is gone)."""
+    from conftest import alloc_ports
+
+    world, count = 4, 1 << 19
+    nbytes = count * 4
+    fault = "degrade@t=0s:10mbit/300s"
+    env = {"PCCLT_STRIPE_CONNS": "2"}
+
+    prot = _run_chaos_world(world, count, steps=9, fault_at=4, fault=fault,
+                            watchdog="1", port_base=alloc_ports(span=2300),
+                            extra_env=env)
+
+    # recovery: post-fault steps return under 2x the healthy median
+    p_steps = prot[0]["steps"]
+    base = sorted(p_steps[1:4])[1]
+    assert min(p_steps[4:7]) < 2 * base, (base, p_steps)
+    assert all(s < 2 * base for s in p_steps[6:]), (base, p_steps)
+
+    # all ranks agree bit-exactly; zero aborts/kicks anywhere
+    assert len({r["digest"] for r in prot.values()}) == 1
+    for r in range(world):
+        ctr = prot[r]["stats"]["counters"]
+        assert ctr["collectives_aborted"] == 0, (r, ctr)
+        assert ctr["kicked"] == 0, (r, ctr)
+
+    # striping engaged on every peer's outbound edge, and the ladder ran
+    # per stripe on exactly one (the degraded) edge
+    striped = sum(e["tx_stripe_windows"] for p in prot.values()
+                  for e in p["stats"]["edges"].values())
+    assert striped > 0, "striping never engaged"
+    victims = [(r, e) for r in range(world)
+               for e in prot[r]["stats"]["edges"].values() if e["wd_relays"]]
+    assert len(victims) == 1, victims
+    assert victims[0][1]["wd_confirms"] >= 1, victims
+
+    # relay fanout: the victim's detours were forwarded by BOTH healthy
+    # third peers, not funneled through one (world=4 -> 2 candidates)
+    fwd_by_peer = [prot[r]["stats"]["counters"]["relay_forwarded"]
+                   for r in range(world)]
+    assert sum(1 for f in fwd_by_peer if f > 0) >= 2, fwd_by_peer
+
+    # end-to-end delivery acks flowed back to the origin
+    acks = sum(p["stats"]["counters"]["relay_acks"] for p in prot.values())
+    assert acks > 0, [p["stats"]["counters"] for p in prot.values()]
+
+    # delivered-unique conservation stays byte-exact with stripes + relays
+    expected = 9 * (2 * (world - 1) * nbytes // world)
+    for r in range(world):
+        edges = prot[r]["stats"]["edges"]
+        unique = sum(e["rx_bytes"] + e["rx_relay_bytes"] - e["dup_bytes"]
+                     for e in edges.values())
+        assert unique == expected, (r, unique, expected, edges)
 
 
 def test_netem_inject_validation():
