@@ -262,6 +262,17 @@ def main() -> None:
             print(f"bench: sync swarm failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["sync_swarm_speedup"] = None
+        # fleet-scale observability (docs/09): 1000 observer sessions x 8
+        # edges at ~12 Hz through the off-dispatcher ingest queue; the
+        # scrape gate (bounded top-K /metrics < 1 s, promlint-clean, zero
+        # queue drops) plus journal-replay cold-restart cost
+        try:
+            for k, v in native_bench.run_master_scale_bench().items():
+                extra[k] = round(v, 6) if isinstance(v, float) else v
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: master scale failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["master_scale_ingest_rate"] = None
 
     # On-chip model legs: the jitted bf16 train step on the real TPU —
     # tokens/s + MFU per family (skip-guarded when no TPU is attached;

@@ -205,6 +205,21 @@ def main() -> int:
                          "Exit prints a SYNC SUMMARY with gates: zero "
                          "wrong-content adoptions, zero failed syncs on "
                          "live peers.")
+    ap.add_argument("--fleet-scale", type=int, default=0, metavar="N",
+                    help="fleet-scale observability lane (docs/09): a "
+                         "native digest bot keeps N simulated OBSERVER "
+                         "sessions (PCCP/2 hello tail byte; they push "
+                         "telemetry, never join the world) flooding the "
+                         "master for the whole soak while the real peers "
+                         "churn. Exit prints a FLEET SCALE summary with "
+                         "digests pushed, ingest-queue drops, and a "
+                         "promlint verdict on the final /metrics scrape. "
+                         "Requires --metrics-port.")
+    ap.add_argument("--fleet-edges", type=int, default=8,
+                    help="edges per simulated observer for --fleet-scale")
+    ap.add_argument("--fleet-hz", type=float, default=5.0,
+                    help="digest cadence per simulated observer for "
+                         "--fleet-scale")
     ap.add_argument("--sync-chunk-bytes", type=int, default=262144,
                     help="PCCLT_SS_CHUNK_BYTES for --sync-churn peers")
     ap.add_argument("--sync-mbps", type=float, default=250.0,
@@ -253,7 +268,42 @@ def main() -> int:
         for i in range(args.peers):
             chaos_args.setdefault(i, []).extend(sync_args)
 
+    if args.fleet_scale > 0 and args.metrics_port is None:
+        print("--fleet-scale requires --metrics-port (the summary gates on "
+              "the scrape)", flush=True)
+        return 2
+
     master = MasterProc(args.master_port, args.journal, args.metrics_port)
+
+    # fleet-scale digest bot (docs/09): one daemon thread drives the native
+    # flood in short rounds so a master assassination mid-soak just costs
+    # one failed round — the next round's observers reconnect
+    fleet_stop = threading.Event()
+    fleet_sent = [0]
+    fleet_failed_rounds = [0]
+
+    def fleet_bot() -> None:
+        import ctypes
+        from pccl_tpu.comm import _native
+        lib = _native.load()
+        while not fleet_stop.is_set():
+            sent = ctypes.c_uint64(0)
+            wall = ctypes.c_double(0.0)
+            rc = lib.pccltDigestFlood(
+                b"127.0.0.1", args.master_port, args.fleet_scale,
+                args.fleet_edges, args.fleet_hz, 5.0,
+                min(8, max(1, args.fleet_scale // 64)),
+                ctypes.byref(sent), ctypes.byref(wall))
+            fleet_sent[0] += sent.value
+            if rc != 0:
+                fleet_failed_rounds[0] += 1
+                time.sleep(1.0)  # master probably down; back off one beat
+
+    fleet_thread = None
+    if args.fleet_scale > 0:
+        fleet_thread = threading.Thread(target=fleet_bot, daemon=True)
+        fleet_thread.start()
+
     peers: list[Peer] = []
     seed = 1
     total_relaunches = 0
@@ -497,12 +547,45 @@ def main() -> int:
             if sync_events["floods"] == 0 or sync_events["seeder_kills"] == 0:
                 print("SYNC FAILED: churn schedule never fired", flush=True)
                 return 1
+        if args.fleet_scale > 0:
+            fleet_stop.set()
+            if fleet_thread is not None:
+                fleet_thread.join(timeout=30)
+            drops = lint_errs = "n/a"
+            try:
+                import urllib.request
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{args.metrics_port}/metrics",
+                        timeout=10) as r:
+                    text = r.read().decode()
+                for line in text.splitlines():
+                    if line.startswith(
+                            "pcclt_master_digest_queue_dropped_total "):
+                        drops = line.split()[-1]
+                from pccl_tpu.comm import promlint
+                lint_errs = str(len(promlint.lint(text)))
+            except OSError:
+                pass
+            print(f"FLEET SCALE: observers={args.fleet_scale} "
+                  f"digests_pushed={fleet_sent[0]} "
+                  f"failed_rounds={fleet_failed_rounds[0]} "
+                  f"queue_drops={drops} promlint_violations={lint_errs}",
+                  flush=True)
+            if fleet_sent[0] == 0:
+                print("FLEET SCALE FAILED: digest bot never landed a round",
+                      flush=True)
+                return 1
+            if lint_errs not in ("n/a", "0"):
+                print("FLEET SCALE FAILED: /metrics is not valid "
+                      "prometheus text", flush=True)
+                return 1
         print(f"SOAK PASSED: {total} heartbeat steps, "
               f"{total_relaunches} relaunches, "
               f"{master_restarts} master restarts in {args.duration:.0f}s",
               flush=True)
         return 0
     finally:
+        fleet_stop.set()
         for p in peers:
             p.kill()
         master.kill()

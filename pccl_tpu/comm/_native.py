@@ -238,6 +238,24 @@ def _declare(lib):
     except AttributeError:
         pass
 
+    # fleet-scale bench hooks: observer-session digest flood + journal
+    # replay bench (docs/09; same older-build tolerance)
+    try:
+        lib.pccltDigestFlood.restype = c.c_int
+        lib.pccltDigestFlood.argtypes = [c.c_char_p, c.c_uint16, c.c_uint32,
+                                         c.c_uint32, c.c_double, c.c_double,
+                                         c.c_uint32, P(c.c_uint64),
+                                         P(c.c_double)]
+        lib.pccltAdmissionProbe.restype = c.c_int
+        lib.pccltAdmissionProbe.argtypes = [c.c_char_p, c.c_uint16,
+                                            c.c_uint32, P(c.c_double),
+                                            P(c.c_double)]
+        lib.pccltMasterReplayBench.restype = c.c_int
+        lib.pccltMasterReplayBench.argtypes = [c.c_char_p, c.c_uint32,
+                                               P(c.c_double), P(c.c_double)]
+    except AttributeError:
+        pass
+
     lib.pccltCreateCommunicator.restype = c.c_int
     lib.pccltCreateCommunicator.argtypes = [P(CommCreateParams), P(c.c_void_p)]
     for fn in ("pccltDestroyCommunicator", "pccltConnect", "pccltUpdateTopology",

@@ -1799,3 +1799,195 @@ def run_sync_swarm_bench(world: int = 8, seeders: int = 4, keys: int = 8,
     out["sync_swarm_resourced_chunks"] = float(resourced)
     out["sync_swarm_dup_chunks"] = float(dup)
     return out
+
+
+# ------------------------------------------------- fleet-scale master plane
+
+def _scrape_http(port: int, path: str = "/metrics",
+                 timeout: float = 30.0) -> str:
+    import socket as socket_mod
+    with socket_mod.create_connection(("127.0.0.1", port),
+                                      timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(f"GET {path} HTTP/1.0\r\nHost: x\r\n\r\n".encode())
+        buf = b""
+        while True:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+    return buf.split(b"\r\n\r\n", 1)[1].decode("utf-8", "replace")
+
+
+def _prom_value(text: str, name: str):
+    """First sample value of an unlabelled series, or None."""
+    for line in text.split("\n"):
+        if line.startswith(name + " "):
+            return float(line.rsplit(None, 1)[-1])
+    return None
+
+
+def run_master_scale_bench(peers: int = 1000, edges: int = 8,
+                           hz: float = 12.0, seconds: float = 4.0,
+                           threads: int = 8,
+                           master_port: int = 48715) -> Dict[str, Any]:
+    """The N=1000 observability gate (docs/09): one master, ``peers``
+    observer sessions (the PCCP/2 hello tail byte — they push digests but
+    never join the world) each pushing an ``edges``-edge digest at ``hz``,
+    all from ``pccltDigestFlood`` (native threads; ctypes releases the
+    GIL). Measures the whole ISSUE-17 surface in one run:
+
+    * ``master_scale_ingest_rate`` — digests/s actually accepted (the
+      flood is paced, so this ~= peers*hz when the master keeps up) with
+      ``master_scale_digest_drops`` the bounded-queue drop count;
+    * ``master_scale_fold_p99_s`` — off-dispatcher fold latency p99, from
+      the master's own ``pcclt_master_digest_fold_seconds`` histogram;
+    * ``master_scale_scrape_s`` / ``_bytes`` / ``_series`` — one timed
+      /metrics render at the default edge top-K, promlint-validated
+      (``master_scale_promlint_violations`` must be 0);
+    * ``master_scale_admission_quiet_s`` vs ``_flood_s`` — the paired A/B
+      on DISPATCHER round latency (observer hello -> welcome round trips
+      via ``pccltAdmissionProbe``) with the digest flood off vs on: the
+      enqueue-only ingest path must leave admission latency unchanged;
+    * ``master_scale_health_quiet_s`` vs ``_flood_s`` — /health cost with
+      the plane idle vs mid-flood (the dispatcher must stay responsive);
+    * ``master_scale_replay_s`` — journal replay wall for ``peers``
+      client records (cold-restart cost at fleet scale).
+
+    CI gates (ci.yml fleet-scale lane): ingest >= 10k/s, scrape < 1 s,
+    drops == 0, promlint clean."""
+    import ctypes as c
+    import subprocess
+    import sys
+    import tempfile
+
+    from pccl_tpu.comm import _native, promlint
+
+    lib = _native.load()
+    if not hasattr(lib, "pccltDigestFlood"):
+        raise RuntimeError("libpcclt.so too old: no pccltDigestFlood")
+
+    port = _port("PCCLT_BENCH_MASTER_PORT_SCALE", master_port)
+    mport = port + 1
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    # fresh renders: the render cache would make the timed scrape measure
+    # a memcpy; the gate is about the real top-K render at N=1000
+    env["PCCLT_METRICS_MAX_AGE_MS"] = "0"
+    env.pop("PCCLT_METRICS_EDGE_TOPK", None)   # default top-K = the gate
+    master = subprocess.Popen(
+        [sys.executable, "-m", "pccl_tpu.comm.master", "--port", str(port),
+         "--metrics-port", str(mport)],
+        cwd=repo_root, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+    out: Dict[str, Any] = {"master_scale_peers": float(peers),
+                           "master_scale_edges_per_peer": float(edges)}
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                _scrape_http(mport, "/health", timeout=2)
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            raise RuntimeError("scale-bench master never served /health")
+
+        t0 = time.perf_counter()
+        _scrape_http(mport, "/health")
+        out["master_scale_health_quiet_s"] = time.perf_counter() - t0
+
+        def admission(rounds: int = 50):
+            mean = c.c_double(0.0)
+            p99 = c.c_double(0.0)
+            rc = lib.pccltAdmissionProbe(b"127.0.0.1", port, rounds,
+                                         c.byref(mean), c.byref(p99))
+            if rc != 0:
+                raise RuntimeError(f"pccltAdmissionProbe rc={rc}")
+            return mean.value, p99.value
+
+        (out["master_scale_admission_quiet_s"],
+         out["master_scale_admission_quiet_p99_s"]) = admission()
+
+        sent = c.c_uint64(0)
+        wall = c.c_double(0.0)
+        flood_err: List[int] = []
+
+        def flood():
+            flood_err.append(lib.pccltDigestFlood(
+                b"127.0.0.1", port, peers, edges, hz, seconds, threads,
+                c.byref(sent), c.byref(wall)))
+
+        import threading
+        th = threading.Thread(target=flood)
+        th.start()
+        # mid-flood control-plane responsiveness: /health while ~peers*hz
+        # digests/s are landing
+        time.sleep(max(0.5, seconds * 0.4))
+        t0 = time.perf_counter()
+        _scrape_http(mport, "/health")
+        out["master_scale_health_flood_s"] = time.perf_counter() - t0
+        # the A/B's flood leg: admission round trips WHILE ~peers*hz
+        # digests/s are hitting the same dispatcher
+        (out["master_scale_admission_flood_s"],
+         out["master_scale_admission_flood_p99_s"]) = admission()
+        th.join(timeout=seconds * 20 + 120)
+        if th.is_alive():
+            raise RuntimeError("digest flood wedged")
+        if flood_err and flood_err[0] != 0:
+            raise RuntimeError(f"pccltDigestFlood rc={flood_err[0]}")
+        out["master_scale_digests_sent"] = float(sent.value)
+        out["master_scale_flood_wall_s"] = wall.value
+        out["master_scale_ingest_rate"] = (
+            sent.value / wall.value if wall.value > 0 else 0.0)
+
+        # fold drain: every accepted digest must land in health state
+        deadline = time.time() + 60
+        folded = drops = 0.0
+        while time.time() < deadline:
+            text = _scrape_http(mport)
+            folded = _prom_value(
+                text, "pcclt_master_telemetry_digests_total") or 0.0
+            drops = _prom_value(
+                text, "pcclt_master_digest_queue_dropped_total") or 0.0
+            if folded + drops >= sent.value:
+                break
+            time.sleep(0.2)
+        out["master_scale_digests_folded"] = folded
+        out["master_scale_digest_drops"] = drops
+        out["master_scale_fold_p99_s"] = _prom_value(
+            text, "pcclt_master_digest_fold_p99_seconds") or 0.0
+
+        # THE scrape gate: one timed render of the steady-state surface
+        t0 = time.perf_counter()
+        text = _scrape_http(mport)
+        out["master_scale_scrape_s"] = time.perf_counter() - t0
+        out["master_scale_scrape_bytes"] = float(len(text))
+        out["master_scale_scrape_series"] = float(sum(
+            1 for ln in text.split("\n") if ln and not ln.startswith("#")))
+        out["master_scale_promlint_violations"] = float(
+            len(promlint.lint(text)))
+
+        t0 = time.perf_counter()
+        _scrape_http(mport, "/health?history=1")
+        out["master_scale_health_history_s"] = time.perf_counter() - t0
+    finally:
+        if master.poll() is None:
+            master.kill()
+        master.wait(timeout=10)
+
+    # cold-restart cost: journal write + replay of `peers` client records,
+    # entirely native (pccltMasterReplayBench)
+    if hasattr(lib, "pccltMasterReplayBench"):
+        jpath = os.path.join(tempfile.mkdtemp(prefix="pcclt_scale_"),
+                             "replay.journal")
+        w_s = c.c_double(0.0)
+        r_s = c.c_double(0.0)
+        rc = lib.pccltMasterReplayBench(jpath.encode(), peers,
+                                        c.byref(w_s), c.byref(r_s))
+        if rc != 0:
+            raise RuntimeError(f"pccltMasterReplayBench rc={rc}")
+        out["master_scale_replay_write_s"] = w_s.value
+        out["master_scale_replay_s"] = r_s.value
+    return out

@@ -200,6 +200,43 @@ PCCLT_EXPORT uint16_t pccltMasterMetricsPort(pccltMaster_t *m);
 PCCLT_EXPORT pccltResult_t pccltMasterGetHealth(pccltMaster_t *m, char *buf,
                                                 uint64_t cap, uint64_t *need);
 
+/* --- fleet-scale bench hooks (pcclt extension, docs/09) ---
+ *
+ * pccltDigestFlood: simulated-fleet telemetry load generator. Opens one
+ * OBSERVER control session per simulated peer against the master at
+ * ip:port (observer sessions push digests but never join the world, so a
+ * flood cannot wedge real admission rounds), then pushes one pre-encoded
+ * telemetry digest of `edges_per_peer` unique edges per peer per 1/hz
+ * tick for `seconds`, spread over `threads` sender threads (0 = default).
+ * Blocking; returns the digest count actually written and the wall time.
+ * pccltMasterUnreachable if any session failed to connect or send.
+ *
+ * pccltAdmissionProbe: dispatcher round-latency probe. Each round is one
+ * fresh observer hello -> welcome round trip, timed after TCP connect —
+ * the hello is parsed, admitted and answered on the dispatcher thread, so
+ * the samples measure exactly the queueing an admission/topology frame
+ * sees, without perturbing the world. Reports mean and p99 seconds.
+ *
+ * pccltMasterReplayBench: journal write + cold-restart replay timing.
+ * Appends `clients` session records to a fresh journal at journal_path,
+ * then replays it (compacted snapshot rewrite + master-state rehydrate)
+ * and reports both phases' wall seconds. The path should be a scratch
+ * file; its contents are overwritten. */
+PCCLT_EXPORT pccltResult_t pccltDigestFlood(const char *ip, uint16_t port,
+                                            uint32_t peers,
+                                            uint32_t edges_per_peer, double hz,
+                                            double seconds, uint32_t threads,
+                                            uint64_t *digests_sent,
+                                            double *wall_seconds);
+PCCLT_EXPORT pccltResult_t pccltAdmissionProbe(const char *ip, uint16_t port,
+                                               uint32_t rounds,
+                                               double *mean_seconds,
+                                               double *p99_seconds);
+PCCLT_EXPORT pccltResult_t pccltMasterReplayBench(const char *journal_path,
+                                                  uint32_t clients,
+                                                  double *write_seconds,
+                                                  double *replay_seconds);
+
 PCCLT_EXPORT pccltResult_t pccltCreateCommunicator(const pccltCommCreateParams_t *params,
                                                    pccltComm_t **out);
 PCCLT_EXPORT pccltResult_t pccltDestroyCommunicator(pccltComm_t *c);

@@ -2,20 +2,27 @@
 // Reference parity: src/pccl.cpp (validation + enum translation over CCoIP).
 #include "../include/pcclt.h"
 
+#include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "client.hpp"
 #include "hash.hpp"
+#include "journal.hpp"
 #include "log.hpp"
 #include "master.hpp"
 #include "netem.hpp"
 #include "shm.hpp"
+#include "sockets.hpp"
 #include "telemetry.hpp"
+#include "version.hpp"
 
 using pcclt::client::Client;
 using pcclt::client::ClientConfig;
@@ -77,7 +84,11 @@ extern "C" {
 pccltResult_t pccltInit(void) { return pccltSuccess; }
 
 const char *pccltGetBuildInfo(void) {
-    return "pcclt 0.1.0 (PCCP/2, tpu-native pccl-capability core)";
+    // version comes from version.hpp so this banner and the
+    // pcclt_build_info metric can never drift apart
+    static const std::string info = std::string("pcclt ") + pcclt::kPccltVersion +
+                                    " (PCCP/2, tpu-native pccl-capability core)";
+    return info.c_str();
 }
 
 // ---------------- master ----------------
@@ -485,6 +496,200 @@ pccltResult_t pccltTraceDump(const char *path) {
     if (p.empty()) return pccltInvalidArgument;
     return pcclt::telemetry::Recorder::inst().dump_json(p) ? pccltSuccess
                                                            : pccltInternalError;
+}
+
+// ---------------- fleet-scale bench hooks (docs/09) ----------------
+
+pccltResult_t pccltDigestFlood(const char *ip, uint16_t port, uint32_t peers,
+                               uint32_t edges_per_peer, double hz,
+                               double seconds, uint32_t threads,
+                               uint64_t *digests_sent, double *wall_seconds) {
+    if (!ip || peers == 0 || edges_per_peer == 0 || hz <= 0 || seconds <= 0)
+        return pccltInvalidArgument;
+    auto addr = pcclt::net::Addr::parse(ip, port);
+    if (!addr) return pccltInvalidArgument;
+    if (threads == 0) threads = 2;
+    if (threads > peers) threads = peers;
+
+    // Simulated-fleet digest bot: one OBSERVER control session per simulated
+    // peer (the master folds digests per session uuid), each pushing a
+    // pre-encoded kC2MTelemetryDigest at `hz`. Payloads are encoded once up
+    // front so the loop measures master-side ingest, not client-side encode.
+    std::atomic<uint64_t> sent{0};
+    std::atomic<int> failed{0};
+    const auto t0 = std::chrono::steady_clock::now();
+    std::vector<std::thread> workers;
+    workers.reserve(threads);
+    for (uint32_t t = 0; t < threads; ++t) {
+        workers.emplace_back([&, t] {
+            struct Conn {
+                pcclt::net::Socket sock;
+                pcclt::Mutex mu; // send_frame write serialization (worker-local)
+                std::vector<uint8_t> digest;
+            };
+            std::vector<std::unique_ptr<Conn>> conns;
+            for (uint32_t p = t; p < peers; p += threads) {
+                auto c = std::make_unique<Conn>();
+                if (!c->sock.connect(*addr, 5000)) {
+                    failed.fetch_add(1, std::memory_order_relaxed);
+                    return;
+                }
+                pcclt::proto::HelloC2M h;
+                h.observer = 1;
+                if (!pcclt::net::send_frame(c->sock, c->mu,
+                                            pcclt::proto::kC2MHello, h.encode()) ||
+                    !pcclt::net::recv_frame(c->sock, 10000)) { // welcome
+                    failed.fetch_add(1, std::memory_order_relaxed);
+                    return;
+                }
+                // one digest per simulated peer: unique endpoints so the
+                // fleet edge table reaches peers * edges_per_peer entries,
+                // with per-edge + per-phase histograms populated the way a
+                // real data-plane digest would be
+                pcclt::proto::TelemetryDigestC2M d;
+                d.interval_ms = static_cast<uint64_t>(1000.0 / hz);
+                d.collectives_ok = 1;
+                d.ring_pushed = 1024;
+                d.ring_cap = 65536;
+                for (uint32_t e = 0; e < edges_per_peer; ++e) {
+                    pcclt::proto::TelemetryDigestC2M::Edge ed;
+                    char ep[64];
+                    snprintf(ep, sizeof ep, "10.%u.%u.%u:9100", (p >> 8) & 255,
+                             p & 255, e & 255);
+                    ed.endpoint = ep;
+                    ed.tx_mbps = 800 + (p % 100);
+                    ed.rx_mbps = 790 + (e % 50);
+                    ed.stall_ratio = 0.01;
+                    ed.tx_bytes = 1 << 20;
+                    ed.rx_bytes = 1 << 20;
+                    for (uint8_t b = 10; b < 14; ++b) {
+                        ed.stage_wire_hist.buckets.push_back({b, 16});
+                        ed.stage_wire_hist.sum_ns += 16u << b;
+                    }
+                    ed.stall_hist.buckets.push_back({12, 2});
+                    ed.stall_hist.sum_ns = 2u << 12;
+                    d.edges.push_back(std::move(ed));
+                }
+                for (uint64_t s = 0; s < 4; ++s)
+                    d.ops.push_back({s + 1, 5000000 + s * 1000, 100000});
+                pcclt::proto::WireHist ph;
+                for (uint8_t b = 18; b < 22; ++b) {
+                    ph.buckets.push_back({b, 8});
+                    ph.sum_ns += 8u << b;
+                }
+                d.phase_hists.push_back({0, std::move(ph)});
+                c->digest = d.encode();
+                conns.push_back(std::move(c));
+            }
+            // paced rounds: every conn pushes one digest per 1/hz tick
+            const auto start = std::chrono::steady_clock::now();
+            uint64_t rounds = static_cast<uint64_t>(seconds * hz + 0.5);
+            if (rounds == 0) rounds = 1;
+            for (uint64_t r = 0; r < rounds; ++r) {
+                for (auto &c : conns) {
+                    if (pcclt::net::send_frame(c->sock, c->mu,
+                                               pcclt::proto::kC2MTelemetryDigest,
+                                               c->digest))
+                        sent.fetch_add(1, std::memory_order_relaxed);
+                    else
+                        failed.fetch_add(1, std::memory_order_relaxed);
+                }
+                auto next = start + std::chrono::duration_cast<
+                                        std::chrono::steady_clock::duration>(
+                                        std::chrono::duration<double>((r + 1) / hz));
+                std::this_thread::sleep_until(next);
+            }
+        });
+    }
+    for (auto &w : workers) w.join();
+    const double wall =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count();
+    if (digests_sent) *digests_sent = sent.load(std::memory_order_relaxed);
+    if (wall_seconds) *wall_seconds = wall;
+    return failed.load(std::memory_order_relaxed) ? pccltMasterUnreachable
+                                                  : pccltSuccess;
+}
+
+pccltResult_t pccltAdmissionProbe(const char *ip, uint16_t port,
+                                  uint32_t rounds, double *mean_seconds,
+                                  double *p99_seconds) {
+    if (!ip || rounds == 0) return pccltInvalidArgument;
+    auto addr = pcclt::net::Addr::parse(ip, port);
+    if (!addr) return pccltInvalidArgument;
+    // Dispatcher round-latency probe: each round is one observer hello ->
+    // welcome round trip. The hello is parsed, admitted and answered ON the
+    // dispatcher thread, so the round trip measures exactly the queueing an
+    // admission/topology frame would see — without perturbing the world
+    // (observers are never admitted). TCP connect happens before the timer.
+    std::vector<double> samples;
+    samples.reserve(rounds);
+    for (uint32_t r = 0; r < rounds; ++r) {
+        pcclt::net::Socket sock;
+        pcclt::Mutex mu;
+        if (!sock.connect(*addr, 5000)) return pccltMasterUnreachable;
+        pcclt::proto::HelloC2M h;
+        h.observer = 1;
+        const auto t0 = std::chrono::steady_clock::now();
+        if (!pcclt::net::send_frame(sock, mu, pcclt::proto::kC2MHello,
+                                    h.encode()) ||
+            !pcclt::net::recv_frame(sock, 10000))
+            return pccltMasterUnreachable;
+        samples.push_back(std::chrono::duration<double>(
+                              std::chrono::steady_clock::now() - t0)
+                              .count());
+    }
+    std::sort(samples.begin(), samples.end());
+    double sum = 0;
+    for (double s : samples) sum += s;
+    if (mean_seconds) *mean_seconds = sum / static_cast<double>(samples.size());
+    if (p99_seconds)
+        *p99_seconds = samples[std::min(samples.size() - 1,
+                                        static_cast<size_t>(
+                                            static_cast<double>(samples.size()) *
+                                            0.99))];
+    return pccltSuccess;
+}
+
+pccltResult_t pccltMasterReplayBench(const char *journal_path, uint32_t clients,
+                                     double *write_seconds,
+                                     double *replay_seconds) {
+    if (!journal_path || clients == 0) return pccltInvalidArgument;
+    using Clock = std::chrono::steady_clock;
+    // phase 1: append `clients` session deltas the way a live master would
+    double write_s = 0;
+    {
+        pcclt::journal::Journal j;
+        if (!j.open(journal_path)) return pccltInvalidArgument;
+        const auto w0 = Clock::now();
+        for (uint32_t i = 0; i < clients; ++i) {
+            pcclt::journal::ClientRec c;
+            c.uuid = pcclt::proto::uuid_random();
+            c.peer_group = 0;
+            char ip[32];
+            snprintf(ip, sizeof ip, "10.%u.%u.%u", (i >> 16) & 255,
+                     (i >> 8) & 255, i & 255);
+            c.ip = ip;
+            c.p2p_port = 9000;
+            c.ss_port = 9001;
+            c.bench_port = 9002;
+            c.accepted = true;
+            j.record_client(c);
+        }
+        write_s = std::chrono::duration<double>(Clock::now() - w0).count();
+    }
+    // phase 2: cold restart — replay + compacted snapshot + state rehydrate
+    pcclt::journal::Journal j2;
+    const auto r0 = Clock::now();
+    if (!j2.open(journal_path)) return pccltInternalError;
+    pcclt::master::MasterState st;
+    st.attach_journal(&j2);
+    const double replay_s =
+        std::chrono::duration<double>(Clock::now() - r0).count();
+    if (st.limbo_count() != clients) return pccltInternalError;
+    if (write_seconds) *write_seconds = write_s;
+    if (replay_seconds) *replay_seconds = replay_s;
+    return pccltSuccess;
 }
 
 pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c, pccltSharedState_t *state,

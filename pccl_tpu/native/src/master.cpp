@@ -118,6 +118,14 @@ void Master::serve_metrics_conn(net::Socket sock) {
         const char *e = strchr(p, ' ');
         if (e) path.assign(p, e);
     }
+    // split off the query string: /health?history=1 asks for the ring of
+    // recent fleet snapshots alongside the live view
+    std::string query;
+    if (auto q = path.find('?'); q != std::string::npos) {
+        query = path.substr(q + 1);
+        path.resize(q);
+    }
+    const bool want_history = query.find("history=1") != std::string::npos;
     std::string body;
     const char *ctype = "text/plain; charset=utf-8";
     const char *status = "200 OK";
@@ -126,10 +134,11 @@ void Master::serve_metrics_conn(net::Socket sock) {
         body = state_.render_metrics();
         ctype = "text/plain; version=0.0.4; charset=utf-8";
     } else if (path == "/health" || path == "/health.json") {
-        body = state_.render_health_json();
+        body = state_.render_health_json(want_history);
         ctype = "application/json";
     } else if (path == "/") {
-        body = "pcclt master: /metrics (prometheus), /health (json)\n";
+        body = "pcclt master: /metrics (prometheus), /health (json), "
+               "/health?history=1 (json + recent fleet snapshots)\n";
     } else {
         status = "404 Not Found";
         body = "not found\n";

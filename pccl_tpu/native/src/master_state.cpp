@@ -12,6 +12,8 @@
 #include "log.hpp"
 #include "sockets.hpp"
 #include "telemetry.hpp"
+#include "uring.hpp"
+#include "version.hpp"
 
 namespace pcclt::master {
 
@@ -21,6 +23,50 @@ namespace {
 proto::PeerEndpoint endpoint_of(const ClientInfo &c) {
     return proto::PeerEndpoint{c.uuid, c.ip, c.p2p_port, c.bench_port, c.peer_group};
 }
+
+// ---- observability-plane tunables (docs/03, docs/09) ----
+
+double straggler_fraction() {
+    static const double v = [] {
+        if (const char *e = std::getenv("PCCLT_STRAGGLER_FRACTION")) {
+            double f = std::atof(e);
+            if (f > 0 && f < 1) return f;
+        }
+        return 0.5;
+    }();
+    return v;
+}
+
+bool straggler_reopt_enabled() {
+    static const bool v = [] {
+        const char *e = std::getenv("PCCLT_STRAGGLER_REOPT");
+        return e && e[0] == '1';
+    }();
+    return v;
+}
+
+// edges quieter than this carry no meaningful throughput sample — an idle
+// edge must never read as "degraded"
+constexpr double kMinActiveMbps = 0.05;
+
+// the receiver must have spent at least this fraction of the interval
+// BLOCKED on the edge for its throughput to count as a capacity sample:
+// achieved rate only witnesses degradation when the wire (not compute or
+// a light duty cycle) is pacing the run — without this gate any healthy
+// link carrying sparse traffic would read as a straggler, and with
+// PCCLT_STRAGGLER_REOPT=1 its load-limited rate would corrupt the matrix
+constexpr double kMinStallRatio = 0.15;
+
+// ingest-queue digest cap. Re-read per enqueue (a linear environ scan is
+// noise next to a digest decode): tests flip it at runtime.
+size_t digest_queue_cap() {
+    if (const char *e = std::getenv("PCCLT_DIGEST_QUEUE_CAP")) {
+        long v = std::atol(e);
+        if (v > 0) return static_cast<size_t>(v);
+    }
+    return 4096;
+}
+
 } // namespace
 
 ClientInfo *MasterState::by_conn(uint64_t conn) {
@@ -151,7 +197,15 @@ void MasterState::attach_journal(journal::Journal *j) {
         g.revision_initialized = gr.revision_initialized;
         g.ring = gr.ring;
     }
-    for (const auto &b : r.bandwidth) bandwidth_.store(b.from, b.to, b.mbps);
+    for (const auto &b : r.bandwidth) {
+        bandwidth_.store(b.from, b.to, b.mbps);
+        IngestItem it;
+        it.kind = IngestItem::kBandwidth;
+        it.peer = b.from;
+        it.to = b.to;
+        it.mbps = b.mbps;
+        enqueue(std::move(it));
+    }
     replay_ops_ = r.op_done;
     if (!limbo_.empty())
         PLOG(kInfo) << "journal restore: epoch " << epoch_ << ", "
@@ -203,7 +257,7 @@ std::vector<Outbox> MasterState::on_session_resume(uint64_t conn,
     ack.ok = 1;
     ack.last_revision = g.last_revision;
     clients_[conn] = c;
-    ++membership_gen_;
+    enqueue_endpoint_add(c);
     journal_client(c);
     PLOG(kInfo) << "session resumed: " << proto::uuid_str(c.uuid) << " group "
                 << c.peer_group << " (" << limbo_.size() << " still in limbo)";
@@ -221,6 +275,43 @@ std::vector<Outbox> MasterState::on_tick() {
     // keep the published health summary fresh even while no digests flow
     // (membership changes between digests must show up in /health promptly)
     publish_health_summary();
+    // straggler transitions the fold thread detected since the last tick:
+    // the parts that need the consensus state — matrix rewrite + journal,
+    // REOPT kick-off, incident broadcast — run here, within one tick
+    // (<=100 ms) of the digest that witnessed the degradation
+    std::vector<StragglerAction> acts;
+    {
+        MutexLock lk(ingest_mu_);
+        acts.swap(pending_actions_);
+    }
+    for (const auto &a : acts) {
+        if (straggler_reopt_enabled() && a.has_to) {
+            // telemetry-refreshed matrix: the measured (degraded) rate
+            // replaces the stale probe value — in the WITNESSED direction:
+            // remote -> reporter for the rate detector, reporter -> remote
+            // for a watchdog CONFIRM — so the background ATSP pass actually
+            // routes around the slow hop; the next optimize round adopts
+            // the improved ring (check_optimize moonshot path)
+            const Uuid &from = a.outbound_confirm ? a.from_raw : a.to_raw;
+            const Uuid &to = a.outbound_confirm ? a.to_raw : a.from_raw;
+            bandwidth_.store(from, to, a.measured_mbps);
+            if (journal_) journal_->record_bandwidth(from, to, a.measured_mbps);
+            IngestItem bw;
+            bw.kind = IngestItem::kBandwidth;
+            bw.peer = from;
+            bw.to = to;
+            bw.mbps = a.measured_mbps;
+            enqueue(std::move(bw));
+            request_straggler_reopt(a.group);
+        }
+        // a watchdog CONFIRM means the data plane is already relaying
+        // around a dead-slow hop mid-collective — exactly the evidence
+        // that evaporates by the time anyone looks: capture it NOW
+        if (a.outbound_confirm)
+            maybe_incident(out, "watchdog_confirm:" + a.from_uuid + "->" +
+                                    a.endpoint,
+                           a.group);
+    }
     if (limbo_.empty()) return out;
     auto now = std::chrono::steady_clock::now();
     std::vector<Uuid> expired;
@@ -267,11 +358,27 @@ std::vector<Outbox> MasterState::on_hello(uint64_t conn, const net::Addr &src_ip
     c.p2p_port = h.p2p_port;
     c.ss_port = h.ss_port;
     c.bench_port = h.bench_port;
+    c.observer = h.observer != 0;
     if (!h.adv_ip.empty()) {
         if (auto a = net::Addr::parse(h.adv_ip, 0)) c.ip = *a;
     }
     clients_[conn] = c;
-    ++membership_gen_;
+    enqueue_endpoint_add(c);
+    if (c.observer) {
+        // telemetry-only control session: never pending, never admitted,
+        // never journaled — a thousand of these must not open (or wedge)
+        // an admission round real peers are waiting on
+        PLOG(kInfo) << "observer session " << proto::uuid_str(c.uuid)
+                    << " attached (telemetry-only), sessions="
+                    << clients_.size();
+        wire::Writer w;
+        w.u8(1);
+        proto::put_uuid(w, c.uuid);
+        w.str("welcome (observer)");
+        w.u64(epoch_);
+        out.push_back({conn, PacketType::kM2CWelcome, w.take()});
+        return out;
+    }
     PLOG(kInfo) << "client " << proto::uuid_str(c.uuid) << " joined (pending), group "
                 << c.peer_group << ", world=" << world_size();
     telemetry::Recorder::inst().instant("membership", "master_join_pending",
@@ -344,7 +451,7 @@ std::vector<Outbox> MasterState::on_peers_pending_query(uint64_t conn) {
     std::vector<Outbox> out;
     bool pending = false;
     for (auto &[_, c] : clients_)
-        if (!c.accepted) pending = true;
+        if (!c.accepted && !c.observer) pending = true;
     wire::Writer w;
     w.u8(pending ? 1 : 0);
     out.push_back({conn, PacketType::kM2CPeersPendingReply, w.take()});
@@ -359,14 +466,17 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
     // or expiry, both of which re-check)
     if (!limbo_.empty()) return;
     auto acc = accepted_clients();
-    bool any_pending = clients_.size() > acc.size();
+    // observers are telemetry-only sessions: never pending, never admitted
+    bool any_pending = false;
+    for (auto &[_, c] : clients_)
+        if (!c.accepted && !c.observer) any_pending = true;
     if (acc.empty() && !any_pending) return;
     // a round runs when every accepted client has voted (trivially true when
     // none are accepted yet — a pending-only world admits immediately)
     for (auto *a : acc)
         if (!a->vote_topology) return;
     for (auto &[_, c] : clients_)
-        if (!c.accepted) {
+        if (!c.accepted && !c.observer) {
             c.accepted = true;
             // An admitted joiner is by definition parked in its establish
             // loop awaiting this round's completion: give it a STANDING
@@ -393,6 +503,7 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
     round_members_.clear();
     std::set<uint32_t> groups;
     for (auto &[_, c] : clients_) {
+        if (c.observer) continue;
         round_members_.insert(c.uuid);
         c.reported_establish = false;
         c.establish_ok = false;
@@ -405,10 +516,12 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
     }
 
     for (auto &[_, c] : clients_) {
+        if (c.observer) continue;
         proto::P2PConnInfo info;
         info.revision = topology_revision_;
         for (auto &[_, o] : clients_)
-            if (o.uuid != c.uuid) info.peers.push_back(endpoint_of(o));
+            if (!o.observer && o.uuid != c.uuid)
+                info.peers.push_back(endpoint_of(o));
         info.ring = groups_[c.peer_group].ring;
         out.push_back({c.conn_id, PacketType::kM2CP2PConnInfo, info.encode()});
     }
@@ -1137,8 +1250,17 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
                                         "world", world_size());
 }
 
+MasterState::MasterState() {
+    // the digest-ingest (fold) thread: drains the bounded queue the
+    // dispatcher enqueues into and owns every health_mu_-guarded write
+    fold_thread_ = std::thread([this] { fold_loop(); });
+}
+
 MasterState::~MasterState() {
     moon_stop_ = true; // improve() polls this, so joins return promptly
+    fold_stop_.store(true, std::memory_order_release);
+    ingest_cv_.notify_all();
+    if (fold_thread_.joinable()) fold_thread_.join();
     for (auto &[_, t] : moon_threads_)
         if (t.joinable()) t.join();
 }
@@ -1184,6 +1306,12 @@ std::vector<Outbox> MasterState::on_bandwidth_report(uint64_t conn, const Uuid &
     if (!c) return out;
     bandwidth_.store(c->uuid, to, mbps);
     if (journal_) journal_->record_bandwidth(c->uuid, to, mbps);
+    IngestItem it;
+    it.kind = IngestItem::kBandwidth;
+    it.peer = c->uuid;
+    it.to = to;
+    it.mbps = mbps;
+    enqueue(std::move(it));
     return out;
 }
 
@@ -1198,57 +1326,158 @@ std::vector<Outbox> MasterState::on_optimize_work_done(uint64_t conn) {
 
 // ---------- fleet health (observability plane, docs/09) ----------
 
-namespace {
-
-double straggler_fraction() {
-    static const double v = [] {
-        if (const char *e = std::getenv("PCCLT_STRAGGLER_FRACTION")) {
-            double f = std::atof(e);
-            if (f > 0 && f < 1) return f;
-        }
-        return 0.5;
-    }();
-    return v;
+void MasterState::enqueue(IngestItem &&it) {
+    const bool droppable = it.kind == IngestItem::kDigest;
+    if (droppable && ingest_depth_.load(std::memory_order_relaxed) >=
+                         digest_queue_cap()) {
+        // overflow drops-and-counts: a digest flood can never back-pressure
+        // the dispatcher (admission/topology rounds) — only digests are
+        // droppable, membership/bandwidth deltas always land
+        ingest_dropped_.fetch_add(1, std::memory_order_relaxed);
+        return;
+    }
+    {
+        MutexLock lk(ingest_mu_);
+        if (droppable) ingest_depth_.fetch_add(1, std::memory_order_relaxed);
+        ingest_q_.push_back(std::move(it));
+    }
+    ingest_cv_.notify_one();
 }
 
-bool straggler_reopt_enabled() {
-    static const bool v = [] {
-        const char *e = std::getenv("PCCLT_STRAGGLER_REOPT");
-        return e && e[0] == '1';
-    }();
-    return v;
+void MasterState::enqueue_endpoint_add(const ClientInfo &c) {
+    if (c.observer) return; // observers own no data-plane endpoint
+    IngestItem it;
+    it.kind = IngestItem::kEndpointAdd;
+    net::Addr a = c.ip;
+    a.port = c.p2p_port;
+    it.endpoint = a.str();
+    it.peer = c.uuid;
+    it.group = c.peer_group;
+    enqueue(std::move(it));
 }
-
-// edges quieter than this carry no meaningful throughput sample — an idle
-// edge must never read as "degraded"
-constexpr double kMinActiveMbps = 0.05;
-
-// the receiver must have spent at least this fraction of the interval
-// BLOCKED on the edge for its throughput to count as a capacity sample:
-// achieved rate only witnesses degradation when the wire (not compute or
-// a light duty cycle) is pacing the run — without this gate any healthy
-// link carrying sparse traffic would read as a straggler, and with
-// PCCLT_STRAGGLER_REOPT=1 its load-limited rate would corrupt the matrix
-constexpr double kMinStallRatio = 0.15;
-
-} // namespace
 
 void MasterState::publish_health_summary() {
-    const size_t w = world_size();
-    const size_t nc = clients_.size();
-    const size_t nl = limbo_.size();
-    const uint64_t now = telemetry::now_ns();
-    MutexLock lk(health_mu_);
-    health_world_ = w;
-    health_clients_ = nc;
-    health_limbo_ = nl;
+    IngestItem it;
+    it.kind = IngestItem::kSummary;
+    it.world = world_size();
+    it.clients = clients_.size();
+    it.limbo = limbo_.size();
+    enqueue(std::move(it));
+}
+
+std::vector<Outbox> MasterState::on_telemetry_digest(
+    uint64_t conn, const proto::TelemetryDigestC2M &d) {
+    std::vector<Outbox> out; // fire-and-forget: never replies
+    auto *c = by_conn(conn);
+    if (!c) return out;
+    // ENQUEUE-ONLY on the dispatcher: no health_mu_, no endpoint
+    // resolution, no string builds — the fold thread owns all of it. The
+    // only work here is one copy of the decoded digest (the dispatcher's
+    // decode buffer is transient) and one bounded-queue push.
+    IngestItem it;
+    it.kind = IngestItem::kDigest;
+    it.digest = d;
+    it.peer = c->uuid;
+    it.group = c->peer_group;
+    it.t_ns = telemetry::now_ns();
+    enqueue(std::move(it));
+    return out;
+}
+
+void MasterState::fold_loop() {
+    for (;;) {
+        std::deque<IngestItem> batch;
+        {
+            MutexLock lk(ingest_mu_);
+            if (ingest_q_.empty() &&
+                !fold_stop_.load(std::memory_order_acquire))
+                ingest_cv_.wait_for(ingest_mu_,
+                                    std::chrono::milliseconds(100));
+            if (ingest_q_.empty()) {
+                if (fold_stop_.load(std::memory_order_acquire)) return;
+            } else {
+                batch.swap(ingest_q_);
+            }
+        }
+        for (auto &it : batch) {
+            if (it.kind == IngestItem::kDigest)
+                ingest_depth_.fetch_sub(1, std::memory_order_relaxed);
+            fold_item(it);
+        }
+        // periodic work rides the same thread (it used to ride dispatcher
+        // ticks): departed-peer eviction + the /health history sampler
+        const uint64_t now = telemetry::now_ns();
+        fold_sweep(now);
+        fold_sample_history(now);
+    }
+}
+
+void MasterState::fold_item(IngestItem &it) {
+    switch (it.kind) {
+    case IngestItem::kDigest:
+        fold_digest(it);
+        break;
+    case IngestItem::kEndpointAdd:
+        fold_endpoints_[it.endpoint] =
+            FoldPeer{it.peer, proto::uuid_str(it.peer), it.group};
+        break;
+    case IngestItem::kEndpointRemove: {
+        // only drop the entry if it still belongs to the departing peer —
+        // a relaunched peer may have re-bound the endpoint in between
+        auto f = fold_endpoints_.find(it.endpoint);
+        if (f != fold_endpoints_.end() && f->second.raw == it.peer)
+            fold_endpoints_.erase(f);
+        break;
+    }
+    case IngestItem::kDeparted: {
+        // keep the record for post-mortems, mark it down (pcclt_peer_up 0;
+        // the next digest after a session resume revives)
+        MutexLock lk(health_mu_);
+        auto fit = fleet_peers_.find(proto::uuid_str(it.peer));
+        if (fit != fleet_peers_.end()) fit->second.departed = true;
+        break;
+    }
+    case IngestItem::kBandwidth:
+        fold_bw_[it.peer][it.to] = it.mbps;
+        break;
+    case IngestItem::kForget:
+        fold_bw_.erase(it.peer);
+        for (auto &[_, m] : fold_bw_) m.erase(it.peer);
+        break;
+    case IngestItem::kSummary: {
+        MutexLock lk(health_mu_);
+        health_world_ = it.world;
+        health_clients_ = it.clients;
+        health_limbo_ = it.limbo;
+        break;
+    }
+    case IngestItem::kIncident: {
+        MutexLock lk(health_mu_);
+        if (it.inc_id.empty()) {
+            // suppressed trigger: only the per-class counter moves
+            ++incidents_suppressed_by_class_[it.inc_trigger];
+        } else {
+            recent_incidents_.push_back({it.inc_id, it.inc_trigger, it.t_ns});
+            while (recent_incidents_.size() > 8) recent_incidents_.pop_front();
+        }
+        break;
+    }
+    }
+}
+
+void MasterState::fold_sweep(uint64_t now) {
     // Retention: departed entries stay visible for post-mortems but must
     // not accumulate forever under peer churn (every relaunch is a fresh
-    // uuid). Sweep every ~5 s of ticks; evict departed peers idle past the
-    // horizon — or past a hard cap, oldest first — plus their edges.
+    // uuid). Sweep every ~5 s; evict departed peers idle past the horizon
+    // — or past a hard cap, oldest first — plus their edges. Used to ride
+    // dispatcher ticks; now the fold thread owns it, so an O(peers) scan
+    // can never pace a consensus round.
+    constexpr uint64_t kSweepNs = 5'000'000'000ull;
     constexpr uint64_t kRetainNs = 10ull * 60 * 1'000'000'000;  // 10 min
     constexpr size_t kMaxPeers = 4096;
-    if (++health_sweep_tick_ % 50 != 0) return;
+    if (now - fold_last_sweep_ns_ < kSweepNs) return;
+    fold_last_sweep_ns_ = now;
+    MutexLock lk(health_mu_);
     std::vector<std::string> evict;
     for (const auto &[uuid, p] : fleet_peers_)
         if (p.departed && now - p.last_digest_ns > kRetainNs)
@@ -1271,18 +1500,68 @@ void MasterState::publish_health_summary() {
     }
 }
 
-std::vector<Outbox> MasterState::on_telemetry_digest(
-    uint64_t conn, const proto::TelemetryDigestC2M &d) {
-    std::vector<Outbox> out; // fire-and-forget: never replies
-    auto *c = by_conn(conn);
-    if (!c) return out;
-    const std::string from = proto::uuid_str(c->uuid);
+namespace {
+
+// /health history ring tunables (docs/03): sample period + retained depth.
+// Re-read per sample (1 Hz-ish): tests flip them at runtime.
+uint64_t health_history_period_ns() {
+    if (const char *e = std::getenv("PCCLT_HEALTH_HISTORY_MS")) {
+        long long v = atoll(e);
+        if (v >= 0) return static_cast<uint64_t>(v) * 1'000'000ull;
+    }
+    return 1'000'000'000ull; // 1 s
+}
+
+size_t health_history_cap() {
+    if (const char *e = std::getenv("PCCLT_HEALTH_HISTORY")) {
+        long v = std::atol(e);
+        if (v >= 0) return static_cast<size_t>(v);
+    }
+    return 120; // 2 min of trend at the default period
+}
+
+} // namespace
+
+void MasterState::fold_sample_history(uint64_t now) {
+    const uint64_t period = health_history_period_ns();
+    if (period == 0) return; // history disabled
+    if (fold_last_sample_ns_ && now - fold_last_sample_ns_ < period) return;
+    HealthSample s;
+    s.t_ns = now;
+    s.digests = digests_total_.load(std::memory_order_relaxed);
+    s.stragglers = stragglers_flagged_.load(std::memory_order_relaxed);
+    s.incidents = incidents_total_.load(std::memory_order_relaxed);
+    s.suppressed = incidents_suppressed_.load(std::memory_order_relaxed);
+    s.queue_depth = ingest_depth_.load(std::memory_order_relaxed);
+    s.queue_dropped = ingest_dropped_.load(std::memory_order_relaxed);
+    const double dt_s =
+        fold_last_sample_ns_ ? (now - fold_last_sample_ns_) / 1e9 : 0;
+    fold_last_sample_ns_ = now;
+    MutexLock lk(health_mu_);
+    const uint64_t prev =
+        health_history_.empty() ? 0 : health_history_.back().digests;
+    s.digest_rate =
+        dt_s > 0 && s.digests >= prev ? (s.digests - prev) / dt_s : 0;
+    s.world = health_world_;
+    s.clients = health_clients_;
+    s.limbo = health_limbo_;
+    s.peers = fleet_peers_.size();
+    s.edges = fleet_edges_.size();
+    health_history_.push_back(s);
+    const size_t cap = std::max<size_t>(1, health_history_cap());
+    while (health_history_.size() > cap) health_history_.pop_front();
+}
+
+void MasterState::fold_digest(IngestItem &item) {
+    const proto::TelemetryDigestC2M &d = item.digest;
+    const std::string from = proto::uuid_str(item.peer);
     const uint64_t now = telemetry::now_ns();
 
     // Resolve each digest edge's endpoint to a peer + its bandwidth-matrix
-    // entry OUTSIDE health_mu_: clients_/bandwidth_ are dispatcher-only
-    // state, and the lock ranks (health 36 > moon 34) forbid acting on the
-    // consensus machine while holding the health lock anyway.
+    // entry OUTSIDE health_mu_, against the fold thread's OWN mirrors
+    // (fold_endpoints_ / fold_bw_, maintained incrementally from the
+    // dispatcher's membership/bandwidth delta items): the dispatcher's
+    // clients_/bandwidth_ are never touched from here.
     struct Resolved {
         const proto::TelemetryDigestC2M::Edge *e;
         std::string to_uuid;
@@ -1291,38 +1570,27 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
         double expected_out_mbps = 0;  // reporter -> remote (outbound): the
                                        // direction a watchdog CONFIRM judges
     };
-    // endpoint->client index, rebuilt only when membership changed since
-    // the last digest — a per-digest rebuild (let alone a per-edge scan)
-    // would put O(world) string builds on the dispatcher thread, which
-    // also runs consensus, for every push in the fleet
-    if (endpoint_index_gen_ != membership_gen_) {
-        endpoint_index_.clear();
-        for (auto &[cid, cc] : clients_) {
-            net::Addr a = cc.ip;
-            a.port = cc.p2p_port;
-            endpoint_index_.emplace(a.str(), cid);
-        }
-        endpoint_index_gen_ = membership_gen_;
-    }
     std::vector<Resolved> resolved;
     resolved.reserve(d.edges.size());
     for (const auto &e : d.edges) {
         Resolved r;
         r.e = &e;
-        auto it = endpoint_index_.find(e.endpoint);
-        if (it != endpoint_index_.end()) {
-            if (auto cit = clients_.find(it->second); cit != clients_.end()) {
-                r.to_uuid = proto::uuid_str(cit->second.uuid);
-                r.to_raw = cit->second.uuid;
-                // the straggler verdict judges the INBOUND direction
-                // (remote -> reporter): the reporter's wire-stall on this
-                // edge is the degradation witness, so the matrix entry to
-                // compare against is remote->reporter too
-                if (auto bw = bandwidth_.get(cit->second.uuid, c->uuid))
-                    r.expected_mbps = *bw;
-                if (auto bw = bandwidth_.get(c->uuid, cit->second.uuid))
-                    r.expected_out_mbps = *bw;
-            }
+        auto it = fold_endpoints_.find(e.endpoint);
+        if (it != fold_endpoints_.end()) {
+            r.to_uuid = it->second.uuid_str;
+            r.to_raw = it->second.raw;
+            // the straggler verdict judges the INBOUND direction
+            // (remote -> reporter): the reporter's wire-stall on this
+            // edge is the degradation witness, so the matrix entry to
+            // compare against is remote->reporter too
+            if (auto bi = fold_bw_.find(it->second.raw); bi != fold_bw_.end())
+                if (auto e2 = bi->second.find(item.peer);
+                    e2 != bi->second.end())
+                    r.expected_mbps = e2->second;
+            if (auto bo = fold_bw_.find(item.peer); bo != fold_bw_.end())
+                if (auto e2 = bo->second.find(it->second.raw);
+                    e2 != bo->second.end())
+                    r.expected_out_mbps = e2->second;
         }
         resolved.push_back(std::move(r));
     }
@@ -1340,10 +1608,9 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
     std::vector<Flagged> newly_flagged;
     {
         MutexLock lk(health_mu_);
-        ++digests_total_;
         auto &p = fleet_peers_[from];
         p.uuid = from;
-        p.group = c->peer_group;
+        p.group = item.group;
         p.last_seq = d.last_seq;
         p.ring_dropped = d.ring_dropped;
         p.ring_pushed = d.ring_pushed;
@@ -1435,7 +1702,10 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
             }
         }
     }
-    publish_health_summary();
+    // publish AFTER the maps: digests_folded() is the "render will see this
+    // digest" gate tests and the bench spin on
+    digests_total_.fetch_add(1, std::memory_order_release);
+    fold_hist_.record(telemetry::now_ns() - item.t_ns);
 
     for (const auto &f : newly_flagged) {
         PLOG(kWarn) << "straggler edge flagged: "
@@ -1450,32 +1720,26 @@ std::vector<Outbox> MasterState::on_telemetry_digest(
             "fleet", "master_straggler", "measured_mbps",
             static_cast<uint64_t>(f.measured), "expected_mbps",
             static_cast<uint64_t>(f.expected), telemetry::intern(f.endpoint));
-        if (straggler_reopt_enabled() && !f.to_uuid.empty()) {
-            // telemetry-refreshed matrix: the measured (degraded) rate
-            // replaces the stale probe value — in the WITNESSED direction:
-            // remote -> reporter for the rate detector, reporter -> remote
-            // for a watchdog CONFIRM — so the background ATSP pass actually
-            // routes around the slow hop; the next optimize round adopts
-            // the improved ring (check_optimize moonshot path)
-            if (f.outbound) {
-                bandwidth_.store(c->uuid, f.to_raw, f.measured);
-                if (journal_)
-                    journal_->record_bandwidth(c->uuid, f.to_raw, f.measured);
-            } else {
-                bandwidth_.store(f.to_raw, c->uuid, f.measured);
-                if (journal_)
-                    journal_->record_bandwidth(f.to_raw, c->uuid, f.measured);
-            }
-            request_straggler_reopt(c->peer_group);
-        }
-        // a watchdog CONFIRM means the data plane is already relaying
-        // around a dead-slow hop mid-collective — exactly the evidence
-        // that evaporates by the time anyone looks: capture it NOW
-        if (f.outbound)
-            maybe_incident(out, "watchdog_confirm:" + from + "->" + f.endpoint,
-                           c->peer_group);
     }
-    return out;
+    if (!newly_flagged.empty()) {
+        // hand the consensus-side follow-ups (matrix rewrite + journal,
+        // REOPT, incident broadcast) to the dispatcher's next tick: the
+        // fold thread must never act on dispatcher-only state
+        MutexLock lk(ingest_mu_);
+        for (const auto &f : newly_flagged) {
+            StragglerAction a;
+            a.endpoint = f.endpoint;
+            a.from_uuid = from;
+            a.from_raw = item.peer;
+            a.to_raw = f.to_raw;
+            a.has_to = !f.to_uuid.empty();
+            a.group = item.group;
+            a.measured_mbps = f.measured;
+            a.expected_mbps = f.expected;
+            a.outbound_confirm = f.outbound;
+            pending_actions_.push_back(std::move(a));
+        }
+    }
 }
 
 void MasterState::request_straggler_reopt(uint32_t gid) {
@@ -1563,21 +1827,32 @@ void MasterState::maybe_incident(std::vector<Outbox> &out,
     const std::string dir = incident_dir();
     if (dir.empty()) return; // plane disabled
     const uint64_t now = telemetry::now_ns();
-    if (last_incident_ns_ && now - last_incident_ns_ < incident_min_ns()) {
-        // rate limited: a flapping edge or an abort storm must not spam
-        // disk — the suppression is still counted and visible on /health
-        MutexLock lk(health_mu_);
-        ++incidents_suppressed_;
+    // rate limited PER TRIGGER CLASS (the prefix before ':'): a flapping
+    // kick storm must not spam disk, but neither may it starve a later
+    // watchdog_confirm bundle — each class carries its own window
+    const std::string klass = trigger.substr(0, trigger.find(':'));
+    uint64_t &last = last_incident_ns_by_class_[klass];
+    if (last && now - last < incident_min_ns()) {
+        // the suppression is still counted (globally and per class) and
+        // visible on /health + /metrics
+        incidents_suppressed_.fetch_add(1, std::memory_order_relaxed);
+        IngestItem sup;
+        sup.kind = IngestItem::kIncident;
+        sup.inc_trigger = klass; // empty inc_id = suppressed
+        enqueue(std::move(sup));
         return;
     }
-    last_incident_ns_ = now;
+    last = now;
     const std::string id = "inc-e" + std::to_string(epoch_) + "-" +
                            std::to_string(++incident_seq_);
+    incidents_total_.fetch_add(1, std::memory_order_relaxed);
     {
-        MutexLock lk(health_mu_);
-        ++incidents_total_;
-        recent_incidents_.push_back({id, trigger, now});
-        while (recent_incidents_.size() > 8) recent_incidents_.pop_front();
+        IngestItem rec;
+        rec.kind = IngestItem::kIncident;
+        rec.inc_id = id;
+        rec.inc_trigger = trigger;
+        rec.t_ns = now;
+        enqueue(std::move(rec));
     }
     PLOG(kWarn) << "incident " << id << " (" << trigger
                 << "): broadcasting black-box capture to " << clients_.size()
@@ -1621,7 +1896,45 @@ void MasterState::maybe_incident(std::vector<Outbox> &out,
     fclose(f);
 }
 
+namespace {
+
+// /metrics cardinality + cache tunables (docs/03). Re-read per render
+// (rare): tests flip them at runtime.
+size_t metrics_edge_topk() {
+    if (const char *e = std::getenv("PCCLT_METRICS_EDGE_TOPK")) {
+        long v = std::atol(e);
+        if (v >= 0) return static_cast<size_t>(v);
+    }
+    return 64;
+}
+
+uint64_t metrics_max_age_ns() {
+    if (const char *e = std::getenv("PCCLT_METRICS_MAX_AGE_MS")) {
+        long long v = atoll(e);
+        if (v >= 0) return static_cast<uint64_t>(v) * 1'000'000ull;
+    }
+    return 1'000'000'000ull; // 1 s
+}
+
+} // namespace
+
 std::string MasterState::render_metrics() const {
+    // Render cache: N concurrent scrapers share one build. The build runs
+    // WHILE HOLDING the cache lock on purpose — late scrapers serialize
+    // behind the builder and get the fresh text for free instead of
+    // kicking off N identical full renders under health_mu_ contention.
+    const uint64_t max_age = metrics_max_age_ns();
+    MutexLock lk(metrics_cache_mu_);
+    const uint64_t now = telemetry::now_ns();
+    if (max_age && !metrics_cache_.empty() &&
+        now - metrics_cache_ns_ < max_age)
+        return metrics_cache_;
+    metrics_cache_ = render_metrics_uncached();
+    metrics_cache_ns_ = now;
+    return metrics_cache_;
+}
+
+std::string MasterState::render_metrics_uncached() const {
     const uint64_t now = telemetry::now_ns();
     std::string o;
     o.reserve(4096);
@@ -1644,11 +1957,12 @@ std::string MasterState::render_metrics() const {
         o += " counter\n";
     };
     // copy the model out under a SHORT critical section, render outside:
-    // the dispatcher takes health_mu_ on every digest/tick, and a large
+    // the fold thread takes health_mu_ on every digest, and a large
     // fleet's exposition is thousands of heap-allocating appends — string
-    // building under the lock would stall consensus for the whole scrape
+    // building under the lock would stall the ingest for the whole scrape
     std::map<std::string, PeerHealth> fleet_peers_copy;
     std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
+    std::map<std::string, uint64_t> suppressed_by_class_copy;
     uint64_t digests_total_copy, stragglers_copy;
     uint64_t incidents_copy, incidents_suppressed_copy;
     size_t world_copy, clients_copy, limbo_copy;
@@ -1656,6 +1970,7 @@ std::string MasterState::render_metrics() const {
         MutexLock lk(health_mu_);
         fleet_peers_copy = fleet_peers_;
         fleet_edges_copy = fleet_edges_;
+        suppressed_by_class_copy = incidents_suppressed_by_class_;
         digests_total_copy = digests_total_;
         stragglers_copy = stragglers_flagged_;
         incidents_copy = incidents_total_;
@@ -1684,6 +1999,53 @@ std::string MasterState::render_metrics() const {
             "incident triggers swallowed by the rate limiter");
     o += "pcclt_master_incidents_suppressed_total " +
          num(incidents_suppressed_copy) + "\n";
+    // per-class suppression detail: the limiter windows are per trigger
+    // class, so the operator can see WHICH storm is being swallowed
+    counter("pcclt_master_incidents_suppressed_by_class_total",
+            "incident triggers swallowed by the per-class rate limiter");
+    {
+        auto esc = [](const std::string &s) {
+            std::string r;
+            for (char ch : s) {
+                if (ch == '\\' || ch == '"') r += '\\';
+                if (ch == '\n') {
+                    r += "\\n";
+                    continue;
+                }
+                r += ch;
+            }
+            return r;
+        };
+        for (const auto &[klass, n] : suppressed_by_class_copy)
+            o += "pcclt_master_incidents_suppressed_by_class_total"
+                 "{trigger_class=\"" +
+                 esc(klass) + "\"} " + num(n) + "\n";
+    }
+    gauge("pcclt_build_info",
+          "build identity (constant 1; the labels are the payload)");
+    o += std::string("pcclt_build_info{version=\"") + kPccltVersion +
+         "\",uring=\"" + (net::uring::enabled() ? "1" : "0") +
+         "\",zerocopy=\"" + (net::uring::zc_min_bytes() ? "1" : "0") +
+         "\"} 1\n";
+    gauge("pcclt_master_uptime_seconds",
+          "seconds since this master process constructed its state machine");
+    o += "pcclt_master_uptime_seconds " + num((now - start_ns_) / 1e9) + "\n";
+    // ingest-queue health: a sustained depth near capacity (or any drops)
+    // means the fold thread is not keeping up with the digest rate
+    gauge("pcclt_master_digest_queue_depth",
+          "telemetry digests waiting in the ingest queue");
+    o += "pcclt_master_digest_queue_depth " +
+         num(static_cast<uint64_t>(
+             ingest_depth_.load(std::memory_order_relaxed))) +
+         "\n";
+    counter("pcclt_master_digest_queue_dropped_total",
+            "telemetry digests dropped at the ingest-queue cap");
+    o += "pcclt_master_digest_queue_dropped_total " +
+         num(ingest_dropped_.load(std::memory_order_relaxed)) + "\n";
+    gauge("pcclt_master_digest_queue_capacity",
+          "ingest-queue digest cap (PCCLT_DIGEST_QUEUE_CAP)");
+    o += "pcclt_master_digest_queue_capacity " +
+         num(static_cast<uint64_t>(digest_queue_cap())) + "\n";
     // the master's OWN flight-recorder ring (the per-peer mirror rides the
     // digest): saturation is visible to a scraper, not just in artifacts
     {
@@ -1752,6 +2114,36 @@ std::string MasterState::render_metrics() const {
                 fn(labels, h);
             }
     };
+    // ingest-thread fold latency (enqueue -> folded): the "is the fold
+    // keeping up" distribution the master-scale bench gates on
+    {
+        auto h = fold_hist_.snapshot();
+        histo("pcclt_master_digest_fold_seconds",
+              "telemetry digest enqueue-to-folded latency on the ingest "
+              "thread (log2 buckets)");
+        uint64_t cum = 0;
+        for (size_t i = 0; i + 1 < telemetry::kHistBuckets; ++i) {
+            if (!h.buckets[i]) continue;
+            cum += h.buckets[i];
+            o += "pcclt_master_digest_fold_seconds_bucket{le=\"" + hist_le(i) +
+                 "\"} " + num(cum) + "\n";
+        }
+        cum += h.buckets[telemetry::kHistBuckets - 1];
+        o += "pcclt_master_digest_fold_seconds_bucket{le=\"+Inf\"} " +
+             num(cum) + "\n";
+        o += "pcclt_master_digest_fold_seconds_sum " + num(h.sum_ns / 1e9) +
+             "\n";
+        o += "pcclt_master_digest_fold_seconds_count " + num(cum) + "\n";
+        gauge("pcclt_master_digest_fold_p50_seconds",
+              "bucket-resolution median digest fold latency");
+        o += "pcclt_master_digest_fold_p50_seconds " +
+             num(h.quantile_ns(0.5) / 1e9) + "\n";
+        gauge("pcclt_master_digest_fold_p99_seconds",
+              "bucket-resolution p99 digest fold latency");
+        o += "pcclt_master_digest_fold_p99_seconds " +
+             num(h.quantile_ns(0.99) / 1e9) + "\n";
+    }
+
     histo("pcclt_phase_latency_seconds",
           "per-peer data-plane phase latency distribution (log2 buckets)");
     each_phase([&](const std::string &labels, const telemetry::HistSnapshot &h) {
@@ -1770,62 +2162,171 @@ std::string MasterState::render_metrics() const {
              num(h.quantile_ns(0.99) / 1e9) + "\n";
     });
 
+    // family-major, one loop per family: the text format requires a
+    // family's samples to be contiguous (promlint.py enforces it; real
+    // scrapers reject re-opened families), so the per-peer block cannot
+    // be emitted peer-major
+    auto each_peer = [&](const char *fam, auto &&val) {
+        for (const auto &[uuid, p] : fleet_peers_copy)
+            o += fam + ("{peer=\"" + uuid + "\",group=\"" +
+                        num(static_cast<uint64_t>(p.group)) + "\"} ") +
+                 val(p) + "\n";
+    };
     counter("pcclt_peer_collectives_ok_total", "collectives completed ok, per peer");
+    each_peer("pcclt_peer_collectives_ok_total",
+              [&](const auto &p) { return num(p.collectives_ok); });
     gauge("pcclt_peer_last_seq", "newest collective seq the peer completed");
+    each_peer("pcclt_peer_last_seq",
+              [&](const auto &p) { return num(p.last_seq); });
     gauge("pcclt_peer_trace_ring_dropped",
           "peer flight-recorder events lost to ring wrap");
+    each_peer("pcclt_peer_trace_ring_dropped",
+              [&](const auto &p) { return num(p.ring_dropped); });
     gauge("pcclt_peer_trace_ring_pushed",
           "events pushed into the peer's flight-recorder ring");
+    each_peer("pcclt_peer_trace_ring_pushed",
+              [&](const auto &p) { return num(p.ring_pushed); });
     gauge("pcclt_peer_trace_ring_capacity",
           "the peer's flight-recorder ring capacity (dropped > 0 means its "
           "traces are truncated to the newest ring_capacity events)");
+    each_peer("pcclt_peer_trace_ring_capacity",
+              [&](const auto &p) { return num(p.ring_cap); });
     gauge("pcclt_peer_staleness_ms", "ms since the peer's last digest");
+    each_peer("pcclt_peer_staleness_ms", [&](const auto &p) {
+        return num((now - p.last_digest_ns) / 1'000'000);
+    });
     gauge("pcclt_peer_up", "1 while the peer's control session is live");
-    for (const auto &[uuid, p] : fleet_peers_copy) {
-        std::string lbl = "{peer=\"" + uuid + "\",group=\"" +
-                          num(static_cast<uint64_t>(p.group)) + "\"} ";
-        o += "pcclt_peer_collectives_ok_total" + lbl + num(p.collectives_ok) + "\n";
-        o += "pcclt_peer_last_seq" + lbl + num(p.last_seq) + "\n";
-        o += "pcclt_peer_trace_ring_dropped" + lbl + num(p.ring_dropped) + "\n";
-        o += "pcclt_peer_trace_ring_pushed" + lbl + num(p.ring_pushed) + "\n";
-        o += "pcclt_peer_trace_ring_capacity" + lbl + num(p.ring_cap) + "\n";
-        o += "pcclt_peer_staleness_ms" + lbl +
-             num((now - p.last_digest_ns) / 1'000'000) + "\n";
-        o += "pcclt_peer_up" + lbl + (p.departed ? "0" : "1");
-        o += "\n";
+    each_peer("pcclt_peer_up", [&](const auto &p) {
+        return std::string(p.departed ? "0" : "1");
+    });
+
+    // ---- bounded per-edge cardinality (fleet scale, docs/09) ----
+    // Full per-edge series only for the top-K edges ranked worst-first by
+    // (wd_state desc, straggler, stall_ratio desc, traffic desc) under
+    // PCCLT_METRICS_EDGE_TOPK (0 = unbounded). The remainder is rolled up
+    // per reporting peer below — at world=1000 the flat exposition would
+    // be O(world^2) series, which no scraper (or scrape window) survives.
+    const size_t topk = metrics_edge_topk();
+    struct Rollup {
+        uint64_t edges = 0, tx_bytes = 0, rx_bytes = 0, stragglers = 0;
+        double max_stall = 0;
+        uint32_t max_wd = 0;
+    };
+    std::map<std::pair<std::string, std::string>, const EdgeHealth *> detail;
+    std::map<std::string, Rollup> rollup;
+    if (topk == 0 || fleet_edges_copy.size() <= topk) {
+        for (const auto &[key, e] : fleet_edges_copy) detail.emplace(key, &e);
+    } else {
+        std::vector<const EdgeHealth *> ranked;
+        ranked.reserve(fleet_edges_copy.size());
+        for (const auto &[key, e] : fleet_edges_copy) ranked.push_back(&e);
+        auto worse = [](const EdgeHealth *a, const EdgeHealth *b) {
+            if (a->wd_state != b->wd_state) return a->wd_state > b->wd_state;
+            if (a->straggler != b->straggler) return a->straggler;
+            if (a->stall_ratio != b->stall_ratio)
+                return a->stall_ratio > b->stall_ratio;
+            return a->tx_bytes + a->rx_bytes > b->tx_bytes + b->rx_bytes;
+        };
+        std::nth_element(ranked.begin(),
+                         ranked.begin() + static_cast<ptrdiff_t>(topk),
+                         ranked.end(), worse);
+        for (size_t i = 0; i < topk; ++i)
+            detail.emplace(
+                std::make_pair(ranked[i]->from_uuid, ranked[i]->to_endpoint),
+                ranked[i]);
+        for (size_t i = topk; i < ranked.size(); ++i) {
+            const EdgeHealth *e = ranked[i];
+            auto &r = rollup[e->from_uuid];
+            ++r.edges;
+            r.tx_bytes += e->tx_bytes;
+            r.rx_bytes += e->rx_bytes;
+            if (e->straggler) ++r.stragglers;
+            r.max_stall = std::max(r.max_stall, e->stall_ratio);
+            r.max_wd = std::max(r.max_wd, e->wd_state);
+        }
     }
 
+    // family-major for the same contiguity reason as the peer block above
+    auto each_edge = [&](const char *fam, auto &&val) {
+        for (const auto &[key, ep] : detail) {
+            const EdgeHealth &e = *ep;
+            o += fam + ("{from=\"" + e.from_uuid + "\",to=\"" +
+                        e.to_endpoint + "\",to_peer=\"" + e.to_uuid +
+                        "\"} ") + val(e) + "\n";
+        }
+    };
     gauge("pcclt_edge_tx_mbps", "EWMA achieved egress per edge, Mbit/s");
+    each_edge("pcclt_edge_tx_mbps",
+              [&](const EdgeHealth &e) { return num(e.tx_mbps); });
     gauge("pcclt_edge_rx_mbps", "EWMA achieved ingress per edge, Mbit/s");
+    each_edge("pcclt_edge_rx_mbps",
+              [&](const EdgeHealth &e) { return num(e.rx_mbps); });
     gauge("pcclt_edge_stall_ratio", "EWMA receiver wire-stall per interval");
+    each_edge("pcclt_edge_stall_ratio",
+              [&](const EdgeHealth &e) { return num(e.stall_ratio); });
     counter("pcclt_edge_tx_bytes_total", "cumulative payload bytes sent on the edge");
+    each_edge("pcclt_edge_tx_bytes_total",
+              [&](const EdgeHealth &e) { return num(e.tx_bytes); });
     counter("pcclt_edge_rx_bytes_total", "cumulative payload bytes received on the edge");
+    each_edge("pcclt_edge_rx_bytes_total",
+              [&](const EdgeHealth &e) { return num(e.rx_bytes); });
     gauge("pcclt_edge_expected_mbps", "bandwidth-matrix entry for the edge");
+    each_edge("pcclt_edge_expected_mbps",
+              [&](const EdgeHealth &e) { return num(e.expected_mbps); });
     gauge("pcclt_edge_straggler",
           "1 while measured throughput sits below the straggler threshold");
+    each_edge("pcclt_edge_straggler", [&](const EdgeHealth &e) {
+        return std::string(e.straggler ? "1" : "0");
+    });
     gauge("pcclt_edge_wd_state",
           "reporter's data-plane watchdog verdict: 0 ok, 1 suspect, "
           "2 confirmed (relaying in-collective)");
-    for (const auto &[key, e] : fleet_edges_copy) {
-        std::string lbl = "{from=\"" + e.from_uuid + "\",to=\"" + e.to_endpoint +
-                          "\",to_peer=\"" + e.to_uuid + "\"} ";
-        o += "pcclt_edge_tx_mbps" + lbl + num(e.tx_mbps) + "\n";
-        o += "pcclt_edge_rx_mbps" + lbl + num(e.rx_mbps) + "\n";
-        o += "pcclt_edge_stall_ratio" + lbl + num(e.stall_ratio) + "\n";
-        o += "pcclt_edge_tx_bytes_total" + lbl + num(e.tx_bytes) + "\n";
-        o += "pcclt_edge_rx_bytes_total" + lbl + num(e.rx_bytes) + "\n";
-        o += "pcclt_edge_expected_mbps" + lbl + num(e.expected_mbps) + "\n";
-        o += "pcclt_edge_straggler" + lbl + (e.straggler ? "1" : "0");
-        o += "\n";
-        o += "pcclt_edge_wd_state" + lbl +
-             num(static_cast<uint64_t>(e.wd_state)) + "\n";
+    each_edge("pcclt_edge_wd_state", [&](const EdgeHealth &e) {
+        return num(static_cast<uint64_t>(e.wd_state));
+    });
+    // per-peer rollups of the edges omitted from detail: conservation
+    // holds (detail + rollup covers every edge) and the worst omitted
+    // stall/wd verdict stays visible even when its edge does not
+    if (!rollup.empty()) {
+        gauge("pcclt_peer_edges_rolled_up",
+              "edges beyond the PCCLT_METRICS_EDGE_TOPK detail set, per "
+              "reporting peer");
+        for (const auto &[peer, r] : rollup)
+            o += "pcclt_peer_edges_rolled_up{peer=\"" + peer + "\"} " +
+                 num(r.edges) + "\n";
+        counter("pcclt_peer_rollup_tx_bytes_total",
+                "cumulative payload bytes sent on rolled-up edges");
+        for (const auto &[peer, r] : rollup)
+            o += "pcclt_peer_rollup_tx_bytes_total{peer=\"" + peer + "\"} " +
+                 num(r.tx_bytes) + "\n";
+        counter("pcclt_peer_rollup_rx_bytes_total",
+                "cumulative payload bytes received on rolled-up edges");
+        for (const auto &[peer, r] : rollup)
+            o += "pcclt_peer_rollup_rx_bytes_total{peer=\"" + peer + "\"} " +
+                 num(r.rx_bytes) + "\n";
+        gauge("pcclt_peer_rollup_max_stall_ratio",
+              "worst EWMA wire-stall among rolled-up edges");
+        for (const auto &[peer, r] : rollup)
+            o += "pcclt_peer_rollup_max_stall_ratio{peer=\"" + peer + "\"} " +
+                 num(r.max_stall) + "\n";
+        gauge("pcclt_peer_rollup_max_wd_state",
+              "worst watchdog verdict among rolled-up edges");
+        for (const auto &[peer, r] : rollup)
+            o += "pcclt_peer_rollup_max_wd_state{peer=\"" + peer + "\"} " +
+                 num(static_cast<uint64_t>(r.max_wd)) + "\n";
+        gauge("pcclt_peer_rollup_stragglers",
+              "flagged straggler edges among rolled-up edges");
+        for (const auto &[peer, r] : rollup)
+            o += "pcclt_peer_rollup_stragglers{peer=\"" + peer + "\"} " +
+                 num(r.stragglers) + "\n";
     }
     // per-(edge, phase) latency distributions: the histogram that names
     // the HOP a stage's wall time / stall tail binds on. One pass per
     // family, same grouping rule as the phase histograms above.
     histo("pcclt_edge_stage_latency_seconds",
           "per-edge ring-stage wall-time distribution (inbound hop)");
-    for (const auto &[key, e] : fleet_edges_copy) {
+    for (const auto &[key, ep] : detail) {
+        const EdgeHealth &e = *ep;
         if (e.stage_wire_hist.empty()) continue;
         std::string labels = "from=\"" + e.from_uuid + "\",to=\"" +
                              e.to_endpoint + "\",to_peer=\"" + e.to_uuid +
@@ -1835,7 +2336,8 @@ std::string MasterState::render_metrics() const {
     }
     histo("pcclt_edge_stall_latency_seconds",
           "per-edge receiver wire-stall distribution (per stage)");
-    for (const auto &[key, e] : fleet_edges_copy) {
+    for (const auto &[key, ep] : detail) {
+        const EdgeHealth &e = *ep;
         if (e.stall_hist.empty()) continue;
         std::string labels = "from=\"" + e.from_uuid + "\",to=\"" +
                              e.to_endpoint + "\",to_peer=\"" + e.to_uuid +
@@ -1846,17 +2348,18 @@ std::string MasterState::render_metrics() const {
     return o;
 }
 
-std::string MasterState::render_health_json() const {
+std::string MasterState::render_health_json(bool include_history) const {
     const uint64_t now = telemetry::now_ns();
     std::string o;
     o.reserve(2048);
     // copy-then-render, as in render_metrics: never build strings while
-    // holding the lock the dispatcher needs per digest/tick
+    // holding the lock the fold thread needs per digest
     std::map<std::string, PeerHealth> fleet_peers_copy;
     std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
     uint64_t digests_total_copy, stragglers_copy;
     uint64_t incidents_copy, incidents_suppressed_copy;
     std::deque<IncidentRec> incidents_recent_copy;
+    std::deque<HealthSample> history_copy;
     size_t world_copy, clients_copy, limbo_copy;
     {
         MutexLock lk(health_mu_);
@@ -1867,6 +2370,7 @@ std::string MasterState::render_health_json() const {
         incidents_copy = incidents_total_;
         incidents_suppressed_copy = incidents_suppressed_;
         incidents_recent_copy = recent_incidents_;
+        if (include_history) history_copy = health_history_;
         world_copy = health_world_;
         clients_copy = health_clients_;
         limbo_copy = health_limbo_;
@@ -1879,6 +2383,48 @@ std::string MasterState::render_health_json() const {
     o += ",\"stragglers_flagged\":" + num(stragglers_copy);
     o += ",\"incidents_total\":" + num(incidents_copy);
     o += ",\"incidents_suppressed\":" + num(incidents_suppressed_copy);
+    // build identity + process age: mirrors the /metrics pcclt_build_info
+    // gauge so a /health-only consumer sees the same facts
+    o += ",\"build\":{\"version\":";
+    json_str(o, kPccltVersion);
+    o += ",\"uring\":";
+    o += net::uring::enabled() ? "true" : "false";
+    o += ",\"zerocopy\":";
+    o += net::uring::zc_min_bytes() ? "true" : "false";
+    o += "}";
+    o += ",\"uptime_seconds\":" + num((now - start_ns_) / 1e9);
+    o += ",\"digest_queue\":{\"depth\":" +
+         num(static_cast<uint64_t>(
+             ingest_depth_.load(std::memory_order_relaxed))) +
+         ",\"dropped\":" +
+         num(ingest_dropped_.load(std::memory_order_relaxed)) +
+         ",\"capacity\":" + num(static_cast<uint64_t>(digest_queue_cap())) +
+         "}";
+    if (include_history) {
+        // the /health?history=1 ring: newest-last fleet snapshots, sampled
+        // by the fold thread every PCCLT_HEALTH_HISTORY_MS
+        o += ",\"history\":[";
+        bool first_h = true;
+        for (const auto &s : history_copy) {
+            if (!first_h) o += ',';
+            first_h = false;
+            o += "{\"age_ms\":" + num((now - s.t_ns) / 1'000'000);
+            o += ",\"world\":" + num(static_cast<uint64_t>(s.world));
+            o += ",\"clients\":" + num(static_cast<uint64_t>(s.clients));
+            o += ",\"limbo\":" + num(static_cast<uint64_t>(s.limbo));
+            o += ",\"peers\":" + num(static_cast<uint64_t>(s.peers));
+            o += ",\"edges\":" + num(static_cast<uint64_t>(s.edges));
+            o += ",\"digests\":" + num(s.digests);
+            o += ",\"digest_rate\":" + num(s.digest_rate);
+            o += ",\"stragglers\":" + num(s.stragglers);
+            o += ",\"incidents\":" + num(s.incidents);
+            o += ",\"suppressed\":" + num(s.suppressed);
+            o += ",\"queue_depth\":" + num(static_cast<uint64_t>(s.queue_depth));
+            o += ",\"queue_dropped\":" + num(s.queue_dropped);
+            o += '}';
+        }
+        o += "]";
+    }
     // newest-last recent incident ids: the pointer from a live /health
     // scrape into the PCCLT_INCIDENT_DIR bundle directories
     o += ",\"incidents\":[";
@@ -1949,7 +2495,17 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
     if (it == clients_.end()) return out;
     ClientInfo gone = it->second;
     clients_.erase(it);
-    ++membership_gen_;
+    if (gone.observer) {
+        // telemetry-only session: nothing consensus-side to unwind (never
+        // accepted, never journaled, no bandwidth rows) — just mark its
+        // fleet record down and refresh the published counts
+        IngestItem dep;
+        dep.kind = IngestItem::kDeparted;
+        dep.peer = gone.uuid;
+        enqueue(std::move(dep));
+        publish_health_summary();
+        return out;
+    }
     if (journal_) journal_->record_client_remove(gone.uuid);
     PLOG(kInfo) << "client " << proto::uuid_str(gone.uuid) << " disconnected, world="
                 << world_size();
@@ -1965,11 +2521,24 @@ std::vector<Outbox> MasterState::on_disconnect(uint64_t conn) {
 void MasterState::remove_client(std::vector<Outbox> &out, const ClientInfo &gone) {
     bandwidth_.forget(gone.uuid);
     {
-        // fleet health: keep the record for post-mortems, mark it down
+        // keep the fold thread's mirrors in step: bandwidth rows gone,
+        // endpoint index entry released, fleet record marked down
         // (pcclt_peer_up 0; the next digest after a session resume revives)
-        MutexLock lk(health_mu_);
-        auto fit = fleet_peers_.find(proto::uuid_str(gone.uuid));
-        if (fit != fleet_peers_.end()) fit->second.departed = true;
+        IngestItem fg;
+        fg.kind = IngestItem::kForget;
+        fg.peer = gone.uuid;
+        enqueue(std::move(fg));
+        IngestItem er;
+        er.kind = IngestItem::kEndpointRemove;
+        net::Addr a = gone.ip;
+        a.port = gone.p2p_port;
+        er.endpoint = a.str();
+        er.peer = gone.uuid;
+        enqueue(std::move(er));
+        IngestItem dep;
+        dep.kind = IngestItem::kDeparted;
+        dep.peer = gone.uuid;
+        enqueue(std::move(dep));
     }
     publish_health_summary();
 
@@ -2021,7 +2590,7 @@ void MasterState::remove_client(std::vector<Outbox> &out, const ClientInfo &gone
     if (!establish_in_flight_) {
         bool any_pending = false;
         for (auto &[_, c] : clients_)
-            if (!c.accepted) any_pending = true;
+            if (!c.accepted && !c.observer) any_pending = true;
         if (!any_pending)
             for (auto &[_, c] : clients_)
                 // admission votes are never moot: their holder is PARKED in

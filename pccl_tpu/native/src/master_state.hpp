@@ -53,6 +53,13 @@ struct ClientInfo {
     net::Addr ip{}; // observed or advertised (family-tagged; port unused)
     uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
     bool accepted = false; // admitted to the world vs pending join
+    // telemetry-only control session (hello observer flag): may push
+    // digests but never joins the world — excluded from admission rounds,
+    // peer lists, and the journal. The fleet-scale digest bots (bench,
+    // stress orchestrator --fleet-scale) and external monitoring agents
+    // register this way so a thousand of them cannot wedge a topology
+    // round that real peers are waiting on.
+    bool observer = false;
 
     // votes (valid within their phase)
     bool vote_topology = false;
@@ -82,10 +89,13 @@ struct CollectiveOp {
 
 // ---- fleet health model (observability plane, docs/09) ----
 // Soft state folded from kC2MTelemetryDigest pushes. Lives behind its own
-// mutex (NOT dispatcher-only like the consensus machine) because the
-// metrics/health HTTP threads read it concurrently; the dispatcher is the
-// only writer. Deliberately unjournaled: rates are meaningless across a
-// restart — a restarted master rebuilds the picture from the next digests.
+// mutex (NOT dispatcher-only like the consensus machine): the dedicated
+// digest-ingest (fold) thread is the only WRITER, the metrics/health HTTP
+// threads read it concurrently, and the dispatcher only ever enqueues
+// work toward it (it takes health_mu_ solely as a render READER inside an
+// incident manifest). Deliberately unjournaled: rates are meaningless
+// across a restart — a restarted master rebuilds the picture from the
+// next digests.
 
 struct PeerHealth {
     std::string uuid;   // uuid_str form (label-friendly)
@@ -143,6 +153,8 @@ struct GroupState {
 
 class MasterState {
 public:
+    // spawns the digest-ingest (fold) thread; joined by the destructor
+    MasterState();
     ~MasterState();
 
     // --- HA: journal attachment + rehydration (call before any event) ---
@@ -186,11 +198,33 @@ public:
                                             const proto::TelemetryDigestC2M &d);
     std::vector<Outbox> on_disconnect(uint64_t conn);
 
-    // --- fleet health egress (HTTP threads; dispatcher is the only writer).
-    // Prometheus text-format gauges/counters, and the /health JSON the C
-    // API (pccltMasterGetHealth) and MasterNode.health() mirror.
+    // --- fleet health egress (HTTP threads; the fold thread is the only
+    // writer). Prometheus text-format gauges/counters, and the /health
+    // JSON the C API (pccltMasterGetHealth) and MasterNode.health()
+    // mirror. render_metrics serves from a short-lived cache
+    // (PCCLT_METRICS_MAX_AGE_MS, default 1000; 0 disables) so N
+    // concurrent scrapers share one build; include_history appends the
+    // /health?history=1 snapshot ring.
     std::string render_metrics() const;
-    std::string render_health_json() const;
+    std::string render_health_json(bool include_history = false) const;
+
+    // --- test/bench hooks (see selftest + run_master_scale_bench) ---
+    // digests fully folded into the fleet maps (NOT merely enqueued):
+    // tests spin on this before asserting render output, since the
+    // dispatcher returns from on_telemetry_digest before the fold runs
+    uint64_t digests_folded() const {
+        return digests_total_.load(std::memory_order_acquire);
+    }
+    uint64_t ingest_dropped() const {
+        return ingest_dropped_.load(std::memory_order_relaxed);
+    }
+    size_t ingest_queue_depth() const {
+        return ingest_depth_.load(std::memory_order_relaxed);
+    }
+    // regression hook: a test holds this while pumping digests through the
+    // dispatcher path — enqueue-only ingest must not block (a deadlock
+    // here means on_telemetry_digest re-grew a health_mu_ acquisition)
+    Mutex &health_mutex_test_hook() { return health_mu_; }
 
     // conns the dispatcher should close (kicked); cleared on read
     std::vector<uint64_t> take_pending_closes();
@@ -260,54 +294,154 @@ private:
     bool optimize_work_phase_ = false;
     BandwidthStore bandwidth_;
 
-    // fleet health (observability plane): dispatcher-written on digest /
-    // tick / membership change, HTTP-thread-read by the render methods.
+    // fleet health (observability plane): the dispatcher ENQUEUES ingest
+    // items (digests, membership deltas, bandwidth-mirror updates, world
+    // counts, incident records); the dedicated fold thread drains them and
+    // is the only writer of the health_mu_-guarded maps. HTTP threads read
+    // under health_mu_ via the render methods.
     // publish_health_summary republishes the dispatcher-only world view
     // (counts) so readers never touch clients_/limbo_ themselves.
-    void publish_health_summary() PCCLT_EXCLUDES(health_mu_);
+    void publish_health_summary();
     // ---- incident black box (docs/09) ----
     // When PCCLT_INCIDENT_DIR is set and an incident trigger fires
     // (collective abort, kick, watchdog CONFIRM, limbo expiry), broadcast
     // a fire-and-forget kM2CIncidentDump to every connected client under a
     // fresh shared incident id and write the master-side manifest
-    // (trigger + fleet-health snapshot) under that id. Rate-limited by
-    // PCCLT_INCIDENT_MIN_MS (default 30000) so a flapping edge cannot
-    // spam disk — suppressed triggers only bump the counter.
+    // (trigger + fleet-health snapshot) under that id. Rate-limited PER
+    // TRIGGER CLASS (the prefix before ':') by PCCLT_INCIDENT_MIN_MS
+    // (default 30000) so a flapping kick storm cannot starve a later
+    // watchdog_confirm bundle — suppressed triggers only bump the
+    // per-class counter.
     void maybe_incident(std::vector<Outbox> &out, const std::string &trigger,
                         uint32_t group);
     struct IncidentRec {
         std::string id, trigger;
         uint64_t t_ns = 0; // telemetry clock at the trigger
     };
-    // dispatcher-only: rate limiter + id counter
-    uint64_t last_incident_ns_ = 0;
+    // dispatcher-only: per-class rate limiter + id counter
+    std::map<std::string, uint64_t> last_incident_ns_by_class_;
     uint64_t incident_seq_ = 0;
     // spawn a background ATSP improvement seeded from the current ring,
     // with the straggler's measured rate substituted into the cost matrix
     // (PCCLT_STRAGGLER_REOPT=1 hook; adopted at the next optimize round)
     void request_straggler_reopt(uint32_t gid);
-    // endpoint->client index for digest resolution, rebuilt lazily when
-    // membership changes (dispatcher-only, like clients_ itself) — a
-    // per-digest rebuild would be O(world log world) string builds on the
-    // consensus thread per push
-    std::map<std::string, uint64_t> endpoint_index_; // endpoint -> conn_id
-    uint64_t membership_gen_ = 1;   // bumped on every clients_ mutation
-    uint64_t endpoint_index_gen_ = 0;
+
+    // ---- digest-ingest queue (dispatcher -> fold thread) ----
+    // Bounded MPSC-style handoff: the dispatcher (and attach_journal, both
+    // serialized) push IngestItems; the fold thread drains them in order.
+    // Only kDigest items are droppable (cap PCCLT_DIGEST_QUEUE_CAP,
+    // default 4096; overflow drops-and-counts so a digest flood can never
+    // back-pressure admission/topology); membership/bandwidth deltas are
+    // control items and always enqueue.
+    struct IngestItem {
+        enum Kind : uint8_t {
+            kDigest,          // fold a telemetry digest
+            kEndpointAdd,     // (endpoint -> peer) index entry add/update
+            kEndpointRemove,  // index entry removal (disconnect/limbo drop)
+            kDeparted,        // mark fleet peer departed (post-mortem keep)
+            kBandwidth,       // bandwidth-matrix mirror: store(peer,to)
+            kForget,          // bandwidth-matrix mirror: forget(peer)
+            kSummary,         // world/clients/limbo counts republish
+            kIncident,        // fired incident record for /health listing
+        };
+        Kind kind = kDigest;
+        proto::TelemetryDigestC2M digest;    // kDigest
+        std::string from_uuid;               // kDigest/kDeparted: label form
+        Uuid peer{};                         // kDigest/kEndpointAdd/kBandwidth/kForget
+        uint32_t group = 0;                  // kDigest/kEndpointAdd
+        std::string endpoint;                // kEndpointAdd/kEndpointRemove
+        Uuid to{};                           // kBandwidth
+        double mbps = 0;                     // kBandwidth
+        size_t world = 0, clients = 0, limbo = 0; // kSummary
+        std::string inc_id, inc_trigger;     // kIncident
+        uint64_t t_ns = 0;                   // kDigest/kIncident
+    };
+    // straggler transitions detected by the fold; drained by the
+    // dispatcher on its next tick (<=100 ms) to run the parts that need
+    // the consensus state: matrix rewrite + journal, REOPT kick-off, and
+    // the incident broadcast
+    struct StragglerAction {
+        std::string endpoint;   // witnessed endpoint ("ip:port")
+        std::string from_uuid;  // reporter (label form)
+        Uuid from_raw{};        // reporter
+        Uuid to_raw{};          // resolved target (valid iff has_to)
+        bool has_to = false;
+        uint32_t group = 0;
+        double measured_mbps = 0, expected_mbps = 0;
+        bool outbound_confirm = false; // watchdog CONFIRM on outbound hop
+    };
+    void enqueue(IngestItem &&it);
+    void enqueue_endpoint_add(const ClientInfo &c);
+    void fold_loop();
+    void fold_item(IngestItem &it);
+    void fold_digest(IngestItem &it);
+    void fold_sweep(uint64_t now);
+    void fold_sample_history(uint64_t now);
+    std::string render_metrics_uncached() const;
+    mutable Mutex ingest_mu_; // lock-rank: 33
+    CondVar ingest_cv_;
+    std::deque<IngestItem> ingest_q_ PCCLT_GUARDED_BY(ingest_mu_);
+    std::vector<StragglerAction> pending_actions_ PCCLT_GUARDED_BY(ingest_mu_);
+    std::atomic<size_t> ingest_depth_{0};     // kDigest items in queue
+    std::atomic<uint64_t> ingest_dropped_{0}; // digests dropped at the cap
+    std::thread fold_thread_;
+    std::atomic<bool> fold_stop_{false};
+    // fold-thread-private digest-resolution state (no lock: single owner).
+    // The endpoint->peer index the dispatcher used to rebuild O(world) per
+    // membership change ON the consensus thread is now maintained
+    // incrementally here from kEndpointAdd/kEndpointRemove deltas; fold_bw_
+    // mirrors the dispatcher-only BandwidthStore for expected-rate lookups.
+    struct FoldPeer {
+        Uuid raw{};
+        std::string uuid_str;
+        uint32_t group = 0;
+    };
+    std::map<std::string, FoldPeer> fold_endpoints_; // endpoint -> peer
+    std::map<Uuid, std::map<Uuid, double>> fold_bw_;
+    uint64_t fold_last_sweep_ns_ = 0;
+    uint64_t fold_last_sample_ns_ = 0;
+    // per-digest fold latency (enqueue->folded), rendered as a histogram +
+    // p50/p99 gauges — the "is the ingest thread keeping up" signal
+    telemetry::Hist fold_hist_;
+
     mutable Mutex health_mu_; // lock-rank: 36
     std::map<std::string, PeerHealth> fleet_peers_ PCCLT_GUARDED_BY(health_mu_);
     std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_
         PCCLT_GUARDED_BY(health_mu_);
-    uint64_t digests_total_ PCCLT_GUARDED_BY(health_mu_) = 0;
-    uint64_t stragglers_flagged_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    // monotone counters: atomics so the fold thread can publish (and
+    // tests/bench can poll) without the readers taking health_mu_
+    std::atomic<uint64_t> digests_total_{0};
+    std::atomic<uint64_t> stragglers_flagged_{0};
     // incident plane: fired incidents (newest last, bounded) + trigger
     // totals incl. rate-limited suppressions, listed on /health
     std::deque<IncidentRec> recent_incidents_ PCCLT_GUARDED_BY(health_mu_);
-    uint64_t incidents_total_ PCCLT_GUARDED_BY(health_mu_) = 0;
-    uint64_t incidents_suppressed_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    std::atomic<uint64_t> incidents_total_{0};
+    std::atomic<uint64_t> incidents_suppressed_{0};
+    std::map<std::string, uint64_t> incidents_suppressed_by_class_
+        PCCLT_GUARDED_BY(health_mu_);
     size_t health_world_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_clients_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_limbo_ PCCLT_GUARDED_BY(health_mu_) = 0;
-    uint64_t health_sweep_tick_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    // /health?history=1 ring: fleet snapshot every
+    // PCCLT_HEALTH_HISTORY_MS (default 1000), last PCCLT_HEALTH_HISTORY
+    // (default 120) kept — trend-over-time without external storage
+    struct HealthSample {
+        uint64_t t_ns = 0;
+        size_t world = 0, clients = 0, limbo = 0, peers = 0, edges = 0;
+        uint64_t digests = 0;   // cumulative at the sample
+        double digest_rate = 0; // digests/s since the previous sample
+        uint64_t stragglers = 0, incidents = 0, suppressed = 0;
+        size_t queue_depth = 0;
+        uint64_t queue_dropped = 0;
+    };
+    std::deque<HealthSample> health_history_ PCCLT_GUARDED_BY(health_mu_);
+    // /metrics render cache (PCCLT_METRICS_MAX_AGE_MS): concurrent
+    // scrapers serialize here and share one build instead of N copies
+    // contending on health_mu_
+    mutable Mutex metrics_cache_mu_; // lock-rank: 35
+    mutable std::string metrics_cache_ PCCLT_GUARDED_BY(metrics_cache_mu_);
+    mutable uint64_t metrics_cache_ns_ PCCLT_GUARDED_BY(metrics_cache_mu_) = 0;
+    const uint64_t start_ns_ = telemetry::now_ns();
 
     // "moonshot" background ATSP improvement (reference: 30 s budget on a
     // thread pool, adopted on a LATER optimize round —

@@ -53,6 +53,7 @@ std::vector<uint8_t> HelloC2M::encode() const {
     w.u16(ss_port);
     w.u16(bench_port);
     w.str(adv_ip);
+    w.u8(observer); // optional tail: old decoders ignore trailing bytes
     return w.take();
 }
 
@@ -66,6 +67,7 @@ std::optional<HelloC2M> HelloC2M::decode(const std::vector<uint8_t> &b) {
         h.ss_port = r.u16();
         h.bench_port = r.u16();
         h.adv_ip = r.str();
+        if (!r.done()) h.observer = r.u8(); // tail-tolerant observer flag
         return h;
     } catch (...) { return std::nullopt; }
 }

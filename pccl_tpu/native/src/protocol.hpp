@@ -139,6 +139,11 @@ struct HelloC2M {
     uint32_t peer_group = 0;
     uint16_t p2p_port = 0, ss_port = 0, bench_port = 0;
     std::string adv_ip; // empty = use source address of the connection
+    // optional trailing byte (tail-tolerant, PCCP/2-compatible both ways):
+    // 1 = telemetry-only observer session — may push digests, never joins
+    // the world (digest bots, external monitors). Old masters ignore the
+    // extra byte; old clients simply never send it (decodes as 0).
+    uint8_t observer = 0;
     std::vector<uint8_t> encode() const;
     static std::optional<HelloC2M> decode(const std::vector<uint8_t> &);
 };

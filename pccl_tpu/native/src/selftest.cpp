@@ -200,7 +200,17 @@ static void test_telemetry() {
 // Observability plane units (docs/09): the digest snapshotter's EWMA fold,
 // the op-sample ring, the recorder's ring-drop accounting, and the master's
 // fleet-health render fed through a real digest packet round-trip.
+// digest folding is asynchronous (off-dispatcher ingest): spin until the
+// fold thread has published at least `n` digests, so render CHECKs see them
+static void wait_folded(master::MasterState &m, uint64_t n) {
+    for (int i = 0; i < 50'000 && m.digests_folded() < n; ++i)
+        std::this_thread::sleep_for(std::chrono::microseconds(100));
+    CHECK(m.digests_folded() >= n);
+}
+
 static void test_observability() {
+    // renders in this test must never serve a stale cache entry
+    setenv("PCCLT_METRICS_MAX_AGE_MS", "0", 1);
     // log2 latency histogram (attribution plane, docs/09): bucket edges,
     // overflow bucket, merge, quantile resolution, sparse<->dense
     {
@@ -322,6 +332,7 @@ static void test_observability() {
     CHECK(!out.empty());
     CHECK(st.on_telemetry_digest(1, *dec).empty()); // fire-and-forget
     CHECK(st.on_telemetry_digest(99, *dec).empty()); // unknown conn: ignored
+    wait_folded(st, 1);
     auto prom = st.render_metrics();
     CHECK(prom.find("pcclt_master_telemetry_digests_total 1") != std::string::npos);
     CHECK(prom.find("pcclt_edge_tx_mbps{") != std::string::npos);
@@ -340,12 +351,25 @@ static void test_observability() {
     CHECK(prom.find("pcclt_peer_trace_ring_capacity{") != std::string::npos);
     CHECK(prom.find("pcclt_master_trace_ring_capacity ") != std::string::npos);
     CHECK(prom.find("pcclt_master_incidents_total 0") != std::string::npos);
+    // build/identity + ingest-queue families (fleet-scale plane, docs/09)
+    CHECK(prom.find("pcclt_build_info{version=\"") != std::string::npos);
+    CHECK(prom.find("pcclt_master_uptime_seconds ") != std::string::npos);
+    CHECK(prom.find("pcclt_master_digest_queue_capacity ") != std::string::npos);
+    CHECK(prom.find("pcclt_master_digest_queue_dropped_total 0") !=
+          std::string::npos);
+    CHECK(prom.find("pcclt_master_digest_fold_seconds_bucket{") !=
+          std::string::npos);
     auto health = st.render_health_json();
     CHECK(health.find("\"telemetry_digests\":1") != std::string::npos);
     CHECK(health.find("\"ring_dropped\":7") != std::string::npos);
     CHECK(health.find("\"ring_pushed\":5000") != std::string::npos);
     CHECK(health.find("\"straggler\":false") != std::string::npos);
     CHECK(health.find("\"incidents\":[]") != std::string::npos);
+    CHECK(health.find("\"build\":{\"version\":") != std::string::npos);
+    CHECK(health.find("\"digest_queue\":{") != std::string::npos);
+    CHECK(health.find("\"history\"") == std::string::npos); // opt-in only
+    CHECK(st.render_health_json(true).find("\"history\":[") !=
+          std::string::npos);
 
     // scrape-cost guard (ROADMAP fleet-scale groundwork): a fleet-sized
     // model — 128 peers x 8 edges = 1024 edge series with full histograms
@@ -382,6 +406,7 @@ static void test_observability() {
             }
             big.on_telemetry_digest(static_cast<uint64_t>(c + 1), dg);
         }
+        wait_folded(big, static_cast<uint64_t>(peers));
         auto t0 = telemetry::now_ns();
         auto text = big.render_metrics();
         auto dt_ms = (telemetry::now_ns() - t0) / 1'000'000;
@@ -389,9 +414,23 @@ static void test_observability() {
         CHECK(text.find("pcclt_edge_stage_latency_seconds_bucket{") !=
               std::string::npos);
         CHECK(dt_ms < 15'000);
+        // default top-K (64) < peers*8 edges: the tail must be rolled up
+        // into per-peer aggregate series instead of dropped on the floor
+        CHECK(text.find("pcclt_peer_edges_rolled_up{") != std::string::npos);
+        CHECK(text.find("pcclt_peer_rollup_tx_bytes_total{") !=
+              std::string::npos);
+        // TOPK=0 = unbounded legacy render: full per-edge detail, no rollup
+        setenv("PCCLT_METRICS_EDGE_TOPK", "0", 1);
+        auto full_text = big.render_metrics();
+        unsetenv("PCCLT_METRICS_EDGE_TOPK");
+        CHECK(full_text.find("pcclt_peer_edges_rolled_up{") ==
+              std::string::npos);
+        CHECK(full_text.size() > text.size());
         fprintf(stderr,
-                "observability: %d-peer scrape = %zu bytes in %llu ms\n",
-                peers, text.size(), (unsigned long long)dt_ms);
+                "observability: %d-peer scrape = %zu bytes in %llu ms "
+                "(topk64) / %zu bytes (full)\n",
+                peers, text.size(), (unsigned long long)dt_ms,
+                full_text.size());
     }
 
     // recorder ring-drop accounting: overflow the 64k ring, count the loss
@@ -417,6 +456,257 @@ static void test_observability() {
     rec.clear();
     rec.enable(was_on);
     fprintf(stderr, "observability: ok\n");
+}
+
+// Off-dispatcher digest ingest (docs/09 fleet scale). The dispatcher's
+// digest path is ENQUEUE-ONLY: it must never acquire health_mu_. The proof
+// is structural — a HOLDER thread owns health_mu_ (starving the fold
+// thread) while the test thread pumps digests and ticks through the
+// dispatcher entry points holding nothing; the holder only releases after
+// witnessing, lock still held, that every call completed and nothing
+// folded. A dispatcher-side health_mu_ acquisition would park the pump
+// behind the holder and the witness could never flip. (The holder thread
+// exists so the dispatcher calls run lock-free on THIS thread — holding
+// health_mu_ across them here would itself order lower-ranked dispatcher
+// locks under rank 36.) Then the bounded-queue overflow contract: at a
+// tiny cap, a flood drops-and-counts instead of back-pressuring the
+// dispatcher.
+static void test_master_ingest_offloop() {
+    setenv("PCCLT_METRICS_MAX_AGE_MS", "0", 1);
+    unsetenv("PCCLT_INCIDENT_DIR");
+    {
+        master::MasterState st;
+        proto::HelloC2M h;
+        h.p2p_port = 7;
+        auto src = net::Addr::parse("10.3.0.1", 0);
+        CHECK(src.has_value());
+        st.on_hello(1, *src, h);
+        proto::TelemetryDigestC2M dg;
+        dg.edges.push_back({"10.3.0.2:7", 5.0, 5.0, 0.0, 100, 100, 0, {}, {}});
+        const uint64_t n = 32;
+        std::atomic<bool> held{false}, pumped{false};
+        std::thread holder([&] {
+            MutexLock lk(st.health_mutex_test_hook()); // fold thread starved
+            held.store(true);
+            for (int i = 0; i < 100000 && !pumped.load(); ++i)
+                // pcclt-verify: allow-blocking(selftest starves the fold thread on purpose; this lock is only ever held standalone)
+                std::this_thread::sleep_for(std::chrono::microseconds(100));
+            // witnessed with health_mu_ still held: every dispatcher call
+            // completed and nothing folded — the digest and tick paths
+            // are lock-free w.r.t. the fleet-health maps
+            CHECK(pumped.load());
+            CHECK(st.digests_folded() == 0);
+        });
+        while (!held.load())
+            std::this_thread::sleep_for(std::chrono::microseconds(50));
+        for (uint64_t i = 0; i < n; ++i) {
+            CHECK(st.on_telemetry_digest(1, dg).empty());
+            st.on_tick();
+        }
+        pumped.store(true);
+        holder.join();
+        wait_folded(st, n);
+        CHECK(st.ingest_dropped() == 0); // default cap far above the burst
+    }
+
+    // bounded-queue overflow: cap 4, fold thread starved -> the flood's
+    // tail is dropped and counted; every digest that DID land still folds
+    {
+        setenv("PCCLT_DIGEST_QUEUE_CAP", "4", 1);
+        master::MasterState st;
+        proto::HelloC2M h;
+        h.p2p_port = 7;
+        auto src = net::Addr::parse("10.3.1.1", 0);
+        CHECK(src.has_value());
+        st.on_hello(1, *src, h);
+        proto::TelemetryDigestC2M dg;
+        dg.edges.push_back({"10.3.1.2:7", 5.0, 5.0, 0.0, 100, 100, 0, {}, {}});
+        const uint64_t flood = 64;
+        std::atomic<bool> held{false}, flooded{false};
+        std::thread holder([&] {
+            MutexLock lk(st.health_mutex_test_hook()); // fold thread starved
+            held.store(true);
+            for (int i = 0; i < 100000 && !flooded.load(); ++i)
+                // pcclt-verify: allow-blocking(selftest starves the fold thread on purpose; this lock is only ever held standalone)
+                std::this_thread::sleep_for(std::chrono::microseconds(100));
+            CHECK(flooded.load());
+        });
+        while (!held.load())
+            std::this_thread::sleep_for(std::chrono::microseconds(50));
+        st.on_telemetry_digest(1, dg);
+        // let the fold thread pick the first digest up and park on
+        // health_mu_ (bounded poll; harmless if it parked elsewhere)
+        for (int i = 0; i < 1000 && st.ingest_queue_depth() > 0; ++i)
+            std::this_thread::sleep_for(std::chrono::microseconds(100));
+        for (uint64_t i = 0; i < flood; ++i) st.on_telemetry_digest(1, dg);
+        CHECK(st.ingest_dropped() > 0);
+        flooded.store(true);
+        holder.join();
+        const uint64_t landed = flood + 1 - st.ingest_dropped();
+        wait_folded(st, landed);
+        CHECK(st.digests_folded() == landed);
+        unsetenv("PCCLT_DIGEST_QUEUE_CAP");
+    }
+
+    // observer control sessions (telemetry-only): welcomed without an
+    // admission round, invisible to the world, digests still fold
+    {
+        master::MasterState st;
+        proto::HelloC2M ho;
+        ho.observer = 1;
+        ho.p2p_port = 1;
+        auto a = net::Addr::parse("10.5.0.1", 0);
+        CHECK(a.has_value());
+        auto out = st.on_hello(1, *a, ho);
+        CHECK(out.size() == 1 && out[0].type == proto::kM2CWelcome);
+        CHECK(st.world_size() == 0); // never pending, never admitted
+        // the observer flag survives the wire round-trip...
+        auto rt = proto::HelloC2M::decode(ho.encode());
+        CHECK(rt.has_value() && rt->observer == 1);
+        // ...and a tail-less hello from an older client decodes observer=0
+        auto enc = ho.encode();
+        enc.pop_back();
+        auto rt0 = proto::HelloC2M::decode(enc);
+        CHECK(rt0.has_value() && rt0->observer == 0);
+        proto::TelemetryDigestC2M dg;
+        dg.edges.push_back({"10.5.0.2:7", 5.0, 5.0, 0.0, 100, 100, 0, {}, {}});
+        st.on_telemetry_digest(1, dg);
+        wait_folded(st, 1);
+        // a real peer joining alongside admits immediately: the observer
+        // holds no vote and appears in no peer list
+        proto::HelloC2M hn;
+        hn.p2p_port = 2;
+        auto b = net::Addr::parse("10.5.0.2", 0);
+        CHECK(b.has_value());
+        auto out2 = st.on_hello(2, *b, hn);
+        CHECK(st.world_size() == 1);
+        for (const auto &o : out2)
+            if (o.type == proto::kM2CP2PConnInfo) {
+                auto info = proto::P2PConnInfo::decode(o.payload);
+                CHECK(info.has_value() && info->peers.empty());
+            }
+        // observer disconnect is a fast path: no journal, no group abort
+        st.on_disconnect(1);
+        CHECK(st.world_size() == 1);
+    }
+    fprintf(stderr, "ingest offloop: ok\n");
+}
+
+// Per-trigger-class incident rate limiting (docs/09): the first
+// watchdog_confirm fires a fleet-wide black-box broadcast; a second
+// confirm of the same class inside the window is suppressed, counted
+// globally AND per class on /metrics.
+static void test_master_incident_classes() {
+    setenv("PCCLT_METRICS_MAX_AGE_MS", "0", 1);
+    setenv("PCCLT_INCIDENT_DIR", "/tmp/pcclt-selftest-incidents", 1);
+    setenv("PCCLT_INCIDENT_MIN_MS", "600000", 1); // one fire per class
+    {
+        master::MasterState st;
+        auto join = [&](uint64_t conn, const char *ip) {
+            proto::HelloC2M h;
+            h.p2p_port = 7;
+            auto a = net::Addr::parse(ip, 0);
+            CHECK(a.has_value());
+            st.on_hello(conn, *a, h);
+        };
+        join(1, "10.4.0.1");
+        join(2, "10.4.0.2");
+        join(3, "10.4.0.3");
+        auto confirm_digest = [](const char *endpoint) {
+            proto::TelemetryDigestC2M d;
+            proto::TelemetryDigestC2M::Edge e;
+            e.endpoint = endpoint;
+            e.tx_mbps = 3.0;
+            e.rx_mbps = 3.0;
+            e.stall_ratio = 0.9;
+            e.wd_state = 2; // watchdog CONFIRMED
+            d.edges.push_back(std::move(e));
+            return d;
+        };
+        // first CONFIRM: incident broadcast reaches every control session
+        st.on_telemetry_digest(1, confirm_digest("10.4.0.2:7"));
+        wait_folded(st, 1);
+        bool fired = false;
+        for (int i = 0; i < 2000 && !fired; ++i) {
+            for (const auto &o : st.on_tick())
+                if (o.type == proto::kM2CIncidentDump) fired = true;
+            if (!fired)
+                std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        }
+        CHECK(fired);
+        // second CONFIRM, same class, inside the window: suppressed
+        st.on_telemetry_digest(1, confirm_digest("10.4.0.3:7"));
+        wait_folded(st, 2);
+        bool suppressed = false;
+        for (int i = 0; i < 2000 && !suppressed; ++i) {
+            for (const auto &o : st.on_tick())
+                CHECK(o.type != proto::kM2CIncidentDump);
+            auto prom = st.render_metrics();
+            suppressed =
+                prom.find("pcclt_master_incidents_suppressed_by_class_total{"
+                          "trigger_class=\"watchdog_confirm\"} 1") !=
+                std::string::npos;
+            if (!suppressed)
+                std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        }
+        CHECK(suppressed);
+        auto prom = st.render_metrics();
+        CHECK(prom.find("pcclt_master_incidents_total 1") != std::string::npos);
+        CHECK(prom.find("pcclt_master_incidents_suppressed_total 1") !=
+              std::string::npos);
+        auto health = st.render_health_json();
+        CHECK(health.find("\"incidents_suppressed\":1") != std::string::npos);
+    }
+    unsetenv("PCCLT_INCIDENT_MIN_MS");
+    unsetenv("PCCLT_INCIDENT_DIR");
+    fprintf(stderr, "incident classes: ok\n");
+}
+
+// /health history ring (docs/09): the fold thread samples fleet gauges on
+// the PCCLT_HEALTH_HISTORY_MS cadence into a bounded ring, served only
+// under /health?history=1.
+static void test_master_health_history() {
+    setenv("PCCLT_METRICS_MAX_AGE_MS", "0", 1);
+    setenv("PCCLT_HEALTH_HISTORY_MS", "20", 1);
+    setenv("PCCLT_HEALTH_HISTORY", "5", 1);
+    {
+        master::MasterState st;
+        proto::HelloC2M h;
+        h.p2p_port = 7;
+        auto src = net::Addr::parse("10.6.0.1", 0);
+        CHECK(src.has_value());
+        st.on_hello(1, *src, h);
+        proto::TelemetryDigestC2M dg;
+        dg.edges.push_back({"10.6.0.2:7", 5.0, 5.0, 0.0, 100, 100, 0, {}, {}});
+        st.on_telemetry_digest(1, dg);
+        wait_folded(st, 1);
+        auto count_samples = [](const std::string &j) {
+            size_t n = 0;
+            for (size_t p = j.find("\"age_ms\":"); p != std::string::npos;
+                 p = j.find("\"age_ms\":", p + 1))
+                ++n;
+            return n;
+        };
+        // samples accumulate on the fold thread's own clock
+        size_t got = 0;
+        for (int i = 0; i < 4000 && got < 2; ++i) {
+            got = count_samples(st.render_health_json(true));
+            if (got < 2)
+                std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        }
+        CHECK(got >= 2);
+        // the ring is bounded: after plenty more periods, at most the cap
+        std::this_thread::sleep_for(std::chrono::milliseconds(700));
+        auto hist = st.render_health_json(true);
+        CHECK(count_samples(hist) >= 2 && count_samples(hist) <= 5);
+        CHECK(hist.find("\"digest_rate\":") != std::string::npos);
+        // plain /health never carries the ring
+        CHECK(st.render_health_json().find("\"history\"") ==
+              std::string::npos);
+    }
+    unsetenv("PCCLT_HEALTH_HISTORY_MS");
+    unsetenv("PCCLT_HEALTH_HISTORY");
+    fprintf(stderr, "health history: ok\n");
 }
 
 // Chaos schedule grammar + timing (netem.hpp, docs/05): parser accepts the
@@ -1894,6 +2184,9 @@ int main() {
     test_lock_annotations();
     test_telemetry();
     test_observability();
+    test_master_ingest_offloop();
+    test_master_incident_classes();
+    test_master_health_history();
     test_chaos_schedule();
     test_netem_striped_bucket();
     test_watchdog();

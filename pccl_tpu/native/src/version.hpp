@@ -1,0 +1,10 @@
+// Single source of truth for the library version string: the C API build
+// banner (pccltGetBuildInfo) and the /metrics // /health build_info
+// surfaces must never drift apart.
+#pragma once
+
+namespace pcclt {
+
+inline constexpr const char *kPccltVersion = "0.1.0";
+
+} // namespace pcclt

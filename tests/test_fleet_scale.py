@@ -1,0 +1,268 @@
+"""Fleet-scale observability plane (docs/09, the N=1000 gate).
+
+The master must survive a metropolis worth of telemetry: ingest lands on a
+bounded queue drained OFF the dispatcher thread, /metrics stays bounded-
+cardinality (top-K edge detail + per-peer rollups), and one scrape of the
+steady-state N=1000 surface completes inside a Prometheus scrape window.
+The flood comes from ``pccltDigestFlood`` — native observer sessions
+(PCCP/2 hello tail byte) that push digests but never join the world.
+
+Tiers here:
+  * promlint self-checks — the strict exposition-text validator must
+    catch the classes of breakage it exists for (it gates every scrape
+    in this file AND test_observability.py);
+  * moderate-N ingest/rollup/history end-to-end on a real master
+    subprocess (per-PR lane);
+  * the full N=1000 gate via run_master_scale_bench (slow lane; hard
+    thresholds mirrored in ci.yml's fleet-scale job).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+LIB = REPO / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+from conftest import alloc_ports  # noqa: E402
+
+
+def _lib_or_skip():
+    from pccl_tpu.comm import _native
+
+    lib = _native.load()
+    if not hasattr(lib, "pccltDigestFlood"):
+        pytest.skip("libpcclt.so predates the fleet-scale bench hooks")
+    return lib
+
+
+# ------------------------------------------------------------- promlint
+
+
+def test_promlint_accepts_valid_exposition():
+    from pccl_tpu.comm import promlint
+
+    text = (
+        "# HELP pcclt_up whether up\n"
+        "# TYPE pcclt_up gauge\n"
+        'pcclt_up{peer="a",group="0"} 1\n'
+        'pcclt_up{peer="b\\"x\\\\y\\n",group="0"} 0\n'
+        "# TYPE pcclt_lat_seconds histogram\n"
+        'pcclt_lat_seconds_bucket{le="0.1"} 2\n'
+        'pcclt_lat_seconds_bucket{le="+Inf"} 3\n'
+        "pcclt_lat_seconds_sum 0.5\n"
+        "pcclt_lat_seconds_count 3\n")
+    assert promlint.lint(text) == []
+
+
+@pytest.mark.parametrize("mutation,needle", [
+    # family's samples torn apart by another family's sample
+    ('pcclt_a 1\npcclt_b 2\npcclt_a{x="1"} 3\n', "reopened"),
+    # same series twice
+    ('pcclt_a{x="1"} 1\npcclt_a{x="1"} 2\n', "duplicate series"),
+    # label value never closes its quote
+    ('pcclt_a{x="oops} 1\n', "unterminated"),
+    # garbage where a float should be
+    ("pcclt_a one\n", "bad value"),
+    # histogram counts must be monotone in le
+    ("# TYPE pcclt_h histogram\n"
+     'pcclt_h_bucket{le="0.1"} 5\npcclt_h_bucket{le="1"} 3\n'
+     'pcclt_h_bucket{le="+Inf"} 5\npcclt_h_sum 1\npcclt_h_count 5\n',
+     "non-monotone"),
+    # +Inf bucket must equal _count
+    ("# TYPE pcclt_h histogram\n"
+     'pcclt_h_bucket{le="+Inf"} 4\npcclt_h_sum 1\npcclt_h_count 5\n',
+     "!= _count"),
+    # buckets with no +Inf terminal
+    ("# TYPE pcclt_h histogram\n"
+     'pcclt_h_bucket{le="1"} 4\npcclt_h_sum 1\npcclt_h_count 4\n',
+     "missing +Inf"),
+])
+def test_promlint_rejects_malformed(mutation, needle):
+    from pccl_tpu.comm import promlint
+
+    errs = promlint.lint(mutation)
+    assert any(needle in e for e in errs), (needle, errs)
+
+
+# ------------------------------------------------- moderate-N end-to-end
+
+
+class _Master:
+    def __init__(self, port: int, mport: int, env: dict | None = None):
+        e = {**os.environ, "PCCLT_METRICS_MAX_AGE_MS": "0", **(env or {})}
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pccl_tpu.comm.master",
+             "--port", str(port), "--metrics-port", str(mport)],
+            cwd=str(REPO), env=e, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT)
+        self.mport = mport
+        from pccl_tpu.comm.native_bench import _scrape_http
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                _scrape_http(mport, "/health", timeout=1)
+                return
+            except OSError:
+                if self.proc.poll() is not None:
+                    raise RuntimeError("master died on startup")
+                time.sleep(0.05)
+        raise RuntimeError("master never served /health")
+
+    def scrape(self, path: str = "/metrics") -> str:
+        from pccl_tpu.comm.native_bench import _scrape_http
+
+        text = _scrape_http(self.mport, path)
+        if path.startswith("/metrics"):
+            from pccl_tpu.comm import promlint
+
+            promlint.assert_valid(text, context=f"GET {path}")
+        return text
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait(timeout=10)
+
+
+def _flood(lib, port: int, peers: int, edges: int, hz: float, secs: float,
+           threads: int = 4) -> int:
+    sent = ctypes.c_uint64(0)
+    wall = ctypes.c_double(0.0)
+    rc = lib.pccltDigestFlood(b"127.0.0.1", port, peers, edges, hz, secs,
+                              threads, ctypes.byref(sent), ctypes.byref(wall))
+    assert rc == 0, f"pccltDigestFlood rc={rc}"
+    return sent.value
+
+
+def test_fleet_ingest_topk_and_history():
+    """80 observers x 4 edges (320 edges > the default top-K of 64): every
+    digest folds with zero queue drops, observers never enter the world,
+    /metrics stays promlint-clean with rollup families carrying the
+    overflow, TOPK=0 restores the full per-edge surface, and the /health
+    history ring keeps bounded, aging samples."""
+    import json
+
+    lib = _lib_or_skip()
+    base = alloc_ports(4)
+    m = _Master(base, base + 1, env={"PCCLT_HEALTH_HISTORY_MS": "50",
+                                     "PCCLT_HEALTH_HISTORY": "6"})
+    try:
+        peers, edges = 80, 4
+        sent = _flood(lib, base, peers, edges, hz=6.0, secs=1.5)
+        assert sent >= peers  # at least one full round landed
+
+        # drain: accepted == folded, drops == 0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            text = m.scrape()
+            folded = _prom(text, "pcclt_master_telemetry_digests_total")
+            if folded >= sent:
+                break
+            time.sleep(0.1)
+        assert folded == sent, (folded, sent)
+        assert _prom(text, "pcclt_master_digest_queue_dropped_total") == 0
+        assert _prom(text, "pcclt_master_digest_queue_capacity") > 0
+        # fold latency histogram present and coherent (promlint already
+        # proved +Inf == count)
+        assert "pcclt_master_digest_fold_seconds_bucket{" in text
+
+        # observers are telemetry-only: the world stayed empty
+        health = json.loads(m.scrape("/health"))
+        assert health["world_size"] == 0
+        assert health["telemetry_digests"] == sent
+        assert "build" in health
+
+        # bounded cardinality: 320 edges, only top-64 in detail; the rest
+        # rolled up per reporting peer, conservation across the split
+        n_detail = sum(1 for ln in text.splitlines()
+                       if ln.startswith("pcclt_edge_tx_bytes_total{"))
+        assert n_detail == 64
+        rollup = _prom_sum(text, "pcclt_peer_edges_rolled_up")
+        assert n_detail + rollup == peers * edges
+        assert _prom_sum(text, "pcclt_edge_tx_bytes_total") > 0
+        assert _prom_sum(text, "pcclt_peer_rollup_tx_bytes_total") > 0
+
+        # /health history: bounded ring of aging samples
+        time.sleep(0.4)
+        hist = json.loads(m.scrape("/health?history=1"))["history"]
+        assert 2 <= len(hist) <= 6
+        assert all("age_ms" in s and "digest_rate" in s for s in hist)
+        assert "history" not in json.loads(m.scrape("/health"))
+    finally:
+        m.kill()
+
+
+def test_fleet_topk_zero_restores_full_surface():
+    """A master spawned with PCCLT_METRICS_EDGE_TOPK=0 exposes every edge
+    as full per-edge series and emits no rollup families."""
+    lib = _lib_or_skip()
+    base = alloc_ports(4)
+    m = _Master(base, base + 1, env={"PCCLT_METRICS_EDGE_TOPK": "0"})
+    try:
+        peers, edges = 40, 4
+        sent = _flood(lib, base, peers, edges, hz=5.0, secs=1.0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            text = m.scrape()
+            if _prom(text, "pcclt_master_telemetry_digests_total") >= sent:
+                break
+            time.sleep(0.1)
+        n_detail = sum(1 for ln in text.splitlines()
+                       if ln.startswith("pcclt_edge_tx_bytes_total{"))
+        assert n_detail == peers * edges
+        assert "pcclt_peer_edges_rolled_up" not in text
+        assert "pcclt_peer_rollup_tx_bytes_total" not in text
+    finally:
+        m.kill()
+
+
+def _prom(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(None, 1)[-1])
+    raise AssertionError(f"{name} not in scrape")
+
+
+def _prom_sum(text: str, name: str) -> float:
+    return sum(float(ln.rsplit(None, 1)[-1]) for ln in text.splitlines()
+               if ln.startswith(name + "{"))
+
+
+# ------------------------------------------------------ the N=1000 gate
+
+
+@pytest.mark.slow
+def test_fleet_full_scale_gate():
+    """ISSUE-17 acceptance: 1000 observers x 8 edges at ~12 Hz. Hard
+    gates (mirrored in ci.yml's fleet-scale lane): zero ingest-queue
+    drops, >= 10k digests/s accepted, the bounded top-K scrape under 1 s,
+    promlint-clean, and journal replay of 1000 client records under 5 s."""
+    _lib_or_skip()
+    from pccl_tpu.comm.native_bench import run_master_scale_bench
+
+    r = run_master_scale_bench(peers=1000, edges=8, hz=12.0, seconds=4.0,
+                               threads=8, master_port=alloc_ports(4))
+    assert r["master_scale_digest_drops"] == 0, r
+    assert r["master_scale_ingest_rate"] >= 10_000, r
+    assert r["master_scale_scrape_s"] < 1.0, r
+    assert r["master_scale_promlint_violations"] == 0, r
+    assert r["master_scale_digests_folded"] >= r["master_scale_digests_sent"]
+    assert r["master_scale_replay_s"] < 5.0, r
+    # the dispatcher stayed responsive mid-flood: /health under 250 ms
+    assert r["master_scale_health_flood_s"] < 0.25, r
+    # the paired A/B: admission (observer hello -> welcome on the
+    # dispatcher thread) unchanged with the flood on — the enqueue-only
+    # ingest path must never put fold work on the admission critical path.
+    # Absolute bound, not a ratio: quiet-side round trips are tens of µs,
+    # so a ratio gate would amplify scheduler noise into flakes.
+    assert r["master_scale_admission_flood_p99_s"] < 0.05, r

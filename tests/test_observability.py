@@ -40,7 +40,13 @@ from conftest import alloc_ports  # noqa: E402
 def _scrape(port: int, path: str = "/metrics", timeout: float = 5.0) -> str:
     with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
                                 timeout=timeout) as r:
-        return r.read().decode()
+        text = r.read().decode()
+    if path.startswith("/metrics"):
+        # every scrape ANY test takes must be strictly valid exposition
+        # text — a real scraper rejects the whole page on one bad line
+        from pccl_tpu.comm import promlint
+        promlint.assert_valid(text, context=f"GET {path}")
+    return text
 
 
 def _prom_samples(text: str, name: str) -> dict:
