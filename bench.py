@@ -220,6 +220,17 @@ def main() -> None:
             print(f"bench: topology opt failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             extra["topology_opt_speedup"] = None
+        # the schedule synthesizer's proof (docs/12): forced tree vs ring
+        # broadcast on a hub-and-spoke wire, forced mesh vs ring all-to-all
+        # on a two-datacenter wire — same-run ring baselines, same wire
+        try:
+            for k, v in native_bench.run_schedule_bench().items():
+                extra[k] = round(v, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: schedule bench failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            extra["sched_hub_speedup"] = None
+            extra["sched_2dc_speedup"] = None
         # the observability plane's cost, pinned in history: loopback step
         # time with digest pushes + trace capture ON vs OFF (docs/09's
         # <= 1% bound; counters are always on in both legs)

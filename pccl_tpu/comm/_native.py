@@ -166,6 +166,16 @@ class CommStats(ctypes.Structure):
         # acks received back at the origin, and zombie sends retired early
         ("relay_acks", ctypes.c_uint64),
         ("relay_retired_early", ctypes.c_uint64),
+        # collective schedule synthesizer (docs/12): ops per stamped
+        # algorithm, program steps run, and PLANNED kRelayRing relay bytes
+        # (kept apart from the watchdog's emergency wd_relays)
+        ("sched_ops_ring", ctypes.c_uint64),
+        ("sched_ops_tree", ctypes.c_uint64),
+        ("sched_ops_butterfly", ctypes.c_uint64),
+        ("sched_ops_mesh", ctypes.c_uint64),
+        ("sched_ops_relay", ctypes.c_uint64),
+        ("sched_steps", ctypes.c_uint64),
+        ("sched_relay_planned_bytes", ctypes.c_uint64),
     ]
 
 
@@ -294,6 +304,25 @@ def _declare(lib):
                                    c.c_uint64, P(ReduceInfo)]
     lib.pccltGatherSlot.restype = c.c_int
     lib.pccltGatherSlot.argtypes = [c.c_void_p, P(c.c_uint64)]
+
+    # widened collective vocabulary (docs/12); tolerate older builds so
+    # PCCLT_LIB can still point at a pre-schedule library
+    try:
+        lib.pccltReduceScatter.restype = c.c_int
+        lib.pccltReduceScatter.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_uint64, c.c_uint64,
+            c.c_int, P(ReduceDescriptor), P(c.c_uint64), P(c.c_uint64),
+            P(ReduceInfo)]
+        lib.pccltBroadcast.restype = c.c_int
+        lib.pccltBroadcast.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64,
+                                       c.c_uint64, c.c_int,
+                                       P(ReduceDescriptor), P(ReduceInfo)]
+        lib.pccltAllToAll.restype = c.c_int
+        lib.pccltAllToAll.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                      c.c_uint64, c.c_uint64, c.c_int,
+                                      P(ReduceDescriptor), P(ReduceInfo)]
+    except AttributeError:
+        pass
 
     lib.pccltShmAlloc.restype = c.c_int
     lib.pccltShmAlloc.argtypes = [c.c_uint64, P(c.c_void_p)]

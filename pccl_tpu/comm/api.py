@@ -893,6 +893,129 @@ class Communicator:
                "gather_slot")
         return int(slot.value)
 
+    def reduce_scatter(self, send, recv=None, *, tag: int = 0,
+                       quantization: QuantizationAlgorithm =
+                       QuantizationAlgorithm.NONE,
+                       quantized_dtype: DataType = DataType.UINT8) -> tuple:
+        """Ring reduce-scatter (docs/12): the group SUM of `send` is computed
+        and each peer keeps only its own contiguous chunk of the result.
+        Returns (chunk, offset, ReduceInfo): `chunk` is a view of recv
+        holding this peer's reduced elements and `offset` is its element
+        offset within the full count — recv[i] == sum_of_send[offset + i].
+        Chunk ownership follows ring rank, so the (offset, count) pair can
+        change across churn; always use the returned values. The fold is
+        SUM (quantization fields still apply to the wire format). recv=None
+        allocates ceil(count/world) elements; a caller-provided recv must
+        be writable, C-contiguous, send's dtype, capacity >=
+        ceil(count/world) — re-checked natively against the commence-time
+        world so mid-call churn aborts instead of overflowing."""
+        send = np.ascontiguousarray(send)
+        if not hasattr(self._lib, "pccltReduceScatter"):
+            raise PcclError(Result.INVALID_USAGE,
+                            "this libpcclt.so predates the schedule "
+                            "synthesizer (pccltReduceScatter); rebuild")
+        world = self.world_size
+        if recv is None:
+            cap = (send.size + max(world, 1) - 1) // max(world, 1)
+            recv = np.empty(max(cap, 1), dtype=send.dtype)
+        if recv.dtype != send.dtype:
+            raise ValueError(f"recv dtype {recv.dtype} != send {send.dtype}")
+        if not recv.flags["C_CONTIGUOUS"] or not recv.flags["WRITEABLE"]:
+            raise ValueError("recv must be writable and C-contiguous")
+        if world <= 1:
+            # solo: the SUM over one peer is the peer's own buffer
+            if recv.size < send.size:
+                raise ValueError(f"recv capacity {recv.size} < {send.size}")
+            np.copyto(recv.reshape(-1)[:send.size],
+                      send.reshape(-1))
+            return recv.reshape(-1)[:send.size], 0, ReduceInfo(0, 0, 1)
+        desc = ReduceDescriptor(tag, ReduceOp.SUM, quantization,
+                                quantized_dtype)._as_c()
+        info = _native.ReduceInfo()
+        off = ctypes.c_uint64()
+        cnt = ctypes.c_uint64()
+        code = self._lib.pccltReduceScatter(
+            self._h, send.ctypes.data_as(ctypes.c_void_p),
+            recv.ctypes.data_as(ctypes.c_void_p), send.size, recv.size,
+            int(_np_dtype_of(send)), ctypes.byref(desc), ctypes.byref(off),
+            ctypes.byref(cnt), ctypes.byref(info))
+        _check(code, "reduce_scatter")
+        return (recv.reshape(-1)[:int(cnt.value)], int(off.value),
+                ReduceInfo(info.tx_bytes, info.rx_bytes, info.world_size))
+
+    def broadcast(self, buf, *, root: int, tag: int = 0,
+                  quantization: QuantizationAlgorithm =
+                  QuantizationAlgorithm.NONE,
+                  quantized_dtype: DataType = DataType.UINT8) -> ReduceInfo:
+        """In-place broadcast from the peer at sorted-uuid slot `root` (its
+        `gather_slot`; every peer must pass the SAME root — a mismatch is a
+        parameter disagreement and gets the minority kicked). On return buf
+        holds the root's bytes bit-identically on every peer. The schedule
+        synthesizer may run this over a bandwidth-weighted tree instead of
+        the ring (docs/12); the result is identical either way."""
+        if not isinstance(buf, np.ndarray) or not buf.flags["C_CONTIGUOUS"] \
+                or not buf.flags["WRITEABLE"]:
+            raise ValueError("broadcast buffer must be a writable "
+                             "C-contiguous ndarray (updated in place)")
+        if not hasattr(self._lib, "pccltBroadcast"):
+            raise PcclError(Result.INVALID_USAGE,
+                            "this libpcclt.so predates the schedule "
+                            "synthesizer (pccltBroadcast); rebuild")
+        if self.world_size <= 1:
+            return ReduceInfo(0, 0, 1)
+        desc = ReduceDescriptor(tag, ReduceOp.SUM, quantization,
+                                quantized_dtype)._as_c()
+        info = _native.ReduceInfo()
+        code = self._lib.pccltBroadcast(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p), buf.size,
+            int(root), int(_np_dtype_of(buf)), ctypes.byref(desc),
+            ctypes.byref(info))
+        _check(code, "broadcast")
+        return ReduceInfo(info.tx_bytes, info.rx_bytes, info.world_size)
+
+    def all_to_all(self, send, recv=None, *, tag: int = 0,
+                   quantization: QuantizationAlgorithm =
+                   QuantizationAlgorithm.NONE,
+                   quantized_dtype: DataType = DataType.UINT8) -> tuple:
+        """All-to-all personalized exchange (docs/12): `send` is world_size
+        equal blocks in sorted-uuid slot order; block j lands as block
+        `my_slot` at the peer holding slot j, and recv block i is the block
+        peer i addressed to us. send.size must be divisible by world_size.
+        recv=None allocates send's shape; a caller-provided recv must be
+        writable, C-contiguous, send's dtype, capacity >= send.size
+        (re-checked natively against the commence-time world). Returns
+        (recv, ReduceInfo)."""
+        send = np.ascontiguousarray(send)
+        if not hasattr(self._lib, "pccltAllToAll"):
+            raise PcclError(Result.INVALID_USAGE,
+                            "this libpcclt.so predates the schedule "
+                            "synthesizer (pccltAllToAll); rebuild")
+        world = self.world_size
+        if recv is None:
+            recv = np.empty(send.shape, dtype=send.dtype)
+        if recv.dtype != send.dtype:
+            raise ValueError(f"recv dtype {recv.dtype} != send {send.dtype}")
+        if not recv.flags["C_CONTIGUOUS"] or not recv.flags["WRITEABLE"]:
+            raise ValueError("recv must be writable and C-contiguous")
+        if recv.size < send.size:
+            raise ValueError(f"recv capacity {recv.size} < send {send.size}")
+        if world <= 1:
+            np.copyto(recv.reshape(-1)[:send.size], send.reshape(-1))
+            return recv, ReduceInfo(0, 0, 1)
+        if send.size % world:
+            raise ValueError(f"send size {send.size} not divisible by "
+                             f"world {world}")
+        desc = ReduceDescriptor(tag, ReduceOp.SUM, quantization,
+                                quantized_dtype)._as_c()
+        info = _native.ReduceInfo()
+        code = self._lib.pccltAllToAll(
+            self._h, send.ctypes.data_as(ctypes.c_void_p),
+            recv.ctypes.data_as(ctypes.c_void_p), send.size // world,
+            recv.size, int(_np_dtype_of(send)), ctypes.byref(desc),
+            ctypes.byref(info))
+        _check(code, "all_to_all")
+        return recv, ReduceInfo(info.tx_bytes, info.rx_bytes, info.world_size)
+
     def all_reduce_async(self, send, recv=None, *, op: ReduceOp = ReduceOp.SUM,
                          tag: Optional[int] = None,
                          quantization: QuantizationAlgorithm = QuantizationAlgorithm.NONE,

@@ -1991,3 +1991,155 @@ def run_master_scale_bench(peers: int = 1000, edges: int = 8,
         out["master_scale_replay_write_s"] = w_s.value
         out["master_scale_replay_s"] = r_s.value
     return out
+
+
+# ------------------------------------------------- schedule synthesizer
+
+def _peer_sched_bcast(rank, master_port, q, world, nbytes, iters, port_base,
+                      envs, gate_dir):
+    """Broadcast peer for the schedule bench: rank 0 publishes its
+    sorted-uuid gather slot through a file gate so every peer names the
+    SAME root (slot order is join-order-racy; a root mismatch is a
+    parameter disagreement and gets the minority kicked)."""
+    os.environ.update(envs[rank])  # this rank's per-edge wire model
+    comm = _connect(rank, master_port, world, port_base)
+    # measure the emulated edges so the synthesizer's tree hangs off the
+    # hub (the forced algo fixes the KIND; the shape comes from the matrix)
+    comm.optimize_topology()
+    root_path = os.path.join(gate_dir, "root_slot")
+    if rank == 0:
+        with open(root_path + ".tmp", "w") as f:
+            f.write(str(comm.gather_slot))
+        os.replace(root_path + ".tmp", root_path)
+    deadline = time.time() + 120
+    while not os.path.exists(root_path):
+        if time.time() > deadline:
+            raise TimeoutError(f"rank {rank}: root slot never published")
+        time.sleep(0.02)
+    with open(root_path) as f:
+        root = int(f.read())
+
+    count = nbytes // 4
+    ref = (np.arange(count, dtype=np.float32) % 509.0) + 1.0
+    buf = ref.copy() if comm.gather_slot == root \
+        else np.full(count, -7.0, dtype=np.float32)
+    comm.broadcast(buf, root=root, tag=31)  # warmup (+ correctness)
+    if not np.array_equal(buf, ref):
+        raise AssertionError(f"rank {rank}: broadcast payload mismatch")
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        comm.broadcast(buf, root=root, tag=31)
+        times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "t": sorted(times)[len(times) // 2]})
+    comm.destroy()
+
+
+def _peer_sched_a2a(rank, master_port, q, world, nbytes, iters, port_base,
+                    envs):
+    """All-to-all peer for the schedule bench: slot-seeded blocks so one
+    verification pass proves delivery, then a timed loop."""
+    os.environ.update(envs[rank])
+    comm = _connect(rank, master_port, world, port_base)
+    comm.optimize_topology()  # measured matrix -> site-aware schedules
+    slot = comm.gather_slot
+    per = nbytes // 4 // world
+    send = np.concatenate(
+        [np.full(per, slot * 100.0 + j + 0.25, dtype=np.float32)
+         for j in range(world)])
+    recv, _ = comm.all_to_all(send, tag=32)  # warmup (+ correctness)
+    for i in range(world):
+        if not np.array_equal(recv[i * per:(i + 1) * per],
+                              np.full(per, i * 100.0 + slot + 0.25,
+                                      dtype=np.float32)):
+            raise AssertionError(f"rank {rank}: a2a block {i} mismatch")
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        comm.all_to_all(send, recv, tag=32)
+        times.append(time.perf_counter() - t0)
+    q.put({"rank": rank, "t": sorted(times)[len(times) // 2]})
+    comm.destroy()
+
+
+def run_schedule_bench(world: int = 4, nbytes: int = 4 << 20, iters: int = 3,
+                       hub_mbps: float = 200.0, spoke_mbps: float = 20.0,
+                       intra_mbps: float = 400.0,
+                       inter_mbps: float = 40.0) -> Dict[str, float]:
+    """End-to-end proof that the collective schedule synthesizer (docs/12)
+    beats the one-ring-for-everything baseline on the two wire shapes it
+    was built for, with same-run ring baselines:
+
+    - hub-and-spoke: every spoke<->spoke edge at ``spoke_mbps``, hub edges
+      at ``hub_mbps``. Any Hamiltonian ring crosses slow spoke edges, so a
+      ring broadcast is gated at ``spoke_mbps``; the bandwidth-weighted
+      tree fans out from the hub root on fast edges
+      (``sched_hub_speedup`` = ring / tree median step time).
+    - two-datacenter: ranks split into two sites, ``intra_mbps`` inside,
+      ``inter_mbps`` across. The ring all-to-all's rotation makes the
+      block at distance r ride r sequential hops (multiply crossing the
+      cut); the mesh sends every block once, directly
+      (``sched_2dc_speedup`` = ring / mesh, plus the mesh's algorithmic
+      ``alltoall_busbw_gbps`` = (N-1)/N * bytes / t).
+
+    PCCLT_SCHEDULE_FORCE pins each leg's algorithm (master-side; the
+    master lives in this process), so the deltas isolate the schedule —
+    same wire, same peers, same payload."""
+    import tempfile
+
+    hub = [[None if i == j else (hub_mbps if 0 in (i, j) else spoke_mbps)
+            for j in range(world)] for i in range(world)]
+    half = world // 2
+    twodc = [[None if i == j else
+              (intra_mbps if (i < half) == (j < half) else inter_mbps)
+              for j in range(world)] for i in range(world)]
+
+    old_env = {k: os.environ.get(k) for k in
+               ("PCCLT_SCHEDULE", "PCCLT_SCHEDULE_FORCE",
+                "PCCLT_BENCH_SECONDS", "PCCLT_BENCH_CONNECTIONS")}
+    os.environ["PCCLT_SCHEDULE"] = "1"
+    os.environ["PCCLT_BENCH_SECONDS"] = "0.4"
+    os.environ["PCCLT_BENCH_CONNECTIONS"] = "2"
+
+    def bcast_leg(force, mport_env, mport, base):
+        os.environ["PCCLT_SCHEDULE_FORCE"] = force
+        with wire_topology(world, base, mbps=hub) as envs, \
+                tempfile.TemporaryDirectory() as gate_dir:
+            res = _spawn_world(world, _peer_sched_bcast,
+                               _port(mport_env, mport),
+                               (world, nbytes, iters, base, envs, gate_dir),
+                               inline_rank0=False, timeout_s=600)
+        return max(r["t"] for r in res)  # collective ends with slowest rank
+
+    def a2a_leg(force, mport_env, mport, base):
+        os.environ["PCCLT_SCHEDULE_FORCE"] = force
+        with wire_topology(world, base, mbps=twodc) as envs:
+            res = _spawn_world(world, _peer_sched_a2a,
+                               _port(mport_env, mport),
+                               (world, nbytes, iters, base, envs),
+                               inline_rank0=False, timeout_s=600)
+        return max(r["t"] for r in res)
+
+    try:
+        t_tree = bcast_leg("tree", "PCCLT_BENCH_MASTER_PORT_SCHED", 48741,
+                           34200)
+        t_bring = bcast_leg("ring", "PCCLT_BENCH_MASTER_PORT_SCHED2", 48743,
+                            34600)
+        t_mesh = a2a_leg("mesh", "PCCLT_BENCH_MASTER_PORT_SCHED3", 48745,
+                         35000)
+        t_aring = a2a_leg("ring", "PCCLT_BENCH_MASTER_PORT_SCHED4", 48747,
+                          35400)
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {"sched_hub_tree_step_s": t_tree,
+            "sched_hub_ring_step_s": t_bring,
+            "sched_hub_speedup": t_bring / t_tree,
+            "sched_2dc_mesh_step_s": t_mesh,
+            "sched_2dc_ring_step_s": t_aring,
+            "sched_2dc_speedup": t_aring / t_mesh,
+            "alltoall_busbw_gbps":
+                (world - 1) / world * nbytes / t_mesh / 1e9}

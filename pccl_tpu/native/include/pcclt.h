@@ -281,6 +281,48 @@ PCCLT_EXPORT pccltResult_t pccltAllGather(pccltComm_t *c, const void *sendbuf,
  * re-query after churn. */
 PCCLT_EXPORT pccltResult_t pccltGatherSlot(pccltComm_t *c, uint64_t *slot);
 
+/* --- widened collective vocabulary (docs/12) ---
+ * All three share pccltAllReduce's consensus/tag/abort/quantization
+ * semantics and ride the synthesized schedule the master stamps on the
+ * commence (PCCLT_SCHEDULE / PCCLT_SCHEDULE_FORCE, docs/03). */
+
+/* Reduce-scatter: the reduce-scatter half of the ring without the
+ * all-gather. recvbuf (capacity recv_capacity elements, >= ceil(count /
+ * world)) receives this rank's fully-reduced chunk of the count-element
+ * global vector; *recv_offset / *recv_count (elements, optional NULL)
+ * report which chunk. Chunk ownership follows ring position, which the
+ * topology optimizer reshuffles — outputs, not inputs. The fold is SUM
+ * (desc->op selects quantization fields only; see docs/12). */
+PCCLT_EXPORT pccltResult_t pccltReduceScatter(pccltComm_t *c, const void *sendbuf,
+                                              void *recvbuf, uint64_t count,
+                                              uint64_t recv_capacity,
+                                              pccltDataType_t dtype,
+                                              const pccltReduceDescriptor_t *desc,
+                                              uint64_t *recv_offset,
+                                              uint64_t *recv_count,
+                                              pccltReduceInfo_t *info);
+
+/* Broadcast: `buf` (count elements) is broadcast IN PLACE from the peer
+ * whose gather slot (sorted-uuid order, pccltGatherSlot) equals
+ * root_slot. Every member must pass the same root_slot (matched-
+ * parameters contract; mismatches kick). Quantized broadcasts end
+ * bit-identical on every rank INCLUDING the root. */
+PCCLT_EXPORT pccltResult_t pccltBroadcast(pccltComm_t *c, void *buf,
+                                          uint64_t count, uint64_t root_slot,
+                                          pccltDataType_t dtype,
+                                          const pccltReduceDescriptor_t *desc,
+                                          pccltReduceInfo_t *info);
+
+/* All-to-all: block j of sendbuf (count_per_peer elements, gather-slot
+ * order) lands at the sender's slot-indexed block of peer j's recvbuf
+ * (capacity recv_capacity >= world * count_per_peer elements). */
+PCCLT_EXPORT pccltResult_t pccltAllToAll(pccltComm_t *c, const void *sendbuf,
+                                         void *recvbuf, uint64_t count_per_peer,
+                                         uint64_t recv_capacity,
+                                         pccltDataType_t dtype,
+                                         const pccltReduceDescriptor_t *desc,
+                                         pccltReduceInfo_t *info);
+
 PCCLT_EXPORT pccltResult_t pccltSynchronizeSharedState(pccltComm_t *c,
                                                        pccltSharedState_t *state,
                                                        pccltSyncStrategy_t strategy,
@@ -393,6 +435,17 @@ typedef struct pccltCommStats_t {
      * zombie sends retired early because an ack covered their span */
     uint64_t relay_acks;
     uint64_t relay_retired_early;
+    /* collective schedule synthesizer (docs/12): ops executed per stamped
+     * algorithm, synthesized-program steps run, and PLANNED relay bytes —
+     * scheduled kRelayRing detours, kept apart from the watchdog's
+     * emergency wd_relays accounting */
+    uint64_t sched_ops_ring;
+    uint64_t sched_ops_tree;
+    uint64_t sched_ops_butterfly;
+    uint64_t sched_ops_mesh;
+    uint64_t sched_ops_relay;
+    uint64_t sched_steps;
+    uint64_t sched_relay_planned_bytes;
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {

@@ -272,6 +272,61 @@ pccltResult_t pccltGatherSlot(pccltComm_t *c, uint64_t *slot) {
     return to_result(c->client->gather_slot(slot));
 }
 
+// ---- widened collective vocabulary (docs/12). The kind markers (RedOp 6..8)
+// are selected HERE, never via the reduce descriptor — their buffer sizing
+// differs, so each export carries what the worker's capacity check needs.
+
+pccltResult_t pccltReduceScatter(pccltComm_t *c, const void *sendbuf,
+                                 void *recvbuf, uint64_t count,
+                                 uint64_t recv_capacity, pccltDataType_t dtype,
+                                 const pccltReduceDescriptor_t *desc,
+                                 uint64_t *recv_offset, uint64_t *recv_count,
+                                 pccltReduceInfo_t *info) {
+    if (!c || !desc || !valid_reduce_op(desc)) return pccltInvalidArgument;
+    pcclt::client::ReduceDesc d = to_desc(desc);
+    d.op = pcclt::proto::RedOp::kReduceScatter;
+    d.recv_capacity = recv_capacity;
+    pcclt::client::ReduceInfo ri;
+    auto st = c->client->all_reduce(sendbuf, recvbuf, count, to_dtype(dtype),
+                                    d, &ri);
+    if (recv_offset) *recv_offset = ri.rs_offset;
+    if (recv_count) *recv_count = ri.rs_count;
+    fill_info(info, ri);
+    return to_result(st);
+}
+
+pccltResult_t pccltBroadcast(pccltComm_t *c, void *buf, uint64_t count,
+                             uint64_t root_slot, pccltDataType_t dtype,
+                             const pccltReduceDescriptor_t *desc,
+                             pccltReduceInfo_t *info) {
+    if (!c || !desc) return pccltInvalidArgument;
+    pcclt::client::ReduceDesc d = to_desc(desc);
+    d.op = pcclt::proto::RedOp::kBroadcast;
+    d.aux = root_slot;  // matched-parameters contract: mismatches kick
+    pcclt::client::ReduceInfo ri;
+    // in place: send == recv arms the worker's snapshot, the abort-retry
+    // restore source for root and non-root alike
+    auto st = c->client->all_reduce(buf, buf, count, to_dtype(dtype), d, &ri);
+    fill_info(info, ri);
+    return to_result(st);
+}
+
+pccltResult_t pccltAllToAll(pccltComm_t *c, const void *sendbuf, void *recvbuf,
+                            uint64_t count_per_peer, uint64_t recv_capacity,
+                            pccltDataType_t dtype,
+                            const pccltReduceDescriptor_t *desc,
+                            pccltReduceInfo_t *info) {
+    if (!c || !desc) return pccltInvalidArgument;
+    pcclt::client::ReduceDesc d = to_desc(desc);
+    d.op = pcclt::proto::RedOp::kAllToAll;
+    d.recv_capacity = recv_capacity;
+    pcclt::client::ReduceInfo ri;
+    auto st = c->client->all_reduce(sendbuf, recvbuf, count_per_peer,
+                                    to_dtype(dtype), d, &ri);
+    fill_info(info, ri);
+    return to_result(st);
+}
+
 pccltResult_t pccltAllReduceAsync(pccltComm_t *c, const void *sendbuf, void *recvbuf,
                                   uint64_t count, pccltDataType_t dtype,
                                   const pccltReduceDescriptor_t *desc) {
@@ -437,6 +492,13 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     out->ss_legacy_syncs = ld(m.ss_legacy_syncs);
     out->relay_acks = ld(m.relay_acks);
     out->relay_retired_early = ld(m.relay_retired_early);
+    out->sched_ops_ring = ld(m.sched_ops_ring);
+    out->sched_ops_tree = ld(m.sched_ops_tree);
+    out->sched_ops_butterfly = ld(m.sched_ops_butterfly);
+    out->sched_ops_mesh = ld(m.sched_ops_mesh);
+    out->sched_ops_relay = ld(m.sched_ops_relay);
+    out->sched_steps = ld(m.sched_steps);
+    out->sched_relay_planned_bytes = ld(m.sched_relay_planned_bytes);
     return pccltSuccess;
 }
 

@@ -421,6 +421,21 @@ Status Client::connect() {
     master_.set_notify(
         static_cast<uint16_t>(PacketType::kM2CIncidentDump),
         [this](net::Frame &&f) { on_incident_dump(std::move(f)); });
+    // schedule plane (docs/12): fire-and-forget table broadcasts after an
+    // optimize round. Adopted for introspection/telemetry only — the
+    // per-op algorithm binding is the commence stamp, so a late or lost
+    // update can never split the group.
+    master_.set_notify(
+        static_cast<uint16_t>(PacketType::kM2CScheduleUpdate),
+        [this](net::Frame &&f) {
+            if (auto su = proto::ScheduleUpdateM2C::decode(f.payload)) {
+                if (auto t = sched::Table::decode(su->table)) {
+                    MutexLock lk(state_mu_);
+                    if (t->version >= sched_table_.version)
+                        sched_table_ = std::move(*t);
+                }
+            }
+        });
     master_.run();
 
     proto::HelloC2M h;
@@ -949,6 +964,12 @@ void Client::adopt(const proto::P2PConnInfo &info, const std::vector<proto::Uuid
                 ++left;
         ring_ = ring;
         topo_revision_ = info.revision;
+        // trailing schedule table rides the conn info (docs/12): a
+        // rejoining peer adopts ring order and schedule in one step
+        if (!info.sched.empty())
+            if (auto t = sched::Table::decode(info.sched))
+                if (t->version >= sched_table_.version)
+                    sched_table_ = std::move(*t);
         // Sweep stale watchdog verdicts (docs/05): the in-op re-probe only
         // runs while an edge is the CURRENT ring successor, so a verdict on
         // an edge the re-opt routed AWAY from would otherwise latch forever
@@ -1592,6 +1613,7 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
     ci.quant_dtype = desc.quant_dtype;
     ci.retry = is_retry ? 1 : 0;
     ci.retry_seq = retry_seq;
+    ci.aux = desc.aux;
     if (!master_.send(PacketType::kC2MCollectiveInit, ci.encode()))
         return classify_master_loss();
 
@@ -1703,10 +1725,20 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
         return Status::kConnectionLost;
     }
     uint64_t seq;
+    // commence stamp (docs/12): the master binds ONE algorithm + root per
+    // op. Trailing fields — a pre-schedule master's commence simply stops
+    // after seq and the op runs ring (the executors' shared default).
+    sched::Algo sched_algo = sched::Algo::kRing;
+    uint32_t sched_root = 0;
     try {
         wire::Reader r(commence->payload);
         r.u64();
         seq = r.u64();
+        if (r.remaining() >= 13) {
+            sched_algo = static_cast<sched::Algo>(r.u8());
+            sched_root = r.u32();
+            r.u64();  // table version the stamp was drawn from (telemetry)
+        }
     } catch (...) {
         commence_span(0);
         drop_prearm();
@@ -1879,6 +1911,73 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
                 };
             }
         }
+        // ---- synthesized schedule bindings (docs/12) ----
+        ctx.sched_algo = sched_algo;
+        ctx.sched_root = sched_root;
+        {
+            // per-ring-index link/counter resolvers: tree/butterfly/mesh
+            // step programs address peers that are not ring neighbors
+            auto ring_sp = std::make_shared<std::vector<proto::Uuid>>(ring);
+            ctx.link_to = [this, ring_sp](uint32_t r) -> net::Link {
+                return r < ring_sp->size() ? tx_link((*ring_sp)[r])
+                                           : net::Link{};
+            };
+            ctx.link_from = [this, ring_sp](uint32_t r,
+                                            int timeout_ms) -> net::Link {
+                return r < ring_sp->size() ? rx_link((*ring_sp)[r], timeout_ms)
+                                           : net::Link{};
+            };
+            ctx.edge_of = [this,
+                           ring_sp](uint32_t r) -> telemetry::EdgeCounters * {
+                if (r >= ring_sp->size()) return nullptr;
+                MutexLock lk(state_mu_);
+                auto it = peers_.find((*ring_sp)[r]);
+                if (it == peers_.end()) return nullptr;
+                net::Addr pa = it->second.ep.ip;
+                pa.port = it->second.ep.p2p_port;
+                return &tele_->edge(pa.str());
+            };
+        }
+        if (sched_algo == sched::Algo::kRelayRing && rank == sched_root &&
+            world >= 3) {
+            // planned relay: the stamp routes THIS rank's outbound hop
+            // through the relay plane for the whole op. Bind the relay
+            // lambdas even with the watchdog env off — planned and
+            // emergency detours share the machinery, only the accounting
+            // differs (sched_relay_planned_bytes vs wd_relays).
+            ctx.planned_relay = true;
+            if (!ctx.relay_window) {
+                proto::Uuid succ = next;
+                ctx.relay_window = [this, succ](uint64_t tag, uint64_t off,
+                                                std::span<const uint8_t> p) {
+                    return relay_window_via(succ, tag, off, p);
+                };
+                ctx.relay_acked = [this](uint64_t tag, uint64_t off,
+                                         size_t len) {
+                    return relay_ack_covered(tag, off, len);
+                };
+            }
+        }
+        // per-schedule-kind op counters (stats() / /metrics satellite)
+        switch (sched_algo) {
+        case sched::Algo::kTree:
+            tele_->comm.sched_ops_tree.fetch_add(1, std::memory_order_relaxed);
+            break;
+        case sched::Algo::kButterfly:
+            tele_->comm.sched_ops_butterfly.fetch_add(1,
+                                                      std::memory_order_relaxed);
+            break;
+        case sched::Algo::kMesh:
+            tele_->comm.sched_ops_mesh.fetch_add(1, std::memory_order_relaxed);
+            break;
+        case sched::Algo::kRelayRing:
+            tele_->comm.sched_ops_relay.fetch_add(1, std::memory_order_relaxed);
+            break;
+        case sched::Algo::kRing:
+        default:
+            tele_->comm.sched_ops_ring.fetch_add(1, std::memory_order_relaxed);
+            break;
+        }
         auto scratch = take_scratch();
         ctx.scratch = &scratch;
         ctx.should_abort = [&]() -> bool {
@@ -1887,8 +1986,28 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
             return false;
         };
         reduce::Result res;
-        if (desc.op == proto::RedOp::kGather &&
-            static_cast<uint64_t>(world) * count > desc.recv_capacity) {
+        // segment order for slotted collectives is by SORTED peer uuid
+        // (ring positions reshuffle across topology rounds and would leak
+        // that instability into the user-visible layout)
+        auto fill_slots = [&] {
+            std::vector<proto::Uuid> sorted = ring;
+            std::sort(sorted.begin(), sorted.end());
+            ctx.slots.resize(world);
+            for (uint32_t i = 0; i < world; ++i)
+                ctx.slots[i] = static_cast<uint32_t>(
+                    std::find(sorted.begin(), sorted.end(), ring[i]) -
+                    sorted.begin());
+        };
+        // recv elements the op will actually write: gather and all-to-all
+        // scale with the commence-time world, reduce-scatter with the
+        // chunk partition ceiling
+        uint64_t recv_need = 0;
+        if (desc.op == proto::RedOp::kGather ||
+            desc.op == proto::RedOp::kAllToAll)
+            recv_need = static_cast<uint64_t>(world) * count;
+        else if (desc.op == proto::RedOp::kReduceScatter)
+            recv_need = (count + world - 1) / world;
+        if (recv_need > desc.recv_capacity) {
             // membership grew between the caller sizing recv and commence:
             // fail OUR leg through the normal complete/abort protocol (a
             // silent overflow or a unilateral bail would wedge the group).
@@ -1898,17 +2017,23 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
             rx.table().purge_range(base_tag, base_tag + 0x10000);
             res = reduce::Result::kAborted;
         } else if (desc.op == proto::RedOp::kGather) {
-            // all-gather: segment order is by SORTED peer uuid (ring
-            // positions reshuffle across topology rounds and would leak
-            // that instability into the user-visible layout)
-            std::vector<proto::Uuid> sorted = ring;
-            std::sort(sorted.begin(), sorted.end());
-            ctx.slots.resize(world);
-            for (uint32_t i = 0; i < world; ++i)
-                ctx.slots[i] = static_cast<uint32_t>(
-                    std::find(sorted.begin(), sorted.end(), ring[i]) -
-                    sorted.begin());
+            fill_slots();
             res = reduce::ring_allgather(ctx, send, recv, count);
+        } else if (desc.op == proto::RedOp::kReduceScatter) {
+            res = reduce::ring_reduce_scatter(ctx, send, recv, count,
+                                              &op->info.rs_offset,
+                                              &op->info.rs_count);
+        } else if (desc.op == proto::RedOp::kBroadcast) {
+            // in place in recv; ctx.sched_root is the ring-index root the
+            // master converted from the slot-space aux stamp
+            res = reduce::run_broadcast(ctx, recv, count);
+        } else if (desc.op == proto::RedOp::kAllToAll) {
+            fill_slots();
+            res = reduce::run_all_to_all(ctx, send, recv, count);
+        } else if (sched_algo == sched::Algo::kButterfly) {
+            // stamped small-payload schedule; falls back to the ring
+            // internally when the commence world is not a power of two
+            res = reduce::butterfly_allreduce(ctx, send, recv, count);
         } else {
             res = reduce::ring_allreduce(ctx, send, recv, count);
         }
@@ -1950,9 +2075,14 @@ Status Client::run_reduce_worker_impl(const void *send, void *recv, uint64_t cou
 
     if (st == Status::kOk && verdict_aborted) {
         // we finished the ring, but the op was aborted group-wide: restore
-        // the input so every rank retries from identical buffers (gather
-        // never reduces in place — a retry simply rewrites every segment)
-        if (desc.op != proto::RedOp::kGather)
+        // the input so every rank retries from identical buffers. Gather,
+        // reduce-scatter and all-to-all never reduce into a full-vector
+        // recv (their recv is segment-sized or freshly rewritten per
+        // retry), so only full-vector ops restore — a blanket memcpy of
+        // nbytes would overrun a chunk-sized reduce-scatter recv.
+        if (desc.op != proto::RedOp::kGather &&
+            desc.op != proto::RedOp::kReduceScatter &&
+            desc.op != proto::RedOp::kAllToAll)
             memcpy(recv, snapshot.empty() ? send : snapshot.data(), nbytes);
         st = Status::kAborted;
     }

@@ -24,6 +24,7 @@
 #include "hash.hpp"
 #include "pool.hpp"
 #include "protocol.hpp"
+#include "schedule.hpp"
 #include "sockets.hpp"
 #include "ss_chunk.hpp"
 #include "telemetry.hpp"
@@ -69,17 +70,25 @@ struct ReduceDesc {
     proto::RedOp op = proto::RedOp::kSum;
     proto::QuantAlgo quant = proto::QuantAlgo::kNone;
     proto::DType quant_dtype = proto::DType::kU8;
-    // gather only (client-side, not on the wire): recv capacity in
-    // ELEMENTS. The commence-time world can exceed the world the caller
-    // sized recv for (a pending joiner admitted in between); the worker
-    // fails the op through the normal abort protocol instead of writing
-    // world*count elements past the buffer.
+    // gather/reduce-scatter/all-to-all (client-side, not on the wire):
+    // recv capacity in ELEMENTS. The commence-time world can exceed the
+    // world the caller sized recv for (a pending joiner admitted in
+    // between); the worker fails the op through the normal abort protocol
+    // instead of writing world*count elements past the buffer.
     uint64_t recv_capacity = ~0ull;
+    // collective-specific argument forwarded as CollectiveInit::aux:
+    // broadcast root SLOT (sorted-uuid order). Matched-parameters
+    // contract — members disagreeing on aux are kicked (docs/12).
+    uint64_t aux = 0;
 };
 
 struct ReduceInfo {
     uint64_t tx_bytes = 0, rx_bytes = 0;
     uint32_t world = 0;
+    // reduce-scatter only: which chunk of the global vector landed in recv
+    // (elements). Chunk ownership follows ring position, which the
+    // topology optimizer reshuffles — outputs, not inputs (docs/12).
+    uint64_t rs_offset = 0, rs_count = 0;
 };
 
 struct SharedStateEntry {
@@ -352,6 +361,10 @@ private:
     std::map<proto::Uuid, PeerConns> peers_ PCCLT_GUARDED_BY(state_mu_);
     std::vector<proto::Uuid> ring_ PCCLT_GUARDED_BY(state_mu_);
     uint64_t topo_revision_ PCCLT_GUARDED_BY(state_mu_) = 0;
+    // synthesized schedule table (docs/12): adopted from P2PConnInfo's
+    // trailing field and kM2CScheduleUpdate broadcasts. Introspection /
+    // telemetry only — the per-op algorithm binding is the commence stamp.
+    sched::Table sched_table_ PCCLT_GUARDED_BY(state_mu_);
 
     // relay ack ranges (leaf: RX threads write, op threads read) + the
     // fanout rotation counter for striped detours

@@ -129,6 +129,11 @@ bool Journal::replay(const std::string &path) {
                 restored_.op_done[{rec.group, rec.tag}] = std::move(rec);
                 break;
             }
+            case kSchedule: {
+                uint32_t g = r.u32();
+                restored_.groups[g].schedule = r.bytes();
+                break;
+            }
             case kOpDoneConsumed: {
                 uint32_t g = r.u32();
                 uint64_t tag = r.u64();
@@ -243,11 +248,19 @@ bool Journal::write_snapshot() {
             w.u8(gr.revision_initialized ? 1 : 0);
             put(kGroup, w.take());
         }
-        wire::Writer w;
-        w.u32(g);
-        w.u32(static_cast<uint32_t>(gr.ring.size()));
-        for (const auto &u : gr.ring) proto::put_uuid(w, u);
-        put(kRing, w.take());
+        {
+            wire::Writer w;
+            w.u32(g);
+            w.u32(static_cast<uint32_t>(gr.ring.size()));
+            for (const auto &u : gr.ring) proto::put_uuid(w, u);
+            put(kRing, w.take());
+        }
+        if (!gr.schedule.empty()) {
+            wire::Writer w;
+            w.u32(g);
+            w.bytes(gr.schedule);
+            put(kSchedule, w.take());
+        }
     }
     for (auto &b : restored_.bandwidth) {
         wire::Writer w;
@@ -320,6 +333,14 @@ void Journal::record_ring(uint32_t group, const std::vector<Uuid> &ring) {
     w.u32(static_cast<uint32_t>(ring.size()));
     for (const auto &u : ring) proto::put_uuid(w, u);
     append(kRing, w.take());
+}
+
+void Journal::record_schedule(uint32_t group,
+                              const std::vector<uint8_t> &table) {
+    wire::Writer w;
+    w.u32(group);
+    w.bytes(table);
+    append(kSchedule, w.take());
 }
 
 void Journal::record_topology_revision(uint64_t rev) {

@@ -46,6 +46,10 @@ struct GroupRec {
     uint64_t last_revision = 0;
     bool revision_initialized = false;
     std::vector<Uuid> ring;
+    // encoded sched::Table (docs/12): the synthesized per-collective
+    // schedule survives a master restart next to the ring order it was
+    // costed against (version lives inside the encoding). Empty = none.
+    std::vector<uint8_t> schedule;
 };
 
 struct BandwidthRec {
@@ -103,6 +107,9 @@ public:
     void record_client_remove(const Uuid &u);
     void record_group(uint32_t group, uint64_t last_revision, bool initialized);
     void record_ring(uint32_t group, const std::vector<Uuid> &ring);
+    // encoded sched::Table for the group (docs/12); journaled whenever a
+    // new schedule version is synthesized at optimize-topology time
+    void record_schedule(uint32_t group, const std::vector<uint8_t> &table);
     void record_topology_revision(uint64_t rev);
     void record_seq_bound(uint64_t bound);
     void record_bandwidth(const Uuid &from, const Uuid &to, double mbps);
@@ -128,6 +135,7 @@ private:
         kSeqBound = 8,
         kOpDone = 9,
         kOpDoneConsumed = 10,
+        kSchedule = 11,
     };
 
     void append(uint8_t type, const std::vector<uint8_t> &payload)

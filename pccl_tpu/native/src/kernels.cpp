@@ -81,8 +81,12 @@ void dispatch_op(proto::RedOp op, T *dst, const T *src, size_t n) {
     case proto::RedOp::kProd: loop(dst, src, n, Mul{}); break;
     case proto::RedOp::kMax: loop(dst, src, n, Max{}); break;
     case proto::RedOp::kMin: loop(dst, src, n, Min{}); break;
-    case proto::RedOp::kGather: break; // not a reduction; client.cpp/api.cpp
-                                      // route gather around these kernels
+    case proto::RedOp::kGather:
+    case proto::RedOp::kReduceScatter:
+    case proto::RedOp::kBroadcast:
+    case proto::RedOp::kAllToAll:
+        break; // collective-kind markers, not arithmetic ops; client.cpp /
+               // api.cpp route them around these kernels (docs/12)
     }
 }
 
@@ -105,8 +109,12 @@ void dispatch_op16(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *sr
     case proto::RedOp::kProd: loop16(bf16, dst, src, n, Mul{}); break;
     case proto::RedOp::kMax: loop16(bf16, dst, src, n, Max{}); break;
     case proto::RedOp::kMin: loop16(bf16, dst, src, n, Min{}); break;
-    case proto::RedOp::kGather: break; // not a reduction; client.cpp/api.cpp
-                                      // route gather around these kernels
+    case proto::RedOp::kGather:
+    case proto::RedOp::kReduceScatter:
+    case proto::RedOp::kBroadcast:
+    case proto::RedOp::kAllToAll:
+        break; // collective-kind markers, not arithmetic ops; client.cpp /
+               // api.cpp route them around these kernels (docs/12)
     }
 }
 
@@ -128,8 +136,12 @@ void dispatch_op3(proto::RedOp op, T *dst, const T *a, const T *b, size_t n) {
     case proto::RedOp::kProd: loop3(dst, a, b, n, Mul{}); break;
     case proto::RedOp::kMax: loop3(dst, a, b, n, Max{}); break;
     case proto::RedOp::kMin: loop3(dst, a, b, n, Min{}); break;
-    case proto::RedOp::kGather: break; // not a reduction; client.cpp/api.cpp
-                                      // route gather around these kernels
+    case proto::RedOp::kGather:
+    case proto::RedOp::kReduceScatter:
+    case proto::RedOp::kBroadcast:
+    case proto::RedOp::kAllToAll:
+        break; // collective-kind markers, not arithmetic ops; client.cpp /
+               // api.cpp route them around these kernels (docs/12)
     }
 }
 
@@ -152,8 +164,12 @@ void dispatch_op16_3(bool bf16, proto::RedOp op, uint16_t *dst, const uint16_t *
     case proto::RedOp::kProd: go(Mul{}); break;
     case proto::RedOp::kMax: go(Max{}); break;
     case proto::RedOp::kMin: go(Min{}); break;
-    case proto::RedOp::kGather: break; // not a reduction; client.cpp/api.cpp
-                                      // route gather around these kernels
+    case proto::RedOp::kGather:
+    case proto::RedOp::kReduceScatter:
+    case proto::RedOp::kBroadcast:
+    case proto::RedOp::kAllToAll:
+        break; // collective-kind markers, not arithmetic ops; client.cpp /
+               // api.cpp route them around these kernels (docs/12)
     }
 }
 

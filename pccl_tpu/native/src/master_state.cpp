@@ -196,6 +196,21 @@ void MasterState::attach_journal(journal::Journal *j) {
         g.last_revision = gr.last_revision;
         g.revision_initialized = gr.revision_initialized;
         g.ring = gr.ring;
+        // schedule plane (docs/12): the synthesized table survives the
+        // restart next to the ring it was costed against, so the first
+        // post-restore commence stamps the same algorithm the fleet was
+        // already running — no ring-everything regression window
+        if (!gr.schedule.empty()) {
+            if (auto t = sched::Table::decode(gr.schedule)) {
+                g.schedule = std::move(*t);
+                g.sched_version = g.schedule.version;
+                IngestItem it;
+                it.kind = IngestItem::kSchedule;
+                it.group = gid;
+                it.sched = gr.schedule;
+                enqueue(std::move(it));
+            }
+        }
     }
     for (const auto &b : r.bandwidth) {
         bandwidth_.store(b.from, b.to, b.mbps);
@@ -523,6 +538,10 @@ void MasterState::check_topology(std::vector<Outbox> &out) {
             if (!o.observer && o.uuid != c.uuid)
                 info.peers.push_back(endpoint_of(o));
         info.ring = groups_[c.peer_group].ring;
+        // trailing schedule table: a (re)joining peer adopts ring order and
+        // schedule in one epoch-safe step (docs/12)
+        if (!groups_[c.peer_group].schedule.empty())
+            info.sched = groups_[c.peer_group].schedule.encode();
         out.push_back({c.conn_id, PacketType::kM2CP2PConnInfo, info.encode()});
     }
 }
@@ -669,7 +688,11 @@ std::vector<Outbox> MasterState::on_collective_init(uint64_t conn,
         g.ops[ci.tag] = op;
         it = g.ops.find(ci.tag);
     } else if (it->second.params.count != ci.count ||
-               it->second.params.dtype != ci.dtype || it->second.params.op != ci.op) {
+               it->second.params.dtype != ci.dtype || it->second.params.op != ci.op ||
+               it->second.params.aux != ci.aux) {
+        // aux is part of the matched-parameters contract (docs/12): a
+        // broadcast where members disagree on the root slot must kick like
+        // a count/dtype mismatch, not silently pick one member's root
         kick(out, *c, "collective op parameter mismatch");
         return out;
     }
@@ -704,14 +727,69 @@ void MasterState::check_collective(std::vector<Outbox> &out, uint32_t group, uin
             journal_->record_seq_bound(seq_bound_);
         }
         for (auto *m : members) op.members.insert(m->uuid);
+        // ---- schedule stamp (docs/12): bind this op to ONE algorithm at
+        // commence, so a racing kM2CScheduleUpdate can never split the
+        // group. Trailing fields; pre-schedule clients stop after seq.
+        const auto &gs = git->second;
+        const uint32_t world = static_cast<uint32_t>(op.members.size());
+        const sched::Coll coll = sched::coll_of(op.params.op);
+        const uint64_t bytes =
+            op.params.count * proto::dtype_size(op.params.dtype);
+        sched::Algo algo = sched::Algo::kRing;
+        uint32_t root = 0;
+        if (coll == sched::Coll::kBroadcast && world > 0) {
+            // aux carries the root SLOT (sorted-uuid order, the
+            // user-visible rank space); the step programs address ring
+            // indices — convert here, once, authoritatively. op.members is
+            // an ordered set, i.e. already the sorted-uuid slot order.
+            if (op.params.aux >= world)
+                PLOG(kWarn) << "broadcast root slot " << op.params.aux
+                            << " out of range for world " << world
+                            << "; wrapping";
+            auto sit = op.members.begin();
+            std::advance(sit, static_cast<size_t>(op.params.aux % world));
+            for (uint32_t i = 0; i < static_cast<uint32_t>(gs.ring.size()); ++i)
+                if (gs.ring[i] == *sit) {
+                    root = i;
+                    break;
+                }
+        }
+        if (sched::schedule_enabled()) {
+            if (auto f = sched::forced_algo()) {
+                // FORCE works at commence even before any optimize round
+                // has synthesized a table (bench/test hook, docs/03)
+                if (sched::algo_valid(coll, *f, world)) algo = *f;
+            } else if (const sched::Entry *e =
+                           gs.schedule.find(coll, sched::size_class(bytes))) {
+                auto a = static_cast<sched::Algo>(e->algo);
+                // re-validate against the COMMENCE world: membership may
+                // have shifted since synthesis (butterfly needs a power of
+                // two, relay roots must still be in range)
+                if (sched::algo_valid(coll, a, world) &&
+                    (a != sched::Algo::kRelayRing || e->root < world)) {
+                    algo = a;
+                    if (a == sched::Algo::kRelayRing) root = e->root;
+                }
+            }
+        }
+        // the only invalid DEFAULT: a2a's rotation tag grid caps at 64
+        // ranks — stamp the mesh for bigger worlds (matches the
+        // executor's deterministic fallback)
+        if (!sched::algo_valid(coll, algo, world) &&
+            coll == sched::Coll::kAllToAll)
+            algo = sched::Algo::kMesh;
         for (auto *m : members) {
             wire::Writer w;
             w.u64(tag);
             w.u64(op.seq);
+            w.u8(static_cast<uint8_t>(algo));
+            w.u32(root);
+            w.u64(gs.sched_version);
             out.push_back({m->conn_id, PacketType::kM2CCollectiveCommence, w.take()});
         }
         PLOG(kDebug) << "collective tag " << tag << " commenced, group " << group
-                     << ", world " << op.members.size();
+                     << ", world " << op.members.size() << ", algo "
+                     << sched::algo_name(algo);
         return;
     }
 
@@ -1232,6 +1310,47 @@ void MasterState::check_optimize(std::vector<Outbox> &out) {
             groups_[gid].ring = ring;
             if (journal_) journal_->record_ring(gid, ring);
             spawn_moonshot(gid, m_uuids, cost, tour);
+
+            // ---- schedule synthesis (docs/12): same measured matrix,
+            // richer question. The planner's peer space is ring POSITIONS,
+            // so build the mbps matrix in adopted-ring order; versioned,
+            // journaled, and broadcast so /metrics and rejoiners see it —
+            // the per-op binding truth stays the commence stamp.
+            if (sched::schedule_enabled()) {
+                auto &gs = groups_[gid];
+                const size_t rn = gs.ring.size();
+                sched::CostModel cm;
+                cm.n = static_cast<uint32_t>(rn);
+                cm.mbps.assign(rn * rn, 0.0);
+                for (size_t i = 0; i < rn; ++i)
+                    for (size_t j = 0; j < rn; ++j) {
+                        if (i == j) continue;
+                        auto bw = bandwidth_.get(gs.ring[i], gs.ring[j]);
+                        cm.mbps[i * rn + j] = bw ? *bw : 0.0;
+                    }
+                std::vector<uint32_t> ring_idx(rn);
+                for (size_t i = 0; i < rn; ++i)
+                    ring_idx[i] = static_cast<uint32_t>(i);
+                gs.schedule =
+                    sched::synthesize(cm, ring_idx, ++gs.sched_version);
+                auto enc = gs.schedule.encode();
+                if (journal_) journal_->record_schedule(gid, enc);
+                for (auto *m : members) {
+                    proto::ScheduleUpdateM2C su;
+                    su.group = gid;
+                    su.table = enc;
+                    out.push_back({m->conn_id, PacketType::kM2CScheduleUpdate,
+                                   su.encode()});
+                }
+                IngestItem sit;
+                sit.kind = IngestItem::kSchedule;
+                sit.group = gid;
+                sit.sched = std::move(enc);
+                enqueue(std::move(sit));
+                PLOG(kInfo) << "group " << gid << ": collective schedule v"
+                            << gs.schedule.version << " synthesized ("
+                            << gs.schedule.entries.size() << " entries)";
+            }
         }
     }
     for (auto *a : acc) {
@@ -1449,6 +1568,13 @@ void MasterState::fold_item(IngestItem &it) {
         health_world_ = it.world;
         health_clients_ = it.clients;
         health_limbo_ = it.limbo;
+        break;
+    }
+    case IngestItem::kSchedule: {
+        auto t = sched::Table::decode(it.sched);
+        if (!t) break;
+        MutexLock lk(health_mu_);
+        fleet_schedules_[it.group] = std::move(*t);
         break;
     }
     case IngestItem::kIncident: {
@@ -1962,6 +2088,7 @@ std::string MasterState::render_metrics_uncached() const {
     // building under the lock would stall the ingest for the whole scrape
     std::map<std::string, PeerHealth> fleet_peers_copy;
     std::map<std::pair<std::string, std::string>, EdgeHealth> fleet_edges_copy;
+    std::map<uint32_t, sched::Table> fleet_schedules_copy;
     std::map<std::string, uint64_t> suppressed_by_class_copy;
     uint64_t digests_total_copy, stragglers_copy;
     uint64_t incidents_copy, incidents_suppressed_copy;
@@ -1970,6 +2097,7 @@ std::string MasterState::render_metrics_uncached() const {
         MutexLock lk(health_mu_);
         fleet_peers_copy = fleet_peers_;
         fleet_edges_copy = fleet_edges_;
+        fleet_schedules_copy = fleet_schedules_;
         suppressed_by_class_copy = incidents_suppressed_by_class_;
         digests_total_copy = digests_total_;
         stragglers_copy = stragglers_flagged_;
@@ -2020,6 +2148,27 @@ std::string MasterState::render_metrics_uncached() const {
             o += "pcclt_master_incidents_suppressed_by_class_total"
                  "{trigger_class=\"" +
                  esc(klass) + "\"} " + num(n) + "\n";
+    }
+    // schedule plane (docs/12): what the synthesizer picked, per group
+    if (!fleet_schedules_copy.empty()) {
+        gauge("pcclt_schedule_version",
+              "synthesized collective schedule table version per group");
+        for (const auto &[gid, t] : fleet_schedules_copy)
+            o += "pcclt_schedule_version{group=\"" +
+                 num(static_cast<uint64_t>(gid)) + "\"} " + num(t.version) +
+                 "\n";
+        gauge("pcclt_schedule_kind",
+              "chosen algorithm per (group, collective, size class); "
+              "constant 1, the labels are the payload");
+        for (const auto &[gid, t] : fleet_schedules_copy)
+            for (const auto &e : t.entries)
+                o += "pcclt_schedule_kind{group=\"" +
+                     num(static_cast<uint64_t>(gid)) + "\",coll=\"" +
+                     sched::coll_name(static_cast<sched::Coll>(e.coll)) +
+                     "\",size_class=\"" +
+                     num(static_cast<uint64_t>(e.size_class)) + "\",algo=\"" +
+                     sched::algo_name(static_cast<sched::Algo>(e.algo)) +
+                     "\"} 1\n";
     }
     gauge("pcclt_build_info",
           "build identity (constant 1; the labels are the payload)");

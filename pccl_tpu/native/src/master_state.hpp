@@ -34,6 +34,7 @@
 #include "bandwidth.hpp"
 #include "journal.hpp"
 #include "protocol.hpp"
+#include "schedule.hpp"
 #include "telemetry.hpp"
 
 namespace pcclt::master {
@@ -149,6 +150,13 @@ struct GroupState {
     std::set<std::pair<Uuid, std::string>> sync_promoted;
     std::map<uint64_t, CollectiveOp> ops;       // by tag
     std::vector<Uuid> ring;                     // current ring order
+    // synthesized collective schedule (docs/12): one entry per
+    // (collective, size-class), costed against the measured bandwidth
+    // matrix at optimize-topology time. Versioned so the commence stamp
+    // can name which table it was drawn from; empty = ring-everything
+    // (fresh group, no optimize round yet, or PCCLT_SCHEDULE=0).
+    sched::Table schedule;
+    uint64_t sched_version = 0;  // last version synthesized for this group
 };
 
 class MasterState {
@@ -343,6 +351,7 @@ private:
             kForget,          // bandwidth-matrix mirror: forget(peer)
             kSummary,         // world/clients/limbo counts republish
             kIncident,        // fired incident record for /health listing
+            kSchedule,        // group's synthesized schedule table changed
         };
         Kind kind = kDigest;
         proto::TelemetryDigestC2M digest;    // kDigest
@@ -355,6 +364,7 @@ private:
         size_t world = 0, clients = 0, limbo = 0; // kSummary
         std::string inc_id, inc_trigger;     // kIncident
         uint64_t t_ns = 0;                   // kDigest/kIncident
+        std::vector<uint8_t> sched;          // kSchedule: Table::encode()
     };
     // straggler transitions detected by the fold; drained by the
     // dispatcher on its next tick (<=100 ms) to run the parts that need
@@ -422,6 +432,10 @@ private:
     size_t health_world_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_clients_ PCCLT_GUARDED_BY(health_mu_) = 0;
     size_t health_limbo_ PCCLT_GUARDED_BY(health_mu_) = 0;
+    // schedule plane (docs/12): per-group synthesized tables mirrored for
+    // /metrics (pcclt_schedule_kind / pcclt_schedule_version)
+    std::map<uint32_t, sched::Table> fleet_schedules_
+        PCCLT_GUARDED_BY(health_mu_);
     // /health?history=1 ring: fleet snapshot every
     // PCCLT_HEALTH_HISTORY_MS (default 1000), last PCCLT_HEALTH_HISTORY
     // (default 120) kept — trend-over-time without external storage

@@ -165,6 +165,8 @@ std::vector<uint8_t> P2PConnInfo::encode() const {
     }
     w.u32(static_cast<uint32_t>(ring.size()));
     for (const auto &u : ring) put_uuid(w, u);
+    // trailing schedule table (docs/12); older clients stop reading above
+    w.bytes(sched);
     return w.take();
 }
 
@@ -185,6 +187,9 @@ std::optional<P2PConnInfo> P2PConnInfo::decode(const std::vector<uint8_t> &b) {
         }
         uint32_t m = r.u32();
         for (uint32_t i = 0; i < m; ++i) p.ring.push_back(get_uuid(r));
+        try {
+            p.sched = r.bytes(); // trailing; absent from older masters
+        } catch (...) {}
         return p;
     } catch (...) { return std::nullopt; }
 }
@@ -201,6 +206,7 @@ std::vector<uint8_t> CollectiveInit::encode() const {
     w.u8(static_cast<uint8_t>(quant_dtype));
     w.u8(retry);
     w.u64(retry_seq);
+    w.u64(aux);
     return w.take();
 }
 
@@ -217,6 +223,7 @@ std::optional<CollectiveInit> CollectiveInit::decode(const std::vector<uint8_t> 
         try {
             c.retry = r.u8(); // trailing; absent from older clients
             c.retry_seq = r.u64();
+            c.aux = r.u64(); // trailing (docs/12); absent decodes 0
         } catch (...) {}
         return c;
     } catch (...) { return std::nullopt; }
@@ -432,6 +439,25 @@ std::optional<SeederUpdateM2C> SeederUpdateM2C::decode(const std::vector<uint8_t
         s.seeder.ip = get_addr(r);
         s.seeder.ss_port = r.u16();
         s.seeder.p2p_port = r.u16();
+        return s;
+    } catch (...) { return std::nullopt; }
+}
+
+// --- ScheduleUpdateM2C (schedule plane, docs/12) ---
+
+std::vector<uint8_t> ScheduleUpdateM2C::encode() const {
+    wire::Writer w;
+    w.u32(group);
+    w.bytes(table);
+    return w.take();
+}
+
+std::optional<ScheduleUpdateM2C> ScheduleUpdateM2C::decode(const std::vector<uint8_t> &b) {
+    try {
+        wire::Reader r(b);
+        ScheduleUpdateM2C s;
+        s.group = r.u32();
+        s.table = r.bytes();
         return s;
     } catch (...) { return std::nullopt; }
 }

@@ -92,6 +92,11 @@ enum PacketType : uint16_t {
     // mid-round. Fire-and-forget broadcast to the syncing group; fetch
     // engines fold the new source in, idle receivers drain and drop it.
     kM2CSeederUpdate = 0x2010,
+    // schedule plane (docs/12): the group's synthesized collective
+    // schedule table changed (new version after optimize-topology).
+    // Fire-and-forget broadcast; the per-op binding truth stays the
+    // commence stamp, so a late or lost update can never split the group.
+    kM2CScheduleUpdate = 0x2011,
 
     // p2p handshake
     kP2PHello = 0x3001,
@@ -121,8 +126,15 @@ size_t dtype_size(DType d);
 
 // kGather: not a reduction — the all-gather collective rides the same
 // consensus/abort machinery with this op id (pcclt extension; the
-// reference lists All-Gather as unshipped roadmap work)
-enum class RedOp : uint8_t { kSum = 0, kAvg, kProd, kMax, kMin, kGather };
+// reference lists All-Gather as unshipped roadmap work).
+// kReduceScatter/kBroadcast/kAllToAll (docs/12): the widened collective
+// vocabulary; reduce-scatter reduces with SUM, broadcast/all-to-all move
+// bytes unreduced. They share the init/commence consensus, tags and abort
+// machinery with the all-reduce.
+enum class RedOp : uint8_t {
+    kSum = 0, kAvg, kProd, kMax, kMin, kGather,
+    kReduceScatter = 6, kBroadcast = 7, kAllToAll = 8
+};
 enum class QuantAlgo : uint8_t { kNone = 0, kMinMax, kZeroPointScale };
 enum class SyncStrategy : uint8_t { kEnforcePopular = 0, kRxOnly, kTxOnly };
 
@@ -182,6 +194,11 @@ struct P2PConnInfo {
     uint64_t revision = 0;
     std::vector<PeerEndpoint> peers; // everyone else in my group's world
     std::vector<Uuid> ring;          // group ring order (includes self)
+    // trailing (tail-tolerant): the group's current synthesized schedule
+    // table, sched::Table::encode() bytes — empty = none yet / old master.
+    // Rides the same packet as the ring order so a rejoining peer adopts
+    // both in one epoch-safe step.
+    std::vector<uint8_t> sched;
     std::vector<uint8_t> encode() const;
     static std::optional<P2PConnInfo> decode(const std::vector<uint8_t> &);
 };
@@ -204,6 +221,10 @@ struct CollectiveInit {
     // delivered). Trailing on the wire; absent (older client) decodes 0.
     uint8_t retry = 0;
     uint64_t retry_seq = 0;
+    // collective-specific argument, trailing (absent decodes 0): the
+    // broadcast root SLOT (sorted-uuid order). Part of the group's
+    // matched-parameters contract — a mismatch kicks like count/dtype/op.
+    uint64_t aux = 0;
     std::vector<uint8_t> encode() const;
     static std::optional<CollectiveInit> decode(const std::vector<uint8_t> &);
 };
@@ -285,6 +306,17 @@ struct SeederUpdateM2C {
     SeederRec seeder;
     std::vector<uint8_t> encode() const;
     static std::optional<SeederUpdateM2C> decode(const std::vector<uint8_t> &);
+};
+
+// kM2CScheduleUpdate: fire-and-forget broadcast of a group's new
+// synthesized schedule table (docs/12). `table` is sched::Table::encode()
+// bytes; the receiver adopts it for introspection/telemetry only — the
+// per-op algorithm binding is the commence stamp.
+struct ScheduleUpdateM2C {
+    uint32_t group = 0;
+    std::vector<uint8_t> table;
+    std::vector<uint8_t> encode() const;
+    static std::optional<ScheduleUpdateM2C> decode(const std::vector<uint8_t> &);
 };
 
 // Telemetry digest (fleet observability plane). Compact by construction:

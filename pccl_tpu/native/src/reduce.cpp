@@ -387,7 +387,16 @@ bool wd_relay_span(RingCtx &ctx, uint64_t tag, uint64_t base_off,
     for (size_t off = 0; off < bytes; off += kRelayWin) {
         size_t n = std::min(kRelayWin, bytes - off);
         if (!ctx.relay_window(tag, base_off + off, {p + off, n})) return false;
-        ctx.tx_edge->wd_relays.fetch_add(1, std::memory_order_relaxed);
+        // planned kRelayRing detours are a CHOSEN schedule, not a failover:
+        // they get their own conservation counter so dashboards can tell
+        // the two apart (docs/12)
+        if (ctx.planned_relay) {
+            if (ctx.tele)
+                ctx.tele->comm.sched_relay_planned_bytes.fetch_add(
+                    n, std::memory_order_relaxed);
+        } else if (ctx.tx_edge) {
+            ctx.tx_edge->wd_relays.fetch_add(1, std::memory_order_relaxed);
+        }
     }
     return true;
 }
@@ -847,6 +856,12 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count) 
     // tx edge's health verdict while the CONFIRMED hold lasts
     Wd wd;
     wd_init(wd, ctx);
+    // planned relay (docs/12 kRelayRing): the master stamped THIS rank as
+    // the bottleneck sender — route the op through the acked relay plane
+    // from the start, exactly the CONFIRMED detour minus the verdict. The
+    // wire/dedupe/ack machinery is identical; only the accounting differs
+    // (sched_relay_planned_bytes, not the emergency wd counters).
+    if (ctx.planned_relay && ctx.relay_window) wd.relay_all = true;
 
     auto restore = [&] {
         // purge FIRST: stage-ahead all-gather sinks point into `recv`, and an
@@ -1749,6 +1764,799 @@ Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count) 
         rec.instant("collective", "wire_stall", "ns", prof.wait_ns, "seq",
                     ctx.op_seq);
     }
+    return Result::kOk;
+}
+
+// ---- synthesized-schedule interpreter (docs/12) ----
+// The executors below run the step programs sched::expand emits for the
+// commence-stamped algorithm. Ring-edge algorithms (chain broadcast, a2a
+// rotation, reduce-scatter) ride the full watchdog ladder; non-ring
+// transfers (tree, butterfly, mesh) resolve links per step through the
+// client-bound ctx.link_to / ctx.link_from and poll aborts via
+// stream_recv exactly like the ring.
+namespace {
+
+// RAII swap of the ctx's inbound link so stream_recv / fetch_meta (which
+// read ctx.rx) can run against an arbitrary peer of a synthesized
+// schedule. Links are shared_ptr bundles, so the copies are cheap.
+struct RxSwap {
+    RingCtx &ctx;
+    net::Link saved_rx;
+    telemetry::EdgeCounters *saved_edge;
+    const char *saved_ep;
+    RxSwap(RingCtx &c, net::Link l, telemetry::EdgeCounters *edge = nullptr)
+        : ctx(c), saved_rx(c.rx), saved_edge(c.rx_edge),
+          saved_ep(c.rx_endpoint) {
+        ctx.rx = std::move(l);
+        ctx.rx_edge = edge;
+        ctx.rx_endpoint = nullptr;
+    }
+    ~RxSwap() {
+        ctx.rx = std::move(saved_rx);
+        ctx.rx_edge = saved_edge;
+        ctx.rx_endpoint = saved_ep;
+    }
+};
+
+// Link to / from a ring index: ring neighbors reuse the op's pinned
+// links (watchdog state and all); everything else goes through the
+// client-bound resolvers. An invalid Link fails the op as kConnectionLost.
+net::Link sched_link_to(RingCtx &ctx, uint32_t r) {
+    if (ctx.world >= 2 && r == (ctx.rank + 1) % ctx.world) return ctx.tx;
+    if (ctx.link_to) return ctx.link_to(r);
+    return {};
+}
+
+net::Link sched_link_from(RingCtx &ctx, uint32_t r) {
+    if (ctx.world >= 2 && r == (ctx.rank + ctx.world - 1) % ctx.world)
+        return ctx.rx;
+    if (ctx.link_from) return ctx.link_from(r, 30000);
+    return {};
+}
+
+telemetry::EdgeCounters *sched_edge(RingCtx &ctx, uint32_t r) {
+    return ctx.edge_of ? ctx.edge_of(r) : nullptr;
+}
+
+void note_steps(RingCtx &ctx, size_t n) {
+    if (ctx.tele)
+        ctx.tele->comm.sched_steps.fetch_add(n, std::memory_order_relaxed);
+}
+
+} // namespace
+
+Result ring_reduce_scatter(RingCtx &ctx, const void *send, void *recv,
+                           size_t count, uint64_t *out_offset,
+                           uint64_t *out_count) {
+    const size_t esz = proto::dtype_size(ctx.dtype);
+    const uint32_t world = ctx.world, rank = ctx.rank;
+    if (world < 2) {
+        if (send != recv) memcpy(recv, send, count * esz);
+        if (out_offset) *out_offset = 0;
+        if (out_count) *out_count = count;
+        return Result::kOk;
+    }
+    const bool quantized = ctx.quant != proto::QuantAlgo::kNone;
+    const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
+    const uint64_t base_tag = ctx.op_seq << 16;
+    // the local fold is always a SUM: RedOp::kReduceScatter on the wire
+    // marks the collective KIND, not an arithmetic operator
+    const auto fold = proto::RedOp::kSum;
+
+    // layout inside the (pooled) scratch: full-count accumulator, then two
+    // alternating rx chunk slots, then (quantized) one tx staging slot
+    const size_t max_chunk = chunk_of(count, world, 0).n_elems;
+    const size_t work_b = count * esz;
+    std::vector<uint8_t> scratch_local;
+    std::vector<uint8_t> &buf = ctx.scratch ? *ctx.scratch : scratch_local;
+    const size_t need =
+        work_b + 2 * max_chunk * qsz + (quantized ? max_chunk * qsz : 0);
+    if (buf.size() < need) buf.resize(need);
+    uint8_t *working = buf.data();
+    auto scratch_at = [&](uint32_t s) {
+        return buf.data() + work_b + (s % 2) * max_chunk * qsz;
+    };
+    uint8_t *qtx =
+        quantized ? buf.data() + work_b + 2 * max_chunk * qsz : nullptr;
+    memcpy(working, send, work_b);
+
+    Wd wd;
+    wd_init(wd, ctx);
+    auto fail = [&](bool conn_lost) {
+        net::Link::wait_all(wd.zombies);
+        wd.zombies.clear();
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+        return conn_lost ? Result::kConnectionLost : Result::kAborted;
+    };
+
+    auto &rec = telemetry::Recorder::inst();
+    Prof prof;
+    auto op_t0 = now_ns();
+    note_steps(ctx, sched::expand(sched::Coll::kReduceScatter,
+                                  sched::Algo::kRing, world, rank, 0, count)
+                        .size());
+    // same one-stage-ahead sink protocol as the all-reduce's RS phase
+    auto reg_stage = [&](uint32_t s) {
+        if (s + 1 >= world) return;
+        const uint32_t rc = (rank + world - s - 1) % world;
+        ctx.rx.table().register_sink(base_tag | s, scratch_at(s),
+                                     chunk_of(count, world, rc).n_elems * qsz,
+                                     /*consumer_pull=*/true);
+    };
+    reg_stage(0);
+    for (uint32_t s = 0; s + 1 < world; ++s) {
+        const uint64_t stage_t0 = now_ns();
+        const uint64_t stage_wait0 = prof.wait_ns;
+        ScopeExit stage_span{[&, s] {
+            stage_attrib(ctx, prof, "rsc_stage", s, stage_t0, stage_wait0);
+        }};
+        const uint64_t tag = base_tag | s;
+        const auto send_span = chunk_of(count, world, (rank + world - s) % world);
+        const auto recv_span =
+            chunk_of(count, world, (rank + world - s - 1) % world);
+        uint8_t *send_ptr = working + send_span.start_elem * esz;
+
+        std::vector<net::SendHandle> tx_job;
+        if (quantized) {
+            // an escalated earlier window still borrows qtx — drain before
+            // the staging slot is overwritten (spans must stay valid)
+            if (!wd.zombies.empty()) drain_zombies(ctx, wd.zombies);
+            quant::Meta m = quant::compute_meta(ctx.quant, ctx.q_dtype,
+                                                ctx.dtype, send_ptr,
+                                                send_span.n_elems);
+            quant::quantize(m, send_ptr, qtx, send_span.n_elems);
+            tx_job.push_back(ctx.tx.send_meta(tag | kMetaBit, m.encode()));
+            if (!(wd.relay_all &&
+                  wd_relay_span(ctx, tag, 0, qtx, send_span.n_elems * qsz))) {
+                auto ph = ctx.tx.send_async(tag, {qtx, send_span.n_elems * qsz},
+                                            ctx.op_seq);
+                tx_job.insert(tx_job.end(), ph.begin(), ph.end());
+                wd_track(wd, tx_job);
+            }
+        } else {
+            // sent chunks of `working` are never rewritten by later stages,
+            // so fp32 zombie spans stay valid until the op-end drain
+            if (!(wd.relay_all &&
+                  wd_relay_span(ctx, tag, 0, send_ptr,
+                                send_span.n_elems * esz))) {
+                tx_job = ctx.tx.send_async(
+                    tag, {send_ptr, send_span.n_elems * esz}, ctx.op_seq);
+                wd_track(wd, tx_job);
+            }
+        }
+        ctx.tx_bytes += send_span.n_elems * qsz;
+
+        reg_stage(s + 1);
+        uint8_t *acc = working + recv_span.start_elem * esz;
+        bool meta_ok = true;
+        bool ok;
+        if (quantized) {
+            RxMeta ms;
+            if (!fetch_meta(ctx, tag | kMetaBit, ms, 0)) {
+                wd.on ? wd_join(wd, ctx, tx_job) : net::Link::wait_all(tx_job);
+                return fail(!ctx.rx.alive());
+            }
+            ok = stream_recv(
+                ctx, tag, recv_span.n_elems * qsz, qsz, scratch_at(s),
+                [&](const uint8_t *src, size_t lo, size_t hi) {
+                    size_t e0 = lo / qsz, e1 = hi / qsz;
+                    if (!for_each_meta_span(
+                            ctx, tag | kMetaBit, ms, recv_span.n_elems, e0, e1,
+                            [&](const quant::Meta &m2, size_t a, size_t b) {
+                                quant::dequantize_accumulate(
+                                    m2, fold, src + (a - e0) * qsz,
+                                    acc + a * esz, b - a);
+                            }))
+                        meta_ok = false;
+                },
+                &prof, /*fill_if_unmapped=*/false, 0, &wd);
+        } else {
+            ok = stream_recv(
+                ctx, tag, recv_span.n_elems * esz, esz, scratch_at(s),
+                [&](const uint8_t *src, size_t lo, size_t hi) {
+                    kernels::accumulate(ctx.dtype, fold, acc + lo, src,
+                                        (hi - lo) / esz);
+                },
+                &prof, /*fill_if_unmapped=*/false, 0, &wd);
+        }
+        ctx.rx.table().unregister_sink(tag);
+        bool tx_ok =
+            wd.on ? wd_join(wd, ctx, tx_job) : net::Link::wait_all(tx_job);
+        if (!ok || !meta_ok || !tx_ok)
+            return fail(!ctx.rx.alive() || !ctx.tx.alive());
+        ctx.rx_bytes += recv_span.n_elems * qsz;
+    }
+
+    // ownership follows ring position: after world-1 stages this rank
+    // holds the fully-reduced chunk (rank+1) % world
+    const auto own = chunk_of(count, world, (rank + 1) % world);
+    memcpy(recv, working + own.start_elem * esz, own.n_elems * esz);
+    if (out_offset) *out_offset = own.start_elem;
+    if (out_count) *out_count = own.n_elems;
+
+    drain_zombies(ctx, wd.zombies);
+    wd_op_clean(wd, ctx);
+    ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+    ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    uint64_t op_t1 = now_ns();
+    if (ctx.rx_edge)
+        ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns,
+                                        std::memory_order_relaxed);
+    if (ctx.tele) {
+        ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
+        ctx.tele->record_phase(telemetry::Phase::kOp, op_t1 - op_t0);
+        ctx.tele->record_phase(telemetry::Phase::kStall, prof.wait_ns);
+    }
+    if (rec.on())
+        rec.span("collective", "reduce_scatter_only", op_t0, op_t1, "seq",
+                 ctx.op_seq, "bytes", count * esz);
+    return Result::kOk;
+}
+
+Result run_broadcast(RingCtx &ctx, void *buf, size_t count) {
+    const size_t esz = proto::dtype_size(ctx.dtype);
+    const uint32_t world = ctx.world, rank = ctx.rank;
+    if (world < 2) return Result::kOk;
+    const uint32_t root = ctx.sched_root % world;
+    const bool quantized = ctx.quant != proto::QuantAlgo::kNone;
+    const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
+    const size_t wire_b = count * qsz;
+    const uint64_t base_tag = ctx.op_seq << 16;
+    auto *out = static_cast<uint8_t *>(buf);
+    // chain steps ride the ring's pinned edges; the star's fan-out/-in
+    // edges resolve per step (no watchdog ladder — abort polls cover them)
+    const bool chain = ctx.sched_algo != sched::Algo::kTree;
+
+    const auto prog = sched::expand(sched::Coll::kBroadcast, ctx.sched_algo,
+                                    world, rank, root, wire_b);
+    note_steps(ctx, prog.size());
+    const sched::Step *in_step = nullptr;
+    std::vector<const sched::Step *> sends;
+    for (const auto &st : prog) {
+        if (st.kind == sched::Step::kSend) sends.push_back(&st);
+        else in_step = &st;
+    }
+
+    std::vector<uint8_t> qloc(quantized ? wire_b : 0);
+    auto &rec = telemetry::Recorder::inst();
+    Prof prof;
+    auto op_t0 = now_ns();
+    auto finish = [&](Result res) {
+        uint64_t op_t1 = now_ns();
+        if (ctx.rx_edge)
+            ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns,
+                                            std::memory_order_relaxed);
+        if (res == Result::kOk && ctx.tele) {
+            ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
+            ctx.tele->record_phase(telemetry::Phase::kOp, op_t1 - op_t0);
+            ctx.tele->record_phase(telemetry::Phase::kStall, prof.wait_ns);
+        }
+        if (res == Result::kOk && rec.on())
+            rec.span("collective", "broadcast", op_t0, op_t1, "seq",
+                     ctx.op_seq, "bytes", count * esz);
+        return res;
+    };
+
+    if (!in_step) {
+        // ---- root: quantize once, fan the payload out per step ----
+        Wd wd;
+        if (chain) wd_init(wd, ctx);  // chain egress is the ring tx edge
+        quant::Meta m;
+        std::vector<uint8_t> menc;
+        if (quantized) {
+            m = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype, out,
+                                    count);
+            quant::quantize(m, out, qloc.data(), count);
+            menc = m.encode();
+        }
+        const uint8_t *payload = quantized ? qloc.data() : out;
+        std::vector<net::SendHandle> hs;
+        std::vector<net::Link> used;
+        for (const auto *st : sends) {
+            net::Link l = sched_link_to(ctx, st->peer);
+            if (!l.valid()) {
+                net::Link::wait_all(hs);
+                for (auto &u : used)
+                    u.table().purge_range(base_tag, base_tag + 0x10000);
+                return finish(Result::kConnectionLost);
+            }
+            const uint64_t tag = base_tag | st->xfer;
+            if (quantized) hs.push_back(l.send_meta(tag | kMetaBit, menc));
+            if (!(chain && wd.relay_all &&
+                  wd_relay_span(ctx, tag, 0, payload, wire_b))) {
+                size_t pre = hs.size();
+                auto ph = l.send_async(tag, {payload, wire_b}, ctx.op_seq);
+                hs.insert(hs.end(), ph.begin(), ph.end());
+                if (chain) wd_track(wd, hs, pre);
+            }
+            used.push_back(std::move(l));
+            ctx.tx_bytes += wire_b;
+        }
+        bool ok = wd.on ? wd_join(wd, ctx, hs) : net::Link::wait_all(hs);
+        drain_zombies(ctx, wd.zombies);
+        if (wd.on) wd_op_clean(wd, ctx);
+        for (auto &u : used)
+            u.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        if (!ok)
+            return finish(ctx.should_abort && ctx.should_abort()
+                              ? Result::kAborted
+                              : Result::kConnectionLost);
+        if (quantized)
+            // bit parity: the root keeps exactly what the receivers decode
+            quant::requantize_self(m, out, count);
+        return finish(Result::kOk);
+    }
+
+    // ---- receiver: star leaf, chain tail, or chain store-and-forward ----
+    const sched::Step *fwd = sends.empty() ? nullptr : sends[0];
+    const uint64_t in_tag = base_tag | in_step->xfer;
+    const uint64_t out_tag = fwd ? (base_tag | fwd->xfer) : 0;
+    const bool from_pred = in_step->peer == (rank + world - 1) % world;
+    net::Link lf = sched_link_from(ctx, in_step->peer);
+    if (!lf.valid()) return finish(Result::kConnectionLost);
+    Wd wd;
+    if (chain && fwd) wd_init(wd, ctx);  // forward egress is the ring tx
+    uint8_t *sink = quantized ? qloc.data() : out;
+    std::vector<net::SendHandle> tx_job;
+    size_t fwd_off = 0;
+    bool meta_ok = true;
+    bool ok;
+    RxMeta ms;
+    {
+        RxSwap swap(ctx, lf,
+                    from_pred ? ctx.rx_edge : sched_edge(ctx, in_step->peer));
+        ctx.rx.table().register_sink(in_tag, sink, wire_b,
+                                     /*consumer_pull=*/true);
+        if (quantized && !fetch_meta(ctx, in_tag | kMetaBit, ms, 0)) {
+            ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+            return finish(ctx.rx.alive() ? Result::kAborted
+                                         : Result::kConnectionLost);
+        }
+        if (quantized && fwd) {
+            // forward the meta ahead of the bytes — deterministic re-encode
+            // keeps every hop's frames byte-identical to the root's
+            if (ms.per_window) {
+                for (uint32_t w = 0; w < ms.qw; ++w)
+                    tx_job.push_back(ctx.tx.send_meta_at(
+                        out_tag | kMetaBit, w + 1,
+                        qwin_encode(ms.qw, ms.get(w))));
+            } else {
+                tx_job.push_back(
+                    ctx.tx.send_meta(out_tag | kMetaBit, ms.whole.encode()));
+            }
+        }
+        ok = stream_recv(
+            ctx, in_tag, wire_b, qsz, sink,
+            [&](const uint8_t *src, size_t lo, size_t hi) {
+                if (src != sink + lo) memcpy(sink + lo, src, hi - lo);
+                if (fwd && !wd.relay_all) {
+                    size_t pre = tx_job.size();
+                    tx_job.push_back(ctx.tx.send_at(out_tag, lo,
+                                                    {sink + lo, hi - lo},
+                                                    ctx.op_seq));
+                    if (wd.on) wd_track(wd, tx_job, pre);
+                    fwd_off = hi;
+                }
+            },
+            &prof, /*fill_if_unmapped=*/true, 0,
+            (chain && fwd && wd.on) ? &wd : nullptr);
+        if (ok && fwd && fwd_off < wire_b) {
+            // relay mode (from the start, or flipped mid-stream): the
+            // remaining span detours; receivers dedupe by byte range
+            if (!(wd.relay_all &&
+                  wd_relay_span(ctx, out_tag, fwd_off, sink + fwd_off,
+                                wire_b - fwd_off))) {
+                size_t pre = tx_job.size();
+                tx_job.push_back(ctx.tx.send_at(
+                    out_tag, fwd_off, {sink + fwd_off, wire_b - fwd_off},
+                    ctx.op_seq));
+                if (wd.on) wd_track(wd, tx_job, pre);
+            }
+        }
+        ctx.rx.table().unregister_sink(in_tag);
+        bool tx_ok =
+            wd.on ? wd_join(wd, ctx, tx_job) : net::Link::wait_all(tx_job);
+        if (ok && meta_ok && tx_ok && quantized) {
+            // decode into the user buffer (metas are all fetched by now for
+            // the legacy whole-chunk mode; per-window stragglers pull here)
+            if (!for_each_meta_span(
+                    ctx, in_tag | kMetaBit, ms, count, 0, count,
+                    [&](const quant::Meta &m2, size_t a, size_t b) {
+                        quant::dequantize_set(m2, sink + a * qsz,
+                                              out + a * esz, b - a);
+                    }))
+                meta_ok = false;
+        }
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        if (!ok || !meta_ok || !tx_ok) {
+            drain_zombies(ctx, wd.zombies);
+            ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+            return finish(!ctx.rx.alive() || !ctx.tx.alive()
+                              ? Result::kConnectionLost
+                              : Result::kAborted);
+        }
+    }
+    ctx.rx_bytes += wire_b;
+    if (fwd) ctx.tx_bytes += wire_b;
+    drain_zombies(ctx, wd.zombies);
+    if (wd.on) wd_op_clean(wd, ctx);
+    ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+    return finish(Result::kOk);
+}
+
+Result run_all_to_all(RingCtx &ctx, const void *send, void *recv,
+                      size_t count_per_peer) {
+    const size_t esz = proto::dtype_size(ctx.dtype);
+    const uint32_t world = ctx.world, rank = ctx.rank;
+    auto *out = static_cast<uint8_t *>(recv);
+    const auto *src8 = static_cast<const uint8_t *>(send);
+    auto slot = [&](uint32_t r) -> size_t {
+        return ctx.slots.empty() ? r : ctx.slots[r];
+    };
+    const size_t bb = count_per_peer * esz;
+    if (world < 2) {
+        if (send != recv) memcpy(recv, send, bb);
+        return Result::kOk;
+    }
+    const bool quantized = ctx.quant != proto::QuantAlgo::kNone;
+    const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
+    const size_t qb = count_per_peer * qsz;
+    const uint64_t base_tag = ctx.op_seq << 16;
+    // the rotation tag grid is (world-1)*world wide: past 64 ranks it
+    // would cross the butterfly/meta tag space (algo_valid), so oversized
+    // worlds deterministically run the mesh — every rank sees the same
+    // commence world, so every rank takes the same branch
+    sched::Algo algo = ctx.sched_algo;
+    if (algo != sched::Algo::kMesh && world > 64) algo = sched::Algo::kMesh;
+    const auto prog =
+        sched::expand(sched::Coll::kAllToAll, algo, world, rank, 0,
+                      static_cast<uint64_t>(qb) * world);
+    note_steps(ctx, prog.size());
+    auto &rec = telemetry::Recorder::inst();
+    Prof prof;
+    auto op_t0 = now_ns();
+    auto finish = [&](Result res) {
+        uint64_t op_t1 = now_ns();
+        if (ctx.rx_edge)
+            ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns,
+                                            std::memory_order_relaxed);
+        if (res == Result::kOk && ctx.tele) {
+            ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
+            ctx.tele->record_phase(telemetry::Phase::kOp, op_t1 - op_t0);
+            ctx.tele->record_phase(telemetry::Phase::kStall, prof.wait_ns);
+        }
+        if (res == Result::kOk && rec.on())
+            rec.span("collective", "all_to_all", op_t0, op_t1, "seq",
+                     ctx.op_seq, "bytes",
+                     static_cast<uint64_t>(bb) * world);
+        return res;
+    };
+
+    if (algo == sched::Algo::kMesh) {
+        // ---- direct mesh: every block one hop over the full p2p mesh ----
+        std::vector<uint8_t> qrx(quantized ? (size_t)world * qb : 0);
+        std::vector<uint8_t> qtx(quantized ? (size_t)world * qb : 0);
+        struct RxEnt {
+            uint32_t peer;
+            uint64_t tag;
+            net::Link link;
+        };
+        std::vector<RxEnt> rx_ents;
+        std::vector<net::Link> tx_links;
+        std::vector<net::SendHandle> hs;
+        auto purge_all = [&] {
+            for (auto &e : rx_ents)
+                e.link.table().purge_range(base_tag, base_tag + 0x10000);
+            for (auto &l : tx_links)
+                l.table().purge_range(base_tag, base_tag + 0x10000);
+            ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+            ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        };
+        auto fail = [&](bool conn_lost) {
+            net::Link::wait_all(hs);
+            purge_all();
+            return finish(conn_lost ? Result::kConnectionLost
+                                    : Result::kAborted);
+        };
+        // register EVERY inbound sink before the first send leaves —
+        // register_sink drains queued racing frames, so symmetric peers
+        // firing immediately is safe
+        for (const auto &st : prog) {
+            if (st.kind != sched::Step::kRecv) continue;
+            net::Link lf = sched_link_from(ctx, st.peer);
+            if (!lf.valid()) return fail(true);
+            uint8_t *sink = quantized ? qrx.data() + (size_t)st.peer * qb
+                                      : out + slot(st.peer) * bb;
+            lf.table().register_sink(base_tag | st.xfer, sink, qb,
+                                     /*consumer_pull=*/true);
+            rx_ents.push_back({st.peer, base_tag | st.xfer, std::move(lf)});
+        }
+        for (const auto &st : prog) {
+            if (st.kind == sched::Step::kCopy) {
+                if (out + slot(rank) * bb != src8 + slot(rank) * bb)
+                    memcpy(out + slot(rank) * bb, src8 + slot(rank) * bb, bb);
+                continue;
+            }
+            if (st.kind != sched::Step::kSend) continue;
+            net::Link lt = sched_link_to(ctx, st.peer);
+            if (!lt.valid()) return fail(true);
+            const uint64_t tag = base_tag | st.xfer;
+            const uint8_t *block = src8 + slot(st.peer) * bb;
+            if (quantized) {
+                // per-destination meta: each block is its own tensor slice
+                uint8_t *q = qtx.data() + (size_t)st.peer * qb;
+                quant::Meta m = quant::compute_meta(ctx.quant, ctx.q_dtype,
+                                                    ctx.dtype, block,
+                                                    count_per_peer);
+                quant::quantize(m, block, q, count_per_peer);
+                hs.push_back(lt.send_meta(tag | kMetaBit, m.encode()));
+                auto ph = lt.send_async(tag, {q, qb}, ctx.op_seq);
+                hs.insert(hs.end(), ph.begin(), ph.end());
+            } else {
+                auto ph = lt.send_async(tag, {block, bb}, ctx.op_seq);
+                hs.insert(hs.end(), ph.begin(), ph.end());
+            }
+            tx_links.push_back(std::move(lt));
+            ctx.tx_bytes += qb;
+        }
+        for (auto &e : rx_ents) {
+            uint8_t *sink = quantized ? qrx.data() + (size_t)e.peer * qb
+                                      : out + slot(e.peer) * bb;
+            RxSwap swap(ctx, e.link, sched_edge(ctx, e.peer));
+            bool meta_ok = true;
+            bool ok = stream_recv(
+                ctx, e.tag, qb, qsz, sink,
+                [&](const uint8_t *p, size_t lo, size_t hi) {
+                    if (p != sink + lo) memcpy(sink + lo, p, hi - lo);
+                },
+                &prof, /*fill_if_unmapped=*/true);
+            if (ok && quantized) {
+                RxMeta ms;
+                if (fetch_meta(ctx, e.tag | kMetaBit, ms, 0)) {
+                    meta_ok = for_each_meta_span(
+                        ctx, e.tag | kMetaBit, ms, count_per_peer, 0,
+                        count_per_peer,
+                        [&](const quant::Meta &m, size_t a, size_t b) {
+                            quant::dequantize_set(
+                                m, sink + a * qsz,
+                                out + slot(e.peer) * bb + a * esz, b - a);
+                        });
+                } else {
+                    meta_ok = false;
+                }
+            }
+            ctx.rx.table().unregister_sink(e.tag);
+            if (!ok || !meta_ok) return fail(!ctx.rx.alive());
+            ctx.rx_bytes += qb;
+        }
+        bool tx_ok = net::Link::wait_all(hs);
+        hs.clear();
+        purge_all();
+        return finish(tx_ok ? Result::kOk : Result::kConnectionLost);
+    }
+
+    // ---- ring rotation: round r's block rides r store-and-forward hops
+    // over the pinned ring edges (full watchdog ladder applies) ----
+    std::vector<uint8_t> abuf(qb), bbuf(qb);
+    Wd wd;
+    wd_init(wd, ctx);
+    auto fail = [&](bool conn_lost) {
+        net::Link::wait_all(wd.zombies);
+        wd.zombies.clear();
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+        return finish(conn_lost ? Result::kConnectionLost : Result::kAborted);
+    };
+    if (out + slot(rank) * bb != src8 + slot(rank) * bb)
+        memcpy(out + slot(rank) * bb, src8 + slot(rank) * bb, bb);
+    quant::Meta m_cur;
+    for (uint32_t r = 1; r < world; ++r) {
+        const uint32_t dst = (rank + r) % world;
+        const uint8_t *block = src8 + slot(dst) * bb;
+        if (quantized) {
+            m_cur = quant::compute_meta(ctx.quant, ctx.q_dtype, ctx.dtype,
+                                        block, count_per_peer);
+            quant::quantize(m_cur, block, abuf.data(), count_per_peer);
+        } else {
+            memcpy(abuf.data(), block, bb);
+        }
+        for (uint32_t h = 1; h <= r; ++h) {
+            // an escalated earlier hop's zombie still borrows the buffer
+            // about to become this hop's sink — spans must stay valid
+            if (!wd.zombies.empty()) drain_zombies(ctx, wd.zombies);
+            const uint64_t tag =
+                base_tag |
+                (sched::kXferA2A + (r - 1) * world + (h - 1));
+            ctx.rx.table().register_sink(tag, bbuf.data(), qb,
+                                         /*consumer_pull=*/true);
+            std::vector<net::SendHandle> tx_job;
+            if (quantized)
+                // the block's meta travels with it hop by hop
+                // (deterministic re-encode: byte-identical frames)
+                tx_job.push_back(
+                    ctx.tx.send_meta(tag | kMetaBit, m_cur.encode()));
+            if (!(wd.relay_all &&
+                  wd_relay_span(ctx, tag, 0, abuf.data(), qb))) {
+                auto ph = ctx.tx.send_async(tag, {abuf.data(), qb},
+                                            ctx.op_seq);
+                tx_job.insert(tx_job.end(), ph.begin(), ph.end());
+                wd_track(wd, tx_job);
+            }
+            ctx.tx_bytes += qb;
+            RxMeta ms;
+            if (quantized && !fetch_meta(ctx, tag | kMetaBit, ms, 0)) {
+                wd.on ? wd_join(wd, ctx, tx_job)
+                      : net::Link::wait_all(tx_job);
+                return fail(!ctx.rx.alive());
+            }
+            bool ok = stream_recv(
+                ctx, tag, qb, qsz, bbuf.data(),
+                [&](const uint8_t *p, size_t lo, size_t hi) {
+                    if (p != bbuf.data() + lo)
+                        memcpy(bbuf.data() + lo, p, hi - lo);
+                },
+                &prof, /*fill_if_unmapped=*/true, 0, &wd);
+            ctx.rx.table().unregister_sink(tag);
+            bool tx_ok = wd.on ? wd_join(wd, ctx, tx_job)
+                               : net::Link::wait_all(tx_job);
+            if (!ok || !tx_ok)
+                return fail(!ctx.rx.alive() || !ctx.tx.alive());
+            ctx.rx_bytes += qb;
+            if (quantized) m_cur = ms.whole;
+            std::swap(abuf, bbuf);
+        }
+        const uint32_t from = (rank + world - r) % world;
+        if (quantized)
+            quant::dequantize_set(m_cur, abuf.data(), out + slot(from) * bb,
+                                  count_per_peer);
+        else
+            memcpy(out + slot(from) * bb, abuf.data(), bb);
+    }
+    drain_zombies(ctx, wd.zombies);
+    wd_op_clean(wd, ctx);
+    ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+    ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    return finish(Result::kOk);
+}
+
+Result butterfly_allreduce(RingCtx &ctx, const void *send, void *recv,
+                           size_t count) {
+    const uint32_t world = ctx.world;
+    // recursive doubling needs a power-of-two world; algo_valid gates the
+    // planner, but a stale stamp must degrade, not corrupt
+    if (world < 2 || (world & (world - 1)) != 0)
+        return ring_allreduce(ctx, send, recv, count);
+    const size_t esz = proto::dtype_size(ctx.dtype);
+    const uint32_t rank = ctx.rank;
+    auto *out = static_cast<uint8_t *>(recv);
+    const bool quantized = ctx.quant != proto::QuantAlgo::kNone;
+    const size_t qsz = quantized ? proto::dtype_size(ctx.q_dtype) : esz;
+    const size_t wire_b = count * qsz;
+    const uint64_t base_tag = ctx.op_seq << 16;
+
+    // working copy + abort restore (same contract as the ring)
+    std::vector<uint8_t> backup_local;
+    const uint8_t *restore_src;
+    if (send == recv) {
+        if (ctx.backup) {
+            restore_src = ctx.backup;
+        } else {
+            backup_local.assign(out, out + count * esz);
+            restore_src = backup_local.data();
+        }
+    } else {
+        memcpy(out, send, count * esz);
+        restore_src = static_cast<const uint8_t *>(send);
+    }
+
+    std::vector<uint8_t> txb(wire_b), rxb(wire_b);
+    std::vector<net::Link> used;
+    auto fail = [&](bool conn_lost) {
+        for (auto &l : used)
+            l.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+        ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+        memcpy(out, restore_src, count * esz);
+        return conn_lost ? Result::kConnectionLost : Result::kAborted;
+    };
+    auto &rec = telemetry::Recorder::inst();
+    Prof prof;
+    auto op_t0 = now_ns();
+    note_steps(ctx, sched::expand(sched::Coll::kAllReduce,
+                                  sched::Algo::kButterfly, world, rank, 0,
+                                  wire_b)
+                        .size());
+    uint32_t k = 0;
+    for (uint32_t bit = 1; bit < world; bit <<= 1, ++k) {
+        const uint32_t partner = rank ^ bit;
+        const uint64_t tag = base_tag | (sched::kXferFly + k);
+        net::Link lt = sched_link_to(ctx, partner);
+        net::Link lf = sched_link_from(ctx, partner);
+        if (!lt.valid() || !lf.valid()) return fail(true);
+        used.push_back(lt);
+        used.push_back(lf);
+        std::vector<net::SendHandle> hs;
+        if (quantized) {
+            // both partners quantize their partial, exchange, then fold the
+            // SAME two quantized buffers in rank order — bit-identical
+            // results on both sides of every round
+            quant::Meta mine = quant::compute_meta(ctx.quant, ctx.q_dtype,
+                                                   ctx.dtype, out, count);
+            quant::quantize(mine, out, txb.data(), count);
+            lf.table().register_sink(tag, rxb.data(), wire_b,
+                                     /*consumer_pull=*/true);
+            hs.push_back(lt.send_meta(tag | kMetaBit, mine.encode()));
+            auto ph = lt.send_async(tag, {txb.data(), wire_b}, ctx.op_seq);
+            hs.insert(hs.end(), ph.begin(), ph.end());
+            RxMeta ms;
+            bool ok;
+            {
+                RxSwap swap(ctx, lf, sched_edge(ctx, partner));
+                ok = stream_recv(
+                    ctx, tag, wire_b, qsz, rxb.data(),
+                    [&](const uint8_t *p, size_t lo, size_t hi) {
+                        if (p != rxb.data() + lo)
+                            memcpy(rxb.data() + lo, p, hi - lo);
+                    },
+                    &prof, /*fill_if_unmapped=*/true);
+                if (ok && !fetch_meta(ctx, tag | kMetaBit, ms, 0)) ok = false;
+                ctx.rx.table().unregister_sink(tag);
+            }
+            bool tx_ok = net::Link::wait_all(hs);
+            if (!ok || !tx_ok) return fail(!lf.alive() || !lt.alive());
+            const bool low = rank < partner;
+            quant::dequantize_set(low ? mine : ms.whole,
+                                  low ? txb.data() : rxb.data(), out, count);
+            quant::dequantize_accumulate(low ? ms.whole : mine, ctx.op,
+                                         low ? rxb.data() : txb.data(), out,
+                                         count);
+        } else {
+            // x op y is commutative per element: both partners compute the
+            // same fold bit-for-bit without any ordering protocol
+            memcpy(txb.data(), out, wire_b);
+            lf.table().register_sink(tag, rxb.data(), wire_b,
+                                     /*consumer_pull=*/true);
+            auto ph = lt.send_async(tag, {txb.data(), wire_b}, ctx.op_seq);
+            hs.insert(hs.end(), ph.begin(), ph.end());
+            bool ok;
+            {
+                RxSwap swap(ctx, lf, sched_edge(ctx, partner));
+                ok = stream_recv(
+                    ctx, tag, wire_b, esz, rxb.data(),
+                    [&](const uint8_t *p, size_t lo, size_t hi) {
+                        if (p != rxb.data() + lo)
+                            memcpy(rxb.data() + lo, p, hi - lo);
+                    },
+                    &prof, /*fill_if_unmapped=*/true);
+                ctx.rx.table().unregister_sink(tag);
+            }
+            bool tx_ok = net::Link::wait_all(hs);
+            if (!ok || !tx_ok) return fail(!lf.alive() || !lt.alive());
+            kernels::accumulate(ctx.dtype, ctx.op, out, rxb.data(), count);
+        }
+        ctx.tx_bytes += wire_b;
+        ctx.rx_bytes += wire_b;
+    }
+    if (ctx.op == proto::RedOp::kAvg)
+        kernels::finalize_avg(ctx.dtype, out, count, world);
+    for (auto &l : used) l.table().purge_range(base_tag, base_tag + 0x10000);
+    ctx.tx.table().purge_range(base_tag, base_tag + 0x10000);
+    ctx.rx.table().purge_range(base_tag, base_tag + 0x10000);
+    uint64_t op_t1 = now_ns();
+    if (ctx.rx_edge)
+        ctx.rx_edge->stall_ns.fetch_add(prof.wait_ns,
+                                        std::memory_order_relaxed);
+    if (ctx.tele) {
+        ctx.tele->record_op(ctx.op_seq, op_t1 - op_t0, prof.wait_ns);
+        ctx.tele->record_phase(telemetry::Phase::kOp, op_t1 - op_t0);
+        ctx.tele->record_phase(telemetry::Phase::kStall, prof.wait_ns);
+    }
+    if (rec.on())
+        rec.span("collective", "butterfly_allreduce", op_t0, op_t1, "seq",
+                 ctx.op_seq, "bytes", count * esz);
     return Result::kOk;
 }
 

@@ -10,6 +10,7 @@
 #include <memory>
 
 #include "protocol.hpp"
+#include "schedule.hpp"
 #include "sockets.hpp"
 
 namespace pcclt::telemetry {
@@ -84,6 +85,21 @@ struct RingCtx {
     // by sorted peer uuid — ring positions reshuffle across topology
     // rounds, so they cannot define the user-visible segment order)
     std::vector<uint32_t> slots;
+    // ---- synthesized schedules (docs/12) ----
+    // The commence-stamped algorithm + root (ring index: broadcast origin
+    // or relay bottleneck sender). The interpreter executes exactly what
+    // the master stamped — never a local choice, so the group can't split.
+    sched::Algo sched_algo = sched::Algo::kRing;
+    uint32_t sched_root = 0;
+    // kRelayRing and this rank is the bottleneck sender: route the whole
+    // op through the acked relay plane as a PLANNED detour (counted in
+    // sched_relay_planned_bytes, not the watchdog's emergency counters)
+    bool planned_relay = false;
+    // per-ring-index link/counter resolvers for non-neighbor transfers
+    // (tree/butterfly/mesh schedules). Absent → ring-neighbor-only algos.
+    std::function<net::Link(uint32_t)> link_to;
+    std::function<net::Link(uint32_t, int)> link_from;
+    std::function<telemetry::EdgeCounters *(uint32_t)> edge_of;
 };
 
 Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count);
@@ -95,5 +111,37 @@ Result ring_allreduce(RingCtx &ctx, const void *send, void *recv, size_t count);
 // (docs/md/04-API Overview/01_PCCL_API_Overview.md:176-177), so this is a
 // pcclt extension built on the same consensus + tag machinery.
 Result ring_allgather(RingCtx &ctx, const void *send, void *recv, size_t count);
+
+// ---- widened collective vocabulary (docs/12) ----
+
+// Reduce-scatter (SUM): the reduce-scatter half of the ring. `recv`
+// (capacity >= ceil(count/world) elements) gets this rank's fully-reduced
+// chunk; out_offset/out_count (elements, in the global vector) report
+// which chunk that is — chunk ownership follows ring position, which the
+// topology optimizer reshuffles, so the range is an output, not an input.
+Result ring_reduce_scatter(RingCtx &ctx, const void *send, void *recv,
+                           size_t count, uint64_t *out_offset,
+                           uint64_t *out_count);
+
+// Broadcast from ctx.sched_root (ring index), in place in `buf`.
+// ctx.sched_algo picks the chain (kRing: pipelined store-and-forward
+// along ring order) or the star (kTree: root sends to every rank
+// directly). Quantized: the root quantizes ONCE and every rank —
+// including the root, via requantize_self — ends bit-identical.
+Result run_broadcast(RingCtx &ctx, void *buf, size_t count);
+
+// All-to-all: block j of `send` (count_per_peer elements, slots in
+// sorted-uuid order like the all-gather) lands at block `rank-slot` of
+// every peer's `recv`. kMesh sends every block directly over the full
+// p2p mesh; kRing is the rotation baseline (block at ring distance r
+// rides r store-and-forward hops).
+Result run_all_to_all(RingCtx &ctx, const void *send, void *recv,
+                      size_t count_per_peer);
+
+// Recursive-doubling all-reduce (power-of-two worlds, small payloads):
+// log2(world) full-payload exchanges with the round-k partner rank^2^k.
+// Commutative fold order makes results bit-identical across ranks.
+Result butterfly_allreduce(RingCtx &ctx, const void *send, void *recv,
+                           size_t count);
 
 } // namespace pcclt::reduce

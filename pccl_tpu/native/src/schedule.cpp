@@ -1,0 +1,535 @@
+#include "schedule.hpp"
+
+#include <algorithm>
+#include <cstdlib>
+#include <map>
+#include <tuple>
+
+#include "protocol.hpp"
+
+namespace pcclt::sched {
+
+namespace {
+
+// Matrix entries <= 0 are unmeasured edges; price them pessimistically so
+// the planner never routes load-bearing traffic over an edge it has never
+// seen, but keep a floor so a zeroed row cannot divide by zero.
+constexpr double kDefaultMbps = 100.0;
+constexpr double kFloorMbps = 0.1;
+// A relayed span crosses two edges store-and-forward; windows pipeline
+// the two hops, so the effective rate is the detour minimum derated, not
+// halved twice. Matches the PR-10 ladder's observed relay throughput.
+constexpr double kRelayDerate = 0.5;
+// Only prefer the relay when the detour clearly beats the direct edge —
+// the relay peer spends CPU and NIC on someone else's bytes.
+constexpr double kRelayGain = 1.5;
+
+uint64_t env_size(const char *name, uint64_t dflt) {
+    if (const char *e = std::getenv(name)) {
+        long long v = atoll(e);
+        if (v > 0) return static_cast<uint64_t>(v);
+    }
+    return dflt;
+}
+
+size_t chunk_len(uint64_t count, uint32_t world, uint32_t c) {
+    uint64_t base = count / world, rem = count % world;
+    return base + (c < rem ? 1 : 0);
+}
+
+uint64_t chunk_start(uint64_t count, uint32_t world, uint32_t c) {
+    uint64_t base = count / world, rem = count % world;
+    return c * base + std::min<uint64_t>(c, rem);
+}
+
+} // namespace
+
+const char *coll_name(Coll c) {
+    switch (c) {
+    case Coll::kAllReduce: return "allreduce";
+    case Coll::kAllGather: return "allgather";
+    case Coll::kReduceScatter: return "reduce_scatter";
+    case Coll::kBroadcast: return "broadcast";
+    case Coll::kAllToAll: return "alltoall";
+    }
+    return "?";
+}
+
+const char *algo_name(Algo a) {
+    switch (a) {
+    case Algo::kRing: return "ring";
+    case Algo::kTree: return "tree";
+    case Algo::kButterfly: return "butterfly";
+    case Algo::kMesh: return "mesh";
+    case Algo::kRelayRing: return "relay";
+    }
+    return "?";
+}
+
+Coll coll_of(proto::RedOp op) {
+    switch (op) {
+    case proto::RedOp::kGather: return Coll::kAllGather;
+    case proto::RedOp::kReduceScatter: return Coll::kReduceScatter;
+    case proto::RedOp::kBroadcast: return Coll::kBroadcast;
+    case proto::RedOp::kAllToAll: return Coll::kAllToAll;
+    default: return Coll::kAllReduce;
+    }
+}
+
+std::optional<Algo> algo_from_name(const std::string &s) {
+    if (s == "ring") return Algo::kRing;
+    if (s == "tree") return Algo::kTree;
+    if (s == "butterfly") return Algo::kButterfly;
+    if (s == "mesh") return Algo::kMesh;
+    if (s == "relay") return Algo::kRelayRing;
+    return std::nullopt;
+}
+
+uint8_t size_class(uint64_t bytes) {
+    uint64_t small_max = env_size("PCCLT_SCHED_SMALL_MAX", 256ull << 10);
+    uint64_t large_min = env_size("PCCLT_SCHED_LARGE_MIN", 8ull << 20);
+    if (large_min <= small_max) large_min = small_max + 1;
+    if (bytes <= small_max) return 0;
+    if (bytes >= large_min) return 2;
+    return 1;
+}
+
+bool algo_valid(Coll c, Algo a, uint32_t world) {
+    if (world < 2) return a == Algo::kRing;
+    switch (c) {
+    case Coll::kAllReduce:
+        if (a == Algo::kButterfly)
+            return world >= 2 && (world & (world - 1)) == 0;
+        return a == Algo::kRing || a == Algo::kRelayRing;
+    case Coll::kAllGather:
+    case Coll::kReduceScatter:
+        return a == Algo::kRing;
+    case Coll::kBroadcast:
+        return a == Algo::kRing || a == Algo::kTree;
+    case Coll::kAllToAll:
+        // the rotation tag grid is (world-1)*world wide; cap it well under
+        // the 0x8000 meta bit (mesh covers big worlds anyway)
+        return a == Algo::kMesh || (a == Algo::kRing && world <= 64);
+    }
+    return false;
+}
+
+// ---- table codec ----
+
+const Entry *Table::find(Coll c, uint8_t sc) const {
+    for (const auto &e : entries)
+        if (e.coll == static_cast<uint8_t>(c) && e.size_class == sc) return &e;
+    return nullptr;
+}
+
+void Table::encode_to(wire::Writer &w) const {
+    w.u64(version);
+    w.u32(static_cast<uint32_t>(entries.size()));
+    for (const auto &e : entries) {
+        w.u8(e.coll);
+        w.u8(e.size_class);
+        w.u8(e.algo);
+        w.u32(e.root);
+    }
+}
+
+std::optional<Table> Table::decode_from(wire::Reader &r) {
+    Table t;
+    t.version = r.u64();
+    uint32_t n = r.u32();
+    if (n > 4096) return std::nullopt;
+    t.entries.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) {
+        Entry e;
+        e.coll = r.u8();
+        e.size_class = r.u8();
+        e.algo = r.u8();
+        e.root = r.u32();
+        t.entries.push_back(e);
+    }
+    return t;
+}
+
+std::vector<uint8_t> Table::encode() const {
+    wire::Writer w;
+    encode_to(w);
+    return w.take();
+}
+
+std::optional<Table> Table::decode(std::span<const uint8_t> b) {
+    try {
+        wire::Reader r(b);
+        return decode_from(r);
+    } catch (const std::exception &) {
+        return std::nullopt;
+    }
+}
+
+// ---- cost model ----
+
+double CostModel::bw(uint32_t i, uint32_t j) const {
+    double v = 0;
+    if (i < n && j < n && mbps.size() >= static_cast<size_t>(n) * n)
+        v = mbps[static_cast<size_t>(i) * n + j];
+    if (v <= 0) v = kDefaultMbps;
+    return std::max(v, kFloorMbps);
+}
+
+double CostModel::cap(uint32_t i) const {
+    double c = kFloorMbps;
+    for (uint32_t j = 0; j < n; ++j)
+        if (j != i) c = std::max(c, bw(i, j));
+    return c;
+}
+
+double CostModel::t(uint32_t i, uint32_t j, double bytes) const {
+    return bytes * 8.0 / (bw(i, j) * 1e6);
+}
+
+double CostModel::cost(Coll c, Algo a, const std::vector<uint32_t> &ring,
+                       uint32_t root, double bytes) const {
+    const uint32_t w = static_cast<uint32_t>(ring.size());
+    if (w < 2) return 0;
+    auto ring_min = [&] {
+        double m = 1e18;
+        for (uint32_t i = 0; i < w; ++i)
+            m = std::min(m, bw(ring[i], ring[(i + 1) % w]));
+        return m;
+    };
+    // star from `root`: one alpha, the slowest spoke, and the root's NIC
+    // serializing (w-1) copies — per-edge emulation would not charge the
+    // NIC, but physical hubs do and the planner must not be fooled.
+    auto star = [&](uint32_t r, double b) {
+        double slow = 0;
+        for (uint32_t j = 0; j < w; ++j)
+            if (ring[j] != r) slow = std::max(slow, t(r, ring[j], b));
+        double nic = (w - 1) * b * 8.0 / (cap(r) * 1e6);
+        return alpha_s + std::max(slow, nic);
+    };
+    switch (c) {
+    case Coll::kAllReduce: {
+        const double chunk = bytes / w;
+        if (a == Algo::kRing)
+            return 2.0 * (w - 1) * (alpha_s + chunk * 8.0 / (ring_min() * 1e6));
+        if (a == Algo::kRelayRing) {
+            // detour the single worst ring edge via its best third peer
+            double mn = 1e18;
+            uint32_t bi = 0;
+            for (uint32_t i = 0; i < w; ++i) {
+                double e = bw(ring[i], ring[(i + 1) % w]);
+                if (e < mn) { mn = e; bi = i; }
+            }
+            const uint32_t src = ring[bi], dst = ring[(bi + 1) % w];
+            double detour = 0;
+            for (uint32_t k = 0; k < w; ++k) {
+                if (ring[k] == src || ring[k] == dst) continue;
+                detour = std::max(detour,
+                                  std::min(bw(src, ring[k]), bw(ring[k], dst)));
+            }
+            double eff = std::max(mn, kRelayDerate * detour);
+            // second-worst direct edge still bounds the ring
+            double rest = 1e18;
+            for (uint32_t i = 0; i < w; ++i)
+                if (i != bi)
+                    rest = std::min(rest, bw(ring[i], ring[(i + 1) % w]));
+            eff = std::min(eff, rest);
+            return 2.0 * (w - 1) *
+                   (1.5 * alpha_s + chunk * 8.0 / (eff * 1e6));
+        }
+        if (a == Algo::kButterfly) {
+            double worst = 1e18;
+            for (uint32_t bit = 1; bit < w; bit <<= 1)
+                for (uint32_t r = 0; r < w; ++r)
+                    worst = std::min(worst, bw(ring[r], ring[r ^ bit]));
+            uint32_t rounds = 0;
+            for (uint32_t bit = 1; bit < w; bit <<= 1) ++rounds;
+            return rounds * (alpha_s + bytes * 8.0 / (worst * 1e6));
+        }
+        if (a == Algo::kTree)  // fan-in reduce + fan-out bcast (cost only)
+            return star(root, bytes) * 2.0;
+        return 1e18;
+    }
+    case Coll::kAllGather:
+    case Coll::kReduceScatter: {
+        const double chunk = bytes / w;
+        if (a == Algo::kRing)
+            return (w - 1) * (alpha_s + chunk * 8.0 / (ring_min() * 1e6));
+        return 1e18;
+    }
+    case Coll::kBroadcast: {
+        if (a == Algo::kTree) return star(root, bytes);
+        if (a == Algo::kRing) {
+            // pipelined chain from the root along ring order: fill alphas
+            // plus the payload over the slowest chain edge
+            double mn = 1e18;
+            uint32_t rpos = 0;
+            for (uint32_t i = 0; i < w; ++i)
+                if (ring[i] == root) rpos = i;
+            for (uint32_t s = 0; s + 1 < w; ++s)
+                mn = std::min(mn, bw(ring[(rpos + s) % w],
+                                     ring[(rpos + s + 1) % w]));
+            return (w - 1) * alpha_s + bytes * 8.0 / (mn * 1e6);
+        }
+        return 1e18;
+    }
+    case Coll::kAllToAll: {
+        const double block = bytes / w;
+        if (a == Algo::kMesh) {
+            double slow = 0;
+            for (uint32_t i = 0; i < w; ++i) {
+                for (uint32_t j = 0; j < w; ++j)
+                    if (i != j) slow = std::max(slow, t(ring[i], ring[j], block));
+                slow = std::max(slow, (w - 1) * block * 8.0 /
+                                          (cap(ring[i]) * 1e6));
+            }
+            return alpha_s + slow;
+        }
+        if (a == Algo::kRing)
+            // rotation: the block at distance r rides r sequential hops
+            return (static_cast<double>(w) * (w - 1) / 2.0) *
+                   (alpha_s + block * 8.0 / (ring_min() * 1e6));
+        return 1e18;
+    }
+    }
+    return 1e18;
+}
+
+Choice choose(const CostModel &m, Coll c, const std::vector<uint32_t> &ring,
+              uint64_t bytes) {
+    const uint32_t w = static_cast<uint32_t>(ring.size());
+    Choice best{Algo::kRing, 0,
+                m.cost(c, Algo::kRing, ring, ring.empty() ? 0 : ring[0],
+                       static_cast<double>(bytes))};
+    if (!schedule_enabled() || w < 3) return best;
+    if (auto f = forced_algo()) {
+        if (algo_valid(c, *f, w)) {
+            Choice ch{*f, 0, m.cost(c, *f, ring, ring[0],
+                                    static_cast<double>(bytes))};
+            if (*f == Algo::kRelayRing) {
+                double mn = 1e18;
+                for (uint32_t i = 0; i < w; ++i) {
+                    double e = m.bw(ring[i], ring[(i + 1) % w]);
+                    if (e < mn) { mn = e; ch.root = i; }
+                }
+            }
+            return ch;
+        }
+        return best;
+    }
+    auto consider = [&](Algo a, uint32_t root_ring_idx, double cost) {
+        if (cost < best.cost * 0.99) best = Choice{a, root_ring_idx, cost};
+    };
+    const double b = static_cast<double>(bytes);
+    switch (c) {
+    case Coll::kAllReduce: {
+        if (algo_valid(c, Algo::kButterfly, w))
+            consider(Algo::kButterfly, 0,
+                     m.cost(c, Algo::kButterfly, ring, 0, b));
+        double mn = 1e18;
+        uint32_t bi = 0;
+        for (uint32_t i = 0; i < w; ++i) {
+            double e = m.bw(ring[i], ring[(i + 1) % w]);
+            if (e < mn) { mn = e; bi = i; }
+        }
+        double rc = m.cost(c, Algo::kRelayRing, ring, 0, b);
+        if (rc * kRelayGain < best.cost) consider(Algo::kRelayRing, bi, rc);
+        break;
+    }
+    case Coll::kBroadcast: {
+        // the real root is per-op; score each algo averaged over roots
+        double ring_avg = 0, tree_avg = 0;
+        for (uint32_t r = 0; r < w; ++r) {
+            ring_avg += m.cost(c, Algo::kRing, ring, ring[r], b);
+            tree_avg += m.cost(c, Algo::kTree, ring, ring[r], b);
+        }
+        best.cost = ring_avg / w;
+        consider(Algo::kTree, 0, tree_avg / w);
+        break;
+    }
+    case Coll::kAllToAll:
+        consider(Algo::kMesh, 0, m.cost(c, Algo::kMesh, ring, ring[0], b));
+        break;
+    case Coll::kAllGather:
+    case Coll::kReduceScatter:
+        break;  // ring is the only executable schedule today
+    }
+    return best;
+}
+
+Table synthesize(const CostModel &m, const std::vector<uint32_t> &ring,
+                 uint64_t version) {
+    // representative payloads per size class (mid-class, honest defaults)
+    const uint64_t rep[kNumSizeClasses] = {64ull << 10, 2ull << 20,
+                                           32ull << 20};
+    Table t;
+    t.version = version;
+    for (uint8_t c = 0; c < kNumColls; ++c) {
+        for (uint8_t sc = 0; sc < kNumSizeClasses; ++sc) {
+            Choice ch = choose(m, static_cast<Coll>(c), ring, rep[sc]);
+            t.entries.push_back(Entry{c, sc, static_cast<uint8_t>(ch.algo),
+                                      ch.root});
+        }
+    }
+    return t;
+}
+
+// ---- step programs ----
+
+Program expand(Coll c, Algo a, uint32_t n, uint32_t rank, uint32_t root,
+               uint64_t bytes) {
+    Program p;
+    if (n < 2) return p;
+    const uint32_t succ = (rank + 1) % n, pred = (rank + n - 1) % n;
+    switch (c) {
+    case Coll::kBroadcast: {
+        if (a == Algo::kTree) {
+            if (rank == root) {
+                for (uint32_t j = 0; j < n; ++j)
+                    if (j != root)
+                        p.push_back({Step::kSend, j, 0, bytes, kXferBcast + j});
+            } else {
+                p.push_back({Step::kRecv, root, 0, bytes, kXferBcast + rank});
+            }
+        } else {  // chain along the ring from the root
+            const uint32_t d = (rank + n - root) % n;
+            if (d > 0)
+                p.push_back({static_cast<uint8_t>(d + 1 < n ? Step::kRecvForward
+                                                            : Step::kRecv),
+                             pred, 0, bytes, kXferBcast + d - 1});
+            if (d + 1 < n)
+                p.push_back({Step::kSend, succ, 0, bytes, kXferBcast + d});
+        }
+        break;
+    }
+    case Coll::kAllToAll: {
+        const uint64_t b = bytes / n;  // bytes = total per-rank payload
+        if (a == Algo::kMesh) {
+            p.push_back({Step::kCopy, rank, rank * b, b, 0});
+            for (uint32_t j = 0; j < n; ++j)
+                if (j != rank)
+                    p.push_back({Step::kSend, j, j * b, b, kXferA2A + rank});
+            for (uint32_t i = 0; i < n; ++i)
+                if (i != rank)
+                    p.push_back({Step::kRecv, i, i * b, b, kXferA2A + i});
+        } else {  // rotation: round r's block rides r sequential ring hops
+            p.push_back({Step::kCopy, rank, rank * b, b, 0});
+            for (uint32_t r = 1; r < n; ++r) {
+                for (uint32_t h = 1; h <= r; ++h) {
+                    const uint32_t x = kXferA2A + (r - 1) * n + (h - 1);
+                    p.push_back({Step::kSend, succ, 0, b, x});
+                    p.push_back({static_cast<uint8_t>(
+                                     h < r ? Step::kRecvForward : Step::kRecv),
+                                 pred, 0, b, x});
+                }
+            }
+        }
+        break;
+    }
+    case Coll::kAllReduce: {
+        if (a == Algo::kButterfly) {
+            uint32_t k = 0;
+            for (uint32_t bit = 1; bit < n; bit <<= 1, ++k) {
+                const uint32_t partner = rank ^ bit;
+                p.push_back({Step::kSend, partner, 0, bytes, kXferFly + k});
+                p.push_back({Step::kRecvReduce, partner, 0, bytes,
+                             kXferFly + k});
+            }
+            break;
+        }
+        // ring / relay-ring: reduce-scatter stages then all-gather stages,
+        // the same tag grid ring_allreduce drives (0x0000.. / 0x4000..)
+        const uint64_t cnt = bytes;  // treat as element-granular bytes
+        for (uint32_t s = 0; s + 1 < n; ++s) {
+            const uint32_t sc_ = (rank + n - s) % n;
+            const uint32_t rc_ = (rank + n - s - 1) % n;
+            p.push_back({Step::kSend, succ, chunk_start(cnt, n, sc_),
+                         chunk_len(cnt, n, sc_), s});
+            p.push_back({Step::kRecvReduce, pred, chunk_start(cnt, n, rc_),
+                         chunk_len(cnt, n, rc_), s});
+        }
+        for (uint32_t s = 0; s + 1 < n; ++s) {
+            const uint32_t sc_ = (rank + 1 + n - s) % n;
+            const uint32_t rc_ = (rank + n - s) % n;
+            p.push_back({Step::kSend, succ, chunk_start(cnt, n, sc_),
+                         chunk_len(cnt, n, sc_), 0x4000u + s});
+            p.push_back({Step::kRecv, pred, chunk_start(cnt, n, rc_),
+                         chunk_len(cnt, n, rc_), 0x4000u + s});
+        }
+        break;
+    }
+    case Coll::kReduceScatter: {
+        const uint64_t cnt = bytes;
+        for (uint32_t s = 0; s + 1 < n; ++s) {
+            const uint32_t sc_ = (rank + n - s) % n;
+            const uint32_t rc_ = (rank + n - s - 1) % n;
+            p.push_back({Step::kSend, succ, chunk_start(cnt, n, sc_),
+                         chunk_len(cnt, n, sc_), s});
+            p.push_back({Step::kRecvReduce, pred, chunk_start(cnt, n, rc_),
+                         chunk_len(cnt, n, rc_), s});
+        }
+        break;
+    }
+    case Coll::kAllGather: {
+        const uint64_t seg = bytes;
+        for (uint32_t s = 0; s + 1 < n; ++s) {
+            const uint32_t fwd = (rank + n - s) % n;
+            const uint32_t src = (rank + n - s - 1) % n;
+            p.push_back({Step::kSend, succ, fwd * seg, seg, s});
+            p.push_back({static_cast<uint8_t>(s + 2 < n ? Step::kRecvForward
+                                                        : Step::kRecv),
+                         pred, src * seg, seg, s});
+        }
+        break;
+    }
+    }
+    return p;
+}
+
+bool conserve(Coll c, Algo a, uint32_t n, uint32_t root, uint64_t bytes,
+              std::string *err) {
+    auto fail = [&](const std::string &m) {
+        if (err) *err = m;
+        return false;
+    };
+    // (src, dst, xfer) -> bytes, matched exactly once each way
+    std::map<std::tuple<uint32_t, uint32_t, uint32_t>, uint64_t> sends, recvs;
+    uint64_t sent = 0, received = 0;
+    for (uint32_t r = 0; r < n; ++r) {
+        for (const auto &s : expand(c, a, n, r, root, bytes)) {
+            if (s.kind == Step::kSend) {
+                auto key = std::make_tuple(r, s.peer, s.xfer);
+                if (sends.count(key)) return fail("duplicate send key");
+                sends[key] = s.bytes;
+                sent += s.bytes;
+            } else if (s.kind != Step::kCopy) {
+                auto key = std::make_tuple(s.peer, r, s.xfer);
+                if (recvs.count(key)) return fail("duplicate recv key");
+                recvs[key] = s.bytes;
+                received += s.bytes;
+            }
+        }
+    }
+    if (sent != received) return fail("sent != received");
+    if (sends.size() != recvs.size()) return fail("unpaired transfers");
+    for (const auto &[key, b] : sends) {
+        auto it = recvs.find(key);
+        if (it == recvs.end()) return fail("send without matching recv");
+        if (it->second != b) return fail("send/recv byte mismatch");
+    }
+    return true;
+}
+
+// ---- env knobs ----
+
+bool schedule_enabled() {
+    const char *e = std::getenv("PCCLT_SCHEDULE");
+    return !(e && e[0] == '0');
+}
+
+std::optional<Algo> forced_algo() {
+    const char *e = std::getenv("PCCLT_SCHEDULE_FORCE");
+    if (!e || !e[0]) return std::nullopt;
+    return algo_from_name(e);
+}
+
+} // namespace pcclt::sched

@@ -1,0 +1,173 @@
+// Collective schedule synthesizer (docs/12).
+// The master already measures a full bandwidth matrix but only used it to
+// solve ATSP for ring ORDER; on hub-and-spoke and two-datacenter maps the
+// ring itself is the wrong algorithm. This planner costs candidate
+// schedules — ATSP ring, bandwidth-weighted tree (star fan-out),
+// recursive-doubling butterfly, direct mesh, and a multi-hop relay ring
+// over the acked kRelayFwd routes — with an alpha-beta model parameterized
+// from the measured matrix, and emits an explicit per-rank step program
+// (send/recv/reduce/forward addressed by peer + byte range). The master
+// picks and versions one entry per (collective, size-class) at
+// optimize-topology time; clients execute the stamped algorithm through
+// the step interpreter in reduce.cpp.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <span>
+#include <string>
+#include <vector>
+
+#include "wire.hpp"
+
+namespace pcclt::proto {
+enum class RedOp : uint8_t;  // protocol.hpp (avoid the heavy include here)
+}
+
+namespace pcclt::sched {
+
+// Collective kinds the interpreter speaks. Values are wire-stable.
+enum class Coll : uint8_t {
+    kAllReduce = 0,
+    kAllGather = 1,
+    kReduceScatter = 2,
+    kBroadcast = 3,
+    kAllToAll = 4,
+};
+inline constexpr uint8_t kNumColls = 5;
+
+// Candidate algorithms. Values are wire-stable (stamped on the commence).
+enum class Algo : uint8_t {
+    kRing = 0,       // ATSP ring (chain for broadcast, rotation for a2a)
+    kTree = 1,       // bandwidth-weighted star from a root
+    kButterfly = 2,  // recursive doubling (power-of-two worlds)
+    kMesh = 3,       // direct pairwise sends (all-to-all)
+    kRelayRing = 4,  // ring with the bottleneck edge detoured via kRelayFwd
+};
+
+const char *coll_name(Coll c);
+const char *algo_name(Algo a);
+std::optional<Algo> algo_from_name(const std::string &s);
+
+// The RedOp doubles as the collective-kind marker for the widened
+// vocabulary (kGather/kReduceScatter/kBroadcast/kAllToAll, docs/12);
+// arithmetic ops are plain all-reduces.
+Coll coll_of(proto::RedOp op);
+
+// ---- size classes ----
+// 0 = small (latency-bound), 1 = medium, 2 = large (bandwidth-bound).
+// Thresholds: PCCLT_SCHED_SMALL_MAX (default 256 KiB) and
+// PCCLT_SCHED_LARGE_MIN (default 8 MiB), re-read per call so tests can
+// flip them at runtime.
+inline constexpr uint8_t kNumSizeClasses = 3;
+uint8_t size_class(uint64_t bytes);
+
+// Which (collective, algorithm) pairs the interpreter can execute for a
+// given world size. The cost model will price inexecutable combinations
+// (e.g. tree all-reduce) for planner sanity tests, but choose() and the
+// master only ever stamp executable ones.
+bool algo_valid(Coll c, Algo a, uint32_t world);
+
+// ---- versioned schedule table (wire format, journaled) ----
+struct Entry {
+    uint8_t coll = 0;        // Coll
+    uint8_t size_class = 0;  // 0..kNumSizeClasses-1
+    uint8_t algo = 0;        // Algo
+    uint32_t root = 0;       // kRelayRing: ring index of the detouring
+                             // sender; unused otherwise (broadcast roots
+                             // are per-op, stamped from the user's slot)
+};
+
+struct Table {
+    uint64_t version = 0;
+    std::vector<Entry> entries;
+
+    bool empty() const { return entries.empty(); }
+    const Entry *find(Coll c, uint8_t sc) const;
+
+    void encode_to(wire::Writer &w) const;
+    static std::optional<Table> decode_from(wire::Reader &r);
+    std::vector<uint8_t> encode() const;
+    static std::optional<Table> decode(std::span<const uint8_t> b);
+};
+
+// ---- alpha-beta cost model ----
+// mbps is an n*n row-major matrix (src row, dst col); entries <= 0 mean
+// unmeasured and fall back to a conservative default. Per-node egress
+// serialization is modeled through cap(): a star root pushing (n-1)
+// copies shares its NIC even when per-edge emulation would not.
+struct CostModel {
+    uint32_t n = 0;
+    std::vector<double> mbps;
+    double alpha_s = 1e-3;  // per-transfer setup latency (seconds)
+
+    double bw(uint32_t i, uint32_t j) const;   // mbps, floored
+    double cap(uint32_t i) const;              // max outgoing edge (mbps)
+    // seconds to move `bytes` over edge i->j, excluding alpha
+    double t(uint32_t i, uint32_t j, double bytes) const;
+    // total seconds for one collective of `bytes` payload per rank over
+    // ring order `ring` (ring position -> matrix index). root is a matrix
+    // index (broadcast origin / relay bottleneck), ignored where unused.
+    double cost(Coll c, Algo a, const std::vector<uint32_t> &ring,
+                uint32_t root, double bytes) const;
+};
+
+struct Choice {
+    Algo algo = Algo::kRing;
+    uint32_t root = 0;  // ring index (kRelayRing bottleneck sender)
+    double cost = 0;
+};
+
+// Best executable algorithm for one (collective, payload). Broadcast is
+// scored averaged over all candidate roots (the actual root is per-op).
+// PCCLT_SCHEDULE_FORCE overrides when the forced algo is executable;
+// PCCLT_SCHEDULE=0 pins everything to the ring.
+Choice choose(const CostModel &m, Coll c, const std::vector<uint32_t> &ring,
+              uint64_t bytes);
+
+// Full table: one entry per (collective, size-class), costed at a
+// representative payload for the class.
+Table synthesize(const CostModel &m, const std::vector<uint32_t> &ring,
+                 uint64_t version);
+
+// ---- per-rank step programs ----
+// Steps address peers by RING index and payloads by byte range in the
+// collective's address space. The interpreter in reduce.cpp executes
+// these; conserve() proves every byte sent is received exactly once.
+struct Step {
+    enum Kind : uint8_t {
+        kSend = 0,        // send [off, off+bytes) to peer as transfer xfer
+        kRecv = 1,        // receive xfer from peer into [off, off+bytes)
+        kRecvReduce = 2,  // receive and fold into the accumulator
+        kRecvForward = 3, // receive and forward windows to the next hop
+        kCopy = 4,        // local move (peer == self)
+    };
+    uint8_t kind = 0;
+    uint32_t peer = 0;
+    uint64_t off = 0;
+    uint64_t bytes = 0;
+    uint32_t xfer = 0;  // low tag bits; unique per transfer within the op
+};
+using Program = std::vector<Step>;
+
+// Wire-tag bases for synthesized transfers; disjoint from the ring
+// all-reduce's stage grid (0x0000/0x4000) and below kMetaBit (0x8000).
+inline constexpr uint32_t kXferBcast = 0x0010;
+inline constexpr uint32_t kXferA2A = 0x0600;
+inline constexpr uint32_t kXferFly = 0x0700;
+
+Program expand(Coll c, Algo a, uint32_t n, uint32_t rank, uint32_t root,
+               uint64_t bytes);
+
+// Cross-rank conservation: expand() for every rank, then require every
+// send to pair with exactly one matching receive (same endpoints, xfer,
+// byte count) and vice versa. err (optional) gets a human-readable
+// reason on failure.
+bool conserve(Coll c, Algo a, uint32_t n, uint32_t root, uint64_t bytes,
+              std::string *err = nullptr);
+
+// ---- env knobs (docs/03) ----
+bool schedule_enabled();            // PCCLT_SCHEDULE != 0 (default on)
+std::optional<Algo> forced_algo();  // PCCLT_SCHEDULE_FORCE
+
+} // namespace pcclt::sched

@@ -22,6 +22,7 @@
 #include "kernels.hpp"
 #include "master.hpp"
 #include "quantize.hpp"
+#include "schedule.hpp"
 #include "ss_chunk.hpp"
 #include "telemetry.hpp"
 #include "wire.hpp"
@@ -1815,6 +1816,144 @@ static void test_atsp() {
     CHECK(atsp::hamiltonian(h, 4, 5e5, 100).empty());
 }
 
+// Schedule synthesizer planner (schedule.hpp, docs/12): the alpha-beta
+// model must rank algorithms the way the physics does, and every step
+// program the planner can emit must conserve bytes across ranks.
+static void test_schedule_planner() {
+    using namespace sched;
+    // choose() honors the kill switch / force overrides; the planner units
+    // pin a known env so a PCCLT_SCHEDULE=0 selftest run (the forced-off
+    // acceptance leg) still exercises the cost model deterministically
+    const char *env_sched = std::getenv("PCCLT_SCHEDULE");
+    const char *env_force = std::getenv("PCCLT_SCHEDULE_FORCE");
+    std::string saved_sched = env_sched ? env_sched : "";
+    std::string saved_force = env_force ? env_force : "";
+    setenv("PCCLT_SCHEDULE", "1", 1);
+    unsetenv("PCCLT_SCHEDULE_FORCE");
+    const std::vector<uint32_t> ring4{0, 1, 2, 3};
+    const uint64_t big = 64ull << 20, tiny = 1024;
+
+    // uniform matrix: a large all-reduce is bandwidth-bound -> ring's
+    // 2(n-1)/n byte factor beats the tree's root-serialized fan-in/out
+    CostModel uni;
+    uni.n = 4;
+    uni.mbps.assign(16, 100.0);
+    CHECK(uni.cost(Coll::kAllReduce, Algo::kRing, ring4, 0, double(big)) <
+          uni.cost(Coll::kAllReduce, Algo::kTree, ring4, 0, double(big)));
+    CHECK(choose(uni, Coll::kAllReduce, ring4, big).algo == Algo::kRing);
+    // ...but a tiny all-reduce is alpha-bound: butterfly's log2(n) rounds
+    // beat the ring's 2(n-1) sequential steps on a power-of-two world
+    CHECK(choose(uni, Coll::kAllReduce, ring4, tiny).algo ==
+          Algo::kButterfly);
+
+    // hub-and-spoke: node 0 has fat links, spoke<->spoke crawls. The ring
+    // must cross slow spoke edges; a hub-rooted tree never does.
+    CostModel hub;
+    hub.n = 4;
+    hub.mbps.assign(16, 10.0);
+    for (uint32_t i = 1; i < 4; ++i) {
+        hub.mbps[0 * 4 + i] = 1000.0;
+        hub.mbps[i * 4 + 0] = 1000.0;
+    }
+    CHECK(hub.cost(Coll::kBroadcast, Algo::kTree, ring4, 0, double(big)) <
+          hub.cost(Coll::kBroadcast, Algo::kRing, ring4, 0, double(big)));
+    CHECK(choose(hub, Coll::kBroadcast, ring4, big).algo == Algo::kTree);
+
+    // one rotten ring edge with healthy detours -> relay ring wins the
+    // all-reduce (world 6 keeps butterfly out of the candidate set)
+    CostModel rot;
+    rot.n = 6;
+    rot.mbps.assign(36, 100.0);
+    rot.mbps[1 * 6 + 2] = 1.0;
+    std::vector<uint32_t> ring6{0, 1, 2, 3, 4, 5};
+    auto rc = choose(rot, Coll::kAllReduce, ring6, big);
+    CHECK(rc.algo == Algo::kRelayRing);
+    CHECK(rc.root == 1);  // the detouring sender is the bottleneck's tail
+    CHECK(rc.cost < rot.cost(Coll::kAllReduce, Algo::kRing, ring6, 0,
+                             double(big)));
+
+    // synthesize(): one entry per (coll, size-class), all executable, and
+    // the table survives its wire round-trip bit-for-bit
+    Table t = synthesize(hub, ring4, 7);
+    CHECK(t.version == 7);
+    CHECK(t.entries.size() == size_t(kNumColls) * kNumSizeClasses);
+    for (const auto &e : t.entries)
+        CHECK(algo_valid(static_cast<Coll>(e.coll),
+                         static_cast<Algo>(e.algo), 4));
+    auto rt = Table::decode(t.encode());
+    CHECK(rt && rt->version == t.version &&
+          rt->entries.size() == t.entries.size());
+    const Entry *fe = t.find(Coll::kBroadcast, 2);
+    CHECK(fe && fe->algo == static_cast<uint8_t>(Algo::kTree));
+
+    // default size-class thresholds (docs/03)
+    CHECK(size_class(4 * 1024) == 0);
+    CHECK(size_class(1ull << 20) == 1);
+    CHECK(size_class(32ull << 20) == 2);
+
+    // byte conservation: every (coll, algo, world) the interpreter may be
+    // asked to run, including odd worlds and non-divisible payloads —
+    // every sent range must pair with exactly one matching receive
+    for (uint32_t n : {2u, 3u, 4u, 5u, 8u}) {
+        for (uint8_t ci = 0; ci < kNumColls; ++ci) {
+            auto c = static_cast<Coll>(ci);
+            for (Algo a : {Algo::kRing, Algo::kTree, Algo::kButterfly,
+                           Algo::kMesh, Algo::kRelayRing}) {
+                if (!algo_valid(c, a, n)) continue;
+                for (uint64_t bytes : {uint64_t(64), uint64_t(4099),
+                                       uint64_t(1) << 20}) {
+                    const uint32_t root =
+                        (c == Coll::kBroadcast || a == Algo::kRelayRing)
+                            ? (n - 1) % n : 0;
+                    std::string err;
+                    if (!conserve(c, a, n, root, bytes, &err)) {
+                        fprintf(stderr,
+                                "conserve %s/%s n=%u b=%llu: %s\n",
+                                coll_name(c), algo_name(a), n,
+                                (unsigned long long)bytes, err.c_str());
+                        CHECK(false);
+                    }
+                }
+            }
+        }
+    }
+    if (env_sched) setenv("PCCLT_SCHEDULE", saved_sched.c_str(), 1);
+    else unsetenv("PCCLT_SCHEDULE");
+    if (env_force) setenv("PCCLT_SCHEDULE_FORCE", saved_force.c_str(), 1);
+}
+
+// PCCLT_WIRE_CHAOS_MAP must arm on FIRST USE of an edge, not at env-parse
+// time: synthesized tree/butterfly/mesh schedules dial edges the ring
+// never touched, and a chaos plane that armed only already-resolved
+// neighbors would silently exempt exactly the paths the synthesizer adds
+// (docs/12). Registry::resolve() owns that guarantee — pin it.
+static void test_chaos_late_arm() {
+    using namespace net::netem;
+    setenv("PCCLT_WIRE_CHAOS_MAP", "127.0.0.1:45611=blackhole@t=9s:10ms", 1);
+    Registry::inst().refresh();
+    auto st0 = chaos_stats();
+    // an unrelated endpoint resolving must not arm the mapped schedule
+    auto other = net::Addr::parse("127.0.0.1", 45613);
+    CHECK(other.has_value());
+    (void)Registry::inst().resolve(*other);
+    CHECK(chaos_stats().armed == st0.armed);
+    // the first (arbitrarily late) resolve of the mapped endpoint arms it
+    auto a = net::Addr::parse("127.0.0.1", 45611);
+    CHECK(a.has_value());
+    auto e1 = Registry::inst().resolve(*a);
+    CHECK(e1 != nullptr);
+    CHECK(chaos_stats().armed == st0.armed + 1);
+    CHECK(e1->pace_enabled());  // armed chaos counts as emulation
+    // refresh + re-resolve keep the SAME edge and never re-arm: a mid-run
+    // env re-read must not restart a timeline peers already live through
+    Registry::inst().refresh();
+    auto e2 = Registry::inst().resolve(*a);
+    CHECK(e2.get() == e1.get());
+    CHECK(chaos_stats().armed == st0.armed + 1);
+    unsetenv("PCCLT_WIRE_CHAOS_MAP");
+    Registry::inst().refresh();
+}
+
 // ---- end-to-end: master + N clients, fp32 ring allreduce + shared state ----
 
 // Port base below the kernel ephemeral range (32768-60999): an in-range
@@ -2180,6 +2319,170 @@ static void test_e2e_abort_mid_ring() {
     mm.join();
 }
 
+// Widened collective vocabulary + schedule-stamped algorithms end-to-end
+// (docs/12): broadcast / reduce-scatter / all-to-all against closed-form
+// expectations, with the synthesizer optionally FORCED onto a non-ring
+// algorithm (nullptr = leave PCCLT_SCHEDULE_FORCE unset). Every payload
+// size is deliberately not divisible by the world.
+static void test_e2e_sched(size_t world, const char *force) {
+    if (force) setenv("PCCLT_SCHEDULE_FORCE", force, 1);
+    uint16_t port = alloc_test_ports(512);
+    master::Master mm(port);
+    CHECK(mm.launch());
+    uint16_t base = static_cast<uint16_t>(port + 16);
+    port = mm.port();
+
+    const size_t count = 2053;
+    std::vector<std::thread> threads;
+    std::atomic<int> ok_count{0};
+    std::atomic<uint64_t> nonring_ops{0};
+
+    for (size_t r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+            client::Client cl(peer_cfg(port, base, r));
+            if (cl.connect() != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: connect failed\n", r);
+                return;
+            }
+            if (!wait_world(cl, world)) return;
+            uint64_t slot = ~0ull;
+            if (cl.gather_slot(&slot) != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: gather_slot failed\n", r);
+                return;
+            }
+            client::ReduceInfo info;
+
+            // all-reduce (force=butterfly runs the halving/doubling path)
+            std::vector<float> x(count), y(count, -1.0f);
+            for (size_t i = 0; i < count; ++i)
+                x[i] = static_cast<float>(i % 89) + static_cast<float>(r);
+            client::ReduceDesc ar;
+            ar.tag = 11;
+            auto st = cl.all_reduce(x.data(), y.data(), count,
+                                    proto::DType::kF32, ar, &info);
+            if (st != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: sched allreduce st=%d\n", r,
+                        int(st));
+                return;
+            }
+            for (size_t i = 0; i < count; ++i) {
+                double expect = world * double(i % 89) +
+                                world * (world - 1) / 2.0;
+                if (std::abs(double(y[i]) - expect) > 1e-3) {
+                    fprintf(stderr, "peer %zu: ar y[%zu]=%f expect %f\n", r,
+                            i, y[i], expect);
+                    return;
+                }
+            }
+
+            // broadcast from slot 0, in place; non-roots start poisoned
+            std::vector<float> b(count);
+            for (size_t i = 0; i < count; ++i)
+                b[i] = slot == 0 ? static_cast<float>(i % 53 + 1)
+                                 : -7.0f;
+            client::ReduceDesc bd;
+            bd.tag = 12;
+            bd.op = proto::RedOp::kBroadcast;
+            bd.aux = 0;
+            st = cl.all_reduce(b.data(), b.data(), count, proto::DType::kF32,
+                               bd, &info);
+            if (st != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: broadcast st=%d\n", r, int(st));
+                return;
+            }
+            for (size_t i = 0; i < count; ++i)
+                if (b[i] != static_cast<float>(i % 53 + 1)) {
+                    fprintf(stderr, "peer %zu: bc b[%zu]=%f\n", r, i, b[i]);
+                    return;
+                }
+
+            // reduce-scatter: chunk contents checked against rs_offset
+            std::vector<float> rs(count);
+            for (size_t i = 0; i < count; ++i)
+                rs[i] = static_cast<float>(i % 31) + static_cast<float>(r);
+            const size_t cap = (count + world - 1) / world;
+            std::vector<float> chunk(cap, -1.0f);
+            client::ReduceDesc rd;
+            rd.tag = 13;
+            rd.op = proto::RedOp::kReduceScatter;
+            rd.recv_capacity = cap;
+            st = cl.all_reduce(rs.data(), chunk.data(), count,
+                               proto::DType::kF32, rd, &info);
+            if (st != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: reduce-scatter st=%d\n", r,
+                        int(st));
+                return;
+            }
+            if (info.rs_count == 0 || info.rs_count > cap ||
+                info.rs_offset + info.rs_count > count) {
+                fprintf(stderr, "peer %zu: rs chunk [%llu,+%llu) bad\n", r,
+                        (unsigned long long)info.rs_offset,
+                        (unsigned long long)info.rs_count);
+                return;
+            }
+            for (size_t k = 0; k < info.rs_count; ++k) {
+                double expect = world * double((info.rs_offset + k) % 31) +
+                                world * (world - 1) / 2.0;
+                if (std::abs(double(chunk[k]) - expect) > 1e-3) {
+                    fprintf(stderr, "peer %zu: rs chunk[%zu]=%f expect %f\n",
+                            r, k, chunk[k], expect);
+                    return;
+                }
+            }
+
+            // all-to-all: block j carries (my_slot, j); block i of the
+            // result must carry (i, my_slot)
+            const size_t per = 37;
+            std::vector<float> a2s(per * world), a2r(per * world, -1.0f);
+            for (size_t j = 0; j < world; ++j)
+                for (size_t i = 0; i < per; ++i)
+                    a2s[j * per + i] =
+                        static_cast<float>(slot * 100 + j) +
+                        static_cast<float>(i % 5) * 0.125f;
+            client::ReduceDesc ad;
+            ad.tag = 14;
+            ad.op = proto::RedOp::kAllToAll;
+            ad.recv_capacity = per * world;
+            st = cl.all_reduce(a2s.data(), a2r.data(), per,
+                               proto::DType::kF32, ad, &info);
+            if (st != client::Status::kOk) {
+                fprintf(stderr, "peer %zu: all-to-all st=%d\n", r, int(st));
+                return;
+            }
+            for (size_t i = 0; i < world; ++i)
+                for (size_t k = 0; k < per; ++k) {
+                    float expect = static_cast<float>(i * 100 + slot) +
+                                   static_cast<float>(k % 5) * 0.125f;
+                    if (a2r[i * per + k] != expect) {
+                        fprintf(stderr,
+                                "peer %zu: a2a [%zu][%zu]=%f expect %f\n", r,
+                                i, k, a2r[i * per + k], expect);
+                        return;
+                    }
+                }
+
+            auto &cc = cl.tele().comm;
+            nonring_ops.fetch_add(cc.sched_ops_tree.load() +
+                                  cc.sched_ops_butterfly.load() +
+                                  cc.sched_ops_mesh.load() +
+                                  cc.sched_ops_relay.load());
+            ok_count.fetch_add(1);
+            cl.disconnect();
+        });
+    }
+    for (auto &t : threads) t.join();
+    CHECK(ok_count.load() == static_cast<int>(world));
+    // a forced non-ring algorithm must actually have run somewhere (the
+    // force only binds where (coll, algo, world) is executable, but every
+    // force used here has at least one executable collective). With the
+    // kill switch thrown (PCCLT_SCHEDULE=0 acceptance leg) the force is
+    // ignored and everything above ran — correctly — over the ring.
+    if (force && sched::schedule_enabled()) CHECK(nonring_ops.load() > 0);
+    if (force) unsetenv("PCCLT_SCHEDULE_FORCE");
+    mm.interrupt();
+    mm.join();
+}
+
 int main() {
     test_lock_annotations();
     test_telemetry();
@@ -2200,6 +2503,8 @@ int main() {
     test_master_ha_state();
     test_op_done_replay();
     test_atsp();
+    test_schedule_planner();
+    test_chaos_late_arm();
     {
         // guarded allocator: bytes usable end-to-end, balanced live count
         size_t live0 = pcclt::galloc::live_count();
@@ -2240,6 +2545,20 @@ int main() {
            g_failures ? "FAIL" : "ok");
     test_e2e_abort_mid_ring();
     printf("e2e world=3 abort mid-ring: %s\n", g_failures ? "FAIL" : "ok");
+    test_e2e_sched(3, "tree");
+    printf("e2e world=3 sched (tree broadcast + new collectives): %s\n",
+           g_failures ? "FAIL" : "ok");
+    if (!fast_mode()) {
+        test_e2e_sched(4, "butterfly");
+        printf("e2e world=4 sched (butterfly allreduce): %s\n",
+               g_failures ? "FAIL" : "ok");
+        test_e2e_sched(4, "mesh");
+        printf("e2e world=4 sched (mesh all-to-all): %s\n",
+               g_failures ? "FAIL" : "ok");
+        test_e2e_sched(2, nullptr);
+        printf("e2e world=2 sched (synthesizer default-on): %s\n",
+               g_failures ? "FAIL" : "ok");
+    }
     if (g_failures) {
         printf("SELFTEST FAILED (%d)\n", g_failures);
         return 1;

@@ -266,6 +266,18 @@ struct CommCounters {
     std::atomic<uint64_t> ss_seeder_promotions{0};     // keys promoted mid-round
     std::atomic<uint64_t> ss_seeders_lost{0};          // sources lost mid-fetch
     std::atomic<uint64_t> ss_legacy_syncs{0};          // fell back to 1-seeder path
+    // ---- synthesized schedules (docs/12) ----
+    // Ops executed per stamped algorithm, interpreter steps executed, and
+    // PLANNED relay bytes (kRelayRing detours) — kept separate from the
+    // watchdog ladder's emergency wd_relays/rx_relay_bytes so dashboards
+    // can tell a chosen detour from a failover.
+    std::atomic<uint64_t> sched_ops_ring{0};
+    std::atomic<uint64_t> sched_ops_tree{0};
+    std::atomic<uint64_t> sched_ops_butterfly{0};
+    std::atomic<uint64_t> sched_ops_mesh{0};
+    std::atomic<uint64_t> sched_ops_relay{0};
+    std::atomic<uint64_t> sched_steps{0};
+    std::atomic<uint64_t> sched_relay_planned_bytes{0};
 };
 
 struct EdgeSnapshot {

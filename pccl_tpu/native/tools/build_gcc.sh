@@ -14,7 +14,7 @@ mkdir -p $OUT/obj
 # old ABI and the linked library silently misbehaves
 NEWEST_HDR=$(ls -t $SRC/*.hpp include/*.h 2>/dev/null | head -1)
 objs=""
-for f in log telemetry guarded_alloc wire shm sockets uring netem protocol journal hash hash_clmul ss_chunk kernels kernels_avx2 quantize bandwidth atsp benchmark master_state master client reduce api; do
+for f in log telemetry guarded_alloc wire shm sockets uring netem protocol journal hash hash_clmul ss_chunk kernels kernels_avx2 quantize bandwidth atsp schedule benchmark master_state master client reduce api; do
   [ -f $SRC/$f.cpp ] || continue
   arch=""
   [ "$f" = kernels_avx2 ] && arch="-mavx2"

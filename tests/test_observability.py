@@ -660,6 +660,62 @@ def test_incident_bundle_on_watchdog_confirm(tmp_path):
         (d / "trace_critic_report.json").write_text(json.dumps(report))
 
 
+def test_schedule_gauges_on_scrape():
+    """Schedule synthesizer fleet introspection (docs/12): after an
+    optimize round synthesizes a schedule table for the group, /metrics
+    must carry pcclt_schedule_version{group} and one
+    pcclt_schedule_kind{group,coll,size_class,algo} series per
+    (collective, size-class) cell — promlint-gated like every family."""
+    from pccl_tpu.comm import MasterNode
+
+    world = 2
+    port_base = alloc_ports(span=2300)
+    os.environ["PCCLT_MASTER_METRICS_PORT"] = "0"
+    master = MasterNode("0.0.0.0", alloc_ports())
+    try:
+        master.run()
+        mp = master.metrics_port
+        peers = [_ObsPeer(master.port, r, world, port_base,
+                          {"PCCLT_BENCH_SECONDS": "0.4",
+                           "PCCLT_BENCH_CONNECTIONS": "1"},
+                          push_ms=150, count=1 << 16, iters=2,
+                          optimize=True, hold=True)
+                 for r in range(world)]
+        try:
+            for p in peers:
+                p.wait_stats()
+            version = {}
+            kinds = {}
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                prom = _scrape(mp)
+                version = _prom_samples(prom, "pcclt_schedule_version")
+                kinds = _prom_samples(prom, "pcclt_schedule_kind")
+                if version and kinds:
+                    break
+                time.sleep(0.3)
+            assert version, "pcclt_schedule_version never appeared"
+            assert all(v >= 1 for v in version.values()), version
+            # one cell per (collective, size-class): 5 colls x 3 classes
+            assert len(kinds) == 15, sorted(kinds)
+            assert all(v == 1 for v in kinds.values()), kinds
+            colls = {dict(k).get("coll") for k in kinds}
+            assert colls == {"allreduce", "allgather", "reduce_scatter",
+                             "broadcast", "alltoall"}, colls
+            algos = {dict(k).get("algo") for k in kinds}
+            assert algos <= {"ring", "tree", "butterfly", "mesh",
+                             "relay"}, algos
+        finally:
+            for p in peers:
+                p.release()
+        for i, p in enumerate(peers):
+            assert p.join() == 0, f"peer {i} failed"
+    finally:
+        os.environ.pop("PCCLT_MASTER_METRICS_PORT", None)
+        master.interrupt()
+        master.destroy()
+
+
 def test_straggler_flag_on_netem_degraded_edge():
     """Straggler detection: bandwidth probes (bench ports, un-emulated)
     fill the matrix with fast loopback numbers; the p2p data plane is

@@ -1,0 +1,434 @@
+"""E2E tests for the collective schedule synthesizer + widened collective
+vocabulary (docs/12_schedule_synthesis.md).
+
+Covers: reduce_scatter / broadcast / all_to_all bit-exactness against numpy
+through the Python API (fp32 with integer-valued payloads, so ring-order
+fp32 folds are exact), quantized variants within quantization tolerance,
+PCCLT_SCHEDULE_FORCE driving each non-ring algorithm end to end with the
+per-algorithm telemetry counters proving which path ran, byte conservation
+across the group, and (slow) chaos-map survival with results bit-identical
+to an undisturbed ring run.
+
+Real master + N client threads on loopback — never network mocks."""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+LIB = REPO / "pccl_tpu" / "native" / "build" / "libpcclt.so"
+pytestmark = pytest.mark.skipif(not LIB.exists(), reason="native lib not built")
+
+from conftest import alloc_ports  # noqa: E402
+
+
+def _ports(n=1):
+    return alloc_ports(64 * n)
+
+
+@pytest.fixture
+def master():
+    from pccl_tpu.comm import MasterNode
+
+    m = MasterNode("0.0.0.0", _ports())
+    m.run()
+    yield m
+    m.interrupt()
+    m.destroy()
+
+
+def _run_peers(master_port, world, worker, base):
+    """world client threads; each runs worker(comm, rank)."""
+    from pccl_tpu.comm import Communicator
+
+    errors = []
+
+    def peer(rank):
+        comm = Communicator("127.0.0.1", master_port,
+                            p2p_port=base + rank * 8,
+                            ss_port=base + 512 + rank * 8,
+                            bench_port=base + 1024 + rank * 8)
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.world_size < world:
+                if time.time() > deadline:
+                    raise TimeoutError(f"rank {rank}: world never {world}")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"peers still running (wedged?): {hung}"
+    assert not errors, f"peer failures: {errors}"
+
+
+def _slot_data(slot: int, count: int, seed: int = 0) -> np.ndarray:
+    """Deterministic integer-valued fp32 payload per slot: group sums stay
+    exactly representable, so ring/tree/butterfly fold order is invisible."""
+    rng = np.random.default_rng(1000 * seed + slot)
+    return rng.integers(0, 512, count).astype(np.float32)
+
+
+# ---------------------------------------------------------------- broadcast
+
+@pytest.mark.parametrize("world,root", [(2, 0), (3, 0), (4, 3)])
+def test_broadcast_bit_exact(master, world, root):
+    """Every peer ends bit-identical to the root slot's buffer, non-roots
+    starting from poison; count not divisible by world."""
+    count = 4099
+    barrier = threading.Barrier(world)
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        buf = (_slot_data(root, count) if slot == root
+               else np.full(count, -7.0, dtype=np.float32))
+        info = comm.broadcast(buf, root=root, tag=5)
+        assert info.world_size == world
+        assert np.array_equal(buf, _slot_data(root, count))
+        barrier.wait(timeout=60)
+
+    _run_peers(master.port, world, worker, _ports(6))
+
+
+def test_broadcast_solo(master):
+    def worker(comm, rank):
+        buf = np.arange(5, dtype=np.float32)
+        info = comm.broadcast(buf, root=0)
+        assert info.world_size == 1
+        assert np.array_equal(buf, np.arange(5, dtype=np.float32))
+
+    _run_peers(master.port, 1, worker, _ports(4))
+
+
+# ----------------------------------------------------------- reduce-scatter
+
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_reduce_scatter_bit_exact(master, world):
+    """Each peer's chunk equals the numpy group sum at [offset, offset+n);
+    the chunks tile the full vector exactly once; tx == rx group-wide."""
+    count = 2053
+    total = np.sum([_slot_data(s, count, seed=2) for s in range(world)],
+                   axis=0, dtype=np.float32)
+    results = {}
+    infos = {}
+    lock = threading.Lock()
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        chunk, off, info = comm.reduce_scatter(
+            _slot_data(slot, count, seed=2), tag=6)
+        assert info.world_size == world
+        assert np.array_equal(chunk, total[off:off + chunk.size])
+        with lock:
+            results[rank] = (off, chunk.size)
+            infos[rank] = info
+
+    _run_peers(master.port, world, worker, _ports(6))
+    # the chunks tile [0, count) exactly (no gap, no overlap)
+    spans = sorted(results.values())
+    assert spans[0][0] == 0
+    assert sum(n for _, n in spans) == count
+    for (o1, n1), (o2, _) in zip(spans, spans[1:]):
+        assert o1 + n1 == o2, spans
+    # conservation: every byte sent was received exactly once
+    assert sum(i.tx_bytes for i in infos.values()) == \
+        sum(i.rx_bytes for i in infos.values())
+
+
+def test_reduce_scatter_quantized(master):
+    """Min-max quantized wire format: within quantization tolerance."""
+    from pccl_tpu.comm import QuantizationAlgorithm
+
+    world, count = 3, 1024
+    total = np.sum([_slot_data(s, count, seed=3) for s in range(world)],
+                   axis=0, dtype=np.float32)
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        chunk, off, info = comm.reduce_scatter(
+            _slot_data(slot, count, seed=3), tag=7,
+            quantization=QuantizationAlgorithm.MIN_MAX)
+        assert info.world_size == world
+        np.testing.assert_allclose(chunk, total[off:off + chunk.size],
+                                   atol=1.5 * world * 2.0)
+
+    _run_peers(master.port, world, worker, _ports(6))
+
+
+# --------------------------------------------------------------- all-to-all
+
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_all_to_all_bit_exact(master, world):
+    """recv block i must be exactly the block peer (slot i) addressed to
+    this peer's slot: recv_j[i] == send_i[j] group-wide, bit-for-bit."""
+    per = 193
+    lock = threading.Lock()
+    infos = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        send = np.concatenate(
+            [_slot_data(slot * world + j, per, seed=4)
+             for j in range(world)])
+        recv, info = comm.all_to_all(send, tag=8)
+        assert info.world_size == world
+        for i in range(world):
+            expect = _slot_data(i * world + slot, per, seed=4)
+            assert np.array_equal(recv[i * per:(i + 1) * per], expect), \
+                f"slot {slot}: block from {i} wrong"
+        with lock:
+            infos[rank] = info
+
+    _run_peers(master.port, world, worker, _ports(6))
+    assert sum(i.tx_bytes for i in infos.values()) == \
+        sum(i.rx_bytes for i in infos.values())
+
+
+def test_all_to_all_quantized(master):
+    from pccl_tpu.comm import QuantizationAlgorithm
+
+    world, per = 3, 256
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        send = np.concatenate(
+            [_slot_data(slot * world + j, per, seed=5)
+             for j in range(world)])
+        recv, info = comm.all_to_all(
+            send, tag=9, quantization=QuantizationAlgorithm.MIN_MAX)
+        assert info.world_size == world
+        for i in range(world):
+            expect = _slot_data(i * world + slot, per, seed=5)
+            np.testing.assert_allclose(recv[i * per:(i + 1) * per], expect,
+                                       atol=4.0)
+
+    _run_peers(master.port, world, worker, _ports(6))
+
+
+# ------------------------------------------------- forced non-ring programs
+
+def _sched_counters(comm):
+    c = comm.stats()["counters"]
+    return {k: v for k, v in c.items() if k.startswith("sched_")}
+
+
+def test_forced_tree_broadcast_matches_ring(master, monkeypatch):
+    """PCCLT_SCHEDULE_FORCE=tree: the star program delivers the identical
+    bytes the ring chain would, and sched_ops_tree proves the tree ran."""
+    monkeypatch.setenv("PCCLT_SCHEDULE_FORCE", "tree")
+    world, count = 3, 8191
+    lock = threading.Lock()
+    counters = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        buf = (_slot_data(1, count, seed=6) if slot == 1
+               else np.zeros(count, dtype=np.float32))
+        comm.broadcast(buf, root=1, tag=10)
+        assert np.array_equal(buf, _slot_data(1, count, seed=6))
+        with lock:
+            counters[rank] = _sched_counters(comm)
+
+    _run_peers(master.port, world, worker, _ports(6))
+    assert sum(c["sched_ops_tree"] for c in counters.values()) == world
+    assert all(c["sched_steps"] > 0 for c in counters.values()), counters
+
+
+def test_forced_butterfly_allreduce_exact(master, monkeypatch):
+    """PCCLT_SCHEDULE_FORCE=butterfly on a power-of-two world: the
+    halving/doubling program sums exactly (integer-valued fp32) and the
+    butterfly counter proves the stamped algorithm actually executed."""
+    monkeypatch.setenv("PCCLT_SCHEDULE_FORCE", "butterfly")
+    world, count = 4, 4099
+    total = np.sum([_slot_data(s, count, seed=7) for s in range(world)],
+                   axis=0, dtype=np.float32)
+    lock = threading.Lock()
+    counters = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        buf = _slot_data(slot, count, seed=7).copy()
+        comm.all_reduce(buf, tag=11)
+        assert np.array_equal(buf, total)
+        with lock:
+            counters[rank] = _sched_counters(comm)
+
+    _run_peers(master.port, world, worker, _ports(6))
+    assert sum(c["sched_ops_butterfly"] for c in counters.values()) == world
+
+
+def test_forced_mesh_all_to_all(master, monkeypatch):
+    """PCCLT_SCHEDULE_FORCE=mesh: direct pairwise exchange, same bytes."""
+    monkeypatch.setenv("PCCLT_SCHEDULE_FORCE", "mesh")
+    world, per = 3, 128
+    lock = threading.Lock()
+    counters = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        send = np.concatenate(
+            [_slot_data(slot * world + j, per, seed=8)
+             for j in range(world)])
+        recv, _ = comm.all_to_all(send, tag=12)
+        for i in range(world):
+            assert np.array_equal(
+                recv[i * per:(i + 1) * per],
+                _slot_data(i * world + slot, per, seed=8))
+        with lock:
+            counters[rank] = _sched_counters(comm)
+
+    _run_peers(master.port, world, worker, _ports(6))
+    assert sum(c["sched_ops_mesh"] for c in counters.values()) == world
+
+
+def test_schedule_off_pins_ring(master, monkeypatch):
+    """PCCLT_SCHEDULE=0 ignores any table/force: only the ring counter
+    moves (kill switch, docs/12)."""
+    monkeypatch.setenv("PCCLT_SCHEDULE", "0")
+    monkeypatch.setenv("PCCLT_SCHEDULE_FORCE", "tree")
+    world, count = 2, 1024
+    lock = threading.Lock()
+    counters = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        buf = (_slot_data(0, count, seed=9) if slot == 0
+               else np.zeros(count, dtype=np.float32))
+        comm.broadcast(buf, root=0, tag=13)
+        assert np.array_equal(buf, _slot_data(0, count, seed=9))
+        with lock:
+            counters[rank] = _sched_counters(comm)
+
+    _run_peers(master.port, world, worker, _ports(6))
+    assert sum(c["sched_ops_tree"] for c in counters.values()) == 0
+    assert sum(c["sched_ops_ring"] for c in counters.values()) == world
+
+
+# -------------------------------------------------------- chaos + degrade
+
+@pytest.mark.slow
+def test_tree_broadcast_survives_chaos_map(master, monkeypatch):
+    """Acceptance: a PCCLT_WIRE_CHAOS_MAP armed on a tree (non-ring) edge
+    — flap + degrade — must not abort or kick anyone, and every peer's
+    result stays bit-identical to the root's buffer (which IS the ring
+    result: broadcast is algorithm-invariant)."""
+    world, count = 4, 1 << 18
+    base = _ports(8)
+    # chaos on every peer's p2p endpoint: whichever edges the tree dials
+    # (root fan-out is not knowable up front — slot->rank mapping is the
+    # master's) are guaranteed covered, including never-ringed ones
+    eps = [f"127.0.0.1:{base + r * 8}" for r in range(world)]
+    chaos = ",".join(f"{ep}=degrade@t=0s:80mbit/3s;flap@t=1s:60msx3"
+                     for ep in eps)
+    monkeypatch.setenv("PCCLT_WIRE_CHAOS_MAP", chaos)
+    monkeypatch.setenv("PCCLT_WIRE_MBPS", "800")
+    monkeypatch.setenv("PCCLT_SCHEDULE_FORCE", "tree")
+    monkeypatch.setenv("PCCLT_WATCHDOG", "1")
+    lock = threading.Lock()
+    counters = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        for it in range(3):
+            buf = (_slot_data(2, count, seed=20 + it) if slot == 2
+                   else np.zeros(count, dtype=np.float32))
+            comm.broadcast(buf, root=2, tag=14 + it)
+            assert np.array_equal(buf, _slot_data(2, count, seed=20 + it)), \
+                f"iteration {it} diverged under chaos"
+        with lock:
+            counters[rank] = comm.stats()["counters"]
+
+    _run_peers(master.port, world, worker, base)
+    assert sum(c["collectives_aborted"] for c in counters.values()) == 0, \
+        counters
+    assert sum(c["sched_ops_tree"] for c in counters.values()) == 3 * world
+
+
+@pytest.mark.slow
+def test_butterfly_survives_mid_collective_degrade(master, monkeypatch):
+    """Mid-collective netem degrade on a butterfly exchange partner with
+    the watchdog armed: the op completes exactly (integer-valued fp32),
+    nobody is kicked, and later iterations keep succeeding."""
+    from pccl_tpu.comm import netem_inject
+
+    world, count = 4, 1 << 18
+    base = _ports(8)
+    monkeypatch.setenv("PCCLT_WIRE_MBPS", "600")
+    monkeypatch.setenv("PCCLT_SCHEDULE_FORCE", "butterfly")
+    monkeypatch.setenv("PCCLT_WATCHDOG", "1")
+    total = {it: np.sum([_slot_data(s, count, seed=30 + it)
+                         for s in range(world)], axis=0, dtype=np.float32)
+             for it in range(3)}
+    lock = threading.Lock()
+    counters = {}
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        for it in range(3):
+            if it == 1 and rank == 0:
+                # degrade OUR busiest live edge mid-run (slot->endpoint
+                # mapping is discovered from stats, like chaos_peer.py)
+                edges = comm.stats()["edges"]
+                if edges:
+                    victim = max(edges.items(),
+                                 key=lambda kv: kv[1]["tx_bytes"])[0]
+                    netem_inject(victim, "degrade@t=0s:40mbit/4s")
+            buf = _slot_data(slot, count, seed=30 + it).copy()
+            comm.all_reduce(buf, tag=17 + it)
+            assert np.array_equal(buf, total[it]), f"iteration {it} wrong"
+        with lock:
+            counters[rank] = comm.stats()["counters"]
+
+    _run_peers(master.port, world, worker, base)
+    assert sum(c["collectives_aborted"] for c in counters.values()) == 0
+    assert sum(c["sched_ops_butterfly"] for c in counters.values()) == \
+        3 * world
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("world", [8])
+def test_new_collectives_world8(master, world):
+    """The full widened vocabulary at world 8 (butterfly-eligible), one
+    pass each, bit-exact."""
+    count = 4096 + 56  # divisible by 8 for a2a blocks after // world
+    per = count // world
+    total = np.sum([_slot_data(s, count, seed=40) for s in range(world)],
+                   axis=0, dtype=np.float32)
+
+    def worker(comm, rank):
+        slot = comm.gather_slot
+        buf = (_slot_data(0, count, seed=41) if slot == 0
+               else np.zeros(count, dtype=np.float32))
+        comm.broadcast(buf, root=0, tag=30)
+        assert np.array_equal(buf, _slot_data(0, count, seed=41))
+
+        chunk, off, info = comm.reduce_scatter(
+            _slot_data(slot, count, seed=40), tag=31)
+        assert np.array_equal(chunk, total[off:off + chunk.size])
+
+        send = np.concatenate([_slot_data(slot * world + j, per, seed=42)
+                               for j in range(world)])
+        recv, _ = comm.all_to_all(send, tag=32)
+        for i in range(world):
+            assert np.array_equal(
+                recv[i * per:(i + 1) * per],
+                _slot_data(i * world + slot, per, seed=42))
+
+    _run_peers(master.port, world, worker, _ports(10))

@@ -68,13 +68,19 @@ MASTER_DISPATCH = {
 # (on_sync_key_done mutates only the round's promotion dedupe set). The
 # model's on_sync_key_done is a no-op and the client model never consumes
 # the update; conformance pins both ids to their real sites.
+# kM2CScheduleUpdate (schedule synthesizer, docs/12) is the same
+# fire-and-forget class: per-op algorithm binding rides the commence stamp,
+# so a late or lost update can never split the group — the broadcast is
+# version-gated introspection/telemetry only. The model never emits it and
+# the client model never consumes it; conformance pins the id to its
+# emission site (check_optimize) and the client's set_notify consumption.
 MASTER_EMITS = {
     "kM2CWelcome", "kM2CSessionResumeAck", "kM2CPeersPendingReply",
     "kM2CP2PConnInfo", "kM2CP2PEstablishedResp", "kM2CTopologyDeferred",
     "kM2CCollectiveCommence", "kM2CCollectiveAbort", "kM2CCollectiveDone",
     "kM2CSharedStateSyncResp", "kM2CSharedStateDone",
     "kM2COptimizeResponse", "kM2COptimizeComplete", "kM2CKicked",
-    "kM2CIncidentDump", "kM2CSeederUpdate",
+    "kM2CIncidentDump", "kM2CSeederUpdate", "kM2CScheduleUpdate",
 }
 
 # kM2C ids the client session FSM consumes (client.cpp recv_match sites)
