@@ -227,6 +227,14 @@ def main() -> int:
                          "--sync-churn (models a per-NIC bottleneck so "
                          "multi-source fetch genuinely multiplies "
                          "bandwidth)")
+    ap.add_argument("--sync-degrade-mbit", type=float, default=2.0,
+                    help="--sync-churn schedules a seeder-edge DEGRADE to "
+                         "this rate on peer 0's egress bucket from 1/3 "
+                         "duration (the joiner flood) through the seeder "
+                         "kill, so its serves toward recovering joiners "
+                         "stall and the serve-side watchdog SUSPECT rung "
+                         "fires in every soak, not only when a peer "
+                         "happens to die mid-serve (0 = no degrade)")
     args = ap.parse_args()
 
     if args.metrics_port is not None:
@@ -262,9 +270,28 @@ def main() -> int:
     if args.sync_churn > 0:
         sync_args = ["--sync-state", str(args.sync_churn)]
         base_env = {"PCCLT_SS_CHUNK_BYTES": str(args.sync_chunk_bytes),
-                    "PCCLT_WIRE_MBPS_MAP": f"127.0.0.1={args.sync_mbps}"}
+                    "PCCLT_WIRE_MBPS_MAP": f"127.0.0.1={args.sync_mbps}",
+                    "PCCLT_WATCHDOG": "1"}
         for i in range(args.peers):
             chaos_env.setdefault(i, {}).update(base_env)
+        if args.sync_degrade_mbit > 0:
+            # scheduled seeder-edge degrade (docs/04): peer 0 — flood-proof,
+            # so always up-to-date and in the seeder directory — has its
+            # per-process egress bucket degraded from the joiner flood
+            # (1/3 duration) through past the seeder kill. Its serves
+            # toward the recovering joiners then stall past the watchdog
+            # deadline and the SUSPECT rung fires in every soak; the
+            # joiners' deadline re-source rescues the chunks from the
+            # healthy seeders, so the round still completes.
+            t0 = max(1, int(args.duration / 3))
+            dur = max(8, int(args.duration / 2))
+            spec = (f"127.0.0.1=degrade@t={t0}s:"
+                    f"{args.sync_degrade_mbit:g}mbit/{dur}s")
+            # a raw --chaos map owns the schedule; the degrade only rides
+            # when the operator did not script their own
+            chaos_env[0].setdefault("PCCLT_WIRE_CHAOS_MAP", spec)
+            print(f"sync-churn: scheduled seeder-edge degrade on peer 0 "
+                  f"({spec})", flush=True)
         for i in range(args.peers):
             chaos_args.setdefault(i, []).extend(sync_args)
 
@@ -318,10 +345,15 @@ def main() -> int:
     chaos_acc = {"faults_armed": 0, "faults_activated": 0, "failovers": 0,
                  "relays": 0, "relay_forwarded": 0, "dup_bytes": 0,
                  "suspects": 0, "confirms": 0, "aborted": 0}
-    # churn-sync accounting (docs/04), folded the same way
+    # churn-sync accounting (docs/04), folded the same way. Since the chunk
+    # plane rides the pooled p2p conns, the per-edge stripe/watchdog/relay
+    # counters now cover sync bytes too — fold them into the summary so the
+    # CI lane can gate on "the hardened transport actually engaged".
     sync_acc = {"chunks_fetched": 0, "chunks_resourced": 0, "chunks_dup": 0,
                 "promotions": 0, "seeder_deaths_survived": 0,
-                "legacy_syncs": 0, "syncs_ok": 0, "syncs_failed": 0}
+                "legacy_syncs": 0, "syncs_ok": 0, "syncs_failed": 0,
+                "stripe_windows": 0, "stripe_bytes": 0, "suspects": 0,
+                "relays": 0, "relay_bytes": 0, "aborted": 0}
     sync_events = {"floods": 0, "seeder_kills": 0, "wrong": 0}
 
     def fold_sync(stats: dict) -> None:
@@ -334,6 +366,13 @@ def main() -> int:
         sync_acc["legacy_syncs"] += c.get("ss_legacy_syncs", 0)
         sync_acc["syncs_ok"] += c.get("syncs_ok", 0)
         sync_acc["syncs_failed"] += c.get("syncs_failed", 0)
+        sync_acc["aborted"] += c.get("collectives_aborted", 0)
+        for e in (stats.get("edges", {}) if stats else {}).values():
+            sync_acc["stripe_windows"] += e.get("tx_stripe_windows", 0)
+            sync_acc["stripe_bytes"] += e.get("tx_stripe_bytes", 0)
+            sync_acc["suspects"] += e.get("wd_suspects", 0)
+            sync_acc["relays"] += e.get("wd_relays", 0)
+            sync_acc["relay_bytes"] += e.get("rx_relay_bytes", 0)
 
     def fold_chaos(stats: dict) -> None:
         if not stats:
@@ -529,10 +568,16 @@ def main() -> int:
                   f"legacy_syncs={sync_acc['legacy_syncs']} "
                   f"syncs_ok={sync_acc['syncs_ok']} "
                   f"syncs_failed={sync_acc['syncs_failed']} "
+                  f"stripe_windows={sync_acc['stripe_windows']} "
+                  f"stripe_bytes={sync_acc['stripe_bytes']} "
+                  f"suspects={sync_acc['suspects']} "
+                  f"relays={sync_acc['relays']} "
+                  f"relay_bytes={sync_acc['relay_bytes']} "
                   f"floods={sync_events['floods']} "
                   f"seeder_kills={sync_events['seeder_kills']} "
                   f"wrong={sync_events['wrong']} "
-                  f"aborted={live_failed}", flush=True)
+                  f"aborted={live_failed} "
+                  f"collective_aborts={sync_acc['aborted']}", flush=True)
             if sync_events["wrong"] > 0:
                 print("SYNC FAILED: bit-wrong shared-state adoption",
                       flush=True)
@@ -546,6 +591,27 @@ def main() -> int:
                 return 1
             if sync_events["floods"] == 0 or sync_events["seeder_kills"] == 0:
                 print("SYNC FAILED: churn schedule never fired", flush=True)
+                return 1
+            if sync_acc["syncs_failed"] > 0:
+                # folded across every peer life: a sync round must never
+                # FAIL under scheduled churn — the chunk plane re-sources
+                # around deaths and degrades (collective_aborts is NOT
+                # gated: SIGKILLing a peer mid-allreduce legitimately
+                # aborts the in-flight op, which survivors then retry)
+                print("SYNC FAILED: sync rounds failed under churn",
+                      flush=True)
+                return 1
+            if args.sync_degrade_mbit > 0 and sync_acc["suspects"] == 0:
+                # the degrade exists to prove the serve-side watchdog sees
+                # sync traffic; a soak where it never tripped proves nothing
+                print("SYNC FAILED: scheduled seeder-edge degrade never "
+                      "tripped the watchdog", flush=True)
+                return 1
+            import os as _os
+            if int(_os.environ.get("PCCLT_STRIPE_CONNS", "1")) > 1 \
+                    and sync_acc["stripe_bytes"] == 0:
+                print("SYNC FAILED: stripe conns requested but no sync "
+                      "bytes were striped", flush=True)
                 return 1
         if args.fleet_scale > 0:
             fleet_stop.set()

@@ -176,6 +176,10 @@ class CommStats(ctypes.Structure):
         ("sched_ops_relay", ctypes.c_uint64),
         ("sched_steps", ctypes.c_uint64),
         ("sched_relay_planned_bytes", ctypes.c_uint64),
+        # sparse revision delta (docs/04): chunks never fetched because the
+        # request-time local leaf already matched the expected leaf
+        ("ss_chunks_delta_skipped", ctypes.c_uint64),
+        ("ss_chunk_bytes_delta_skipped", ctypes.c_uint64),
     ]
 
 

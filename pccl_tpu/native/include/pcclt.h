@@ -446,6 +446,12 @@ typedef struct pccltCommStats_t {
     uint64_t sched_ops_relay;
     uint64_t sched_steps;
     uint64_t sched_relay_planned_bytes;
+    /* sparse revision delta (docs/04): chunks skipped because the
+     * request-time local leaf hash already matched the expected leaf.
+     * Extends the conservation identity: unique delivered bytes +
+     * ss_chunk_bytes_delta_skipped == total dirty-key bytes. */
+    uint64_t ss_chunks_delta_skipped;
+    uint64_t ss_chunk_bytes_delta_skipped;
 } pccltCommStats_t;
 
 typedef struct pccltEdgeStats_t {

@@ -499,6 +499,8 @@ pccltResult_t pccltCommGetStats(pccltComm_t *c, pccltCommStats_t *out) {
     out->sched_ops_relay = ld(m.sched_ops_relay);
     out->sched_steps = ld(m.sched_steps);
     out->sched_relay_planned_bytes = ld(m.sched_relay_planned_bytes);
+    out->ss_chunks_delta_skipped = ld(m.ss_chunks_delta_skipped);
+    out->ss_chunk_bytes_delta_skipped = ld(m.ss_chunk_bytes_delta_skipped);
     return pccltSuccess;
 }
 

@@ -391,6 +391,385 @@ bool Client::ss_serve_chunk(net::Socket &sock, const net::Frame &req) {
     return true;
 }
 
+// ---------------- pooled chunk serve (docs/04 unified transport) ----------
+
+// RX threads land kChunkReq here; they must never do window/materialize/
+// striped-send work inline (that would head-of-line-block every tag
+// multiplexed on the same conn), so requests queue to a small serve pool.
+void Client::chunk_req_enqueue(const uint8_t *requester_uuid, uint64_t tag,
+                               std::vector<uint8_t> spec) {
+    ChunkServeReq req;
+    memcpy(req.requester.data(), requester_uuid, 16);
+    req.tag = tag;
+    req.spec = std::move(spec);
+    MutexLock lk(chunk_mu_);
+    if (chunk_stop_) return;  // tearing down: the fetcher re-sources
+    if (chunk_threads_.empty()) {
+        int n = std::max(1, env_int("PCCLT_SS_SERVE_THREADS", 4));
+        for (int i = 0; i < n; ++i)
+            chunk_threads_.emplace_back([this] { chunk_serve_loop(); });
+    }
+    chunk_queue_.push_back(std::move(req));
+    chunk_cv_.notify_one();
+}
+
+void Client::chunk_serve_loop() {
+    while (true) {
+        ChunkServeReq req;
+        {
+            MutexLock lk(chunk_mu_);
+            // opportunistic zombie reaping: a parked serve's buffer is
+            // freed the moment its last handle drains (or its conn dies);
+            // a relay delivery ack retires the stalled direct copy early
+            // at the next frame boundary (same idiom as drain_zombies)
+            for (auto zit = chunk_zombies_.begin();
+                 zit != chunk_zombies_.end();) {
+                bool all_done = true;
+                for (auto &h : zit->hs) {
+                    if (!h) continue;
+                    if (!h->done()) {
+                        all_done = false;
+                        if (!h->cancel.load(std::memory_order_relaxed) &&
+                            relay_ack_covered(h->tag, h->off,
+                                              h->span.size())) {
+                            h->cancel.store(true, std::memory_order_relaxed);
+                            tele_->comm.relay_retired_early.fetch_add(
+                                1, std::memory_order_relaxed);
+                        }
+                    }
+                }
+                if (all_done) zit = chunk_zombies_.erase(zit);
+                else ++zit;
+            }
+            while (chunk_queue_.empty() && !chunk_stop_)
+                chunk_cv_.wait_for(chunk_mu_, std::chrono::milliseconds(250));
+            if (chunk_stop_) return;
+            if (chunk_queue_.empty()) continue;
+            req = std::move(chunk_queue_.front());
+            chunk_queue_.pop_front();
+        }
+        chunk_serve_pooled(req.requester, req.tag, req.spec);
+    }
+}
+
+// Serve one chunk range over the pooled data plane: header via kChunkHdr,
+// payload as striped kData windows into the requester's registered sink —
+// the exact transport the collectives ride, so the bytes inherit per-lane
+// wire emulation, the per-flow cwnd model, zerocopy TX, and (below) the
+// same three-stage watchdog failover ladder.
+void Client::chunk_serve_pooled(const proto::Uuid &requester, uint64_t tag,
+                                const std::vector<uint8_t> &spec) {
+    uint64_t revision = 0, cb = 0;
+    std::string key;
+    uint32_t first = 0, count = 0;
+    int status = 0;
+    try {
+        wire::Reader r(spec);
+        revision = r.u64();
+        key = r.str();
+        cb = r.u64();
+        first = r.u32();
+        count = r.u32();
+    } catch (...) { status = 2; }
+
+    // the reverse route: header + payload ride OUR tx pool toward the
+    // requester, landing in the rx table where its fetch worker registered
+    // the sink. Edge accounting keys by the requester's canonical
+    // data-plane endpoint — the same edge the collectives and the chaos
+    // map use (the sync-byte attribution fix rides on this convergence).
+    net::Link txl = tx_link(requester);
+    std::shared_ptr<net::MultiplexConn> hdr_conn;
+    std::string canon_key;
+    {
+        MutexLock lk(state_mu_);
+        auto it = peers_.find(requester);
+        if (it != peers_.end()) {
+            net::Addr canon = it->second.ep.ip;
+            canon.port = it->second.ep.p2p_port;
+            canon_key = canon.str();
+            for (const auto &c : it->second.tx)
+                if (c && c->alive()) { hdr_conn = c; break; }
+        }
+    }
+    // no route back: drop silently — the fetcher's chunk budget expires
+    // and it re-sources from another seeder (normal churn behavior)
+    if (!hdr_conn || !txl.valid()) return;
+
+    SharedStateEntry e;
+    if (status == 0) {
+        MutexLock lk(dist_mu_);
+        if (!dist_open_ || revision != dist_revision_) {
+            status = 1;
+        } else {
+            auto it = dist_entries_.find(key);
+            if (it == dist_entries_.end()) status = 2;
+            else if (!dist_servable_.count(key)) status = 1;
+            else e = it->second;
+        }
+    }
+    uint64_t nbytes = status == 0 ? e.count * proto::dtype_size(e.dtype) : 0;
+    if (status == 0) {
+        uint32_t nchunks = ssc::chunk_count(nbytes, cb);
+        if (cb == 0 || cb > (64ull << 20) || count == 0 || first >= nchunks ||
+            count > nchunks - first)
+            status = 2;
+    }
+    uint64_t payload = 0;
+    for (uint32_t i = 0; status == 0 && i < count; ++i)
+        payload += ssc::chunk_len(nbytes, cb, first + i);
+
+    wire::Writer hw;
+    hw.u8(static_cast<uint8_t>(status));
+    hw.u64(payload);
+    hdr_conn->send_owned(net::MultiplexConn::kChunkHdr, tag, 0, hw.take());
+    if (status != 0) return;
+
+    if (e.materialize && e.mat_once) {
+        // materialize writes the app's buffer — serving-guarded
+        if (!ss_serve_enter(revision, key)) return;
+        std::call_once(*e.mat_once, e.materialize, e.materialize_ctx);
+        ss_serve_exit();
+    }
+
+    // Copy the range into OWNED scratch under serving-guard slices: the
+    // striped async sends (and any copy parked behind a relay detour)
+    // must never read app memory after ss_close_window returns — the
+    // guard only covers this copy, not the send lifetime.
+    auto buf = std::make_shared<std::vector<uint8_t>>(payload);
+    const auto *base = static_cast<const uint8_t *>(e.data);
+    const uint64_t src0 = static_cast<uint64_t>(first) * cb;
+    for (uint64_t off = 0; off < payload;) {
+        uint64_t n = std::min<uint64_t>(payload - off, 1u << 20);
+        // window closed mid-copy: the header promised bytes we can no
+        // longer read — stop; the fetcher's budget expires + re-sources
+        if (!ss_serve_enter(revision, key)) return;
+        memcpy(buf->data() + off, base + src0 + off, n);
+        ss_serve_exit();
+        off += n;
+    }
+
+    // count BEFORE the sends complete: the requester can finish its round
+    // the instant the last byte lands, and the distributor reads
+    // dist_tx_bytes_ right after Done — a post-send increment could still
+    // be pending on this thread (same rationale as the legacy serve)
+    auto *ec = &tele_->edge(canon_key);
+    dist_tx_bytes_.fetch_add(payload);
+    ec->tx_sync_bytes.fetch_add(payload, std::memory_order_relaxed);
+    tele_->comm.ss_seeder_chunks_served.fetch_add(count,
+                                                  std::memory_order_relaxed);
+
+    // striped launch: the range is one window sub-striped across the pool
+    // (the collective grid: PCCLT_STRIPE_CONNS clamped to pool, 64 KiB
+    // sub floor) — conn TX paces per-lane on the netem edge, so a chaos
+    // degrade/blackhole lands mid-transfer exactly like a collective's
+    size_t stripes = 4;
+    if (const char *se = std::getenv("PCCLT_STRIPE_CONNS")) {
+        int v = atoi(se);
+        if (v > 0) stripes = static_cast<size_t>(v);
+    }
+    stripes = std::max<size_t>(1, std::min(stripes, txl.size()));
+    const size_t rot0 = static_cast<size_t>(
+        chunk_tag_seq_.fetch_add(1, std::memory_order_relaxed));
+    constexpr size_t kSubMin = 64u << 10;
+    std::vector<net::SendHandle> hs;
+    if (stripes <= 1 || payload < 2 * kSubMin) {
+        hs.push_back(txl.send_at(tag, 0,
+                                 {buf->data(), static_cast<size_t>(payload)},
+                                 rot0));
+    } else {
+        size_t sub = (static_cast<size_t>(payload) + stripes - 1) / stripes;
+        if (sub < kSubMin) sub = kSubMin;
+        for (size_t off = 0, j = 0; off < payload; off += sub, ++j)
+            hs.push_back(txl.send_at(
+                tag, off,
+                {buf->data() + off,
+                 std::min(sub, static_cast<size_t>(payload) - off)},
+                rot0 + j % stripes));
+        ec->tx_stripe_windows.fetch_add(1, std::memory_order_relaxed);
+        ec->tx_stripe_bytes.fetch_add(payload, std::memory_order_relaxed);
+    }
+
+    // ---- watchdog ladder join (docs/05, serve side) ----
+    // Same opt-in + envelope as the collectives: deadline = factor x the
+    // EWMA-predicted transfer time, floored. SUSPECT re-issues the
+    // pending backlog on a fresh conn (races the originals — receiver
+    // dedupe makes the copy free); CONFIRMED detours the backlog via a
+    // third peer in 1 MiB relay windows and stops waiting on the direct
+    // copies. A capped join bounds the serve thread; whatever is still
+    // pending parks as a zombie holding the buffer alive.
+    const bool wd_on = [] {
+        const char *wde = std::getenv("PCCLT_WATCHDOG");
+        return wde && wde[0] && wde[0] != '0';
+    }();
+    const double wd_factor = env_double("PCCLT_WATCHDOG_FACTOR", 4.0);
+    const uint64_t wd_min_ns =
+        static_cast<uint64_t>(env_int("PCCLT_WATCHDOG_MIN_MS", 300)) *
+        1'000'000ull;
+    auto deadline_ns = [&](uint64_t bytes) {
+        uint64_t rate = ec->wd_rate_bps.load(std::memory_order_relaxed);
+        uint64_t base_t = rate > 0
+                              ? static_cast<uint64_t>(bytes * 1e9 / rate)
+                              : 500'000'000ull;
+        return std::max(static_cast<uint64_t>(base_t * wd_factor), wd_min_ns);
+    };
+    auto mark = [&](telemetry::EdgeHealth v) {
+        auto nv = static_cast<uint32_t>(v);
+        uint32_t cur = ec->wd_health.load(std::memory_order_relaxed);
+        while (cur < nv && !ec->wd_health.compare_exchange_weak(
+                               cur, nv, std::memory_order_relaxed)) {
+        }
+        if (v == telemetry::EdgeHealth::kSuspect)
+            ec->wd_suspects.fetch_add(1, std::memory_order_relaxed);
+        if (v == telemetry::EdgeHealth::kConfirmed) {
+            ec->wd_confirms.fetch_add(1, std::memory_order_relaxed);
+            ec->wd_confirmed_at_ns.store(telemetry::now_ns(),
+                                         std::memory_order_relaxed);
+        }
+    };
+    const uint64_t t_launch = telemetry::now_ns();
+    uint64_t t_rung = t_launch;  // re-armed at each escalation
+    bool reissued = false, confirmed = false;
+    net::Link fresh;
+    std::vector<net::SendHandle> extra;  // reissue copies (kept for zombies)
+    std::set<const net::SendState *> satisfied;  // detoured or copy-covered
+    std::set<const net::SendState *> measured;   // fed the EWMA already
+    // give-up cap: bounds a serve thread even when every rung fails
+    // (requester gone, no third peer) — the fetcher re-sources regardless
+    const uint64_t cap_ns =
+        std::max<uint64_t>(3 * deadline_ns(payload), 30'000'000'000ull);
+    std::map<const net::SendState *, net::SendHandle> reissue_of;
+    while (true) {
+        uint64_t backlog = 0;
+        net::SendHandle oldest;
+        for (auto &h : hs) {
+            if (satisfied.count(h.get())) continue;
+            if (h->done()) {
+                if (!measured.count(h.get())) {
+                    measured.insert(h.get());
+                    if (h->wait(0) &&
+                        ec->wd_health.load(std::memory_order_relaxed) == 0) {
+                        // healthy completion feeds the EWMA — with the
+                        // anti-poisoning clamp: a sample an order of
+                        // magnitude under the envelope IS the degradation
+                        uint64_t dur = telemetry::now_ns() - t_launch;
+                        uint64_t rate =
+                            ec->wd_rate_bps.load(std::memory_order_relaxed);
+                        bool degraded =
+                            rate > 0 && dur > 0 &&
+                            h->span.size() * 1e9 / dur < rate / 8.0;
+                        if (!degraded && dur >= 1'000'000 &&
+                            h->span.size() >= kSubMin) {
+                            auto r2 = static_cast<uint64_t>(h->span.size() *
+                                                            1e9 / dur);
+                            ec->wd_rate_bps.store(
+                                rate ? static_cast<uint64_t>(0.7 * rate +
+                                                             0.3 * r2)
+                                     : r2,
+                                std::memory_order_relaxed);
+                        }
+                    }
+                }
+                continue;
+            }
+            // a landed reissue copy satisfies its stalled original: the
+            // bytes are delivered (receiver-side dedupe), the original
+            // drains as a zombie
+            auto rit = reissue_of.find(h.get());
+            if (rit != reissue_of.end() && rit->second->done() &&
+                rit->second->wait(0)) {
+                satisfied.insert(h.get());
+                continue;
+            }
+            backlog += h->span.size();
+            if (!oldest) oldest = h;
+        }
+        if (!oldest) break;  // everything delivered / detoured / satisfied
+        const uint64_t now = telemetry::now_ns();
+        if (now - t_launch > cap_ns) break;  // give up: park as zombie
+        if (wd_on && now - t_rung > deadline_ns(backlog)) {
+            if (!reissued) {
+                // rung 1, SUSPECT: one fresh conn, re-issue the backlog —
+                // first copy to land wins, the loser drains as a zombie
+                reissued = true;
+                t_rung = telemetry::now_ns();
+                mark(telemetry::EdgeHealth::kSuspect);
+                fresh = fresh_pool_conn(requester);
+                if (fresh.valid()) {
+                    for (auto &h : hs) {
+                        if (h->done() || satisfied.count(h.get())) continue;
+                        auto h2 = fresh.send_at(h->tag, h->off, h->span, 0);
+                        reissue_of[h.get()] = h2;
+                        extra.push_back(std::move(h2));
+                        ec->wd_reissues.fetch_add(1,
+                                                  std::memory_order_relaxed);
+                    }
+                }
+                continue;
+            }
+            if (!confirmed) {
+                // rung 2, CONFIRMED: detour the backlog via a third peer
+                // in relay windows; detoured spans stop gating the join
+                confirmed = true;
+                t_rung = telemetry::now_ns();
+                bool any = false;
+                constexpr size_t kRelayWin = 1u << 20;
+                for (auto &h : hs) {
+                    if (h->done() || satisfied.count(h.get())) continue;
+                    bool ok = true;
+                    const uint8_t *p = h->span.data();
+                    for (size_t off = 0; ok && off < h->span.size();
+                         off += kRelayWin) {
+                        size_t n = std::min(kRelayWin, h->span.size() - off);
+                        ok = relay_window_via(requester, tag, h->off + off,
+                                              {p + off, n});
+                        if (ok)
+                            ec->wd_relays.fetch_add(
+                                1, std::memory_order_relaxed);
+                    }
+                    if (ok) {
+                        satisfied.insert(h.get());
+                        any = true;
+                    }
+                }
+                if (any) mark(telemetry::EdgeHealth::kConfirmed);
+                continue;
+            }
+            // both rungs burned: wait out the cap, then zombie
+        }
+        oldest->wait(50);
+    }
+    // park whatever is still pending (stalled originals behind a detour,
+    // loser reissue copies): the zombie holds the scratch alive until the
+    // handles drain or their conns die; the sweep in chunk_serve_loop
+    // cancels acked spans early and frees the buffer
+    ChunkTxZombie z;
+    for (auto &h : hs)
+        if (h && !h->done()) z.hs.push_back(h);
+    for (auto &h : extra)
+        if (h && !h->done()) z.hs.push_back(h);
+    if (!z.hs.empty()) {
+        z.buf = std::move(buf);
+        MutexLock lk(chunk_mu_);
+        chunk_zombies_.push_back(std::move(z));
+    }
+}
+
+void Client::chunk_serve_stop_join() {
+    std::vector<std::thread> threads;
+    {
+        MutexLock lk(chunk_mu_);
+        chunk_stop_ = true;
+        chunk_queue_.clear();
+        threads.swap(chunk_threads_);
+        chunk_cv_.notify_all();
+    }
+    for (auto &t : threads) t.join();
+    // called after every pool conn closed: close() failed all pending
+    // handles, so the parked buffers are safe to drop
+    MutexLock lk(chunk_mu_);
+    chunk_zombies_.clear();
+}
+
 void Client::on_bench_accept(net::Socket sock) {
     static bench::ServeState state;
     spawn_service(std::move(sock), [](net::Socket &sock,
@@ -406,6 +785,11 @@ Status Client::connect() {
     {
         MutexLock lk(svc_mu_);
         svc_accepting_ = true;
+    }
+    {
+        // re-arm the pooled chunk-serve plane after a prior disconnect
+        MutexLock lk(chunk_mu_);
+        chunk_stop_ = false;
     }
     if (!p2p_listener_.listen(cfg_.p2p_port, 64)) return Status::kInternal;
     if (!ss_listener_.listen(cfg_.ss_port, 64)) return Status::kInternal;
@@ -715,6 +1099,10 @@ void Client::disconnect() {
         for (auto &c : pc.rx)
             if (c) c->close();
     }
+    // LAST: the conn closes above failed every pending send handle, so the
+    // serve pool's parked zombie buffers are droppable and the workers
+    // (which only touch peers_ via the state lock) have nothing to serve
+    chunk_serve_stop_join();
 }
 
 Status Client::check_kicked() {
@@ -1332,6 +1720,14 @@ void Client::install_relay_handlers(
         // ORIGIN side: merge the acked range so drain_zombies can query it
         [this](uint64_t tag, uint64_t off, uint64_t len) {
             note_relay_ack(tag, off, len);
+        });
+    // chunk plane on the pool (docs/04 unified transport): a kChunkReq can
+    // arrive on any inbound conn; the RX thread only enqueues — the serve
+    // pool does the window/materialize/striped-send work
+    conn->set_chunk_req_handler(
+        [this](const uint8_t *req_uuid, uint64_t tag,
+               std::vector<uint8_t> spec) {
+            chunk_req_enqueue(req_uuid, tag, std::move(spec));
         });
 }
 
@@ -2313,7 +2709,8 @@ Status Client::sync_shared_state_impl(uint64_t revision, proto::SyncStrategy str
             for (size_t k = 0; k < resp->outdated_keys.size(); ++k)
                 if (k >= resp->key_leaves.size() || resp->key_leaves[k].empty())
                     legacy_keys.push_back(resp->outdated_keys[k]);
-            st = ss_fetch_chunked(*resp, entries, hash_type, gen0, &rx_bytes);
+            st = ss_fetch_chunked(*resp, req, entries, hash_type, gen0,
+                                  &rx_bytes);
             if (st == Status::kOk && !legacy_keys.empty())
                 st = ss_fetch_legacy(*resp, legacy_keys, entries, hash_type,
                                      &rx_bytes);
@@ -2486,6 +2883,7 @@ Status Client::ss_fetch_legacy(const proto::SharedStateSyncResp &resp,
 }
 
 Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
+                                const proto::SharedStateSyncC2M &req,
                                 const std::vector<SharedStateEntry> &entries,
                                 hash::Type ht, uint64_t gen0,
                                 uint64_t *rx_bytes) {
@@ -2518,6 +2916,16 @@ Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
         ks.nbytes = nbytes;
         ks.dst = static_cast<uint8_t *>(t->data);
         ks.leaves = lv;
+        // sparse revision delta (docs/04): the request-time leaves we sent
+        // the master describe the bytes ALREADY in this buffer — chunks
+        // whose local leaf matches the expected one are born done and
+        // never travel (the plan counts them as delta-skipped)
+        for (const auto &m : req.entries)
+            if (m.name == name) {
+                if (m.chunk_leaves.size() == lv.size())
+                    ks.local_leaves = m.chunk_leaves;
+                break;
+            }
         specs.push_back(std::move(ks));
         resp_idx.push_back(k);
         targets.push_back(t);
@@ -2535,25 +2943,28 @@ Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
         rot);
 
     std::vector<std::thread> workers;
-    // per-worker live-fd handles (the spawn_service pattern): once the
-    // plan finishes, shut the fds down so a worker parked in a blocking
-    // recv exits NOW, not at its recv budget — only the dispatcher
-    // thread mutates this vector
-    std::vector<std::shared_ptr<std::atomic<int>>> worker_fds;
-    std::map<std::string, uint32_t> started;  // endpoint -> seeder index
+    // one worker per seeder PEER (uuid-keyed): the transport is the pooled
+    // mesh conns, so there are no per-worker sockets to manage — a worker
+    // parked mid-range waits in bounded slices and re-checks finished(),
+    // so the dispatcher never needs an fd sweep to unblock it
+    std::map<std::string, uint32_t> started;  // uuid -> seeder index
     auto spawn_for = [&](const proto::SeederRec &rec) -> int {
         if (rec.uuid == uuid_) return -1;  // self-seeding is a no-op
+        {
+            // not in our mesh: unusable as a pooled source (the master's
+            // directory and our membership can skew for a beat mid-churn)
+            MutexLock lk(state_mu_);
+            if (!peers_.count(rec.uuid)) return -1;
+        }
         net::Addr canon = rec.ip;
         canon.port = rec.p2p_port ? rec.p2p_port : rec.ss_port;
-        std::string key = canon.str();
-        uint32_t sidx = plan->add_seeder(key);
-        if (!started.count(key)) {
-            started[key] = sidx;
-            auto fd_h = std::make_shared<std::atomic<int>>(-1);
-            worker_fds.push_back(fd_h);
+        std::string ukey = proto::uuid_str(rec.uuid);
+        uint32_t sidx = plan->add_seeder(canon.str());
+        if (!started.count(ukey)) {
+            started[ukey] = sidx;
             workers.emplace_back(
-                [this, plan, sidx, rec, rev = resp.revision, ht, fd_h] {
-                    ss_fetch_worker(plan, sidx, rec, rev, ht, fd_h);
+                [this, plan, sidx, rec, rev = resp.revision, ht] {
+                    ss_fetch_worker(plan, sidx, rec, rev, ht);
                 });
         }
         return static_cast<int>(sidx);
@@ -2619,15 +3030,6 @@ Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
         }
         if (session_flipped()) plan->abort();
     }
-    // unblock stragglers: a worker mid-recv on a dead/blackholed edge
-    // would otherwise hold the join (and thus the group's dist-done
-    // barrier) for its whole recv budget. The plan is finished by now,
-    // so any worker dialing PAST this sweep sees finished() right after
-    // its connect returns and closes itself.
-    for (auto &h : worker_fds) {
-        int fd = h->load(std::memory_order_acquire);
-        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
-    }
     for (auto &t : workers)
         if (t.joinable()) t.join();
     drain_completions();
@@ -2643,6 +3045,8 @@ Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
     add(c.ss_chunk_bytes_fetched, ps.bytes_fetched);
     add(c.ss_chunk_bytes_resourced, ps.bytes_resourced);
     add(c.ss_chunk_bytes_dup, ps.bytes_dup);
+    add(c.ss_chunks_delta_skipped, ps.chunks_delta_skipped);
+    add(c.ss_chunk_bytes_delta_skipped, ps.bytes_delta_skipped);
     *rx_bytes += ps.unique_bytes;
     telemetry::Recorder::inst().span(
         "membership", "sync_fetch", t_fetch0, telemetry::now_ns(), "bytes",
@@ -2653,28 +3057,64 @@ Status Client::ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
                                      : Status::kConnectionLost;
 }
 
+// Pooled fetch worker (docs/04 unified transport): ranges are requested
+// over the mesh conns as kChunkReq frames and the payload arrives as
+// striped kData windows in this peer's rx SinkTable — the same sink a
+// relay detour (kRelayDeliver, origin = the seeder) feeds, so a seeder
+// whose direct edge degrades mid-range still lands its bytes here via a
+// third peer, deduped and charged to the canonical edge. The worker never
+// owns a socket: waits are bounded slices that re-check finished(), so
+// the dispatcher join needs no fd sweep.
 void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
                              uint32_t sidx, proto::SeederRec rec,
-                             uint64_t revision, hash::Type ht,
-                             const std::shared_ptr<std::atomic<int>> &fd_h) {
+                             uint64_t revision, hash::Type ht) {
     telemetry::EdgeCounters *ec = nullptr;
     std::string canon_key;
-    auto edge = ss_edge_for(rec.ip, rec.p2p_port, rec.ss_port, *tele_, &ec,
-                            &canon_key);
-    net::Addr ss_addr = rec.ip;
-    ss_addr.port = rec.ss_port;
-    net::Socket sock;
-    bool connected = false;
+    // resolved at FETCH time, so a chaos schedule injected after the mesh
+    // dialed (pccltNetemInject creates a per-endpoint edge that conns
+    // holding the process default never see) is still honored below
+    auto edge =
+        ss_edge_for(rec.ip, rec.p2p_port, rec.ss_port, *tele_, &ec, &canon_key);
     int fails = 0;     // consecutive transport failures against this seeder
     int refusals = 0;  // consecutive status-1 "window not ready" answers
     std::vector<uint8_t> scratch;
-    const uint16_t my_p2p = p2p_listener_.port();
     auto retire = [&] {
         plan->seeder_gone(sidx);
         tele_->comm.ss_seeders_lost.fetch_add(1, std::memory_order_relaxed);
         telemetry::Recorder::inst().instant(
             "membership", "sync_seeder_lost", "revision", revision, nullptr, 0,
             telemetry::intern(canon_key));
+    };
+    // the seeder's inbound sink table: payload kData frames land here, and
+    // so do relay detours (kRelayDeliver resolves origin = the seeder)
+    std::shared_ptr<net::SinkTable> rx_table;
+    {
+        MutexLock lk(state_mu_);
+        auto it = peers_.find(rec.uuid);
+        if (it != peers_.end()) {
+            if (!it->second.rx_table)
+                it->second.rx_table = std::make_shared<net::SinkTable>();
+            rx_table = it->second.rx_table;
+        }
+    }
+    if (!rx_table) {
+        retire();
+        return;
+    }
+    // dead-peer detection (the pooled analogue of a refused dial / broken
+    // recv): a SIGKILLed seeder's conns RST and go !alive() within a beat,
+    // while a blackholed edge keeps its conns — so this trips on real
+    // death, not chaos, and the wait loops below bail promptly instead of
+    // parking a whole budget against a corpse
+    auto peer_alive = [&] {
+        MutexLock lk(state_mu_);
+        auto it = peers_.find(rec.uuid);
+        if (it == peers_.end()) return false;
+        for (const auto &c : it->second.tx)
+            if (c && c->alive()) return true;
+        for (const auto &c : it->second.rx)
+            if (c && c->alive()) return true;
+        return false;
     };
     while (!plan->finished() && plan->seeder_alive(sidx)) {
         auto take = plan->take(sidx, telemetry::now_ns());
@@ -2689,22 +3129,48 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
                 plan->failed(take->key, take->first + i, sidx,
                              hash_bad && i == from);
         };
-        if (!connected) {
-            if (plan->finished()) break;
-            fd_h->store(-1, std::memory_order_release);  // before the close
-            sock = net::Socket();
-            if (!sock.connect(ss_addr, 3'000)) {
-                fail_range(0);
-                retire();
-                break;
-            }
-            // a dial can complete AFTER the dispatcher's shutdown sweep
-            // (the sweep saw -1): finished() is already true by then, so
-            // this re-check closes the race before any blocking recv
-            if (plan->finished()) break;
-            sock.set_bufsizes(4 << 20);
-            fd_h->store(sock.fd(), std::memory_order_release);
-            connected = true;
+        // scripted outage on the canonical sync edge: park HERE in bounded
+        // slices (range held — the dispatcher's deadline re-sources the
+        // chunks from another seeder, the per-chunk failover of docs/04)
+        // instead of racing requests into a blackhole. The park ends at
+        // the outage's ABSOLUTE end, so when the conns model the same
+        // armed edge nothing is double-charged.
+        if (edge) {
+            while (!plan->finished() && plan->seeder_alive(sidx) &&
+                   edge->chaos_at().outage)
+                std::this_thread::sleep_for(std::chrono::milliseconds(50));
+            if (plan->finished() || !plan->seeder_alive(sidx)) break;
+        }
+        uint64_t payload = 0;
+        for (uint32_t i = 0; i < take->count; ++i)
+            payload += ssc::chunk_len(ks.nbytes, cb, take->first + i);
+        // register the sink BEFORE the request leaves: a fast seeder's
+        // first kData frame must find the sink, not the queued-frame path
+        const uint64_t tag =
+            (1ull << 63) |
+            chunk_tag_seq_.fetch_add(1, std::memory_order_relaxed);
+        scratch.resize(payload);
+        rx_table->register_sink(tag, scratch.data(), payload);
+        auto drop_sink = [&] {
+            rx_table->unregister_sink(tag);
+            // retire the tag: stripes/detours straggling in after a failed
+            // or finished range are dropped instead of queueing forever
+            rx_table->purge_range(tag, tag + 1);
+        };
+        // request rides OUR tx pool toward the seeder: [16B own uuid][spec]
+        std::shared_ptr<net::MultiplexConn> out;
+        {
+            MutexLock lk(state_mu_);
+            auto it = peers_.find(rec.uuid);
+            if (it != peers_.end())
+                for (const auto &c : it->second.tx)
+                    if (c && c->alive()) { out = c; break; }
+        }
+        if (!out) {
+            drop_sink();
+            fail_range(0);
+            retire();
+            break;
         }
         wire::Writer w;
         w.u64(revision);
@@ -2712,31 +3178,45 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
         w.u64(cb);
         w.u32(take->first);
         w.u32(take->count);
-        w.u16(my_p2p);
-        Mutex mu;
-        bool sent = net::send_frame(sock, mu, PacketType::kC2SChunkRequest,
-                                    w.data());
-        std::optional<net::Frame> hdr;
-        if (sent) {
-            int ms = static_cast<int>(std::min<uint64_t>(
-                plan->chunk_budget_ns() / 1'000'000 + 1'000, 60'000));
-            hdr = net::recv_frame(sock, ms);
+        auto spec = w.take();
+        std::vector<uint8_t> pl(16 + spec.size());
+        memcpy(pl.data(), uuid_.data(), 16);
+        memcpy(pl.data() + 16, spec.data(), spec.size());
+        out->send_owned(net::MultiplexConn::kChunkReq, tag, 0, std::move(pl));
+        // header: [u8 status][BE u64 payload len] on the queued-frame path
+        // (same tag, kChunkHdr) — bounded slices so a finished plan
+        // reclaims this worker promptly even mid-outage
+        const uint64_t hdr_budget_ns = std::min<uint64_t>(
+            plan->chunk_budget_ns() + 1'000'000'000ull, 60'000'000'000ull);
+        const uint64_t t_hdr = telemetry::now_ns();
+        std::optional<std::vector<uint8_t>> hdr;
+        while (true) {
+            hdr = rx_table->recv_queued(tag, 50);
+            if (hdr || plan->finished() || !peer_alive() ||
+                telemetry::now_ns() - t_hdr > hdr_budget_ns)
+                break;
         }
-        if (!sent || !hdr || hdr->type != PacketType::kS2CChunkHeader) {
+        uint8_t status = 255;
+        if (hdr) {
+            try {
+                wire::Reader r(*hdr);
+                status = r.u8();
+                (void)r.u64();  // payload length (implied by the chunk grid)
+            } catch (...) { status = 255; }
+        }
+        if (plan->finished()) {
+            drop_sink();
+            break;
+        }
+        if (status == 255) {  // no (or garbled) header inside the budget
+            drop_sink();
             fail_range(0);
-            connected = false;
-            if (++fails >= 2) {
+            if (!peer_alive() || ++fails >= 2) {
                 retire();
                 break;
             }
             continue;
         }
-        uint8_t status = 2;
-        try {
-            wire::Reader r(hdr->payload);
-            status = r.u8();
-            (void)r.u64();  // payload length (implied by the chunk grid)
-        } catch (...) {}
         if (status == 1) {
             // serve window not ready (peer still processing its response
             // / key not yet complete there): back off, don't blacklist —
@@ -2747,6 +3227,7 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
             // out nor finish. ~20 refusals ≈ 2 s of backoff is far past
             // any response-processing race; after that the refusal is a
             // real failure and the normal retire ladder applies.
+            drop_sink();
             if (++refusals >= 20) {
                 fail_range(0);
                 retire();
@@ -2758,6 +3239,7 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
             continue;
         }
         if (status != 0) {
+            drop_sink();
             fail_range(0);
             if (++fails >= 2) {
                 retire();
@@ -2765,37 +3247,36 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
             }
             continue;
         }
+        // payload: striped kData windows (direct, re-issued, or relay-
+        // detoured — the sink dedupes) filling [0, payload). Verify chunk
+        // by chunk as the contiguous prefix grows; a blackholed sync edge
+        // parks HERE in bounded waits while the dispatcher's deadline
+        // re-sources the chunks from a different seeder (docs/04) and the
+        // SEEDER's watchdog climbs its ladder to route around the edge.
+        uint64_t need = 0;
+        bool range_ok = true, hash_bad = false;
+        uint32_t failed_at = 0;
         for (uint32_t i = 0; i < take->count; ++i) {
             uint32_t idx = take->first + i;
             uint64_t len = ssc::chunk_len(ks.nbytes, cb, idx);
-            scratch.resize(len);
-            // netem ingress on the seeder's canonical edge: delivery
-            // delay incl. scripted chaos — a blackholed sync edge parks
-            // HERE while the dispatcher's deadline re-sources the chunk
-            // from a different seeder (per-chunk failover, docs/04).
-            // Sliced so a finished plan reclaims this worker promptly
-            // even mid-outage.
-            if (edge && edge->delay_enabled()) {
-                uint64_t d = edge->delivery_delay_ns();
-                while (d > 0 && !plan->finished()) {
-                    uint64_t slice = std::min<uint64_t>(d, 100'000'000ull);
-                    std::this_thread::sleep_for(
-                        std::chrono::nanoseconds(slice));
-                    d -= slice;
-                }
+            const uint64_t budget_ns = std::min<uint64_t>(
+                plan->chunk_budget_ns() + 100'000'000ull, 60'000'000'000ull);
+            const uint64_t t0 = telemetry::now_ns();
+            size_t have = 0;
+            while (true) {
+                have = rx_table->wait_filled(tag, need + len, 50);
+                if (have >= need + len || plan->finished() ||
+                    !peer_alive() || telemetry::now_ns() - t0 > budget_ns)
+                    break;
             }
-            uint64_t t0 = telemetry::now_ns();
-            int budget_ms = static_cast<int>(std::min<uint64_t>(
-                plan->chunk_budget_ns() / 1'000'000 + 100, 60'000));
-            if (!sock.recv_all_deadline(scratch.data(), len, budget_ms)) {
-                fail_range(i);
-                connected = false;
-                if (++fails >= 2) retire();
+            if (have < need + len) {
+                range_ok = false;
+                failed_at = i;
                 break;
             }
             uint64_t t1 = telemetry::now_ns();
             tele_->record_phase(telemetry::Phase::kSyncFetch, t1 - t0);
-            uint64_t h = hash::content_hash(ht, scratch.data(), len);
+            uint64_t h = hash::content_hash(ht, scratch.data() + need, len);
             tele_->record_phase(telemetry::Phase::kSyncVerify,
                                 telemetry::now_ns() - t1);
             if (h != ks.leaves[idx]) {
@@ -2806,13 +3287,14 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
                 telemetry::Recorder::inst().instant(
                     "membership", "sync_chunk_mismatch", "revision", revision,
                     "chunk", idx, telemetry::intern(ks.name));
-                fail_range(i, /*hash_bad=*/true);
-                connected = false;  // stream alignment is suspect too
+                range_ok = false;
+                hash_bad = true;
+                failed_at = i;
                 break;
             }
             ec->rx_sync_bytes.fetch_add(len, std::memory_order_relaxed);
             if (uint8_t *dst = plan->claim(take->key, idx)) {
-                memcpy(dst, scratch.data(), len);
+                memcpy(dst, scratch.data() + need, len);
                 plan->published(take->key, idx, sidx, take->gens[i],
                                 telemetry::now_ns());
             } else {
@@ -2820,8 +3302,16 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
             }
             fails = 0;
             refusals = 0;
+            need += len;
         }
-        if (!connected && !plan->seeder_alive(sidx)) break;
+        drop_sink();
+        if (!range_ok) {
+            fail_range(failed_at, hash_bad);
+            if (!hash_bad && (!peer_alive() || ++fails >= 2)) {
+                retire();
+                break;
+            }
+        }
     }
 }
 

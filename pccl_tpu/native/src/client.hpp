@@ -10,6 +10,7 @@
 #pragma once
 
 #include <atomic>
+#include <deque>
 #include <future>
 #include <map>
 #include <memory>
@@ -234,24 +235,30 @@ private:
     // keep the persistent serve connection alive.
     bool ss_serve_chunk(net::Socket &sock, const net::Frame &req);
     // Multi-source fetch of the chunk-mapped outdated keys: a FetchPlan
-    // dispatched across one worker (socket) per seeder, per-chunk
-    // verify/deadline/re-source, mid-round seeder promotion. gen0 is the
+    // dispatched across one worker per seeder (unified transport: each
+    // worker rides the pooled MultiplexConns, no bespoke socket),
+    // per-chunk verify/deadline/re-source, mid-round seeder promotion.
+    // `req` is the request we sent the master — its per-entry chunk
+    // leaves are the request-time hashes of our own buffers, the source
+    // of the sparse-delta skip (chunks whose local leaf already matches
+    // the brokered leaf are born done and never travel). gen0 is the
     // session generation the sync started under.
     Status ss_fetch_chunked(const proto::SharedStateSyncResp &resp,
+                            const proto::SharedStateSyncC2M &req,
                             const std::vector<SharedStateEntry> &entries,
                             hash::Type ht, uint64_t gen0, uint64_t *rx_bytes);
-    // One fetch worker: a persistent socket to one seeder draining plan
-    // assignments (dial -> request range -> verify each chunk). `fd_h`
-    // publishes the worker's live socket fd (-1 while none, the
-    // spawn_service pattern) so the dispatcher can shut a straggler's
-    // recv down the moment the plan finishes — one stuck worker must not
-    // stall the group's dist-done barrier for its whole recv budget.
-    // The worker re-checks plan->finished() after every dial, closing
-    // the shutdown-vs-fresh-dial race.
+    // One fetch worker per seeder, on the POOL (docs/04 unified
+    // transport): register a sink for the range's response tag in the
+    // seeder's inbound table, send kChunkReq over our tx pool, read the
+    // kChunkHdr status off the queued-frame path, then wait the payload
+    // into the sink — kData frames at range-relative offsets, arriving
+    // striped across the seeder's pool conns or detoured through a relay
+    // peer, dedupe through the one SinkTable. All waits are bounded
+    // slices re-checking plan->finished(), so the dispatcher never needs
+    // to shut a socket down to reclaim a straggler.
     void ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
                          uint32_t sidx, proto::SeederRec rec,
-                         uint64_t revision, hash::Type ht,
-                         const std::shared_ptr<std::atomic<int>> &fd_h);
+                         uint64_t revision, hash::Type ht);
     // Legacy single-distributor fetch of `keys` (the pre-chunk-plane
     // transport, kept for tiny states / world=2 / leafless device
     // entries), now with a 30 s-class no-progress deadline and netem
@@ -260,6 +267,22 @@ private:
                            const std::vector<std::string> &keys,
                            const std::vector<SharedStateEntry> &entries,
                            hash::Type ht, uint64_t *rx_bytes);
+
+    // ---- pooled chunk serve plane (docs/04 unified transport) ----
+    // RX-thread hook target for kChunkReq frames: enqueue for the serve
+    // pool (never blocks; lazily spawns PCCLT_SS_SERVE_THREADS workers).
+    void chunk_req_enqueue(const uint8_t *requester_uuid, uint64_t tag,
+                           std::vector<uint8_t> spec);
+    void chunk_serve_loop();  // serve-pool worker: drain queued requests
+    // Serve ONE pooled chunk-range request: kChunkHdr status on the
+    // requester's reverse link, then the payload as striped kData windows
+    // (per-lane netem pacing, zerocopy — the collective TX path) with the
+    // full watchdog ladder: a stalled window goes SUSPECT and re-issues
+    // on a fresh pool conn, a second stall CONFIRMS the edge and detours
+    // the bytes through a third peer via the acked relay plane.
+    void chunk_serve_pooled(const proto::Uuid &requester, uint64_t tag,
+                            const std::vector<uint8_t> &spec);
+    void chunk_serve_stop_join();  // disconnect: stop + join + reap
 
     // p2p pool width per peer: cfg_.pool_size grown to PCCLT_STRIPE_CONNS
     // (docs/08 multipath striping), capped at 8
@@ -418,6 +441,34 @@ private:
     int dist_serving_ PCCLT_GUARDED_BY(dist_mu_) = 0;
     CondVar dist_cv_;
     std::atomic<uint64_t> dist_tx_bytes_{0};
+
+    // pooled chunk serve plane (docs/04 unified transport): kChunkReq
+    // frames land on RX threads, which enqueue here; a lazily-spawned
+    // serve pool (PCCLT_SS_SERVE_THREADS) drains the queue. Leaf lock:
+    // enqueue/pop only, never held across serve work or another lock.
+    struct ChunkServeReq {
+        proto::Uuid requester{};
+        uint64_t tag = 0;
+        std::vector<uint8_t> spec;
+    };
+    Mutex chunk_mu_; // lock-rank: 21
+    CondVar chunk_cv_;
+    std::deque<ChunkServeReq> chunk_queue_ PCCLT_GUARDED_BY(chunk_mu_);
+    bool chunk_stop_ PCCLT_GUARDED_BY(chunk_mu_) = false;
+    std::vector<std::thread> chunk_threads_ PCCLT_GUARDED_BY(chunk_mu_);
+    // serve scratch whose striped handles were still in flight when the
+    // serve returned (ladder gave up, or a zombied direct copy behind a
+    // successful relay detour): the buffer must outlive every handle.
+    // Swept lazily by the serve loop; drained at disconnect AFTER the
+    // peer conns close (close fails all pending handles).
+    struct ChunkTxZombie {
+        std::vector<net::SendHandle> hs;
+        std::shared_ptr<std::vector<uint8_t>> buf;
+    };
+    std::vector<ChunkTxZombie> chunk_zombies_ PCCLT_GUARDED_BY(chunk_mu_);
+    // fetcher-side response-tag allocator: bit 63 keeps the chunk-plane
+    // namespace disjoint from collective tags (op seq << 16)
+    std::atomic<uint64_t> chunk_tag_seq_{1};
 
     // Per-connection service threads (p2p handshakes, shared-state serving,
     // benchmark serving). Tracked so disconnect() can interrupt their sockets

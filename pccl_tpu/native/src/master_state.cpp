@@ -1080,7 +1080,11 @@ void MasterState::check_shared_state(std::vector<Outbox> &out, uint32_t group) {
                 uint32_t idx;
                 if (it == seeder_by_uuid.end()) {
                     idx = static_cast<uint32_t>(seeders.size());
-                    seeders.push_back({m->uuid, m->ip, m->ss_port, m->p2p_port});
+                    // the chunk plane rides the pooled p2p mesh now: the
+                    // seeder directory advertises data-plane endpoints
+                    // only. The legacy ss-port field stays on the wire
+                    // (decode-tolerant zero) for un-upgraded fetchers.
+                    seeders.push_back({m->uuid, m->ip, 0, m->p2p_port});
                     seeder_by_uuid[m->uuid] = idx;
                 } else {
                     idx = it->second;
@@ -1132,7 +1136,7 @@ std::vector<Outbox> MasterState::on_sync_key_done(uint64_t conn,
     proto::SeederUpdateM2C up;
     up.revision = d.revision;
     up.key = d.key;
-    up.seeder = {c->uuid, c->ip, c->ss_port, c->p2p_port};
+    up.seeder = {c->uuid, c->ip, 0, c->p2p_port};  // p2p endpoint only
     auto payload = up.encode();
     for (auto *m : group_members(c->peer_group))
         if (m->conn_id != conn && m->sync_req)

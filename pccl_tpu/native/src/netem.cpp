@@ -554,11 +554,16 @@ std::shared_ptr<Edge> Registry::resolve(const Addr &peer) {
         drop_.count(exact) || cwnd_.count(exact) ||
         chaos_specs_.count(exact)) {
         match = exact;  // per-endpoint bucket
+    } else if (edges_.count(exact)) {
+        // injected per-endpoint edge (pccltNetemInject): exact beats the
+        // ip wildcard below, same as an exact MAP entry would — an
+        // injection is deliberate and endpoint-specific, so a host-wide
+        // wildcard must not shadow it for post-injection resolvers (the
+        // fetch workers re-resolve per range; docs/04)
+        match = exact;
     } else if (mbps_.count(ip) || rtt_.count(ip) || jitter_.count(ip) ||
                drop_.count(ip) || cwnd_.count(ip) || chaos_specs_.count(ip)) {
         match = ip;  // per-host bucket, shared by every port on that ip
-    } else if (edges_.count(exact)) {
-        match = exact;  // injected per-endpoint edge (pccltNetemInject)
     } else if (edges_.count(ip)) {
         match = ip;
     } else {

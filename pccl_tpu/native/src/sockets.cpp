@@ -1555,6 +1555,8 @@ void MultiplexConn::tx_loop() {
         case kRelayFwd:
         case kRelayDeliver:
         case kRelayAck:
+        case kChunkReq:
+        case kChunkHdr:
             // one frame per window (windows are pipeline-granular, well
             // under the frame cap); tag/off are the ORIGINAL coordinates
             sock_ok = write_frame(req->kind, req->tag, req->off, req->span);
@@ -2213,6 +2215,46 @@ void MultiplexConn::rx_loop() {
                 memcpy(&len, buf.data(), 8);
                 relay_ack_(tag, off, wire::from_be(len));
             }
+            continue;
+        }
+
+        if (kind == kChunkReq) {
+            // shared-state chunk-range request (docs/04): [16B requester
+            // uuid][range spec]. Hand off to the client's serve pool —
+            // materialize/copy/send happens off the RX thread.
+            if (n < 16) {
+                PLOG(kError) << "multiplex rx: short chunk-req frame";
+                break;
+            }
+            std::vector<uint8_t> buf(n);
+            if (!sock_.recv_all(buf.data(), n)) break;
+            if (chunk_req_) {
+                std::vector<uint8_t> spec(buf.begin() + 16, buf.end());
+                chunk_req_(buf.data(), tag, std::move(spec));
+            } else {
+                PLOG(kWarn) << "chunk-req frame with no server; dropping "
+                               "(tag=" << tag << ")";
+            }
+            continue;
+        }
+
+        if (kind == kChunkHdr) {
+            // chunk-range response header ([u8 status][BE u64 payload
+            // len]): queued for the fetch worker exactly like a sink-less
+            // kData frame — [8B host-order off][payload] — so recv_queued
+            // on the response tag picks it up with no new plumbing.
+            std::vector<uint8_t> buf(n);
+            if (n > 0 && !sock_.recv_all(buf.data(), n)) break;
+            {
+                MutexLock lk(table_->mu_);
+                if (!table_->is_retired(tag)) {
+                    std::vector<uint8_t> qf(8 + n);
+                    memcpy(qf.data(), &off, 8);
+                    if (n > 0) memcpy(qf.data() + 8, buf.data(), n);
+                    table_->queues_[tag].push_back(std::move(qf));
+                }
+            }
+            table_->signal_tag(tag);
             continue;
         }
 

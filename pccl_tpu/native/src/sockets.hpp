@@ -468,6 +468,17 @@ public:
         relay_ack_ = std::move(ack);
     }
 
+    // Chunk-plane request hook (docs/04 unified transport). Set by the
+    // owning client BEFORE run(): a kChunkReq arrived on this conn —
+    // payload is [16B requester uuid][range spec]; the handler receives the
+    // uuid pointer plus the spec bytes after it. Runs on the RX thread
+    // holding no lock; must not block (enqueue-only — the serve pool does
+    // the materialize/send work).
+    using ChunkReqFn = std::function<void(const uint8_t *requester_uuid,
+                                          uint64_t tag,
+                                          std::vector<uint8_t> spec)>;
+    void set_chunk_req_handler(ChunkReqFn fn) { chunk_req_ = std::move(fn); }
+
     SinkTable &table() { return *table_; }
     const std::shared_ptr<SinkTable> &table_ptr() { return table_; }
 
@@ -511,6 +522,18 @@ public:
         // the delivered length as a BE u64. Fire-and-forget; lets the
         // origin retire CONFIRMED-stalled zombies before op end.
         kRelayAck = 10,
+        // shared-state chunk plane on the pool (docs/04 unified transport):
+        // a chunk-range REQUEST rides fetcher -> seeder, payload
+        // [16B requester uuid][protocol-framed range spec]; tag is the
+        // fetcher-chosen response tag, off is 0. The seeder answers with a
+        // kChunkHdr on the SAME tag ([u8 status][BE u64 payload len]) and,
+        // on status 0, the payload itself as plain kData frames at
+        // range-relative offsets — so chunk bytes reassemble through the
+        // fetcher's registered sink exactly like collective windows and
+        // inherit striping, pacing, zerocopy, relay dedupe, and per-edge
+        // telemetry from the one transport.
+        kChunkReq = 11,
+        kChunkHdr = 12,
     };
 
 private:
@@ -656,6 +679,8 @@ private:
     RelayFwdFn relay_fwd_;
     RelayDeliverFn relay_deliver_;
     RelayAckFn relay_ack_;
+    // chunk-plane request hook (set before run(), RX-thread-read only)
+    ChunkReqFn chunk_req_;
 
     // striped-bucket pacing lane on wire_ (docs/08 multipath striping):
     // allocated at construction / set_wire_peer rekey, released on close,

@@ -61,12 +61,31 @@ FetchPlan::FetchPlan(std::vector<KeySpec> keys, uint64_t chunk_bytes,
         k.chunks.resize(k.nchunks);
         total_bytes_ += ks.nbytes;
         total_chunks_ += k.nchunks;
+        // sparse revision delta (docs/04): chunks whose request-time local
+        // hash already equals the expected leaf are born done — a
+        // drag-along peer one revision behind fetches only what changed
+        if (ks.local_leaves.size() == k.nchunks &&
+            ks.leaves.size() == k.nchunks) {
+            for (uint32_t ci = 0; ci < k.nchunks; ++ci) {
+                if (ks.local_leaves[ci] != ks.leaves[ci]) continue;
+                k.chunks[ci].state = CState::kDone;
+                uint64_t len = chunk_len(ks.nbytes, chunk_bytes_, ci);
+                stats_.chunks_delta_skipped++;
+                stats_.bytes_delta_skipped += len;
+                done_chunks_++;
+                k.done++;
+            }
+        }
         k.spec = std::move(ks);
         keys_.push_back(std::move(k));
     }
-    // a zero-chunk key (empty entry) is born complete
+    // a zero-chunk key (empty entry) — or one whose chunks were ALL
+    // delta-skipped — is born complete and must still report (promotion)
     for (uint32_t i = 0; i < keys_.size(); ++i)
-        if (keys_[i].nchunks == 0) completed_keys_.push_back(i);
+        if (keys_[i].done == keys_[i].nchunks && !keys_[i].reported) {
+            keys_[i].reported = true;
+            completed_keys_.push_back(i);
+        }
 }
 
 uint32_t FetchPlan::add_seeder(const std::string &endpoint) {

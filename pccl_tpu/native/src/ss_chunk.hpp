@@ -59,20 +59,32 @@ struct KeySpec {
     uint64_t nbytes = 0;
     uint8_t *dst = nullptr;            // receive buffer (entry host memory)
     std::vector<uint64_t> leaves;      // expected per-chunk hashes
+    // sparse revision delta (docs/04): the fetcher's CURRENT per-chunk
+    // hashes over dst, computed at request time. Where local == expected
+    // the chunk's bytes are already canonical — the plan marks it done at
+    // construction (delta-skipped) and no seeder is ever asked for it. A
+    // drag-along peer one revision behind thus fetches only what changed.
+    // Empty = no local baseline (cold joiner / size change): fetch all.
+    std::vector<uint64_t> local_leaves;
 };
 
 // Cumulative plan counters (chunk granularity + bytes). Every verified
 // arrival lands in exactly one of fetched/resourced (by assignment
 // generation: first assignment vs a re-sourced one); arrivals for an
-// already-delivered chunk ALSO land in dup. Hence the conservation
-// identity at completion:
-//   fetched_bytes + resourced_bytes - dup_bytes == sum(chunk bytes)
+// already-delivered chunk ALSO land in dup. Chunks proven locally
+// canonical at construction (sparse delta) are counted in delta_skipped
+// and never assigned. Hence the conservation identities at completion:
+//   fetched_bytes + resourced_bytes - dup_bytes == unique_bytes
+//   unique_bytes + bytes_delta_skipped == sum(chunk bytes)
 struct PlanStats {
     uint64_t chunks_fetched = 0, chunks_resourced = 0, chunks_dup = 0;
     uint64_t bytes_fetched = 0, bytes_resourced = 0, bytes_dup = 0;
     uint64_t hash_mismatches = 0;
     uint64_t seeders_lost = 0;
     uint64_t unique_bytes = 0;         // delivered into buffers (verified)
+    // sparse revision delta: chunks whose local bytes already matched the
+    // expected leaf at plan construction (never fetched)
+    uint64_t chunks_delta_skipped = 0, bytes_delta_skipped = 0;
 };
 
 // Multi-source fetch state machine. Thread-safe: workers (one per seeder

@@ -266,6 +266,12 @@ struct CommCounters {
     std::atomic<uint64_t> ss_seeder_promotions{0};     // keys promoted mid-round
     std::atomic<uint64_t> ss_seeders_lost{0};          // sources lost mid-fetch
     std::atomic<uint64_t> ss_legacy_syncs{0};          // fell back to 1-seeder path
+    // sparse revision delta (docs/04): chunks whose request-time local
+    // leaf already matched the expected leaf — born done, never travel.
+    // Extends the identity: unique delivered + bytes_delta_skipped ==
+    // total dirty-key bytes.
+    std::atomic<uint64_t> ss_chunks_delta_skipped{0};
+    std::atomic<uint64_t> ss_chunk_bytes_delta_skipped{0};
     // ---- synthesized schedules (docs/12) ----
     // Ops executed per stamped algorithm, interpreter steps executed, and
     // PLANNED relay bytes (kRelayRing detours) — kept separate from the

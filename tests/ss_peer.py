@@ -13,6 +13,17 @@ process the moment its own ``ss_seeder_chunks_served`` counter reaches N:
 a deterministic "the busiest seeder dies mid-serve", no orchestrator
 timing games. Results are written as JSON to --result-file (absent for
 the killed peer, by design).
+
+``--inject-on-serve ENDPOINT=SPEC`` arms a watcher that calls
+``netem_inject(ENDPOINT, SPEC)`` the moment this peer's own per-edge
+``tx_sync_bytes`` toward ENDPOINT goes nonzero. Serve accounting is
+counted BEFORE the striped sends launch, so the injected fault lands
+while the serve's paced window is still in flight — a deterministic
+"the seeder's egress edge dies mid-serve" (the watchdog-ladder gate).
+
+``--linger-s S`` sleeps S seconds between the sync returning and the
+stats snapshot, so cross-peer aftermath (relay detours, acks) lands in
+the recorded counters.
 """
 
 from __future__ import annotations
@@ -55,13 +66,21 @@ def main() -> int:
     ap.add_argument("--elems", type=int, default=65536)
     ap.add_argument("--revision", type=int, default=1)
     ap.add_argument("--suicide-after-served", type=int, default=0)
+    ap.add_argument("--inject-on-serve", default="")
+    ap.add_argument("--linger-s", type=float, default=0.0)
+    ap.add_argument("--p2p-port", type=int, default=0)
+    ap.add_argument("--ss-port", type=int, default=0)
+    ap.add_argument("--bench-port", type=int, default=0)
     ap.add_argument("--result-file", required=True)
     args = ap.parse_args()
 
     from pccl_tpu.comm import (Communicator, SharedState,
-                               SharedStateSyncStrategy, TensorInfo)
+                               SharedStateSyncStrategy, TensorInfo,
+                               netem_inject)
 
-    comm = Communicator("127.0.0.1", args.master_port)
+    comm = Communicator("127.0.0.1", args.master_port,
+                        p2p_port=args.p2p_port, ss_port=args.ss_port,
+                        bench_port=args.bench_port)
     comm.connect()
     deadline = time.time() + 60
     while comm.global_world_size < args.world:
@@ -83,6 +102,20 @@ def main() -> int:
                 time.sleep(0.002)
         threading.Thread(target=watcher, daemon=True).start()
 
+    if args.inject_on_serve:
+        endpoint, spec = args.inject_on_serve.split("=", 1)
+
+        def injector():
+            while True:
+                e = comm.stats()["edges"].get(endpoint)
+                if e and e["tx_sync_bytes"] > 0:
+                    # the serve toward `endpoint` is counted pre-send: its
+                    # paced window is in flight RIGHT NOW — arm the fault
+                    netem_inject(endpoint, spec)
+                    return
+                time.sleep(0.001)
+        threading.Thread(target=injector, daemon=True).start()
+
     arrays = content_arrays(args.keys, args.elems, args.role == "seeder")
     rev = args.revision if args.role == "seeder" else 0
     st = SharedState([TensorInfo.from_numpy(k, v) for k, v in arrays.items()],
@@ -91,6 +124,10 @@ def main() -> int:
     info = comm.sync_shared_state(st, SharedStateSyncStrategy.ENFORCE_POPULAR)
     wall = time.perf_counter() - t0
 
+    if args.linger_s > 0:
+        # keep the mesh up so in-flight aftermath (watchdog relay detours,
+        # delivery acks) lands in the snapshot below
+        time.sleep(args.linger_s)
     stats = comm.stats()
     res = {
         "rank": args.rank,

@@ -604,7 +604,9 @@ def test_device_hash_clean_sync_never_stages(master, monkeypatch):
 
 
 def _spawn_ss_peer(master_port, world, rank, role, tmp, keys, elems,
-                   env_extra=None, revision=1, suicide_after_served=0):
+                   env_extra=None, revision=1, suicide_after_served=0,
+                   inject_on_serve=None, linger_s=0.0, p2p_port=0,
+                   ss_port=0, bench_port=0):
     import subprocess
     import sys
     result = Path(tmp) / f"peer-{rank}.json"
@@ -620,6 +622,13 @@ def _spawn_ss_peer(master_port, world, rank, role, tmp, keys, elems,
            "--result-file", str(result)]
     if suicide_after_served:
         cmd += ["--suicide-after-served", str(suicide_after_served)]
+    if inject_on_serve:
+        cmd += ["--inject-on-serve", inject_on_serve]
+    if linger_s:
+        cmd += ["--linger-s", str(linger_s)]
+    if p2p_port:
+        cmd += ["--p2p-port", str(p2p_port), "--ss-port", str(ss_port),
+                "--bench-port", str(bench_port)]
     return subprocess.Popen(cmd, env=env), result
 
 
@@ -799,6 +808,181 @@ def test_chunk_blackhole_failover(master, monkeypatch):
     assert c["ss_chunks_resourced"] >= 1
     assert (c["ss_chunk_bytes_fetched"] + c["ss_chunk_bytes_resourced"]
             - c["ss_chunk_bytes_dup"]) == nbytes
+
+
+def test_sync_edge_attribution_one_edge_per_pair(master, monkeypatch):
+    """Unified-transport attribution regression: with a WILDCARD netem map
+    armed (bare-ip key — netem matching cannot canonicalise endpoints for
+    us) a chunk-plane sync must leave exactly ONE telemetry edge per peer
+    pair, keyed by the peer's canonical p2p endpoint, with sync bytes AND
+    stripe bytes metered on that same edge. The legacy serve metered
+    against the fetcher's ss endpoint, minting phantom `ip:ss_port` edges
+    whenever chaos/pace maps keyed by the canonical p2p endpoint."""
+    monkeypatch.setenv("PCCLT_SS_CHUNK_BYTES", "131072")
+    monkeypatch.setenv("PCCLT_STRIPE_CONNS", "2")
+    # wildcard: every edge in the process shares the one ip bucket
+    monkeypatch.setenv("PCCLT_WIRE_MBPS_MAP", "127.0.0.1=400")
+    keys, elems = 4, 65536  # 4 x 256 KiB = 1 MiB, chunks of 128 KiB
+    nbytes = keys * elems * 4
+    base = alloc_ports()
+    p2p = {r: base + 10 + 4 * r for r in range(3)}
+
+    def worker(comm, rank):
+        rng = np.random.default_rng(11)
+        if rank == 0:
+            arrs = {f"k{i}": rng.standard_normal(elems).astype(np.float32)
+                    for i in range(keys)}
+            rev = 1
+        else:
+            arrs = {f"k{i}": np.zeros(elems, dtype=np.float32)
+                    for i in range(keys)}
+            rev = 0
+        info = _sync(comm, arrs, revision=rev)
+        return info.revision, comm.stats()
+
+    from pccl_tpu.comm import Communicator
+    results, errors = {}, {}
+
+    def peer(rank):
+        comm = Communicator("127.0.0.1", master.port, p2p_port=p2p[rank],
+                            ss_port=base + 40 + 4 * rank,
+                            bench_port=base + 52 + 4 * rank)
+        try:
+            comm.connect()
+            deadline = time.time() + 30
+            while comm.global_world_size < 3:
+                if time.time() > deadline:
+                    raise TimeoutError("world never formed")
+                if comm.are_peers_pending():
+                    comm.update_topology()
+                time.sleep(0.01)
+            results[rank] = worker(comm, rank)
+        except Exception as e:  # noqa: BLE001
+            errors[rank] = e
+        finally:
+            comm.destroy()
+
+    threads = [threading.Thread(target=peer, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+    tx_sync = tx_stripe = rx_sync = 0
+    for rank in range(3):
+        rev, stats = results[rank]
+        assert rev == 1
+        assert stats["counters"]["ss_legacy_syncs"] == 0
+        edges = stats["edges"]
+        # ONE edge per peer pair, keyed by the canonical p2p endpoint —
+        # no ss-port phantoms, nothing keyed by an ephemeral source port
+        expected = {f"127.0.0.1:{p2p[r]}" for r in range(3) if r != rank}
+        assert set(edges) == expected, f"rank {rank}: {sorted(edges)}"
+        for e in edges.values():
+            tx_sync += e["tx_sync_bytes"]
+            tx_stripe += e["tx_stripe_bytes"]
+            rx_sync += e["rx_sync_bytes"]
+    # both cold joiners' unique payload was metered on canonical edges,
+    # and every chunk serve rode the striped window path (>= 128 KiB
+    # ranges at PCCLT_STRIPE_CONNS=2) — chunk bytes visible in stripe
+    # counters is the ISSUE-19 acceptance signal
+    assert tx_sync >= 2 * nbytes
+    assert rx_sync >= 2 * nbytes
+    assert tx_stripe >= 2 * nbytes
+
+
+@pytest.mark.slow
+def test_swarm_world16_chaos_relay_gate(master, tmp_path):
+    """ISSUE-19 acceptance: world=16 (8 seeders + 8 cold joiners) under a
+    PCCLT_WIRE_CHAOS_MAP seeder-edge blackhole AND a busiest-seeder
+    SIGKILL. The blackhole is re-armed mid-serve (netem_inject on the
+    map-created edge, triggered by the seeder's own pre-send serve
+    accounting) so the paced in-flight window stalls and the serve-side
+    watchdog climbs the full ladder: SUSPECT fresh-conn reissue, then
+    CONFIRMED relay detour via a third peer. Gates: zero failed syncs,
+    bit-identical state, >= 1 chunk delivered via the relay detour,
+    per-chunk conservation byte-exact."""
+    import json
+
+    world, keys, elems = 16, 2, 1048576  # 2 x 4 MiB = 8 MiB state
+    nbytes = keys * elems * 4
+    base = alloc_ports(160)
+    p2p = {r: base + 8 + r for r in range(world)}
+    dark_ep = f"127.0.0.1:{p2p[8]}"  # seeder0 -> joiner8: the dark edge
+
+    common = {"PCCLT_SS_CHUNK_BYTES": "131072",
+              "PCCLT_SS_FETCH_RANGE": "8",     # 1 MiB ranges
+              "PCCLT_SS_FETCH_MIN_MS": "400",
+              "PCCLT_WATCHDOG": "1",
+              "PCCLT_WATCHDOG_MIN_MS": "150"}
+    # rank 0: the edge toward joiner 8 is exact-listed (map-created at dial
+    # time, so live conns hold it and the mid-run inject arms THE edge they
+    # pace on) and slowed to 60 Mbit so a 1 MiB serve window is in flight
+    # long enough for the injected outage to land under it
+    dark_seeder = dict(common)
+    dark_seeder["PCCLT_WIRE_MBPS_MAP"] = f"{dark_ep}=60"
+    dark_seeder["PCCLT_WIRE_CHAOS_MAP"] = f"{dark_ep}=blackhole@t=0:500ms"
+
+    procs = {}
+    for rank in range(world):
+        role = "seeder" if rank < 8 else "joiner"
+        kw = {}
+        if rank == 0:
+            kw["env_extra"] = dark_seeder
+            kw["inject_on_serve"] = f"{dark_ep}=blackhole@t=0:8000ms"
+        else:
+            kw["env_extra"] = common
+        if rank == 1:
+            kw["suicide_after_served"] = 4
+        procs[rank] = _spawn_ss_peer(
+            master.port, world, rank, role, tmp_path, keys, elems,
+            linger_s=8.0, p2p_port=p2p[rank], ss_port=base + 40 + rank,
+            bench_port=base + 72 + rank, **kw)
+
+    deadline = time.time() + 300
+    for rank, (p, _) in procs.items():
+        p.wait(timeout=max(1, deadline - time.time()))
+    assert procs[1][0].returncode == -9, "victim was not SIGKILLed"
+    assert not procs[1][1].exists()
+
+    import ss_peer as ssp
+    expected = ssp.digest_of(ssp.content_arrays(keys, elems, popular=True))
+    res = {}
+    for rank, (p, rfile) in procs.items():
+        if rank == 1:
+            continue
+        assert p.returncode == 0, f"rank {rank} failed rc={p.returncode}"
+        res[rank] = json.loads(rfile.read_text())
+        r = res[rank]
+        # bit-identical convergence, zero failed syncs, zero aborts/kicks
+        assert r["revision"] == 1
+        assert r["digest"] == expected, f"rank {rank} diverged"
+        c = r["counters"]
+        assert c["syncs_ok"] == 1 and c["syncs_failed"] == 0
+        assert c["kicked"] == 0 and c["collectives_aborted"] == 0
+        if r["role"] == "joiner":
+            assert r["rx_bytes"] == nbytes
+            # conservation byte-exact: fetched + re-sourced - dup == unique,
+            # and unique + delta-skipped == total (cold joiner: delta == 0)
+            assert (c["ss_chunk_bytes_fetched"] +
+                    c["ss_chunk_bytes_resourced"] -
+                    c["ss_chunk_bytes_dup"]) == nbytes
+            assert c["ss_chunk_bytes_delta_skipped"] == 0
+    # the SIGKILLed seeder was observed and re-sourced around
+    joiners = [res[r] for r in range(8, world)]
+    assert sum(r["counters"]["ss_seeders_lost"] for r in joiners) >= 1
+    # >= 1 chunk delivered via the relay detour: the dark seeder's ladder
+    # CONFIRMED the edge and detoured its backlog via a third peer...
+    s0 = res[0]["edges"]
+    assert sum(e["wd_relays"] for e in s0.values()) >= 1, s0
+    assert sum(e["wd_confirms"] for e in s0.values()) >= 1
+    # ...and the detoured window landed at the joiner, charged to the
+    # origin seeder's canonical edge
+    j8 = res[8]["edges"]
+    assert sum(e["rx_relay_bytes"] for e in j8.values()) >= 1, j8
+    # the injected fault actually gated live traffic
+    assert res[0]["counters"]["chaos_faults_activated"] >= 1
 
 
 @pytest.mark.slow
