@@ -313,21 +313,12 @@ void Client::ss_serve_legacy(net::Socket &sock, const net::Frame &req) {
 }
 
 bool Client::ss_serve_chunk(net::Socket &sock, const net::Frame &req) {
-    uint64_t revision, cb;
-    std::string key;
-    uint32_t first, count;
-    uint16_t req_p2p = 0;
-    try {
-        wire::Reader r(req.payload);
-        revision = r.u64();
-        key = r.str();
-        cb = r.u64();
-        first = r.u32();
-        count = r.u32();
-        try {
-            req_p2p = r.u16();
-        } catch (...) {}
-    } catch (...) { return false; }
+    auto spec = ssc::ChunkReqSpec::decode(req.payload);
+    if (!spec) return false;
+    uint64_t revision = spec->revision, cb = spec->chunk_bytes;
+    std::string key = spec->key;
+    uint32_t first = spec->first, count = spec->count;
+    uint16_t req_p2p = spec->req_p2p;
 
     // status: 0 = ok (payload follows), 1 = retry later (window/key not
     // ready — the fetcher backs off without blacklisting us), 2 = refuse
@@ -463,14 +454,15 @@ void Client::chunk_serve_pooled(const proto::Uuid &requester, uint64_t tag,
     std::string key;
     uint32_t first = 0, count = 0;
     int status = 0;
-    try {
-        wire::Reader r(spec);
-        revision = r.u64();
-        key = r.str();
-        cb = r.u64();
-        first = r.u32();
-        count = r.u32();
-    } catch (...) { status = 2; }
+    if (auto rs = ssc::ChunkReqSpec::decode(spec)) {
+        revision = rs->revision;
+        key = rs->key;
+        cb = rs->chunk_bytes;
+        first = rs->first;
+        count = rs->count;
+    } else {
+        status = 2;
+    }
 
     // the reverse route: header + payload ride OUR tx pool toward the
     // requester, landing in the rx table where its fetch worker registered
@@ -1706,11 +1698,18 @@ void Client::install_relay_handlers(
                 return;
             }
             const uint64_t len = bytes.size();
-            table->deliver_window(tag, off, std::move(bytes), edge);
-            if (ack_out) {
+            bool settled = table->deliver_window(tag, off, std::move(bytes),
+                                                 edge);
+            if (ack_out && settled) {
                 // fire-and-forget (enqueue-only: we are on an RX thread);
                 // the ack covers the RANGE — whether this copy or an
-                // earlier one placed the bytes, [off, off+len) is complete
+                // earlier one placed the bytes, [off, off+len) is durably
+                // accounted for. deliver_window withholds `settled` when
+                // any byte was skipped against a mid-write CLAIM: the
+                // claim-holder can still die and tear those bytes, and an
+                // ack would let the origin cancel the last remaining copy
+                // on lying coverage (model-checker finding,
+                // relay_vs_direct_deaths)
                 wire::Writer w;
                 w.u64(len);
                 ack_out->send_owned(net::MultiplexConn::kRelayAck, tag, off,
@@ -3172,13 +3171,13 @@ void Client::ss_fetch_worker(const std::shared_ptr<ssc::FetchPlan> &plan,
             retire();
             break;
         }
-        wire::Writer w;
-        w.u64(revision);
-        w.str(ks.name);
-        w.u64(cb);
-        w.u32(take->first);
-        w.u32(take->count);
-        auto spec = w.take();
+        ssc::ChunkReqSpec rq;
+        rq.revision = revision;
+        rq.key = ks.name;
+        rq.chunk_bytes = cb;
+        rq.first = take->first;
+        rq.count = take->count;
+        auto spec = rq.encode(/*with_p2p=*/false);
         std::vector<uint8_t> pl(16 + spec.size());
         memcpy(pl.data(), uuid_.data(), 16);
         memcpy(pl.data() + 16, spec.data(), spec.size());

@@ -1,0 +1,440 @@
+// pcclt_fuzz — structure-aware wire-decode fuzzing (docs/11, layer 5).
+//
+// Every byte sequence a peer can hand us must decode-or-reject: no crash,
+// no UB, no out-of-bounds read, and every successful decode must be a
+// fixed point of the encode<->decode pair (decode(encode(v)) re-encodes
+// to identical bytes). This binary drives EVERY wire decoder in the tree
+// against adversarial input:
+//
+//   * the 13 proto::* control-plane payload decoders (protocol.hpp);
+//   * net::FrameHeader::parse — the 21-byte data-plane frame preamble
+//     rx_loop trusts before reading a payload;
+//   * sched::Table::decode — the journaled schedule table (docs/12);
+//   * ssc::ChunkReqSpec::decode — the chunk-range request grammar both
+//     serve paths (legacy socket + pooled kChunkReq) share;
+//   * the netem env grammars: parse_chaos / parse_map / parse_chaos_map /
+//     parse_dur_ns (PCCLT_WIRE_*_MAP, PCCLT_WIRE_CHAOS_MAP).
+//
+// One binary, two drivers:
+//   * libFuzzer (clang, -DPCCLT_LIBFUZZER with -fsanitize=fuzzer):
+//     coverage-guided over LLVMFuzzerTestOneInput. The first input byte
+//     selects the target decoder, the rest is its payload — one corpus
+//     explores the whole decode surface.
+//   * standalone (default — gcc ships no libFuzzer): replays any corpus
+//     files passed as argv, then runs a deterministic structure-aware
+//     sweep: for every wire struct, encode representative instances and
+//     (a) check the round-trip fixed point, (b) decode EVERY prefix of
+//     the encoding (torn tail: each must decode-or-reject), (c) decode
+//     every single-byte corruption, (d) a seeded xorshift garbage pass.
+//     Build with PCCLT_BUILD_FLAGS="-fsanitize=address,undefined" to get
+//     the memory/UB oracle the sweep is designed for.
+//
+// `--emit-corpus DIR` writes the sweep's seed encodings as corpus files
+// (target byte + payload) for the CI fuzz lane to start from.
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "log.hpp"
+#include "netem.hpp"
+#include "protocol.hpp"
+#include "schedule.hpp"
+#include "sockets.hpp"
+#include "ss_chunk.hpp"
+#include "wire.hpp"
+
+using namespace pcclt;
+
+namespace {
+
+[[noreturn]] void die(const char *target, const char *what) {
+    fprintf(stderr, "pcclt_fuzz: %s: %s\n", target, what);
+    abort();  // crash: libFuzzer/ASan harvest the input as a finding
+}
+
+// decode(bytes) -> if accepted, encode/decode must reach a fixed point:
+// e1 = v.encode(); v2 = decode(e1) must ACCEPT and re-encode to e1.
+// (decode(bytes) need not re-encode to `bytes`: trailing optional
+// sections are tail-tolerant by design, so garbage tails are dropped.)
+template <typename T>
+void round_trip(const char *target, const std::vector<uint8_t> &bytes) {
+    auto v = T::decode(bytes);
+    if (!v) return;
+    auto e1 = v->encode();
+    auto v2 = T::decode(e1);
+    if (!v2) die(target, "re-decode of own encoding rejected");
+    if (v2->encode() != e1) die(target, "encode<->decode not a fixed point");
+}
+
+void chunk_req_target(const std::vector<uint8_t> &bytes) {
+    auto v = ssc::ChunkReqSpec::decode(bytes);
+    if (!v) return;
+    // the optional p2p tail makes the plain round-trip lossy (a present
+    // zero port re-encodes as absent); fix the tail choice and iterate
+    auto e1 = v->encode(v->req_p2p != 0);
+    auto v2 = ssc::ChunkReqSpec::decode(e1);
+    if (!v2) die("chunk_req", "re-decode of own encoding rejected");
+    if (v2->encode(v2->req_p2p != 0) != e1)
+        die("chunk_req", "encode<->decode not a fixed point");
+}
+
+void frame_header_target(const uint8_t *data, size_t size) {
+    auto fh = net::FrameHeader::parse(data, size);
+    if (!fh) return;
+    if (size < net::FrameHeader::kWire)
+        die("frame_header", "accepted a short preamble");
+    if (fh->payload > net::FrameHeader::kMaxLen - 17)
+        die("frame_header", "payload length above the frame cap");
+}
+
+void table_target(const std::vector<uint8_t> &bytes) {
+    auto t = sched::Table::decode(bytes);
+    if (!t) return;
+    auto e1 = t->encode();
+    auto t2 = sched::Table::decode(e1);
+    if (!t2) die("sched_table", "re-decode of own encoding rejected");
+    if (t2->encode() != e1) die("sched_table", "encode<->decode not a fixed point");
+}
+
+constexpr int kNumTargets = 20;
+
+void one_input(const uint8_t *data, size_t size) {
+    if (size == 0) return;
+    const int target = data[0] % kNumTargets;
+    const uint8_t *p = data + 1;
+    const size_t n = size - 1;
+    const std::vector<uint8_t> b(p, p + n);
+    const std::string s(reinterpret_cast<const char *>(p), n);
+    switch (target) {
+    case 0: round_trip<proto::HelloC2M>("hello", b); break;
+    case 1: round_trip<proto::SessionResumeC2M>("session_resume", b); break;
+    case 2: round_trip<proto::SessionResumeAck>("session_resume_ack", b); break;
+    case 3: round_trip<proto::P2PConnInfo>("p2p_conn_info", b); break;
+    case 4: round_trip<proto::CollectiveInit>("collective_init", b); break;
+    case 5: round_trip<proto::SharedStateSyncC2M>("ss_sync", b); break;
+    case 6: round_trip<proto::SharedStateSyncResp>("ss_sync_resp", b); break;
+    case 7: round_trip<proto::SyncKeyDoneC2M>("sync_key_done", b); break;
+    case 8: round_trip<proto::SeederUpdateM2C>("seeder_update", b); break;
+    case 9: round_trip<proto::ScheduleUpdateM2C>("schedule_update", b); break;
+    case 10: round_trip<proto::TelemetryDigestC2M>("telemetry_digest", b); break;
+    case 11: round_trip<proto::IncidentDumpM2C>("incident_dump", b); break;
+    case 12: round_trip<proto::OptimizeResponse>("optimize_resp", b); break;
+    case 13: frame_header_target(p, n); break;
+    case 14: table_target(b); break;
+    case 15: chunk_req_target(b); break;
+    case 16: net::netem::parse_chaos(s, "fuzz"); break;
+    case 17: net::netem::parse_map(s.c_str(), "fuzz"); break;
+    case 18: net::netem::parse_chaos_map(s.c_str()); break;
+    case 19: net::netem::parse_dur_ns(s); break;
+    }
+}
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t *data, size_t size) {
+    one_input(data, size);
+    return 0;
+}
+
+#ifndef PCCLT_LIBFUZZER
+
+namespace {
+
+// ------------------------------------------------ structure-aware seeds
+
+struct Seed {
+    const char *name;
+    uint8_t target;
+    std::vector<uint8_t> payload;
+};
+
+std::vector<uint8_t> str_bytes(const char *s) {
+    return {reinterpret_cast<const uint8_t *>(s),
+            reinterpret_cast<const uint8_t *>(s) + strlen(s)};
+}
+
+std::vector<Seed> make_seeds() {
+    std::vector<Seed> out;
+    auto add = [&](const char *name, uint8_t target,
+                   std::vector<uint8_t> payload) {
+        out.push_back({name, target, std::move(payload)});
+    };
+    proto::Uuid ua{}, ub{};
+    for (int i = 0; i < 16; ++i) { ua[i] = uint8_t(i + 1); ub[i] = uint8_t(0xF0 + i); }
+    net::Addr a4 = *net::Addr::parse("10.1.2.3", 0);
+    net::Addr a6 = *net::Addr::parse("::1", 0);
+
+    {   // empty-default + populated instance of every proto struct
+        proto::HelloC2M v;
+        add("hello_default", 0, v.encode());
+        v.peer_group = 7; v.p2p_port = 4001; v.ss_port = 4002;
+        v.bench_port = 4003; v.adv_ip = "10.1.2.3"; v.observer = 1;
+        add("hello", 0, v.encode());
+    }
+    {
+        proto::SessionResumeC2M v;
+        v.uuid = ua; v.last_revision = 42; v.p2p_port = 4001;
+        v.adv_ip = "10.1.2.3";
+        add("session_resume", 1, v.encode());
+    }
+    {
+        proto::SessionResumeAck v;
+        v.ok = 1; v.epoch = 3; v.last_revision = 42; v.reason = "rehydrated";
+        add("session_resume_ack", 2, v.encode());
+    }
+    {
+        proto::P2PConnInfo v;
+        add("p2p_conn_info_empty", 3, v.encode());
+        v.revision = 9;
+        v.peers.push_back({ua, a4, 4001, 4003, 7});
+        v.peers.push_back({ub, a6, 5001, 5003, 7});
+        v.ring = {ua, ub};
+        sched::Table t;
+        t.version = 2;
+        t.entries.push_back({0, 2, 0, 0});
+        t.entries.push_back({3, 1, 3, 1});
+        v.sched = t.encode();
+        add("p2p_conn_info", 3, v.encode());
+        add("sched_table", 14, t.encode());
+    }
+    {
+        proto::CollectiveInit v;
+        v.tag = 77; v.count = 1 << 20; v.retry = 1; v.retry_seq = 5; v.aux = 2;
+        add("collective_init", 4, v.encode());
+    }
+    {
+        proto::SharedStateSyncC2M v;
+        v.revision = 12;
+        proto::SharedStateEntryMeta m;
+        m.name = "weights"; m.count = 4096; m.hash = 0xDEADBEEF;
+        m.chunk_leaves = {1, 2, 3};
+        v.entries.push_back(m);
+        v.chunk_bytes = 1 << 20;
+        add("ss_sync", 5, v.encode());
+    }
+    {
+        proto::SharedStateSyncResp v;
+        add("ss_sync_resp_empty", 6, v.encode());
+        v.outdated = 1; v.dist_ip = a4; v.dist_port = 4002; v.revision = 12;
+        v.outdated_keys = {"weights", "opt"};
+        v.expected_hashes = {0xAA, 0xBB};
+        v.has_chunk_map = 1; v.chunk_bytes = 1 << 20; v.dist_p2p_port = 4001;
+        v.seeders = {{ua, a4, 4002, 4001}, {ub, a6, 5002, 5001}};
+        v.key_leaves = {{1, 2, 3}, {}};
+        v.key_seeders = {{0, 1}, {1}};
+        add("ss_sync_resp", 6, v.encode());
+    }
+    {
+        proto::SyncKeyDoneC2M v;
+        v.revision = 12; v.key = "weights";
+        add("sync_key_done", 7, v.encode());
+    }
+    {
+        proto::SeederUpdateM2C v;
+        v.revision = 12; v.key = "weights"; v.seeder = {ua, a4, 4002, 4001};
+        add("seeder_update", 8, v.encode());
+    }
+    {
+        proto::ScheduleUpdateM2C v;
+        v.group = 7;
+        sched::Table t;
+        t.version = 4;
+        t.entries.push_back({1, 0, 1, 3});
+        v.table = t.encode();
+        add("schedule_update", 9, v.encode());
+    }
+    {
+        proto::TelemetryDigestC2M v;
+        add("telemetry_digest_empty", 10, v.encode());
+        v.epoch = 3; v.last_seq = 100; v.interval_ms = 500;
+        v.collectives_ok = 99;
+        proto::TelemetryDigestC2M::Edge e;
+        e.endpoint = "10.1.2.3:4001"; e.tx_mbps = 940.5; e.wd_state = 2;
+        e.stage_wire_hist.sum_ns = 1234;
+        e.stage_wire_hist.buckets = {{3, 10}, {7, 2}};
+        v.edges.push_back(e);
+        v.ops.push_back({100, 5'000'000, 1'000'000});
+        v.ring_pushed = 7; v.ring_cap = 1024;
+        proto::WireHist ph;
+        ph.sum_ns = 99; ph.buckets = {{1, 1}};
+        v.phase_hists = {{2, ph}};
+        add("telemetry_digest", 10, v.encode());
+    }
+    {
+        proto::IncidentDumpM2C v;
+        v.incident_id = "inc-e3-1"; v.trigger = "collective_abort"; v.epoch = 3;
+        add("incident_dump", 11, v.encode());
+    }
+    {
+        proto::OptimizeResponse v;
+        v.complete = 0;
+        v.requests.push_back({ua, a4, 4003});
+        add("optimize_resp", 12, v.encode());
+    }
+    {   // valid data-plane frame preamble: len = 17 + 8 payload bytes
+        wire::Writer w;
+        w.u32(17 + 8);
+        w.u8(net::MultiplexConn::kRelayFwd);
+        w.u64(0x1122334455667788ull);
+        w.u64(4096);
+        add("frame_header", 13, w.take());
+    }
+    {
+        ssc::ChunkReqSpec v;
+        v.revision = 12; v.key = "weights"; v.chunk_bytes = 1 << 20;
+        v.first = 3; v.count = 4;
+        add("chunk_req", 15, v.encode(false));
+        v.req_p2p = 4001;
+        add("chunk_req_p2p", 15, v.encode(true));
+    }
+    add("chaos", 16,
+        str_bytes("flap@t=3s:500msx3;degrade@t=10s:100mbit/5s;blackhole:2s"));
+    add("map", 17, str_bytes("10.1.2.3:4001=940,10.1.2.4:4001=12.5"));
+    add("chaos_map", 18,
+        str_bytes("10.1.2.3:4001=flap@t=3s:1sx2,10.1.2.4:4001=degrade:50mbit/2s"));
+    add("dur", 19, str_bytes("200ms"));
+    return out;
+}
+
+// --------------------------------------------------- deterministic sweep
+
+uint64_t g_cases = 0;
+
+void run(const std::vector<uint8_t> &input) {
+    one_input(input.data(), input.size());
+    ++g_cases;
+}
+
+// a known-valid encoding MUST be accepted — prove it, don't just not-crash
+// (a decoder that rejects everything passes every robustness test)
+void assert_accepts(const Seed &seed) {
+    const auto &b = seed.payload;
+    bool ok = true;
+    switch (seed.target) {
+    case 0: ok = proto::HelloC2M::decode(b).has_value(); break;
+    case 1: ok = proto::SessionResumeC2M::decode(b).has_value(); break;
+    case 2: ok = proto::SessionResumeAck::decode(b).has_value(); break;
+    case 3: ok = proto::P2PConnInfo::decode(b).has_value(); break;
+    case 4: ok = proto::CollectiveInit::decode(b).has_value(); break;
+    case 5: ok = proto::SharedStateSyncC2M::decode(b).has_value(); break;
+    case 6: ok = proto::SharedStateSyncResp::decode(b).has_value(); break;
+    case 7: ok = proto::SyncKeyDoneC2M::decode(b).has_value(); break;
+    case 8: ok = proto::SeederUpdateM2C::decode(b).has_value(); break;
+    case 9: ok = proto::ScheduleUpdateM2C::decode(b).has_value(); break;
+    case 10: ok = proto::TelemetryDigestC2M::decode(b).has_value(); break;
+    case 11: ok = proto::IncidentDumpM2C::decode(b).has_value(); break;
+    case 12: ok = proto::OptimizeResponse::decode(b).has_value(); break;
+    case 13:
+        ok = net::FrameHeader::parse(b.data(), b.size()).has_value();
+        break;
+    case 14: ok = sched::Table::decode(b).has_value(); break;
+    case 15: ok = ssc::ChunkReqSpec::decode(b).has_value(); break;
+    default: {  // grammar targets: the valid seed must parse non-empty
+        const std::string s(b.begin(), b.end());
+        if (seed.target == 16)
+            ok = !net::netem::parse_chaos(s, "seed").empty();
+        else if (seed.target == 17)
+            ok = !net::netem::parse_map(s.c_str(), "seed").empty();
+        else if (seed.target == 18)
+            ok = !net::netem::parse_chaos_map(s.c_str()).empty();
+        else if (seed.target == 19)
+            ok = net::netem::parse_dur_ns(s).has_value();
+        break;
+    }
+    }
+    if (!ok) die(seed.name, "rejected a known-valid encoding");
+}
+
+void sweep() {
+    for (const auto &seed : make_seeds()) {
+        assert_accepts(seed);
+        std::vector<uint8_t> input;
+        input.push_back(seed.target);
+        input.insert(input.end(), seed.payload.begin(), seed.payload.end());
+        // torn tail: every prefix decodes-or-rejects (n == size -> the
+        // full input, which also exercises the round-trip fixed point)
+        for (size_t n = 0; n <= input.size(); ++n)
+            run({input.begin(), input.begin() + n});
+        // single-byte corruption at every position
+        for (size_t i = 1; i < input.size(); ++i) {
+            auto m = input;
+            m[i] ^= 0xFF;
+            run(m);
+        }
+        // length-field inflation: smash each u32-aligned window to huge
+        for (size_t i = 1; i + 4 <= input.size(); i += 4) {
+            auto m = input;
+            m[i] = 0xFF; m[i + 1] = 0xFF; m[i + 2] = 0xFF; m[i + 3] = 0xFE;
+            run(m);
+        }
+    }
+    // seeded xorshift garbage across all targets (deterministic)
+    uint64_t x = 0x9E3779B97F4A7C15ull;
+    auto next = [&x] {
+        x ^= x << 13; x ^= x >> 7; x ^= x << 17;
+        return x;
+    };
+    for (int t = 0; t < kNumTargets; ++t) {
+        for (int rep = 0; rep < 64; ++rep) {
+            std::vector<uint8_t> input;
+            input.push_back(uint8_t(t));
+            size_t len = next() % 96;
+            for (size_t i = 0; i < len; ++i) input.push_back(uint8_t(next()));
+            run(input);
+        }
+    }
+}
+
+// the seeds double as the CI fuzz lane's starting corpus
+int emit_corpus(const char *dir) {
+    int wrote = 0;
+    for (const auto &seed : make_seeds()) {
+        std::string path = std::string(dir) + "/" + seed.name + ".bin";
+        std::ofstream f(path, std::ios::binary | std::ios::trunc);
+        if (!f) {
+            fprintf(stderr, "pcclt_fuzz: cannot write %s\n", path.c_str());
+            return 1;
+        }
+        f.put(char(seed.target));
+        f.write(reinterpret_cast<const char *>(seed.payload.data()),
+                std::streamsize(seed.payload.size()));
+        ++wrote;
+    }
+    printf("pcclt_fuzz: wrote %d corpus seeds to %s\n", wrote, dir);
+    return 0;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    // the netem grammars warn on every malformed entry — gag them below
+    // ERROR or a sweep emits tens of thousands of expected-reject lines
+    // (the env threshold is latched by a static initializer, so set the
+    // threshold directly rather than via setenv)
+    log::set_threshold(log::Level::kError);
+    if (argc == 3 && strcmp(argv[1], "--emit-corpus") == 0)
+        return emit_corpus(argv[2]);
+    int replayed = 0;
+    for (int i = 1; i < argc; ++i) {
+        std::ifstream f(argv[i], std::ios::binary);
+        if (!f) {
+            fprintf(stderr, "pcclt_fuzz: cannot read %s\n", argv[i]);
+            return 1;
+        }
+        std::vector<uint8_t> bytes{std::istreambuf_iterator<char>(f),
+                                   std::istreambuf_iterator<char>()};
+        run(bytes);
+        ++replayed;
+    }
+    sweep();
+    printf("pcclt_fuzz: sweep ok (%" PRIu64 " cases, %d corpus files replayed)\n",
+           g_cases, replayed);
+    return 0;
+}
+
+#endif  // !PCCLT_LIBFUZZER

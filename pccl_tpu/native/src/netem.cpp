@@ -47,6 +47,8 @@ namespace {
 std::atomic<uint64_t> g_chaos_armed{0};
 std::atomic<uint64_t> g_chaos_activated{0};
 
+}  // namespace
+
 // "5s" / "200ms" / bare seconds -> ns; nullopt on garbage
 std::optional<uint64_t> parse_dur_ns(const std::string &s) {
     char *endp = nullptr;
@@ -59,8 +61,6 @@ std::optional<uint64_t> parse_dur_ns(const std::string &s) {
     else return std::nullopt;
     return static_cast<uint64_t>(v * scale);
 }
-
-}  // namespace
 
 std::vector<ChaosFault> parse_chaos(const std::string &spec, const char *what) {
     std::vector<ChaosFault> out;
@@ -455,8 +455,6 @@ double env_f(const char *name) {
 }
 }  // namespace
 
-namespace {
-
 // chaos-map split: values contain '=' (t=5s) and faults are ';'-joined,
 // so the generic parse_map (last-'=' split, numeric values) cannot serve —
 // split entries on ',' and the key at the FIRST '='
@@ -481,8 +479,6 @@ std::map<std::string, std::string> parse_chaos_map(const char *spec) {
     }
     return out;
 }
-
-}  // namespace
 
 void Registry::refresh() {
     MutexLock lk(mu_);

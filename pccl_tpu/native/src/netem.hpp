@@ -60,6 +60,7 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <optional>
 #include <string>
 #include <vector>
 
@@ -115,6 +116,16 @@ struct ChaosVerdict {
 // with a warning (mirroring parse_map); empty result = nothing usable.
 std::vector<ChaosFault> parse_chaos(const std::string &spec,
                                     const char *what);
+
+// "5s" / "200ms" / bare seconds -> ns; nullopt on garbage. Exposed for
+// the decode fuzzer (the chaos grammar's duration leaf).
+std::optional<uint64_t> parse_dur_ns(const std::string &s);
+
+// PCCLT_WIRE_CHAOS_MAP split: values contain '=' (t=5s) and faults are
+// ';'-joined, so the generic parse_map (last-'=' split, numeric values)
+// cannot serve — entries split on ',', the key at the FIRST '='.
+// Exposed for tests and the decode fuzzer.
+std::map<std::string, std::string> parse_chaos_map(const char *spec);
 
 // Arm `spec` on the edge resolved for `endpoint` ("ip:port") right now
 // (offsets relative to the call). Returns false when the spec parses to

@@ -1056,6 +1056,187 @@ static void test_wire() {
     }
 }
 
+// Deterministic torn-tail sweep over every wire struct: encode a populated
+// instance, then decode EVERY prefix of the encoding. Each prefix must
+// decode-or-reject — never crash, never read past the buffer (the ASan
+// build is the oracle) — and whatever a prefix DOES decode must re-encode
+// to a fixed point (trailing sections are tail-tolerant by design, so
+// short prefixes may legitimately be accepted as older-peer encodings).
+// pcclt_fuzz runs the same sweep plus corruption passes; this copy keeps
+// the property pinned in the default selftest lane.
+template <typename T>
+static void trunc_sweep(const T &v) {
+    auto full = v.encode();
+    CHECK(T::decode(full).has_value());
+    for (size_t n = 0; n <= full.size(); ++n) {
+        std::vector<uint8_t> pre(full.begin(), full.begin() + n);
+        auto d = T::decode(pre);
+        if (n == full.size()) CHECK(d.has_value());
+        if (d) {
+            auto e1 = d->encode();
+            auto d2 = T::decode(e1);
+            CHECK(d2 && d2->encode() == e1);
+        }
+    }
+}
+
+static void test_proto_truncation() {
+    proto::Uuid ua{};
+    for (int i = 0; i < 16; ++i) ua[i] = static_cast<uint8_t>(i + 1);
+    net::Addr a4 = *net::Addr::parse("10.1.2.3", 0);
+
+    proto::HelloC2M hello;
+    hello.peer_group = 7;
+    hello.adv_ip = "10.1.2.3";
+    hello.observer = 1;
+    trunc_sweep(hello);
+
+    proto::SessionResumeC2M resume;
+    resume.uuid = ua;
+    resume.last_revision = 42;
+    resume.adv_ip = "10.1.2.3";
+    trunc_sweep(resume);
+
+    proto::SessionResumeAck rack;
+    rack.ok = 1;
+    rack.reason = "rehydrated";
+    trunc_sweep(rack);
+
+    proto::P2PConnInfo p2p;
+    p2p.revision = 9;
+    p2p.peers.push_back({ua, a4, 4001, 4003, 7});
+    p2p.ring = {ua};
+    sched::Table table;
+    table.version = 2;
+    table.entries.push_back({0, 2, 0, 0});
+    p2p.sched = table.encode();
+    trunc_sweep(p2p);
+
+    proto::CollectiveInit init;
+    init.tag = 77;
+    init.count = 1 << 20;
+    init.retry = 1;
+    init.retry_seq = 5;
+    init.aux = 2;
+    trunc_sweep(init);
+
+    proto::SharedStateSyncC2M sync;
+    sync.revision = 12;
+    proto::SharedStateEntryMeta meta;
+    meta.name = "weights";
+    meta.count = 4096;
+    meta.chunk_leaves = {1, 2, 3};
+    sync.entries.push_back(meta);
+    sync.chunk_bytes = 1 << 20;
+    trunc_sweep(sync);
+
+    proto::SharedStateSyncResp resp;
+    resp.outdated = 1;
+    resp.dist_ip = a4;
+    resp.revision = 12;
+    resp.outdated_keys = {"weights"};
+    resp.expected_hashes = {0xAA};
+    resp.has_chunk_map = 1;
+    resp.chunk_bytes = 1 << 20;
+    resp.seeders = {{ua, a4, 4002, 4001}};
+    resp.key_leaves = {{1, 2, 3}};
+    resp.key_seeders = {{0}};
+    trunc_sweep(resp);
+
+    proto::SyncKeyDoneC2M done;
+    done.revision = 12;
+    done.key = "weights";
+    trunc_sweep(done);
+
+    proto::SeederUpdateM2C supd;
+    supd.revision = 12;
+    supd.key = "weights";
+    supd.seeder = {ua, a4, 4002, 4001};
+    trunc_sweep(supd);
+
+    proto::ScheduleUpdateM2C schu;
+    schu.group = 7;
+    schu.table = table.encode();
+    trunc_sweep(schu);
+
+    proto::TelemetryDigestC2M dig;
+    dig.epoch = 3;
+    proto::TelemetryDigestC2M::Edge edge;
+    edge.endpoint = "10.1.2.3:4001";
+    edge.wd_state = 2;
+    edge.stage_wire_hist.sum_ns = 1234;
+    edge.stage_wire_hist.buckets = {{3, 10}};
+    dig.edges.push_back(edge);
+    dig.ops.push_back({100, 5'000'000, 1'000'000});
+    proto::WireHist ph;
+    ph.sum_ns = 99;
+    ph.buckets = {{1, 1}};
+    dig.phase_hists = {{2, ph}};
+    trunc_sweep(dig);
+
+    proto::IncidentDumpM2C inc;
+    inc.incident_id = "inc-e3-1";
+    inc.trigger = "collective_abort";
+    inc.epoch = 3;
+    trunc_sweep(inc);
+
+    proto::OptimizeResponse opt;
+    opt.requests.push_back({ua, a4, 4003});
+    trunc_sweep(opt);
+
+    {   // schedule table: span-decode every prefix of a valid encoding
+        auto full = table.encode();
+        CHECK(sched::Table::decode(full).has_value());
+        for (size_t n = 0; n < full.size(); ++n) {
+            auto d = sched::Table::decode({full.data(), n});
+            if (d) CHECK(sched::Table::decode(d->encode()).has_value());
+        }
+    }
+    {   // chunk-range request, with and without the optional p2p tail
+        ssc::ChunkReqSpec rq;
+        rq.revision = 12;
+        rq.key = "weights";
+        rq.chunk_bytes = 1 << 20;
+        rq.first = 3;
+        rq.count = 4;
+        for (bool p2pb : {false, true}) {
+            rq.req_p2p = p2pb ? 4001 : 0;
+            auto full = rq.encode(p2pb);
+            CHECK(ssc::ChunkReqSpec::decode(full).has_value());
+            for (size_t n = 0; n < full.size(); ++n) {
+                std::vector<uint8_t> pre(full.begin(), full.begin() + n);
+                ssc::ChunkReqSpec::decode(pre);  // decode-or-reject
+            }
+        }
+    }
+    {   // data-plane frame preamble: exact length gate, torn prefixes reject
+        wire::Writer w;
+        w.u32(17 + 8);
+        w.u8(net::MultiplexConn::kRelayFwd);
+        w.u64(0x1122334455667788ull);
+        w.u64(4096);
+        auto full = w.take();
+        CHECK(full.size() == net::FrameHeader::kWire);
+        auto fh = net::FrameHeader::parse(full.data(), full.size());
+        CHECK(fh && fh->kind == net::MultiplexConn::kRelayFwd &&
+              fh->payload == 8 && fh->off == 4096);
+        for (size_t n = 0; n < full.size(); ++n)
+            CHECK(!net::FrameHeader::parse(full.data(), n));
+        // the two length gates: len < 17 and len > kMaxLen both reject
+        wire::Writer bad_lo, bad_hi;
+        bad_lo.u32(16);
+        bad_hi.u32(net::FrameHeader::kMaxLen + 1);
+        for (auto *bw : {&bad_lo, &bad_hi}) {
+            bw->u8(0);
+            bw->u64(0);
+            bw->u64(0);
+            auto b = bw->take();
+            CHECK(!net::FrameHeader::parse(b.data(), b.size()));
+        }
+    }
+    fprintf(stderr, "proto truncation sweep: ok\n");
+}
+
 static void test_hash() {
     const char *s = "the quick brown fox jumps over the lazy dog";
     uint64_t h1 = hash::simplehash(s, strlen(s));
@@ -2494,6 +2675,7 @@ int main() {
     test_netem_striped_bucket();
     test_watchdog();
     test_wire();
+    test_proto_truncation();
     test_hash();
     test_ss_chunk();
     test_kernels();

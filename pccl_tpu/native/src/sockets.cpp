@@ -605,6 +605,33 @@ bool SinkTable::Sink::fully_covered(size_t off, size_t end) const {
     return true;
 }
 
+size_t SinkTable::Sink::published_overlap(size_t off, size_t end) const {
+    // count bytes of [off, end) already covered by prefix + extents.
+    // Claims are deliberately excluded: a claim's owner runs this same
+    // accounting when its own write publishes, so each byte's FIRST
+    // publisher counts zero and every later overlapping publisher counts
+    // exactly its overlap — no byte is double-charged.
+    size_t overlap = 0;
+    size_t at = off;
+    while (at < end) {
+        size_t covered_to = 0;
+        if (at < prefix) covered_to = prefix;
+        auto it = extents.upper_bound(at);
+        if (it != extents.begin()) {
+            auto p = std::prev(it);
+            if (p->second > at) covered_to = std::max(covered_to, p->second);
+        }
+        if (covered_to > at) {
+            size_t to = std::min(covered_to, end);
+            overlap += to - at;
+            at = to;
+            continue;
+        }
+        at = it != extents.end() ? std::min(end, it->first) : end;
+    }
+    return overlap;
+}
+
 size_t SinkTable::place_deduped(Sink &s, uint64_t tag, uint64_t off,
                                 const uint8_t *bytes, size_t len) {
     // copy only the gaps the coverage map leaves open. Claimed ranges are
@@ -644,35 +671,49 @@ size_t SinkTable::place_deduped(Sink &s, uint64_t tag, uint64_t off,
     return delivered;
 }
 
-void SinkTable::deliver_window(uint64_t tag, uint64_t off,
+bool SinkTable::deliver_window(uint64_t tag, uint64_t off,
                                std::vector<uint8_t> bytes,
                                telemetry::EdgeCounters *origin) {
     const size_t n = bytes.size();
     size_t delivered = 0;
     bool handled = false;
+    bool ack_ok = false;
     {
         MutexLock lk(mu_);
         if (is_retired(tag)) {
             handled = true;  // straggler for a finished op: drop + count dup
+            ack_ok = true;   // the op is done — its bytes are settled
         } else {
             auto it = sinks_.find(tag);
             if (it != sinks_.end() && !it->second.cancel &&
                 off + n <= it->second.cap) {
                 delivered = place_deduped(it->second, tag, off, bytes.data(), n);
                 handled = true;
+                // model-checker finding (relay_vs_direct_deaths): ack only
+                // a range that is fully PUBLISHED. Bytes this window
+                // skipped because an RX thread holds a mid-write claim are
+                // not durable — the claim-holder can die and tear them,
+                // and an ack here would let the origin cancel the last
+                // copy of those bytes on lying coverage.
+                ack_ok = it->second.published_overlap(off, off + n) == n;
             } else if (it == sinks_.end()) {
                 // raced ahead of the stage's registration: park it;
                 // register_sink drains with the same dedupe + accounting
                 relay_pending_.emplace(tag,
                                        PendingRelay{off, std::move(bytes),
                                                     origin});
+                ack_ok = true;  // held verbatim until the sink appears
             } else {
                 handled = true;  // cancelled/overflow: unwanted, count dup
+                // a cancelled sink means the consumer is tossing the op —
+                // acking cannot lose bytes anyone still wants; an
+                // overflowing window is malformed and must NOT be acked
+                ack_ok = it->second.cancel;
             }
         }
     }
     signal_tag(tag);
-    if (!handled || !origin) return;
+    if (!handled || !origin) return ack_ok;
     // symmetric with the direct path's rx_bytes: EVERY handled relay byte
     // counts as received, and the not-delivered remainder as duplicate —
     // so rx_bytes + rx_relay_bytes - dup_bytes == unique payload, exactly
@@ -683,6 +724,7 @@ void SinkTable::deliver_window(uint64_t tag, uint64_t off,
         if (delivered == 0)
             origin->dup_windows.fetch_add(1, std::memory_order_relaxed);
     }
+    return ack_ok;
 }
 
 void SinkTable::Sink::add_extent(size_t off, size_t end) {
@@ -990,7 +1032,6 @@ bool cma_enabled_env() {
 //    shm) on emulated edges: an emulated WAN cannot be bypassed
 
 constexpr size_t kRxSlice = 256 << 10;  // TCP sink write slice (cancel latency)
-constexpr uint32_t kMaxDataFrame = 272u << 20;
 
 // process_vm_readv slice. Measured on the target host class, the kernel's
 // pin-and-copy path peaks at small-to-mid slices (64K–512K ≈ 4.4 GB/s) and
@@ -1003,6 +1044,23 @@ size_t cma_slice() {
 }
 
 } // namespace
+
+std::optional<FrameHeader> FrameHeader::parse(const uint8_t *hdr, size_t n) {
+    if (n < kWire) return std::nullopt;
+    uint32_t be_len;
+    uint64_t be_tag, be_off;
+    memcpy(&be_len, hdr, 4);
+    memcpy(&be_tag, hdr + 5, 8);
+    memcpy(&be_off, hdr + 13, 8);
+    uint32_t len = wire::from_be(be_len);
+    if (len < 17 || len > kMaxLen) return std::nullopt;
+    FrameHeader fh;
+    fh.kind = hdr[4];
+    fh.tag = wire::from_be(be_tag);
+    fh.off = wire::from_be(be_off);
+    fh.payload = len - 17;
+    return fh;
+}
 
 MultiplexConn::MultiplexConn(Socket sock, std::shared_ptr<SinkTable> table,
                              std::shared_ptr<telemetry::Domain> dom)
@@ -1992,22 +2050,17 @@ bool MultiplexConn::uring_recv_sink(uint8_t *dst, size_t n, uint64_t tag,
 void MultiplexConn::rx_loop() {
     std::vector<uint8_t> scratch;
     while (alive_.load()) {
-        uint8_t hdr[21];
-        if (!sock_.recv_all(hdr, 21)) break;
-        uint32_t be_len;
-        uint64_t be_tag, be_off;
-        memcpy(&be_len, hdr, 4);
-        uint8_t kind = hdr[4];
-        memcpy(&be_tag, hdr + 5, 8);
-        memcpy(&be_off, hdr + 13, 8);
-        uint32_t len = wire::from_be(be_len);
-        uint64_t tag = wire::from_be(be_tag);
-        uint64_t off = wire::from_be(be_off);
-        if (len < 17 || len > kMaxDataFrame) {
-            PLOG(kError) << "multiplex rx: bad frame length " << len;
+        uint8_t hdr[FrameHeader::kWire];
+        if (!sock_.recv_all(hdr, sizeof hdr)) break;
+        auto fh = FrameHeader::parse(hdr, sizeof hdr);
+        if (!fh) {
+            PLOG(kError) << "multiplex rx: bad frame header";
             break;
         }
-        size_t n = len - 17;
+        uint8_t kind = fh->kind;
+        uint64_t tag = fh->tag;
+        uint64_t off = fh->off;
+        size_t n = fh->payload;
 
         if (kind == kCmaAck || kind == kCmaAckDrop || kind == kCmaNack) {
             SendHandle st;
@@ -2363,25 +2416,50 @@ void MultiplexConn::rx_loop() {
                     // inside the visibility delay still reads as covered)
                     if (!(delivered && delay_ns > 0))
                         it->second.claims.erase(off);
-                    if (delivered && delay_ns == 0)
+                    if (delivered && delay_ns == 0) {
+                        // model-checker finding: a committed direct write
+                        // whose range partially overlaps already-published
+                        // bytes grew coverage by the fresh remainder only —
+                        // the overlap is a duplicate and must be counted,
+                        // or rx + relay - dup drifts from unique on every
+                        // relay-vs-direct race with misaligned windows
+                        size_t ovl =
+                            it->second.published_overlap(off, off + n);
+                        if (ovl)
+                            edge().dup_bytes.fetch_add(
+                                ovl, std::memory_order_relaxed);
                         it->second.add_extent(off, off + n);
+                    }
                 }
             }
             if (delivered && delay_ns > 0) {
                 // bytes already landed zero-copy in the sink; only their
                 // VISIBILITY (extent + wakeup) rides the delay line
                 netem::DelayLine::inst().deliver(
-                    delay_ns, [tbl = table_, tag, off, n] {
+                    delay_ns,
+                    [tbl = table_, tag, off, n, dom = dom_, ec = &edge()] {
                         {
                             MutexLock lk(tbl->mu_);
                             auto it = tbl->sinks_.find(tag);
                             if (it != tbl->sinks_.end()) {
                                 it->second.claims.erase(off);
                                 if (!it->second.cancel &&
-                                    off + n <= it->second.cap)
+                                    off + n <= it->second.cap) {
+                                    // model-checker finding: same overlap
+                                    // accounting as the undelayed commit —
+                                    // a failover copy published inside the
+                                    // visibility delay makes our overlap a
+                                    // duplicate
+                                    size_t ovl = it->second.published_overlap(
+                                        off, off + n);
+                                    if (ovl)
+                                        ec->dup_bytes.fetch_add(
+                                            ovl, std::memory_order_relaxed);
                                     it->second.add_extent(off, off + n);
+                                }
                             }
                         }
+                        (void)dom;  // keeps the counter domain alive
                         tbl->signal_tag(tag);
                     });
             } else {
@@ -2424,13 +2502,28 @@ void MultiplexConn::rx_loop() {
                                     it->second, tag, off, bytes.data(), n);
                                 placed = true;
                             } else if (!tbl->is_retired(tag)) {
-                                std::vector<uint8_t> qf(8 + n);
-                                memcpy(qf.data(), &off, 8);
-                                if (n > 0)
-                                    memcpy(qf.data() + 8, bytes.data(), n);
-                                tbl->queues_[tag].push_back(std::move(qf));
-                                delivered = n;
-                                placed = true;
+                                // model-checker finding: same exact-duplicate
+                                // queue dedupe as the undelayed path — a
+                                // dropped copy stays placed=false and is
+                                // charged as a dup below
+                                auto &q = tbl->queues_[tag];
+                                bool dup_q = false;
+                                for (const auto &f : q)
+                                    if (f.size() == 8 + n &&
+                                        memcmp(f.data(), &off, 8) == 0) {
+                                        dup_q = true;
+                                        break;
+                                    }
+                                if (!dup_q) {
+                                    std::vector<uint8_t> qf(8 + n);
+                                    memcpy(qf.data(), &off, 8);
+                                    if (n > 0)
+                                        memcpy(qf.data() + 8, bytes.data(),
+                                               n);
+                                    q.push_back(std::move(qf));
+                                    delivered = n;
+                                    placed = true;
+                                }
                             }
                         }
                         if (!placed || delivered < bytes.size())
@@ -2455,11 +2548,28 @@ void MultiplexConn::rx_loop() {
                     delivered = table_->place_deduped(it->second, tag, off,
                                                       scratch.data(), n);
                 } else if (!table_->is_retired(tag)) {
-                    // queued frames carry their offset in the first 8 bytes
-                    std::vector<uint8_t> qf(8 + n);
-                    memcpy(qf.data(), &off, 8);
-                    if (n > 0) memcpy(qf.data() + 8, scratch.data(), n);
-                    table_->queues_[tag].push_back(std::move(qf));
+                    // queued frames carry their offset in the first 8 bytes.
+                    // model-checker finding: a re-issued window racing sink
+                    // registration must not queue twice — register_sink's
+                    // drain publishes extents with no dup accounting, so an
+                    // exact (off, len) duplicate would double-publish
+                    // uncounted. Drop it here and charge it as a dup.
+                    auto &q = table_->queues_[tag];
+                    bool dup_q = false;
+                    for (const auto &f : q)
+                        if (f.size() == 8 + n &&
+                            memcmp(f.data(), &off, 8) == 0) {
+                            dup_q = true;
+                            break;
+                        }
+                    if (dup_q) {
+                        placed = false;
+                    } else {
+                        std::vector<uint8_t> qf(8 + n);
+                        memcpy(qf.data(), &off, 8);
+                        if (n > 0) memcpy(qf.data() + 8, scratch.data(), n);
+                        q.push_back(std::move(qf));
+                    }
                 } else {
                     // retired tag: straggler from a purged op — drop
                     placed = false;

@@ -125,6 +125,23 @@ bool send_frame(Socket &s, Mutex &write_mu, uint16_t type,
                 std::span<const uint8_t> payload) PCCLT_EXCLUDES(write_mu);
 // blocking; returns nullopt on disconnect/error
 std::optional<Frame> recv_frame(Socket &s);
+
+// --- data-plane frame preamble (MultiplexConn wire format) ---
+// Every multiplexed frame leads with the fixed 21-byte header
+// [4B be len][1B kind][8B be tag][8B be off]; `len` counts kind + tag +
+// off + payload, so a well-formed frame has len in [17, kMaxLen]. The
+// parse is factored out of rx_loop so the wire-decode fuzzer can drive
+// it byte-for-byte (tools: pcclt_fuzz).
+struct FrameHeader {
+    static constexpr size_t kWire = 21;
+    static constexpr uint32_t kMaxLen = 272u << 20;
+    uint8_t kind = 0;
+    uint64_t tag = 0;
+    uint64_t off = 0;
+    size_t payload = 0;  // len - 17 bytes follow the preamble
+    // nullopt on a short buffer or a length outside [17, kMaxLen]
+    static std::optional<FrameHeader> parse(const uint8_t *hdr, size_t n);
+};
 // bounded: returns nullopt on disconnect/error/deadline (for handshake
 // threads that must not block forever on a silent connection)
 std::optional<Frame> recv_frame(Socket &s, int timeout_ms);
@@ -312,7 +329,15 @@ public:
     // charged to origin->rx_relay_*. With no sink yet (window raced ahead
     // of the stage's registration) the window parks in relay_pending_ and
     // register_sink drains it with the same dedupe + accounting.
-    void deliver_window(uint64_t tag, uint64_t off,
+    //
+    // Returns whether [off, off+len) is DURABLY accounted for after the
+    // call (published, parked, or belongs to a finished/cancelled op) —
+    // the gate for the end-to-end kRelayAck. Bytes skipped because an RX
+    // thread holds a CLAIM over them are NOT durable: the claim-holder
+    // can still die mid-write and tear them, so acking such a range lets
+    // the origin cancel the only remaining copy of those bytes
+    // (model-checker finding, relay_vs_direct_deaths).
+    bool deliver_window(uint64_t tag, uint64_t off,
                         std::vector<uint8_t> bytes,
                         telemetry::EdgeCounters *origin);
 
@@ -344,6 +369,10 @@ private:
         void add_extent(size_t off, size_t end);
         // covered-by prefix/extents/claims test for the dedupe
         bool fully_covered(size_t off, size_t end) const;
+        // bytes of [off, end) already published (prefix/extents only —
+        // NOT claims: an in-flight claimant counts its own overlap when
+        // its write publishes). Feeds dup_bytes at direct-commit time.
+        size_t published_overlap(size_t off, size_t end) const;
     };
     struct PendingDesc { // CMA descriptor that arrived before its sink
         std::weak_ptr<MultiplexConn> ack_conn; // conn to pull through and ack on

@@ -44,6 +44,41 @@ uint64_t root_hash(hash::Type t, const std::vector<uint64_t> &leaves) {
     return hash::content_hash(t, buf.data(), buf.size());
 }
 
+// ----------------------------------------------------------- request wire
+
+std::vector<uint8_t> ChunkReqSpec::encode(bool with_p2p) const {
+    wire::Writer w;
+    w.u64(revision);
+    w.str(key);
+    w.u64(chunk_bytes);
+    w.u32(first);
+    w.u32(count);
+    if (with_p2p) w.u16(req_p2p);
+    return w.take();
+}
+
+std::optional<ChunkReqSpec> ChunkReqSpec::decode(
+        const std::vector<uint8_t> &b) {
+    ChunkReqSpec s;
+    try {
+        wire::Reader r(b);
+        s.revision = r.u64();
+        s.key = r.str();
+        s.chunk_bytes = r.u64();
+        s.first = r.u32();
+        s.count = r.u32();
+        // the p2p port tail is optional: the pooled spec stops at count.
+        // A torn tail (1 stray byte) is still a reject, not a fuzzer
+        // finding — the reader throws and we fall back to "absent".
+        try {
+            s.req_p2p = r.u16();
+        } catch (...) {}
+    } catch (...) {
+        return std::nullopt;
+    }
+    return s;
+}
+
 // ------------------------------------------------------------- FetchPlan
 
 FetchPlan::FetchPlan(std::vector<KeySpec> keys, uint64_t chunk_bytes,

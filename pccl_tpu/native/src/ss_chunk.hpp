@@ -51,6 +51,27 @@ std::vector<uint64_t> leaf_hashes(hash::Type t, const void *data,
 // simply carry no leaves; their dirty keys take the legacy path).
 uint64_t root_hash(hash::Type t, const std::vector<uint64_t> &leaves);
 
+// ----------------------------------------------------------- request wire
+
+// One chunk-range request as it crosses the wire (legacy kSSChunkReq
+// socket payload and the pooled kChunkReq spec share this grammar):
+//   revision u64, key str, chunk_bytes u64, first u32, count u32,
+//   then optionally the requester's p2p port (u16) — only the legacy
+//   socket path sends it (the pooled path already knows the route back).
+// Both serve paths in client.cpp decode through here, and pcclt_fuzz
+// drives decode() directly with adversarial bytes.
+struct ChunkReqSpec {
+    uint64_t revision = 0;
+    std::string key;
+    uint64_t chunk_bytes = 0;
+    uint32_t first = 0, count = 0;
+    uint16_t req_p2p = 0;              // 0 = absent (pooled requests)
+
+    std::vector<uint8_t> encode(bool with_p2p) const;
+    static std::optional<ChunkReqSpec> decode(
+        const std::vector<uint8_t> &b);
+};
+
 // ------------------------------------------------------------- fetch plan
 
 // One outdated key the plan must fill.

@@ -29,4 +29,5 @@ wait
 $CXX -shared $FLAGS $EXTRA_FLAGS -o $OUT/libpcclt.so $objs
 $CXX $FLAGS $EXTRA_FLAGS -Isrc -o $OUT/pcclt_selftest $SRC/selftest.cpp -L$OUT -lpcclt -Wl,-rpath,'$ORIGIN'
 $CXX $FLAGS $EXTRA_FLAGS -Isrc -o $OUT/pcclt_socktest $SRC/socktest.cpp -L$OUT -lpcclt -Wl,-rpath,'$ORIGIN'
+$CXX $FLAGS $EXTRA_FLAGS -Isrc -o $OUT/pcclt_fuzz $SRC/fuzz_decode.cpp -L$OUT -lpcclt -Wl,-rpath,'$ORIGIN'
 echo "build ok"

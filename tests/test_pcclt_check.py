@@ -119,8 +119,8 @@ def test_abi_catches_field_count_mismatch(abi_tree):
 def proto_tree(tmp_path):
     for rel in (f"{SRC}/protocol.hpp", f"{SRC}/protocol.cpp",
                 f"{SRC}/client.cpp", f"{SRC}/master.cpp",
-                f"{SRC}/master_state.cpp", f"{SRC}/sockets.cpp",
-                f"{SRC}/benchmark.cpp"):
+                f"{SRC}/master_state.cpp", f"{SRC}/sockets.hpp",
+                f"{SRC}/sockets.cpp", f"{SRC}/benchmark.cpp"):
         (tmp_path / rel).parent.mkdir(parents=True, exist_ok=True)
         shutil.copy(ROOT / rel, tmp_path / rel)
     return tmp_path
@@ -155,6 +155,30 @@ def test_protocol_catches_missing_dispatch_arm(proto_tree):
     out = protocol_ids.check(proto_tree)
     assert any("kC2MOptimizeTopology" in f.message and "dispatch arm" in f.message
                for f in out), _msgs(out)
+
+
+def test_protocol_catches_orphaned_frame_kind(proto_tree):
+    _edit(proto_tree, f"{SRC}/sockets.hpp",
+          "kChunkHdr = 12,", "kChunkHdr = 12,\n        kBogusKind = 13,")
+    out = protocol_ids.check(proto_tree)
+    assert any("kBogusKind" in f.message and "rx handler arm" in f.message
+               for f in out), _msgs(out)
+    assert any("kBogusKind" in f.message and "tx_loop" in f.message
+               for f in out), _msgs(out)
+
+
+def test_protocol_catches_duplicate_frame_kind_value(proto_tree):
+    _edit(proto_tree, f"{SRC}/sockets.hpp",
+          "kChunkHdr = 12,", "kChunkHdr = 11,")
+    out = protocol_ids.check(proto_tree)
+    assert any("reuses wire value 11" in f.message for f in out), _msgs(out)
+
+
+def test_protocol_catches_lost_kdata_marker(proto_tree):
+    _edit(proto_tree, f"{SRC}/sockets.cpp",
+          "// kData — sink fast path", "// data path")
+    out = protocol_ids.check(proto_tree)
+    assert any("sink fast path" in f.message for f in out), _msgs(out)
 
 
 def test_protocol_catches_missing_decoder(proto_tree):

@@ -455,3 +455,176 @@ def test_mutation_no_moot_decline_strands_voter():
     explore(sc)  # the decline keeps this live
     with pytest.raises(Violation, match="stuck world|livelock"):
         explore(sc, NoMootDecline)
+
+
+# ------------------------------------------------- data-plane checker
+# The frame-flow model checker (dataplane_check): clean on the real
+# tree, conformance drift caught both ways, and the invariant suite
+# kept honest by single-rule mutations of the SinkTable / ack models.
+
+
+from tools.pcclt_verify import dataplane_check as dp
+from tools.pcclt_verify.dataplane_spec import AckModel, TableModel
+
+
+def _dp_scenario(name: str) -> dp.Scenario:
+    for sc in dp.default_scenarios():
+        if sc.name == name:
+            return sc
+    raise AssertionError(f"no dataplane scenario {name}")
+
+
+def test_dataplane_real_tree_clean():
+    out = dp.check(ROOT)
+    assert out == [], _msgs(out)
+
+
+def test_dataplane_default_suite_explores_all_faults():
+    # every adversarial action class must actually fire somewhere in the
+    # suite — a fault the explorer never schedules is a vacuous guarantee
+    import collections
+    counts: "collections.Counter[str]" = collections.Counter()
+    orig = dp.apply_action
+
+    def counting(w, act):
+        counts[act[0]] += 1
+        return orig(w, act)
+
+    dp.apply_action = counting
+    try:
+        for sc in dp.default_scenarios():
+            dp.explore(sc)
+    finally:
+        dp.apply_action = orig
+    for needed in ("dup_frame", "relay_dup", "cancel", "lose", "die",
+                   "seeder_die", "resource", "suspect", "confirm",
+                   "reissue"):
+        assert counts[needed] > 0, f"suite never explores {needed!r}"
+
+
+# ---- mutations: break one rule, the invariant that rule protects fails
+
+
+class NoDedup(TableModel):
+    """First-arrival-wins dedupe removed: a duplicated direct frame is
+    claimed and committed a second time, and the commit-side overlap
+    accounting is silenced with it."""
+
+    def dedup_direct(self, s, off, end):
+        return False
+
+    def dup_on_commit(self, length, fresh):
+        return 0
+
+
+def test_dataplane_mutation_no_dedup_breaks_conservation():
+    with pytest.raises(dp.Violation, match="conservation"):
+        dp.explore(_dp_scenario("stripe_reorder_dup"), NoDedup)
+
+
+class NoAckMerge(AckModel):
+    """Interval merge replaced by a summed byte total: a window acked
+    twice counts double, so coverage of [0, n) is claimed after 2 acks
+    of the same [0, n/2) sub-range."""
+
+    def __init__(self):
+        super().__init__()
+        self.totals: "dict[int, int]" = {}
+
+    def copy(self):
+        a = super().copy()
+        a.totals = dict(self.totals)
+        return a
+
+    def freeze(self):
+        return (super().freeze(), tuple(sorted(self.totals.items())))
+
+    def note_ack(self, tag, off, length):
+        self.totals[tag] = self.totals.get(tag, 0) + length
+        super().note_ack(tag, off, length)
+
+    def ack_covered(self, tag, off, length):
+        return self.totals.get(tag, 0) >= length
+
+
+def test_dataplane_mutation_no_ack_merge_unsound_cancel():
+    # the duplicated relay window in relay_vs_direct double-acks [0, 2);
+    # the summed total then "covers" [0, 4) and cancels the direct zombie
+    # while bytes [2, 4) never arrived
+    with pytest.raises(dp.Violation, match="ack-retire unsound"):
+        dp.explore(_dp_scenario("relay_vs_direct"), TableModel, NoAckMerge)
+
+
+class NoUnretire(TableModel):
+    """register_sink no longer removes the previous incarnation's retire
+    marker: round-2 relay deliveries are silently eaten by the stale
+    marker while their end-to-end acks still fire and cancel live
+    copies whose bytes never landed."""
+
+    def unretire_on_register(self, tag):
+        pass
+
+
+def test_dataplane_mutation_no_unretire_detected():
+    with pytest.raises(dp.Violation,
+                       match="ack-retire unsound|stuck world|livelock"):
+        dp.explore(_dp_scenario("retire_tag_reuse"), NoUnretire)
+
+
+# ---- conformance drift: edit the real dispatch surface, catch it
+
+
+@pytest.fixture
+def dp_tree(tmp_path):
+    for rel in (f"{SRC}/sockets.hpp", f"{SRC}/sockets.cpp",
+                f"{SRC}/client.cpp", f"{SRC}/reduce.cpp",
+                f"{SRC}/telemetry.hpp", f"{SRC}/ss_chunk.hpp"):
+        (tmp_path / rel).parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(ROOT / rel, tmp_path / rel)
+    return tmp_path
+
+
+def test_dataplane_conformance_copy_of_real_tree_passes(dp_tree):
+    assert dp.conformance_findings(dp_tree) == []
+
+
+def test_dataplane_conformance_catches_new_kind(dp_tree):
+    _edit(dp_tree, f"{SRC}/sockets.hpp",
+          "kChunkHdr = 12,",
+          "kChunkHdr = 12,\n        kBrandNewKind = 13,")
+    out = dp.conformance_findings(dp_tree)
+    assert any("kBrandNewKind" in f.message and "no entry" in f.message
+               for f in out), _msgs(out)
+
+
+def test_dataplane_conformance_catches_value_drift(dp_tree):
+    _edit(dp_tree, f"{SRC}/sockets.hpp",
+          "kChunkHdr = 12,", "kChunkHdr = 14,")
+    out = dp.conformance_findings(dp_tree)
+    assert any("kChunkHdr" in f.message and "realign" in f.message
+               for f in out), _msgs(out)
+
+
+def test_dataplane_conformance_catches_rearmed_dispatch(dp_tree):
+    # splitting kRelayAck out of nothing — merge it into the kChunkReq
+    # arm: the arm partition no longer matches the spec's grouping
+    _edit(dp_tree, f"{SRC}/sockets.cpp",
+          "        if (kind == kChunkReq) {",
+          "        if (kind == kChunkReq || kind == kRelayAck) {")
+    out = dp.conformance_findings(dp_tree)
+    assert any("kChunkReq" in f.message and "RX_DISPATCH" in f.message
+               for f in out), _msgs(out)
+
+
+def test_dataplane_conformance_catches_lost_fastpath_marker(dp_tree):
+    _edit(dp_tree, f"{SRC}/sockets.cpp",
+          "// kData — sink fast path", "// data sink path")
+    out = dp.conformance_findings(dp_tree)
+    assert any("sink fast path" in f.message for f in out), _msgs(out)
+
+
+def test_dataplane_conformance_catches_unrouted_hook(dp_tree):
+    _edit(dp_tree, f"{SRC}/client.cpp",
+          "set_chunk_req_handler", "zz_chunk_req_handler")
+    out = dp.conformance_findings(dp_tree)
+    assert any("set_chunk_req_handler" in f.message for f in out), _msgs(out)

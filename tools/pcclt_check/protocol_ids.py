@@ -17,6 +17,21 @@ comparable codebases — an orphaned id compiles fine and fails at runtime):
   * every payload struct declared with ``encode()`` in ``protocol.hpp``
     defines BOTH ``X::encode`` and ``X::decode`` in ``protocol.cpp``
     (serialize/deserialize parity).
+
+The same exhaustiveness discipline covers the DATA-plane frame vocabulary
+(``MultiplexConn::Kind`` in ``sockets.hpp`` — kData, the relay trio, the
+chunk pair, CMA/shm control frames):
+
+  * kind wire values are unique;
+  * every kind has a real rx handler arm in ``sockets.cpp``'s rx_loop
+    (kData is the pinned fall-through, marked ``// kData — sink fast
+    path``) — an unhandled kind is dropped as garbage at the demux;
+  * every kind has a ``case kX:`` arm in tx_loop's frame writer — a kind
+    nobody can send is an orphan.
+
+The deeper semantic diff (arm grouping, hook routing, ladder pinning)
+lives in ``tools/pcclt_verify/dataplane_check.py``; this layer is the
+cheap per-kind existence audit that runs with the other id checks.
 """
 
 from __future__ import annotations
@@ -39,6 +54,19 @@ def parse_packet_enum(text: str) -> "dict[str, tuple[int, int]]":
     for em in re.finditer(r"(k\w+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)", body):
         line = text.count("\n", 0, start + em.start()) + 1
         out[em.group(1)] = (int(em.group(2), 0), line)
+    return out
+
+
+def parse_frame_kinds(text: str) -> "dict[str, tuple[int, int]]":
+    """MultiplexConn::Kind enumerators -> (value, line) from sockets.hpp."""
+    m = re.search(r"enum\s+Kind\s*:\s*uint8_t\s*\{(.*?)\};", text, re.S)
+    if not m:
+        return {}
+    body, start = m.group(1), m.start(1)
+    out: dict[str, tuple[int, int]] = {}
+    for em in re.finditer(r"(k\w+)\s*=\s*(\d+)", body):
+        line = text.count("\n", 0, start + em.start()) + 1
+        out[em.group(1)] = (int(em.group(2)), line)
     return out
 
 
@@ -107,6 +135,46 @@ def check(root: Path) -> "list[Finding]":
                     "protocol", f"{SRC}/protocol.hpp", line,
                     f"{name} is referenced by no data-plane file "
                     "(client/sockets/benchmark) — orphaned id"))
+
+    # --- data-plane frame kinds (MultiplexConn::Kind) ---
+    sockets_hpp = text_of("sockets.hpp")
+    sockets_cpp = text_of("sockets.cpp")
+    kinds = parse_frame_kinds(sockets_hpp)
+    if not kinds:
+        out.append(Finding(
+            "protocol", f"{SRC}/sockets.hpp", 0,
+            "could not parse `enum Kind : uint8_t` — the data-plane frame "
+            "vocabulary moved; realign parse_frame_kinds"))
+    kind_vals: dict[int, str] = {}
+    for name, (val, line) in sorted(kinds.items(), key=lambda kv: kv[1]):
+        if val in kind_vals:
+            out.append(Finding(
+                "protocol", f"{SRC}/sockets.hpp", line,
+                f"frame kind {name} reuses wire value {val} already taken "
+                f"by {kind_vals[val]} — the demux would misroute frames"))
+        else:
+            kind_vals[val] = name
+        # rx: a dispatch condition per kind; kData is the pinned
+        # fall-through after every `kind ==` test fails
+        if name == "kData":
+            if "// kData — sink fast path" not in sockets_cpp:
+                out.append(Finding(
+                    "protocol", f"{SRC}/sockets.cpp", 0,
+                    "rx_loop's kData fall-through lost its '// kData — "
+                    "sink fast path' marker — restore it where the sink "
+                    "fast path begins"))
+        elif not re.search(rf"kind == {name}\b", sockets_cpp):
+            out.append(Finding(
+                "protocol", f"{SRC}/sockets.hpp", line,
+                f"frame kind {name} has no `kind == {name}` rx handler arm "
+                "in sockets.cpp — inbound frames of this kind are dropped "
+                "as garbage"))
+        # tx: every kind must be sendable through tx_loop's frame writer
+        if not re.search(rf"case {name}:", sockets_cpp):
+            out.append(Finding(
+                "protocol", f"{SRC}/sockets.hpp", line,
+                f"frame kind {name} has no `case {name}:` arm in "
+                "sockets.cpp's tx_loop — an orphaned kind nobody can send"))
 
     # --- encode/decode parity for typed payloads ---
     proto_cpp = text_of("protocol.cpp")

@@ -40,13 +40,15 @@ CheckFn = Callable[[Path], "list[Finding] | Skip"]
 
 def _registry() -> "dict[str, CheckFn]":
     # imported lazily so `--checker fsm` does not pay for libclang
-    from . import blocking, conformance, lock_graph, model_check
+    from . import (blocking, conformance, dataplane_check, lock_graph,
+                   model_check)
 
     return {
         "lockorder": lock_graph.check,
         "blocking": blocking.check,
         "fsm": model_check.check,
         "conformance": conformance.check,
+        "dataplane": dataplane_check.check,
     }
 
 

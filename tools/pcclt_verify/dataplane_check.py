@@ -1,0 +1,830 @@
+"""Checker ``dataplane``: explicit-state model checking of the byte plane.
+
+Explores EVERY interleaving of the data-plane machines in
+``dataplane_spec.py`` — frame duplication, reorder across stripes, conn
+death mid-window, the watchdog ladder re-issuing on a fresh conn and
+relaying around a CONFIRMED edge, relay windows racing direct copies on
+the same byte ranges, zombie frames landing after a tag retired, chunk
+serves with a seeder SIGKILL mid-range — against these invariants:
+
+  * **conservation**: rx_bytes + rx_relay_bytes - dup_bytes equals the
+    unique payload ground truth at every reachable state (the identity
+    ``sockets.cpp``'s deliver_window documents as exact);
+  * **no-double-publish**: no placement publishes into a byte range a
+    concurrent writer has claimed and not yet committed;
+  * **ack-retire soundness**: a stalled direct copy cancelled early via
+    relay acks has its whole span acked, and every acked byte really is
+    accounted for at the receiver;
+  * **no-stuck**: every reachable state has a path to quiescence — ops
+    complete or abort under any fault schedule (reverse-reachability, so
+    livelocks with no escape path are caught too).
+
+A conformance pass diffs the spec's frame vocabulary and handler arms
+against the REAL dispatch surface (``sockets.hpp``'s Kind enum, the
+rx_loop if-chain and tx_loop switch in ``sockets.cpp``, the router hooks
+client.cpp installs, reduce.cpp's EdgeHealth ladder, ss_chunk.hpp's
+PlanStats fields), exactly as the control-plane ``conformance`` checker
+pins master.cpp — so the model cannot drift from the code.
+
+Run as a checker (CI: ``python -m tools.pcclt_verify --checker dataplane``)
+or directly (``python -m tools.pcclt_verify.dataplane_check [--deep]``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+from typing import Any
+
+from . import Finding, Skip
+from . import dataplane_spec as spec
+from .dataplane_spec import AckModel, DataViolation, TableModel
+
+CHECKER = "dataplane"
+SRC = "pccl_tpu/native/src"
+SPEC_REL = "tools/pcclt_verify/dataplane_spec.py"
+
+Action = tuple[Any, ...]
+
+
+class Violation(Exception):
+    def __init__(self, message: str, trace: "list[Action] | None" = None):
+        super().__init__(message)
+        self.message = message
+        self.trace = trace or []
+
+    def __str__(self) -> str:
+        tail = self.trace[-14:]
+        steps = " ; ".join("/".join(str(p) for p in a) for a in tail)
+        more = "" if len(self.trace) <= 14 else f" (last 14 of {len(self.trace)} steps) "
+        return f"{self.message}{more and ' '}[trace{more}: {steps}]"
+
+
+@dataclasses.dataclass
+class Scenario:
+    """One adversarial data-plane workload, explored exhaustively.
+
+    A *copy* is one wire incarnation of a stripe window (the original
+    send, a duplicated frame, or a watchdog re-issue on a fresh conn);
+    every copy's begin/commit interleaves freely with everything else.
+    """
+
+    name: str
+    cap: int                                  # sink bytes per round
+    stripes: "tuple[tuple[int, int], ...]"    # direct windows [off, end)
+    rounds: int = 1                           # tag reuse across incarnations
+    dup: "tuple[int, ...]" = ()               # stripes the env may duplicate
+    wd: bool = False                          # watchdog ladder enabled
+    relay: "tuple[tuple[int, int], ...]" = ()  # CONFIRMED windows [off, end)
+    relay_dup: "tuple[int, ...]" = ()         # relay windows env may dup
+    deaths: int = 0                           # conn/seeder death budget
+    chunk: bool = False                       # chunk-plane round trip
+    max_states: int = 400_000
+
+
+# copy states: inflight -> begun -> done | lost | cancelled
+_LIVE = ("inflight", "begun")
+_TERMINAL = ("done", "lost", "cancelled")
+
+
+@dataclasses.dataclass
+class World:
+    table: TableModel
+    acks: AckModel
+    scenario: Scenario
+    round: int = 0                 # op incarnation / fetch attempt index
+    fetch_done: bool = False       # chunk: some attempt completed the range
+    registered: bool = False
+    req_sent: bool = False         # chunk: request reached the seeder
+    hdr: str = "none"              # chunk: none|inflight|queued|consumed
+    seeder_dead: bool = False      # chunk: current round's seeder
+    health: int = 0                # LADDER rung, reset per round
+    deaths_left: int = 0
+    # (round, stripe, copy) -> state; copy 0 = original, 1 = duplicated
+    # frame, 2 = watchdog re-issue (re-armed while the stripe is missing)
+    copies: "dict[tuple[int, int, int], str]" = dataclasses.field(
+        default_factory=dict)
+    # (round, relay_idx, copy) -> "inflight" | "delivered"
+    relays: "dict[tuple[int, int, int], str]" = dataclasses.field(
+        default_factory=dict)
+    # (round, tag, off, len) — acks carry their op incarnation: the real
+    # client scopes relay acks to the op (tag ranges embed the seq and
+    # purge_relay_acks runs at op end), so an ack can never cross into the
+    # next incarnation. The soundness check below PROVES that purge is
+    # load-bearing: without it, a stale ack from a finished incarnation
+    # cancels a retried op's direct copy whose bytes never arrived.
+    acks_inflight: "tuple[tuple[int, int, int, int], ...]" = ()
+
+    def copy_world(self) -> "World":
+        return World(self.table.copy(), self.acks.copy(), self.scenario,
+                     self.round, self.fetch_done, self.registered,
+                     self.req_sent, self.hdr, self.seeder_dead, self.health,
+                     self.deaths_left, dict(self.copies), dict(self.relays),
+                     self.acks_inflight)
+
+    def freeze(self):
+        return (self.table.freeze(), self.acks.freeze(), self.round,
+                self.fetch_done, self.registered, self.req_sent, self.hdr,
+                self.seeder_dead, self.health, self.deaths_left,
+                tuple(sorted(self.copies.items())),
+                tuple(sorted(self.relays.items())),
+                tuple(sorted(self.acks_inflight)))
+
+    def done_all(self) -> bool:
+        if self.scenario.chunk:
+            return self.fetch_done
+        return self.round >= self.scenario.rounds
+
+    # ---- tags: collectives reuse one tag across incarnations (op retry
+    # after an abort replays the same coordinates); chunk fetches burn a
+    # fresh tag per attempt (client.cpp's chunk_tag_seq_) ----
+
+    def tag_of(self, rnd: int) -> int:
+        return (rnd + 1) if self.scenario.chunk else 1
+
+    def cur_tag(self) -> int:
+        return self.tag_of(self.round)
+
+    def stripe_done(self, s: int) -> bool:
+        off, end = self.scenario.stripes[s]
+        sink = self.table.sinks.get(self.cur_tag())
+        return sink is not None and sink.fully_covered(off, end)
+
+
+def initial_world(sc: Scenario) -> World:
+    w = World(TableModel(), AckModel(), sc, deaths_left=sc.deaths)
+    if not sc.chunk:
+        # the sender's stripes are on the wire from the start; sink
+        # registration races them (the queued-frame path)
+        for s in range(len(sc.stripes)):
+            w.copies[(0, s, 0)] = "inflight"
+    return w
+
+
+def _spawn_round(w: World) -> None:
+    for s in range(len(w.scenario.stripes)):
+        w.copies[(w.round, s, 0)] = "inflight"
+
+
+# --------------------------------------------------------------------------
+# enabled actions
+# --------------------------------------------------------------------------
+
+
+def enabled_actions(w: World) -> "list[Action]":
+    acts: "list[Action]" = []
+    sc = w.scenario
+    done_all = w.done_all()
+    tag = w.cur_tag() if not done_all else None
+
+    if not done_all:
+        if not w.registered:
+            acts.append(("register",))
+        if sc.chunk:
+            if w.registered and not w.req_sent:
+                acts.append(("chunk_req",))
+            if w.req_sent and not w.seeder_dead and w.hdr == "none":
+                acts.append(("serve_hdr",))
+            if w.hdr == "inflight":
+                acts.append(("hdr_arrive",))
+            if (w.hdr == "queued"
+                    and w.table.take_hdr_peek(tag)):
+                acts.append(("hdr_consume",))
+            if w.deaths_left > 0 and not w.seeder_dead and w.req_sent:
+                acts.append(("seeder_die",))
+            # each seeder death buys one re-source attempt (fresh tag)
+            if w.seeder_dead and w.round < sc.deaths:
+                sink = w.table.sinks.get(tag)
+                if w.registered and (sink is None or sink.busy == 0):
+                    acts.append(("resource",))
+        # watchdog ladder (monotone per op incarnation)
+        if sc.wd and not sc.chunk:
+            incomplete = [s for s in range(len(sc.stripes))
+                          if not w.stripe_done(s)]
+            if w.health == 0 and incomplete and w.registered:
+                acts.append(("suspect",))
+            if w.health >= 1:
+                for s in incomplete:
+                    live = any(st in _LIVE for (r, si, c), st
+                               in w.copies.items()
+                               if r == w.round and si == s)
+                    if not live:
+                        acts.append(("reissue", s))
+            if w.health == 1 and sc.relay:
+                acts.append(("confirm",))
+        # round completion: the consumer saw every byte (and, chunk-side,
+        # the response header); stragglers may still be on the wire — that
+        # is exactly the retire machinery's job
+        sink = w.table.sinks.get(tag) if w.registered else None
+        if (sink is not None and sink.complete() and sink.busy == 0
+                and (not sc.chunk or w.hdr == "consumed")):
+            acts.append(("complete",))
+
+    # frame-level actions stay enabled for every round's stragglers
+    for (r, s, c), st in sorted(w.copies.items()):
+        if st == "inflight":
+            acts.append(("begin", r, s, c))
+            if w.deaths_left > 0 and not sc.chunk:
+                acts.append(("lose", r, s, c))
+            off, end = sc.stripes[s]
+            if w.acks.ack_covered(w.tag_of(r), off, end - off):
+                acts.append(("cancel", r, s, c))
+        elif st == "begun":
+            acts.append(("commit", r, s, c))
+            if w.deaths_left > 0 and not sc.chunk:
+                acts.append(("die", r, s, c))
+        if (st in ("inflight", "begun", "done") and c == 0
+                and s in sc.dup and (r, s, 1) not in w.copies):
+            acts.append(("dup_frame", r, s))
+    for (r, i, c), st in sorted(w.relays.items()):
+        if st == "inflight":
+            acts.append(("relay_arrive", r, i, c))
+        if (st in ("inflight", "delivered") and c == 0
+                and i in sc.relay_dup and (r, i, 1) not in w.relays):
+            acts.append(("relay_dup", r, i))
+    for k in range(len(w.acks_inflight)):
+        acts.append(("ack_arrive", k))
+    return acts
+
+
+# --------------------------------------------------------------------------
+# action application (returns the successor world)
+# --------------------------------------------------------------------------
+
+
+def apply_action(w0: World, act: Action) -> World:
+    w = w0.copy_world()
+    sc = w.scenario
+    kind = act[0]
+
+    if kind == "register":
+        w.table.register_sink(w.cur_tag(), sc.cap)
+        w.registered = True
+    elif kind == "chunk_req":
+        w.req_sent = True
+    elif kind == "serve_hdr":
+        # the seeder's reply: header + striped payload start racing back
+        w.hdr = "inflight"
+        for s in range(len(sc.stripes)):
+            w.copies[(w.round, s, 0)] = "inflight"
+    elif kind == "hdr_arrive":
+        w.table.chunk_hdr(w.cur_tag(), 0)
+        w.hdr = "queued"
+    elif kind == "hdr_consume":
+        if w.table.take_hdr(w.cur_tag()) is None:
+            raise Violation("hdr_consume enabled with no queued header")
+        w.hdr = "consumed"
+    elif kind == "seeder_die":
+        # SIGKILL: every in-flight frame from this seeder dies with it;
+        # a mid-write copy releases its claim (rx_loop's failure path)
+        w.seeder_dead = True
+        w.deaths_left -= 1
+        for (r, s, c), st in list(w.copies.items()):
+            if r != w.round:
+                continue
+            if st == "begun":
+                off, end = sc.stripes[s]
+                w.table.data_die(w.cur_tag(), off, end - off)
+            if st in _LIVE:
+                w.copies[(r, s, c)] = "lost"
+        if w.hdr == "inflight":
+            w.hdr = "none"
+    elif kind == "resource":
+        # fetch worker's drop_sink: unregister + purge (retire), then
+        # re-request the range from the next seeder on a FRESH tag
+        w.table.purge(w.cur_tag())
+        w.round += 1
+        w.registered = False
+        w.req_sent = False
+        w.hdr = "none"
+        w.seeder_dead = False
+        w.health = 0
+    elif kind == "suspect":
+        w.health = 1
+    elif kind == "reissue":
+        # the watchdog re-issues the missed window on a fresh pool conn,
+        # re-armed for as long as the stripe stays missing (deadline loop)
+        w.copies[(w.round, act[1], 2)] = "inflight"
+    elif kind == "confirm":
+        w.health = 2
+        for i in range(len(sc.relay)):
+            w.relays[(w.round, i, 0)] = "inflight"
+    elif kind == "relay_dup":
+        _, r, i = act
+        w.relays[(r, i, 1)] = "inflight"
+    elif kind == "relay_arrive":
+        _, r, i, c = act
+        off, end = sc.relay[i]
+        length = end - off
+        tag = w.tag_of(r)
+        settled = w.table.deliver_window(tag, off, length)
+        w.relays[(r, i, c)] = "delivered"
+        # the final receiver acks the RANGE end-to-end, fire-and-forget —
+        # but ONLY when deliver_window reports it durably accounted for.
+        # A range partially dropped against a mid-write claim must not be
+        # acked: the claim-holder can die and tear those bytes, and the
+        # ack would cancel the origin's last copy on lying coverage
+        # (model-checker finding, relay_vs_direct_deaths)
+        if settled:
+            w.acks_inflight = w.acks_inflight + ((r, tag, off, length),)
+    elif kind == "ack_arrive":
+        k = act[1]
+        r, tag, off, length = w.acks_inflight[k]
+        w.acks_inflight = w.acks_inflight[:k] + w.acks_inflight[k + 1:]
+        if r >= w.round or sc.chunk:
+            w.acks.note_ack(tag, off, length)
+        # else: the incarnation that launched this relay already finished;
+        # its wire-tag range is dead (seq-scoped, purged at op end), so
+        # the ack merges into nothing — modeling note_relay_ack on a
+        # purged, never-reused tag range
+    elif kind == "dup_frame":
+        _, r, s = act
+        w.copies[(r, s, 1)] = "inflight"
+    elif kind in ("begin", "lose", "cancel"):
+        _, r, s, c = act
+        off, end = sc.stripes[s]
+        length = end - off
+        tag = w.tag_of(r)
+        if kind == "begin":
+            verdict = w.table.data_begin(tag, off, length)
+            if verdict == "claimed":
+                w.copies[(r, s, c)] = "begun"
+            else:  # dup / queued: the frame is fully drained on arrival
+                w.copies[(r, s, c)] = "done"
+        elif kind == "lose":
+            w.deaths_left -= 1
+            w.copies[(r, s, c)] = "lost"
+        else:  # cancel: early zombie retirement via relay-ack coverage
+            for b in range(off, end):
+                if not w.table.byte_present(tag, b):
+                    raise Violation(
+                        f"ack-retire unsound: zombie copy {(r, s, c)} "
+                        f"cancelled on relay-ack coverage of [{off},{end}) "
+                        f"but byte {b} is not accounted for at the "
+                        "receiver — acked coverage lied")
+            w.copies[(r, s, c)] = "cancelled"
+    elif kind in ("commit", "die"):
+        _, r, s, c = act
+        off, end = sc.stripes[s]
+        length = end - off
+        if kind == "commit":
+            w.table.data_commit(w.tag_of(r), off, length)
+            w.copies[(r, s, c)] = "done"
+        else:
+            w.deaths_left -= 1
+            w.table.data_die(w.tag_of(r), off, length)
+            w.copies[(r, s, c)] = "lost"
+    elif kind == "complete":
+        # op end: unregister (retire) the sink and purge the op's relay
+        # acks (client.cpp's purge_relay_acks — op-scoped ack validity)
+        w.table.unregister_sink(w.cur_tag())
+        w.acks.acks.pop(w.cur_tag(), None)
+        w.registered = False
+        w.health = 0
+        w.req_sent = False
+        w.hdr = "none"
+        w.seeder_dead = False
+        if sc.chunk:
+            w.fetch_done = True
+        else:
+            w.round += 1
+            if w.round < sc.rounds:
+                _spawn_round(w)
+    else:  # pragma: no cover - enumerator/apply drift
+        raise AssertionError(f"unknown action {act}")
+
+    w.table.check_conservation()
+    return w
+
+
+# --------------------------------------------------------------------------
+# exploration
+# --------------------------------------------------------------------------
+
+
+def _quiescent(w: World) -> bool:
+    if not w.done_all():
+        return False
+    if any(st not in _TERMINAL for st in w.copies.values()):
+        return False
+    if any(st != "delivered" for st in w.relays.values()):
+        return False
+    return not w.acks_inflight
+
+
+@dataclasses.dataclass
+class Result:
+    scenario: str
+    states: int
+    quiescent: int
+
+
+def explore(sc: Scenario, table_cls: type = TableModel,
+            ack_cls: type = AckModel) -> Result:
+    """DFS every interleaving; raises Violation on the first broken
+    invariant (with the action trace that reaches it)."""
+    w0 = initial_world(sc)
+    w0.table = table_cls()
+    w0.acks = ack_cls()
+    f0 = w0.freeze()
+    worlds: "dict[Any, World]" = {f0: w0}
+    parent: "dict[Any, tuple[Any, Action] | None]" = {f0: None}
+    succs: "dict[Any, list[Any]]" = {}
+    stack = [f0]
+    quiescent: "set[Any]" = set()
+
+    def trace_to(f: Any) -> "list[Action]":
+        acts: "list[Action]" = []
+        while True:
+            pa = parent[f]
+            if pa is None:
+                break
+            f, a = pa
+            acts.append(a)
+        acts.reverse()
+        return acts
+
+    while stack:
+        f = stack.pop()
+        if f in succs:
+            continue
+        w = worlds[f]
+        acts = enabled_actions(w)
+        nxt: "list[Any]" = []
+        if not acts and not _quiescent(w):
+            raise Violation(
+                f"stuck world in scenario '{sc.name}': no action enabled "
+                f"but round {w.round}/{sc.rounds} is incomplete "
+                f"(copies={dict(w.copies)})", trace_to(f))
+        for a in acts:
+            try:
+                w2 = apply_action(w, a)
+            except (Violation, DataViolation) as v:
+                msg = getattr(v, "message", str(v))
+                raise Violation(f"scenario '{sc.name}': {msg}",
+                                trace_to(f) + [a]) from None
+            f2 = w2.freeze()
+            nxt.append(f2)
+            if f2 not in worlds:
+                worlds[f2] = w2
+                parent[f2] = (f, a)
+                stack.append(f2)
+                if len(worlds) > sc.max_states:
+                    raise Violation(
+                        f"scenario '{sc.name}' exceeded {sc.max_states} "
+                        "states — shrink the scenario (this cap is a guard "
+                        "against model regressions, not an invariant)")
+        succs[f] = nxt
+        if _quiescent(w):
+            quiescent.add(f)
+
+    # liveness: every reachable state must have a PATH to quiescence
+    rev: "dict[Any, list[Any]]" = {}
+    for f, ns in succs.items():
+        for n in ns:
+            rev.setdefault(n, []).append(f)
+    ok = set(quiescent)
+    frontier = list(quiescent)
+    while frontier:
+        f = frontier.pop()
+        for p in rev.get(f, ()):
+            if p not in ok:
+                ok.add(p)
+                frontier.append(p)
+    bad = [f for f in succs if f not in ok]
+    if bad:
+        f = bad[0]
+        w = worlds[f]
+        raise Violation(
+            f"livelock in scenario '{sc.name}': {len(bad)} reachable "
+            f"state(s) have NO path to quiescence; e.g. round {w.round} "
+            f"with copies={dict(w.copies)} relays={dict(w.relays)}",
+            trace_to(f))
+    return Result(sc.name, len(worlds), len(quiescent))
+
+
+# --------------------------------------------------------------------------
+# scenario suite
+# --------------------------------------------------------------------------
+
+
+def default_scenarios() -> "list[Scenario]":
+    """The per-PR suite: every data-plane fault class from ISSUE/PR 10-19,
+    sized to finish on a 1-core CI box."""
+    return [
+        # striped sends racing sink registration, one frame duplicated:
+        # the queued-frame path, queue dedupe, and first-arrival-wins
+        Scenario("stripe_reorder_dup", cap=4, stripes=((0, 2), (2, 4)),
+                 dup=(0,)),
+        # the full failover ladder: a stalled direct window re-issued on a
+        # fresh conn, then CONFIRMED-relayed as two misaligned windows
+        # racing the direct copies on the same byte ranges, with
+        # end-to-end acks retiring the zombie early (a duplicated relay
+        # window double-acks one sub-range, and a duplicated direct frame
+        # races the relay windows on partially-overlapping ranges)
+        Scenario("relay_vs_direct", cap=4, stripes=((0, 4),), wd=True,
+                 dup=(0,), relay=((0, 2), (2, 4)), relay_dup=(0,)),
+        # conn death at every point of a striped window (frame lost in
+        # flight, or mid-write with a claim held); watchdog re-issue is
+        # the recovery path
+        Scenario("conn_death_mid_window", cap=4, stripes=((0, 2), (2, 4)),
+                 wd=True, deaths=1),
+        # two incarnations of one op on the SAME tag (abort/retry replays
+        # identical coordinates): round 1 retires the tag, round 2 must
+        # un-retire it on re-registration; round-2 frames racing the
+        # re-registration are dropped as retired stragglers and the
+        # ladder re-issues them — with relay windows and zombie
+        # cancellation in the mix
+        # NOTE wd=True is load-bearing for liveness, not just scenario
+        # spice: a round-2 frame that arrives BEFORE the re-registration
+        # is (correctly) dropped against the round-1 retire marker, and
+        # only the watchdog re-issue rung recovers the stripe. The model
+        # proves the no-watchdog variant of this interleaving deadlocks —
+        # which is why reduce.cpp always arms the ladder for striped ops.
+        Scenario("retire_tag_reuse", cap=2, stripes=((0, 2),), rounds=2,
+                 wd=True, relay=((0, 2),)),
+        # chunk plane: request/header/striped-payload round trip with the
+        # seeder SIGKILLed mid-range; the fetch worker drops+purges the
+        # tag and re-sources from a second seeder on a fresh tag
+        Scenario("chunk_serve_sigkill", cap=4, stripes=((0, 2), (2, 4)),
+                 chunk=True, deaths=1),
+    ]
+
+
+def deep_scenarios() -> "list[Scenario]":
+    return [
+        Scenario("stripe3_dup2", cap=6, stripes=((0, 2), (2, 4), (4, 6)),
+                 dup=(0, 1), max_states=2_000_000),
+        Scenario("relay_vs_direct_deaths", cap=4, stripes=((0, 4),),
+                 wd=True, relay=((0, 2), (2, 4)), relay_dup=(0, 1),
+                 deaths=1, max_states=2_000_000),
+        Scenario("reuse3_relay", cap=2, stripes=((0, 2),), rounds=3,
+                 wd=True, relay=((0, 2),), max_states=2_000_000),
+        Scenario("chunk_double_sigkill", cap=4, stripes=((0, 2), (2, 4)),
+                 chunk=True, deaths=2, max_states=2_000_000),
+    ]
+
+
+def run_suite(scenarios: "list[Scenario]",
+              table_cls: type = TableModel,
+              ack_cls: type = AckModel,
+              verbose: bool = False) -> "list[Result]":
+    out = []
+    for sc in scenarios:
+        r = explore(sc, table_cls, ack_cls)
+        out.append(r)
+        if verbose:
+            print(f"  {r.scenario}: {r.states} states, "
+                  f"{r.quiescent} quiescent — ok")
+    return out
+
+
+# --------------------------------------------------------------------------
+# conformance: the model cannot drift from the dispatch surface
+# --------------------------------------------------------------------------
+
+
+def parse_kind_enum(sockets_hpp: str) -> "dict[str, int]":
+    """Kind enumerator -> value from sockets.hpp's MultiplexConn::Kind."""
+    m = re.search(r"enum\s+Kind\s*:\s*uint8_t\s*\{(.*?)\};", sockets_hpp,
+                  re.S)
+    if not m:
+        return {}
+    return {name: int(val) for name, val in
+            re.findall(r"(k\w+)\s*=\s*(\d+)", m.group(1))}
+
+
+def parse_rx_arms(rx_body: str) -> "list[frozenset[str]]":
+    """The rx_loop's top-level `if (kind == kX || kind == kY)` dispatch
+    conditions, one frozenset of kinds per arm (nested re-checks inside an
+    arm are deeper-indented and skipped)."""
+    out = []
+    for cond in re.findall(r"(?m)^ {8}if \((kind == k\w+"
+                           r"(?: \|\| kind == k\w+)*)\)", rx_body):
+        out.append(frozenset(re.findall(r"kind == (k\w+)", cond)))
+    return out
+
+
+def _body_of(text: str, marker: str) -> str:
+    """Source text from `marker` to the next top-level function def."""
+    start = text.find(marker)
+    if start < 0:
+        return ""
+    end = re.search(r"\n\}\n\n", text[start:])
+    return text[start:start + end.end()] if end else text[start:]
+
+
+def conformance_findings(root: Path) -> "list[Finding]":
+    src = Path(root) / SRC
+
+    def text_of(name: str) -> str:
+        p = src / name
+        return p.read_text() if p.is_file() else ""
+
+    sockets_hpp = text_of("sockets.hpp")
+    sockets_cpp = text_of("sockets.cpp")
+    client = text_of("client.cpp")
+    reduce_cpp = text_of("reduce.cpp")
+    telemetry_hpp = text_of("telemetry.hpp")
+    ss_chunk_hpp = text_of("ss_chunk.hpp")
+    out: "list[Finding]" = []
+    if not sockets_hpp or not sockets_cpp or not client:
+        return [Finding(CHECKER, SRC, 0,
+                        "sockets.hpp/sockets.cpp/client.cpp missing — "
+                        "cannot diff the spec against the frame surface")]
+
+    # --- Kind enum <-> FRAME_KINDS (names, values, uniqueness) --------
+    real = parse_kind_enum(sockets_hpp)
+    if not real:
+        out.append(Finding(
+            CHECKER, f"{SRC}/sockets.hpp", 0,
+            "could not parse `enum Kind : uint8_t { ... }` — the frame "
+            "vocabulary moved; realign parse_kind_enum"))
+    vals: "dict[int, list[str]]" = {}
+    for name, v in real.items():
+        vals.setdefault(v, []).append(name)
+    for v, names in sorted(vals.items()):
+        if len(names) > 1:
+            out.append(Finding(
+                CHECKER, f"{SRC}/sockets.hpp", 0,
+                f"frame kinds {sorted(names)} share wire value {v} — "
+                "kinds must be unique on the wire"))
+    for name in sorted(set(real) - set(spec.FRAME_KINDS)):
+        out.append(Finding(
+            CHECKER, f"{SRC}/sockets.hpp", 0,
+            f"frame kind {name} = {real[name]} has no entry in the "
+            f"data-plane spec — teach {SPEC_REL} the kind (FRAME_KINDS "
+            "and its RX_DISPATCH arm)"))
+    for name in sorted(set(spec.FRAME_KINDS) - set(real)):
+        out.append(Finding(
+            CHECKER, SPEC_REL, 0,
+            f"spec kind {name} no longer exists in sockets.hpp's Kind "
+            "enum — stale spec entry"))
+    for name in sorted(set(real) & set(spec.FRAME_KINDS)):
+        if real[name] != spec.FRAME_KINDS[name]:
+            out.append(Finding(
+                CHECKER, SPEC_REL, 0,
+                f"spec pins {name} = {spec.FRAME_KINDS[name]} but "
+                f"sockets.hpp says {real[name]} — realign the spec"))
+
+    # --- rx_loop if-chain <-> RX_DISPATCH arm partition ---------------
+    rx = _body_of(sockets_cpp, "void MultiplexConn::rx_loop()")
+    if not rx:
+        out.append(Finding(
+            CHECKER, f"{SRC}/sockets.cpp", 0,
+            "MultiplexConn::rx_loop not found — realign the dataplane "
+            "conformance parser"))
+    else:
+        arms = parse_rx_arms(rx)
+        spec_arms: "dict[str, set[str]]" = {}
+        for k, arm in spec.RX_DISPATCH.items():
+            spec_arms.setdefault(arm, set()).add(k)
+        fallthrough = spec_arms.pop("sink_fastpath", set())
+        want = {frozenset(g) for g in spec_arms.values()}
+        got = set(arms)
+        for g in sorted(got - want, key=sorted):
+            out.append(Finding(
+                CHECKER, f"{SRC}/sockets.cpp", 0,
+                f"rx_loop dispatch arm for {sorted(g)} has no matching "
+                f"arm grouping in the spec's RX_DISPATCH — teach "
+                f"{SPEC_REL} the arm"))
+        for g in sorted(want - got, key=sorted):
+            out.append(Finding(
+                CHECKER, SPEC_REL, 0,
+                f"spec groups {sorted(g)} under one rx arm but rx_loop "
+                "has no such dispatch condition — stale spec arm"))
+        if fallthrough != {"kData"}:
+            out.append(Finding(
+                CHECKER, SPEC_REL, 0,
+                "spec's sink_fastpath fall-through arm must be exactly "
+                f"{{kData}}, got {sorted(fallthrough)}"))
+        elif "// kData — sink fast path" not in rx:
+            out.append(Finding(
+                CHECKER, f"{SRC}/sockets.cpp", 0,
+                "rx_loop's kData fall-through lost its '// kData — sink "
+                "fast path' marker — the spec pins kData as the final "
+                "arm; restore the marker where the fast path begins"))
+
+    # --- tx_loop switch <-> TX_ARMS -----------------------------------
+    tx = _body_of(sockets_cpp, "void MultiplexConn::tx_loop()")
+    tx_cases = set(re.findall(r"case (k\w+):", tx))
+    for k in sorted(tx_cases - spec.TX_ARMS):
+        out.append(Finding(
+            CHECKER, f"{SRC}/sockets.cpp", 0,
+            f"tx_loop sends {k} but the spec's TX_ARMS does not list it"))
+    for k in sorted(spec.TX_ARMS - tx_cases):
+        out.append(Finding(
+            CHECKER, SPEC_REL, 0,
+            f"spec lists tx arm {k} but tx_loop's switch has no such "
+            "case — stale spec arm"))
+
+    # --- routed kinds: rx arm invokes the hook, client installs it ----
+    for k, hook in sorted(spec.ROUTED_KINDS.items()):
+        if hook + "(" not in rx:
+            out.append(Finding(
+                CHECKER, f"{SRC}/sockets.cpp", 0,
+                f"spec routes {k} through hook {hook} but rx_loop never "
+                f"invokes {hook}(...) — rewire the arm or the spec"))
+        if not re.search(rf"\b{hook}\b", sockets_hpp):
+            out.append(Finding(
+                CHECKER, f"{SRC}/sockets.hpp", 0,
+                f"hook member {hook} (route for {k}) missing from "
+                "MultiplexConn"))
+    for installer in ("set_relay_handlers", "set_chunk_req_handler"):
+        if installer not in client:
+            out.append(Finding(
+                CHECKER, f"{SRC}/client.cpp", 0,
+                f"client.cpp never calls {installer} — the routed frame "
+                "kinds would hit the no-router fallback on every conn"))
+
+    # --- client-originated kinds --------------------------------------
+    client_kinds = set(re.findall(r"MultiplexConn::(k\w+)", client))
+    client_kinds &= set(spec.FRAME_KINDS)
+    # kData payloads ride the striped Link helpers, not a Kind literal
+    if re.search(r"\bsend_at\(|\bsend_async\(|\bsend_bytes\(", client):
+        client_kinds.add("kData")
+    for k in sorted(client_kinds - spec.CLIENT_SENDS):
+        out.append(Finding(
+            CHECKER, f"{SRC}/client.cpp", 0,
+            f"client.cpp originates {k} frames but the spec's "
+            f"CLIENT_SENDS does not include it — teach {SPEC_REL}"))
+    for k in sorted(spec.CLIENT_SENDS - client_kinds):
+        out.append(Finding(
+            CHECKER, SPEC_REL, 0,
+            f"spec claims the client originates {k} but client.cpp never "
+            "does — stale spec entry"))
+
+    # --- the watchdog ladder <-> EdgeHealth ---------------------------
+    m = re.search(r"enum class EdgeHealth[^{]*\{(.*?)\};", telemetry_hpp,
+                  re.S)
+    ladder = {name: int(v) for name, v in
+              re.findall(r"(k\w+)\s*=\s*(\d+)", m.group(1))} if m else {}
+    if ladder != spec.LADDER:
+        out.append(Finding(
+            CHECKER, SPEC_REL, 0,
+            f"spec LADDER {spec.LADDER} != telemetry.hpp EdgeHealth "
+            f"{ladder} — the failover ladder drifted"))
+    for rung in ("kSuspect", "kConfirmed"):
+        if not re.search(rf"EdgeHealth::{rung}\b", reduce_cpp):
+            out.append(Finding(
+                CHECKER, f"{SRC}/reduce.cpp", 0,
+                f"reduce.cpp never climbs to EdgeHealth::{rung} — the "
+                "modeled ladder rung is unreachable in the watchdog"))
+
+    # --- chunk-plane stats fields -------------------------------------
+    ps = re.search(r"struct PlanStats\s*\{(.*?)\};", ss_chunk_hpp, re.S)
+    fields = set(re.findall(r"(\w+)\s*=", ps.group(1))) if ps else set()
+    for f in sorted(spec.PLAN_STATS_FIELDS - fields):
+        out.append(Finding(
+            CHECKER, f"{SRC}/ss_chunk.hpp", 0,
+            f"PlanStats field {f} (named in the spec's conservation "
+            "identity) no longer exists — realign the spec or the struct"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# checker entry points
+# --------------------------------------------------------------------------
+
+
+def check(root: Path) -> "list[Finding] | Skip":
+    out = conformance_findings(Path(root))
+    try:
+        run_suite(default_scenarios())
+    except Violation as v:
+        out.append(Finding(CHECKER, SPEC_REL, 0, str(v)))
+    return out
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="pcclt_verify.dataplane_check",
+        description="explicit-state model checker for the byte plane")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the larger worlds (minutes, not seconds)")
+    ap.add_argument("--root", default=".",
+                    help="repo root for the conformance diff")
+    args = ap.parse_args(argv)
+    rc = 0
+    for f in conformance_findings(Path(args.root)):
+        print(f"CONFORMANCE: {f}")
+        rc = 1
+    try:
+        print("default suite:")
+        run_suite(default_scenarios(), verbose=True)
+        if args.deep:
+            print("deep suite:")
+            run_suite(deep_scenarios(), verbose=True)
+    except Violation as v:
+        print(f"VIOLATION: {v}")
+        return 1
+    if rc == 0:
+        print("dataplane check: all invariants hold")
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

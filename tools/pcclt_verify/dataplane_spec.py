@@ -1,0 +1,573 @@
+"""Executable spec of the pooled data plane: frames, sinks, the ladder.
+
+The control-plane spec (``fsm_spec.py``) deliberately declares the byte
+plane out of model.  This module is that missing layer: a Python mirror of
+the machinery that makes faults bit-identical —
+
+  * the **frame vocabulary** of ``MultiplexConn`` (``sockets.hpp``'s
+    ``Kind`` enum) and the RX/TX dispatch arms in ``sockets.cpp`` that
+    route each kind (tables below, pinned by the dataplane conformance
+    pass in ``dataplane_check.py``);
+  * the **SinkTable** claim/publish/dedup/retire machine
+    (``sockets.cpp``): byte-range coverage via ``prefix``/``extents``/
+    ``claims``, first-verified-arrival-wins dedupe, queued frames and
+    parked relay windows that raced sink registration, and the
+    ``retired_`` tag ranges that turn post-completion stragglers into
+    counted duplicates;
+  * the **watchdog ladder** (``reduce.cpp``): OK -> SUSPECT (re-issue the
+    window on a fresh pool conn; first success wins, the loser dedupes)
+    -> CONFIRMED (acked relay detour via a healthy third peer), with
+    end-to-end ``kRelayAck`` coverage merged origin-side
+    (``Client::note_relay_ack``) so a stalled direct copy — a *zombie* —
+    retires early only once its whole span is acked
+    (``Client::relay_ack_covered``);
+  * the **chunk plane** round trip (``kChunkReq``/``kChunkHdr`` + striped
+    ``kData`` payloads) including serve-side seeder death and the
+    retire/un-retire rule that makes tag reuse across op incarnations
+    legal (``SinkTable::register_sink``'s single-tag un-retire).
+
+Deliberate abstractions, in the control-plane spec's style:
+
+  * bytes carry no content — the plane is bit-identical by construction
+    (content-addressed chunks, deterministic reductions), so coverage
+    arithmetic over ``[off, end)`` ranges IS the payload model;
+  * conns are reduced to "the transfer a frame rides": conn death maps to
+    in-flight frame loss plus claim release (``rx_loop``'s mid-write
+    failure path);
+  * CMA/shm same-host kinds keep their dispatch-table entries (the
+    conformance pass pins them) but are not explored — they bypass the
+    byte-conservation machinery (descriptor acks complete sender handles
+    without touching sink coverage);
+  * rx accounting is counted at frame *commit*: the real ``rx_loop``
+    counts ``rx_bytes`` at header parse, so a conn dying mid-frame leaves
+    telemetry slop on a dying edge.  The conservation identity is
+    therefore specified — and checked — over cleanly delivered traffic;
+  * ``purge_range`` in the model counts purged queued frames as
+    duplicates so the identity stays exact across aborts; the
+    implementation drops them unattributed (aborted ops sit outside its
+    exactness claim, which covers completed traffic only).
+
+The invariants the explorer (``dataplane_check.py``) holds this model to:
+
+  ===================  ====================================================
+  conservation         rx_bytes + rx_relay_bytes - dup_bytes equals the
+                       unique payload ground truth (published coverage +
+                       retained coverage of retired sinks + queued bytes)
+                       at every reachable state
+  no-double-publish    no placement ever publishes into a byte range
+                       another writer has claimed and not yet published
+  ack-retire           a zombie cancelled via relay acks has its whole
+                       span acked, and every acked byte is accounted for
+                       at the receiver (placed, parked, queued, or
+                       dropped-as-duplicate)
+  no-stuck             every reachable state has a path to quiescence
+                       (ops complete or abort under any fault schedule)
+  ===================  ====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# --------------------------------------------------------------------------
+# frame vocabulary (sockets.hpp MultiplexConn::Kind) — conformance-pinned
+# --------------------------------------------------------------------------
+
+FRAME_KINDS: "dict[str, int]" = {
+    "kData": 0,
+    "kCmaDesc": 1,
+    "kCmaAck": 2,
+    "kCmaNack": 3,
+    "kCmaHello": 4,
+    "kShmAnnounce": 5,
+    "kShmRetire": 6,
+    "kCmaAckDrop": 7,
+    "kRelayFwd": 8,
+    "kRelayDeliver": 9,
+    "kRelayAck": 10,
+    "kChunkReq": 11,
+    "kChunkHdr": 12,
+}
+
+# rx_loop dispatch: kind -> the arm that consumes it. Kinds sharing one
+# `if (kind == a || kind == b)` condition share an arm label. kData is the
+# fall-through arm (the sink fast path) — there is no `if` for it; the
+# conformance pass checks the arm's marker comment instead.
+RX_DISPATCH: "dict[str, str]" = {
+    "kCmaAck": "cma_completion",
+    "kCmaAckDrop": "cma_completion",
+    "kCmaNack": "cma_completion",
+    "kCmaHello": "cma_hello",
+    "kShmAnnounce": "shm_announce",
+    "kShmRetire": "shm_retire",
+    "kCmaDesc": "cma_desc",
+    "kRelayFwd": "relay_window",
+    "kRelayDeliver": "relay_window",
+    "kRelayAck": "relay_ack",
+    "kChunkReq": "chunk_req",
+    "kChunkHdr": "chunk_hdr",
+    "kData": "sink_fastpath",  # fall-through, not an if-arm
+}
+
+# tx_loop dispatch: every kind must have a `case` in the send switch
+# (kCmaDesc/kShmAnnounce/kShmRetire are never enqueued — shm_sync_tx
+# writes them inline — but their arms must exist and say so).
+TX_ARMS: "set[str]" = set(FRAME_KINDS)
+
+# kinds the conn routes to installed client hooks instead of handling
+# internally: kind -> the MultiplexConn hook member its rx arm invokes.
+ROUTED_KINDS: "dict[str, str]" = {
+    "kRelayFwd": "relay_fwd_",
+    "kRelayDeliver": "relay_deliver_",
+    "kRelayAck": "relay_ack_",
+    "kChunkReq": "chunk_req_",
+}
+
+# kinds client.cpp originates over the pool (send_owned/send_async sites).
+CLIENT_SENDS: "set[str]" = {
+    "kData", "kRelayFwd", "kRelayDeliver", "kRelayAck",
+    "kChunkReq", "kChunkHdr",
+}
+
+# the reduce.cpp failover ladder (enum EdgeHealth) the watchdog climbs —
+# monotone within an op: OK -> SUSPECT -> CONFIRMED.
+LADDER: "dict[str, int]" = {"kOk": 0, "kSuspect": 1, "kConfirmed": 2}
+
+# ss_chunk.hpp PlanStats counters whose documented conservation identities
+# the chunk plane rests on (fetched + resourced - dup == unique;
+# unique + delta_skipped == total): pinned so a counter rename in the
+# real tree orphans the spec'd identity.
+PLAN_STATS_FIELDS: "set[str]" = {
+    "bytes_fetched", "bytes_resourced", "bytes_dup", "unique_bytes",
+    "bytes_delta_skipped",
+}
+
+
+class DataViolation(Exception):
+    """An invariant of the data-plane spec broken by a model step."""
+
+
+def _merge_into(m: "dict[int, int]", lo: int, hi: int) -> None:
+    """Interval-merge [lo, hi) into a map off->end (note_relay_ack)."""
+    drop = []
+    for o, e in m.items():
+        if e >= lo and o <= hi:  # touching or overlapping
+            lo = min(lo, o)
+            hi = max(hi, e)
+            drop.append(o)
+    for o in drop:
+        del m[o]
+    m[lo] = hi
+
+
+# --------------------------------------------------------------------------
+# SinkTable model
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SinkModel:
+    """One registered sink: mirrors SinkTable::Sink's coverage machine."""
+
+    cap: int
+    prefix: int = 0
+    extents: "dict[int, int]" = dataclasses.field(default_factory=dict)
+    claims: "dict[int, int]" = dataclasses.field(default_factory=dict)
+    busy: int = 0
+    cancel: bool = False
+
+    def copy(self) -> "SinkModel":
+        return SinkModel(self.cap, self.prefix, dict(self.extents),
+                         dict(self.claims), self.busy, self.cancel)
+
+    def freeze(self):
+        return (self.cap, self.prefix, tuple(sorted(self.extents.items())),
+                tuple(sorted(self.claims.items())), self.busy, self.cancel)
+
+    # -- coverage arithmetic (Sink::fully_covered / published_overlap) --
+
+    def _byte_in(self, b: int, with_claims: bool) -> bool:
+        if b < self.prefix:
+            return True
+        maps = (self.extents, self.claims) if with_claims else (self.extents,)
+        return any(o <= b < e for m in maps for o, e in m.items())
+
+    def covered_bytes(self, off: int, end: int) -> int:
+        """Bytes of [off, end) covered by prefix/extents/claims."""
+        return sum(1 for b in range(off, end) if self._byte_in(b, True))
+
+    def published_bytes(self, off: int, end: int) -> int:
+        """Bytes of [off, end) actually published (prefix/extents only)."""
+        return sum(1 for b in range(off, end) if self._byte_in(b, False))
+
+    def fully_covered(self, off: int, end: int) -> bool:
+        return self.covered_bytes(off, end) == end - off
+
+    def add_extent(self, off: int, end: int) -> None:
+        if off <= self.prefix:
+            self.prefix = max(self.prefix, end)
+            while True:
+                nxt = [o for o in self.extents if o <= self.prefix]
+                if not nxt:
+                    break
+                for o in nxt:
+                    self.prefix = max(self.prefix, self.extents.pop(o))
+        else:
+            self.extents[off] = max(self.extents.get(off, 0), end)
+
+    def published_total(self) -> int:
+        return self.published_bytes(0, self.cap)
+
+    def complete(self) -> bool:
+        return self.cap > 0 and self.prefix >= self.cap
+
+
+@dataclasses.dataclass
+class Counters:
+    """The per-edge conservation counters, folded to one aggregate."""
+
+    rx_bytes: int = 0
+    rx_relay_bytes: int = 0
+    dup_bytes: int = 0
+
+    def copy(self) -> "Counters":
+        return dataclasses.replace(self)
+
+    def freeze(self):
+        return (self.rx_bytes, self.rx_relay_bytes, self.dup_bytes)
+
+
+class TableModel:
+    """Mirror of SinkTable: sinks, queued frames, parked relay windows,
+    retired tag ranges, and the conservation counters.
+
+    Overridable RULE methods (the mutation-test surface, mirroring
+    MasterModel's style):
+
+      * ``dedup_direct``   — the fully-covered first-arrival-wins verdict
+                             the kData fast path runs before claiming;
+      * ``dup_on_commit``  — the duplicate-byte accounting of a committed
+                             direct write (bytes that did not grow
+                             coverage count as duplicates);
+      * ``unretire_on_register`` — register_sink's single-tag un-retire
+                             that makes tag reuse across op incarnations
+                             legal.
+    """
+
+    def __init__(self) -> None:
+        self.sinks: "dict[int, SinkModel]" = {}
+        self.queues: "dict[int, tuple]" = {}      # tag -> ((off, len), ...)
+        self.relay_pending: "dict[int, tuple]" = {}
+        self.retired: "tuple[tuple[int, int], ...]" = ()
+        self.counters = Counters()
+        self.retained = 0  # published coverage of retired/unregistered sinks
+
+    # ---- copy/freeze ----
+
+    def copy(self) -> "TableModel":
+        t = type(self)()
+        t.sinks = {k: s.copy() for k, s in self.sinks.items()}
+        t.queues = dict(self.queues)
+        t.relay_pending = dict(self.relay_pending)
+        t.retired = self.retired
+        t.counters = self.counters.copy()
+        t.retained = self.retained
+        return t
+
+    def freeze(self):
+        return (tuple((k, s.freeze()) for k, s in sorted(self.sinks.items())),
+                tuple(sorted(self.queues.items())),
+                tuple(sorted(self.relay_pending.items())),
+                self.retired, self.counters.freeze(), self.retained)
+
+    # ---- retire machinery ----
+
+    def is_retired(self, tag: int) -> bool:
+        return any(lo <= tag < hi for lo, hi in self.retired)
+
+    def unretire_on_register(self, tag: int) -> None:
+        # RULE: register_sink removes a completed-tag marker (single-tag
+        # entries from unregister_sink) — re-registration means the tag is
+        # live again, so tag reuse across op incarnations stays legal.
+        self.retired = tuple((lo, hi) for lo, hi in self.retired
+                             if not (lo == tag and hi == tag + 1))
+
+    # ---- dedup rules ----
+
+    def dedup_direct(self, s: SinkModel, off: int, end: int) -> bool:
+        # RULE: the kData fast path drops (and counts) a frame whose whole
+        # range is already covered by prefix/extents/claims — first
+        # verified arrival wins; published bytes are never rewritten under
+        # a consumer.
+        return s.fully_covered(off, end)
+
+    def dup_on_commit(self, length: int, fresh: int) -> int:
+        # RULE: a committed direct write whose range partially overlapped
+        # already-published bytes grew coverage by `fresh` only — the
+        # remainder is a duplicate and must be counted, or the identity
+        # rx + relay - dup == unique drifts on every relay-vs-direct race
+        # whose window boundaries misalign (model-checker finding; see the
+        # published_overlap accounting in sockets.cpp's rx_loop).
+        return length - fresh
+
+    # ---- sink lifecycle ----
+
+    def register_sink(self, tag: int, cap: int) -> None:
+        self.unretire_on_register(tag)
+        s = SinkModel(cap)
+        # frames that raced ahead of registration were queued with offsets
+        for off, length in self.queues.pop(tag, ()):
+            if off + length <= cap:
+                s.add_extent(off, off + length)
+        self.sinks[tag] = s
+        # parked failover windows: place with the same dedupe + accounting
+        # as a live delivery
+        for off, length in self.relay_pending.pop(tag, ()):
+            delivered = 0
+            if not s.cancel and off + length <= cap:
+                delivered, _ = self._place_deduped(s, off, length)
+            self.counters.rx_relay_bytes += length
+            self.counters.dup_bytes += length - delivered
+
+    def unregister_sink(self, tag: int) -> None:
+        s = self.sinks.get(tag)
+        if s is None:
+            return
+        if s.busy:
+            raise DataViolation(
+                "unregister_sink while a writer is busy — the real table "
+                "waits out wait_not_busy_range first (model ordering bug)")
+        complete = s.complete()
+        self.retained += s.published_total()
+        del self.sinks[tag]
+        if complete:
+            self.retired = self.retired + ((tag, tag + 1),)
+
+    def purge(self, tag: int) -> None:
+        """purge_range([tag, tag+1)): cancel, drop, retire. The model
+        counts dropped queued bytes as duplicates (see module docstring)."""
+        s = self.sinks.get(tag)
+        if s is not None:
+            if s.busy:
+                raise DataViolation("purge finishing with a busy writer — "
+                                    "wait_not_busy_range ordering bug")
+            self.retained += s.published_total()
+            del self.sinks[tag]
+        for off, length in self.queues.pop(tag, ()):
+            if off != "hdr":
+                self.counters.dup_bytes += length
+        for off, length in self.relay_pending.pop(tag, ()):
+            self.counters.rx_relay_bytes += length
+            self.counters.dup_bytes += length
+        self.retired = self.retired + ((tag, tag + 1),)
+
+    # ---- frame arrival (the rx_loop arms) ----
+
+    def data_begin(self, tag: int, off: int, length: int) -> str:
+        """kData header parsed: dedupe verdict + claim. Returns 'claimed',
+        'dup' (drained + counted), or 'queued'."""
+        end = off + length
+        s = self.sinks.get(tag)
+        if s is not None and not s.cancel and end <= s.cap:
+            if self.dedup_direct(s, off, end):
+                self.counters.rx_bytes += length
+                self.counters.dup_bytes += length
+                return "dup"
+            s.busy += 1
+            # claim before writing: a concurrent failover delivery must
+            # skip (not republish) the range we are filling
+            s.claims[off] = max(s.claims.get(off, 0), end)
+            return "claimed"
+        if self.is_retired(tag) or s is not None:
+            # post-completion straggler, or cancelled/overflow: drain+count
+            self.counters.rx_bytes += length
+            self.counters.dup_bytes += length
+            return "dup"
+        # no sink yet: queue for registration. Exact-range duplicates are
+        # dropped and counted here — a re-issued window racing sink
+        # registration must not queue twice (both copies would later
+        # publish as extents with no dup accounting; model-checker
+        # finding, mirrored by the queue dedupe in sockets.cpp).
+        if (off, length) in self.queues.get(tag, ()):
+            self.counters.rx_bytes += length
+            self.counters.dup_bytes += length
+            return "dup"
+        self.queues[tag] = self.queues.get(tag, ()) + ((off, length),)
+        self.counters.rx_bytes += length
+        return "queued"
+
+    def data_commit(self, tag: int, off: int, length: int) -> None:
+        """The claimed write finished cleanly: publish + account."""
+        end = off + length
+        s = self.sinks.get(tag)
+        if s is None:
+            raise DataViolation("commit for an unregistered sink — busy "
+                                "must pin the sink (wait_not_busy_range)")
+        s.busy -= 1
+        fresh = length - s.published_bytes(off, end)
+        s.claims.pop(off, None)
+        s.add_extent(off, end)
+        self.counters.rx_bytes += length
+        self.counters.dup_bytes += self.dup_on_commit(length, fresh)
+
+    def data_die(self, tag: int, off: int, length: int) -> None:
+        """Conn died mid-write: claim released, nothing published, and no
+        rx is counted for the torn frame (see the module docstring)."""
+        s = self.sinks.get(tag)
+        if s is None:
+            raise DataViolation("mid-write death for an unregistered sink")
+        s.busy -= 1
+        s.claims.pop(off, None)
+
+    def _place_deduped(self, s: SinkModel, off: int,
+                       length: int) -> "tuple[int, tuple[int, ...]]":
+        """Byte-granular gap filling (SinkTable::place_deduped): fill only
+        what prefix/extents/claims leave open; never touch a claim.
+        Returns (delivered, placed byte positions)."""
+        placed = []
+        for b in range(off, off + length):
+            if s._byte_in(b, True):
+                continue
+            placed.append(b)
+            s.add_extent(b, b + 1)
+        return len(placed), tuple(placed)
+
+    def deliver_window(self, tag: int, off: int, length: int) -> bool:
+        """kRelayDeliver handled (SinkTable::deliver_window). Placed bytes
+        publish; the remainder counts duplicate. Returns whether the range
+        is DURABLY accounted for afterwards — the kRelayAck gate: bytes
+        skipped against a mid-write CLAIM are not durable (the claim
+        holder can die and tear them), so such a window must not be acked
+        (model-checker finding, relay_vs_direct_deaths)."""
+        if self.is_retired(tag):
+            self.counters.rx_relay_bytes += length
+            self.counters.dup_bytes += length
+            return True  # finished op: its bytes are settled
+        s = self.sinks.get(tag)
+        if s is None:
+            # raced ahead of the stage's registration: park it — held
+            # verbatim until the sink appears, so the range is durable
+            self.relay_pending[tag] = (self.relay_pending.get(tag, ())
+                                       + ((off, length),))
+            return True
+        delivered = 0
+        ack_ok = False
+        if not s.cancel and off + length <= s.cap:
+            claims_before = dict(s.claims)
+            delivered, placed = self._place_deduped(s, off, length)
+            for b in placed:
+                if any(o <= b < e for o, e in claims_before.items()):
+                    raise DataViolation(
+                        f"relay placement published byte {b} inside a "
+                        "claimed range another writer is filling — "
+                        "double-publish into a claimed range")
+            ack_ok = s.published_bytes(off, off + length) == length
+        else:
+            # cancelled: the consumer is tossing the op, acking cannot
+            # lose wanted bytes; overflow: malformed, never acked
+            ack_ok = s.cancel
+        self.counters.rx_relay_bytes += length
+        self.counters.dup_bytes += length - delivered
+        return ack_ok
+
+    def chunk_hdr(self, tag: int, status: int) -> None:
+        """kChunkHdr queued for the fetch worker — dropped if retired."""
+        if self.is_retired(tag):
+            return
+        self.queues[tag] = self.queues.get(tag, ()) + (("hdr", status),)
+
+    def take_hdr_peek(self, tag: int) -> bool:
+        return any(item[0] == "hdr" for item in self.queues.get(tag, ()))
+
+    def take_hdr(self, tag: int) -> "int | None":
+        q = self.queues.get(tag, ())
+        for i, item in enumerate(q):
+            if item[0] == "hdr":
+                self.queues[tag] = q[:i] + q[i + 1:]
+                if not self.queues[tag]:
+                    del self.queues[tag]
+                return item[1]
+        return None
+
+    # ---- the conservation identity ----
+
+    def unique_truth(self) -> int:
+        """Ground-truth unique payload: published coverage of live sinks,
+        retained coverage of finished ones, and data bytes held queued."""
+        live = sum(s.published_total() for s in self.sinks.values())
+        queued = sum(length for q in self.queues.values()
+                     for off, length in q if off != "hdr")
+        return self.retained + live + queued
+
+    def byte_present(self, tag: int, b: int) -> bool:
+        """Is byte `b` of `tag` accounted for receiver-side? (placed,
+        queued, parked, or legitimately dropped on a finished/cancelled
+        sink) — the ack-retire soundness witness.
+
+        A LIVE sink takes precedence over a retired marker: in the correct
+        model the two never coexist (register_sink un-retires), and a
+        mutant that breaks the unretire rule must not get its wrongly-kept
+        marker accepted as evidence that the live op's bytes arrived."""
+        s = self.sinks.get(tag)
+        if s is not None:
+            if s.cancel or s._byte_in(b, True):
+                return True
+        elif self.is_retired(tag):
+            return True
+        for off, length in self.queues.get(tag, ()):
+            if off != "hdr" and off <= b < off + length:
+                return True
+        for off, length in self.relay_pending.get(tag, ()):
+            if off <= b < off + length:
+                return True
+        return False
+
+    def check_conservation(self) -> None:
+        c = self.counters
+        truth = self.unique_truth()
+        if c.rx_bytes + c.rx_relay_bytes - c.dup_bytes != truth:
+            raise DataViolation(
+                f"byte conservation violated: rx {c.rx_bytes} + relay "
+                f"{c.rx_relay_bytes} - dup {c.dup_bytes} != unique {truth} "
+                "— a copy was double-published or a duplicate went "
+                "uncounted")
+
+
+# --------------------------------------------------------------------------
+# origin-side ack machine (Client::note_relay_ack / relay_ack_covered)
+# --------------------------------------------------------------------------
+
+
+class AckModel:
+    """The origin's merged relay-ack coverage, per tag.
+
+    Overridable RULE methods:
+
+      * ``note_ack``    — interval-MERGE the acked range (adjacent and
+                          overlapping acks coalesce into one interval);
+      * ``ack_covered`` — containment: one merged interval must span the
+                          whole queried range before a zombie may retire.
+    """
+
+    def __init__(self) -> None:
+        self.acks: "dict[int, dict[int, int]]" = {}
+
+    def copy(self) -> "AckModel":
+        a = type(self)()
+        a.acks = {t: dict(m) for t, m in self.acks.items()}
+        return a
+
+    def freeze(self):
+        return tuple((t, tuple(sorted(m.items())))
+                     for t, m in sorted(self.acks.items()))
+
+    def note_ack(self, tag: int, off: int, length: int) -> None:
+        if length == 0:
+            return
+        _merge_into(self.acks.setdefault(tag, {}), off, off + length)
+
+    def ack_covered(self, tag: int, off: int, length: int) -> bool:
+        m = self.acks.get(tag)
+        if not m:
+            return False
+        return any(o <= off and e >= off + length for o, e in m.items())
